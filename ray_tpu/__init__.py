@@ -1,0 +1,92 @@
+"""ray_tpu: a TPU-native distributed compute framework.
+
+A distributed futures core (tasks, actors, objects, placement groups) with ML
+libraries on top — train (JaxTrainer), data (streaming datasets), tune
+(experiments), rllib (RL), serve — designed JAX/XLA/pjit/Pallas-first.
+Capability-equivalent to the reference lorenzoritter/ray (see SURVEY.md), not a
+port: TPU collectives ride ICI via XLA sharding, the object store moves host
+bytes and references, and the control plane stays off the training hot path.
+
+Top-level surface mirrors `ray.*`:
+
+    import ray_tpu
+    ray_tpu.init()
+    @ray_tpu.remote
+    def f(x): return x + 1
+    ray_tpu.get(f.remote(1))
+"""
+from __future__ import annotations
+
+from .core.api import (
+    ActorClass,
+    ActorHandle,
+    RemoteFunction,
+    available_resources,
+    cluster_resources,
+    free,
+    get,
+    get_actor,
+    get_runtime_context,
+    init,
+    is_initialized,
+    kill,
+    nodes,
+    put,
+    remote,
+    shutdown,
+    wait,
+)
+from .core.controller import (
+    ActorDiedError,
+    DependencyError,
+    GetTimeoutError,
+    RayTpuError,
+    TaskError,
+    WorkerCrashedError,
+)
+from .core.placement_group import placement_group, remove_placement_group
+from .core.serialization import ObjectRef
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "init",
+    "shutdown",
+    "is_initialized",
+    "remote",
+    "get",
+    "put",
+    "wait",
+    "free",
+    "kill",
+    "get_actor",
+    "get_runtime_context",
+    "cluster_resources",
+    "available_resources",
+    "nodes",
+    "placement_group",
+    "remove_placement_group",
+    "ObjectRef",
+    "ActorHandle",
+    "ActorClass",
+    "RemoteFunction",
+    "RayTpuError",
+    "TaskError",
+    "GetTimeoutError",
+    "WorkerCrashedError",
+    "ActorDiedError",
+    "DependencyError",
+    "__version__",
+]
+
+
+def __getattr__(name):
+    # Lazy subpackage access: `ray_tpu.train`, `ray_tpu.data`, ... import on
+    # first touch so core stays jax-free for lightweight worker processes.
+    import importlib
+
+    if name in ("train", "data", "tune", "rllib", "serve", "parallel", "models", "ops", "util", "workflow"):
+        mod = importlib.import_module(f"ray_tpu.{name}")
+        globals()[name] = mod
+        return mod
+    raise AttributeError(f"module 'ray_tpu' has no attribute {name!r}")

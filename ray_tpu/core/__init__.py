@@ -1,0 +1,1 @@
+"""Distributed futures core: controller, workers, object store, public API."""

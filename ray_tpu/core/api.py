@@ -1,0 +1,525 @@
+"""Public core API: init/shutdown, @remote tasks, actors, get/put/wait.
+
+Parity surface with the reference's L2 API (ray: python/ray/_private/worker.py
+init:1214 get:2523 put:2655 wait:2720, remote_function.py:266, actor.py:566),
+implemented over the asyncio controller instead of a C++ CoreWorker. See
+SURVEY.md §2.1 mapping note for why the Python control plane is acceptable on
+TPU: per-step data movement belongs to XLA programs, not to this layer.
+"""
+from __future__ import annotations
+
+import atexit
+import functools
+import os
+import threading
+import time
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Union
+
+import cloudpickle
+
+from . import context as ctx
+from .client import CoreClient, EventLoopThread
+from .controller import Controller, GetTimeoutError, TaskError
+from .ids import ActorID, NodeID, ObjectID, TaskID
+from .object_store import get_bytes, put_bytes
+from .serialization import ObjectRef, pack_args
+
+_init_lock = threading.RLock()
+_owned_controller: Optional[Controller] = None
+_controller_io: Optional[EventLoopThread] = None
+
+
+# ------------------------------------------------------------------ lifecycle
+
+
+def init(
+    address: Optional[str] = None,
+    *,
+    num_cpus: Optional[int] = None,
+    num_tpus: Optional[int] = None,
+    resources: Optional[Dict[str, float]] = None,
+    namespace: str = "default",
+    ignore_reinit_error: bool = False,
+) -> "ClusterHandle":
+    """Start (or connect to) a cluster and bind this process as the driver.
+
+    With no ``address`` a local controller is started in-process and one
+    virtual node is registered with the host's resources (reference:
+    ray.init starting GCS+raylet, _private/node.py:1342).
+    """
+    global _owned_controller, _controller_io
+    with _init_lock:
+        if ctx.is_initialized():
+            if ignore_reinit_error:
+                return ClusterHandle(ctx.get_worker_context())
+            raise RuntimeError("ray_tpu.init() called twice; pass ignore_reinit_error=True")
+
+        if address is None:
+            from ray_tpu.util.accelerators import detect_tpu_chips
+
+            io = EventLoopThread(name="rtpu-controller")
+            controller = Controller()
+            host, port = io.call(controller.start(), timeout=10)
+            node_res: Dict[str, float] = {
+                "CPU": float(num_cpus if num_cpus is not None else os.cpu_count() or 1),
+            }
+            tpus = num_tpus if num_tpus is not None else detect_tpu_chips()
+            if tpus:
+                node_res["TPU"] = float(tpus)
+            if resources:
+                node_res.update(resources)
+            node_id = controller.add_node(node_res, labels={"head": "1"})
+            _owned_controller = controller
+            _controller_io = io
+            address = f"{host}:{port}"
+        else:
+            node_id = ""
+
+        host, port_s = address.rsplit(":", 1)
+        client = CoreClient(host, int(port_s), handler=_driver_handler)
+        client.request({"kind": "register", "role": "driver"})
+        if not node_id:
+            state = client.request({"kind": "cluster_state"})
+            node_id = state["nodes"][0]["node_id"] if state["nodes"] else ""
+        wc = ctx.WorkerContext(client=client, node_id=node_id, role="driver", namespace=namespace)
+        wc.extra["address"] = address
+        ctx.set_worker_context(wc)
+        atexit.register(_atexit_shutdown)
+        return ClusterHandle(wc)
+
+
+async def _driver_handler(conn, msg):
+    if msg.get("kind") == "pubsub":
+        ctx.deliver_pubsub(msg["channel"], msg["data"])
+    return None
+
+
+def _atexit_shutdown() -> None:
+    try:
+        shutdown()
+    except Exception:
+        pass
+
+
+def shutdown() -> None:
+    global _owned_controller, _controller_io
+    with _init_lock:
+        if not ctx.is_initialized():
+            return
+        wc = ctx.get_worker_context()
+        if _owned_controller is not None and _controller_io is not None:
+            try:
+                _controller_io.call(_owned_controller.shutdown(), timeout=5)
+            except Exception:
+                pass
+        try:
+            wc.client.close()
+        except Exception:
+            pass
+        if _controller_io is not None:
+            _controller_io.stop()
+        _owned_controller = None
+        _controller_io = None
+        ctx.set_worker_context(None)
+        from .object_store import close_process_segments
+
+        close_process_segments()
+
+
+def is_initialized() -> bool:
+    return ctx.is_initialized()
+
+
+@dataclass
+class ClusterHandle:
+    wc: ctx.WorkerContext
+
+    @property
+    def address(self) -> str:
+        return self.wc.extra.get("address", "")
+
+
+# ------------------------------------------------------------------- get/put
+
+
+def put(value: Any) -> ObjectRef:
+    wc = ctx.get_worker_context()
+    oid = ObjectID.generate()
+    loc = put_bytes(value, oid, wc.node_id)
+    wc.client.request({"kind": "put_location", "loc": loc})
+    return ObjectRef(oid)
+
+
+def _with_block_notify(fn: Callable[[], Any]) -> Any:
+    """Release this task's CPU while blocked in get/wait (reference:
+    NotifyDirectCallTaskBlocked, src/ray/raylet_client/raylet_client.h:380)."""
+    wc = ctx.get_worker_context()
+    task_id = ctx.current_task_id()
+    if task_id is None or wc.role != "worker":
+        return fn()
+    wc.client.request({"kind": "task_blocked", "task_id": task_id})
+    try:
+        return fn()
+    finally:
+        try:
+            wc.client.request({"kind": "task_unblocked", "task_id": task_id})
+        except Exception:
+            pass
+
+
+def get(refs: Union[ObjectRef, Sequence[ObjectRef]], *, timeout: Optional[float] = None) -> Any:
+    wc = ctx.get_worker_context()
+    single = isinstance(refs, ObjectRef)
+    ref_list: List[ObjectRef] = [refs] if single else list(refs)
+    for r in ref_list:
+        if not isinstance(r, ObjectRef):
+            raise TypeError(f"get() expects ObjectRef(s), got {type(r)}")
+    ids = [r.object_id for r in ref_list]
+
+    def fetch():
+        return wc.client.request(
+            {"kind": "get_locations", "object_ids": ids, "timeout": timeout}
+        )
+
+    locs = _with_block_notify(fetch)
+    out = []
+    for oid in ids:
+        loc = locs[oid]
+        val = get_bytes(loc)
+        if loc.is_error:
+            if isinstance(val, BaseException):
+                raise val
+            raise RuntimeError(str(val))
+        out.append(val)
+    return out[0] if single else out
+
+
+def wait(
+    refs: Sequence[ObjectRef],
+    *,
+    num_returns: int = 1,
+    timeout: Optional[float] = None,
+    fetch_local: bool = True,
+) -> Tuple[List[ObjectRef], List[ObjectRef]]:
+    wc = ctx.get_worker_context()
+    ids = [r.object_id for r in refs]
+    if num_returns > len(ids):
+        raise ValueError("num_returns exceeds number of refs")
+
+    def do():
+        return wc.client.request(
+            {"kind": "wait", "object_ids": ids, "num_returns": num_returns, "timeout": timeout}
+        )
+
+    ready_ids = set(_with_block_notify(do))
+    ready = [r for r in refs if r.object_id in ready_ids]
+    not_ready = [r for r in refs if r.object_id not in ready_ids]
+    return ready, not_ready
+
+
+def free(refs: Sequence[ObjectRef]) -> None:
+    wc = ctx.get_worker_context()
+    wc.client.request({"kind": "free_objects", "object_ids": [r.object_id for r in refs]})
+
+
+# ------------------------------------------------------------------- tasks
+
+
+def _normalize_strategy(scheduling_strategy: Any) -> Tuple[Dict[str, Any], Optional[Tuple[str, int]]]:
+    """Returns (strategy dict, pg tuple)."""
+    from ray_tpu.util.scheduling_strategies import (
+        NodeAffinitySchedulingStrategy,
+        NodeLabelSchedulingStrategy,
+        PlacementGroupSchedulingStrategy,
+    )
+
+    if scheduling_strategy is None or scheduling_strategy == "DEFAULT":
+        return {"type": "DEFAULT"}, None
+    if scheduling_strategy == "SPREAD":
+        return {"type": "SPREAD"}, None
+    if isinstance(scheduling_strategy, NodeAffinitySchedulingStrategy):
+        return (
+            {"type": "NODE_AFFINITY", "node_id": scheduling_strategy.node_id,
+             "soft": scheduling_strategy.soft},
+            None,
+        )
+    if isinstance(scheduling_strategy, NodeLabelSchedulingStrategy):
+        return {"type": "NODE_LABEL", "labels": scheduling_strategy.hard}, None
+    if isinstance(scheduling_strategy, PlacementGroupSchedulingStrategy):
+        pg = scheduling_strategy.placement_group
+        idx = scheduling_strategy.placement_group_bundle_index
+        if idx is None or idx < 0:
+            idx = 0
+        return {"type": "DEFAULT"}, (pg.id, idx)
+    raise ValueError(f"unknown scheduling strategy {scheduling_strategy!r}")
+
+
+class RemoteFunction:
+    """Handle produced by @remote on a function (reference:
+    python/ray/remote_function.py:266 RemoteFunction._remote)."""
+
+    def __init__(self, fn: Callable, options: Optional[Dict[str, Any]] = None):
+        self._fn = fn
+        self._options = options or {}
+        self._func_id: Optional[str] = None
+        self._registered_with: Optional[str] = None
+        functools.update_wrapper(self, fn)
+
+    def options(self, **opts) -> "RemoteFunction":
+        new = RemoteFunction(self._fn, {**self._options, **opts})
+        new._func_id = self._func_id
+        new._registered_with = self._registered_with
+        return new
+
+    def _ensure_registered(self, wc: ctx.WorkerContext) -> str:
+        key = wc.client.token
+        if self._func_id is None or self._registered_with != key:
+            blob = cloudpickle.dumps(self._fn)
+            func_id = TaskID.generate()
+            wc.client.request({"kind": "register_function", "func_id": func_id, "blob": blob})
+            self._func_id = func_id
+            self._registered_with = key
+        return self._func_id
+
+    def remote(self, *args, **kwargs):
+        wc = ctx.get_worker_context()
+        func_id = self._ensure_registered(wc)
+        opts = self._options
+        num_returns = opts.get("num_returns", 1)
+        resources = dict(opts.get("resources", {}) or {})
+        resources["CPU"] = float(opts.get("num_cpus", 1 if "num_tpus" not in opts else 0))
+        if opts.get("num_tpus"):
+            resources["TPU"] = float(opts["num_tpus"])
+        strategy, pg = _normalize_strategy(opts.get("scheduling_strategy"))
+        args_blob, deps = pack_args(args, kwargs)
+        return_ids = [ObjectID.generate() for _ in range(max(num_returns, 0))]
+        spec = {
+            "task_id": TaskID.generate(),
+            "func_id": func_id,
+            "args_blob": args_blob,
+            "deps": deps,
+            "return_ids": return_ids,
+            "resources": {k: v for k, v in resources.items() if v},
+            "scheduling": strategy,
+            "pg": pg,
+            "label": getattr(self._fn, "__name__", "task"),
+        }
+        wc.client.request({"kind": "submit_task", "spec": spec})
+        refs = [ObjectRef(oid) for oid in return_ids]
+        if num_returns == 1:
+            return refs[0]
+        if num_returns == 0:
+            return None
+        return refs
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            f"remote function {self.__name__!r} cannot be called directly; "
+            f"use .remote() or access the underlying function via ._fn"
+        )
+
+
+# ------------------------------------------------------------------- actors
+
+
+class ActorMethod:
+    def __init__(self, handle: "ActorHandle", name: str, num_returns: int = 1):
+        self._handle = handle
+        self._name = name
+        self._num_returns = num_returns
+
+    def options(self, num_returns: int = 1) -> "ActorMethod":
+        return ActorMethod(self._handle, self._name, num_returns)
+
+    def remote(self, *args, **kwargs):
+        return self._handle._submit(self._name, args, kwargs, self._num_returns)
+
+
+class ActorHandle:
+    """Client-side handle to an actor (reference: actor.py ActorHandle)."""
+
+    def __init__(self, actor_id: str, method_names: Sequence[str]):
+        self._actor_id = actor_id
+        self._method_names = list(method_names)
+
+    def __getattr__(self, name: str) -> ActorMethod:
+        if name.startswith("_"):
+            raise AttributeError(name)
+        if self._method_names and name not in self._method_names:
+            raise AttributeError(f"actor has no method {name!r}")
+        return ActorMethod(self, name)
+
+    def _submit(self, method: str, args, kwargs, num_returns: int):
+        wc = ctx.get_worker_context()
+        args_blob, deps = pack_args(args, kwargs)
+        return_ids = [ObjectID.generate() for _ in range(max(num_returns, 0))]
+        spec = {
+            "task_id": TaskID.generate(),
+            "actor_id": self._actor_id,
+            "method_name": method,
+            "args_blob": args_blob,
+            "deps": deps,
+            "return_ids": return_ids,
+            "resources": {},
+            "label": f"actor.{method}",
+        }
+        wc.client.request({"kind": "submit_actor_task", "spec": spec})
+        refs = [ObjectRef(oid) for oid in return_ids]
+        if num_returns == 1:
+            return refs[0]
+        if num_returns == 0:
+            return None
+        return refs
+
+    def __reduce__(self):
+        return (ActorHandle, (self._actor_id, self._method_names))
+
+    def __repr__(self) -> str:
+        return f"ActorHandle({self._actor_id[:16]})"
+
+
+class ActorClass:
+    def __init__(self, cls: type, options: Optional[Dict[str, Any]] = None):
+        self._cls = cls
+        self._options = options or {}
+        self._func_id: Optional[str] = None
+        self._registered_with: Optional[str] = None
+
+    def options(self, **opts) -> "ActorClass":
+        new = ActorClass(self._cls, {**self._options, **opts})
+        new._func_id = self._func_id
+        new._registered_with = self._registered_with
+        return new
+
+    def _ensure_registered(self, wc: ctx.WorkerContext) -> str:
+        key = wc.client.token
+        if self._func_id is None or self._registered_with != key:
+            blob = cloudpickle.dumps(self._cls)
+            func_id = TaskID.generate()
+            wc.client.request({"kind": "register_function", "func_id": func_id, "blob": blob})
+            self._func_id = func_id
+            self._registered_with = key
+        return self._func_id
+
+    def remote(self, *args, **kwargs) -> ActorHandle:
+        wc = ctx.get_worker_context()
+        func_id = self._ensure_registered(wc)
+        opts = self._options
+        resources = dict(opts.get("resources", {}) or {})
+        # Actors default to 0 CPU while alive (reference semantics — this is
+        # what lets 40k actors coexist on a node; ray actor.py default).
+        resources["CPU"] = float(opts.get("num_cpus", 0))
+        if opts.get("num_tpus"):
+            resources["TPU"] = float(opts["num_tpus"])
+        strategy, pg = _normalize_strategy(opts.get("scheduling_strategy"))
+        args_blob, deps = pack_args(args, kwargs)
+        actor_id = ActorID.generate()
+        method_names = [
+            n for n in dir(self._cls)
+            if not n.startswith("_") and callable(getattr(self._cls, n, None))
+        ]
+        spec = {
+            "task_id": TaskID.generate(),
+            "actor_id": actor_id,
+            "func_id": func_id,
+            "args_blob": args_blob,
+            "deps": deps,
+            "return_ids": [],
+            "resources": {k: v for k, v in resources.items() if v},
+            "scheduling": strategy,
+            "pg": pg,
+            "name": opts.get("name"),
+            "namespace": wc.namespace,
+            "detached": opts.get("lifetime") == "detached",
+            "max_concurrency": opts.get("max_concurrency", 1),
+            "label": f"{self._cls.__name__}.__init__",
+        }
+        wc.client.request({"kind": "create_actor", "spec": spec})
+        wc.client.request(
+            {"kind": "kv_put", "ns": "__actor_methods__", "key": actor_id,
+             "value": cloudpickle.dumps(method_names)}
+        )
+        return ActorHandle(actor_id, method_names)
+
+
+def remote(*args, **kwargs):
+    """``@remote`` decorator for functions and classes, with option form
+    ``@remote(num_cpus=..., num_tpus=..., resources=..., ...)``."""
+
+    def wrap(target):
+        if isinstance(target, type):
+            return ActorClass(target, kwargs)
+        return RemoteFunction(target, kwargs)
+
+    if len(args) == 1 and not kwargs and (callable(args[0]) or isinstance(args[0], type)):
+        return wrap(args[0])
+    if args:
+        raise TypeError("use @remote or @remote(**options)")
+    return wrap
+
+
+def kill(actor: ActorHandle, *, no_restart: bool = True) -> None:
+    wc = ctx.get_worker_context()
+    wc.client.request({"kind": "kill_actor", "actor_id": actor._actor_id})
+
+
+def get_actor(name: str, namespace: Optional[str] = None) -> ActorHandle:
+    wc = ctx.get_worker_context()
+    info = wc.client.request(
+        {"kind": "get_named_actor", "name": name, "namespace": namespace or wc.namespace}
+    )
+    methods_blob = wc.client.request(
+        {"kind": "kv_get", "ns": "__actor_methods__", "key": info["actor_id"]}
+    )
+    methods = cloudpickle.loads(methods_blob) if methods_blob else []
+    return ActorHandle(info["actor_id"], methods)
+
+
+# --------------------------------------------------------------- cluster info
+
+
+def cluster_resources() -> Dict[str, float]:
+    wc = ctx.get_worker_context()
+    state = wc.client.request({"kind": "cluster_state"})
+    out: Dict[str, float] = {}
+    for n in state["nodes"]:
+        for k, v in n["resources"].items():
+            out[k] = out.get(k, 0.0) + v
+    return out
+
+
+def available_resources() -> Dict[str, float]:
+    wc = ctx.get_worker_context()
+    state = wc.client.request({"kind": "cluster_state"})
+    out: Dict[str, float] = {}
+    for n in state["nodes"]:
+        for k, v in n["available"].items():
+            out[k] = out.get(k, 0.0) + v
+    return out
+
+
+def nodes() -> List[Dict[str, Any]]:
+    wc = ctx.get_worker_context()
+    return ctx.get_worker_context().client.request({"kind": "cluster_state"})["nodes"]
+
+
+@dataclass
+class RuntimeContext:
+    node_id: str
+    namespace: str
+    task_id: Optional[str]
+    actor_id: Optional[str]
+
+    def get_node_id(self) -> str:
+        return self.node_id
+
+
+def get_runtime_context() -> RuntimeContext:
+    wc = ctx.get_worker_context()
+    return RuntimeContext(
+        node_id=wc.node_id,
+        namespace=wc.namespace,
+        task_id=ctx.current_task_id(),
+        actor_id=ctx.current_actor_id(),
+    )

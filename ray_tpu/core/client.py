@@ -1,0 +1,83 @@
+"""Synchronous client over the asyncio control-plane connection.
+
+Both the driver and every worker process embed one of these — the analog of
+the reference's CoreWorker library (ray: src/ray/core_worker/core_worker.h:292)
+being linked into driver and worker processes alike. A dedicated thread runs
+the asyncio loop; public methods are thread-safe and synchronous.
+"""
+from __future__ import annotations
+
+import asyncio
+import threading
+from typing import Any, Awaitable, Callable, Dict, Optional
+
+from . import protocol
+
+
+class EventLoopThread:
+    def __init__(self, name: str = "rtpu-io"):
+        self.loop = asyncio.new_event_loop()
+        self.thread = threading.Thread(target=self._run, name=name, daemon=True)
+        self.thread.start()
+
+    def _run(self) -> None:
+        asyncio.set_event_loop(self.loop)
+        self.loop.run_forever()
+
+    def call(self, coro: Awaitable[Any], timeout: Optional[float] = None) -> Any:
+        fut = asyncio.run_coroutine_threadsafe(coro, self.loop)
+        return fut.result(timeout)
+
+    def call_nowait(self, coro: Awaitable[Any]) -> "asyncio.Future":
+        return asyncio.run_coroutine_threadsafe(coro, self.loop)
+
+    def stop(self) -> None:
+        def _drain() -> None:
+            for t in asyncio.all_tasks(self.loop):
+                t.cancel()
+            self.loop.call_soon(self.loop.stop)
+
+        try:
+            self.loop.call_soon_threadsafe(_drain)
+        except RuntimeError:
+            return
+        self.thread.join(timeout=2)
+
+
+class CoreClient:
+    """Thread-safe request/push client to the controller."""
+
+    def __init__(
+        self,
+        host: str,
+        port: int,
+        handler: Optional[Callable[[protocol.Connection, Dict[str, Any]], Awaitable[Any]]] = None,
+        loop_thread: Optional[EventLoopThread] = None,
+    ):
+        self.io = loop_thread or EventLoopThread()
+        self.host = host
+        self.port = port
+        # Stable identity for caches keyed per-connection (id() of a freed
+        # client can be reused by a new one after shutdown/re-init).
+        import secrets
+
+        self.token = secrets.token_hex(8)
+        self.conn: protocol.Connection = self.io.call(
+            protocol.connect(host, port, handler, name=f"client->{host}:{port}"), timeout=10
+        )
+
+    def request(self, msg: Dict[str, Any], timeout: Optional[float] = None) -> Any:
+        return self.io.call(self.conn.request(msg, timeout), timeout=None)
+
+    def request_async(self, msg: Dict[str, Any]) -> "asyncio.Future":
+        return self.io.call_nowait(self.conn.request(msg))
+
+    def send(self, msg: Dict[str, Any]) -> None:
+        self.io.call(self.conn.send(msg))
+
+    def close(self) -> None:
+        try:
+            self.io.call(self.conn.close(), timeout=2)
+        except Exception:
+            pass
+        self.io.stop()

@@ -1,0 +1,31 @@
+"""In-process virtual multi-node cluster for tests.
+
+Reference: python/ray/cluster_utils.py:135 `Cluster.add_node` — the mechanism
+by which "multi-node" behavior is tested on one machine. Here a virtual node
+is a resource pool in the controller with its own worker-process pool.
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from . import api, context as ctx
+
+
+class Cluster:
+    """Drive the controller owned by `ray_tpu.init()` to add virtual nodes."""
+
+    def __init__(self, initialize_head: bool = True, head_resources: Optional[Dict[str, float]] = None):
+        self.head_handle = None
+        if initialize_head:
+            res = dict(head_resources or {"CPU": 1})
+            num_cpus = int(res.pop("CPU", 1))
+            self.head_handle = api.init(num_cpus=num_cpus, resources=res)
+
+    def add_node(self, resources: Dict[str, float], labels: Optional[Dict[str, str]] = None) -> str:
+        wc = ctx.get_worker_context()
+        return wc.client.request(
+            {"kind": "add_node", "resources": dict(resources), "labels": labels or {}}
+        )["node_id"]
+
+    def shutdown(self) -> None:
+        api.shutdown()

@@ -1,0 +1,58 @@
+"""Per-process worker/driver context (reference: ray._private.worker.Worker
+singleton, python/ray/_private/worker.py:411)."""
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+from .client import CoreClient
+
+
+@dataclass
+class WorkerContext:
+    client: CoreClient
+    node_id: str
+    role: str  # "driver" | "worker"
+    namespace: str = "default"
+    extra: Dict[str, Any] = field(default_factory=dict)
+
+
+_context: Optional[WorkerContext] = None
+task_local = threading.local()
+_pubsub_callbacks: Dict[str, List[Callable[[Any], None]]] = {}
+
+
+def set_worker_context(c: Optional[WorkerContext]) -> None:
+    global _context
+    _context = c
+
+
+def get_worker_context() -> WorkerContext:
+    if _context is None:
+        raise RuntimeError("ray_tpu is not initialized; call ray_tpu.init() first")
+    return _context
+
+
+def is_initialized() -> bool:
+    return _context is not None
+
+
+def current_task_id() -> Optional[str]:
+    return getattr(task_local, "task_id", None)
+
+
+def current_actor_id() -> Optional[str]:
+    return getattr(task_local, "actor_id", None)
+
+
+def on_pubsub(channel: str, cb: Callable[[Any], None]) -> None:
+    _pubsub_callbacks.setdefault(channel, []).append(cb)
+
+
+def deliver_pubsub(channel: str, data: Any) -> None:
+    for cb in _pubsub_callbacks.get(channel, []):
+        try:
+            cb(data)
+        except Exception:
+            pass

@@ -1,0 +1,913 @@
+"""Cluster controller: control plane for the distributed futures core.
+
+Role-equivalent to the reference's GCS server + cluster scheduler
+(ray: src/ray/gcs/gcs_server/gcs_server.h:78, gcs_actor_manager.h:281,
+gcs_placement_group_manager.h:230, raylet/scheduling/cluster_task_manager.h:70),
+collapsed into one asyncio service for the single-host/virtual-multi-node
+topology that round 1 targets. Responsibilities:
+
+- membership: virtual nodes + worker processes (the reference's raylet worker
+  pool, worker_pool.h:159, becomes a per-node on-demand process pool here),
+- the object directory / memory store for inlined objects,
+- task scheduling with resource accounting, dependency resolution, and
+  scheduling strategies (DEFAULT/SPREAD/node-affinity/placement-group; the
+  reference's policy suite is raylet/scheduling/policy/),
+- the actor directory with named/detached actors and ordered per-actor
+  dispatch (gcs_actor_manager.h semantics),
+- placement groups with PACK/SPREAD/STRICT_PACK/STRICT_SPREAD bundle
+  reservation (bundle_scheduling_policy.h:82-106),
+- an internal KV store (gcs_kv_manager) and a tiny pubsub.
+
+TPU-first note: the controller is deliberately *off* the training hot path.
+Mesh formation (ray_tpu.parallel) uses it only to place host processes and
+exchange coordinator addresses; every per-step byte moves inside XLA programs.
+"""
+from __future__ import annotations
+
+import asyncio
+import os
+import subprocess
+import sys
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Set, Tuple
+
+from . import protocol
+from .ids import ActorID, NodeID, ObjectID, PlacementGroupID, TaskID, WorkerID
+from .object_store import ObjectLocation, free_segment
+
+# Worker processes a node may grow to (the reference caps via resources; this
+# is a backstop against runaway spawning on the 1-CPU CI host).
+MAX_WORKERS_PER_NODE = int(os.environ.get("RTPU_MAX_WORKERS_PER_NODE", "32"))
+
+
+def _res_fits(avail: Dict[str, float], need: Dict[str, float]) -> bool:
+    return all(avail.get(k, 0.0) + 1e-9 >= v for k, v in need.items())
+
+
+def _res_sub(avail: Dict[str, float], need: Dict[str, float]) -> None:
+    for k, v in need.items():
+        avail[k] = avail.get(k, 0.0) - v
+
+
+def _res_add(avail: Dict[str, float], need: Dict[str, float]) -> None:
+    for k, v in need.items():
+        avail[k] = avail.get(k, 0.0) + v
+
+
+@dataclass
+class NodeInfo:
+    node_id: str
+    resources: Dict[str, float]
+    available: Dict[str, float]
+    index: int
+    labels: Dict[str, str] = field(default_factory=dict)
+    alive: bool = True
+    spawning: int = 0
+    workers: Set[str] = field(default_factory=set)
+
+
+@dataclass
+class WorkerInfo:
+    worker_id: str
+    node_id: str
+    conn: protocol.Connection
+    state: str = "idle"  # idle | task | actor
+    current_task: Optional[str] = None
+    actor_ids: Set[str] = field(default_factory=set)
+    proc: Optional[subprocess.Popen] = None
+
+
+@dataclass
+class ActorInfo:
+    actor_id: str
+    name: Optional[str]
+    state: str = "pending"  # pending | alive | dead
+    worker_id: Optional[str] = None
+    node_id: Optional[str] = None
+    resources: Dict[str, float] = field(default_factory=dict)
+    pg: Optional[Tuple[str, int]] = None  # (pg_id, bundle_index)
+    creation_error: Optional[Exception] = None
+    pending_calls: List[Dict[str, Any]] = field(default_factory=list)
+    detached: bool = False
+    reserved: bool = False
+    creation_task_id: Optional[str] = None
+    order_lock: asyncio.Lock = field(default_factory=asyncio.Lock)
+
+
+@dataclass
+class Bundle:
+    resources: Dict[str, float]
+    node_id: Optional[str] = None
+    available: Dict[str, float] = field(default_factory=dict)
+
+
+@dataclass
+class PGInfo:
+    pg_id: str
+    bundles: List[Bundle]
+    strategy: str
+    name: Optional[str]
+    state: str = "pending"  # pending | ready | removed
+    ready_event: asyncio.Event = field(default_factory=asyncio.Event)
+
+
+class Controller:
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self.host = host
+        self.port = port
+        self.server: Optional[asyncio.base_events.Server] = None
+        self.nodes: Dict[str, NodeInfo] = {}
+        self.workers: Dict[str, WorkerInfo] = {}
+        self.actors: Dict[str, ActorInfo] = {}
+        self.named_actors: Dict[Tuple[str, str], str] = {}  # (namespace, name) -> actor_id
+        self.objects: Dict[str, ObjectLocation] = {}
+        self.object_waiters: Dict[str, List[asyncio.Event]] = {}
+        self.tasks: Dict[str, Dict[str, Any]] = {}  # pending/running task specs
+        self.pending_queue: List[str] = []  # task_ids awaiting scheduling
+        self.functions: Dict[str, bytes] = {}  # function/class table (gcs_function_manager)
+        self.kv: Dict[Tuple[str, str], bytes] = {}
+        self.pgs: Dict[str, PGInfo] = {}
+        self.named_pgs: Dict[str, str] = {}
+        self.subs: Dict[str, List[protocol.Connection]] = {}  # pubsub channel -> conns
+        self.driver_conns: Set[protocol.Connection] = set()
+        self._node_counter = 0
+        self._sched_wakeup = asyncio.Event()
+        self._sched_task: Optional[asyncio.Task] = None
+        self._closing = False
+        self.start_time = time.time()
+
+    # ------------------------------------------------------------------ setup
+
+    async def start(self) -> Tuple[str, int]:
+        self.server = await asyncio.start_server(self._on_connection, self.host, self.port)
+        self.port = self.server.sockets[0].getsockname()[1]
+        self._sched_task = asyncio.get_running_loop().create_task(self._scheduler_loop())
+        return self.host, self.port
+
+    def add_node(
+        self,
+        resources: Dict[str, float],
+        labels: Optional[Dict[str, str]] = None,
+        node_id: Optional[str] = None,
+    ) -> str:
+        nid = node_id or NodeID.generate()
+        self._node_counter += 1
+        self.nodes[nid] = NodeInfo(
+            node_id=nid,
+            resources=dict(resources),
+            available=dict(resources),
+            index=self._node_counter,
+            labels=labels or {},
+        )
+        self._wake_scheduler()
+        return nid
+
+    async def shutdown(self) -> None:
+        self._closing = True
+        for w in list(self.workers.values()):
+            try:
+                await w.conn.send({"kind": "shutdown"})
+            except Exception:
+                pass
+        await asyncio.sleep(0.05)
+        for w in list(self.workers.values()):
+            if w.proc is not None and w.proc.poll() is None:
+                try:
+                    w.proc.terminate()
+                except Exception:
+                    pass
+        for loc in self.objects.values():
+            if loc.shm_name:
+                free_segment(loc.shm_name)
+        self.objects.clear()
+        if self._sched_task is not None:
+            self._sched_task.cancel()
+        if self.server is not None:
+            self.server.close()
+
+    # ------------------------------------------------------- connection layer
+
+    async def _on_connection(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter) -> None:
+        conn = protocol.Connection(reader, writer, self._handle, name="controller-peer")
+        conn.start()
+        await conn.closed.wait()
+        await self._on_disconnect(conn)
+
+    async def _on_disconnect(self, conn: protocol.Connection) -> None:
+        if self._closing:
+            return
+        self.driver_conns.discard(conn)
+        dead = [w for w in self.workers.values() if w.conn is conn]
+        for w in dead:
+            await self._on_worker_death(w)
+
+    async def _on_worker_death(self, w: WorkerInfo) -> None:
+        self.workers.pop(w.worker_id, None)
+        node = self.nodes.get(w.node_id)
+        if node:
+            node.workers.discard(w.worker_id)
+        # Fail the running task, if any.
+        if w.current_task and w.current_task in self.tasks:
+            spec = self.tasks.pop(w.current_task)
+            self._release_task_resources(spec)
+            err = WorkerCrashedError(
+                f"worker {w.worker_id[:8]} died while running task {spec.get('label', '')}"
+            )
+            for oid in spec["return_ids"]:
+                self._store_error(oid, err)
+        # Mark hosted actors dead.
+        for aid in list(w.actor_ids):
+            actor = self.actors.get(aid)
+            if actor and actor.state != "dead":
+                self._mark_actor_dead(actor, WorkerCrashedError(f"actor {aid[:8]} process died"))
+        self._wake_scheduler()
+
+    # ------------------------------------------------------------ msg routing
+
+    async def _handle(self, conn: protocol.Connection, msg: Dict[str, Any]) -> Any:
+        kind = msg["kind"]
+        fn = getattr(self, f"_h_{kind}", None)
+        if fn is None:
+            raise ValueError(f"controller: unknown message kind {kind!r}")
+        return await fn(conn, msg)
+
+    # --------------------------------------------------------------- handlers
+
+    async def _h_register(self, conn, msg):
+        role = msg["role"]
+        if role == "driver":
+            self.driver_conns.add(conn)
+            return {"ok": True}
+        worker_id = msg["worker_id"]
+        node_id = msg["node_id"]
+        w = self.workers.get(worker_id)
+        if w is not None:
+            w.conn = conn  # reconnect
+        else:
+            w = WorkerInfo(worker_id=worker_id, node_id=node_id, conn=conn)
+            self.workers[worker_id] = w
+        node = self.nodes.get(node_id)
+        if node:
+            node.workers.add(worker_id)
+            node.spawning = max(0, node.spawning - 1)
+        self._wake_scheduler()
+        return {"ok": True}
+
+    async def _h_put_location(self, conn, msg):
+        loc: ObjectLocation = msg["loc"]
+        self._store_location(loc)
+        return {"ok": True}
+
+    async def _wait_for_object(self, oid: str, deadline: Optional[float] = None) -> ObjectLocation:
+        """Block until `oid` is in the object table; waiter registrations are
+        cleaned up on timeout/cancel so polling callers don't leak Events."""
+        while oid not in self.objects:
+            ev = asyncio.Event()
+            lst = self.object_waiters.setdefault(oid, [])
+            lst.append(ev)
+            try:
+                if deadline is None:
+                    await ev.wait()
+                else:
+                    remaining = max(0.0, deadline - time.monotonic())
+                    await asyncio.wait_for(ev.wait(), remaining or 1e-6)
+            finally:
+                if not ev.is_set():
+                    try:
+                        lst.remove(ev)
+                    except ValueError:
+                        pass
+                    if not lst:
+                        self.object_waiters.pop(oid, None)
+        return self.objects[oid]
+
+    async def _h_get_locations(self, conn, msg):
+        ids: List[str] = msg["object_ids"]
+        timeout = msg.get("timeout")
+        deadline = None if timeout is None else time.monotonic() + timeout
+        out: Dict[str, ObjectLocation] = {}
+        for oid in ids:
+            try:
+                out[oid] = await self._wait_for_object(oid, deadline)
+            except asyncio.TimeoutError:
+                raise GetTimeoutError(f"object {oid[:8]} not ready within {timeout}s") from None
+        return out
+
+    async def _h_wait(self, conn, msg):
+        ids: List[str] = msg["object_ids"]
+        num_returns: int = msg["num_returns"]
+        timeout = msg.get("timeout")
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            ready = [oid for oid in ids if oid in self.objects]
+            if len(ready) >= num_returns:
+                return ready[:num_returns]
+            if deadline is not None and time.monotonic() >= deadline:
+                return ready
+            waiters = [
+                asyncio.ensure_future(self._wait_for_object(oid, deadline))
+                for oid in ids
+                if oid not in self.objects
+            ]
+            remaining = None if deadline is None else max(1e-6, deadline - time.monotonic())
+            done, pend = await asyncio.wait(
+                waiters, timeout=remaining, return_when=asyncio.FIRST_COMPLETED
+            )
+            for p in pend:
+                p.cancel()
+            if pend:
+                await asyncio.gather(*pend, return_exceptions=True)
+
+    async def _h_free_objects(self, conn, msg):
+        for oid in msg["object_ids"]:
+            loc = self.objects.pop(oid, None)
+            if loc is not None and loc.shm_name:
+                free_segment(loc.shm_name)
+        return {"ok": True}
+
+    async def _h_register_function(self, conn, msg):
+        self.functions[msg["func_id"]] = msg["blob"]
+        return {"ok": True}
+
+    async def _h_fetch_function(self, conn, msg):
+        blob = self.functions.get(msg["func_id"])
+        if blob is None:
+            raise KeyError(f"function {msg['func_id']} not found in function table")
+        return blob
+
+    async def _h_submit_task(self, conn, msg):
+        spec = msg["spec"]
+        self.tasks[spec["task_id"]] = spec
+        spec["state"] = "waiting_deps"
+        await self._resolve_deps_then_queue(spec)
+        return {"ok": True}
+
+    async def _resolve_deps_then_queue(self, spec: Dict[str, Any]) -> None:
+        deps: List[str] = [d for d in spec.get("deps", []) if d not in self.objects]
+        if deps:
+            async def waiter():
+                for oid in list(deps):
+                    await self._wait_for_object(oid)
+                # Dependency errors propagate without running the task.
+                err = self._first_dep_error(spec)
+                if err is not None:
+                    self._fail_task(spec, err)
+                    return
+                spec["state"] = "pending"
+                self.pending_queue.append(spec["task_id"])
+                self._wake_scheduler()
+
+            asyncio.get_running_loop().create_task(waiter())
+        else:
+            err = self._first_dep_error(spec)
+            if err is not None:
+                self._fail_task(spec, err)
+                return
+            spec["state"] = "pending"
+            self.pending_queue.append(spec["task_id"])
+            self._wake_scheduler()
+
+    def _first_dep_error(self, spec) -> Optional[Exception]:
+        for oid in spec.get("deps", []):
+            loc = self.objects.get(oid)
+            if loc is not None and loc.is_error:
+                return DependencyError(f"upstream task failed for object {oid[:8]}")
+        return None
+
+    def _fail_task(self, spec, err: Exception) -> None:
+        self.tasks.pop(spec["task_id"], None)
+        for oid in spec["return_ids"]:
+            self._store_error(oid, err)
+
+    async def _h_task_done(self, conn, msg):
+        task_id = msg["task_id"]
+        spec = self.tasks.pop(task_id, None)
+        for loc in msg.get("locations", []):
+            self._store_location(loc)
+        if msg.get("error_locations"):
+            for loc in msg["error_locations"]:
+                self._store_location(loc)
+        w = self.workers.get(msg["worker_id"])
+        if w is not None and w.current_task == task_id:
+            w.current_task = None
+            if w.state == "task":
+                w.state = "idle"
+        if spec is not None:
+            self._release_task_resources(spec)
+        self._wake_scheduler()
+        return {"ok": True}
+
+    async def _h_task_blocked(self, conn, msg):
+        # A task blocked in get() releases its CPU so child tasks can run
+        # (reference: NotifyDirectCallTaskBlocked, raylet_client.h:380).
+        spec = self.tasks.get(msg["task_id"])
+        if spec is not None and not spec.get("blocked"):
+            spec["blocked"] = True
+            node = self.nodes.get(spec.get("sched_node", ""))
+            cpu = spec.get("resources", {}).get("CPU", 0.0)
+            if node and cpu:
+                _res_add(node.available, {"CPU": cpu})
+                self._wake_scheduler()
+        return {"ok": True}
+
+    async def _h_task_unblocked(self, conn, msg):
+        spec = self.tasks.get(msg["task_id"])
+        if spec is not None and spec.get("blocked"):
+            spec["blocked"] = False
+            node = self.nodes.get(spec.get("sched_node", ""))
+            cpu = spec.get("resources", {}).get("CPU", 0.0)
+            if node and cpu:
+                # May drive available negative transiently; oversubscription on
+                # wake avoids deadlock (same tradeoff the reference makes).
+                _res_sub(node.available, {"CPU": cpu})
+        return {"ok": True}
+
+    # actors ------------------------------------------------------------------
+
+    async def _h_create_actor(self, conn, msg):
+        spec = msg["spec"]
+        actor_id = spec["actor_id"]
+        name = spec.get("name")
+        namespace = spec.get("namespace", "default")
+        if name:
+            key = (namespace, name)
+            if key in self.named_actors and self.actors[self.named_actors[key]].state != "dead":
+                raise ValueError(f"actor name {name!r} already taken")
+            self.named_actors[key] = actor_id
+        actor = ActorInfo(
+            actor_id=actor_id,
+            name=name,
+            resources=spec.get("resources", {}),
+            pg=spec.get("pg"),
+            detached=spec.get("detached", False),
+            creation_task_id=spec["task_id"],
+        )
+        self.actors[actor_id] = actor
+        spec["is_actor_creation"] = True
+        self.tasks[spec["task_id"]] = spec
+        await self._resolve_deps_then_queue(spec)
+        return {"ok": True}
+
+    async def _h_actor_ready(self, conn, msg):
+        actor = self.actors.get(msg["actor_id"])
+        if actor is None:
+            return {"ok": False}
+        if actor.creation_task_id:
+            self.tasks.pop(actor.creation_task_id, None)
+        actor.state = "alive"
+        calls, actor.pending_calls = actor.pending_calls, []
+        for call in calls:
+            await self._dispatch_actor_call(actor, call)
+        return {"ok": True}
+
+    async def _h_actor_error(self, conn, msg):
+        actor = self.actors.get(msg["actor_id"])
+        if actor is None:
+            return {"ok": False}
+        if actor.creation_task_id:
+            self.tasks.pop(actor.creation_task_id, None)
+        actor.creation_error = msg["error"]
+        self._mark_actor_dead(actor, msg["error"])
+        w = self.workers.get(actor.worker_id or "")
+        if w is not None:
+            w.actor_ids.discard(actor.actor_id)
+            if not w.actor_ids:
+                w.state = "idle"
+        self._wake_scheduler()
+        return {"ok": True}
+
+    async def _h_submit_actor_task(self, conn, msg):
+        spec = msg["spec"]
+        actor = self.actors.get(spec["actor_id"])
+        if actor is None:
+            raise ValueError(f"unknown actor {spec['actor_id']}")
+        if actor.state == "dead":
+            err = actor.creation_error or ActorDiedError(f"actor {actor.actor_id[:8]} is dead")
+            for oid in spec["return_ids"]:
+                self._store_error(oid, err)
+            return {"ok": True}
+        self.tasks[spec["task_id"]] = spec
+        if actor.state == "pending":
+            actor.pending_calls.append(spec)
+        else:
+            await self._dispatch_actor_call(actor, spec)
+        return {"ok": True}
+
+    async def _dispatch_actor_call(self, actor: ActorInfo, spec: Dict[str, Any]) -> None:
+        w = self.workers.get(actor.worker_id or "")
+        if w is None:
+            self._fail_task(spec, ActorDiedError("actor worker gone"))
+            return
+        # Per-actor ordered dispatch (direct_actor_task_submitter.h sequencing).
+        async with actor.order_lock:
+            # Wait for deps before forwarding so the worker never blocks.
+            for oid in spec.get("deps", []):
+                await self._wait_for_object(oid)
+            err = self._first_dep_error(spec)
+            if err is not None:
+                self._fail_task(spec, err)
+                return
+            spec["sched_node"] = actor.node_id
+            await w.conn.send({"kind": "execute_actor_task", "spec": spec})
+
+    async def _h_get_named_actor(self, conn, msg):
+        key = (msg.get("namespace", "default"), msg["name"])
+        aid = self.named_actors.get(key)
+        if aid is None or self.actors[aid].state == "dead":
+            raise ValueError(f"no actor named {msg['name']!r}")
+        actor = self.actors[aid]
+        return {"actor_id": aid, "methods": self.kv.get(("__actor_methods__", aid), b"")}
+
+    async def _h_kill_actor(self, conn, msg):
+        actor = self.actors.get(msg["actor_id"])
+        if actor is None or actor.state == "dead":
+            return {"ok": True}
+        w = self.workers.get(actor.worker_id or "")
+        self._mark_actor_dead(actor, ActorDiedError(f"actor {actor.actor_id[:8]} was killed"))
+        if w is not None:
+            try:
+                await w.conn.send({"kind": "shutdown"})
+            except Exception:
+                pass
+            if w.proc is not None:
+                try:
+                    w.proc.terminate()
+                except Exception:
+                    pass
+            await self._on_worker_death(w)
+        return {"ok": True}
+
+    def _mark_actor_dead(self, actor: ActorInfo, err: Exception) -> None:
+        actor.state = "dead"
+        actor.creation_error = actor.creation_error or err
+        for call in actor.pending_calls:
+            self._fail_task(call, err)
+        actor.pending_calls = []
+        # Fail in-flight calls already forwarded to the worker.
+        for tid, spec in list(self.tasks.items()):
+            if spec.get("actor_id") == actor.actor_id:
+                self._fail_task(spec, err)
+        node = self.nodes.get(actor.node_id or "")
+        if node and actor.reserved:
+            actor.reserved = False
+            self._release_reservation(actor.resources, node, actor.pg)
+
+    # placement groups --------------------------------------------------------
+
+    async def _h_create_placement_group(self, conn, msg):
+        pg_id = msg["pg_id"]
+        bundles = [Bundle(resources=dict(b), available=dict(b)) for b in msg["bundles"]]
+        pg = PGInfo(pg_id=pg_id, bundles=bundles, strategy=msg["strategy"], name=msg.get("name"))
+        self.pgs[pg_id] = pg
+        if pg.name:
+            self.named_pgs[pg.name] = pg_id
+        self._try_reserve_pg(pg)
+        self._wake_scheduler()
+        return {"ok": True}
+
+    async def _h_pg_wait(self, conn, msg):
+        pg = self.pgs[msg["pg_id"]]
+        timeout = msg.get("timeout")
+        if timeout is None:
+            await pg.ready_event.wait()
+        else:
+            try:
+                await asyncio.wait_for(pg.ready_event.wait(), timeout)
+            except asyncio.TimeoutError:
+                raise GetTimeoutError("placement group not ready") from None
+        return {"state": pg.state, "bundle_nodes": [b.node_id for b in pg.bundles]}
+
+    async def _h_remove_placement_group(self, conn, msg):
+        pg = self.pgs.get(msg["pg_id"])
+        if pg is None or pg.state == "removed":
+            return {"ok": True}
+        for b in pg.bundles:
+            node = self.nodes.get(b.node_id or "")
+            if node is not None:
+                _res_add(node.available, b.resources)
+        pg.state = "removed"
+        if pg.name:
+            self.named_pgs.pop(pg.name, None)
+        self._wake_scheduler()
+        return {"ok": True}
+
+    def _try_reserve_pg(self, pg: PGInfo) -> None:
+        """All-or-nothing bundle reservation (2-phase in the reference,
+        gcs_placement_group_scheduler.h:274; atomic here since state is local)."""
+        if pg.state != "pending":
+            return
+        nodes = [n for n in self.nodes.values() if n.alive]
+        nodes.sort(key=lambda n: n.index)
+        trial = {n.node_id: dict(n.available) for n in nodes}
+        assignment: List[str] = []
+        strategy = pg.strategy
+        used_nodes: Set[str] = set()
+        for b in pg.bundles:
+            placed = None
+            candidates = nodes
+            if strategy == "STRICT_PACK" and assignment:
+                candidates = [n for n in nodes if n.node_id == assignment[0]]
+            elif strategy == "STRICT_SPREAD":
+                candidates = [n for n in nodes if n.node_id not in used_nodes]
+            elif strategy == "PACK" and assignment:
+                candidates = sorted(nodes, key=lambda n: (n.node_id != assignment[-1], n.index))
+            elif strategy == "SPREAD":
+                candidates = sorted(nodes, key=lambda n: (n.node_id in used_nodes, n.index))
+            for n in candidates:
+                if _res_fits(trial[n.node_id], b.resources):
+                    placed = n.node_id
+                    break
+            if placed is None:
+                return  # cannot satisfy yet; retried on resource release
+            _res_sub(trial[placed], b.resources)
+            assignment.append(placed)
+            used_nodes.add(placed)
+        # Commit.
+        for b, nid in zip(pg.bundles, assignment):
+            b.node_id = nid
+            b.available = dict(b.resources)
+            _res_sub(self.nodes[nid].available, b.resources)
+        pg.state = "ready"
+        pg.ready_event.set()
+
+    # kv / pubsub / introspection ---------------------------------------------
+
+    async def _h_kv_put(self, conn, msg):
+        key = (msg.get("ns", ""), msg["key"])
+        exists = key in self.kv
+        if msg.get("overwrite", True) or not exists:
+            self.kv[key] = msg["value"]
+            return {"added": not exists}
+        return {"added": False}
+
+    async def _h_kv_get(self, conn, msg):
+        return self.kv.get((msg.get("ns", ""), msg["key"]))
+
+    async def _h_kv_del(self, conn, msg):
+        return {"deleted": self.kv.pop((msg.get("ns", ""), msg["key"]), None) is not None}
+
+    async def _h_kv_keys(self, conn, msg):
+        ns = msg.get("ns", "")
+        prefix = msg.get("prefix", "")
+        return [k for (n, k) in self.kv if n == ns and k.startswith(prefix)]
+
+    async def _h_subscribe(self, conn, msg):
+        self.subs.setdefault(msg["channel"], []).append(conn)
+        return {"ok": True}
+
+    async def _h_publish(self, conn, msg):
+        for c in list(self.subs.get(msg["channel"], [])):
+            try:
+                await c.send({"kind": "pubsub", "channel": msg["channel"], "data": msg["data"]})
+            except Exception:
+                pass
+        return {"ok": True}
+
+    async def _h_cluster_state(self, conn, msg):
+        return {
+            "nodes": [
+                {
+                    "node_id": n.node_id,
+                    "resources": dict(n.resources),
+                    "available": dict(n.available),
+                    "labels": dict(n.labels),
+                    "alive": n.alive,
+                    "index": n.index,
+                    "num_workers": len(n.workers),
+                }
+                for n in self.nodes.values()
+            ],
+            "num_workers": len(self.workers),
+            "actors": {
+                aid: {"state": a.state, "name": a.name, "node_id": a.node_id}
+                for aid, a in self.actors.items()
+            },
+            "pending_tasks": len(self.pending_queue),
+            "uptime_s": time.time() - self.start_time,
+        }
+
+    async def _h_add_node(self, conn, msg):
+        nid = self.add_node(msg["resources"], msg.get("labels"))
+        return {"node_id": nid}
+
+    async def _h_ping(self, conn, msg):
+        return {"pong": True, "t": time.time()}
+
+    # ---------------------------------------------------------- object helpers
+
+    def _store_location(self, loc: ObjectLocation) -> None:
+        self.objects[loc.object_id] = loc
+        for ev in self.object_waiters.pop(loc.object_id, []):
+            ev.set()
+
+    def _store_error(self, object_id: str, err: Exception) -> None:
+        import pickle as _p
+
+        data = _p.dumps(err)
+        loc = ObjectLocation(object_id=object_id, size=len(data), inline=data, is_error=True)
+        self._store_location(loc)
+
+    # -------------------------------------------------------------- scheduler
+
+    def _wake_scheduler(self) -> None:
+        self._sched_wakeup.set()
+
+    async def _scheduler_loop(self) -> None:
+        """Single scheduling fiber (the reference's ScheduleAndDispatchTasks,
+        cluster_task_manager.h:117, without the cross-raylet spillback — all
+        state is local to the controller here)."""
+        while True:
+            await self._sched_wakeup.wait()
+            self._sched_wakeup.clear()
+            try:
+                await self._schedule_once()
+            except Exception as e:  # pragma: no cover — keep scheduling alive
+                sys.stderr.write(f"[controller] scheduler error: {e!r}\n")
+
+    async def _schedule_once(self) -> None:
+        # Retry pending placement groups first (resources may have freed).
+        for pg in self.pgs.values():
+            self._try_reserve_pg(pg)
+        remaining: List[str] = []
+        for task_id in self.pending_queue:
+            spec = self.tasks.get(task_id)
+            if spec is None:
+                continue
+            placed = await self._try_place(spec)
+            if not placed:
+                remaining.append(task_id)
+        self.pending_queue = remaining
+
+    def _eligible_nodes(self, spec) -> List[NodeInfo]:
+        strategy = spec.get("scheduling", {"type": "DEFAULT"})
+        nodes = [n for n in self.nodes.values() if n.alive]
+        st = strategy.get("type", "DEFAULT")
+        if st == "NODE_AFFINITY":
+            hard = [n for n in nodes if n.node_id == strategy["node_id"]]
+            if hard or not strategy.get("soft", False):
+                return hard
+            return sorted(nodes, key=lambda n: n.index)
+        if st == "SPREAD":
+            # Least-loaded first: spread by available CPU fraction.
+            def load(n: NodeInfo) -> float:
+                tot = n.resources.get("CPU", 1.0) or 1.0
+                return 1.0 - n.available.get("CPU", 0.0) / tot
+
+            return sorted(nodes, key=lambda n: (load(n), n.index))
+        if st == "NODE_LABEL":
+            want: Dict[str, str] = strategy.get("labels", {})
+            return [n for n in nodes if all(n.labels.get(k) == v for k, v in want.items())]
+        # DEFAULT: hybrid pack-first in node index order (hybrid_scheduling_policy.h
+        # top-k behavior degenerates to first-fit at this scale).
+        return sorted(nodes, key=lambda n: n.index)
+
+    async def _try_place(self, spec: Dict[str, Any]) -> bool:
+        resources: Dict[str, float] = spec.get("resources", {})
+        pg_ref: Optional[Tuple[str, int]] = spec.get("pg")
+        if pg_ref is not None:
+            pg = self.pgs.get(pg_ref[0])
+            if pg is None or pg.state == "removed":
+                self._fail_task(spec, ValueError("placement group removed"))
+                return True
+            if pg.state != "ready":
+                return False
+            bundle = pg.bundles[pg_ref[1]]
+            node = self.nodes[bundle.node_id]
+            if not _res_fits(bundle.available, resources):
+                return False
+            w = self._find_idle_worker(node)
+            if w is None:
+                self._maybe_spawn_worker(node)
+                return False
+            _res_sub(bundle.available, resources)
+            spec["sched_node"] = node.node_id
+            await self._dispatch(spec, node, w)
+            return True
+        for node in self._eligible_nodes(spec):
+            if not _res_fits(node.available, resources):
+                continue
+            w = self._find_idle_worker(node)
+            if w is None:
+                self._maybe_spawn_worker(node)
+                continue
+            _res_sub(node.available, resources)
+            spec["sched_node"] = node.node_id
+            await self._dispatch(spec, node, w)
+            return True
+        return False
+
+    def _find_idle_worker(self, node: NodeInfo) -> Optional[WorkerInfo]:
+        for wid in node.workers:
+            w = self.workers.get(wid)
+            if w is not None and w.state == "idle":
+                return w
+        return None
+
+    def _maybe_spawn_worker(self, node: NodeInfo) -> None:
+        if node.spawning >= 4 or len(node.workers) + node.spawning >= MAX_WORKERS_PER_NODE:
+            return
+        node.spawning += 1
+        env = dict(os.environ)
+        env["RTPU_CONTROLLER"] = f"{self.host}:{self.port}"
+        env["RTPU_NODE_ID"] = node.node_id
+        pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+        env["PYTHONPATH"] = pkg_root + os.pathsep + env.get("PYTHONPATH", "")
+        # Workers never grab the real TPU by default: the mesh layer assigns
+        # device visibility explicitly when a training world is formed.
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu.core.worker_main"],
+            env=env,
+            stdout=None,
+            stderr=None,
+        )
+        # The process registers itself; stash the handle for teardown on the
+        # first worker that registers from this node without one.
+        asyncio.get_running_loop().create_task(self._adopt_proc(node.node_id, proc))
+
+    async def _adopt_proc(self, node_id: str, proc: subprocess.Popen) -> None:
+        for _ in range(600):
+            await asyncio.sleep(0.1)
+            for w in self.workers.values():
+                if w.node_id == node_id and w.proc is None:
+                    w.proc = proc
+                    return
+            if proc.poll() is not None:
+                node = self.nodes.get(node_id)
+                if node:
+                    node.spawning = max(0, node.spawning - 1)
+                self._wake_scheduler()
+                return
+
+    async def _dispatch(self, spec: Dict[str, Any], node: NodeInfo, w: WorkerInfo) -> None:
+        if spec.get("is_actor_creation"):
+            actor = self.actors[spec["actor_id"]]
+            actor.worker_id = w.worker_id
+            actor.node_id = node.node_id
+            actor.reserved = True
+            w.state = "actor"
+            w.actor_ids.add(actor.actor_id)
+            await w.conn.send({"kind": "instantiate_actor", "spec": spec})
+        else:
+            w.state = "task"
+            w.current_task = spec["task_id"]
+            await w.conn.send({"kind": "execute_task", "spec": spec})
+
+    def _release_task_resources(self, spec: Dict[str, Any]) -> None:
+        node = self.nodes.get(spec.get("sched_node", ""))
+        if node is None:
+            return
+        resources = dict(spec.get("resources", {}))
+        if spec.get("blocked"):
+            resources.pop("CPU", None)  # CPU already released at block time
+        self._release_reservation(resources, node, spec.get("pg"))
+
+    def _release_reservation(
+        self, resources: Dict[str, float], node: NodeInfo, pg_ref: Optional[Tuple[str, int]]
+    ) -> None:
+        if pg_ref is not None:
+            pg = self.pgs.get(pg_ref[0])
+            if pg is not None and pg.state == "ready":
+                _res_add(pg.bundles[pg_ref[1]].available, resources)
+            # PG removed/pending: the bundle's full reservation was (or will
+            # be) returned to the node wholesale at remove time — releasing
+            # here too would double-credit the node and oversubscribe it.
+            return
+        _res_add(node.available, resources)
+
+
+# ------------------------------------------------------------------ exceptions
+
+
+class RayTpuError(Exception):
+    pass
+
+
+class GetTimeoutError(RayTpuError, TimeoutError):
+    pass
+
+
+class WorkerCrashedError(RayTpuError):
+    pass
+
+
+class ActorDiedError(RayTpuError):
+    pass
+
+
+class DependencyError(RayTpuError):
+    pass
+
+
+class TaskError(RayTpuError):
+    """Wraps an exception raised inside a remote task (reference: RayTaskError)."""
+
+    def __init__(self, label: str, cause: Exception, traceback_str: str = ""):
+        super().__init__(f"task {label} failed: {cause!r}\n{traceback_str}")
+        self.label = label
+        self.cause = cause
+        self.traceback_str = traceback_str
+
+    def __reduce__(self):
+        return (TaskError, (self.label, self.cause, self.traceback_str))

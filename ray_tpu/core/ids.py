@@ -1,0 +1,61 @@
+"""Unique identifiers for the distributed futures core.
+
+Equivalent in role to the reference's ID types (ray: src/ray/common/id.h) but
+deliberately simple: 16 random bytes rendered as hex. IDs are value objects used
+as dict keys throughout the control plane.
+"""
+from __future__ import annotations
+
+import os
+import binascii
+
+
+class BaseID(str):
+    """An ID is just an interned hex string subclass (cheap, picklable, hashable)."""
+
+    __slots__ = ()
+
+    @classmethod
+    def generate(cls) -> "BaseID":
+        return cls(binascii.hexlify(os.urandom(16)).decode())
+
+    @classmethod
+    def nil(cls) -> "BaseID":
+        return cls("0" * 32)
+
+    def is_nil(self) -> bool:
+        return self == "0" * 32
+
+    def hex(self) -> str:  # parity with ray's ObjectID.hex()
+        return str(self)
+
+    def __repr__(self) -> str:
+        return f"{type(self).__name__}({str.__repr__(self)})"
+
+
+class ObjectID(BaseID):
+    __slots__ = ()
+
+
+class TaskID(BaseID):
+    __slots__ = ()
+
+
+class ActorID(BaseID):
+    __slots__ = ()
+
+
+class NodeID(BaseID):
+    __slots__ = ()
+
+
+class WorkerID(BaseID):
+    __slots__ = ()
+
+
+class PlacementGroupID(BaseID):
+    __slots__ = ()
+
+
+class JobID(BaseID):
+    __slots__ = ()

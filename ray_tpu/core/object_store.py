@@ -1,0 +1,183 @@
+"""Host-side object store: the data plane for task/actor results.
+
+Role-equivalent to the reference's plasma store + in-process memory store
+(ray: src/ray/object_manager/plasma/, src/ray/core_worker/store_provider/),
+redesigned for the TPU-host setting:
+
+- Small objects (<= INLINE_THRESHOLD pickled bytes) are "inlined": their bytes
+  travel on the control plane and live in the controller's memory store. This
+  matches the reference's in-process store for small returns.
+- Large objects are written once into POSIX shared memory by the producing
+  process and read zero-copy-attached by any consumer process on the same
+  host. Only the (shm name, size) location travels on the control plane.
+- Device arrays: jax.Array values are pulled to host (numpy) at `put` time by
+  the serializer. The TPU-native fast path for device-to-device movement is
+  NOT this store — it is the mesh/collective layer (ray_tpu.parallel), where
+  XLA moves bytes over ICI. The store moves *references and host bytes*,
+  mirroring SURVEY.md §2.1's mapping note.
+
+Pickling uses protocol 5 with out-of-band buffers so numpy arrays are
+serialized without an intermediate copy of the payload bytes: buffers are
+memcpy'd directly into the shared-memory segment.
+"""
+from __future__ import annotations
+
+import pickle
+import secrets
+from dataclasses import dataclass, field
+from multiprocessing import resource_tracker, shared_memory
+from typing import Any, Dict, List, Optional, Tuple
+
+INLINE_THRESHOLD = 256 * 1024
+
+_HDR = 8  # u64 little-endian length of the pickle stream, then buffer table
+
+
+def _untrack(name: str) -> None:
+    """Opt out of multiprocessing's resource tracker.
+
+    Segment lifetime is owned by the controller (freed on explicit free or at
+    cluster shutdown), not by whichever process happened to touch it first.
+    """
+    try:
+        resource_tracker.unregister("/" + name, "shared_memory")
+    except Exception:
+        pass
+
+
+@dataclass
+class ObjectLocation:
+    """Where an object's bytes live. Exactly one of `inline` / `shm_name` set."""
+
+    object_id: str
+    size: int
+    inline: Optional[bytes] = None
+    shm_name: Optional[str] = None
+    node_id: Optional[str] = None
+    is_error: bool = False
+    # Buffer table for out-of-band pickle5 buffers: (offset, length) pairs.
+    buffers: List[Tuple[int, int]] = field(default_factory=list)
+    # Offset of the pickle stream inside the segment.
+    pickle_off: int = 0
+    pickle_len: int = 0
+
+
+def serialize(value: Any) -> Tuple[bytes, List[pickle.PickleBuffer]]:
+    """Pickle with out-of-band buffers (protocol 5)."""
+    oob: List[pickle.PickleBuffer] = []
+    data = pickle.dumps(value, protocol=5, buffer_callback=oob.append)
+    return data, oob
+
+
+def put_bytes(value: Any, object_id: str, node_id: str) -> ObjectLocation:
+    """Serialize `value`; inline small results, spill large ones to shm."""
+    data, oob = serialize(value)
+    total = len(data) + sum(len(b.raw()) for b in oob)
+    if total <= INLINE_THRESHOLD:
+        # Re-pickle in-band: cheap at this size, keeps the inline path simple.
+        if oob:
+            data = pickle.dumps(value, protocol=pickle.HIGHEST_PROTOCOL)
+        return ObjectLocation(object_id=object_id, size=len(data), inline=data, node_id=node_id)
+
+    # Layout: [pickle stream][buf0][buf1]... with a location-table in metadata.
+    name = "rtpu_" + secrets.token_hex(8)
+    seg = shared_memory.SharedMemory(name=name, create=True, size=max(total, 1))
+    _untrack(name)
+    off = 0
+    seg.buf[off : off + len(data)] = data
+    pickle_off, pickle_len = off, len(data)
+    off += len(data)
+    table: List[Tuple[int, int]] = []
+    for b in oob:
+        raw = b.raw()
+        n = raw.nbytes
+        seg.buf[off : off + n] = raw
+        table.append((off, n))
+        off += n
+        b.release()
+    loc = ObjectLocation(
+        object_id=object_id,
+        size=total,
+        shm_name=name,
+        node_id=node_id,
+        buffers=table,
+        pickle_off=pickle_off,
+        pickle_len=pickle_len,
+    )
+    seg.close()
+    return loc
+
+
+class _SegmentCache:
+    """Per-process cache of attached read-only segments."""
+
+    def __init__(self) -> None:
+        self._segs: Dict[str, shared_memory.SharedMemory] = {}
+
+    def attach(self, name: str) -> shared_memory.SharedMemory:
+        seg = self._segs.get(name)
+        if seg is None:
+            # No _untrack here: on Python 3.12 attaching does not register
+            # with the resource tracker; unregistering would make the tracker
+            # daemon log KeyErrors at exit.
+            seg = shared_memory.SharedMemory(name=name)
+            self._segs[name] = seg
+        return seg
+
+    def drop(self, name: str) -> None:
+        seg = self._segs.pop(name, None)
+        if seg is not None:
+            try:
+                seg.close()
+            except Exception:
+                pass
+
+    def close_all(self) -> None:
+        for name in list(self._segs):
+            self.drop(name)
+
+
+_segments = _SegmentCache()
+
+
+def get_bytes(loc: ObjectLocation, copy: bool = True) -> Any:
+    """Reconstruct the value at `loc`.
+
+    With ``copy=False`` out-of-band numpy buffers alias the shared-memory
+    segment zero-copy (consumers must treat results as read-only and must not
+    outlive a free() — same contract as plasma). The default copies, which
+    keeps segment lifetime decoupled from value lifetime; perf-sensitive
+    internal paths (data-loading into device buffers) opt into zero-copy.
+    """
+    if loc.inline is not None:
+        return pickle.loads(loc.inline)
+    assert loc.shm_name is not None
+    seg = _segments.attach(loc.shm_name)
+    data = bytes(seg.buf[loc.pickle_off : loc.pickle_off + loc.pickle_len])
+    bufs = []
+    for off, n in loc.buffers:
+        view = seg.buf[off : off + n]
+        bufs.append(bytes(view) if copy else view)
+    return pickle.loads(data, buffers=bufs)
+
+
+def free_segment(shm_name: str) -> None:
+    """Unlink a segment (controller-driven).
+
+    Uses shm_unlink directly: SharedMemory.unlink() would also ping the
+    resource tracker, which never saw this name in the freeing process and
+    would log KeyErrors from its daemon at exit.
+    """
+    _segments.drop(shm_name)
+    try:
+        import _posixshmem
+
+        _posixshmem.shm_unlink("/" + shm_name)
+    except FileNotFoundError:
+        pass
+    except Exception:
+        pass
+
+
+def close_process_segments() -> None:
+    _segments.close_all()

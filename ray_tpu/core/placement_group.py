@@ -1,0 +1,71 @@
+"""Placement groups: gang reservation of resource bundles.
+
+Reference: python/ray/util/placement_group.py + the GCS-side 2-phase scheduler
+(src/ray/gcs/gcs_server/gcs_placement_group_scheduler.h:274). Strategies:
+PACK / SPREAD / STRICT_PACK / STRICT_SPREAD over virtual nodes. On TPU this is
+the primitive that reserves a *slice*: one bundle per TPU host, STRICT_SPREAD
+across hosts, then the mesh layer forms a jax Mesh on the reserved hosts.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional
+
+from . import context as ctx
+from .ids import PlacementGroupID
+
+VALID_STRATEGIES = ("PACK", "SPREAD", "STRICT_PACK", "STRICT_SPREAD")
+
+
+@dataclass
+class PlacementGroup:
+    id: str
+    bundle_specs: List[Dict[str, float]]
+    strategy: str
+
+    def ready(self, timeout: Optional[float] = None) -> bool:
+        wc = ctx.get_worker_context()
+        info = wc.client.request({"kind": "pg_wait", "pg_id": self.id, "timeout": timeout})
+        return info["state"] == "ready"
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        try:
+            return self.ready(timeout)
+        except Exception:
+            return False
+
+    def bundle_nodes(self) -> List[str]:
+        wc = ctx.get_worker_context()
+        info = wc.client.request({"kind": "pg_wait", "pg_id": self.id, "timeout": None})
+        return info["bundle_nodes"]
+
+    def __reduce__(self):
+        return (PlacementGroup, (self.id, self.bundle_specs, self.strategy))
+
+
+def placement_group(
+    bundles: List[Dict[str, float]],
+    strategy: str = "PACK",
+    name: Optional[str] = None,
+) -> PlacementGroup:
+    if strategy not in VALID_STRATEGIES:
+        raise ValueError(f"strategy must be one of {VALID_STRATEGIES}")
+    if not bundles or any(not b for b in bundles):
+        raise ValueError("bundles must be non-empty resource dicts")
+    wc = ctx.get_worker_context()
+    pg_id = PlacementGroupID.generate()
+    wc.client.request(
+        {
+            "kind": "create_placement_group",
+            "pg_id": pg_id,
+            "bundles": [dict(b) for b in bundles],
+            "strategy": strategy,
+            "name": name,
+        }
+    )
+    return PlacementGroup(pg_id, [dict(b) for b in bundles], strategy)
+
+
+def remove_placement_group(pg: PlacementGroup) -> None:
+    wc = ctx.get_worker_context()
+    wc.client.request({"kind": "remove_placement_group", "pg_id": pg.id})

@@ -1,0 +1,158 @@
+"""Wire protocol for the control/data plane.
+
+Length-prefixed pickled message dicts over TCP, with request/response
+correlation and server-push support. This plays the role of the reference's
+gRPC layer (ray: src/ray/rpc/) — a thin, asyncio-native RPC substrate. The
+message schema is a plain dict: {"kind": str, "rid": int|None, ...payload}.
+
+Design notes (TPU-first):
+- The control plane carries *references and metadata only*; bulk array bytes
+  move through the shared-memory object store (see object_store.py) or stay
+  resident in XLA device buffers. Keeping the RPC layer tiny and in Python is
+  fine because it is never on the per-step hot path of a training loop — the
+  hot path is inside one jitted XLA program.
+"""
+from __future__ import annotations
+
+import asyncio
+import itertools
+import pickle
+import struct
+from typing import Any, Awaitable, Callable, Dict, Optional
+
+_LEN = struct.Struct("!Q")
+
+# Messages are small control-plane payloads; large values go via the object
+# store.  A high cap catches protocol bugs (accidentally inlined tensors).
+MAX_MSG_BYTES = 1 << 31
+
+
+def dumps(msg: Dict[str, Any]) -> bytes:
+    return pickle.dumps(msg, protocol=pickle.HIGHEST_PROTOCOL)
+
+
+def loads(data: bytes) -> Dict[str, Any]:
+    return pickle.loads(data)
+
+
+async def read_msg(reader: asyncio.StreamReader) -> Dict[str, Any]:
+    header = await reader.readexactly(_LEN.size)
+    (n,) = _LEN.unpack(header)
+    if n > MAX_MSG_BYTES:
+        raise ValueError(f"message too large: {n} bytes")
+    data = await reader.readexactly(n)
+    return loads(data)
+
+
+def write_msg(writer: asyncio.StreamWriter, msg: Dict[str, Any]) -> None:
+    data = dumps(msg)
+    writer.write(_LEN.pack(len(data)))
+    writer.write(data)
+
+
+class Connection:
+    """A bidirectional message channel with request/response correlation.
+
+    Both peers may issue requests; `handler` serves the remote peer's requests
+    and unsolicited pushes. One reader task demultiplexes responses (matched on
+    "rid") from incoming requests.
+    """
+
+    def __init__(
+        self,
+        reader: asyncio.StreamReader,
+        writer: asyncio.StreamWriter,
+        handler: Optional[Callable[["Connection", Dict[str, Any]], Awaitable[None]]] = None,
+        name: str = "",
+    ):
+        self.reader = reader
+        self.writer = writer
+        self.handler = handler
+        self.name = name
+        self._rid = itertools.count(1)
+        self._pending: Dict[int, asyncio.Future] = {}
+        self._reader_task: Optional[asyncio.Task] = None
+        self._send_lock = asyncio.Lock()
+        self.closed = asyncio.Event()
+
+    def start(self) -> None:
+        self._reader_task = asyncio.get_running_loop().create_task(self._read_loop())
+
+    async def _read_loop(self) -> None:
+        try:
+            while True:
+                msg = await read_msg(self.reader)
+                if msg.get("kind") == "__response__":
+                    fut = self._pending.pop(msg["rid"], None)
+                    if fut is not None and not fut.done():
+                        if msg.get("error") is not None:
+                            fut.set_exception(msg["error"])
+                        else:
+                            fut.set_result(msg.get("result"))
+                elif self.handler is not None:
+                    # Serve concurrently: a handler may itself await RPCs.
+                    asyncio.get_running_loop().create_task(self._serve(msg))
+        except (asyncio.IncompleteReadError, ConnectionResetError, OSError, EOFError):
+            pass
+        finally:
+            for fut in self._pending.values():
+                if not fut.done():
+                    fut.set_exception(ConnectionError(f"connection {self.name!r} closed"))
+            self._pending.clear()
+            self.closed.set()
+            try:
+                self.writer.close()
+            except Exception:
+                pass
+
+    async def _serve(self, msg: Dict[str, Any]) -> None:
+        rid = msg.get("rid")
+        try:
+            result = await self.handler(self, msg)
+            if rid is not None:
+                await self.send({"kind": "__response__", "rid": rid, "result": result})
+        except Exception as e:  # noqa: BLE001 — errors propagate to the caller
+            if rid is not None:
+                try:
+                    await self.send({"kind": "__response__", "rid": rid, "error": e})
+                except Exception:
+                    pass
+
+    async def send(self, msg: Dict[str, Any]) -> None:
+        """Fire-and-forget push (no response expected)."""
+        async with self._send_lock:
+            write_msg(self.writer, msg)
+            await self.writer.drain()
+
+    async def request(self, msg: Dict[str, Any], timeout: Optional[float] = None) -> Any:
+        """Send a request and await the correlated response."""
+        rid = next(self._rid)
+        msg = dict(msg, rid=rid)
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._pending[rid] = fut
+        await self.send(msg)
+        if timeout is None:
+            return await fut
+        return await asyncio.wait_for(fut, timeout)
+
+    async def close(self) -> None:
+        if self._reader_task is not None:
+            self._reader_task.cancel()
+        try:
+            self.writer.close()
+            await self.writer.wait_closed()
+        except Exception:
+            pass
+        self.closed.set()
+
+
+async def connect(
+    host: str,
+    port: int,
+    handler: Optional[Callable[[Connection, Dict[str, Any]], Awaitable[None]]] = None,
+    name: str = "",
+) -> Connection:
+    reader, writer = await asyncio.open_connection(host, port)
+    conn = Connection(reader, writer, handler, name=name)
+    conn.start()
+    return conn

@@ -1,0 +1,72 @@
+"""ObjectRef and argument serialization.
+
+The reference threads ObjectRefs in-band through cloudpickle with an ownership
+sidecar (ray: python/ray/_private/serialization.py); here an ObjectRef pickles
+to its id and reconstructs bound to whatever process deserializes it. Top-level
+task arguments that are ObjectRefs are replaced by ArgRef markers and become
+scheduling dependencies (values are resolved worker-side before execution);
+nested refs travel as refs — the same semantics as the reference.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict, List, Tuple
+
+import cloudpickle
+
+from .ids import ObjectID
+
+
+class ObjectRef:
+    """A distributed future. `ray_tpu.get(ref)` resolves it."""
+
+    __slots__ = ("object_id",)
+
+    def __init__(self, object_id: str):
+        self.object_id = object_id
+
+    def hex(self) -> str:
+        return self.object_id
+
+    def __reduce__(self):
+        return (ObjectRef, (self.object_id,))
+
+    def __hash__(self) -> int:
+        return hash(self.object_id)
+
+    def __eq__(self, other: Any) -> bool:
+        return isinstance(other, ObjectRef) and other.object_id == self.object_id
+
+    def __repr__(self) -> str:
+        return f"ObjectRef({self.object_id[:16]})"
+
+    # Allow `await ref` inside async code paths (parity with ray's awaitable refs).
+    def __await__(self):
+        from . import api
+
+        yield
+        return api.get(self)
+
+
+@dataclass(frozen=True)
+class ArgRef:
+    """Marker for a top-level ObjectRef argument (resolved before execution)."""
+
+    index: Any
+    object_id: str
+
+
+def pack_args(args: Tuple[Any, ...], kwargs: Dict[str, Any]) -> Tuple[bytes, List[str]]:
+    """Replace top-level ObjectRefs with ArgRef markers; return (blob, dep ids)."""
+    deps: List[str] = []
+
+    def sub(i: Any, v: Any) -> Any:
+        if isinstance(v, ObjectRef):
+            deps.append(v.object_id)
+            return ArgRef(i, v.object_id)
+        return v
+
+    new_args = tuple(sub(i, a) for i, a in enumerate(args))
+    new_kwargs = {k: sub(k, v) for k, v in kwargs.items()}
+    blob = cloudpickle.dumps((new_args, new_kwargs))
+    return blob, deps

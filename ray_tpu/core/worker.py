@@ -1,0 +1,242 @@
+"""Worker process runtime: task execution loop + actor hosting.
+
+Role-equivalent to the reference's worker-side CoreWorker + the Python worker
+shell (ray: src/ray/core_worker/core_worker.cc ExecuteTask path,
+python/ray/_private/workers/default_worker.py). One OS process per worker;
+plain tasks run on a small thread pool, each actor gets a dedicated mailbox
+thread providing ordered execution (max_concurrency>1 widens the mailbox to a
+thread pool, mirroring threaded actors / ConcurrencyGroupManager).
+
+Workers import neither jax nor any ML library at startup — a worker stays a
+~50ms-spawn control-plane process until user code pulls heavy imports.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+import queue
+import threading
+import traceback
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Dict, List, Optional
+
+import cloudpickle
+
+from . import context as ctx
+from .client import CoreClient
+from .controller import ActorDiedError, TaskError
+from .ids import WorkerID
+from .object_store import ObjectLocation, get_bytes, put_bytes
+from .serialization import ArgRef, ObjectRef
+
+
+class ActorMailbox:
+    """Ordered (or bounded-concurrency) execution context for one actor."""
+
+    def __init__(self, runtime: "WorkerRuntime", actor_id: str, max_concurrency: int):
+        self.runtime = runtime
+        self.actor_id = actor_id
+        self.instance: Any = None
+        self.q: "queue.Queue[Optional[Dict[str, Any]]]" = queue.Queue()
+        self.threads = [
+            threading.Thread(target=self._loop, name=f"actor-{actor_id[:8]}-{i}", daemon=True)
+            for i in range(max(1, max_concurrency))
+        ]
+        for t in self.threads:
+            t.start()
+
+    def submit(self, spec: Dict[str, Any]) -> None:
+        self.q.put(spec)
+
+    def stop(self) -> None:
+        for _ in self.threads:
+            self.q.put(None)
+
+    def _loop(self) -> None:
+        while True:
+            spec = self.q.get()
+            if spec is None:
+                return
+            if "__create__" in spec:
+                spec["__create__"]()
+                continue
+            self.runtime.run_task(spec, actor_instance=self.instance)
+
+
+class WorkerRuntime:
+    def __init__(self, controller_addr: str, node_id: str):
+        host, port = controller_addr.rsplit(":", 1)
+        self.worker_id = WorkerID.generate()
+        self.node_id = node_id
+        self.client = CoreClient(host, int(port), handler=self._handle)
+        self.pool = ThreadPoolExecutor(max_workers=32, thread_name_prefix="task")
+        self.functions: Dict[str, Any] = {}
+        self.actors: Dict[str, ActorMailbox] = {}
+        self.shutdown_event = threading.Event()
+        # Context must be live before registration: the controller may push a
+        # task the instant the register request lands.
+        ctx.set_worker_context(ctx.WorkerContext(client=self.client, node_id=node_id, role="worker"))
+        self.client.request(
+            {"kind": "register", "role": "worker", "worker_id": self.worker_id, "node_id": node_id}
+        )
+
+    # ----------------------------------------------------------- push handler
+
+    async def _handle(self, conn, msg):
+        kind = msg["kind"]
+        if kind == "execute_task":
+            self.pool.submit(self.run_task, msg["spec"])
+        elif kind == "instantiate_actor":
+            self._instantiate_actor(msg["spec"])
+        elif kind == "execute_actor_task":
+            spec = msg["spec"]
+            mb = self.actors.get(spec["actor_id"])
+            if mb is not None:
+                mb.submit(spec)
+        elif kind == "shutdown":
+            self.shutdown_event.set()
+        elif kind == "pubsub":
+            ctx.deliver_pubsub(msg["channel"], msg["data"])
+        return None
+
+    # -------------------------------------------------------------- execution
+
+    def _load_function(self, func_id: str) -> Any:
+        fn = self.functions.get(func_id)
+        if fn is None:
+            blob = self.client.request({"kind": "fetch_function", "func_id": func_id})
+            fn = cloudpickle.loads(blob)
+            self.functions[func_id] = fn
+        return fn
+
+    def _resolve_args(self, spec: Dict[str, Any]) -> tuple:
+        args, kwargs = pickle.loads(spec["args_blob"])
+        ref_ids = [v.object_id for v in (*args, *kwargs.values()) if isinstance(v, ArgRef)]
+        locs: Dict[str, ObjectLocation] = {}
+        if ref_ids:
+            locs = self.client.request({"kind": "get_locations", "object_ids": ref_ids})
+
+        def resolve(v: Any) -> Any:
+            if isinstance(v, ArgRef):
+                loc = locs[v.object_id]
+                val = get_bytes(loc)
+                if loc.is_error:
+                    raise val if isinstance(val, BaseException) else RuntimeError(val)
+                return val
+            return v
+
+        args = tuple(resolve(a) for a in args)
+        kwargs = {k: resolve(v) for k, v in kwargs.items()}
+        return args, kwargs
+
+    def run_task(self, spec: Dict[str, Any], actor_instance: Any = None) -> None:
+        task_id = spec["task_id"]
+        tls = ctx.task_local
+        tls.task_id = task_id
+        tls.label = spec.get("label", "")
+        try:
+            args, kwargs = self._resolve_args(spec)
+            if spec.get("actor_id") and actor_instance is not None:
+                method = getattr(actor_instance, spec["method_name"])
+                result = method(*args, **kwargs)
+            else:
+                fn = self._load_function(spec["func_id"])
+                result = fn(*args, **kwargs)
+            if _is_coroutine(result):
+                import asyncio
+
+                result = asyncio.run(result)
+            locations = self._store_returns(spec, result)
+            self.client.request(
+                {
+                    "kind": "task_done",
+                    "task_id": task_id,
+                    "worker_id": self.worker_id,
+                    "locations": locations,
+                }
+            )
+        except BaseException as e:  # noqa: BLE001 — every task error is captured
+            tb = traceback.format_exc()
+            label = spec.get("label", task_id[:8])
+            err = TaskError(label, e, tb)
+            try:
+                data = pickle.dumps(err)
+            except Exception:
+                # Unpicklable cause (socket, lock, ...): degrade to a string
+                # rendition so the error still reaches the caller instead of
+                # hanging the task forever.
+                err = TaskError(label, RuntimeError(f"{type(e).__name__}: {e}"), tb)
+                data = pickle.dumps(err)
+            err_locs = [
+                ObjectLocation(object_id=oid, size=len(data), inline=data, is_error=True)
+                for oid in spec["return_ids"]
+            ]
+            try:
+                self.client.request(
+                    {
+                        "kind": "task_done",
+                        "task_id": task_id,
+                        "worker_id": self.worker_id,
+                        "error_locations": err_locs,
+                    }
+                )
+            except Exception:
+                pass
+        finally:
+            tls.task_id = None
+
+    def _store_returns(self, spec: Dict[str, Any], result: Any) -> List[ObjectLocation]:
+        return_ids: List[str] = spec["return_ids"]
+        if not return_ids:
+            return []
+        if len(return_ids) == 1:
+            values = [result]
+        else:
+            values = list(result)
+            if len(values) != len(return_ids):
+                raise ValueError(
+                    f"task declared num_returns={len(return_ids)} but returned {len(values)}"
+                )
+        return [put_bytes(v, oid, self.node_id) for v, oid in zip(values, return_ids)]
+
+    def _instantiate_actor(self, spec: Dict[str, Any]) -> None:
+        actor_id = spec["actor_id"]
+        mb = ActorMailbox(self, actor_id, spec.get("max_concurrency", 1))
+        self.actors[actor_id] = mb
+
+        def create():
+            try:
+                cls = self._load_function(spec["func_id"])
+                args, kwargs = self._resolve_args(spec)
+                mb.instance = cls(*args, **kwargs)
+                ctx.task_local.actor_id = actor_id
+                self.client.request({"kind": "actor_ready", "actor_id": actor_id})
+            except BaseException as e:  # noqa: BLE001
+                tb = traceback.format_exc()
+                self.client.request(
+                    {
+                        "kind": "actor_error",
+                        "actor_id": actor_id,
+                        "error": ActorDiedError(f"actor constructor failed: {e!r}\n{tb}"),
+                    }
+                )
+
+        # __init__ runs on the mailbox thread so actor state is thread-affine.
+        mb.q.put({"__create__": create})
+
+    def serve_forever(self) -> None:
+        self.shutdown_event.wait()
+        try:
+            self.client.close()
+        except Exception:
+            pass
+        # Hard-exit: executor threads are non-daemon and user task code may be
+        # mid-flight; a worker told to shut down must actually die (the
+        # reference's raylet SIGTERMs its workers for the same reason).
+        os._exit(0)
+
+
+def _is_coroutine(x: Any) -> bool:
+    import inspect
+
+    return inspect.iscoroutine(x)

@@ -1,0 +1,24 @@
+"""Worker process entrypoint (reference:
+python/ray/_private/workers/default_worker.py). Spawned by the controller with
+RTPU_CONTROLLER / RTPU_NODE_ID in the environment."""
+from __future__ import annotations
+
+import os
+import sys
+
+
+def main() -> int:
+    addr = os.environ.get("RTPU_CONTROLLER")
+    node_id = os.environ.get("RTPU_NODE_ID")
+    if not addr or not node_id:
+        sys.stderr.write("worker_main: RTPU_CONTROLLER / RTPU_NODE_ID not set\n")
+        return 2
+    from .worker import WorkerRuntime
+
+    rt = WorkerRuntime(addr, node_id)
+    rt.serve_forever()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

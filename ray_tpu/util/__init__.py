@@ -1,0 +1,1 @@
+"""Utilities: scheduling strategies, accelerators, collectives, actor pools."""

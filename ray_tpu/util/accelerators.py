@@ -1,0 +1,67 @@
+"""TPU detection and topology helpers.
+
+Reference: python/ray/_private/accelerators/tpu.py:75 TPUAcceleratorManager —
+/dev/accel* chip counting (:101-120), TPU_VISIBLE_CHIPS isolation, pod-type
+detection via GCE metadata (:52), per-pod custom resources (:335-398). Here TPU
+is a first-class resource rather than a plugin: the controller schedules hosts,
+and the mesh layer (ray_tpu.parallel) owns device topology.
+"""
+from __future__ import annotations
+
+import glob
+import os
+from typing import Dict, Optional
+
+# Peak dense bf16 TFLOP/s per chip, used for MFU accounting (public specs).
+TPU_PEAK_TFLOPS_BF16: Dict[str, float] = {
+    "v4": 275.0,
+    "v5e": 197.0,
+    "v5p": 459.0,
+    "v6e": 918.0,
+}
+
+
+def detect_tpu_chips() -> int:
+    """Count local TPU chips without importing jax (workers stay light)."""
+    env = os.environ.get("RTPU_NUM_TPUS")
+    if env is not None:
+        return int(env)
+    chips = glob.glob("/dev/accel*")
+    if chips:
+        return len(chips)
+    vfio = glob.glob("/dev/vfio/[0-9]*")
+    if vfio:
+        return len(vfio)
+    return 0
+
+
+def detect_tpu_generation() -> Optional[str]:
+    """Best-effort generation string ("v4", "v5e", "v5p", "v6e")."""
+    env = os.environ.get("RTPU_TPU_GENERATION")
+    if env:
+        return env
+    accel_type = os.environ.get("TPU_ACCELERATOR_TYPE", "")  # e.g. "v5litepod-16"
+    if accel_type.startswith("v5lite"):
+        return "v5e"
+    for gen in ("v6e", "v5p", "v5e", "v4"):
+        if accel_type.startswith(gen):
+            return gen
+    return None
+
+
+def tpu_pod_resources(pod_name: str, pod_type: str, is_head: bool) -> Dict[str, float]:
+    """Per-pod custom resources mirroring the reference's scheme (tpu.py:335-398):
+    every host in pod P advertises {P: 1}; host 0 adds {"TPU-<pod_type>-head": 1}
+    so exactly one task can claim the pod-leader slot."""
+    res: Dict[str, float] = {pod_name: 1.0}
+    if is_head:
+        res[f"TPU-{pod_type}-head"] = 1.0
+    return res
+
+
+def peak_flops_per_chip(generation: Optional[str] = None, dtype: str = "bf16") -> float:
+    gen = generation or detect_tpu_generation() or "v5e"
+    tf = TPU_PEAK_TFLOPS_BF16.get(gen, 197.0)
+    if dtype in ("f32", "float32"):
+        tf = tf / 2
+    return tf * 1e12

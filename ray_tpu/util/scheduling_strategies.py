@@ -1,0 +1,24 @@
+"""Scheduling strategies (reference: python/ray/util/scheduling_strategies.py:15,41)."""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional
+
+
+@dataclass
+class PlacementGroupSchedulingStrategy:
+    placement_group: Any
+    placement_group_bundle_index: Optional[int] = None
+    placement_group_capture_child_tasks: bool = False
+
+
+@dataclass
+class NodeAffinitySchedulingStrategy:
+    node_id: str
+    soft: bool = False
+
+
+@dataclass
+class NodeLabelSchedulingStrategy:
+    hard: Dict[str, str] = field(default_factory=dict)
+    soft: Dict[str, str] = field(default_factory=dict)

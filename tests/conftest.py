@@ -1,0 +1,33 @@
+"""Test fixtures (reference: python/ray/tests/conftest.py ray_start_regular:419,
+ray_start_cluster:500).
+
+JAX is forced onto a virtual 8-device CPU platform before any test imports it,
+so sharding/collective tests run the real pjit/shard_map paths without TPU
+hardware (SURVEY.md §4.4 test-ring 2).
+"""
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="module")
+def ray_start_regular():
+    import ray_tpu
+
+    handle = ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
+    yield handle
+    ray_tpu.shutdown()
+
+
+@pytest.fixture(scope="module")
+def ray_start_cluster():
+    from ray_tpu.core.cluster_utils import Cluster
+
+    cluster = Cluster(head_resources={"CPU": 2})
+    yield cluster
+    cluster.shutdown()

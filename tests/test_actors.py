@@ -1,0 +1,105 @@
+"""Actor tests (reference: python/ray/tests/test_actor*.py)."""
+import time
+
+import pytest
+
+import ray_tpu
+
+
+@ray_tpu.remote
+class Counter:
+    def __init__(self, start=0):
+        self.n = start
+
+    def inc(self, k=1):
+        self.n += k
+        return self.n
+
+    def read(self):
+        return self.n
+
+
+def test_actor_basic(ray_start_regular):
+    c = Counter.remote(100)
+    assert ray_tpu.get(c.inc.remote()) == 101
+    assert ray_tpu.get(c.inc.remote(5)) == 106
+    assert ray_tpu.get(c.read.remote()) == 106
+
+
+def test_actor_ordered_execution(ray_start_regular):
+    c = Counter.remote(0)
+    refs = [c.inc.remote() for _ in range(20)]
+    assert ray_tpu.get(refs) == list(range(1, 21))
+
+
+def test_actor_handle_passing(ray_start_regular):
+    c = Counter.remote(0)
+
+    @ray_tpu.remote
+    def bump(handle):
+        return ray_tpu.get(handle.inc.remote(10))
+
+    assert ray_tpu.get(bump.remote(c)) == 10
+    assert ray_tpu.get(c.read.remote()) == 10
+
+
+def test_named_actor(ray_start_regular):
+    c = Counter.options(name="the-counter").remote(7)
+    ray_tpu.get(c.read.remote())  # ensure alive
+    h = ray_tpu.get_actor("the-counter")
+    assert ray_tpu.get(h.read.remote()) == 7
+
+
+def test_named_actor_duplicate_rejected(ray_start_regular):
+    Counter.options(name="dup-counter").remote(0)
+    with pytest.raises(Exception):
+        Counter.options(name="dup-counter").remote(0)
+
+
+def test_kill_actor(ray_start_regular):
+    c = Counter.options(name="victim").remote(0)
+    ray_tpu.get(c.read.remote())
+    ray_tpu.kill(c)
+    time.sleep(0.2)
+    with pytest.raises(Exception):
+        ray_tpu.get(c.read.remote(), timeout=5)
+
+
+def test_actor_constructor_error(ray_start_regular):
+    @ray_tpu.remote
+    class Bad:
+        def __init__(self):
+            raise RuntimeError("bad init")
+
+        def ping(self):
+            return 1
+
+    b = Bad.remote()
+    with pytest.raises(Exception):
+        ray_tpu.get(b.ping.remote(), timeout=30)
+
+
+def test_actor_method_error(ray_start_regular):
+    @ray_tpu.remote
+    class Flaky:
+        def boom(self):
+            raise ValueError("x")
+
+        def ok(self):
+            return "fine"
+
+    f = Flaky.remote()
+    with pytest.raises(ray_tpu.TaskError):
+        ray_tpu.get(f.boom.remote())
+    # Actor survives a method error.
+    assert ray_tpu.get(f.ok.remote()) == "fine"
+
+
+def test_async_actor_method(ray_start_regular):
+    @ray_tpu.remote
+    class AsyncActor:
+        async def compute(self, x):
+            return x * 2
+
+    a = AsyncActor.remote()
+    assert ray_tpu.get(a.compute.remote(21)) == 42

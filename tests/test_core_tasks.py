@@ -1,0 +1,116 @@
+"""Core task/object tests (reference test strategy: python/ray/tests/test_basic*.py)."""
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+
+
+@ray_tpu.remote
+def add(a, b):
+    return a + b
+
+
+def test_task_roundtrip(ray_start_regular):
+    assert ray_tpu.get(add.remote(1, 2)) == 3
+
+
+def test_parallel_tasks(ray_start_regular):
+    refs = [add.remote(i, i) for i in range(8)]
+    assert ray_tpu.get(refs) == [2 * i for i in range(8)]
+
+
+def test_put_get_small(ray_start_regular):
+    ref = ray_tpu.put({"k": [1, 2, 3]})
+    assert ray_tpu.get(ref) == {"k": [1, 2, 3]}
+
+
+def test_put_get_large_shm(ray_start_regular):
+    big = np.arange(1_000_000, dtype=np.float32)
+    out = ray_tpu.get(ray_tpu.put(big))
+    np.testing.assert_array_equal(out, big)
+
+
+def test_objectref_arg_dependency(ray_start_regular):
+    r1 = add.remote(1, 1)
+    r2 = add.remote(r1, 10)
+    assert ray_tpu.get(r2) == 12
+
+
+def test_nested_ref_passthrough(ray_start_regular):
+    @ray_tpu.remote
+    def passthrough(lst):
+        # Nested refs arrive as refs (not values) — ray semantics.
+        assert isinstance(lst[0], ray_tpu.ObjectRef)
+        return ray_tpu.get(lst[0])
+
+    inner = ray_tpu.put(42)
+    assert ray_tpu.get(passthrough.remote([inner])) == 42
+
+
+def test_num_returns(ray_start_regular):
+    @ray_tpu.remote(num_returns=2)
+    def two():
+        return 1, 2
+
+    a, b = two.remote()
+    assert ray_tpu.get(a) == 1 and ray_tpu.get(b) == 2
+
+
+def test_task_error_propagates(ray_start_regular):
+    @ray_tpu.remote
+    def boom():
+        raise ValueError("kaboom")
+
+    with pytest.raises(ray_tpu.TaskError) as ei:
+        ray_tpu.get(boom.remote())
+    assert isinstance(ei.value.cause, ValueError)
+
+
+def test_dependency_error_propagates(ray_start_regular):
+    @ray_tpu.remote
+    def boom():
+        raise ValueError("kaboom")
+
+    downstream = add.remote(boom.remote(), 1)
+    with pytest.raises(ray_tpu.RayTpuError):
+        ray_tpu.get(downstream)
+
+
+def test_wait(ray_start_regular):
+    @ray_tpu.remote
+    def slow(t):
+        time.sleep(t)
+        return t
+
+    fast, slow_ref = slow.remote(0.05), slow.remote(10)
+    ready, not_ready = ray_tpu.wait([fast, slow_ref], num_returns=1, timeout=5)
+    assert ready == [fast] and not_ready == [slow_ref]
+
+
+def test_get_timeout(ray_start_regular):
+    @ray_tpu.remote
+    def never():
+        time.sleep(60)
+
+    with pytest.raises(ray_tpu.GetTimeoutError):
+        ray_tpu.get(never.remote(), timeout=0.5)
+
+
+def test_nested_task_submission(ray_start_regular):
+    @ray_tpu.remote
+    def outer():
+        return ray_tpu.get(add.remote(20, 22))
+
+    assert ray_tpu.get(outer.remote()) == 42
+
+
+def test_remote_function_not_callable(ray_start_regular):
+    with pytest.raises(TypeError):
+        add(1, 2)
+
+
+def test_cluster_resources(ray_start_regular):
+    res = ray_tpu.cluster_resources()
+    assert res.get("CPU", 0) >= 4

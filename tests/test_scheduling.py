@@ -1,0 +1,84 @@
+"""Scheduling: strategies, resources, placement groups over virtual nodes
+(reference: python/ray/tests/test_scheduling*.py, test_placement_group*.py)."""
+import pytest
+
+import ray_tpu
+from ray_tpu.util.scheduling_strategies import (
+    NodeAffinitySchedulingStrategy,
+    PlacementGroupSchedulingStrategy,
+)
+
+
+@ray_tpu.remote
+def whoami():
+    return ray_tpu.get_runtime_context().get_node_id()
+
+
+@pytest.fixture(scope="module")
+def three_nodes(ray_start_cluster):
+    cluster = ray_start_cluster
+    n2 = cluster.add_node({"CPU": 2, "TPU": 4})
+    n3 = cluster.add_node({"CPU": 2})
+    return cluster, n2, n3
+
+
+def test_node_affinity(three_nodes):
+    _, _, n3 = three_nodes
+    ref = whoami.options(scheduling_strategy=NodeAffinitySchedulingStrategy(node_id=n3)).remote()
+    assert ray_tpu.get(ref, timeout=60) == n3
+
+
+def test_tpu_resource_scheduling(three_nodes):
+    _, n2, _ = three_nodes
+    ref = whoami.options(num_tpus=1).remote()
+    assert ray_tpu.get(ref, timeout=60) == n2
+
+
+def test_custom_resource(three_nodes):
+    cluster, _, _ = three_nodes
+    n4 = cluster.add_node({"CPU": 1, "my_resource": 2})
+    ref = whoami.options(resources={"my_resource": 1}).remote()
+    assert ray_tpu.get(ref, timeout=60) == n4
+
+
+def test_strict_spread_pg(three_nodes):
+    pg = ray_tpu.placement_group([{"CPU": 1}] * 3, strategy="STRICT_SPREAD")
+    assert pg.ready(timeout=60)
+    nodes = pg.bundle_nodes()
+    assert len(set(nodes)) == 3
+    refs = [
+        whoami.options(
+            scheduling_strategy=PlacementGroupSchedulingStrategy(
+                placement_group=pg, placement_group_bundle_index=i
+            )
+        ).remote()
+        for i in range(3)
+    ]
+    assert ray_tpu.get(refs, timeout=120) == nodes
+    ray_tpu.remove_placement_group(pg)
+
+
+def test_strict_pack_pg(three_nodes):
+    pg = ray_tpu.placement_group([{"CPU": 1}, {"CPU": 1}], strategy="STRICT_PACK")
+    assert pg.ready(timeout=60)
+    nodes = pg.bundle_nodes()
+    assert len(set(nodes)) == 1
+    ray_tpu.remove_placement_group(pg)
+
+
+def test_infeasible_pg_pends(three_nodes):
+    pg = ray_tpu.placement_group([{"CPU": 999}], strategy="PACK")
+    with pytest.raises(Exception):
+        pg.ready(timeout=0.5)
+    ray_tpu.remove_placement_group(pg)
+
+
+def test_pg_resources_released_on_remove(three_nodes):
+    before = ray_tpu.available_resources()["CPU"]
+    pg = ray_tpu.placement_group([{"CPU": 1}], strategy="PACK")
+    assert pg.ready(timeout=60)
+    during = ray_tpu.available_resources()["CPU"]
+    assert during == before - 1
+    ray_tpu.remove_placement_group(pg)
+    after = ray_tpu.available_resources()["CPU"]
+    assert after == before
