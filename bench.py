@@ -1,0 +1,84 @@
+"""Benchmark: single-chip training throughput + MFU of the flagship decoder.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+value = tokens/sec/chip on a llama-family ~350M model, bf16 activations,
+adamw, remat off. vs_baseline = achieved MFU / 0.45 (the Llama north-star MFU
+target from BASELINE.json; the reference publishes no tokens/sec numbers —
+BASELINE.md).
+"""
+from __future__ import annotations
+
+import json
+import time
+
+
+def main() -> None:
+    from ray_tpu.util.jaxenv import ensure_platform
+
+    ensure_platform()  # honor JAX_PLATFORMS even where a site config forces it
+    import jax
+    import numpy as np
+
+    from ray_tpu.models.configs import bench_350m
+    from ray_tpu.parallel import MeshSpec, RULES_DP, make_mesh
+    from ray_tpu.train.step import transformer_train_step
+    from ray_tpu.util.accelerators import peak_flops_per_chip
+
+    dev = jax.devices()[0]
+    on_tpu = dev.platform != "cpu"
+    if on_tpu:
+        cfg = bench_350m(remat=True)
+        batch, seq = 8, 1024
+        steps, warmup = 20, 3
+    else:  # CPU smoke fallback so the bench always emits a line
+        from ray_tpu.models.configs import llama_tiny
+
+        cfg = llama_tiny()
+        batch, seq = 4, 128
+        steps, warmup = 3, 1
+
+    mesh = make_mesh(MeshSpec(), devices=[dev])
+    ts = transformer_train_step(cfg, mesh, rules=RULES_DP)
+    params, opt_state = ts.init(jax.random.key(0))
+    tokens = np.random.default_rng(0).integers(
+        0, cfg.vocab_size, (batch, seq + 1), dtype=np.int32
+    )
+    b = ts.shard_batch({"tokens": tokens})
+
+    for _ in range(warmup):
+        params, opt_state, loss = ts.step(params, opt_state, b)
+        float(loss)
+
+    # Force a device-to-host fetch every step: on the axon relay platform
+    # block_until_ready() can return before execution completes, silently
+    # inflating throughput; a scalar D2H transfer is an honest barrier.
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        params, opt_state, loss = ts.step(params, opt_state, b)
+        float(loss)
+    dt = time.perf_counter() - t0
+
+    tokens_per_step = batch * seq
+    tok_s = tokens_per_step * steps / dt
+    flops_per_tok = cfg.flops_per_token(seq)
+    achieved = tok_s * flops_per_tok
+    peak = peak_flops_per_chip() if on_tpu else 1e12
+    mfu = achieved / peak
+
+    print(
+        json.dumps(
+            {
+                "metric": "train_tokens_per_sec_per_chip_350m",
+                "value": round(tok_s, 1),
+                "unit": "tokens/s",
+                "vs_baseline": round(mfu / 0.45, 4),
+                "mfu": round(mfu, 4),
+                "model_params": cfg.num_params(),
+                "platform": dev.platform,
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -813,6 +813,10 @@ class Controller:
         env["RTPU_NODE_ID"] = node.node_id
         pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
         env["PYTHONPATH"] = pkg_root + os.pathsep + env.get("PYTHONPATH", "")
+        # Propagate the driver's import path so functions defined in driver-
+        # local modules resolve on workers (the lightweight analog of the
+        # reference's working_dir runtime env, runtime_env/working_dir.py).
+        env["RTPU_SYS_PATH"] = os.pathsep.join(p or os.getcwd() for p in sys.path)
         # Workers never grab the real TPU by default: the mesh layer assigns
         # device visibility explicitly when a training world is formed.
         env.setdefault("JAX_PLATFORMS", "cpu")

@@ -13,6 +13,11 @@ def main() -> int:
     if not addr or not node_id:
         sys.stderr.write("worker_main: RTPU_CONTROLLER / RTPU_NODE_ID not set\n")
         return 2
+    extra_path = os.environ.get("RTPU_SYS_PATH")
+    if extra_path:
+        for p in reversed(extra_path.split(os.pathsep)):
+            if p and p not in sys.path:
+                sys.path.insert(0, p)
     from .worker import WorkerRuntime
 
     rt = WorkerRuntime(addr, node_id)

@@ -1,0 +1,259 @@
+"""Decoder-only transformer, TPU-first: one functional implementation covering
+the GPT-2 family (LayerNorm/GELU/learned positions) and the Llama family
+(RMSNorm/SwiGLU/RoPE/GQA), selected by config.
+
+Design choices driven by XLA/TPU, not by the reference (which has no models —
+it hosts torch):
+- Pure functional: params are a pytree of arrays; no module framework in the
+  hot path, nothing to trace but array math.
+- Layers are stacked and iterated with lax.scan → one compiled layer body,
+  O(1) compile time in depth, and the natural seam for pipeline parallelism.
+- Every array dim carries a logical axis name; `param_logical_specs` returns
+  the matching pytree so any sharding strategy (DP/FSDP/TP/SP) is a rule
+  table away (ray_tpu.parallel.sharding).
+- Activations in bfloat16, params/optimizer in float32 (MXU-native mix).
+- Optional jax.checkpoint on the layer body (remat) to trade FLOPs for HBM.
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ray_tpu.ops.attention import attention
+from ray_tpu.parallel.sharding import maybe_constrain
+
+Params = Dict[str, Any]
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 32000
+    d_model: int = 512
+    n_layers: int = 4
+    n_heads: int = 8
+    n_kv_heads: Optional[int] = None  # None => MHA
+    d_ff: Optional[int] = None  # None => 4*d_model (gelu) or 8/3*d_model (swiglu)
+    max_seq_len: int = 2048
+    norm: str = "rmsnorm"  # rmsnorm | layernorm
+    activation: str = "swiglu"  # swiglu | gelu
+    positional: str = "rope"  # rope | learned
+    rope_theta: float = 500000.0
+    tie_embeddings: bool = True
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    remat: bool = False
+
+    @property
+    def kv_heads(self) -> int:
+        return self.n_kv_heads or self.n_heads
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+    @property
+    def ff_dim(self) -> int:
+        if self.d_ff is not None:
+            return self.d_ff
+        if self.activation == "swiglu":
+            # Llama sizing: 2/3 * 4d rounded to a multiple of 128 (MXU tile).
+            d = int(8 * self.d_model / 3)
+            return (d + 127) // 128 * 128
+        return 4 * self.d_model
+
+    def num_params(self) -> int:
+        d, L, V = self.d_model, self.n_layers, self.vocab_size
+        h = self.head_dim
+        attn = d * (self.n_heads * h) + 2 * d * (self.kv_heads * h) + (self.n_heads * h) * d
+        if self.activation == "swiglu":
+            mlp = 3 * d * self.ff_dim
+        else:
+            mlp = 2 * d * self.ff_dim
+        norms = 2 * d * L + d
+        if self.norm == "layernorm":
+            norms *= 2  # biases alongside scales
+        emb = V * d * (1 if self.tie_embeddings else 2)
+        pos = 0 if self.positional == "rope" else self.max_seq_len * d
+        return L * (attn + mlp) + norms + emb + pos
+
+    def flops_per_token(self, seq_len: Optional[int] = None) -> float:
+        """Forward+backward FLOPs/token ≈ 6*N + 12*L*S*d_head*n_heads (attn)."""
+        S = seq_len or self.max_seq_len
+        return 6.0 * self.num_params() + 12.0 * self.n_layers * S * self.d_model
+
+
+def _dense_init(key, shape, param_dtype, scale: Optional[float] = None):
+    fan_in = shape[0]
+    std = scale if scale is not None else (1.0 / math.sqrt(fan_in))
+    return (jax.random.normal(key, shape) * std).astype(param_dtype)
+
+
+def init_params(key: jax.Array, cfg: TransformerConfig) -> Params:
+    d, L, V, F = cfg.d_model, cfg.n_layers, cfg.vocab_size, cfg.ff_dim
+    H, KVH, hd = cfg.n_heads, cfg.kv_heads, cfg.head_dim
+    keys = jax.random.split(key, 12)
+
+    def stack(initializer, shape, k):
+        ks = jax.random.split(k, L)
+        return jnp.stack([initializer(ks[i], shape, cfg.param_dtype) for i in range(L)])
+
+    layers = {
+        "attn_norm": jnp.ones((L, d), cfg.param_dtype),
+        "wq": stack(_dense_init, (d, H * hd), keys[0]),
+        "wk": stack(_dense_init, (d, KVH * hd), keys[1]),
+        "wv": stack(_dense_init, (d, KVH * hd), keys[2]),
+        "wo": stack(lambda k, s, pd: _dense_init(k, s, pd, scale=1.0 / math.sqrt(2 * L * s[0])),
+                    (H * hd, d), keys[3]),
+        "mlp_norm": jnp.ones((L, d), cfg.param_dtype),
+        "w_up": stack(_dense_init, (d, F), keys[4]),
+        "w_down": stack(lambda k, s, pd: _dense_init(k, s, pd, scale=1.0 / math.sqrt(2 * L * s[0])),
+                        (F, d), keys[5]),
+    }
+    if cfg.activation == "swiglu":
+        layers["w_gate"] = stack(_dense_init, (d, F), keys[6])
+    if cfg.norm == "layernorm":
+        layers["attn_norm_b"] = jnp.zeros((L, d), cfg.param_dtype)
+        layers["mlp_norm_b"] = jnp.zeros((L, d), cfg.param_dtype)
+
+    params: Params = {
+        "embed": (jax.random.normal(keys[7], (V, d)) * 0.02).astype(cfg.param_dtype),
+        "final_norm": jnp.ones((d,), cfg.param_dtype),
+        "layers": layers,
+    }
+    if cfg.norm == "layernorm":
+        params["final_norm_b"] = jnp.zeros((d,), cfg.param_dtype)
+    if not cfg.tie_embeddings:
+        params["lm_head"] = _dense_init(keys[8], (d, V), cfg.param_dtype, scale=0.02)
+    if cfg.positional == "learned":
+        params["pos_embed"] = (
+            jax.random.normal(keys[9], (cfg.max_seq_len, d)) * 0.02
+        ).astype(cfg.param_dtype)
+    return params
+
+
+def param_logical_specs(cfg: TransformerConfig) -> Params:
+    """Pytree of logical axis names matching init_params' structure
+    (consumed by parallel.sharding.tree_shardings)."""
+    layers = {
+        "attn_norm": (None, None),
+        "wq": (None, "embed", "heads"),
+        "wk": (None, "embed", "kv_heads"),
+        "wv": (None, "embed", "kv_heads"),
+        "wo": (None, "heads", "embed"),
+        "mlp_norm": (None, None),
+        "w_up": (None, "embed", "mlp"),
+        "w_down": (None, "mlp", "embed"),
+    }
+    if cfg.activation == "swiglu":
+        layers["w_gate"] = (None, "embed", "mlp")
+    if cfg.norm == "layernorm":
+        layers["attn_norm_b"] = (None, None)
+        layers["mlp_norm_b"] = (None, None)
+    specs: Params = {
+        "embed": ("vocab", "embed"),
+        "final_norm": (None,),
+        "layers": layers,
+    }
+    if cfg.norm == "layernorm":
+        specs["final_norm_b"] = (None,)
+    if not cfg.tie_embeddings:
+        specs["lm_head"] = ("embed", "vocab")
+    if cfg.positional == "learned":
+        specs["pos_embed"] = (None, "embed")
+    return specs
+
+
+def _norm(x, w, b, kind: str):
+    xf = x.astype(jnp.float32)
+    if kind == "rmsnorm":
+        x2 = jnp.mean(xf * xf, axis=-1, keepdims=True)
+        out = xf * jax.lax.rsqrt(x2 + 1e-6) * w.astype(jnp.float32)
+    else:
+        mu = jnp.mean(xf, axis=-1, keepdims=True)
+        var = jnp.var(xf, axis=-1, keepdims=True)
+        out = (xf - mu) * jax.lax.rsqrt(var + 1e-5) * w.astype(jnp.float32)
+        if b is not None:
+            out = out + b.astype(jnp.float32)
+    return out.astype(x.dtype)
+
+
+def _rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
+    """Rotary embedding over the last dim of [B, S, H, D]."""
+    D = x.shape[-1]
+    half = D // 2
+    freqs = 1.0 / (theta ** (jnp.arange(0, half, dtype=jnp.float32) / half))
+    angles = positions[:, :, None].astype(jnp.float32) * freqs[None, None, :]  # [B,S,half]
+    cos = jnp.cos(angles)[:, :, None, :]
+    sin = jnp.sin(angles)[:, :, None, :]
+    x1, x2 = x[..., :half].astype(jnp.float32), x[..., half:].astype(jnp.float32)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
+    return out.astype(x.dtype)
+
+
+def _layer_body(cfg: TransformerConfig, x: jax.Array, layer: Params, positions: jax.Array):
+    B, S, d = x.shape
+    H, KVH, hd = cfg.n_heads, cfg.kv_heads, cfg.head_dim
+
+    h = _norm(x, layer["attn_norm"], layer.get("attn_norm_b"), cfg.norm)
+    q = (h @ layer["wq"].astype(cfg.dtype)).reshape(B, S, H, hd)
+    k = (h @ layer["wk"].astype(cfg.dtype)).reshape(B, S, KVH, hd)
+    v = (h @ layer["wv"].astype(cfg.dtype)).reshape(B, S, KVH, hd)
+    if cfg.positional == "rope":
+        q = _rope(q, positions, cfg.rope_theta)
+        k = _rope(k, positions, cfg.rope_theta)
+    q = maybe_constrain(q, ("batch", "seq_act", "heads", None))
+    o = attention(q, k, v, causal=True)
+    x = x + o.reshape(B, S, H * hd) @ layer["wo"].astype(cfg.dtype)
+    x = maybe_constrain(x, ("batch", "seq_act", "embed"))
+
+    h = _norm(x, layer["mlp_norm"], layer.get("mlp_norm_b"), cfg.norm)
+    up = h @ layer["w_up"].astype(cfg.dtype)
+    if cfg.activation == "swiglu":
+        gate = h @ layer["w_gate"].astype(cfg.dtype)
+        act = jax.nn.silu(gate) * up
+    else:
+        act = jax.nn.gelu(up)
+    x = x + act @ layer["w_down"].astype(cfg.dtype)
+    x = maybe_constrain(x, ("batch", "seq_act", "embed"))
+    return x
+
+
+def forward(params: Params, tokens: jax.Array, cfg: TransformerConfig) -> jax.Array:
+    """tokens [B, S] int32 -> logits [B, S, V] (f32)."""
+    B, S = tokens.shape
+    x = params["embed"].astype(cfg.dtype)[tokens]
+    x = maybe_constrain(x, ("batch", "seq_act", "embed"))
+    positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32)[None], (B, S))
+    if cfg.positional == "learned":
+        x = x + params["pos_embed"].astype(cfg.dtype)[:S][None]
+
+    body = lambda carry, layer: (_layer_body(cfg, carry, layer, positions), None)
+    if cfg.remat:
+        body = jax.checkpoint(body)
+    x, _ = jax.lax.scan(body, x, params["layers"])
+
+    x = _norm(x, params["final_norm"], params.get("final_norm_b"), cfg.norm)
+    head = params.get("lm_head", None)
+    if head is None:
+        head = params["embed"].T
+    logits = x @ head.astype(cfg.dtype)
+    return logits.astype(jnp.float32)
+
+
+def loss_fn(params: Params, batch: Dict[str, jax.Array], cfg: TransformerConfig) -> jax.Array:
+    """Next-token cross-entropy. batch: tokens [B,S]; loss over tokens[1:]."""
+    tokens = batch["tokens"]
+    logits = forward(params, tokens[:, :-1], cfg)
+    targets = tokens[:, 1:]
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    mask = batch.get("mask")
+    if mask is not None:
+        m = mask[:, 1:].astype(jnp.float32)
+        return -(ll * m).sum() / jnp.maximum(m.sum(), 1.0)
+    return -ll.mean()

@@ -1,0 +1,87 @@
+"""Attention ops: reference XLA implementation + dispatch point for Pallas.
+
+The reference framework has no attention kernels at all (it delegates to
+torch); here attention is a first-class op because it dominates the MFU
+budget. `attention()` is the single entry point models call; it dispatches to
+a Pallas flash kernel on TPU (ops.flash_attention) when shapes allow, else to
+a fused-softmax XLA implementation that the compiler maps onto MXU+VPU well.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def _on_tpu() -> bool:
+    try:
+        return jax.devices()[0].platform == "tpu"
+    except Exception:
+        return False
+
+
+def reference_attention(
+    q: jax.Array,  # [B, S, H, D]
+    k: jax.Array,  # [B, S, KVH, D]
+    v: jax.Array,  # [B, S, KVH, D]
+    *,
+    causal: bool = True,
+    scale: Optional[float] = None,
+) -> jax.Array:
+    """Plain XLA attention with GQA head-broadcast. Computes in f32 for
+    numerical stability, returns q.dtype."""
+    B, S, H, D = q.shape
+    KVH = k.shape[2]
+    assert H % KVH == 0, f"heads {H} not divisible by kv_heads {KVH}"
+    group = H // KVH
+    scale = scale if scale is not None else D ** -0.5
+
+    qf = q.astype(jnp.float32) * scale
+    kf = k.astype(jnp.float32)
+    vf = v.astype(jnp.float32)
+    # [B, KVH, group, S, D] x [B, KVH, S, D] -> [B, KVH, group, S, S]
+    qg = qf.reshape(B, S, KVH, group, D)
+    logits = jnp.einsum("bskgd,btkd->bkgst", qg, kf)
+    if causal:
+        mask = jnp.tril(jnp.ones((S, S), dtype=bool))
+        logits = jnp.where(mask[None, None, None], logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bkgst,btkd->bskgd", probs, vf)
+    return out.reshape(B, S, H, D).astype(q.dtype)
+
+
+def attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = True,
+    scale: Optional[float] = None,
+    use_flash: Optional[bool] = None,
+) -> jax.Array:
+    """Dispatching attention entry point used by all models."""
+    if use_flash is None:
+        use_flash = _on_tpu()
+    if use_flash:
+        try:
+            from .flash_attention import flash_attention
+
+            return flash_attention(q, k, v, causal=causal, scale=scale)
+        except ImportError:
+            global _warned_no_flash
+            if not _warned_no_flash:
+                import warnings
+
+                warnings.warn(
+                    "flash_attention kernel unavailable; falling back to "
+                    "reference attention (materializes S^2 logits — expect "
+                    "HBM pressure at long sequence lengths)",
+                    stacklevel=2,
+                )
+                _warned_no_flash = True
+    return reference_attention(q, k, v, causal=causal, scale=scale)
+
+
+_warned_no_flash = False

@@ -1,0 +1,45 @@
+"""Parallelism layer: device meshes, sharding rules, collectives.
+
+The keystone the reference lacks (SURVEY.md §7 step 4): DP/FSDP/TP/PP/SP/EP
+expressed as jax.sharding over a named Mesh, with host-level collectives for
+processes outside the mesh.
+"""
+from .mesh import (
+    AXIS_ORDER,
+    MeshBootstrap,
+    MeshSpec,
+    best_effort_spec,
+    make_mesh,
+    single_device_mesh,
+)
+from .sharding import (
+    DEFAULT_RULES,
+    RULES_DP,
+    RULES_FSDP,
+    RULES_TP,
+    constrain,
+    logical_to_mesh_spec,
+    named_sharding,
+    replicated,
+    shard_batch,
+    tree_shardings,
+)
+
+__all__ = [
+    "AXIS_ORDER",
+    "MeshSpec",
+    "MeshBootstrap",
+    "make_mesh",
+    "single_device_mesh",
+    "best_effort_spec",
+    "DEFAULT_RULES",
+    "RULES_DP",
+    "RULES_FSDP",
+    "RULES_TP",
+    "named_sharding",
+    "logical_to_mesh_spec",
+    "tree_shardings",
+    "constrain",
+    "shard_batch",
+    "replicated",
+]

@@ -1,0 +1,211 @@
+"""Cross-process collectives (`ray.util.collective` parity).
+
+Reference: python/ray/util/collective/collective.py (init_collective_group
+:120, allreduce :258, GroupManager :40) with NCCL-via-cupy / pygloo backends
+and a named-actor Rendezvous (nccl_collective_group.py:29).
+
+TPU-native split, mirroring SURVEY.md §5.8's three planes:
+- **In-mesh collectives** (the hot path) are NOT here: they are XLA psum /
+  all_gather / reduce_scatter / all-to-all emitted from pjit/shard_map over
+  the Mesh — see ray_tpu.parallel.mesh. Nothing in Python touches per-step
+  bytes.
+- **Host-level collectives** (this module) synchronize *processes* that are
+  not in one XLA program: CPU train workers (DP gradient all-reduce in the
+  MNIST smoke config), cross-slice barriers, weight broadcast to env-runners.
+  Backend: a named rendezvous actor + the shared-memory object store — the
+  structural analog of the reference's gloo path.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+import ray_tpu
+
+_REDUCE_OPS = {
+    "sum": lambda xs: _tree_reduce(xs, np.add),
+    "mean": lambda xs: _tree_scale(_tree_reduce(xs, np.add), 1.0 / len(xs)),
+    "max": lambda xs: _tree_reduce(xs, np.maximum),
+    "min": lambda xs: _tree_reduce(xs, np.minimum),
+}
+
+
+def _tree_reduce(trees: List[Any], op) -> Any:
+    import jax
+
+    out = trees[0]
+    for t in trees[1:]:
+        out = jax.tree.map(lambda a, b: op(np.asarray(a), np.asarray(b)), out, t)
+    return out
+
+
+def _tree_scale(tree: Any, s: float) -> Any:
+    import jax
+
+    return jax.tree.map(lambda a: np.asarray(a) * s, tree)
+
+
+class _RoundError:
+    """Picklable sentinel carrying a failed round's error to all ranks."""
+
+    def __init__(self, msg: str):
+        self.msg = msg
+
+
+@ray_tpu.remote
+class _RendezvousActor:
+    """Barrier/reduce hub for one collective group. Methods run with
+    max_concurrency == world_size so all ranks can block in one round
+    together (threaded-actor pattern, reference: Rendezvous actor)."""
+
+    def __init__(self, world_size: int):
+        self.world_size = world_size
+        self.lock = threading.Lock()
+        self.cv = threading.Condition(self.lock)
+        self.rounds: Dict[Any, Dict[int, Any]] = {}
+        self.results: Dict[Any, Any] = {}
+        self.done_counts: Dict[Any, int] = {}
+
+    def collect(self, key, rank: int, value, op: Optional[str]):
+        """All-gather `value` from every rank; if `op` is set, reduce instead.
+
+        A failure while producing the round's result is published to every
+        waiting rank (as an exception sentinel) — otherwise ranks already
+        parked in cv.wait() would hang forever.
+        """
+        with self.cv:
+            slot = self.rounds.setdefault(key, {})
+            if rank in slot:
+                raise RuntimeError(f"rank {rank} contributed twice to round {key}")
+            slot[rank] = value
+            if len(slot) == self.world_size:
+                ordered = [slot[r] for r in range(self.world_size)]
+                try:
+                    self.results[key] = _REDUCE_OPS[op](ordered) if op else ordered
+                except Exception as e:  # noqa: BLE001 — publish to all ranks
+                    self.results[key] = _RoundError(repr(e))
+                self.done_counts[key] = 0
+                self.cv.notify_all()
+            else:
+                while key not in self.results:
+                    self.cv.wait()
+            result = self.results[key]
+            self.done_counts[key] += 1
+            if self.done_counts[key] == self.world_size:
+                del self.rounds[key], self.results[key], self.done_counts[key]
+            if isinstance(result, _RoundError):
+                raise RuntimeError(f"collective round {key} failed: {result.msg}")
+            return result
+
+    def ping(self):
+        return True
+
+
+class CollectiveGroup:
+    def __init__(self, name: str, world_size: int, rank: int, actor):
+        self.name = name
+        self.world_size = world_size
+        self.rank = rank
+        self._actor = actor
+        self._round = 0
+
+    def _next_key(self, tag: str) -> str:
+        self._round += 1
+        return f"{tag}:{self._round}"
+
+    def allreduce(self, value, op: str = "sum"):
+        """Reduce a numpy array (or pytree of arrays) across the group."""
+        if op not in _REDUCE_OPS:
+            raise ValueError(f"op must be one of {sorted(_REDUCE_OPS)}, got {op!r}")
+        key = self._next_key("ar")
+        return ray_tpu.get(self._actor.collect.remote(key, self.rank, value, op))
+
+    def allgather(self, value) -> List[Any]:
+        key = self._next_key("ag")
+        return ray_tpu.get(self._actor.collect.remote(key, self.rank, value, None))
+
+    def broadcast(self, value, src_rank: int = 0):
+        key = self._next_key("bc")
+        got = ray_tpu.get(
+            self._actor.collect.remote(key, self.rank, value if self.rank == src_rank else None, None)
+        )
+        return got[src_rank]
+
+    def reducescatter(self, value, op: str = "sum"):
+        """Reduce then return this rank's equal slice along axis 0."""
+        reduced = self.allreduce(value, op)
+        arr = np.asarray(reduced)
+        chunks = np.array_split(arr, self.world_size, axis=0)
+        return chunks[self.rank]
+
+    def barrier(self) -> None:
+        key = self._next_key("bar")
+        ray_tpu.get(self._actor.collect.remote(key, self.rank, None, None))
+
+
+_groups: Dict[str, CollectiveGroup] = {}
+
+
+def init_collective_group(
+    world_size: int,
+    rank: int,
+    group_name: str = "default",
+    backend: str = "shm",
+) -> CollectiveGroup:
+    """Join (rank 0: create) a collective group. Reference API:
+    util/collective/collective.py:120."""
+    actor_name = f"__rtpu_collective__{group_name}"
+    if rank == 0:
+        actor = _RendezvousActor.options(
+            name=actor_name, max_concurrency=world_size + 1
+        ).remote(world_size)
+        ray_tpu.get(actor.ping.remote())
+    else:
+        actor = _wait_for_actor(actor_name)
+    group = CollectiveGroup(group_name, world_size, rank, actor)
+    _groups[group_name] = group
+    return group
+
+
+def _wait_for_actor(name: str, timeout: float = 60.0):
+    import time
+
+    deadline = time.monotonic() + timeout
+    while True:
+        try:
+            return ray_tpu.get_actor(name)
+        except Exception:
+            if time.monotonic() >= deadline:
+                raise
+            time.sleep(0.1)
+
+
+def get_group(group_name: str = "default") -> CollectiveGroup:
+    return _groups[group_name]
+
+
+def allreduce(value, group_name: str = "default", op: str = "sum"):
+    return get_group(group_name).allreduce(value, op)
+
+
+def allgather(value, group_name: str = "default"):
+    return get_group(group_name).allgather(value)
+
+
+def broadcast(value, src_rank: int = 0, group_name: str = "default"):
+    return get_group(group_name).broadcast(value, src_rank)
+
+
+def barrier(group_name: str = "default") -> None:
+    get_group(group_name).barrier()
+
+
+def destroy_collective_group(group_name: str = "default") -> None:
+    group = _groups.pop(group_name, None)
+    if group is not None and group.rank == 0:
+        try:
+            ray_tpu.kill(group._actor)
+        except Exception:
+            pass

@@ -1,0 +1,134 @@
+"""Device-mesh formation: the TPU-native replacement for process groups.
+
+Where the reference bootstraps NCCL process groups per library (torch
+dist.init_process_group in ray Train's _TorchBackend, torch/config.py:65-199;
+cupy-NCCL groups in ray.util.collective nccl_collective_group.py:128), the
+TPU-native design has ONE primitive: a `jax.sharding.Mesh` over the slice's
+devices, with named axes for every parallelism dimension. XLA emits the
+collectives; ICI carries them. This module owns mesh axis conventions and
+construction, including multi-host formation parameters (the analog of
+MASTER_ADDR handoff) and virtual CPU meshes for tests.
+
+Axis conventions (orders chosen so the innermost/fastest axes map to ICI
+neighbors; see the scaling-book recipe: mesh → shardings → XLA collectives):
+
+    data  — pure data parallelism (gradient all-reduce)
+    fsdp  — ZeRO-style parameter/optimizer sharding (all-gather + reduce-scatter)
+    tensor— megatron-style intra-layer model parallelism
+    seq   — sequence/context parallelism (ring attention neighbors)
+    expert— MoE expert parallelism (all-to-all)
+    pipe  — pipeline stages (ppermute microbatch handoff)
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+AXIS_ORDER: Tuple[str, ...] = ("pipe", "data", "fsdp", "seq", "expert", "tensor")
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshSpec:
+    """Logical parallelism layout. -1 on at most one axis means "fill with
+    remaining devices" (like torch DeviceMesh / t5x partitioning)."""
+
+    data: int = 1
+    fsdp: int = 1
+    tensor: int = 1
+    pipe: int = 1
+    seq: int = 1
+    expert: int = 1
+
+    def sizes(self) -> Dict[str, int]:
+        return {
+            "pipe": self.pipe,
+            "data": self.data,
+            "fsdp": self.fsdp,
+            "seq": self.seq,
+            "expert": self.expert,
+            "tensor": self.tensor,
+        }
+
+    def resolve(self, n_devices: int) -> "MeshSpec":
+        sizes = self.sizes()
+        wildcards = [k for k, v in sizes.items() if v == -1]
+        if len(wildcards) > 1:
+            raise ValueError("at most one mesh axis may be -1")
+        fixed = math.prod(v for v in sizes.values() if v != -1)
+        if wildcards:
+            if n_devices % fixed != 0:
+                raise ValueError(
+                    f"{n_devices} devices not divisible by fixed axes product {fixed}"
+                )
+            sizes[wildcards[0]] = n_devices // fixed
+        elif fixed != n_devices:
+            raise ValueError(f"mesh spec {sizes} needs {fixed} devices, have {n_devices}")
+        return MeshSpec(**{k: sizes[k] for k in ("data", "fsdp", "tensor", "pipe", "seq", "expert")})
+
+    @property
+    def num_devices(self) -> int:
+        return math.prod(self.sizes().values())
+
+
+def make_mesh(
+    spec: MeshSpec,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build a Mesh with the canonical axis order.
+
+    Device order matters for ICI locality: jax.devices() on TPU enumerates in
+    physical torus order, so adjacent mesh coordinates along the trailing
+    axes land on ICI neighbors. We keep that order (no shuffling) and put
+    `tensor`/`expert`/`seq` innermost where the highest-bandwidth traffic is.
+    """
+    devs = list(devices) if devices is not None else list(jax.devices())
+    spec = spec.resolve(len(devs))
+    sizes = spec.sizes()
+    shape = tuple(sizes[a] for a in AXIS_ORDER)
+    arr = np.asarray(devs, dtype=object).reshape(shape)
+    return Mesh(arr, AXIS_ORDER)
+
+
+def single_device_mesh() -> Mesh:
+    return make_mesh(MeshSpec(), devices=jax.devices()[:1])
+
+
+def best_effort_spec(
+    n_devices: int,
+    *,
+    want_fsdp: bool = False,
+    want_tensor: int = 1,
+) -> MeshSpec:
+    """A sane default layout: tensor innermost, remainder to fsdp or data."""
+    if n_devices % want_tensor != 0:
+        raise ValueError(f"{n_devices} devices not divisible by tensor={want_tensor}")
+    rest = n_devices // want_tensor
+    if want_fsdp:
+        return MeshSpec(fsdp=rest, tensor=want_tensor)
+    return MeshSpec(data=rest, tensor=want_tensor)
+
+
+@dataclasses.dataclass
+class MeshBootstrap:
+    """Parameters a multi-host world needs to form one mesh — the analog of
+    the reference handing MASTER_ADDR/RANK to every torch worker
+    (backend_executor.py:436 + torch/config.py:153-199). The Train layer puts
+    one of these in each worker's env; workers call `initialize()` before any
+    jax computation touches devices."""
+
+    coordinator_address: str  # "host:port" of process 0
+    num_processes: int
+    process_id: int
+
+    def initialize(self) -> None:
+        if self.num_processes > 1:
+            jax.distributed.initialize(
+                coordinator_address=self.coordinator_address,
+                num_processes=self.num_processes,
+                process_id=self.process_id,
+            )

@@ -1,0 +1,111 @@
+"""Sharded training-step construction: params+optimizer+batch → one jitted
+XLA program over a mesh.
+
+This is the layer where the reference's per-step torch/NCCL machinery
+(DDP all-reduce inside the user train loop, SURVEY.md §3.4.4-6) collapses into
+compiler output: gradients reduce over `data`, parameters gather/scatter over
+`fsdp`, activations split over `tensor`/`seq` — all emitted by GSPMD from the
+shardings we pin on params and batch. Only params and inputs are constrained;
+optimizer state inherits shardings by propagation (zeros_like(param) inside
+the jitted init), which is the robust idiom for arbitrary optax trees.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ray_tpu.parallel import sharding as shd
+
+
+class ShardedTrainStep:
+    """Holds the jitted init/step pair and the shardings they pin.
+
+    loss_fn(params, batch) -> scalar loss. `logical_specs` is the pytree of
+    logical axis names matching params (models expose param_logical_specs).
+    """
+
+    def __init__(
+        self,
+        *,
+        init_params_fn: Callable[[jax.Array], Any],
+        loss_fn: Callable[[Any, Any], jax.Array],
+        logical_specs: Any,
+        mesh: Mesh,
+        rules: Optional[shd.Rules] = None,
+        optimizer: Optional[optax.GradientTransformation] = None,
+        donate: bool = True,
+    ):
+        self.mesh = mesh
+        self.rules = rules or shd.DEFAULT_RULES
+        self.optimizer = optimizer or optax.adamw(3e-4, weight_decay=0.0)
+        self.param_shardings = shd.tree_shardings(mesh, logical_specs, self.rules)
+        self._loss_fn = loss_fn
+        self._init_params_fn = init_params_fn
+
+        def _init(rng):
+            with shd.sharding_ctx(self.mesh, self.rules):
+                params = init_params_fn(rng)
+                opt_state = self.optimizer.init(params)
+            return params, opt_state
+
+        # Pin param shardings; let GSPMD propagate into optimizer state
+        # (mu/nu are zeros_like(param) → inherit the param layout).
+        self._jit_init = jax.jit(
+            _init, out_shardings=(self.param_shardings, None)
+        )
+
+        def _step(params, opt_state, batch):
+            with shd.sharding_ctx(self.mesh, self.rules):
+                loss, grads = jax.value_and_grad(self._loss_fn)(params, batch)
+                updates, opt_state = self.optimizer.update(grads, opt_state, params)
+                params = optax.apply_updates(params, updates)
+            return params, opt_state, loss
+
+        self._jit_step = jax.jit(_step, donate_argnums=(0, 1) if donate else ())
+
+        def _eval(params, batch):
+            with shd.sharding_ctx(self.mesh, self.rules):
+                return self._loss_fn(params, batch)
+
+        self._jit_eval = jax.jit(_eval)
+
+    def init(self, rng: jax.Array) -> Tuple[Any, Any]:
+        return self._jit_init(rng)
+
+    def shard_batch(self, batch: Any) -> Any:
+        return shd.shard_batch(self.mesh, batch)
+
+    def step(self, params, opt_state, batch) -> Tuple[Any, Any, jax.Array]:
+        return self._jit_step(params, opt_state, batch)
+
+    def eval_loss(self, params, batch) -> jax.Array:
+        return self._jit_eval(params, batch)
+
+    def lower_step(self, params, opt_state, batch):
+        """Expose the lowered/compiled step (for compile checks and AOT)."""
+        return self._jit_step.lower(params, opt_state, batch)
+
+
+def transformer_train_step(
+    cfg,
+    mesh: Mesh,
+    *,
+    rules: Optional[shd.Rules] = None,
+    optimizer: Optional[optax.GradientTransformation] = None,
+) -> ShardedTrainStep:
+    """Convenience: wire a models.transformer config into a ShardedTrainStep."""
+    from ray_tpu.models import transformer as tfm
+
+    return ShardedTrainStep(
+        init_params_fn=lambda rng: tfm.init_params(rng, cfg),
+        loss_fn=lambda params, batch: tfm.loss_fn(params, batch, cfg),
+        logical_specs=tfm.param_logical_specs(cfg),
+        mesh=mesh,
+        rules=rules,
+        optimizer=optimizer,
+    )

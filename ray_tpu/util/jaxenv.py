@@ -1,0 +1,50 @@
+"""JAX platform selection helper.
+
+Some environments (axon-tunneled TPU) register a PJRT plugin at interpreter
+startup and force `jax_platforms` via jax.config, which silently overrides the
+JAX_PLATFORMS env var. Anything that needs a specific platform (CPU test
+meshes, TPU bench) must call ensure_platform() before touching devices.
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+
+def ensure_platform(platform: Optional[str] = None) -> None:
+    """Force the JAX platform (before any computation initializes backends).
+
+    Resolution order: explicit arg > RTPU_JAX_PLATFORM > JAX_PLATFORMS env.
+    No-op if none is set.
+    """
+    platform = (
+        platform
+        or os.environ.get("RTPU_JAX_PLATFORM")
+        or os.environ.get("JAX_PLATFORMS")
+    )
+    if not platform:
+        return
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", platform)
+    except Exception as e:
+        import warnings
+
+        warnings.warn(
+            f"could not force jax platform {platform!r} ({e!r}); "
+            "jax may already be initialized on a different backend",
+            stacklevel=2,
+        )
+
+
+def cpu_mesh_env(n_devices: int = 8) -> None:
+    """Configure this process for an n-device virtual CPU mesh (test ring 2,
+    SURVEY.md §4.4). Must run before jax initializes a backend."""
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n_devices}"
+        ).strip()
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    ensure_platform("cpu")

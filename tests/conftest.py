@@ -7,10 +7,11 @@ hardware (SURVEY.md §4.4 test-ring 2).
 """
 import os
 
-os.environ["JAX_PLATFORMS"] = "cpu"
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["RTPU_JAX_PLATFORM"] = "cpu"
+
+from ray_tpu.util.jaxenv import cpu_mesh_env  # noqa: E402
+
+cpu_mesh_env(8)
 
 import pytest  # noqa: E402
 
