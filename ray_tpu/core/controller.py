@@ -29,6 +29,7 @@ import os
 import subprocess
 import sys
 import time
+import uuid
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Set, Tuple
 
@@ -132,6 +133,7 @@ class Controller:
         self.subs: Dict[str, List[protocol.Connection]] = {}  # pubsub channel -> conns
         self.driver_conns: Set[protocol.Connection] = set()
         self._node_counter = 0
+        self._spawned_procs: Dict[str, subprocess.Popen] = {}  # spawn_token -> proc
         self._sched_wakeup = asyncio.Event()
         self._sched_task: Optional[asyncio.Task] = None
         self._closing = False
@@ -247,6 +249,14 @@ class Controller:
         else:
             w = WorkerInfo(worker_id=worker_id, node_id=node_id, conn=conn)
             self.workers[worker_id] = w
+        # Exact proc adoption via startup token (reference: worker startup
+        # tokens, worker_pool.h:251) — heuristic matching can swap proc handles
+        # between workers, making kill() terminate the wrong process.
+        token = msg.get("spawn_token")
+        if token:
+            proc = self._spawned_procs.pop(token, None)
+            if proc is not None:
+                w.proc = proc
         node = self.nodes.get(node_id)
         if node:
             node.workers.add(worker_id)
@@ -808,9 +818,11 @@ class Controller:
         if node.spawning >= 4 or len(node.workers) + node.spawning >= MAX_WORKERS_PER_NODE:
             return
         node.spawning += 1
+        spawn_token = uuid.uuid4().hex
         env = dict(os.environ)
         env["RTPU_CONTROLLER"] = f"{self.host}:{self.port}"
         env["RTPU_NODE_ID"] = node.node_id
+        env["RTPU_SPAWN_TOKEN"] = spawn_token
         pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
         env["PYTHONPATH"] = pkg_root + os.pathsep + env.get("PYTHONPATH", "")
         # Propagate the driver's import path so functions defined in driver-
@@ -826,18 +838,18 @@ class Controller:
             stdout=None,
             stderr=None,
         )
-        # The process registers itself; stash the handle for teardown on the
-        # first worker that registers from this node without one.
-        asyncio.get_running_loop().create_task(self._adopt_proc(node.node_id, proc))
+        self._spawned_procs[spawn_token] = proc
+        # The worker registers itself carrying the token (exact adoption in
+        # _h_register); this task only reaps processes that die pre-register.
+        asyncio.get_running_loop().create_task(self._watch_spawn(node.node_id, spawn_token, proc))
 
-    async def _adopt_proc(self, node_id: str, proc: subprocess.Popen) -> None:
+    async def _watch_spawn(self, node_id: str, spawn_token: str, proc: subprocess.Popen) -> None:
         for _ in range(600):
             await asyncio.sleep(0.1)
-            for w in self.workers.values():
-                if w.node_id == node_id and w.proc is None:
-                    w.proc = proc
-                    return
+            if spawn_token not in self._spawned_procs:
+                return  # adopted by a registered worker
             if proc.poll() is not None:
+                self._spawned_procs.pop(spawn_token, None)
                 node = self.nodes.get(node_id)
                 if node:
                     node.spawning = max(0, node.spawning - 1)

@@ -77,8 +77,23 @@ class WorkerRuntime:
         # task the instant the register request lands.
         ctx.set_worker_context(ctx.WorkerContext(client=self.client, node_id=node_id, role="worker"))
         self.client.request(
-            {"kind": "register", "role": "worker", "worker_id": self.worker_id, "node_id": node_id}
+            {
+                "kind": "register",
+                "role": "worker",
+                "worker_id": self.worker_id,
+                "node_id": node_id,
+                "spawn_token": os.environ.get("RTPU_SPAWN_TOKEN"),
+            }
         )
+
+        # Fate-share with the controller: if the control connection drops the
+        # worker must die (reference: workers fate-share with their raylet;
+        # an orphaned worker would leak forever).
+        async def _watch_conn() -> None:
+            await self.client.conn.closed.wait()
+            self.shutdown_event.set()
+
+        self.client.io.call_nowait(_watch_conn())
 
     # ----------------------------------------------------------- push handler
 

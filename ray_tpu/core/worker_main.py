@@ -20,7 +20,12 @@ def main() -> int:
                 sys.path.insert(0, p)
     from .worker import WorkerRuntime
 
-    rt = WorkerRuntime(addr, node_id)
+    try:
+        rt = WorkerRuntime(addr, node_id)
+    except (ConnectionError, OSError):
+        # Controller already gone (cluster shut down while we were spawning):
+        # exit quietly, mirroring raylet workers dying with their raylet.
+        return 0
     rt.serve_forever()
     return 0
 

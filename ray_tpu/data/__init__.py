@@ -1,0 +1,43 @@
+"""ray_tpu.data — streaming Dataset library (SURVEY.md §2.3, §7 step 6)."""
+from .block import Block, BlockAccessor
+from .context import DataContext
+from .dataset import Dataset
+from .grouped import GroupedData
+from .read_api import (
+    from_arrow,
+    from_blocks,
+    from_items,
+    from_numpy,
+    from_numpy_refs,
+    from_pandas,
+    range,  # noqa: A004
+    range_tensor,
+    read_binary_files,
+    read_csv,
+    read_datasource,
+    read_json,
+    read_numpy,
+    read_parquet,
+)
+
+__all__ = [
+    "Block",
+    "BlockAccessor",
+    "DataContext",
+    "Dataset",
+    "GroupedData",
+    "from_arrow",
+    "from_blocks",
+    "from_items",
+    "from_numpy",
+    "from_numpy_refs",
+    "from_pandas",
+    "range",
+    "range_tensor",
+    "read_binary_files",
+    "read_csv",
+    "read_datasource",
+    "read_json",
+    "read_numpy",
+    "read_parquet",
+]

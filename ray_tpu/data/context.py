@@ -1,0 +1,32 @@
+"""DataContext: per-driver execution knobs (reference: data/context.py
+DataContext — target block sizes, execution options)."""
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Optional
+
+
+@dataclass
+class DataContext:
+    target_max_block_size: int = 128 * 1024 * 1024
+    target_min_block_size: int = 1 * 1024 * 1024
+    # Streaming executor backpressure: max concurrently running tasks per
+    # map stage (reference: ConcurrencyCapBackpressurePolicy +
+    # ReservationOpResourceAllocator, resource_manager.py:29).
+    max_tasks_in_flight: int = 8
+    preserve_order: bool = True
+    default_batch_format: str = "numpy"
+    # Shuffle fan-out (#output partitions defaults to #input blocks).
+    shuffle_partitions: Optional[int] = None
+    read_parallelism: int = 8
+
+    _lock = threading.Lock()
+    _current: Optional["DataContext"] = None
+
+    @classmethod
+    def get_current(cls) -> "DataContext":
+        with cls._lock:
+            if cls._current is None:
+                cls._current = DataContext()
+            return cls._current

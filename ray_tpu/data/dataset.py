@@ -1,0 +1,298 @@
+"""Dataset: lazy, distributed data pipeline.
+
+Parity: reference python/ray/data/dataset.py (map_batches :379, iter_batches
+:3725, materialize :4605, streaming_split :1222), grouped_data.py, read_api.
+A Dataset is a logical-op chain executed by the StreamingExecutor on demand;
+blocks are object refs in the host store. TPU-first: `iter_batches` has a
+device-prefetch path (`iter_device_batches`) that overlaps host→TPU transfer
+with consumption, and actor-pool map_batches reserves TPU chips per actor.
+"""
+from __future__ import annotations
+
+import uuid
+from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple, Union
+
+import numpy as np
+
+import ray_tpu as rt
+
+from . import logical as L
+from .block import Block, BlockAccessor, concat_blocks
+from .context import DataContext
+from .datasource import write_block
+from .executor import StreamingExecutor
+
+
+class Dataset:
+    def __init__(self, ops: List[L.LogicalOp], ctx: Optional[DataContext] = None):
+        self._ops = ops
+        self._ctx = ctx or DataContext.get_current()
+
+    # ------------------------------------------------------------- transforms
+
+    def _append(self, op: L.LogicalOp) -> "Dataset":
+        return Dataset(self._ops + [op], self._ctx)
+
+    def map_batches(
+        self,
+        fn: Union[Callable, type],
+        *,
+        batch_size: Optional[int] = None,
+        batch_format: Optional[str] = None,
+        compute: Any = None,
+        fn_args: Tuple = (),
+        fn_kwargs: Optional[Dict[str, Any]] = None,
+        fn_constructor_args: Tuple = (),
+        fn_constructor_kwargs: Optional[Dict[str, Any]] = None,
+        num_cpus: Optional[float] = None,
+        num_tpus: Optional[float] = None,
+        concurrency: Optional[Any] = None,
+    ) -> "Dataset":
+        """reference: dataset.py:379. A class `fn` runs on an actor pool
+        (stateful UDF — model inference); a plain callable runs as tasks."""
+        return self._append(L.MapBatches(
+            fn=fn,
+            batch_size=batch_size,
+            batch_format=batch_format or self._ctx.default_batch_format,
+            fn_args=fn_args,
+            fn_kwargs=fn_kwargs or {},
+            fn_constructor_args=fn_constructor_args,
+            fn_constructor_kwargs=fn_constructor_kwargs or {},
+            compute=compute,
+            num_cpus=num_cpus,
+            num_tpus=num_tpus,
+            concurrency=concurrency,
+        ))
+
+    def map(self, fn: Callable[[Dict[str, Any]], Dict[str, Any]]) -> "Dataset":
+        return self._append(L.MapRows(fn))
+
+    def flat_map(self, fn: Callable[[Dict[str, Any]], List[Dict[str, Any]]]) -> "Dataset":
+        return self._append(L.FlatMap(fn))
+
+    def filter(self, fn: Callable[[Dict[str, Any]], bool]) -> "Dataset":
+        return self._append(L.Filter(fn))
+
+    def add_column(self, name: str, fn: Callable[[Any], np.ndarray]) -> "Dataset":
+        def add(batch):
+            batch[name] = fn(batch)
+            return batch
+
+        return self._append(L.MapBatches(fn=add, batch_format="numpy"))
+
+    def drop_columns(self, cols: List[str]) -> "Dataset":
+        def drop(batch):
+            return {k: v for k, v in batch.items() if k not in cols}
+
+        return self._append(L.MapBatches(fn=drop, batch_format="numpy"))
+
+    def select_columns(self, cols: List[str]) -> "Dataset":
+        def select(batch):
+            return {k: batch[k] for k in cols}
+
+        return self._append(L.MapBatches(fn=select, batch_format="numpy"))
+
+    def rename_columns(self, mapping: Dict[str, str]) -> "Dataset":
+        def ren(batch):
+            return {mapping.get(k, k): v for k, v in batch.items()}
+
+        return self._append(L.MapBatches(fn=ren, batch_format="numpy"))
+
+    def repartition(self, num_blocks: int) -> "Dataset":
+        return self._append(L.Repartition(num_blocks))
+
+    def random_shuffle(self, *, seed: Optional[int] = None) -> "Dataset":
+        return self._append(L.RandomShuffle(seed))
+
+    def randomize_block_order(self, *, seed: Optional[int] = None) -> "Dataset":
+        """Shuffle at block granularity only — cheap epoch-level reshuffle
+        (reference: dataset.randomize_block_order)."""
+        refs = self.to_block_refs()
+        rng = np.random.default_rng(seed)
+        order = rng.permutation(len(refs))
+        return Dataset([L.InputData(refs=[refs[i] for i in order])], self._ctx)
+
+    def sort(self, key: str, descending: bool = False) -> "Dataset":
+        return self._append(L.Sort(key, descending))
+
+    def limit(self, n: int) -> "Dataset":
+        return self._append(L.Limit(n))
+
+    def union(self, *others: "Dataset") -> "Dataset":
+        return self._append(L.Union([o._ops for o in others]))
+
+    def zip(self, other: "Dataset") -> "Dataset":
+        return self._append(L.Zip(other._ops))
+
+    def groupby(self, key: Optional[str]) -> "GroupedData":
+        from .grouped import GroupedData
+
+        return GroupedData(self, key)
+
+    # ------------------------------------------------------------ consumption
+
+    def _execute(self) -> Iterator[Any]:
+        return StreamingExecutor(self._ctx).execute(self._ops)
+
+    def to_block_refs(self) -> List[Any]:
+        return list(self._execute())
+
+    def materialize(self) -> "Dataset":
+        """Execute fully; the result holds resolved block refs
+        (reference: dataset.py:4605 → MaterializedDataset)."""
+        refs = self.to_block_refs()
+        rt.wait(refs, num_returns=len(refs)) if refs else None
+        return Dataset([L.InputData(refs=refs)], self._ctx)
+
+    def count(self) -> int:
+        @rt.remote
+        def c(b):
+            return BlockAccessor(b).num_rows()
+
+        return int(sum(rt.get([c.remote(r) for r in self._execute()]) or [0]))
+
+    def schema(self) -> Any:
+        for ref in self._execute():
+            return BlockAccessor(rt.get(ref)).schema()
+        return None
+
+    def columns(self) -> List[str]:
+        for ref in self._execute():
+            return BlockAccessor(rt.get(ref)).column_names()
+        return []
+
+    def num_blocks(self) -> int:
+        return len(self.to_block_refs())
+
+    def take(self, n: int = 20) -> List[Dict[str, Any]]:
+        out: List[Dict[str, Any]] = []
+        for ref in self._execute():
+            for row in BlockAccessor(rt.get(ref)).iter_rows():
+                out.append(row)
+                if len(out) >= n:
+                    return out
+        return out
+
+    def take_all(self) -> List[Dict[str, Any]]:
+        return self.take(n=1 << 62)
+
+    def take_batch(self, batch_size: int = 20, *, batch_format: str = "numpy") -> Any:
+        blocks = []
+        have = 0
+        for ref in self._execute():
+            b = rt.get(ref)
+            blocks.append(b)
+            have += BlockAccessor(b).num_rows()
+            if have >= batch_size:
+                break
+        merged = BlockAccessor(concat_blocks(blocks))
+        return BlockAccessor(merged.slice(0, min(batch_size, merged.num_rows()))).to_batch(batch_format)
+
+    def show(self, n: int = 20) -> None:
+        for row in self.take(n):
+            print(row)
+
+    def iter_rows(self) -> Iterator[Dict[str, Any]]:
+        for ref in self._execute():
+            yield from BlockAccessor(rt.get(ref)).iter_rows()
+
+    def iter_batches(
+        self,
+        *,
+        batch_size: Optional[int] = 256,
+        batch_format: str = "numpy",
+        drop_last: bool = False,
+        local_shuffle_buffer_size: Optional[int] = None,
+        local_shuffle_seed: Optional[int] = None,
+    ) -> Iterator[Any]:
+        """reference: dataset.py:3725 — re-chunk the block stream into batches."""
+        from .iterator import batch_stream
+
+        return batch_stream(
+            self._execute(), batch_size, batch_format, drop_last,
+            local_shuffle_buffer_size, local_shuffle_seed,
+        )
+
+    def iter_device_batches(self, *, batch_size: int = 256, sharding=None,
+                            prefetch: int = 2) -> Iterator[Any]:
+        """TPU ingest: numpy batches → `jax.device_put` with a prefetch queue
+        so H2D transfer overlaps consumption (the reference's
+        iter_torch_batches+prefetch_batches analog, TPU-native)."""
+        from .iterator import device_batch_stream
+
+        return device_batch_stream(
+            self.iter_batches(batch_size=batch_size, batch_format="numpy"),
+            sharding, prefetch,
+        )
+
+    def to_pandas(self):
+        import pandas as pd
+
+        dfs = [BlockAccessor(rt.get(r)).to_pandas() for r in self._execute()]
+        return pd.concat(dfs, ignore_index=True) if dfs else pd.DataFrame()
+
+    def to_numpy_refs(self) -> List[Any]:
+        return self.to_block_refs()
+
+    # ------------------------------------------------------------------ split
+
+    def split(self, n: int, *, equal: bool = False) -> List["Dataset"]:
+        refs = self.to_block_refs()
+        groups: List[List[Any]] = [[] for _ in range(n)]
+        for i, r in enumerate(refs):
+            groups[i % n].append(r)
+        return [Dataset([L.InputData(refs=g)], self._ctx) for g in groups]
+
+    def split_shard(self, rank: int, world_size: int) -> "Dataset":
+        """Deterministic round-robin block shard for DP ingest (the simple
+        path behind get_dataset_shard; streaming_split is the coordinated
+        variant)."""
+        refs = self.to_block_refs()
+        mine = [r for i, r in enumerate(refs) if i % world_size == rank]
+        return Dataset([L.InputData(refs=mine)], self._ctx)
+
+    def streaming_split(self, n: int, *, equal: bool = False,
+                        locality_hints: Optional[List[str]] = None) -> List[Any]:
+        """reference: dataset.py:1222 — n coordinated iterators backed by an
+        OutputSplitter actor feeding consumers on demand."""
+        from .iterator import SplitCoordinator, SplitIterator
+
+        name = f"rtpu_split_{uuid.uuid4().hex[:8]}"
+        coord_cls = rt.remote(SplitCoordinator)
+        coord = coord_cls.options(name=name, max_concurrency=max(4, 2 * n)).remote(
+            self._ops, self._ctx, n
+        )
+        return [SplitIterator(coord, i) for i in range(n)]
+
+    # ------------------------------------------------------------------ write
+
+    def write_parquet(self, path: str, **kwargs) -> None:
+        self._write(path, "parquet", **kwargs)
+
+    def write_csv(self, path: str, **kwargs) -> None:
+        self._write(path, "csv", **kwargs)
+
+    def write_json(self, path: str, **kwargs) -> None:
+        self._write(path, "json", **kwargs)
+
+    def _write(self, path: str, fmt: str, **kwargs) -> None:
+        @rt.remote
+        def w(block, i):
+            return write_block(block, path, fmt, i, **kwargs)
+
+        refs = [w.remote(r, i) for i, r in enumerate(self._execute())]
+        rt.get(refs)
+
+    # ------------------------------------------------------------------ stats
+
+    def stats(self) -> str:
+        ex = StreamingExecutor(self._ctx)
+        refs = list(ex.execute(self._ops))
+        if refs:
+            rt.wait(refs, num_returns=len(refs))
+        lines = [f"{name}: {wall:.3f}s over {cnt} blocks" for name, wall, cnt in ex.stats]
+        return "\n".join(lines) or "(no stages executed)"
+
+    def __repr__(self) -> str:
+        names = [type(op).__name__ for op in self._ops]
+        return f"Dataset({' -> '.join(names)})"

@@ -1,0 +1,191 @@
+"""Datasources: read tasks producing blocks.
+
+Parity: reference python/ray/data/datasource/ + read_api.py (read_parquet
+:605, read_csv, read_json, read_numpy, read_binary_files, from_items, range).
+A Datasource yields ReadTask thunks; each runs remotely and returns one block
+(reference: ReadTask → blocks in plasma; here → blocks in the host store).
+"""
+from __future__ import annotations
+
+import glob as globlib
+import os
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from .block import Block, rows_to_block
+
+
+@dataclass
+class ReadTask:
+    """A zero-arg callable returning one block, plus size metadata."""
+
+    fn: Callable[[], Block]
+    num_rows: Optional[int] = None
+
+    def __call__(self) -> Block:
+        return self.fn()
+
+
+class Datasource:
+    def get_read_tasks(self, parallelism: int) -> List[ReadTask]:
+        raise NotImplementedError
+
+
+class RangeDatasource(Datasource):
+    def __init__(self, n: int, tensor_shape: Optional[tuple] = None):
+        self.n = n
+        self.tensor_shape = tensor_shape
+
+    def get_read_tasks(self, parallelism: int) -> List[ReadTask]:
+        parallelism = max(1, min(parallelism, self.n or 1))
+        splits = np.array_split(np.arange(self.n, dtype=np.int64), parallelism)
+        shape = self.tensor_shape
+
+        def make(ids: np.ndarray) -> ReadTask:
+            def read() -> Block:
+                if shape is None:
+                    return {"id": ids}
+                data = np.broadcast_to(
+                    ids.reshape((-1,) + (1,) * len(shape)), (len(ids),) + shape
+                ).copy()
+                return {"data": data}
+
+            return ReadTask(read, num_rows=len(ids))
+
+        return [make(s) for s in splits if len(s) or parallelism == 1]
+
+
+class ItemsDatasource(Datasource):
+    def __init__(self, items: List[Any]):
+        self.items = list(items)
+
+    def get_read_tasks(self, parallelism: int) -> List[ReadTask]:
+        parallelism = max(1, min(parallelism, len(self.items) or 1))
+        chunks = np.array_split(np.arange(len(self.items)), parallelism)
+
+        def make(idx: np.ndarray) -> ReadTask:
+            part = [self.items[i] for i in idx]
+
+            def read() -> Block:
+                rows = [x if isinstance(x, dict) else {"item": x} for x in part]
+                return rows_to_block(rows)
+
+            return ReadTask(read, num_rows=len(part))
+
+        return [make(c) for c in chunks if len(c) or parallelism == 1]
+
+
+def _expand_paths(paths, suffix: Optional[str] = None) -> List[str]:
+    if isinstance(paths, str):
+        paths = [paths]
+    out: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            pat = os.path.join(p, "**", f"*{suffix}" if suffix else "*")
+            out.extend(sorted(f for f in globlib.glob(pat, recursive=True)
+                              if os.path.isfile(f)))
+        elif any(ch in p for ch in "*?["):
+            out.extend(sorted(globlib.glob(p)))
+        else:
+            out.append(p)
+    if not out:
+        raise FileNotFoundError(f"no files matched {paths}")
+    return out
+
+
+class FileDatasource(Datasource):
+    """One read task per file group."""
+
+    suffix: Optional[str] = None
+
+    def __init__(self, paths, **kwargs):
+        self.paths = _expand_paths(paths, self.suffix)
+        self.kwargs = kwargs
+
+    def read_file(self, path: str) -> Block:
+        raise NotImplementedError
+
+    def get_read_tasks(self, parallelism: int) -> List[ReadTask]:
+        groups = np.array_split(np.arange(len(self.paths)), max(1, min(parallelism, len(self.paths))))
+        tasks = []
+        for g in groups:
+            if not len(g):
+                continue
+            files = [self.paths[i] for i in g]
+
+            def read(files=files) -> Block:
+                from .block import concat_blocks
+
+                return concat_blocks([self.read_file(f) for f in files])
+
+            tasks.append(ReadTask(read))
+        return tasks
+
+
+class ParquetDatasource(FileDatasource):
+    suffix = ".parquet"
+
+    def read_file(self, path: str) -> Block:
+        import pyarrow.parquet as pq
+
+        return pq.read_table(path, **self.kwargs)
+
+
+class CSVDatasource(FileDatasource):
+    suffix = ".csv"
+
+    def read_file(self, path: str) -> Block:
+        import pyarrow.csv as pacsv
+
+        return pacsv.read_csv(path, **self.kwargs)
+
+
+class JSONDatasource(FileDatasource):
+    suffix = ".json"
+
+    def read_file(self, path: str) -> Block:
+        import pyarrow.json as pajson
+
+        return pajson.read_json(path, **self.kwargs)
+
+
+class NumpyDatasource(FileDatasource):
+    suffix = ".npy"
+
+    def read_file(self, path: str) -> Block:
+        return {"data": np.load(path, **self.kwargs)}
+
+
+class BinaryDatasource(FileDatasource):
+    def read_file(self, path: str) -> Block:
+        with open(path, "rb") as f:
+            data = f.read()
+        import pyarrow as pa
+
+        return pa.Table.from_pydict({"bytes": [data], "path": [path]})
+
+
+# ------------------------------------------------------------------- writers
+
+
+def write_block(block: Block, path: str, file_format: str, index: int, **kwargs) -> str:
+    from .block import BlockAccessor
+
+    os.makedirs(path, exist_ok=True)
+    fp = os.path.join(path, f"part-{index:05d}.{file_format}")
+    table = BlockAccessor(block).to_arrow()
+    if file_format == "parquet":
+        import pyarrow.parquet as pq
+
+        pq.write_table(table, fp, **kwargs)
+    elif file_format == "csv":
+        import pyarrow.csv as pacsv
+
+        pacsv.write_csv(table, fp, **kwargs)
+    elif file_format == "json":
+        BlockAccessor(block).to_pandas().to_json(fp, orient="records", lines=True)
+    else:
+        raise ValueError(f"unknown format {file_format}")
+    return fp

@@ -1,0 +1,479 @@
+"""Streaming execution of a logical plan over the task/actor plane.
+
+Parity: reference data/_internal/execution/streaming_executor.py (:48, run
+:200, _scheduling_loop_step :250), operators/ (TaskPoolMapOperator,
+ActorPoolMapOperator actor_pool_map_operator.py:36), and planner/exchange for
+the all-to-all ops (push-based shuffle: partition tasks fan out to reduce
+tasks). Structure here: the plan is compiled into a chain of Python
+generators over ObjectRefs — pulling the tail drives the whole pipeline, each
+map stage keeps at most `max_tasks_in_flight` tasks running (backpressure),
+and blocks stream driver-side only as refs (bytes stay in the host store).
+"""
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
+
+import numpy as np
+
+import ray_tpu as rt
+
+from . import logical as L
+from .block import Block, BlockAccessor, block_from_batch, concat_blocks, rows_to_block
+from .context import DataContext
+
+
+# ------------------------------------------------------------- fused map fns
+
+
+def _compile_map_stage(ops: List[L.LogicalOp], batch_format_default: str) -> Callable[[Block], Block]:
+    """Build one block→block function applying all fused ops in order
+    (reference: MapTransformer chaining, _internal/execution/map_transformer.py)."""
+
+    def apply(block: Block) -> Block:
+        for op in ops:
+            acc = BlockAccessor(block)
+            if isinstance(op, L.MapBatches):
+                fmt = op.batch_format or batch_format_default
+                bs = op.batch_size
+                n = acc.num_rows()
+                if bs is None or bs >= n:
+                    out = op.fn(acc.to_batch(fmt), *op.fn_args, **op.fn_kwargs)
+                    block = block_from_batch(out)
+                else:
+                    parts = []
+                    for s in range(0, n, bs):
+                        sub = BlockAccessor(acc.slice(s, min(s + bs, n)))
+                        out = op.fn(sub.to_batch(fmt), *op.fn_args, **op.fn_kwargs)
+                        parts.append(block_from_batch(out))
+                    block = concat_blocks(parts)
+            elif isinstance(op, L.MapRows):
+                block = rows_to_block([op.fn(r) for r in acc.iter_rows()])
+            elif isinstance(op, L.FlatMap):
+                rows: List[Dict[str, Any]] = []
+                for r in acc.iter_rows():
+                    rows.extend(op.fn(r))
+                block = rows_to_block(rows)
+            elif isinstance(op, L.Filter):
+                keep = np.array([bool(op.fn(r)) for r in acc.iter_rows()], dtype=bool)
+                block = acc.take_rows(np.nonzero(keep)[0])
+            else:  # pragma: no cover
+                raise TypeError(f"not a fusable map op: {op}")
+        return block
+
+    return apply
+
+
+class _PoolWorker:
+    """Actor hosting a callable-class UDF (reference: _MapWorker inside
+    ActorPoolMapOperator, actor_pool_map_operator.py)."""
+
+    def __init__(self, cls, ctor_args, ctor_kwargs):
+        self.fn = cls(*ctor_args, **ctor_kwargs)
+
+    def apply(self, block: Block, batch_format: str, batch_size: Optional[int],
+              fn_args, fn_kwargs) -> Block:
+        acc = BlockAccessor(block)
+        n = acc.num_rows()
+        if batch_size is None or batch_size >= n:
+            return block_from_batch(self.fn(acc.to_batch(batch_format), *fn_args, **fn_kwargs))
+        parts = []
+        for s in range(0, n, batch_size):
+            sub = BlockAccessor(acc.slice(s, min(s + batch_size, n)))
+            parts.append(block_from_batch(self.fn(sub.to_batch(batch_format), *fn_args, **fn_kwargs)))
+        return concat_blocks(parts)
+
+
+# ----------------------------------------------------------------- executor
+
+
+class StreamingExecutor:
+    def __init__(self, ctx: Optional[DataContext] = None):
+        self.ctx = ctx or DataContext.get_current()
+        self.stats: List[Tuple[str, float, int]] = []  # (stage, wall_s, blocks)
+
+    # -- public ---------------------------------------------------------------
+
+    def execute(self, ops: List[L.LogicalOp]) -> Iterator[Any]:
+        """Yield output block refs; pulling drives the pipeline."""
+        stages = L.fuse_plan(ops)
+        stream: Iterator[Any] = iter(())
+        for stage in stages:
+            op = stage[0]
+            if isinstance(op, L.Read):
+                stream = self._read_stage(op)
+            elif isinstance(op, L.InputData):
+                stream = iter(list(op.refs))
+            elif isinstance(op, L.MapBatches) and op.is_actor_compute:
+                stream = self._actor_pool_stage(stream, op)
+            elif L.is_fusable_map(op):
+                stream = self._task_map_stage(stream, stage)
+            elif isinstance(op, L.Repartition):
+                stream = self._repartition(stream, op.num_blocks)
+            elif isinstance(op, L.RandomShuffle):
+                stream = self._random_shuffle(stream, op.seed)
+            elif isinstance(op, L.Sort):
+                stream = self._sort(stream, op.key, op.descending)
+            elif isinstance(op, L.Limit):
+                stream = self._limit(stream, op.n)
+            elif isinstance(op, L.Union):
+                stream = self._union(stream, op.others)
+            elif isinstance(op, L.Zip):
+                stream = self._zip(stream, op.other)
+            elif isinstance(op, L.Aggregate):
+                stream = self._aggregate(stream, op)
+            else:  # pragma: no cover
+                raise TypeError(f"unknown logical op {op}")
+        return stream
+
+    # -- stages ---------------------------------------------------------------
+
+    def _read_stage(self, op: L.Read) -> Iterator[Any]:
+        parallelism = op.parallelism if op.parallelism > 0 else self.ctx.read_parallelism
+        tasks = op.datasource.get_read_tasks(parallelism)
+
+        @rt.remote
+        def do_read(task):
+            return task()
+
+        return self._bounded_submit((do_read.remote(t) for t in tasks), "read", len(tasks))
+
+    def _task_map_stage(self, inputs: Iterator[Any], stage: List[L.LogicalOp]) -> Iterator[Any]:
+        apply = _compile_map_stage(stage, self.ctx.default_batch_format)
+        mb = next((o for o in stage if isinstance(o, L.MapBatches)), None)
+        opts: Dict[str, Any] = {}
+        if mb is not None:
+            if mb.num_cpus is not None:
+                opts["num_cpus"] = mb.num_cpus
+            if mb.num_tpus:
+                opts["num_tpus"] = mb.num_tpus
+        remote_fn = rt.remote(apply)
+        if opts:
+            remote_fn = remote_fn.options(**opts)
+        label = "+".join(type(o).__name__ for o in stage)
+        return self._bounded_submit(
+            (remote_fn.remote(ref) for ref in inputs), label, None
+        )
+
+    def _bounded_submit(self, submissions: Iterator[Any], label: str,
+                        total: Optional[int]) -> Iterator[Any]:
+        """Cap in-flight tasks; yield refs in submission (FIFO) order when
+        preserve_order else completion order."""
+        cap = self.ctx.max_tasks_in_flight
+        t0 = time.perf_counter()
+        n = 0
+        pending: List[Any] = []
+        preserve = self.ctx.preserve_order
+        for ref in submissions:
+            pending.append(ref)
+            while len(pending) >= cap:
+                if preserve:
+                    out, pending = pending[0], pending[1:]
+                    rt.wait([out], num_returns=1)
+                else:
+                    ready, pending = rt.wait(pending, num_returns=1)
+                    out = ready[0]
+                n += 1
+                yield out
+        while pending:
+            if preserve:
+                out, pending = pending[0], pending[1:]
+                rt.wait([out], num_returns=1)
+            else:
+                ready, pending = rt.wait(pending, num_returns=1)
+                out = ready[0]
+            n += 1
+            yield out
+        self.stats.append((label, time.perf_counter() - t0, n))
+
+    def _actor_pool_stage(self, inputs: Iterator[Any], op: L.MapBatches) -> Iterator[Any]:
+        """Fixed/bounded actor pool (reference: ActorPoolMapOperator + _ActorPool
+        autoscaling :375; TPU-aware: num_tpus reserves chips per actor so the
+        pool lands one actor per TPU host — the ViT batch-inference shape)."""
+        conc = op.concurrency or 1
+        if isinstance(conc, (tuple, list)):
+            min_actors, max_actors = conc
+        else:
+            min_actors = max_actors = int(conc)
+        actor_opts: Dict[str, Any] = {"max_concurrency": 2}
+        if op.num_cpus is not None:
+            actor_opts["num_cpus"] = op.num_cpus
+        if op.num_tpus:
+            actor_opts["num_tpus"] = op.num_tpus
+        pool_cls = rt.remote(_PoolWorker)
+        actors = [
+            pool_cls.options(**actor_opts).remote(op.fn, op.fn_constructor_args,
+                                                  op.fn_constructor_kwargs)
+            for _ in range(min_actors)
+        ]
+        fmt = op.batch_format or self.ctx.default_batch_format
+        t0 = time.perf_counter()
+        n = 0
+        per_actor_cap = 2
+        inflight: List[Tuple[Any, int]] = []  # (ref, actor_idx)
+        load = [0] * len(actors)
+
+        def submit(ref: Any) -> None:
+            # least-loaded dispatch; grow pool if saturated and below max
+            i = min(range(len(actors)), key=lambda j: load[j])
+            if load[i] >= per_actor_cap and len(actors) < max_actors:
+                actors.append(pool_cls.options(**actor_opts).remote(
+                    op.fn, op.fn_constructor_args, op.fn_constructor_kwargs))
+                load.append(0)
+                i = len(actors) - 1
+            load[i] += 1
+            inflight.append((
+                actors[i].apply.remote(ref, fmt, op.batch_size, op.fn_args, op.fn_kwargs),
+                i,
+            ))
+
+        def drain_one() -> Any:
+            nonlocal n
+            ref, i = inflight.pop(0)
+            rt.wait([ref], num_returns=1)
+            load[i] -= 1
+            n += 1
+            return ref
+
+        try:
+            for ref in inputs:
+                while len(inflight) >= per_actor_cap * len(actors):
+                    yield drain_one()
+                submit(ref)
+            while inflight:
+                yield drain_one()
+        finally:
+            for a in actors:
+                try:
+                    rt.kill(a)
+                except Exception:
+                    pass
+            self.stats.append((f"ActorPool[{type(op.fn).__name__}]",
+                               time.perf_counter() - t0, n))
+
+    # -- all-to-all -----------------------------------------------------------
+
+    def _counts(self, refs: List[Any]) -> List[int]:
+        @rt.remote
+        def count(b):
+            return BlockAccessor(b).num_rows()
+
+        return rt.get([count.remote(r) for r in refs])
+
+    def _repartition(self, inputs: Iterator[Any], num_blocks: int) -> Iterator[Any]:
+        refs = list(inputs)
+        counts = self._counts(refs)
+        total = sum(counts)
+        bounds = [total * i // num_blocks for i in range(num_blocks + 1)]
+
+        @rt.remote
+        def build(start, end, *blocks):
+            parts = []
+            off = 0
+            for b, c in zip(blocks, counts):
+                lo, hi = max(start - off, 0), min(end - off, c)
+                if lo < hi:
+                    parts.append(BlockAccessor(b).slice(lo, hi))
+                off += c
+            return concat_blocks(parts) if parts else rows_to_block([])
+
+        for i in range(num_blocks):
+            yield build.remote(bounds[i], bounds[i + 1], *refs)
+
+    def _random_shuffle(self, inputs: Iterator[Any], seed: Optional[int]) -> Iterator[Any]:
+        """Two-round push shuffle (reference: planner/exchange push-based
+        shuffle): map tasks split each block into P random parts; reduce tasks
+        concat + local permute."""
+        refs = list(inputs)
+        P = self.ctx.shuffle_partitions or max(1, len(refs))
+
+        def split(block, i):
+            rng = np.random.default_rng(None if seed is None else seed + i)
+            acc = BlockAccessor(block)
+            n = acc.num_rows()
+            perm = rng.permutation(n)
+            out = [acc.take_rows(part) for part in np.array_split(perm, P)]
+            return out if P > 1 else out[0]
+
+        split_remote = rt.remote(split).options(num_returns=P)
+        parts: List[List[Any]] = []
+        for i, r in enumerate(refs):
+            res = split_remote.remote(r, i)
+            parts.append([res] if P == 1 else list(res))
+
+        def reduce(j, *shards):
+            rng = np.random.default_rng(None if seed is None else seed + 10_000 + j)
+            merged = concat_blocks(list(shards))
+            acc = BlockAccessor(merged)
+            return acc.take_rows(rng.permutation(acc.num_rows()))
+
+        reduce_remote = rt.remote(reduce)
+        for j in range(P):
+            yield reduce_remote.remote(j, *[parts[i][j] for i in range(len(refs))])
+
+    def _sort(self, inputs: Iterator[Any], key: str, descending: bool) -> Iterator[Any]:
+        """Sample-based range partition sort (reference: exchange/sort)."""
+        refs = list(inputs)
+        P = max(1, len(refs))
+
+        @rt.remote
+        def sample(b):
+            cols = BlockAccessor(b).to_numpy()
+            v = cols[key]
+            if len(v) == 0:
+                return v
+            idx = np.random.default_rng(0).choice(len(v), min(20, len(v)), replace=False)
+            return v[idx]
+
+        samples = np.concatenate([s for s in rt.get([sample.remote(r) for r in refs])
+                                  if len(s)]) if refs else np.array([])
+        if len(samples) == 0:
+            yield from refs
+            return
+        qs = np.quantile(np.sort(samples), np.linspace(0, 1, P + 1)[1:-1]) if P > 1 else []
+
+        def partition(b):
+            acc = BlockAccessor(b)
+            v = acc.to_numpy()[key]
+            ids = np.searchsorted(qs, v, side="right") if P > 1 else np.zeros(len(v), int)
+            out = [acc.take_rows(np.nonzero(ids == p)[0]) for p in range(P)]
+            return out if P > 1 else out[0]
+
+        part_remote = rt.remote(partition).options(num_returns=P)
+        parts = []
+        for r in refs:
+            res = part_remote.remote(r)
+            parts.append([res] if P == 1 else list(res))
+
+        def merge(*shards):
+            merged = concat_blocks(list(shards))
+            acc = BlockAccessor(merged)
+            order = np.argsort(acc.to_numpy()[key], kind="stable")
+            if descending:
+                order = order[::-1]
+            return acc.take_rows(order)
+
+        merge_remote = rt.remote(merge)
+        outs = [merge_remote.remote(*[parts[i][j] for i in range(len(refs))])
+                for j in range(P)]
+        yield from (outs[::-1] if descending else outs)
+
+    def _limit(self, inputs: Iterator[Any], n: int) -> Iterator[Any]:
+        taken = 0
+
+        @rt.remote
+        def head(b, k):
+            return BlockAccessor(b).slice(0, k)
+
+        @rt.remote
+        def count(b):
+            return BlockAccessor(b).num_rows()
+
+        for ref in inputs:
+            if taken >= n:
+                break
+            c = rt.get(count.remote(ref))
+            if taken + c <= n:
+                taken += c
+                yield ref
+            else:
+                yield head.remote(ref, n - taken)
+                taken = n
+
+    def _union(self, inputs: Iterator[Any], other_plans: List[List[L.LogicalOp]]) -> Iterator[Any]:
+        yield from inputs
+        for plan in other_plans:
+            yield from StreamingExecutor(self.ctx).execute(plan)
+
+    def _zip(self, inputs: Iterator[Any], other_plan: List[L.LogicalOp]) -> Iterator[Any]:
+        left = list(inputs)
+        right = list(StreamingExecutor(self.ctx).execute(other_plan))
+        lcounts = self._counts(left)
+        rcounts = self._counts(right)
+        if sum(lcounts) != sum(rcounts):
+            raise ValueError(
+                f"zip requires equal row counts, got {sum(lcounts)} vs {sum(rcounts)}"
+            )
+
+        @rt.remote
+        def zip_slice(start, end, lblock, *rblocks):
+            lcols = BlockAccessor(lblock).to_numpy()
+            parts = []
+            off = 0
+            for rb, c in zip(rblocks, rcounts):
+                lo, hi = max(start - off, 0), min(end - off, c)
+                if lo < hi:
+                    parts.append(BlockAccessor(rb).slice(lo, hi))
+                off += c
+            rcols = BlockAccessor(concat_blocks(parts)).to_numpy()
+            out = dict(lcols)
+            for k, v in rcols.items():
+                out[k if k not in out else f"{k}_1"] = v
+            return out
+
+        off = 0
+        for lb, c in zip(left, lcounts):
+            yield zip_slice.remote(off, off + c, lb, *right)
+            off += c
+
+    def _aggregate(self, inputs: Iterator[Any], op: L.Aggregate) -> Iterator[Any]:
+        """Hash-partition groupby + per-partition pandas aggregate
+        (reference: grouped_data.py over sort-based exchange)."""
+        refs = list(inputs)
+        key = op.key
+        aggs = op.aggs
+        P = max(1, min(len(refs), 8)) if key is not None else 1
+
+        if key is None:
+            @rt.remote
+            def global_agg(*blocks):
+                import pandas as pd
+
+                df = pd.concat([BlockAccessor(b).to_pandas() for b in blocks])
+                row: Dict[str, Any] = {}
+                for kind, col, out_name in aggs:
+                    if kind == "count":
+                        row[out_name] = len(df)
+                    else:
+                        row[out_name] = getattr(df[col], kind)()
+                return rows_to_block([row])
+
+            yield global_agg.remote(*refs)
+            return
+
+        def part_fn(b):
+            import zlib
+
+            acc = BlockAccessor(b)
+            v = acc.to_numpy()[key]
+            # Stable cross-process hash: Python's hash() is salted per process
+            # (PYTHONHASHSEED), which would scatter one key across partitions.
+            h = np.array([zlib.crc32(repr(x).encode()) % P for x in v.tolist()])
+            out = [acc.take_rows(np.nonzero(h == p)[0]) for p in range(P)]
+            return out if P > 1 else out[0]
+
+        part_remote = rt.remote(part_fn).options(num_returns=P)
+        parts = []
+        for r in refs:
+            res = part_remote.remote(r)
+            parts.append([res] if P == 1 else list(res))
+
+        def agg_fn(*shards):
+            import pandas as pd
+
+            df = pd.concat([BlockAccessor(b).to_pandas() for b in shards])
+            if df.empty:
+                return rows_to_block([])
+            g = df.groupby(key, sort=True)
+            out = pd.DataFrame(index=g.size().index)
+            for kind, col, out_name in aggs:
+                if kind == "count":
+                    out[out_name] = g.size()
+                else:
+                    out[out_name] = getattr(g[col], kind)()
+            out = out.reset_index()
+            return {c: out[c].to_numpy() for c in out.columns}
+
+        agg_remote = rt.remote(agg_fn)
+        for j in range(P):
+            yield agg_remote.remote(*[parts[i][j] for i in range(len(refs))])

@@ -1,0 +1,202 @@
+"""Batch iteration, device prefetch, and coordinated streaming splits.
+
+Parity: reference data/iterator.py (DataIterator), block_batching/ (batcher +
+local shuffle buffer), and the OutputSplitter operator backing
+Dataset.streaming_split (_internal/execution/operators/output_splitter.py).
+TPU-first: `device_batch_stream` overlaps `jax.device_put` H2D with consumer
+compute via a small prefetch queue — the torch `prefetch_batches`/pin-memory
+analog for XLA.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, Dict, Iterator, List, Optional
+
+import numpy as np
+
+import ray_tpu as rt
+
+from .block import BlockAccessor, concat_blocks
+
+
+def batch_stream(refs: Iterator[Any], batch_size: Optional[int], batch_format: str,
+                 drop_last: bool, shuffle_buffer: Optional[int],
+                 shuffle_seed: Optional[int]) -> Iterator[Any]:
+    """Re-chunk a stream of block refs into fixed-size batches."""
+    rng = np.random.default_rng(shuffle_seed)
+    carry = None  # leftover block
+    buffer: List[Dict[str, np.ndarray]] = []
+    buffered_rows = 0
+
+    def emit(block) -> Iterator[Any]:
+        nonlocal carry
+        acc = BlockAccessor(block)
+        n = acc.num_rows()
+        if batch_size is None:
+            if n:
+                yield acc.to_batch(batch_format)
+            return
+        start = 0
+        while n - start >= batch_size:
+            yield BlockAccessor(acc.slice(start, start + batch_size)).to_batch(batch_format)
+            start += batch_size
+        carry = acc.slice(start, n) if start < n else None
+
+    for ref in refs:
+        block = rt.get(ref)
+        if shuffle_buffer:
+            acc = BlockAccessor(block)
+            buffer.append(acc.to_numpy())
+            buffered_rows += acc.num_rows()
+            if buffered_rows >= shuffle_buffer:
+                merged = BlockAccessor(concat_blocks(buffer))
+                perm = rng.permutation(merged.num_rows())
+                buffer, buffered_rows = [], 0
+                block = merged.take_rows(perm)
+            else:
+                continue
+        if carry is not None:
+            block = concat_blocks([carry, block])
+            carry = None
+        yield from emit(block)
+    if shuffle_buffer and buffer:
+        merged = BlockAccessor(concat_blocks(buffer))
+        block = merged.take_rows(rng.permutation(merged.num_rows()))
+        if carry is not None:
+            block = concat_blocks([carry, block])
+            carry = None
+        yield from emit(block)
+    if carry is not None and not drop_last:
+        acc = BlockAccessor(carry)
+        if acc.num_rows():
+            yield acc.to_batch(batch_format)
+
+
+def device_batch_stream(batches: Iterator[Dict[str, np.ndarray]], sharding,
+                        prefetch: int) -> Iterator[Any]:
+    """Move numpy batches onto device ahead of consumption.
+
+    A producer thread runs `jax.device_put` (async dispatch: returns as soon
+    as the transfer is enqueued) keeping up to `prefetch` batches in flight,
+    so HBM fills while the consumer's previous step computes.
+    """
+    import jax
+
+    q: "queue.Queue[Any]" = queue.Queue(maxsize=max(1, prefetch))
+    _DONE = object()
+    stop = threading.Event()
+
+    def put(item: Any) -> bool:
+        # Bounded put that notices consumer abandonment: without the stop
+        # check a dropped generator would block this thread in q.put forever,
+        # pinning `prefetch` device batches in HBM for the process lifetime.
+        while not stop.is_set():
+            try:
+                q.put(item, timeout=0.5)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def produce() -> None:
+        try:
+            for b in batches:
+                if stop.is_set():
+                    return
+                dev = jax.device_put(b, sharding) if sharding is not None \
+                    else jax.device_put(b)
+                if not put(dev):
+                    return
+        except BaseException as e:  # noqa: BLE001 — surfaced on the consumer side
+            put(e)
+        finally:
+            put(_DONE)
+
+    t = threading.Thread(target=produce, name="device-prefetch", daemon=True)
+    t.start()
+    try:
+        while True:
+            item = q.get()
+            if item is _DONE:
+                return
+            if isinstance(item, BaseException):
+                raise item
+            yield item
+    finally:
+        stop.set()
+
+
+class SplitCoordinator:
+    """Actor feeding n consumers from one executed stream on demand
+    (reference: OutputSplitter behind streaming_split, output_splitter.py;
+    `equal=False` semantics — first-come first-served block handout)."""
+
+    def __init__(self, ops, ctx, n: int):
+        from .executor import StreamingExecutor
+
+        self._stream = StreamingExecutor(ctx).execute(ops)
+        self._lock = threading.Lock()
+        self.n = n
+        self._epoch_refs: List[Any] = []  # replayable for repeated epochs
+        self._consumed_all = False
+        self._positions: Dict[Any, int] = {}
+
+    def next_block(self, split_idx: int, epoch: int) -> Optional[Any]:
+        with self._lock:
+            if epoch == 0:
+                # First epoch: dynamic first-come-first-served handout straight
+                # off the streaming executor (load-balances uneven consumers).
+                if self._consumed_all:
+                    return None
+                try:
+                    ref = next(self._stream)
+                    self._epoch_refs.append(ref)
+                    return ref
+                except StopIteration:
+                    self._consumed_all = True
+                    return None
+            # Later epochs replay the materialized refs round-robin.
+            refs = [r for i, r in enumerate(self._epoch_refs)
+                    if i % self.n == split_idx]
+            key = (split_idx, epoch)
+            pos = self._positions.get(key, 0)
+            if pos >= len(refs):
+                return None
+            self._positions[key] = pos + 1
+            return refs[pos]
+
+
+class SplitIterator:
+    """Per-consumer handle to a SplitCoordinator."""
+
+    def __init__(self, coordinator, split_idx: int):
+        self._coord = coordinator
+        self._idx = split_idx
+        self._epoch = 0
+
+    def _ref_stream(self) -> Iterator[Any]:
+        while True:
+            ref = rt.get(self._coord.next_block.remote(self._idx, self._epoch))
+            if ref is None:
+                self._epoch += 1
+                return
+            yield ref
+
+    def iter_batches(self, *, batch_size: Optional[int] = 256,
+                     batch_format: str = "numpy", drop_last: bool = False,
+                     local_shuffle_buffer_size: Optional[int] = None,
+                     local_shuffle_seed: Optional[int] = None) -> Iterator[Any]:
+        return batch_stream(self._ref_stream(), batch_size, batch_format,
+                            drop_last, local_shuffle_buffer_size, local_shuffle_seed)
+
+    def iter_rows(self) -> Iterator[Dict[str, Any]]:
+        for ref in self._ref_stream():
+            yield from BlockAccessor(rt.get(ref)).iter_rows()
+
+    def iter_device_batches(self, *, batch_size: int = 256, sharding=None,
+                            prefetch: int = 2) -> Iterator[Any]:
+        return device_batch_stream(
+            self.iter_batches(batch_size=batch_size, batch_format="numpy"),
+            sharding, prefetch,
+        )

@@ -1,0 +1,130 @@
+"""Logical plan: operators + the fusion rule.
+
+Parity: reference data/_internal/logical/ (logical operators, optimizers.py
+rewrite rules — notably map fusion) and _internal/planner/. The plan is a
+chain (Union/Zip reference sibling plans); the optimizer fuses adjacent
+row/batch maps with compatible compute so one task does the whole pipeline
+stage (the reference's OperatorFusionRule).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from .datasource import Datasource
+
+
+@dataclass
+class LogicalOp:
+    pass
+
+
+@dataclass
+class Read(LogicalOp):
+    datasource: Datasource
+    parallelism: int = -1
+
+
+@dataclass
+class InputData(LogicalOp):
+    """Pre-materialized block refs (from_blocks / materialized datasets)."""
+
+    refs: List[Any] = field(default_factory=list)
+
+
+@dataclass
+class MapBatches(LogicalOp):
+    fn: Any  # callable, or class for actor-pool compute
+    batch_size: Optional[int] = None
+    batch_format: str = "numpy"
+    fn_args: Tuple = ()
+    fn_kwargs: Dict[str, Any] = field(default_factory=dict)
+    fn_constructor_args: Tuple = ()
+    fn_constructor_kwargs: Dict[str, Any] = field(default_factory=dict)
+    compute: Optional[Any] = None  # None=tasks; ActorPoolStrategy for actors
+    num_cpus: Optional[float] = None
+    num_tpus: Optional[float] = None
+    concurrency: Optional[Any] = None
+
+    @property
+    def is_actor_compute(self) -> bool:
+        return isinstance(self.fn, type)
+
+
+@dataclass
+class MapRows(LogicalOp):
+    fn: Callable[[Dict[str, Any]], Dict[str, Any]]
+
+
+@dataclass
+class FlatMap(LogicalOp):
+    fn: Callable[[Dict[str, Any]], List[Dict[str, Any]]]
+
+
+@dataclass
+class Filter(LogicalOp):
+    fn: Callable[[Dict[str, Any]], bool]
+
+
+@dataclass
+class Repartition(LogicalOp):
+    num_blocks: int
+
+
+@dataclass
+class RandomShuffle(LogicalOp):
+    seed: Optional[int] = None
+
+
+@dataclass
+class Sort(LogicalOp):
+    key: str
+    descending: bool = False
+
+
+@dataclass
+class Limit(LogicalOp):
+    n: int
+
+
+@dataclass
+class Union(LogicalOp):
+    others: List[List[LogicalOp]] = field(default_factory=list)
+
+
+@dataclass
+class Zip(LogicalOp):
+    other: List[LogicalOp] = field(default_factory=list)
+
+
+@dataclass
+class Aggregate(LogicalOp):
+    key: Optional[str]
+    aggs: List[Tuple[str, str, str]] = field(default_factory=list)  # (kind, col, out_name)
+
+
+ROW_OPS = (MapRows, FlatMap, Filter)
+
+
+def is_fusable_map(op: LogicalOp) -> bool:
+    if isinstance(op, ROW_OPS):
+        return True
+    return isinstance(op, MapBatches) and not op.is_actor_compute
+
+
+def fuse_plan(ops: List[LogicalOp]) -> List[List[LogicalOp]]:
+    """Group the chain into stages: runs of fusable maps become one stage
+    (executed by a single task per block); everything else stands alone."""
+    stages: List[List[LogicalOp]] = []
+    run: List[LogicalOp] = []
+    for op in ops:
+        if is_fusable_map(op):
+            run.append(op)
+        else:
+            if run:
+                stages.append(run)
+                run = []
+            stages.append([op])
+    if run:
+        stages.append(run)
+    return stages

@@ -1,0 +1,101 @@
+"""Dataset creation API (reference: python/ray/data/read_api.py —
+read_parquet :605, range, from_items, from_pandas, from_numpy, ...)."""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from . import logical as L
+from .context import DataContext
+from .dataset import Dataset
+from .datasource import (
+    BinaryDatasource,
+    CSVDatasource,
+    Datasource,
+    ItemsDatasource,
+    JSONDatasource,
+    NumpyDatasource,
+    ParquetDatasource,
+    RangeDatasource,
+)
+
+
+def _mk(ds: Datasource, parallelism: int = -1) -> Dataset:
+    return Dataset([L.Read(ds, parallelism)])
+
+
+def range(n: int, *, parallelism: int = -1) -> Dataset:  # noqa: A001
+    return _mk(RangeDatasource(n), parallelism)
+
+
+def range_tensor(n: int, *, shape: tuple = (1,), parallelism: int = -1) -> Dataset:
+    return _mk(RangeDatasource(n, tensor_shape=tuple(shape)), parallelism)
+
+
+def from_items(items: List[Any], *, parallelism: int = -1) -> Dataset:
+    return _mk(ItemsDatasource(items), parallelism)
+
+
+def from_numpy(arr: np.ndarray, *, column: str = "data") -> Dataset:
+    import ray_tpu as rt
+
+    ref = rt.put({column: np.asarray(arr)})
+    return Dataset([L.InputData(refs=[ref])])
+
+
+def from_numpy_refs(refs: List[Any]) -> Dataset:
+    return Dataset([L.InputData(refs=list(refs))])
+
+
+def from_blocks(blocks: List[Any]) -> Dataset:
+    import ray_tpu as rt
+
+    return Dataset([L.InputData(refs=[rt.put(b) for b in blocks])])
+
+
+def from_pandas(dfs) -> Dataset:
+    import pandas as pd
+
+    import ray_tpu as rt
+
+    if isinstance(dfs, pd.DataFrame):
+        dfs = [dfs]
+    import pyarrow as pa
+
+    refs = [rt.put(pa.Table.from_pandas(df, preserve_index=False)) for df in dfs]
+    return Dataset([L.InputData(refs=refs)])
+
+
+def from_arrow(tables) -> Dataset:
+    import pyarrow as pa
+
+    import ray_tpu as rt
+
+    if isinstance(tables, pa.Table):
+        tables = [tables]
+    return Dataset([L.InputData(refs=[rt.put(t) for t in tables])])
+
+
+def read_parquet(paths, *, parallelism: int = -1, **kwargs) -> Dataset:
+    return _mk(ParquetDatasource(paths, **kwargs), parallelism)
+
+
+def read_csv(paths, *, parallelism: int = -1, **kwargs) -> Dataset:
+    return _mk(CSVDatasource(paths, **kwargs), parallelism)
+
+
+def read_json(paths, *, parallelism: int = -1, **kwargs) -> Dataset:
+    return _mk(JSONDatasource(paths, **kwargs), parallelism)
+
+
+def read_numpy(paths, *, parallelism: int = -1, **kwargs) -> Dataset:
+    return _mk(NumpyDatasource(paths, **kwargs), parallelism)
+
+
+def read_binary_files(paths, *, parallelism: int = -1) -> Dataset:
+    return _mk(BinaryDatasource(paths), parallelism)
+
+
+def read_datasource(datasource: Datasource, *, parallelism: int = -1) -> Dataset:
+    return _mk(datasource, parallelism)

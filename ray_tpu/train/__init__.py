@@ -1,0 +1,53 @@
+"""ray_tpu.train — the Train-equivalent layer (SURVEY.md §2.4, §7 step 5)."""
+from .backend import Backend, HostCollectiveBackend, JaxBackend
+from .backend_executor import BackendExecutor, TrainingFailedError, TrainingIterator
+from .checkpoint import Checkpoint
+from .config import CheckpointConfig, FailureConfig, RunConfig, ScalingConfig
+from .data_parallel_trainer import DataParallelTrainer, JaxTrainer, Result
+from .session import (
+    get_checkpoint,
+    get_context,
+    get_dataset_shard,
+    get_mesh,
+    report,
+)
+from .storage import CheckpointManager, StorageContext
+
+
+def __getattr__(name):
+    # `.step` pulls jax+optax; keep that out of control-plane worker startup.
+    if name in ("ShardedTrainStep", "transformer_train_step"):
+        from . import step
+
+        return getattr(step, name)
+    raise AttributeError(name)
+
+from .worker_group import RayTrainWorker, WorkerGroup
+
+__all__ = [
+    "Backend",
+    "BackendExecutor",
+    "Checkpoint",
+    "CheckpointConfig",
+    "CheckpointManager",
+    "DataParallelTrainer",
+    "FailureConfig",
+    "HostCollectiveBackend",
+    "JaxBackend",
+    "JaxTrainer",
+    "RayTrainWorker",
+    "Result",
+    "RunConfig",
+    "ScalingConfig",
+    "ShardedTrainStep",
+    "StorageContext",
+    "TrainingFailedError",
+    "TrainingIterator",
+    "WorkerGroup",
+    "get_checkpoint",
+    "get_context",
+    "get_dataset_shard",
+    "get_mesh",
+    "report",
+    "transformer_train_step",
+]

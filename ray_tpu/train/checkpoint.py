@@ -1,0 +1,104 @@
+"""Directory-based checkpoints.
+
+Parity: reference train/_checkpoint.py (directory `Checkpoint` with
+from_directory/to_directory/as_directory) + dict convenience carried over from
+its legacy API. TPU-first delta (SURVEY.md §5.4): `save_sharded` /
+`load_sharded` persist a jax pytree with every *host* writing only the shards
+it owns, via orbax — the tensorstore/ocdbt-style path the reference lacks.
+"""
+from __future__ import annotations
+
+import json
+import os
+import pickle
+import shutil
+import tempfile
+import uuid
+from contextlib import contextmanager
+from typing import Any, Dict, Iterator, Optional
+
+_DICT_FILE = "_dict_checkpoint.pkl"
+_METADATA_FILE = ".metadata.json"
+
+
+class Checkpoint:
+    """A checkpoint is a directory; this is a handle to it."""
+
+    def __init__(self, path: str):
+        self.path = os.path.abspath(os.fspath(path))
+
+    # ------------------------------------------------------------ construction
+
+    @classmethod
+    def from_directory(cls, path: str) -> "Checkpoint":
+        return cls(path)
+
+    @classmethod
+    def from_dict(cls, data: Dict[str, Any]) -> "Checkpoint":
+        d = tempfile.mkdtemp(prefix="rtpu_ckpt_")
+        with open(os.path.join(d, _DICT_FILE), "wb") as f:
+            pickle.dump(data, f)
+        return cls(d)
+
+    # ------------------------------------------------------------------ access
+
+    def to_dict(self) -> Dict[str, Any]:
+        p = os.path.join(self.path, _DICT_FILE)
+        if not os.path.exists(p):
+            raise ValueError(f"checkpoint at {self.path} is not a dict checkpoint")
+        with open(p, "rb") as f:
+            return pickle.load(f)
+
+    def to_directory(self, path: Optional[str] = None) -> str:
+        dest = path or tempfile.mkdtemp(prefix="rtpu_ckpt_")
+        if os.path.abspath(dest) != self.path:
+            shutil.copytree(self.path, dest, dirs_exist_ok=True)
+        return dest
+
+    @contextmanager
+    def as_directory(self) -> Iterator[str]:
+        yield self.path
+
+    # --------------------------------------------------------------- metadata
+
+    def set_metadata(self, metadata: Dict[str, Any]) -> None:
+        with open(os.path.join(self.path, _METADATA_FILE), "w") as f:
+            json.dump(metadata, f)
+
+    def get_metadata(self) -> Dict[str, Any]:
+        p = os.path.join(self.path, _METADATA_FILE)
+        if not os.path.exists(p):
+            return {}
+        with open(p) as f:
+            return json.load(f)
+
+    # ------------------------------------------------- sharded jax checkpoints
+
+    @classmethod
+    def save_sharded(cls, tree: Any, path: Optional[str] = None) -> "Checkpoint":
+        """Persist a (possibly sharded) jax pytree; each host writes only its
+        own shards (orbax/tensorstore ocdbt layout)."""
+        import orbax.checkpoint as ocp
+
+        dest = os.path.abspath(path or os.path.join(
+            tempfile.gettempdir(), f"rtpu_sharded_{uuid.uuid4().hex[:12]}"
+        ))
+        ckptr = ocp.StandardCheckpointer()
+        ckptr.save(os.path.join(dest, "state"), tree, force=True)
+        ckptr.wait_until_finished()
+        return cls(dest)
+
+    def load_sharded(self, target: Any = None) -> Any:
+        """Restore the pytree; with `target` (a pytree of jax.ShapeDtypeStruct
+        with shardings, or live arrays) shards land directly on the right
+        devices without a host gather."""
+        import orbax.checkpoint as ocp
+
+        ckptr = ocp.StandardCheckpointer()
+        state_path = os.path.join(self.path, "state")
+        if target is not None:
+            return ckptr.restore(state_path, target)
+        return ckptr.restore(state_path)
+
+    def __repr__(self) -> str:
+        return f"Checkpoint(path={self.path!r})"

@@ -1,0 +1,85 @@
+"""Run/scaling configuration dataclasses.
+
+Parity with the reference's AIR configs (ray: python/ray/air/config.py —
+ScalingConfig, RunConfig :623, FailureConfig :395, CheckpointConfig :457).
+TPU-first deltas: resources are expressed as TPU chips per worker, and a
+worker is a *host* (one process per TPU host owning all its chips — the JAX
+process model), not a per-device rank like torch DDP.
+"""
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional
+
+
+@dataclass
+class ScalingConfig:
+    """How many train workers and what each reserves.
+
+    reference: air/config.py ScalingConfig (num_workers, use_gpu,
+    resources_per_worker, placement_strategy). `use_tpu=True` gives each
+    worker `tpus_per_worker` chips. Placement defaults to PACK (reference
+    default); for multi-host TPU training pass
+    `placement_strategy="STRICT_SPREAD"` so workers land one-per-host (the
+    JAX process model — one process owns all of a host's chips).
+    """
+
+    num_workers: int = 1
+    use_tpu: bool = False
+    tpus_per_worker: float = 0
+    resources_per_worker: Optional[Dict[str, float]] = None
+    placement_strategy: str = "PACK"
+    trainer_resources: Optional[Dict[str, float]] = None
+
+    def worker_resources(self) -> Dict[str, float]:
+        res = dict(self.resources_per_worker or {})
+        if "CPU" not in res:
+            res["CPU"] = 1.0
+        if self.use_tpu and "TPU" not in res:
+            res["TPU"] = float(self.tpus_per_worker or 1)
+        return {k: v for k, v in res.items() if v}
+
+    def as_placement_group_bundles(self) -> list:
+        return [self.worker_resources() for _ in range(self.num_workers)]
+
+
+@dataclass
+class FailureConfig:
+    """reference: air/config.py:395 — max_failures whole-group restarts; -1
+    means unlimited."""
+
+    max_failures: int = 0
+
+
+@dataclass
+class CheckpointConfig:
+    """reference: air/config.py:457 — top-k retention ordered by a metric."""
+
+    num_to_keep: Optional[int] = None
+    checkpoint_score_attribute: Optional[str] = None
+    checkpoint_score_order: str = "max"
+    checkpoint_frequency: int = 0
+    checkpoint_at_end: Optional[bool] = None
+
+    def __post_init__(self) -> None:
+        if self.checkpoint_score_order not in ("max", "min"):
+            raise ValueError("checkpoint_score_order must be 'max' or 'min'")
+
+
+@dataclass
+class RunConfig:
+    """reference: air/config.py:623 — experiment name, storage, failure and
+    checkpoint policy."""
+
+    name: Optional[str] = None
+    storage_path: Optional[str] = None
+    failure_config: FailureConfig = field(default_factory=FailureConfig)
+    checkpoint_config: CheckpointConfig = field(default_factory=CheckpointConfig)
+    stop: Optional[Dict[str, Any]] = None
+    verbose: int = 1
+    log_to_file: bool = False
+    callbacks: Optional[list] = None
+
+    def resolved_storage_path(self) -> str:
+        return self.storage_path or os.path.expanduser("~/ray_tpu_results")

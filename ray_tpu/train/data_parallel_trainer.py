@@ -1,0 +1,156 @@
+"""DataParallelTrainer + JaxTrainer + Result.
+
+Parity: reference train/base_trainer.py:111 (BaseTrainer, fit :567) and
+train/data_parallel_trainer.py:25 (DataParallelTrainer, training_loop :428).
+The reference routes every fit through Tune as a single-trial experiment; here
+fit() drives the TrainingIterator directly and the Tune layer reuses the same
+trainable wrapper (`as_trainable`) when running under a Tuner — same topology,
+one less mandatory hop.
+
+JaxTrainer is the north-star addition (SURVEY.md §7 step 5): workers are TPU
+hosts; the backend forms the jax Mesh (ICI) before the user loop runs, and
+`ray_tpu.train.get_mesh()` hands it to the loop.
+"""
+from __future__ import annotations
+
+import os
+import uuid
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Optional
+
+from .backend import Backend, HostCollectiveBackend, JaxBackend
+from .backend_executor import TrainingFailedError, TrainingIterator
+from .checkpoint import Checkpoint
+from .config import RunConfig, ScalingConfig
+from .storage import CheckpointManager, StorageContext
+
+
+@dataclass
+class Result:
+    """reference: air/result.py — terminal metrics + best/latest checkpoint."""
+
+    metrics: Dict[str, Any]
+    checkpoint: Optional[Checkpoint]
+    path: str
+    error: Optional[BaseException] = None
+    best_checkpoints: list = field(default_factory=list)
+
+
+class DataParallelTrainer:
+    """SPMD function trainer: run `train_loop_per_worker` on N workers.
+
+    reference: train/data_parallel_trainer.py:25. Gradient sync strategy is
+    the worker function's business: host collectives for CPU smoke
+    (util.collective), in-mesh XLA collectives on TPU (the loop just calls a
+    jitted sharded step).
+    """
+
+    _default_backend_cls = HostCollectiveBackend
+
+    def __init__(
+        self,
+        train_loop_per_worker: Callable,
+        *,
+        train_loop_config: Optional[Dict[str, Any]] = None,
+        scaling_config: Optional[ScalingConfig] = None,
+        run_config: Optional[RunConfig] = None,
+        datasets: Optional[Dict[str, Any]] = None,
+        backend: Optional[Backend] = None,
+        resume_from_checkpoint: Optional[Checkpoint] = None,
+    ):
+        self.train_loop_per_worker = train_loop_per_worker
+        self.train_loop_config = train_loop_config
+        self.scaling_config = scaling_config or ScalingConfig()
+        self.run_config = run_config or RunConfig()
+        self.datasets = datasets or {}
+        self.backend = backend or self._default_backend_cls()
+        self.resume_from_checkpoint = resume_from_checkpoint
+
+    # ------------------------------------------------------------------- fit
+
+    def fit(self) -> Result:
+        name = self.run_config.name or f"train_{uuid.uuid4().hex[:8]}"
+        storage = StorageContext(
+            storage_path=self.run_config.resolved_storage_path(),
+            experiment_name=name,
+        )
+        ckpt_manager = CheckpointManager(storage, self.run_config.checkpoint_config)
+        iterator = TrainingIterator(
+            scaling_config=self.scaling_config,
+            backend=self.backend,
+            train_fn=self.train_loop_per_worker,
+            config=self.train_loop_config,
+            storage=storage,
+            checkpoint_manager=ckpt_manager,
+            max_failures=self.run_config.failure_config.max_failures,
+            resume_checkpoint=self.resume_from_checkpoint,
+            dataset_shard_fn=self._dataset_shard_fn(),
+        )
+        error: Optional[BaseException] = None
+        metrics: Dict[str, Any] = {}
+        try:
+            metrics = iterator.run()
+        except TrainingFailedError as e:
+            error = e
+        best = ckpt_manager.best
+        result = Result(
+            metrics=metrics,
+            checkpoint=best.checkpoint if best else None,
+            path=storage.trial_dir,
+            error=error,
+            best_checkpoints=[(t.checkpoint, t.metrics) for t in ckpt_manager.tracked],
+        )
+        if error is not None:
+            raise TrainingFailedError(str(error)) from error
+        return result
+
+    # --------------------------------------------------------------- datasets
+
+    def _dataset_shard_fn(self) -> Optional[Callable]:
+        if not self.datasets:
+            return None
+        datasets = self.datasets
+        materialized: Dict[str, Any] = {}
+
+        def shard(rank: int, world_size: int) -> Dict[str, Any]:
+            out = {}
+            for k, ds in datasets.items():
+                if hasattr(ds, "split_shard"):
+                    # Execute the pipeline ONCE and shard the resulting block
+                    # refs: per-rank re-execution would hand ranks shards of
+                    # *different* runs (catastrophic with nondeterministic ops
+                    # like random_shuffle). For datasets too large to
+                    # materialize, pass Dataset.streaming_split iterators in
+                    # `datasets` directly.
+                    if k not in materialized:
+                        materialized[k] = ds.materialize()
+                    out[k] = materialized[k].split_shard(rank, world_size)
+                else:
+                    out[k] = ds
+            return out
+
+        return shard
+
+    # ------------------------------------------------------------- tune glue
+
+    def as_trainable(self) -> type:
+        """Wrap into a Tune trainable class (reference:
+        base_trainer._generate_trainable_cls :693)."""
+        from ray_tpu.tune.trainable import wrap_trainer_as_trainable
+
+        return wrap_trainer_as_trainable(self)
+
+
+class JaxTrainer(DataParallelTrainer):
+    """DataParallelTrainer whose backend forms a jax Mesh on every worker.
+
+    The training loop retrieves it via `ray_tpu.train.get_mesh()` and runs a
+    jitted sharded step — per-step collectives are XLA's, not the control
+    plane's (reference analog: TorchTrainer + _TorchBackend, SURVEY.md §3.4).
+    """
+
+    def __init__(self, *args, jax_distributed: bool = False,
+                 mesh_shape: Optional[Dict[str, int]] = None, **kwargs):
+        kwargs.setdefault("backend", JaxBackend(distributed=jax_distributed,
+                                                mesh_shape=mesh_shape))
+        super().__init__(*args, **kwargs)

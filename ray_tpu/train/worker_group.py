@@ -1,0 +1,190 @@
+"""Actor group forming the training world.
+
+Parity: reference train/_internal/worker_group.py (WorkerGroup :102,
+execute_async :233) — N actors, optionally gang-scheduled in a placement
+group, sorted by node so ranks are stable host-major (the reference sorts by
+node IP for the same reason, backend_executor.py:356).
+"""
+from __future__ import annotations
+
+import queue
+import threading
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional
+
+import ray_tpu as rt
+from ray_tpu.util.scheduling_strategies import PlacementGroupSchedulingStrategy
+
+from .session import TrainContext, _get_session, _init_session, _shutdown_session
+
+
+class RayTrainWorker:
+    """The per-worker actor hosting the user's train loop.
+
+    reference: train/_internal/worker_group.py RayTrainWorker — a shell that
+    executes arbitrary functions; the training thread + session queue mirror
+    backend_executor.start_training/session.py.
+    """
+
+    def __init__(self) -> None:
+        self._thread: Optional[threading.Thread] = None
+        self._error: Optional[BaseException] = None
+        self._done = threading.Event()
+
+    # Generic remote execution (backend hooks, probes).
+    def execute(self, fn: Callable, *args, **kwargs) -> Any:
+        return fn(*args, **kwargs)
+
+    def node_id(self) -> str:
+        return rt.get_runtime_context().node_id
+
+    def join_collective(self, world_size: int, rank: int, backend: str, group_name: str) -> None:
+        from ray_tpu.util import collective
+
+        collective.init_collective_group(world_size, rank, backend, group_name)
+
+    # ------------------------------------------------------------- train loop
+
+    def init_session(self, context: TrainContext, checkpoint=None, dataset_shards=None) -> None:
+        _init_session(context, checkpoint, dataset_shards)
+
+    def setup_session_extras(self, mesh_fn: Optional[Callable] = None,
+                             collective_group: Optional[str] = None) -> None:
+        s = _get_session()
+        if mesh_fn is not None:
+            s.mesh = mesh_fn()
+        s.collective_group = collective_group
+
+    def start_training(self, train_fn: Callable, config: Optional[Dict[str, Any]]) -> None:
+        s = _get_session()
+
+        def run() -> None:
+            try:
+                if config is not None:
+                    train_fn(config)
+                else:
+                    train_fn()
+                s.results.put({"type": "done", "rank": s.context.world_rank})
+            except StopIteration:
+                s.results.put({"type": "done", "rank": s.context.world_rank})
+            except BaseException as e:  # noqa: BLE001 — surfaced to the driver
+                import traceback
+
+                self._error = e
+                s.results.put({
+                    "type": "error",
+                    "rank": s.context.world_rank,
+                    "error": e,
+                    "traceback": traceback.format_exc(),
+                })
+            finally:
+                self._done.set()
+
+        self._thread = threading.Thread(target=run, name="train-loop", daemon=True)
+        self._thread.start()
+
+    def next_result(self, timeout: float = 10.0) -> Optional[Dict[str, Any]]:
+        """Drain one queued result; None when nothing arrived in `timeout`."""
+        s = _get_session(strict=False)
+        if s is None:
+            return None
+        try:
+            item = s.results.get(timeout=timeout)
+        except queue.Empty:
+            return None
+        if item.get("type") == "report" and item.get("checkpoint") is not None:
+            # The driver persists; ship the local path (shared-fs contract,
+            # reference persists from the worker via StorageContext instead).
+            item["checkpoint_path"] = item["checkpoint"].path
+        return item
+
+    def request_stop(self) -> None:
+        s = _get_session(strict=False)
+        if s is not None:
+            s.stop_requested = True
+
+    def finish(self) -> None:
+        if self._thread is not None:
+            self._thread.join(timeout=30)
+        _shutdown_session()
+
+
+@dataclass
+class WorkerMetadata:
+    actor: Any
+    node_id: str
+    world_rank: int = -1
+    local_rank: int = -1
+    node_rank: int = -1
+
+
+class WorkerGroup:
+    """Spawn and address a gang of RayTrainWorker actors."""
+
+    def __init__(
+        self,
+        num_workers: int,
+        resources_per_worker: Optional[Dict[str, float]] = None,
+        placement_group=None,
+        actor_cls: type = RayTrainWorker,
+    ):
+        self.num_workers = num_workers
+        res = dict(resources_per_worker or {"CPU": 1})
+        cls = rt.remote(actor_cls)
+        self.workers: List[WorkerMetadata] = []
+        handles = []
+        for i in range(num_workers):
+            opts: Dict[str, Any] = {
+                "num_cpus": res.get("CPU", 0),
+                "max_concurrency": 8,
+            }
+            if res.get("TPU"):
+                opts["num_tpus"] = res["TPU"]
+            extra = {k: v for k, v in res.items() if k not in ("CPU", "TPU")}
+            if extra:
+                opts["resources"] = extra
+            if placement_group is not None:
+                opts["scheduling_strategy"] = PlacementGroupSchedulingStrategy(
+                    placement_group=placement_group, placement_group_bundle_index=i
+                )
+            handles.append(cls.options(**opts).remote())
+        node_ids = rt.get([h.node_id.remote() for h in handles])
+        metas = [WorkerMetadata(actor=h, node_id=n) for h, n in zip(handles, node_ids)]
+        # Host-major stable ordering: group by node, assign ranks
+        # (reference: _create_rank_world_size_mappings backend_executor.py:356).
+        metas.sort(key=lambda m: m.node_id)
+        node_order: List[str] = []
+        local_counts: Dict[str, int] = {}
+        for rank, m in enumerate(metas):
+            if m.node_id not in node_order:
+                node_order.append(m.node_id)
+            m.world_rank = rank
+            m.node_rank = node_order.index(m.node_id)
+            m.local_rank = local_counts.get(m.node_id, 0)
+            local_counts[m.node_id] = m.local_rank + 1
+        self.workers = metas
+
+    def execute_async(self, fn: Callable, *args, **kwargs) -> List[Any]:
+        return [m.actor.execute.remote(fn, *args, **kwargs) for m in self.workers]
+
+    def execute(self, fn: Callable, *args, **kwargs) -> List[Any]:
+        return rt.get(self.execute_async(fn, *args, **kwargs))
+
+    def execute_single(self, rank: int, fn: Callable, *args, **kwargs) -> Any:
+        return rt.get(self.workers[rank].actor.execute.remote(fn, *args, **kwargs))
+
+    def foreach(self, method: str, *args, **kwargs) -> List[Any]:
+        return rt.get([
+            getattr(m.actor, method).remote(*args, **kwargs) for m in self.workers
+        ])
+
+    def shutdown(self) -> None:
+        for m in self.workers:
+            try:
+                rt.kill(m.actor)
+            except Exception:
+                pass
+        self.workers = []
+
+    def __len__(self) -> int:
+        return len(self.workers)

@@ -1,0 +1,305 @@
+"""Host-level collectives over the task/actor plane.
+
+API parity with the reference's ``ray.util.collective``
+(python/ray/util/collective/collective.py — init_collective_group:120,
+allreduce:258, reduce/broadcast/allgather/reducescatter/send/recv:311-655,
+GroupManager:40). The reference backs these with NCCL-via-cupy / pygloo and a
+named-actor ``Rendezvous`` (collective_group/nccl_collective_group.py:29,128).
+
+TPU-native position (SURVEY.md §5.8): *device* collectives belong to XLA —
+all-reduce/all-gather/reduce-scatter over ICI are emitted by the compiler from
+shardings (ray_tpu.parallel). This module is the **host plane**: control-sized
+numpy payloads between worker processes — gradient smoke tests on CPU,
+cross-slice rendezvous, barriers, weight broadcast outside a mesh. It is
+deliberately implemented over the actor plane (a rendezvous actor per group),
+mirroring the reference's named-actor rendezvous, so it works anywhere the
+control plane reaches (multi-host over DCN included) with zero extra wiring.
+"""
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+_GROUP_ACTOR_PREFIX = "rtpu_collective::"
+
+REDUCE_OPS = {
+    "sum": lambda xs: _tree_reduce(np.add, xs),
+    "prod": lambda xs: _tree_reduce(np.multiply, xs),
+    "min": lambda xs: _tree_reduce(np.minimum, xs),
+    "max": lambda xs: _tree_reduce(np.maximum, xs),
+}
+
+
+def _tree_reduce(op, xs: List[Any]) -> Any:
+    acc = xs[0]
+    for x in xs[1:]:
+        acc = op(acc, x)
+    return acc
+
+
+class _RendezvousActor:
+    """Synchronizes one collective group; one instance per group name.
+
+    Every member calls ``collect(rank, seq, kind, payload)``; the call blocks
+    until all ``world_size`` members of that (seq, kind) round have arrived,
+    then each caller receives its slice of the result. P2P send/recv match on
+    explicit (src, dst, tag) keys instead of full-group rounds.
+    """
+
+    def __init__(self, world_size: int):
+        self.world_size = world_size
+        self.cv = threading.Condition()
+        self.rounds: Dict[Tuple[int, str], Dict[int, Any]] = {}
+        self.results: Dict[Tuple[int, str], Any] = {}
+        self.done_count: Dict[Tuple[int, str], int] = {}
+        self.p2p: Dict[Tuple[int, int, int], Any] = {}
+
+    def collect(self, rank: int, seq: int, kind: str, payload: Any, opt: Optional[str] = None):
+        key = (seq, kind if opt is None else f"{kind}:{opt}")
+        with self.cv:
+            slot = self.rounds.setdefault(key, {})
+            if rank in slot:
+                raise RuntimeError(
+                    f"rank {rank} contributed twice to round {key}; collective "
+                    "calls must be issued in the same order on every rank"
+                )
+            slot[rank] = payload
+            if len(slot) == self.world_size:
+                self.results[key] = self._combine(kind, opt, slot)
+                self.done_count[key] = 0
+                self.cv.notify_all()
+            else:
+                self.cv.wait_for(lambda: key in self.results, timeout=300)
+                if key not in self.results:
+                    # Withdraw our contribution so a failed round doesn't pin
+                    # payloads in this long-lived actor forever.
+                    slot = self.rounds.get(key)
+                    if slot is not None:
+                        slot.pop(rank, None)
+                        if not slot:
+                            self.rounds.pop(key, None)
+                    raise TimeoutError(
+                        f"collective round {key} timed out waiting for "
+                        f"{self.world_size - len(self.rounds.get(key, {}))} member(s)"
+                    )
+            out = self._slice_result(kind, key, rank)
+            self.done_count[key] += 1
+            if self.done_count[key] == self.world_size:
+                del self.rounds[key], self.results[key], self.done_count[key]
+            return out
+
+    def _combine(self, kind: str, opt: Optional[str], slot: Dict[int, Any]) -> Any:
+        vals = [slot[r] for r in range(self.world_size)]
+        if kind == "barrier":
+            return True
+        if kind == "allreduce" or kind == "reduce":
+            return REDUCE_OPS[opt or "sum"](vals)
+        if kind == "allgather":
+            return vals
+        if kind == "reducescatter":
+            red = REDUCE_OPS[opt or "sum"](vals)
+            return np.array_split(np.asarray(red), self.world_size, axis=0)
+        if kind == "broadcast":
+            src = next(v for v in vals if v is not None)
+            return src
+        raise ValueError(f"unknown collective kind {kind!r}")
+
+    def _slice_result(self, kind: str, key, rank: int) -> Any:
+        res = self.results[key]
+        if kind == "reducescatter":
+            return res[rank]
+        return res
+
+    def send(self, dst: int, tag: int, payload: Any) -> bool:
+        with self.cv:
+            self.p2p[(dst, tag, 0)] = payload
+            self.cv.notify_all()
+        return True
+
+    def recv(self, dst: int, tag: int) -> Any:
+        key = (dst, tag, 0)
+        with self.cv:
+            ok = self.cv.wait_for(lambda: key in self.p2p, timeout=300)
+            if not ok:
+                raise TimeoutError(f"recv(dst={dst}, tag={tag}) timed out")
+            return self.p2p.pop(key)
+
+
+@dataclass
+class _GroupState:
+    name: str
+    world_size: int
+    rank: int
+    handle: Any
+    seq: int = 0
+    p2p_tags: Dict[Tuple[int, int], int] = field(default_factory=dict)
+
+    def next_seq(self) -> int:
+        self.seq += 1
+        return self.seq
+
+    def next_tag(self, a: int, b: int) -> int:
+        """Monotone tag per ordered (src,dst) pair — keeps repeated send/recv
+        pairs matched in order."""
+        k = (a, b)
+        self.p2p_tags[k] = self.p2p_tags.get(k, 0) + 1
+        # tag space: src*1e6*... collapse into one int
+        return (a * 1_000_003 + b) * 1_000_003 + self.p2p_tags[k]
+
+
+# Process-global group registry (reference: GroupManager singleton,
+# collective.py:40). NOT thread-local: a worker joins on its actor mailbox
+# thread but issues collectives from the train-loop thread.
+_process_groups: Dict[str, _GroupState] = {}
+
+
+def _groups() -> Dict[str, _GroupState]:
+    return _process_groups
+
+
+def _rendezvous_actor(group_name: str, world_size: int):
+    """Get-or-create the named rendezvous actor for a group (reference:
+    Rendezvous via named actor, nccl_collective_group.py:29)."""
+    import ray_tpu as rt
+
+    name = _GROUP_ACTOR_PREFIX + group_name
+    try:
+        return rt.get_actor(name)
+    except Exception:
+        pass
+    try:
+        cls = rt.remote(_RendezvousActor)
+        return cls.options(
+            name=name, max_concurrency=max(16, 4 * world_size), lifetime="detached"
+        ).remote(world_size)
+    except Exception:
+        # Lost the creation race: another member registered the name first.
+        return rt.get_actor(name)
+
+
+def init_collective_group(
+    world_size: int,
+    rank: int,
+    backend: str = "host",
+    group_name: str = "default",
+) -> None:
+    """Join this process into a collective group (reference: collective.py:120)."""
+    if not (0 <= rank < world_size):
+        raise ValueError(f"rank {rank} out of range for world_size {world_size}")
+    if group_name in _groups():
+        raise RuntimeError(f"collective group {group_name!r} already initialized")
+    handle = _rendezvous_actor(group_name, world_size)
+    _groups()[group_name] = _GroupState(group_name, world_size, rank, handle)
+    barrier(group_name)
+
+
+def destroy_collective_group(group_name: str = "default") -> None:
+    st = _groups().pop(group_name, None)
+    if st is not None and st.rank == 0:
+        import ray_tpu as rt
+
+        try:
+            rt.kill(st.handle)
+        except Exception:
+            pass
+
+
+def is_group_initialized(group_name: str = "default") -> bool:
+    return group_name in _groups()
+
+
+def get_rank(group_name: str = "default") -> int:
+    return _state(group_name).rank
+
+
+def get_collective_group_size(group_name: str = "default") -> int:
+    return _state(group_name).world_size
+
+
+def _state(group_name: str) -> _GroupState:
+    st = _groups().get(group_name)
+    if st is None:
+        raise RuntimeError(
+            f"collective group {group_name!r} is not initialized in this "
+            "process; call init_collective_group first"
+        )
+    return st
+
+
+def _round(group_name: str, kind: str, payload: Any, opt: Optional[str] = None) -> Any:
+    import ray_tpu as rt
+
+    st = _state(group_name)
+    seq = st.next_seq()
+    return rt.get(st.handle.collect.remote(st.rank, seq, kind, payload, opt))
+
+
+def allreduce(tensor: np.ndarray, group_name: str = "default", op: str = "sum") -> np.ndarray:
+    """In-place-style allreduce (returns the reduced array; reference
+    collective.py:258 mutates the cupy tensor in place — numpy callers here
+    assign the return)."""
+    return _round(group_name, "allreduce", np.asarray(tensor), op)
+
+
+def allreduce_multigpu(tensor_list, group_name: str = "default", op: str = "sum"):
+    return [allreduce(t, group_name, op) for t in tensor_list]
+
+
+def reduce(tensor: np.ndarray, dst_rank: int = 0, group_name: str = "default", op: str = "sum"):
+    out = _round(group_name, "reduce", np.asarray(tensor), op)
+    return out if get_rank(group_name) == dst_rank else tensor
+
+
+def broadcast(tensor: Optional[np.ndarray], src_rank: int = 0, group_name: str = "default"):
+    st = _state(group_name)
+    payload = np.asarray(tensor) if st.rank == src_rank else None
+    return _round(group_name, "broadcast", payload)
+
+
+def allgather(tensor: np.ndarray, group_name: str = "default") -> List[np.ndarray]:
+    return _round(group_name, "allgather", np.asarray(tensor))
+
+
+def reducescatter(tensor: np.ndarray, group_name: str = "default", op: str = "sum") -> np.ndarray:
+    return _round(group_name, "reducescatter", np.asarray(tensor), op)
+
+
+def barrier(group_name: str = "default") -> None:
+    _round(group_name, "barrier", None)
+
+
+def send(tensor: np.ndarray, dst_rank: int, group_name: str = "default") -> None:
+    import ray_tpu as rt
+
+    st = _state(group_name)
+    tag = st.next_tag(st.rank, dst_rank)
+    rt.get(st.handle.send.remote(dst_rank, tag, np.asarray(tensor)))
+
+
+def recv(src_rank: int, group_name: str = "default") -> np.ndarray:
+    import ray_tpu as rt
+
+    st = _state(group_name)
+    tag = st.next_tag(src_rank, st.rank)
+    return rt.get(st.handle.recv.remote(st.rank, tag))
+
+
+def create_collective_group(
+    actors,
+    world_size: int,
+    ranks: List[int],
+    backend: str = "host",
+    group_name: str = "default",
+) -> None:
+    """Driver-side declaration: make each actor join the group (reference:
+    collective.py declare_collective_group)."""
+    import ray_tpu as rt
+
+    refs = [
+        a.join_collective.remote(world_size, r, backend, group_name)
+        for a, r in zip(actors, ranks)
+    ]
+    rt.get(refs)
