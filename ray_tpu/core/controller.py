@@ -77,6 +77,9 @@ class WorkerInfo:
     current_task: Optional[str] = None
     actor_ids: Set[str] = field(default_factory=set)
     proc: Optional[subprocess.Popen] = None
+    # TPU-capable workers carry the accelerator runtime (axon/PJRT plugin)
+    # and cost seconds to start; plain workers skip it and start in ~0.3s.
+    tpu_capable: bool = False
 
 
 @dataclass
@@ -188,6 +191,19 @@ class Controller:
         if self.server is not None:
             self.server.close()
 
+    async def _shutdown_worker(self, w: WorkerInfo) -> None:
+        """Gracefully stop one worker process (already removed from pools)."""
+        try:
+            await w.conn.send({"kind": "shutdown"})
+        except Exception:
+            pass
+        await asyncio.sleep(0.05)
+        if w.proc is not None and w.proc.poll() is None:
+            try:
+                w.proc.terminate()
+            except Exception:
+                pass
+
     # ------------------------------------------------------- connection layer
 
     async def _on_connection(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter) -> None:
@@ -247,7 +263,8 @@ class Controller:
         if w is not None:
             w.conn = conn  # reconnect
         else:
-            w = WorkerInfo(worker_id=worker_id, node_id=node_id, conn=conn)
+            w = WorkerInfo(worker_id=worker_id, node_id=node_id, conn=conn,
+                           tpu_capable=bool(msg.get("tpu_capable")))
             self.workers[worker_id] = w
         # Exact proc adoption via startup token (reference: worker startup
         # tokens, worker_pool.h:251) — heuristic matching can swap proc handles
@@ -786,20 +803,22 @@ class Controller:
             node = self.nodes[bundle.node_id]
             if not _res_fits(bundle.available, resources):
                 return False
-            w = self._find_idle_worker(node)
+            needs_tpu = resources.get("TPU", 0) > 0
+            w = self._find_idle_worker(node, needs_tpu)
             if w is None:
-                self._maybe_spawn_worker(node)
+                self._maybe_spawn_worker(node, needs_tpu)
                 return False
             _res_sub(bundle.available, resources)
             spec["sched_node"] = node.node_id
             await self._dispatch(spec, node, w)
             return True
+        needs_tpu = resources.get("TPU", 0) > 0
         for node in self._eligible_nodes(spec):
             if not _res_fits(node.available, resources):
                 continue
-            w = self._find_idle_worker(node)
+            w = self._find_idle_worker(node, needs_tpu)
             if w is None:
-                self._maybe_spawn_worker(node)
+                self._maybe_spawn_worker(node, needs_tpu)
                 continue
             _res_sub(node.available, resources)
             spec["sched_node"] = node.node_id
@@ -807,22 +826,59 @@ class Controller:
             return True
         return False
 
-    def _find_idle_worker(self, node: NodeInfo) -> Optional[WorkerInfo]:
+    def _find_idle_worker(
+        self, node: NodeInfo, needs_tpu: bool = False
+    ) -> Optional[WorkerInfo]:
+        # Plain work prefers plain workers so the scarce, seconds-to-start
+        # TPU-capable workers stay free for TPU tasks.
+        fallback: Optional[WorkerInfo] = None
         for wid in node.workers:
             w = self.workers.get(wid)
-            if w is not None and w.state == "idle":
+            if w is None or w.state != "idle":
+                continue
+            if needs_tpu:
+                if w.tpu_capable:
+                    return w
+            elif w.tpu_capable:
+                fallback = fallback or w
+            else:
                 return w
-        return None
+        return fallback
 
-    def _maybe_spawn_worker(self, node: NodeInfo) -> None:
-        if node.spawning >= 4 or len(node.workers) + node.spawning >= MAX_WORKERS_PER_NODE:
+    def _maybe_spawn_worker(self, node: NodeInfo, needs_tpu: bool = False) -> None:
+        if node.spawning >= 4:
             return
+        if len(node.workers) + node.spawning >= MAX_WORKERS_PER_NODE:
+            # At the cap, a TPU task must not starve behind idle plain
+            # workers: reap one to make room (reference: worker_pool.cc idle
+            # worker killing to satisfy the pool cap).
+            if not needs_tpu:
+                return
+            victim = None
+            for wid in list(node.workers):
+                w = self.workers.get(wid)
+                if w is not None and w.state == "idle" and not w.tpu_capable:
+                    victim = w
+                    break
+            if victim is None:
+                return
+            node.workers.discard(victim.worker_id)
+            self.workers.pop(victim.worker_id, None)
+            asyncio.get_running_loop().create_task(self._shutdown_worker(victim))
         node.spawning += 1
         spawn_token = uuid.uuid4().hex
         env = dict(os.environ)
         env["RTPU_CONTROLLER"] = f"{self.host}:{self.port}"
         env["RTPU_NODE_ID"] = node.node_id
         env["RTPU_SPAWN_TOKEN"] = spawn_token
+        if needs_tpu:
+            env["RTPU_TPU_WORKER"] = "1"
+        else:
+            # Plain workers skip the accelerator runtime entirely: the axon
+            # PJRT plugin registration in sitecustomize imports jax (~3s of
+            # interpreter startup). Control-plane workers must spawn in
+            # ~0.3s (reference: prestarted raylet workers, worker_pool.h).
+            env.pop("PALLAS_AXON_POOL_IPS", None)
         pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
         env["PYTHONPATH"] = pkg_root + os.pathsep + env.get("PYTHONPATH", "")
         # Propagate the driver's import path so functions defined in driver-

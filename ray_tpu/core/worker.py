@@ -83,6 +83,7 @@ class WorkerRuntime:
                 "worker_id": self.worker_id,
                 "node_id": node_id,
                 "spawn_token": os.environ.get("RTPU_SPAWN_TOKEN"),
+                "tpu_capable": bool(os.environ.get("RTPU_TPU_WORKER")),
             }
         )
 

@@ -1,0 +1,101 @@
+"""Callbacks + built-in loggers.
+
+Parity: reference tune/callback.py (Callback hooks) and tune/logger/
+(CSVLoggerCallback, JsonLoggerCallback) — per-trial progress.csv,
+result.json (jsonl) and params.json files in the trial dir, the layout
+analysis tools expect.
+"""
+from __future__ import annotations
+
+import csv
+import json
+import os
+from typing import Any, Dict, Optional, TextIO
+
+
+class Callback:
+    def on_experiment_start(self, controller) -> None:
+        pass
+
+    def on_trial_start(self, trial) -> None:
+        pass
+
+    def on_trial_result(self, trial, result: Dict[str, Any]) -> None:
+        pass
+
+    def on_trial_complete(self, trial) -> None:
+        pass
+
+    def on_trial_error(self, trial) -> None:
+        pass
+
+    def on_experiment_end(self, controller) -> None:
+        pass
+
+
+def _flatten(d: Dict[str, Any], prefix: str = "") -> Dict[str, Any]:
+    out: Dict[str, Any] = {}
+    for k, v in d.items():
+        key = f"{prefix}{k}"
+        if isinstance(v, dict):
+            out.update(_flatten(v, key + "/"))
+        else:
+            out[key] = v
+    return out
+
+
+class JsonLoggerCallback(Callback):
+    """Appends each result as a JSON line to <trial_dir>/result.json and
+    writes params.json once."""
+
+    def __init__(self):
+        self._files: Dict[str, TextIO] = {}
+
+    def _ensure(self, trial) -> Optional[TextIO]:
+        if not trial.local_dir:
+            return None
+        f = self._files.get(trial.trial_id)
+        if f is None:
+            with open(os.path.join(trial.local_dir, "params.json"), "w") as pf:
+                json.dump(trial.config, pf, default=str)
+            f = open(os.path.join(trial.local_dir, "result.json"), "a")
+            self._files[trial.trial_id] = f
+        return f
+
+    def on_trial_result(self, trial, result: Dict[str, Any]) -> None:
+        f = self._ensure(trial)
+        if f:
+            f.write(json.dumps(result, default=str) + "\n")
+            f.flush()
+
+    def on_experiment_end(self, controller) -> None:
+        for f in self._files.values():
+            f.close()
+        self._files.clear()
+
+
+class CSVLoggerCallback(Callback):
+    """Appends flattened results to <trial_dir>/progress.csv."""
+
+    def __init__(self):
+        self._writers: Dict[str, Any] = {}
+
+    def on_trial_result(self, trial, result: Dict[str, Any]) -> None:
+        if not trial.local_dir:
+            return
+        flat = _flatten(result)
+        entry = self._writers.get(trial.trial_id)
+        if entry is None:
+            f = open(os.path.join(trial.local_dir, "progress.csv"), "w", newline="")
+            w = csv.DictWriter(f, fieldnames=list(flat.keys()), extrasaction="ignore")
+            w.writeheader()
+            entry = (f, w)
+            self._writers[trial.trial_id] = entry
+        f, w = entry
+        w.writerow(flat)
+        f.flush()
+
+    def on_experiment_end(self, controller) -> None:
+        for f, _ in self._writers.values():
+            f.close()
+        self._writers.clear()

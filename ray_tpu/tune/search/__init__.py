@@ -1,0 +1,33 @@
+"""Search: spaces, variant generation, searcher interface."""
+from .sample import (
+    Categorical,
+    Domain,
+    Float,
+    Integer,
+    choice,
+    grid_search,
+    loguniform,
+    qrandint,
+    randint,
+    randn,
+    uniform,
+)
+from .searcher import ConcurrencyLimiter, Searcher
+from .basic_variant import BasicVariantGenerator
+
+__all__ = [
+    "BasicVariantGenerator",
+    "Categorical",
+    "ConcurrencyLimiter",
+    "Domain",
+    "Float",
+    "Integer",
+    "Searcher",
+    "choice",
+    "grid_search",
+    "loguniform",
+    "qrandint",
+    "randint",
+    "randn",
+    "uniform",
+]

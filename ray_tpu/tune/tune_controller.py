@@ -1,0 +1,334 @@
+"""TuneController: drives trial actors to completion.
+
+Parity: reference tune/execution/tune_controller.py (step loop: start actors,
+collect training results, apply scheduler decisions, retry failures, persist
+experiment state) over ray_tpu core actors instead of RayActorManager. One
+trial = one actor hosting the Trainable; `train()` calls stream results back
+as futures.
+"""
+from __future__ import annotations
+
+import logging
+import os
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+import ray_tpu
+from ray_tpu.core.api import ActorHandle
+
+from . import schedulers as sched
+from .callbacks import Callback
+from .experiment import (
+    ERROR,
+    PAUSED,
+    PENDING,
+    RUNNING,
+    TERMINATED,
+    Trial,
+    save_experiment_state,
+)
+from .search.searcher import Searcher
+from .trainable import RESULT_DONE
+
+logger = logging.getLogger(__name__)
+
+
+class _TrialRunner:
+    """Hosts one Trainable inside an actor process."""
+
+    def __init__(self, trainable_cls_pickled: bytes, config: Dict[str, Any]):
+        import cloudpickle
+
+        cls = cloudpickle.loads(trainable_cls_pickled)
+        self._trainable = cls(config)
+
+    def train(self) -> Dict[str, Any]:
+        return self._trainable.train()
+
+    def save(self, checkpoint_dir: str) -> str:
+        return self._trainable.save(checkpoint_dir)
+
+    def restore(self, checkpoint_path: str) -> None:
+        self._trainable.restore(checkpoint_path)
+
+    def reset(self, new_config: Dict[str, Any]) -> bool:
+        return self._trainable.reset(new_config)
+
+    def stop(self) -> None:
+        self._trainable.stop()
+
+
+class TuneController:
+    def __init__(
+        self,
+        trainable_cls: type,
+        searcher: Searcher,
+        scheduler: sched.TrialScheduler,
+        experiment_dir: str,
+        *,
+        metric: Optional[str] = None,
+        mode: str = "max",
+        max_concurrent: int = 0,
+        max_failures: int = 0,
+        checkpoint_freq: int = 0,
+        checkpoint_at_end: bool = False,
+        stop: Optional[Dict[str, Any]] = None,
+        callbacks: Optional[List[Callback]] = None,
+        resources_per_trial: Optional[Dict[str, float]] = None,
+        trials: Optional[List[Trial]] = None,
+    ):
+        import cloudpickle
+
+        self.trainable_blob = cloudpickle.dumps(trainable_cls)
+        self.searcher = searcher
+        self.scheduler = scheduler
+        self.metric = metric
+        self.mode = mode
+        self.experiment_dir = experiment_dir
+        self.max_failures = max_failures
+        self.checkpoint_freq = checkpoint_freq
+        self.checkpoint_at_end = checkpoint_at_end
+        self.stop_criteria = stop or {}
+        self.callbacks = callbacks or []
+        self.resources_per_trial = resources_per_trial or {"num_cpus": 1}
+        if max_concurrent <= 0:
+            cpus = ray_tpu.cluster_resources().get("CPU", 1)
+            per = self.resources_per_trial.get("num_cpus", 1) or 1
+            max_concurrent = max(1, int(cpus // per))
+        self.max_concurrent = max_concurrent
+
+        self.trials: List[Trial] = trials or []
+        # Injected (restored) trials must still enter the scheduler's
+        # population or PBT/ASHA silently ignore them.
+        for t in self.trials:
+            self.scheduler.on_trial_add(t)
+        self._actors: Dict[str, ActorHandle] = {}
+        self._inflight: Dict[Any, Trial] = {}  # ObjectRef -> trial
+        self._searcher_done = False
+
+    # ----------------------------------------------------------------- helpers
+
+    def _trial_dir(self, trial: Trial) -> str:
+        d = os.path.join(self.experiment_dir, trial.trial_id)
+        os.makedirs(d, exist_ok=True)
+        return d
+
+    def _make_actor(self, trial: Trial) -> ActorHandle:
+        opts = dict(self.resources_per_trial)
+        actor = ray_tpu.remote(_TrialRunner).options(**opts).remote(
+            self.trainable_blob, trial.config
+        )
+        return actor
+
+    def _start_trial(self, trial: Trial, restore_path: Optional[str] = None) -> None:
+        trial.local_dir = self._trial_dir(trial)
+        actor = self._make_actor(trial)
+        if restore_path:
+            ray_tpu.get(actor.restore.remote(restore_path))
+        self._actors[trial.trial_id] = actor
+        trial.status = RUNNING
+        self._submit_train(trial)
+
+    def _submit_train(self, trial: Trial) -> None:
+        ref = self._actors[trial.trial_id].train.remote()
+        self._inflight[ref] = trial
+
+    def _kill_actor(self, trial: Trial, graceful: bool = True) -> None:
+        actor = self._actors.pop(trial.trial_id, None)
+        if actor is None:
+            return
+        if graceful:
+            try:
+                ray_tpu.get(actor.stop.remote(), timeout=5)
+            except Exception:
+                pass
+        try:
+            ray_tpu.kill(actor)
+        except Exception:
+            pass
+
+    def _checkpoint_trial(self, trial: Trial) -> Optional[str]:
+        actor = self._actors.get(trial.trial_id)
+        if actor is None:
+            return None
+        n = trial.iteration
+        d = os.path.join(trial.local_dir, f"checkpoint_{n:06d}")
+        try:
+            path = ray_tpu.get(actor.save.remote(d))
+            trial.checkpoint_path = path
+            return path
+        except Exception:
+            logger.exception("checkpoint of trial %s failed", trial.trial_id)
+            return None
+
+    def _should_stop(self, result: Dict[str, Any]) -> bool:
+        if result.get(RESULT_DONE):
+            return True
+        for k, v in self.stop_criteria.items():
+            if k in result and result[k] >= v:
+                return True
+        return False
+
+    # ------------------------------------------------------------ trial intake
+
+    def _maybe_request_trials(self) -> None:
+        while not self._searcher_done and len(self.trials) < 10_000:
+            live = sum(1 for t in self.trials if t.status in (PENDING, RUNNING, PAUSED))
+            if live >= self.max_concurrent * 2:
+                return
+            import uuid
+
+            tid = uuid.uuid4().hex[:8]
+            cfg = self.searcher.suggest(tid)
+            if cfg == Searcher.FINISHED:
+                self._searcher_done = True
+                return
+            if cfg is None:
+                return
+            trial = Trial(config=cfg, trial_id=tid)
+            self.trials.append(trial)
+            self.scheduler.on_trial_add(trial)
+            for cb in self.callbacks:
+                cb.on_trial_start(trial)
+
+    # ------------------------------------------------------------- result path
+
+    def _complete(self, trial: Trial, result: Dict[str, Any], status: str) -> None:
+        if status == TERMINATED and (self.checkpoint_at_end or self.checkpoint_freq):
+            self._checkpoint_trial(trial)
+        self._kill_actor(trial)
+        trial.status = status
+        self.scheduler.on_trial_complete(trial, result)
+        self.searcher.on_trial_complete(trial.trial_id, result, error=False)
+        for cb in self.callbacks:
+            cb.on_trial_complete(trial)
+
+    def _handle_result(self, trial: Trial, result: Dict[str, Any]) -> None:
+        trial.record_result(result)
+        for cb in self.callbacks:
+            cb.on_trial_result(trial, result)
+        self.searcher.on_trial_result(trial.trial_id, result)
+
+        if self._should_stop(result):
+            self._complete(trial, result, TERMINATED)
+            return
+
+        decision = self.scheduler.on_trial_result(trial, result)
+        if decision == sched.STOP:
+            self._complete(trial, result, TERMINATED)
+        elif decision == sched.PAUSE:
+            donor: Optional[Trial] = getattr(trial, "_pbt_donor", None)
+            new_config: Optional[Dict] = getattr(trial, "_pbt_new_config", None)
+            if donor is not None and new_config is not None:
+                self._exploit(trial, donor, new_config)
+            else:
+                self._checkpoint_trial(trial)
+                self._kill_actor(trial)
+                trial.status = PAUSED
+        else:
+            if self.checkpoint_freq and trial.iteration % self.checkpoint_freq == 0:
+                self._checkpoint_trial(trial)
+            self._submit_train(trial)
+
+    def _exploit(self, trial: Trial, donor: Trial, new_config: Dict[str, Any]) -> None:
+        """PBT exploit+explore: replace trial's state with donor's checkpoint
+        and a perturbed config (reference pbt.py _exploit)."""
+        trial._pbt_donor = None  # type: ignore[attr-defined]
+        trial._pbt_new_config = None  # type: ignore[attr-defined]
+        donor_ckpt = self._checkpoint_trial(donor) or donor.checkpoint_path
+        if donor_ckpt is None:
+            self._submit_train(trial)
+            return
+        # Drop any in-flight ref for this trial's old actor.
+        self._inflight = {r: t for r, t in self._inflight.items() if t is not trial}
+        self._kill_actor(trial, graceful=False)
+        trial.config = new_config
+        self._start_trial(trial, restore_path=donor_ckpt)
+
+    def _handle_error(self, trial: Trial, err: BaseException) -> None:
+        trial.num_failures += 1
+        for cb in self.callbacks:
+            cb.on_trial_error(trial)
+        if self.max_failures < 0 or trial.num_failures <= self.max_failures:
+            logger.warning(
+                "trial %s failed (%s), retry %d/%d",
+                trial.trial_id, err, trial.num_failures, self.max_failures,
+            )
+            self._kill_actor(trial, graceful=False)
+            self._start_trial(trial, restore_path=trial.checkpoint_path)
+            return
+        self._kill_actor(trial, graceful=False)
+        trial.status = ERROR
+        trial.error_msg = str(err)
+        self.scheduler.on_trial_error(trial)
+        self.searcher.on_trial_complete(trial.trial_id, None, error=True)
+        for cb in self.callbacks:
+            cb.on_trial_complete(trial)
+
+    # -------------------------------------------------------------- main loop
+
+    def step(self) -> bool:
+        """One controller iteration; returns False when the experiment is done."""
+        self._maybe_request_trials()
+
+        running = [t for t in self.trials if t.status == RUNNING]
+        pending = [t for t in self.trials if t.status == PENDING]
+        paused = [t for t in self.trials if t.status == PAUSED]
+        while pending and len(running) < self.max_concurrent:
+            trial = self.scheduler.choose_trial_to_run(pending)
+            if trial is None:
+                break
+            pending.remove(trial)
+            # Restored trials resume from their last checkpoint rather than
+            # retraining from scratch (reference: trial restore on resume).
+            self._start_trial(trial, restore_path=trial.checkpoint_path)
+            running.append(trial)
+        # Resume paused trials when capacity allows.
+        while paused and len(running) < self.max_concurrent:
+            trial = paused.pop(0)
+            self._start_trial(trial, restore_path=trial.checkpoint_path)
+            running.append(trial)
+
+        if not self._inflight:
+            live = [t for t in self.trials if t.status in (PENDING, RUNNING, PAUSED)]
+            return bool(live) or not self._searcher_done
+
+        refs = list(self._inflight.keys())
+        ready, _ = ray_tpu.wait(refs, num_returns=1, timeout=10.0)
+        for ref in ready:
+            trial = self._inflight.pop(ref)
+            if trial.status != RUNNING:
+                continue  # stale ref from a replaced actor
+            try:
+                result = ray_tpu.get(ref)
+            except Exception as e:
+                self._handle_error(trial, e)
+                continue
+            self._handle_result(trial, result)
+        return True
+
+    def run(self) -> List[Trial]:
+        for cb in self.callbacks:
+            cb.on_experiment_start(self)
+        last_save = 0.0
+        try:
+            while self.step():
+                if time.time() - last_save > 5:
+                    save_experiment_state(
+                        self.experiment_dir, self.trials, self.searcher.get_state(),
+                        meta={"metric": self.metric, "mode": self.mode},
+                    )
+                    last_save = time.time()
+        finally:
+            for t in self.trials:
+                if t.status == RUNNING:
+                    self._kill_actor(t, graceful=False)
+                    t.status = ERROR
+                    t.error_msg = "experiment interrupted"
+            save_experiment_state(
+                self.experiment_dir, self.trials, self.searcher.get_state(),
+                meta={"metric": self.metric, "mode": self.mode},
+            )
+            for cb in self.callbacks:
+                cb.on_experiment_end(self)
+        return self.trials
