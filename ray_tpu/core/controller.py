@@ -65,6 +65,7 @@ class NodeInfo:
     labels: Dict[str, str] = field(default_factory=dict)
     alive: bool = True
     spawning: int = 0
+    spawning_tpu: int = 0
     workers: Set[str] = field(default_factory=set)
 
 
@@ -137,6 +138,7 @@ class Controller:
         self.driver_conns: Set[protocol.Connection] = set()
         self._node_counter = 0
         self._spawned_procs: Dict[str, subprocess.Popen] = {}  # spawn_token -> proc
+        self._tpu_spawn_tokens: Set[str] = set()  # tokens of TPU-capable spawns
         self._sched_wakeup = asyncio.Event()
         self._sched_task: Optional[asyncio.Task] = None
         self._closing = False
@@ -270,14 +272,19 @@ class Controller:
         # tokens, worker_pool.h:251) — heuristic matching can swap proc handles
         # between workers, making kill() terminate the wrong process.
         token = msg.get("spawn_token")
+        was_tpu_spawn = False
         if token:
             proc = self._spawned_procs.pop(token, None)
             if proc is not None:
                 w.proc = proc
+            was_tpu_spawn = token in self._tpu_spawn_tokens
+            self._tpu_spawn_tokens.discard(token)
         node = self.nodes.get(node_id)
         if node:
             node.workers.add(worker_id)
             node.spawning = max(0, node.spawning - 1)
+            if was_tpu_spawn:
+                node.spawning_tpu = max(0, node.spawning_tpu - 1)
         self._wake_scheduler()
         return {"ok": True}
 
@@ -848,6 +855,12 @@ class Controller:
     def _maybe_spawn_worker(self, node: NodeInfo, needs_tpu: bool = False) -> None:
         if node.spawning >= 4:
             return
+        # One in-flight TPU-capable spawn satisfies any number of queued TPU
+        # tasks' wakeups during its multi-second startup; without this guard
+        # every scheduler pass reaps another idle plain worker and launches a
+        # surplus TPU worker.
+        if needs_tpu and node.spawning_tpu > 0:
+            return
         if len(node.workers) + node.spawning >= MAX_WORKERS_PER_NODE:
             # At the cap, a TPU task must not starve behind idle plain
             # workers: reap one to make room (reference: worker_pool.cc idle
@@ -866,6 +879,8 @@ class Controller:
             self.workers.pop(victim.worker_id, None)
             asyncio.get_running_loop().create_task(self._shutdown_worker(victim))
         node.spawning += 1
+        if needs_tpu:
+            node.spawning_tpu += 1
         spawn_token = uuid.uuid4().hex
         env = dict(os.environ)
         env["RTPU_CONTROLLER"] = f"{self.host}:{self.port}"
@@ -873,6 +888,7 @@ class Controller:
         env["RTPU_SPAWN_TOKEN"] = spawn_token
         if needs_tpu:
             env["RTPU_TPU_WORKER"] = "1"
+            self._tpu_spawn_tokens.add(spawn_token)
         else:
             # Plain workers skip the accelerator runtime entirely: the axon
             # PJRT plugin registration in sitecustomize imports jax (~3s of
@@ -909,6 +925,9 @@ class Controller:
                 node = self.nodes.get(node_id)
                 if node:
                     node.spawning = max(0, node.spawning - 1)
+                    if spawn_token in self._tpu_spawn_tokens:
+                        node.spawning_tpu = max(0, node.spawning_tpu - 1)
+                self._tpu_spawn_tokens.discard(spawn_token)
                 self._wake_scheduler()
                 return
 

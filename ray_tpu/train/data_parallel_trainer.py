@@ -85,6 +85,7 @@ class DataParallelTrainer:
             max_failures=self.run_config.failure_config.max_failures,
             resume_checkpoint=self.resume_from_checkpoint,
             dataset_shard_fn=self._dataset_shard_fn(),
+            on_report=getattr(self, "_tune_report_hook", None),
         )
         error: Optional[BaseException] = None
         metrics: Dict[str, Any] = {}

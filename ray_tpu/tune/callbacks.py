@@ -75,27 +75,60 @@ class JsonLoggerCallback(Callback):
 
 
 class CSVLoggerCallback(Callback):
-    """Appends flattened results to <trial_dir>/progress.csv."""
+    """Appends flattened results to <trial_dir>/progress.csv.
+
+    Buffers rows in memory and rewrites the file whenever a new metric key
+    first appears, so late-appearing columns aren't dropped; appends to an
+    existing file (experiment restore) only when its header still matches.
+    """
 
     def __init__(self):
-        self._writers: Dict[str, Any] = {}
+        # trial_id -> {"path", "fields": [..], "rows": [...], "file": f|None}
+        self._state: Dict[str, Dict[str, Any]] = {}
+
+    def _rewrite(self, st: Dict[str, Any]) -> None:
+        if st["file"] is not None:
+            st["file"].close()
+        f = open(st["path"], "w", newline="")
+        w = csv.DictWriter(f, fieldnames=st["fields"], restval="")
+        w.writeheader()
+        for row in st["rows"]:
+            w.writerow(row)
+        st["file"] = f
 
     def on_trial_result(self, trial, result: Dict[str, Any]) -> None:
         if not trial.local_dir:
             return
         flat = _flatten(result)
-        entry = self._writers.get(trial.trial_id)
-        if entry is None:
-            f = open(os.path.join(trial.local_dir, "progress.csv"), "w", newline="")
-            w = csv.DictWriter(f, fieldnames=list(flat.keys()), extrasaction="ignore")
-            w.writeheader()
-            entry = (f, w)
-            self._writers[trial.trial_id] = entry
-        f, w = entry
-        w.writerow(flat)
-        f.flush()
+        st = self._state.get(trial.trial_id)
+        if st is None:
+            path = os.path.join(trial.local_dir, "progress.csv")
+            st = {"path": path, "fields": list(flat.keys()), "rows": [],
+                  "file": None}
+            if os.path.exists(path):
+                # Resumed trial: keep prior rows so restore doesn't truncate
+                # history (result.json appends; the two must stay in sync).
+                try:
+                    with open(path, newline="") as old:
+                        reader = csv.DictReader(old)
+                        if reader.fieldnames:
+                            st["fields"] = list(reader.fieldnames)
+                            st["rows"] = list(reader)
+                except Exception:
+                    pass
+            self._state[trial.trial_id] = st
+        new_keys = [k for k in flat if k not in st["fields"]]
+        st["rows"].append(flat)
+        if new_keys or st["file"] is None:
+            st["fields"].extend(new_keys)
+            self._rewrite(st)
+        else:
+            csv.DictWriter(st["file"], fieldnames=st["fields"],
+                           restval="", extrasaction="ignore").writerow(flat)
+        st["file"].flush()
 
     def on_experiment_end(self, controller) -> None:
-        for f, _ in self._writers.values():
-            f.close()
-        self._writers.clear()
+        for st in self._state.values():
+            if st["file"] is not None:
+                st["file"].close()
+        self._state.clear()

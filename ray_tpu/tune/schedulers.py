@@ -88,7 +88,6 @@ class AsyncHyperBandScheduler(TrialScheduler):
         pending_rungs = self._next_rung.setdefault(
             trial.trial_id, sorted(self.rungs.keys())
         )
-        decision = CONTINUE
         while pending_rungs and t >= pending_rungs[0]:
             rung = pending_rungs.pop(0)
             recorded = self.rungs[rung]
@@ -97,8 +96,11 @@ class AsyncHyperBandScheduler(TrialScheduler):
             k = max(1, int(len(recorded) / self.rf))
             cutoff = sorted(recorded, reverse=True)[k - 1]
             if score < cutoff:
-                decision = STOP
-        return decision
+                # Cut at the first failed rung; don't pollute later rungs'
+                # populations with a score the trial never legitimately
+                # reached (it would drag their cutoffs down).
+                return STOP
+        return CONTINUE
 
 
 class MedianStoppingRule(TrialScheduler):

@@ -184,7 +184,9 @@ def wrap_function(fn: Callable) -> type:
 def wrap_trainer_as_trainable(trainer) -> type:
     """Train->Tune glue (reference base_trainer._generate_trainable_cls:693):
     a trial runs `trainer.fit()` with the trial's config merged into
-    train_loop_config, reporting each intermediate result."""
+    train_loop_config. Each rank-0 `train.report` inside the fit streams to
+    the Tune controller as an intermediate result (so ASHA/PBT can act
+    mid-trial), and the final result carries the best checkpoint."""
     import copy
 
     def _trainable_fn(config: Dict[str, Any]) -> None:
@@ -192,6 +194,8 @@ def wrap_trainer_as_trainable(trainer) -> type:
         merged = dict(t.train_loop_config or {})
         merged.update(config.get("train_loop_config", config))
         t.train_loop_config = merged
+        t._tune_report_hook = lambda item: report(
+            {**item["metrics"], "training_iteration": item["iteration"]})
         result = t.fit()
         report(dict(result.metrics), checkpoint=result.checkpoint)
 

@@ -124,7 +124,22 @@ class TuneController:
         trial.local_dir = self._trial_dir(trial)
         actor = self._make_actor(trial)
         if restore_path:
-            ray_tpu.get(actor.restore.remote(restore_path))
+            try:
+                ray_tpu.get(actor.restore.remote(restore_path))
+            except Exception as e:
+                # A broken/unreachable checkpoint is a *trial* failure, not an
+                # experiment abort: count it against max_failures like any
+                # other trial error (reference: trial-level FailureConfig).
+                try:
+                    ray_tpu.kill(actor)
+                except Exception:
+                    pass
+                logger.exception(
+                    "restore of trial %s from %s failed", trial.trial_id,
+                    restore_path)
+                trial.status = RUNNING  # so _handle_error's retry accounting runs
+                self._handle_error(trial, e)
+                return
         self._actors[trial.trial_id] = actor
         trial.status = RUNNING
         self._submit_train(trial)

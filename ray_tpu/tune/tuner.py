@@ -188,8 +188,20 @@ class Tuner:
 
         resources = getattr(self.trainable, "_tune_resources", None) or {"num_cpus": 1}
 
+        trainable_cls = resolve_trainable(self.trainable)
+        # Reference semantics (tune/impl/tuner_internal.py): unset
+        # checkpoint_at_end defaults to True for the class API (which
+        # implements save_checkpoint) and False for function trainables
+        # (they report checkpoints in-band; forcing a save would produce
+        # phantom empty checkpoint dirs).
+        ckpt_at_end = self.run_config.checkpoint_config.checkpoint_at_end
+        if ckpt_at_end is None:
+            from .trainable import FunctionTrainable
+
+            ckpt_at_end = not issubclass(trainable_cls, FunctionTrainable)
+
         controller = TuneController(
-            resolve_trainable(self.trainable),
+            trainable_cls,
             searcher,
             scheduler,
             exp_dir,
@@ -198,9 +210,7 @@ class Tuner:
             max_concurrent=tc.max_concurrent_trials,
             max_failures=self.run_config.failure_config.max_failures,
             checkpoint_freq=getattr(self.run_config.checkpoint_config, "checkpoint_frequency", 0),
-            checkpoint_at_end=(
-                self.run_config.checkpoint_config.checkpoint_at_end is not False
-            ),
+            checkpoint_at_end=bool(ckpt_at_end),
             stop=self.run_config.stop,
             callbacks=callbacks,
             resources_per_trial=resources,
@@ -242,9 +252,18 @@ def run(
     scheduler: Optional[TrialScheduler] = None,
     search_alg: Optional[Searcher] = None,
     stop: Optional[Dict[str, Any]] = None,
-    **kwargs,
+    max_failures: int = 0,
+    checkpoint_freq: int = 0,
+    checkpoint_at_end: bool = False,
+    name: Optional[str] = None,
+    storage_path: Optional[str] = None,
+    callbacks: Optional[list] = None,
+    max_concurrent_trials: Optional[int] = None,
 ) -> ResultGrid:
     """Legacy `tune.run` facade over Tuner (reference tune/tune.py run())."""
+    from ray_tpu.train.config import (CheckpointConfig, FailureConfig,
+                                      RunConfig)
+
     tuner = Tuner(
         trainable,
         param_space=config,
@@ -254,6 +273,18 @@ def run(
             num_samples=num_samples,
             scheduler=scheduler,
             search_alg=search_alg,
+            max_concurrent_trials=max_concurrent_trials,
+        ),
+        run_config=RunConfig(
+            name=name,
+            storage_path=storage_path,
+            stop=stop,
+            callbacks=callbacks,
+            failure_config=FailureConfig(max_failures=max_failures),
+            checkpoint_config=CheckpointConfig(
+                checkpoint_frequency=checkpoint_freq,
+                checkpoint_at_end=checkpoint_at_end,
+            ),
         ),
     )
     return tuner.fit()
