@@ -246,14 +246,26 @@ def forward(params: Params, tokens: jax.Array, cfg: TransformerConfig) -> jax.Ar
 
 
 def loss_fn(params: Params, batch: Dict[str, jax.Array], cfg: TransformerConfig) -> jax.Array:
-    """Next-token cross-entropy. batch: tokens [B,S]; loss over tokens[1:]."""
+    """Next-token cross-entropy. batch: tokens [B,S]; loss over tokens[1:].
+
+    The forward runs on the FULL sequence (the final position's logits are
+    masked out of the loss) so the activation sequence length stays divisible
+    by the `seq` mesh axis under context parallelism — slicing to S-1 would
+    break ring-attention sharding for power-of-two S.
+    """
     tokens = batch["tokens"]
-    logits = forward(params, tokens[:, :-1], cfg)
-    targets = tokens[:, 1:]
+    B, S = tokens.shape
+    logits = forward(params, tokens, cfg)  # [B, S, V]
+    targets = jnp.concatenate(
+        [tokens[:, 1:], jnp.zeros((B, 1), tokens.dtype)], axis=1)
     logp = jax.nn.log_softmax(logits, axis=-1)
     ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    valid = jnp.concatenate(
+        [jnp.ones((B, S - 1), jnp.float32), jnp.zeros((B, 1), jnp.float32)],
+        axis=1)
     mask = batch.get("mask")
     if mask is not None:
-        m = mask[:, 1:].astype(jnp.float32)
-        return -(ll * m).sum() / jnp.maximum(m.sum(), 1.0)
-    return -ll.mean()
+        shifted = jnp.concatenate(
+            [mask[:, 1:], jnp.zeros((B, 1), mask.dtype)], axis=1)
+        valid = valid * shifted.astype(jnp.float32)
+    return -(ll * valid).sum() / jnp.maximum(valid.sum(), 1.0)

@@ -52,6 +52,34 @@ def reference_attention(
     return out.reshape(B, S, H, D).astype(q.dtype)
 
 
+def _seq_parallel_attention(q, k, v, mesh, rules, causal, scale):
+    """Embed ring attention in the jitted program via shard_map when the
+    mesh has a nontrivial `seq` axis: pjit keeps global array semantics
+    outside; inside, each device runs the ring over its sequence shard."""
+    from jax import shard_map
+
+    from ray_tpu.parallel.sharding import logical_to_mesh_spec
+    from .ring_attention import ring_attention
+
+    q_spec = logical_to_mesh_spec(("batch", "seq_act", "heads", None), rules, mesh)
+    kv_spec = logical_to_mesh_spec(("batch", "seq_act", "kv_heads", None), rules, mesh)
+    if q_spec[1] != "seq":
+        # Rules don't route the activation sequence dim onto the seq axis
+        # (e.g. RULES_DP on a mesh that happens to have seq>1): a ring over
+        # replicated full-sequence "chunks" would silently double-count
+        # keys. Fall back to dense attention.
+        return None
+    fn = shard_map(
+        lambda q, k, v: ring_attention(q, k, v, "seq", causal=causal,
+                                       scale=scale),
+        mesh=mesh,
+        in_specs=(q_spec, kv_spec, kv_spec),
+        out_specs=q_spec,
+        check_vma=False,
+    )
+    return fn(q, k, v)
+
+
 def attention(
     q: jax.Array,
     k: jax.Array,
@@ -62,6 +90,15 @@ def attention(
     use_flash: Optional[bool] = None,
 ) -> jax.Array:
     """Dispatching attention entry point used by all models."""
+    from ray_tpu.parallel.sharding import current_sharding_ctx
+
+    ctx = current_sharding_ctx()
+    if ctx is not None:
+        mesh, rules = ctx
+        if "seq" in mesh.axis_names and mesh.shape["seq"] > 1:
+            out = _seq_parallel_attention(q, k, v, mesh, rules, causal, scale)
+            if out is not None:
+                return out
     if use_flash is None:
         use_flash = _on_tpu()
     if use_flash:

@@ -258,6 +258,22 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 def _flash_bwd(res, g, scale, causal, block_q, block_k):
     q, k, v, o, lse = res
+    do = g.astype(q.dtype)
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                    axis=-1, keepdims=True)  # [B, H, S, 1]
+    return flash_bwd_core(q, k, v, do, lse, delta, scale=scale,
+                          causal=causal, block_q=block_q, block_k=block_k)
+
+
+def flash_bwd_core(q, k, v, do, lse, delta, *, scale, causal,
+                   block_q=DEFAULT_BLOCK, block_k=DEFAULT_BLOCK):
+    """Backward kernels given externally supplied row stats.
+
+    lse/delta are [B,H,S,1] and may come from a *global* softmax (ring
+    attention merges chunk statistics before calling this per chunk) — p is
+    recomputed as exp(s - lse), so partial-chunk gradients compose by
+    simple accumulation.
+    """
     B, H, S, D = q.shape
     KVH = k.shape[1]
     group = H // KVH
@@ -265,10 +281,6 @@ def _flash_bwd(res, g, scale, causal, block_q, block_k):
     bk = min(block_k, S)
     nq = pl.cdiv(S, bq)
     nk = pl.cdiv(S, bk)
-
-    do = g.astype(q.dtype)
-    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
-                    axis=-1, keepdims=True)  # [B, H, S, 1]
 
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, scale=scale, causal=causal,
