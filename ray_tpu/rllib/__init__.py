@@ -1,0 +1,38 @@
+"""RL library: Algorithm/Config over env-runner actors + jax learners.
+
+Parity map (reference rllib/, SURVEY.md §2.7):
+- Algorithm(Trainable) + fluent AlgorithmConfig  -> algorithm.py, algorithm_config.py
+- RLModule + catalog                             -> core/rl_module.py, core/catalog.py
+- Learner/LearnerGroup (torch DDP -> jax mesh)   -> core/learner.py, core/learner_group.py
+- SingleAgentEnvRunner/EnvRunnerGroup            -> env/
+- FaultTolerantActorManager                      -> utils/actor_manager.py
+- GAE / v-trace                                  -> utils/gae.py
+- PPO / IMPALA                                   -> algorithms/
+"""
+from .algorithm import Algorithm
+from .algorithm_config import AlgorithmConfig
+from .algorithms import IMPALA, IMPALAConfig, PPO, PPOConfig
+from .core import JaxLearner, LearnerGroup, MLPModule, RLModule
+from .env import EnvRunnerGroup, SingleAgentEnvRunner
+from .utils import (FaultTolerantActorManager, SingleAgentEpisode,
+                    compute_gae, episodes_to_batch, vtrace)
+
+__all__ = [
+    "Algorithm",
+    "AlgorithmConfig",
+    "PPO",
+    "PPOConfig",
+    "IMPALA",
+    "IMPALAConfig",
+    "RLModule",
+    "MLPModule",
+    "JaxLearner",
+    "LearnerGroup",
+    "EnvRunnerGroup",
+    "SingleAgentEnvRunner",
+    "FaultTolerantActorManager",
+    "SingleAgentEpisode",
+    "episodes_to_batch",
+    "compute_gae",
+    "vtrace",
+]

@@ -1,0 +1,139 @@
+"""Algorithm: the Tune-trainable RL loop.
+
+Parity: reference rllib/algorithms/algorithm.py:213 (Algorithm(Trainable),
+step :818, training_step :1586, save/restore). Builds the EnvRunnerGroup +
+LearnerGroup from an AlgorithmConfig; `train()` = one training_step with
+metric bookkeeping; checkpoints carry learner state (params+optimizer).
+"""
+from __future__ import annotations
+
+import os
+import pickle
+import time
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from ray_tpu.tune.trainable import Trainable
+
+from .algorithm_config import AlgorithmConfig
+from .core.learner_group import LearnerGroup
+from .env.env_runner_group import EnvRunnerGroup
+
+
+class Algorithm(Trainable):
+    config_cls = AlgorithmConfig
+
+    def __init__(self, config=None, **kwargs):
+        if isinstance(config, AlgorithmConfig):
+            self._algo_config = config
+        elif isinstance(config, dict) or config is None:
+            # From Tune: a plain dict of overrides onto the default config.
+            base = self.get_default_config()
+            for k, v in (config or {}).items():
+                setattr(base, k, v)
+            self._algo_config = base
+        else:
+            raise TypeError(f"bad config {type(config)}")
+        super().__init__(config={}, **kwargs)
+
+    @classmethod
+    def get_default_config(cls) -> AlgorithmConfig:
+        return cls.config_cls(algo_class=cls)
+
+    # ----------------------------------------------------------------- setup
+
+    def setup(self, config: Dict[str, Any]) -> None:
+        cfg = self._algo_config
+        self.env_runner_group = EnvRunnerGroup(
+            cfg.make_env_creator(),
+            self._module_factory(),
+            num_runners=cfg.num_env_runners,
+            num_envs_per_runner=cfg.num_envs_per_env_runner,
+            seed=cfg.seed,
+        )
+        self.learner_group = LearnerGroup(
+            self._learner_factory(), num_learners=cfg.num_learners)
+        self._timesteps_total = 0
+        self._episodes_total = 0
+        self._recent_returns: list = []
+
+    # -------------------------------------------------- algorithm interface
+
+    def _module_factory(self):
+        """Returns a zero-arg callable building the RLModule (must be
+        cloudpickle-able: called inside env-runner actors)."""
+        cfg = self._algo_config
+        creator = cfg.make_env_creator()
+        model_config = dict(cfg.model)
+
+        def factory():
+            from .core.catalog import module_for_space
+
+            env = creator()
+            try:
+                return module_for_space(
+                    env.observation_space, env.action_space, model_config)
+            finally:
+                env.close()
+
+        return factory
+
+    def _learner_factory(self):
+        raise NotImplementedError
+
+    def training_step(self) -> Dict[str, Any]:
+        raise NotImplementedError
+
+    # ------------------------------------------------------------ Trainable
+
+    def step(self) -> Dict[str, Any]:
+        t0 = time.time()
+        result = self.training_step()
+        cfg = self._algo_config
+        if (cfg.evaluation_interval
+                and self._iteration % cfg.evaluation_interval == 0):
+            result["evaluation_return_mean"] = self.env_runner_group.evaluate(
+                cfg.evaluation_num_episodes)
+        result.setdefault("timesteps_total", self._timesteps_total)
+        result.setdefault("episodes_total", self._episodes_total)
+        result["time_this_iter_s"] = time.time() - t0
+        return result
+
+    def _record_episodes(self, episodes) -> None:
+        done = [e for e in episodes if e.is_done]
+        self._episodes_total += len(done)
+        self._timesteps_total += sum(len(e) for e in episodes)
+        self._recent_returns.extend(e.total_reward() for e in done)
+        window = self._algo_config.metrics_num_episodes_for_smoothing
+        self._recent_returns = self._recent_returns[-window:]
+
+    @property
+    def episode_return_mean(self) -> float:
+        if not self._recent_returns:
+            return float("nan")
+        return float(np.mean(self._recent_returns))
+
+    # ---------------------------------------------------------- checkpoints
+
+    def save_checkpoint(self, checkpoint_dir: str) -> None:
+        state = {
+            "learner": self.learner_group.get_state(),
+            "timesteps_total": self._timesteps_total,
+            "episodes_total": self._episodes_total,
+        }
+        with open(os.path.join(checkpoint_dir, "algorithm_state.pkl"),
+                  "wb") as f:
+            pickle.dump(state, f)
+
+    def load_checkpoint(self, checkpoint_dir: str) -> None:
+        with open(os.path.join(checkpoint_dir, "algorithm_state.pkl"),
+                  "rb") as f:
+            state = pickle.load(f)
+        self.learner_group.set_state(state["learner"])
+        self._timesteps_total = state["timesteps_total"]
+        self._episodes_total = state["episodes_total"]
+
+    def cleanup(self) -> None:
+        self.env_runner_group.stop()
+        self.learner_group.shutdown()

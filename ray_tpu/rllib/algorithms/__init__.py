@@ -1,0 +1,4 @@
+from .ppo import PPO, PPOConfig
+from .impala import IMPALA, IMPALAConfig
+
+__all__ = ["PPO", "PPOConfig", "IMPALA", "IMPALAConfig"]

@@ -1,0 +1,5 @@
+from .rl_module import MLPModule, RLModule
+from .learner import JaxLearner
+from .learner_group import LearnerGroup
+
+__all__ = ["RLModule", "MLPModule", "JaxLearner", "LearnerGroup"]

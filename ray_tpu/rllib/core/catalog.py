@@ -1,0 +1,92 @@
+"""Default model catalog: obs/action space -> RLModule.
+
+Parity: reference rllib/core/models/catalog.py (1.1k LoC of framework
+branching collapses here: one MLP family, one Nature-CNN family for pixels,
+both plain jax). Conv layers use lax.conv_general_dilated in NHWC — XLA
+lowers these onto the MXU directly.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .rl_module import MLPModule, Params, RLModule, _dense, _dense_init
+
+# (out_channels, kernel, stride) — the Nature DQN/IMPALA-shallow stack.
+NATURE_CONV = ((32, 8, 4), (64, 4, 2), (64, 3, 1))
+
+
+class CNNModule(RLModule):
+    """Pixel policy: shared conv trunk + separate pi/vf heads (reference
+    catalog's conv defaults for Atari)."""
+
+    def __init__(self, obs_shape: Tuple[int, int, int], num_actions: int,
+                 conv: Sequence[Tuple[int, int, int]] = NATURE_CONV,
+                 hidden: int = 512):
+        self.obs_shape = obs_shape  # (H, W, C)
+        self.num_actions = num_actions
+        self.conv = tuple(conv)
+        self.hidden = hidden
+
+    def _conv_out_dim(self) -> int:
+        h, w, _ = self.obs_shape
+        for _, k, s in self.conv:
+            h = (h - k) // s + 1
+            w = (w - k) // s + 1
+        return h * w * self.conv[-1][0]
+
+    def init(self, rng: jax.Array) -> Params:
+        keys = jax.random.split(rng, len(self.conv) + 3)
+        convs = []
+        c_in = self.obs_shape[-1]
+        for i, (c_out, k, _) in enumerate(self.conv):
+            fan_in = k * k * c_in
+            w = jax.random.normal(keys[i], (k, k, c_in, c_out)) * np.sqrt(
+                2.0 / fan_in)
+            convs.append({"w": w.astype(jnp.float32),
+                          "b": jnp.zeros((c_out,), jnp.float32)})
+            c_in = c_out
+        flat = self._conv_out_dim()
+        return {
+            "convs": convs,
+            "trunk": _dense_init(keys[-3], flat, self.hidden),
+            "pi": _dense_init(keys[-2], self.hidden, self.num_actions,
+                              scale=0.01),
+            "vf": _dense_init(keys[-1], self.hidden, 1, scale=1.0),
+        }
+
+    def forward(self, params: Params, obs: jax.Array) -> Dict[str, jax.Array]:
+        x = obs.astype(jnp.float32)
+        if x.dtype != jnp.float32 or obs.dtype == jnp.uint8:
+            x = x / 255.0
+        for p, (_, _, stride) in zip(params["convs"], self.conv):
+            x = jax.lax.conv_general_dilated(
+                x, p["w"], (stride, stride), "VALID",
+                dimension_numbers=("NHWC", "HWIO", "NHWC"))
+            x = jax.nn.relu(x + p["b"])
+        x = x.reshape(x.shape[0], -1)
+        h = jax.nn.relu(_dense(params["trunk"], x))
+        logits = _dense(params["pi"], h)
+        vf = _dense(params["vf"], h)[..., 0]
+        return {"logits": logits, "vf": vf}
+
+
+def module_for_space(obs_space, act_space, model_config: Dict[str, Any]) -> RLModule:
+    """gymnasium spaces -> default RLModule."""
+    import gymnasium as gym
+
+    if not isinstance(act_space, gym.spaces.Discrete):
+        raise NotImplementedError(
+            f"only Discrete action spaces supported, got {act_space}")
+    shape = obs_space.shape
+    if len(shape) == 3:
+        return CNNModule(shape, int(act_space.n),
+                         conv=model_config.get("conv", NATURE_CONV),
+                         hidden=model_config.get("hidden", 512))
+    if len(shape) == 1:
+        return MLPModule(shape[0], int(act_space.n),
+                         hiddens=model_config.get("fcnet_hiddens", (64, 64)))
+    raise NotImplementedError(f"unsupported obs shape {shape}")

@@ -1,0 +1,141 @@
+"""JaxLearner: the TPU training half of the RL stack.
+
+Parity: reference rllib/core/learner/learner.py + torch_learner.py — but the
+GPU/DDP path (TorchDDPRLModule wrapping, per-learner NCCL) is replaced by
+ONE jitted update over a device mesh: gradients reduce over the `data` mesh
+axis inside the compiled program (pjit inserts the psum), minibatch SGD
+epochs run as a host loop over device-resident shards. The learner is
+framework-complete for policy-gradient losses; algorithms subclass and
+implement `loss(params, batch, rng)`.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..core.rl_module import RLModule
+
+
+class JaxLearner:
+    def __init__(
+        self,
+        module: RLModule,
+        *,
+        lr: float = 3e-4,
+        grad_clip: Optional[float] = 0.5,
+        optimizer: Optional[optax.GradientTransformation] = None,
+        mesh: Optional[Mesh] = None,
+        seed: int = 0,
+    ):
+        self.module = module
+        self.mesh = mesh
+        tx = optimizer or optax.adam(lr)
+        if grad_clip is not None:
+            tx = optax.chain(optax.clip_by_global_norm(grad_clip), tx)
+        self.optimizer = tx
+        self._rng = jax.random.key(seed)
+        self.params = self.module.init(jax.random.key(seed))
+        self.opt_state = self.optimizer.init(self.params)
+        if mesh is not None:
+            # Params replicated over the mesh; batches shard over `data`.
+            rep = NamedSharding(mesh, P())
+            self.params = jax.device_put(self.params, rep)
+            self.opt_state = jax.device_put(self.opt_state, rep)
+        self._jit_update = jax.jit(self._update, donate_argnums=(0, 1))
+
+    # ------------------------------------------------------------------ loss
+
+    def loss(self, params, batch: Dict[str, jax.Array], rng: jax.Array
+             ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+        """Return (scalar loss, metrics). Implemented by the algorithm."""
+        raise NotImplementedError
+
+    # ---------------------------------------------------------------- update
+
+    def _update(self, params, opt_state, batch, rng):
+        (loss, metrics), grads = jax.value_and_grad(
+            self.loss, has_aux=True)(params, batch, rng)
+        updates, opt_state = self.optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        metrics = dict(metrics)
+        metrics["total_loss"] = loss
+        metrics["grad_norm"] = optax.global_norm(grads)
+        return params, opt_state, metrics
+
+    def _shard_batch(self, batch: Dict[str, np.ndarray]) -> Dict[str, Any]:
+        if self.mesh is None:
+            return {k: jnp.asarray(v) for k, v in batch.items()}
+        axes = tuple(a for a in ("data", "fsdp")
+                     if a in self.mesh.axis_names and self.mesh.shape[a] > 1)
+
+        def put(v):
+            if np.ndim(v) == 0 or not axes:
+                return jax.device_put(v, NamedSharding(self.mesh, P()))
+            spec = P(axes, *([None] * (np.ndim(v) - 1)))
+            return jax.device_put(v, NamedSharding(self.mesh, spec))
+
+        return {k: put(v) for k, v in batch.items()}
+
+    def update(
+        self,
+        batch: Dict[str, np.ndarray],
+        *,
+        minibatch_size: Optional[int] = None,
+        num_epochs: int = 1,
+        shuffle: bool = True,
+    ) -> Dict[str, float]:
+        """Minibatch-SGD over the batch; returns averaged metrics."""
+        n = next(iter(batch.values())).shape[0]
+        mb = minibatch_size or n
+        all_metrics: list = []
+        rng_np = np.random.default_rng(int(jax.random.randint(
+            self._consume_rng(), (), 0, 2**31 - 1)))
+        for _ in range(num_epochs):
+            idx = rng_np.permutation(n) if shuffle else np.arange(n)
+            for start in range(0, n - mb + 1, mb):
+                rows = idx[start:start + mb]
+                sub = {k: v[rows] for k, v in batch.items()}
+                dev_batch = self._shard_batch(sub)
+                self.params, self.opt_state, metrics = self._jit_update(
+                    self.params, self.opt_state, dev_batch,
+                    self._consume_rng())
+                all_metrics.append(metrics)
+        if not all_metrics:
+            return {}
+        out: Dict[str, float] = {}
+        for k in all_metrics[0]:
+            out[k] = float(np.mean([float(m[k]) for m in all_metrics]))
+        return out
+
+    def _consume_rng(self) -> jax.Array:
+        self._rng, sub = jax.random.split(self._rng)
+        return sub
+
+    # ----------------------------------------------------------- state/ckpt
+
+    def get_weights(self) -> Any:
+        return jax.device_get(self.params)
+
+    def set_weights(self, weights: Any) -> None:
+        if self.mesh is not None:
+            weights = jax.device_put(
+                weights, NamedSharding(self.mesh, P()))
+        self.params = weights
+
+    def get_state(self) -> Dict[str, Any]:
+        return {
+            "params": jax.device_get(self.params),
+            "opt_state": jax.device_get(self.opt_state),
+        }
+
+    def set_state(self, state: Dict[str, Any]) -> None:
+        self.set_weights(state["params"])
+        self.opt_state = state["opt_state"]
+        if self.mesh is not None:
+            self.opt_state = jax.device_put(
+                self.opt_state, NamedSharding(self.mesh, P()))

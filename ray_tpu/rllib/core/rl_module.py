@@ -1,0 +1,107 @@
+"""RLModule: the model abstraction of the RL stack.
+
+Parity: reference rllib/core/rl_module/rl_module.py (forward_inference /
+forward_exploration / forward_train) — but functional: params are an
+explicit pytree (works under pjit/pmap and donates cleanly), and the module
+object holds only architecture. The default MLPModule covers the CartPole/
+classic-control family; CNNModule (atari) in catalog.py.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Params = Any
+
+
+class RLModule:
+    """Interface. forward returns {"logits": [B, A], "vf": [B]}."""
+
+    def init(self, rng: jax.Array) -> Params:
+        raise NotImplementedError
+
+    def forward(self, params: Params, obs: jax.Array) -> Dict[str, jax.Array]:
+        raise NotImplementedError
+
+    # ------------------------------------------------------- action sampling
+
+    def action_dist(self, logits: jax.Array):
+        return CategoricalDist(logits)
+
+    def forward_inference(self, params: Params, obs: jax.Array) -> jax.Array:
+        """Greedy action."""
+        out = self.forward(params, obs)
+        return jnp.argmax(out["logits"], axis=-1)
+
+    def forward_exploration(
+        self, params: Params, obs: jax.Array, rng: jax.Array
+    ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+        """Sampled action, its logp, and the value estimate."""
+        out = self.forward(params, obs)
+        dist = self.action_dist(out["logits"])
+        action = dist.sample(rng)
+        return action, dist.logp(action), out["vf"]
+
+
+class CategoricalDist:
+    def __init__(self, logits: jax.Array):
+        self.logits = logits
+
+    def sample(self, rng: jax.Array) -> jax.Array:
+        return jax.random.categorical(rng, self.logits, axis=-1)
+
+    def logp(self, action: jax.Array) -> jax.Array:
+        logp_all = jax.nn.log_softmax(self.logits, axis=-1)
+        return jnp.take_along_axis(
+            logp_all, action[..., None].astype(jnp.int32), axis=-1)[..., 0]
+
+    def entropy(self) -> jax.Array:
+        logp = jax.nn.log_softmax(self.logits, axis=-1)
+        return -jnp.sum(jnp.exp(logp) * logp, axis=-1)
+
+
+def _dense_init(rng, n_in, n_out, scale=np.sqrt(2.0)):
+    w = jax.random.orthogonal(rng, max(n_in, n_out))[:n_in, :n_out] * scale
+    return {"w": w.astype(jnp.float32), "b": jnp.zeros((n_out,), jnp.float32)}
+
+
+def _dense(p, x):
+    return x @ p["w"] + p["b"]
+
+
+class MLPModule(RLModule):
+    """Separate policy/value MLP trunks (reference models/catalog.py default
+    fcnet); orthogonal init, tanh activations — the classic PPO recipe."""
+
+    def __init__(self, obs_dim: int, num_actions: int,
+                 hiddens: Sequence[int] = (64, 64)):
+        self.obs_dim = obs_dim
+        self.num_actions = num_actions
+        self.hiddens = tuple(hiddens)
+
+    def init(self, rng: jax.Array) -> Params:
+        sizes = (self.obs_dim,) + self.hiddens
+        n = len(self.hiddens)
+        keys = jax.random.split(rng, 2 * n + 2)
+        pi = [_dense_init(keys[i], sizes[i], sizes[i + 1]) for i in range(n)]
+        vf = [_dense_init(keys[n + i], sizes[i], sizes[i + 1])
+              for i in range(n)]
+        pi.append(_dense_init(keys[-2], sizes[-1], self.num_actions,
+                              scale=0.01))
+        vf.append(_dense_init(keys[-1], sizes[-1], 1, scale=1.0))
+        return {"pi": pi, "vf": vf}
+
+    def forward(self, params: Params, obs: jax.Array) -> Dict[str, jax.Array]:
+        x = obs.astype(jnp.float32)
+        h = x
+        for layer in params["pi"][:-1]:
+            h = jnp.tanh(_dense(layer, h))
+        logits = _dense(params["pi"][-1], h)
+        h = x
+        for layer in params["vf"][:-1]:
+            h = jnp.tanh(_dense(layer, h))
+        vf = _dense(params["vf"][-1], h)[..., 0]
+        return {"logits": logits, "vf": vf}

@@ -1,0 +1,4 @@
+from .env_runner import SingleAgentEnvRunner
+from .env_runner_group import EnvRunnerGroup
+
+__all__ = ["SingleAgentEnvRunner", "EnvRunnerGroup"]

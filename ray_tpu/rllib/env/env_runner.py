@@ -1,0 +1,149 @@
+"""SingleAgentEnvRunner: CPU sampling actor.
+
+Parity: reference rllib/env/single_agent_env_runner.py:49 (`sample` :127,
+gym.vector envs :701): owns a vectorized gymnasium env, steps it with the
+current policy (jitted CPU forward — env runners never touch the TPU), and
+returns completed/truncated episode chunks carrying logp and value
+predictions for GAE/v-trace.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from ..utils.episodes import SingleAgentEpisode
+
+
+class SingleAgentEnvRunner:
+    def __init__(
+        self,
+        env_creator: Callable[[], Any],
+        module_factory: Callable[[], Any],
+        *,
+        num_envs: int = 1,
+        seed: int = 0,
+        worker_index: int = 0,
+    ):
+        import gymnasium as gym
+
+        # Sampling is pure CPU work; never grab the accelerator.
+        from ray_tpu.util.jaxenv import ensure_platform
+
+        ensure_platform("cpu")
+        import jax
+
+        self._jax = jax
+        self.envs = gym.vector.SyncVectorEnv(
+            [env_creator for _ in range(num_envs)])
+        self.num_envs = num_envs
+        self.module = module_factory()
+        self.params = None
+        self._rng = jax.random.key(seed * 10_007 + worker_index)
+        self._explore_fn = jax.jit(self.module.forward_exploration)
+        self._value_fn = jax.jit(
+            lambda p, o: self.module.forward(p, o)["vf"])
+        seed_val = int(seed * 65_537 + worker_index)
+        self._obs, _ = self.envs.reset(seed=seed_val)
+        self._episodes = [SingleAgentEpisode() for _ in range(num_envs)]
+        for i in range(num_envs):
+            self._episodes[i].observations.append(self._obs[i].copy())
+        # gymnasium >=1.0 vector envs autoreset on the step AFTER done
+        # (AutoresetMode.NEXT_STEP): that step's action is ignored, so no
+        # transition must be recorded for it.
+        self._needs_reset = np.zeros(num_envs, dtype=bool)
+
+    # ----------------------------------------------------------------- state
+
+    def set_weights(self, weights) -> None:
+        self.params = weights
+
+    def ping(self) -> str:
+        return "ok"
+
+    # ---------------------------------------------------------------- sample
+
+    def sample(self, num_timesteps: int) -> List[SingleAgentEpisode]:
+        """Step the vector env ~num_timesteps (per runner, across its envs);
+        returns episode CHUNKS (done or truncated-by-horizon or cut at the
+        end of the rollout, with bootstrap values for the cut ones)."""
+        assert self.params is not None, "set_weights before sample"
+        jax = self._jax
+        out: List[SingleAgentEpisode] = []
+        steps = 0
+        while steps < num_timesteps:
+            self._rng, sub = jax.random.split(self._rng)
+            actions, logp, vf = self._explore_fn(
+                self.params, self._obs, sub)
+            actions = np.asarray(actions)
+            logp = np.asarray(logp)
+            vf = np.asarray(vf)
+            next_obs, rewards, terms, truncs, _ = self.envs.step(actions)
+            vf_next: Optional[np.ndarray] = None  # lazy V(next_obs)
+            for i in range(self.num_envs):
+                if self._needs_reset[i]:
+                    # Autoreset step: the env ignored our action and returned
+                    # the reset observation — start the new episode here.
+                    self._needs_reset[i] = False
+                    fresh = SingleAgentEpisode()
+                    fresh.observations.append(next_obs[i].copy())
+                    self._episodes[i] = fresh
+                    continue
+                ep = self._episodes[i]
+                ep.actions.append(actions[i])
+                ep.rewards.append(float(rewards[i]))
+                ep.logp.append(float(logp[i]))
+                ep.vf_preds.append(float(vf[i]))
+                steps += 1
+                if terms[i] or truncs[i]:
+                    ep.terminated = bool(terms[i])
+                    ep.truncated = bool(truncs[i])
+                    # NEXT_STEP autoreset: next_obs[i] IS the final obs.
+                    ep.observations.append(next_obs[i].copy())
+                    if truncs[i] and not terms[i]:
+                        if vf_next is None:
+                            vf_next = np.asarray(
+                                self._value_fn(self.params, next_obs))
+                        ep.bootstrap_value = float(vf_next[i])
+                    out.append(ep)
+                    self._episodes[i] = SingleAgentEpisode()
+                    self._needs_reset[i] = True
+                else:
+                    ep.observations.append(next_obs[i].copy())
+            self._obs = next_obs
+        # Cut the in-flight episodes: hand them out with a bootstrap value
+        # and start fresh chunks that continue from the same env state.
+        live_idx = [i for i in range(self.num_envs)
+                    if len(self._episodes[i]) > 0]
+        if live_idx:
+            vf_last = np.asarray(self._value_fn(self.params, self._obs))
+            for i in live_idx:
+                ep = self._episodes[i]
+                ep.bootstrap_value = float(vf_last[i])
+                out.append(ep)
+                cont = SingleAgentEpisode()
+                cont.observations.append(self._obs[i].copy())
+                self._episodes[i] = cont
+        return out
+
+    def sample_episode_greedy(self, max_steps: int = 10_000) -> float:
+        """One full greedy-policy episode on a fresh env; returns its return
+        (evaluation path, reference Algorithm.evaluate)."""
+        import gymnasium as gym
+
+        env = self.envs.env_fns[0]()
+        jax = self._jax
+        obs, _ = env.reset()
+        total = 0.0
+        for _ in range(max_steps):
+            action = self.module.forward_inference(
+                self.params, np.asarray(obs)[None])
+            obs, r, term, trunc, _ = env.step(int(np.asarray(action)[0]))
+            total += float(r)
+            if term or trunc:
+                break
+        env.close()
+        return total
+
+    def stop(self) -> None:
+        self.envs.close()

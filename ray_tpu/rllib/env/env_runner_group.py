@@ -1,0 +1,91 @@
+"""EnvRunnerGroup: the sampling fleet.
+
+Parity: reference rllib/env/env_runner_group.py + the
+`synchronous_parallel_sample` train-op (ppo.py:435): N env-runner actors on
+CPU hosts, weight sync before sampling, fault-tolerant fan-out via
+FaultTolerantActorManager. num_runners=0 runs a local (in-driver) runner —
+the debug/test path, like the reference's local worker.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional
+
+import ray_tpu
+from ..utils.actor_manager import FaultTolerantActorManager
+from ..utils.episodes import SingleAgentEpisode
+from .env_runner import SingleAgentEnvRunner
+
+
+class EnvRunnerGroup:
+    def __init__(
+        self,
+        env_creator: Callable[[], Any],
+        module_factory: Callable[[], Any],
+        *,
+        num_runners: int = 0,
+        num_envs_per_runner: int = 1,
+        seed: int = 0,
+        runner_resources: Optional[Dict[str, float]] = None,
+        max_restarts: int = 3,
+    ):
+        self.num_runners = num_runners
+        if num_runners == 0:
+            self._local = SingleAgentEnvRunner(
+                env_creator, module_factory,
+                num_envs=num_envs_per_runner, seed=seed, worker_index=0)
+            self._manager = None
+        else:
+            self._local = None
+            opts = dict(runner_resources or {"num_cpus": 1})
+            cls = ray_tpu.remote(SingleAgentEnvRunner).options(**opts)
+
+            def factory(i: int):
+                return cls.remote(
+                    env_creator, module_factory,
+                    num_envs=num_envs_per_runner, seed=seed,
+                    worker_index=i + 1)
+
+            self._manager = FaultTolerantActorManager(
+                factory, num_runners, max_restarts=max_restarts)
+
+    # -------------------------------------------------------------- sampling
+
+    def sync_weights(self, weights: Any) -> None:
+        if self._local is not None:
+            self._local.set_weights(weights)
+        else:
+            self._manager.foreach_actor("set_weights", weights)
+
+    def sample(self, total_timesteps: int) -> List[SingleAgentEpisode]:
+        """Synchronous parallel sample of ~total_timesteps across runners."""
+        if self._local is not None:
+            return self._local.sample(total_timesteps)
+        n = max(1, len(self._manager.healthy_actor_ids()))
+        per = max(1, total_timesteps // n)
+        results = self._manager.foreach_actor("sample", per)
+        episodes: List[SingleAgentEpisode] = []
+        for _, eps in results:
+            episodes.extend(eps)
+        # Heal for the next round; freshly restored runners get weights at
+        # the next sync_weights call.
+        self._manager.restore_unhealthy()
+        return episodes
+
+    def evaluate(self, num_episodes: int = 1) -> float:
+        """Mean greedy-policy episode return."""
+        if self._local is not None:
+            rets = [self._local.sample_episode_greedy()
+                    for _ in range(num_episodes)]
+            return sum(rets) / len(rets)
+        ids = self._manager.healthy_actor_ids()[:num_episodes] or []
+        results = self._manager.foreach_actor(
+            "sample_episode_greedy", actor_ids=ids)
+        if not results:
+            return float("nan")
+        return sum(r for _, r in results) / len(results)
+
+    def stop(self) -> None:
+        if self._local is not None:
+            self._local.stop()
+        if self._manager is not None:
+            self._manager.shutdown()

@@ -1,0 +1,11 @@
+from .actor_manager import FaultTolerantActorManager
+from .episodes import SingleAgentEpisode, episodes_to_batch
+from .gae import compute_gae, vtrace
+
+__all__ = [
+    "FaultTolerantActorManager",
+    "SingleAgentEpisode",
+    "episodes_to_batch",
+    "compute_gae",
+    "vtrace",
+]

@@ -1,0 +1,105 @@
+"""Fault-tolerant actor pool for sampling/learner actors.
+
+Parity: reference rllib/utils/actor_manager.py:196 FaultTolerantActorManager
+(foreach_actor :573, probe_unhealthy_actors :823): calls fan out to a set of
+actors; actors whose calls raise are marked unhealthy and skipped; restart
+recreates them from the saved factory so a lost env runner never kills the
+training loop.
+"""
+from __future__ import annotations
+
+import logging
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import ray_tpu
+
+logger = logging.getLogger(__name__)
+
+
+class FaultTolerantActorManager:
+    def __init__(
+        self,
+        actor_factory: Callable[[int], Any],
+        num_actors: int,
+        *,
+        max_restarts: int = 3,
+    ):
+        self._factory = actor_factory
+        self._max_restarts = max_restarts
+        self._actors: Dict[int, Any] = {
+            i: actor_factory(i) for i in range(num_actors)
+        }
+        self._healthy: Dict[int, bool] = {i: True for i in self._actors}
+        self._restarts: Dict[int, int] = {i: 0 for i in self._actors}
+
+    # ------------------------------------------------------------------ info
+
+    @property
+    def num_actors(self) -> int:
+        return len(self._actors)
+
+    def healthy_actor_ids(self) -> List[int]:
+        return [i for i, ok in self._healthy.items() if ok]
+
+    def actor(self, i: int):
+        return self._actors[i]
+
+    # ------------------------------------------------------------------ calls
+
+    def foreach_actor(
+        self,
+        fn_name: str,
+        *args,
+        actor_ids: Optional[Sequence[int]] = None,
+        timeout: Optional[float] = None,
+        **kwargs,
+    ) -> List[Tuple[int, Any]]:
+        """Call method `fn_name(*args, **kwargs)` on each healthy actor;
+        returns [(actor_id, result)] for the calls that succeeded and marks
+        failed actors unhealthy."""
+        ids = [i for i in (actor_ids or self.healthy_actor_ids())
+               if self._healthy.get(i)]
+        refs = {}
+        for i in ids:
+            try:
+                refs[i] = getattr(self._actors[i], fn_name).remote(
+                    *args, **kwargs)
+            except Exception:
+                logger.exception("submit to actor %d failed", i)
+                self._healthy[i] = False
+        out: List[Tuple[int, Any]] = []
+        for i, ref in refs.items():
+            try:
+                out.append((i, ray_tpu.get(ref, timeout=timeout)))
+            except Exception:
+                logger.exception("actor %d call %s failed", i, fn_name)
+                self._healthy[i] = False
+        return out
+
+    def restore_unhealthy(self) -> int:
+        """Recreate dead actors from the factory (bounded by max_restarts).
+        Returns the number restored."""
+        restored = 0
+        for i, ok in list(self._healthy.items()):
+            if ok:
+                continue
+            if self._restarts[i] >= self._max_restarts:
+                continue
+            try:
+                ray_tpu.kill(self._actors[i])
+            except Exception:
+                pass
+            self._actors[i] = self._factory(i)
+            self._healthy[i] = True
+            self._restarts[i] += 1
+            restored += 1
+        return restored
+
+    def shutdown(self) -> None:
+        for a in self._actors.values():
+            try:
+                ray_tpu.kill(a)
+            except Exception:
+                pass
+        self._actors.clear()
+        self._healthy.clear()

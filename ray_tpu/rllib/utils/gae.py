@@ -1,0 +1,91 @@
+"""Advantage estimators as jittable scans.
+
+Parity: reference rllib/evaluation/postprocessing.py compute_advantages
+(GAE) and rllib/algorithms/impala/vtrace_torch.py (v-trace). Both are
+expressed as `lax.scan` over reversed time — compiler-friendly TPU control
+flow instead of the reference's Python/torch loops.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def compute_gae(
+    rewards: jax.Array,      # [T] or [B, T]
+    values: jax.Array,       # same shape
+    dones: jax.Array,        # same shape (1.0 where episode ended at t)
+    bootstrap_value: jax.Array,  # [] or [B]
+    *,
+    gamma: float = 0.99,
+    lam: float = 0.95,
+):
+    """Returns (advantages, value_targets), same shape as rewards."""
+    if rewards.ndim == 1:
+        adv, vt = compute_gae(rewards[None], values[None], dones[None],
+                              jnp.asarray(bootstrap_value)[None],
+                              gamma=gamma, lam=lam)
+        return adv[0], vt[0]
+
+    cont = 1.0 - dones.astype(jnp.float32)
+    next_values = jnp.concatenate(
+        [values[:, 1:], bootstrap_value[:, None]], axis=1)
+    # next value is 0 where the episode terminated at t
+    deltas = rewards + gamma * next_values * cont - values
+
+    def scan_fn(carry, xs):
+        delta_t, cont_t = xs
+        adv = delta_t + gamma * lam * cont_t * carry
+        return adv, adv
+
+    _, adv_rev = jax.lax.scan(
+        scan_fn,
+        jnp.zeros(rewards.shape[0], jnp.float32),
+        (deltas.T[::-1], cont.T[::-1]),
+    )
+    advantages = adv_rev[::-1].T
+    return advantages, advantages + values
+
+
+def vtrace(
+    behavior_logp: jax.Array,   # [B, T] log pi_b(a|s)
+    target_logp: jax.Array,     # [B, T] log pi(a|s)
+    rewards: jax.Array,         # [B, T]
+    values: jax.Array,          # [B, T]
+    dones: jax.Array,           # [B, T]
+    bootstrap_value: jax.Array,  # [B]
+    *,
+    gamma: float = 0.99,
+    clip_rho: float = 1.0,
+    clip_c: float = 1.0,
+):
+    """IMPALA v-trace targets (Espeholt et al. 2018) as a reverse scan.
+
+    Returns (vs, pg_advantages): vs are the corrected value targets; the
+    policy gradient uses rho_t * (r_t + gamma*vs_{t+1} - V(s_t)).
+    """
+    rho = jnp.exp(target_logp - behavior_logp)
+    rho_c = jnp.minimum(clip_rho, rho)
+    c = jnp.minimum(clip_c, rho)
+    cont = 1.0 - dones.astype(jnp.float32)
+
+    next_values = jnp.concatenate(
+        [values[:, 1:], bootstrap_value[:, None]], axis=1)
+    deltas = rho_c * (rewards + gamma * next_values * cont - values)
+
+    def scan_fn(acc, xs):
+        delta_t, c_t, cont_t = xs
+        acc = delta_t + gamma * cont_t * c_t * acc
+        return acc, acc
+
+    _, acc_rev = jax.lax.scan(
+        scan_fn,
+        jnp.zeros(rewards.shape[0], jnp.float32),
+        (deltas.T[::-1], c.T[::-1], cont.T[::-1]),
+    )
+    vs_minus_v = acc_rev[::-1].T
+    vs = values + vs_minus_v
+
+    next_vs = jnp.concatenate([vs[:, 1:], bootstrap_value[:, None]], axis=1)
+    pg_adv = rho_c * (rewards + gamma * next_vs * cont - values)
+    return vs, pg_adv
