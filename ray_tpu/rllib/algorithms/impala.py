@@ -158,22 +158,31 @@ class IMPALA(Algorithm):
                 episodes = ray_tpu.get(ref)
             except Exception:
                 manager._healthy[actor_id] = False
-                if manager.restore_unhealthy():
-                    # A restored runner is a FRESH actor with no weights —
-                    # arming it without a sync would assert in sample().
+                before = set(manager.healthy_actor_ids())
+                manager.restore_unhealthy()
+                # Re-arm ONLY actually-restored runners (fresh actors need
+                # weights first or sample() asserts); a runner past its
+                # restart budget stays un-armed — re-arming its dead handle
+                # would busy-loop on ActorDiedError forever.
+                restored = [i for i in manager.healthy_actor_ids()
+                            if i not in before]
+                if restored:
                     manager.foreach_actor(
                         "set_weights", self.learner_group.get_weights(),
-                        actor_ids=[actor_id])
-                self._arm(manager, [actor_id], cfg.rollout_fragment_length)
+                        actor_ids=restored)
+                    self._arm(manager, restored,
+                              cfg.rollout_fragment_length)
                 continue
             metrics = self._update_from_episodes(episodes)
             done_updates += 1
             if self._updates_since_broadcast >= cfg.broadcast_interval:
+                # Fleet-wide broadcast: syncing only the just-drained runner
+                # would leave the others' policy lag unbounded.
                 weights = self.learner_group.get_weights()
-                manager.foreach_actor("set_weights", weights,
-                                      actor_ids=[actor_id])
+                manager.foreach_actor("set_weights", weights)
                 self._updates_since_broadcast = 0
-            self._arm(manager, [actor_id], cfg.rollout_fragment_length)
+            if manager._healthy.get(actor_id):
+                self._arm(manager, [actor_id], cfg.rollout_fragment_length)
         return self._result(metrics)
 
     def _result(self, metrics: Dict[str, float]) -> Dict[str, Any]:

@@ -91,7 +91,11 @@ class JaxLearner:
     ) -> Dict[str, float]:
         """Minibatch-SGD over the batch; returns averaged metrics."""
         n = next(iter(batch.values())).shape[0]
-        mb = minibatch_size or n
+        # Clamp: a requested minibatch larger than the batch must still run
+        # ONE full-batch step, not silently zero (range below would be
+        # empty). Tail rows that don't fill a minibatch are dropped, as in
+        # the reference's minibatch iterator.
+        mb = min(minibatch_size or n, n)
         all_metrics: list = []
         rng_np = np.random.default_rng(int(jax.random.randint(
             self._consume_rng(), (), 0, 2**31 - 1)))

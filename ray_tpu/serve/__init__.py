@@ -1,0 +1,33 @@
+"""Serve: model serving on the actor runtime.
+
+Parity map (reference python/ray/serve/, SURVEY.md §2.6):
+- @serve.deployment / .bind() graph     -> deployment.py
+- ServeController + DeploymentState     -> controller.py
+- ReplicaActor + UserCallableWrapper    -> replica.py
+- DeploymentHandle + pow-2 Router       -> handle.py
+- HTTP proxy (ASGI)                     -> proxy.py
+- @serve.batch                          -> batching.py
+- serve.run/start/delete/status         -> api.py
+"""
+from .api import (delete, get_app_handle, get_deployment_handle, run,
+                  shutdown, start, status)
+from .batching import batch
+from .deployment import Application, AutoscalingConfig, Deployment, deployment
+from .handle import DeploymentHandle, DeploymentResponse
+
+__all__ = [
+    "deployment",
+    "Deployment",
+    "Application",
+    "AutoscalingConfig",
+    "DeploymentHandle",
+    "DeploymentResponse",
+    "run",
+    "start",
+    "shutdown",
+    "delete",
+    "status",
+    "get_app_handle",
+    "get_deployment_handle",
+    "batch",
+]

@@ -1,0 +1,114 @@
+"""@serve.batch — request coalescing inside a replica.
+
+Parity: reference serve/batching.py (@serve.batch): calls queue until
+max_batch_size accumulate or batch_wait_timeout_s elapses, then the wrapped
+function runs ONCE on the list of requests and each caller gets its element
+back. On TPU replicas this is what turns 128 concurrent 1-item requests
+into one MXU-shaped batch.
+"""
+from __future__ import annotations
+
+import functools
+import queue
+import threading
+from typing import Any, Callable, List, Optional
+
+
+class _Batcher:
+    def __init__(self, fn: Callable[[List[Any]], List[Any]],
+                 max_batch_size: int, timeout_s: float):
+        self.fn = fn
+        self.max_batch_size = max_batch_size
+        self.timeout_s = timeout_s
+        self._queue: "queue.Queue" = queue.Queue()
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def submit(self, item: Any) -> Any:
+        slot: "queue.Queue" = queue.Queue(1)
+        self._queue.put((item, slot))
+        result = slot.get()
+        if isinstance(result, _Err):
+            raise result.exc
+        return result
+
+    def _loop(self) -> None:
+        while True:
+            item, slot = self._queue.get()
+            batch = [(item, slot)]
+            # Coalesce: wait up to timeout_s for more, cap at max size.
+            deadline = threading.Event()
+            import time
+
+            t_end = time.time() + self.timeout_s
+            while len(batch) < self.max_batch_size:
+                remaining = t_end - time.time()
+                if remaining <= 0:
+                    break
+                try:
+                    batch.append(self._queue.get(timeout=remaining))
+                except queue.Empty:
+                    break
+            items = [b[0] for b in batch]
+            try:
+                results = self.fn(items)
+                if len(results) != len(items):
+                    raise ValueError(
+                        f"batch fn returned {len(results)} results for "
+                        f"{len(items)} inputs")
+                for (_, s), r in zip(batch, results):
+                    s.put(r)
+            except Exception as e:
+                for _, s in batch:
+                    s.put(_Err(e))
+
+
+class _Err:
+    def __init__(self, exc: BaseException):
+        self.exc = exc
+
+
+# Guards batcher creation: concurrent FIRST calls would otherwise each get a
+# private batcher and nothing ever coalesces. Module-level (pickled by
+# reference) because a lock captured in the decorator closure would make
+# decorated deployment classes uncloudpicklable.
+_CREATE_LOCK = threading.Lock()
+
+
+def batch(_fn: Optional[Callable] = None, *, max_batch_size: int = 8,
+          batch_wait_timeout_s: float = 0.01):
+    """Decorator for replica methods taking a list of requests."""
+
+    def wrap(fn):
+        state: dict = {}
+
+        @functools.wraps(fn)
+        def wrapper(*args):
+            # Bound method: args = (self, item); function: (item,)
+            if len(args) == 2:
+                owner, item = args
+                key = id(owner)
+                caller = lambda items: fn(owner, items)
+            else:
+                (item,) = args
+                key = None
+                caller = fn
+            b = state.get(key)
+            if b is None:
+                # Import-at-call: referencing the module-global lock by name
+                # would snapshot the (unpicklable) lock into this closure's
+                # globals when cloudpickle ships the deployment by value.
+                from ray_tpu.serve.batching import _CREATE_LOCK as lock
+
+                with lock:
+                    b = state.get(key)
+                    if b is None:
+                        b = state[key] = _Batcher(
+                            caller, max_batch_size, batch_wait_timeout_s)
+            return b.submit(item)
+
+        return wrapper
+
+    if _fn is not None:
+        return wrap(_fn)
+    return wrap

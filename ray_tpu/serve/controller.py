@@ -1,0 +1,216 @@
+"""ServeController: the control-plane actor.
+
+Parity: reference serve/_private/controller.py:86 (ServeController) +
+deployment_state.py:1226 (DeploymentState reconciliation): holds target
+state per deployment, reconciles actual replica actors toward it, restarts
+dead replicas, runs queue-metric autoscaling
+(autoscaling_state.py:82 / replica_queue_length_autoscaling_policy), and
+answers routing queries (replica handle lists, versioned so routers can
+long-poll-style refresh cheaply).
+"""
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import cloudpickle
+
+import ray_tpu
+from .replica import ReplicaActor
+
+logger = logging.getLogger(__name__)
+
+CONTROLLER_NAME = "SERVE_CONTROLLER"
+
+
+class _DeploymentInfo:
+    def __init__(self, name: str, serialized_callable: bytes, init_args,
+                 init_kwargs, config: Dict[str, Any]):
+        self.name = name
+        self.serialized_callable = serialized_callable
+        self.init_args = init_args
+        self.init_kwargs = init_kwargs
+        self.config = config
+        self.target_replicas: int = config["num_replicas"]
+        self.replicas: List[Any] = []  # ActorHandles
+        self.version = 0
+        self.last_error: Optional[str] = None
+        # autoscaling bookkeeping
+        self.last_scale_up = 0.0
+        self.last_scale_down = 0.0
+
+
+class ServeController:
+    def __init__(self):
+        self._deployments: Dict[str, _DeploymentInfo] = {}
+        self._route_prefixes: Dict[str, str] = {}  # prefix -> deployment
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._loop = threading.Thread(target=self._control_loop, daemon=True)
+        self._loop.start()
+
+    # ------------------------------------------------------------ deploy API
+
+    def deploy(self, name: str, serialized_callable: bytes, init_args,
+               init_kwargs, config: Dict[str, Any],
+               route_prefix: Optional[str] = None) -> None:
+        with self._lock:
+            info = self._deployments.get(name)
+            if info is None:
+                info = _DeploymentInfo(name, serialized_callable, init_args,
+                                       init_kwargs, config)
+                self._deployments[name] = info
+            else:
+                info.serialized_callable = serialized_callable
+                info.init_args = init_args
+                info.init_kwargs = init_kwargs
+                info.config = config
+                info.target_replicas = config["num_replicas"]
+                # In-place redeploy: drop old replicas; reconcile restarts.
+                for r in info.replicas:
+                    self._kill_replica(r)
+                info.replicas = []
+                info.version += 1
+            if route_prefix:
+                self._route_prefixes[route_prefix] = name
+        self._reconcile()
+
+    def delete_deployment(self, name: str) -> None:
+        with self._lock:
+            info = self._deployments.pop(name, None)
+            self._route_prefixes = {
+                p: d for p, d in self._route_prefixes.items() if d != name}
+        if info:
+            for r in info.replicas:
+                self._kill_replica(r)
+
+    def shutdown(self) -> None:
+        self._stop.set()
+        with self._lock:
+            names = list(self._deployments)
+        for n in names:
+            self.delete_deployment(n)
+
+    # -------------------------------------------------------------- routing
+
+    def get_replicas(self, name: str) -> Tuple[int, List[Any]]:
+        """(version, replica handles) — routers cache until version bumps."""
+        info = self._deployments.get(name)
+        if info is None:
+            raise KeyError(f"no deployment {name!r}")
+        return info.version, list(info.replicas)
+
+    def get_deployment_names(self) -> List[str]:
+        return list(self._deployments)
+
+    def get_route_table(self) -> Dict[str, str]:
+        return dict(self._route_prefixes)
+
+    def get_last_error(self, name: str) -> Optional[str]:
+        info = self._deployments.get(name)
+        return info.last_error if info else None
+
+    # ---------------------------------------------------------- reconcile
+
+    def _make_replica(self, info: _DeploymentInfo):
+        opts = dict(info.config.get("ray_actor_options") or {})
+        opts.setdefault("num_cpus", 0.1)
+        # Replicas serve concurrently up to max_ongoing_requests (mailbox
+        # thread pool) — required for @serve.batch to ever see a batch.
+        opts.setdefault("max_concurrency",
+                        info.config.get("max_ongoing_requests", 16))
+        cls = ray_tpu.remote(ReplicaActor).options(**opts)
+        return cls.remote(info.serialized_callable, info.init_args,
+                          info.init_kwargs, info.config.get("user_config"))
+
+    def _kill_replica(self, handle) -> None:
+        try:
+            ray_tpu.get(handle.prepare_shutdown.remote(), timeout=2.0)
+        except Exception:
+            pass
+        try:
+            ray_tpu.kill(handle)
+        except Exception:
+            pass
+
+    def _reconcile(self) -> None:
+        # Runs under _lock: deploy()/delete_deployment() on other mailbox
+        # threads mutate info.replicas and the deployments dict; an unlocked
+        # reconcile pass could resurrect just-killed old-version replicas
+        # into info.replicas without a version bump (routers would then hold
+        # dead handles until the next pass).
+        with self._lock:
+            for info in list(self._deployments.values()):
+                # Health-check existing replicas; drop the dead.
+                alive = []
+                for r in info.replicas:
+                    try:
+                        ray_tpu.get(r.check_health.remote(), timeout=10.0)
+                        alive.append(r)
+                    except Exception as e:
+                        logger.warning("replica of %s failed health check",
+                                       info.name)
+                        info.last_error = repr(e)
+                        try:
+                            ray_tpu.kill(r)
+                        except Exception:
+                            pass
+                changed = len(alive) != len(info.replicas)
+                while len(alive) < info.target_replicas:
+                    alive.append(self._make_replica(info))
+                    changed = True
+                while len(alive) > info.target_replicas:
+                    self._kill_replica(alive.pop())
+                    changed = True
+                if changed:
+                    info.replicas = alive
+                    info.version += 1
+
+    # --------------------------------------------------------- autoscaling
+
+    def _autoscale(self) -> None:
+        # Metric: per-replica EXECUTING requests (queue_len). Backlog queued
+        # in the actor mailbox beyond max_concurrency is not visible; it
+        # surfaces as sustained max-concurrency execution, which still
+        # drives upscale.
+        now = time.time()
+        with self._lock:
+            infos = list(self._deployments.values())
+        for info in infos:
+            ac = info.config.get("autoscaling_config")
+            if not ac:
+                continue
+            ongoing = 0
+            for r in list(info.replicas):
+                try:
+                    ongoing += ray_tpu.get(r.queue_len.remote(), timeout=5.0)
+                except Exception:
+                    pass
+            n = max(1, len(info.replicas))
+            per = ongoing / n
+            target = info.target_replicas
+            if per > ac["target_ongoing_requests"] and (
+                    now - info.last_scale_up >= ac["upscale_delay_s"]):
+                target = min(ac["max_replicas"], info.target_replicas + 1)
+                info.last_scale_up = now
+            elif per < ac["target_ongoing_requests"] * 0.5 and (
+                    now - info.last_scale_down >= ac["downscale_delay_s"]):
+                target = max(ac["min_replicas"], info.target_replicas - 1)
+                info.last_scale_down = now
+            info.target_replicas = target
+
+    # ------------------------------------------------------------ the loop
+
+    def _control_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self._autoscale()
+                self._reconcile()
+            except Exception:
+                logger.exception("serve control loop error")
+            self._stop.wait(1.0)
+
+    def ping(self) -> str:
+        return "pong"

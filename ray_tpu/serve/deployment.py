@@ -1,0 +1,111 @@
+"""Deployment authoring API.
+
+Parity: reference serve/api.py @serve.deployment + serve/deployment.py
+(class Deployment) and the deployment-graph build
+(serve/_private/deployment_graph_build.py): `.bind(*args)` produces an
+Application node; bound child nodes become DeploymentHandles injected into
+the parent's constructor at deploy time (model composition).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, List, Optional, Tuple, Union
+
+
+@dataclasses.dataclass
+class AutoscalingConfig:
+    """reference serve/config.py AutoscalingConfig (queue-metric driven)."""
+
+    min_replicas: int = 1
+    max_replicas: int = 4
+    target_ongoing_requests: float = 2.0
+    upscale_delay_s: float = 0.0
+    downscale_delay_s: float = 10.0
+
+
+@dataclasses.dataclass
+class DeploymentConfig:
+    num_replicas: int = 1
+    max_ongoing_requests: int = 16
+    ray_actor_options: Dict[str, Any] = dataclasses.field(
+        default_factory=dict)
+    autoscaling_config: Optional[AutoscalingConfig] = None
+    health_check_period_s: float = 10.0
+    user_config: Optional[Dict[str, Any]] = None
+
+
+class Deployment:
+    def __init__(self, func_or_class: Union[type, Callable], name: str,
+                 config: DeploymentConfig):
+        self.func_or_class = func_or_class
+        self.name = name
+        self.config = config
+
+    def options(self, **kwargs) -> "Deployment":
+        cfg = dataclasses.replace(self.config)
+        name = kwargs.pop("name", self.name)
+        for k, v in kwargs.items():
+            if not hasattr(cfg, k):
+                raise AttributeError(f"unknown deployment option {k!r}")
+            setattr(cfg, k, v)
+        return Deployment(self.func_or_class, name, cfg)
+
+    def bind(self, *args, **kwargs) -> "Application":
+        return Application(self, args, kwargs)
+
+    def __repr__(self) -> str:
+        return f"Deployment({self.name})"
+
+
+class Application:
+    """A bound deployment DAG node (reference dag/dag_node.py ClassNode)."""
+
+    def __init__(self, deployment: Deployment, args: Tuple, kwargs: Dict):
+        self.deployment = deployment
+        self.args = args
+        self.kwargs = kwargs
+
+    def _flatten(self, out: Optional[List["Application"]] = None
+                 ) -> List["Application"]:
+        """Topological list, children first."""
+        out = out if out is not None else []
+        for a in list(self.args) + list(self.kwargs.values()):
+            if isinstance(a, Application):
+                a._flatten(out)
+        if self not in out:
+            out.append(self)
+        return out
+
+
+def deployment(
+    _func_or_class: Optional[Union[type, Callable]] = None,
+    *,
+    name: Optional[str] = None,
+    num_replicas: Optional[int] = None,
+    max_ongoing_requests: Optional[int] = None,
+    ray_actor_options: Optional[Dict[str, Any]] = None,
+    autoscaling_config: Optional[Union[AutoscalingConfig, Dict]] = None,
+    user_config: Optional[Dict[str, Any]] = None,
+):
+    """@serve.deployment decorator (reference serve/api.py:deployment)."""
+
+    def wrap(fc):
+        cfg = DeploymentConfig()
+        if num_replicas is not None:
+            cfg.num_replicas = num_replicas
+        if max_ongoing_requests is not None:
+            cfg.max_ongoing_requests = max_ongoing_requests
+        if ray_actor_options is not None:
+            cfg.ray_actor_options = dict(ray_actor_options)
+        if autoscaling_config is not None:
+            ac = autoscaling_config
+            cfg.autoscaling_config = (
+                ac if isinstance(ac, AutoscalingConfig)
+                else AutoscalingConfig(**ac))
+        if user_config is not None:
+            cfg.user_config = dict(user_config)
+        return Deployment(fc, name or fc.__name__, cfg)
+
+    if _func_or_class is not None:
+        return wrap(_func_or_class)
+    return wrap

@@ -1,0 +1,179 @@
+"""DeploymentHandle + Router.
+
+Parity: reference serve/handle.py:711 (DeploymentHandle, .remote :783) →
+serve/_private/router.py:312 (Router.assign_request) →
+replica_scheduler/pow_2_scheduler.py:49 (PowerOfTwoChoicesReplicaScheduler).
+The router keeps a local in-flight counter per replica and picks the less
+loaded of two random candidates — queue-length probing without an extra
+RPC per request. Replica lists are cached and refreshed from the
+controller only when the deployment version bumps or a call fails
+(reference LongPollClient config push).
+"""
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+import ray_tpu
+
+from .controller import CONTROLLER_NAME
+
+
+class DeploymentNotFoundError(Exception):
+    """The handle's deployment no longer exists on the controller."""
+
+
+class DeploymentResponse:
+    """Future-like result of handle.remote() (reference DeploymentResponse:
+    resolves to the result; .result() blocks; ._to_object_ref for chaining)."""
+
+    def __init__(self, ref, router, replica_key):
+        self._ref = ref
+        self._router = router
+        self._replica_key = replica_key
+        self._done = False
+
+    def result(self, timeout: Optional[float] = None) -> Any:
+        try:
+            return ray_tpu.get(self._ref, timeout=timeout)
+        finally:
+            self._release()
+
+    def _release(self) -> None:
+        if not self._done:
+            self._done = True
+            self._router._on_done(self._replica_key)
+
+    def __del__(self):
+        # Fire-and-forget callers never call result(); without this the
+        # router's in-flight counter for the replica leaks permanently and
+        # power-of-two routing starves it of traffic.
+        try:
+            self._release()
+        except Exception:
+            pass
+
+    def _to_object_ref(self):
+        return self._ref
+
+
+class Router:
+    REFRESH_PERIOD_S = 3.0
+
+    def __init__(self, deployment_name: str):
+        self.name = deployment_name
+        self._lock = threading.Lock()
+        self._version = -1
+        self._replicas: List[Any] = []
+        self._inflight: Dict[str, int] = {}
+        self._controller = None
+        self._last_refresh = 0.0
+
+    def _ctrl(self):
+        if self._controller is None:
+            self._controller = ray_tpu.get_actor(CONTROLLER_NAME)
+        return self._controller
+
+    def _refresh(self, force: bool = False) -> None:
+        now = time.time()
+        with self._lock:
+            fresh = (self._replicas
+                     and now - self._last_refresh < self.REFRESH_PERIOD_S)
+            if fresh and not force:
+                return
+        try:
+            version, replicas = ray_tpu.get(
+                self._ctrl().get_replicas.remote(self.name))
+        except Exception as e:
+            if "no deployment" in str(e):
+                with self._lock:
+                    self._replicas = []
+                raise DeploymentNotFoundError(self.name) from e
+            raise
+        with self._lock:
+            self._version = version
+            self._replicas = replicas
+            self._inflight = {r._actor_id: self._inflight.get(r._actor_id, 0)
+                              for r in replicas}
+            self._last_refresh = now
+
+    def _pick(self):
+        """Power-of-two-choices over local in-flight counts."""
+        with self._lock:
+            reps = self._replicas
+            if not reps:
+                raise RuntimeError(f"no replicas for {self.name}")
+            if len(reps) == 1:
+                r = reps[0]
+            else:
+                a, b = random.sample(reps, 2)
+                r = a if (self._inflight.get(a._actor_id, 0)
+                          <= self._inflight.get(b._actor_id, 0)) else b
+            self._inflight[r._actor_id] = self._inflight.get(
+                r._actor_id, 0) + 1
+            return r
+
+    def _on_done(self, key: str) -> None:
+        with self._lock:
+            if key in self._inflight and self._inflight[key] > 0:
+                self._inflight[key] -= 1
+
+    def assign(self, method_name: str, args, kwargs,
+               retries: int = 3) -> DeploymentResponse:
+        self._refresh()
+        last_err: Optional[Exception] = None
+        for attempt in range(retries):
+            try:
+                replica = self._pick()
+            except RuntimeError as e:
+                last_err = e
+                time.sleep(0.2 * (attempt + 1))
+                self._refresh(force=True)
+                continue
+            try:
+                ref = replica.handle_request.remote(
+                    method_name, args, kwargs)
+                return DeploymentResponse(ref, self, replica._actor_id)
+            except Exception as e:  # dead replica: drop + refresh
+                last_err = e
+                self._on_done(replica._actor_id)
+                self._refresh(force=True)
+        raise RuntimeError(
+            f"could not assign request to {self.name}: {last_err}")
+
+
+class DeploymentHandle:
+    def __init__(self, deployment_name: str, method_name: str = "__call__"):
+        self.deployment_name = deployment_name
+        self._method_name = method_name
+        self._router: Optional[Router] = None
+
+    # Routers hold runtime state; rebuild lazily after pickling (handles are
+    # injected into replica constructors for composition).
+    def __getstate__(self):
+        return {"deployment_name": self.deployment_name,
+                "_method_name": self._method_name}
+
+    def __setstate__(self, state):
+        self.deployment_name = state["deployment_name"]
+        self._method_name = state["_method_name"]
+        self._router = None
+
+    def options(self, *, method_name: str) -> "DeploymentHandle":
+        return DeploymentHandle(self.deployment_name, method_name)
+
+    @property
+    def method(self):
+        return self._method_name
+
+    def __getattr__(self, name: str):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return DeploymentHandle(self.deployment_name, name)
+
+    def remote(self, *args, **kwargs) -> DeploymentResponse:
+        if self._router is None:
+            self._router = Router(self.deployment_name)
+        return self._router.assign(self._method_name, args, kwargs)

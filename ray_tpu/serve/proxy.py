@@ -1,0 +1,115 @@
+"""HTTP proxy: route prefix -> deployment handle.
+
+Parity: reference serve/_private/proxy.py:1112 (ProxyActor, HTTPProxy :748
+ASGI). An aiohttp server runs on a dedicated thread (inside the driver or a
+proxy actor); requests route by longest-prefix match against the
+controller's route table and dispatch through the same DeploymentHandle /
+power-of-two router as Python callers. JSON in/out; non-JSON bodies pass
+through as text.
+"""
+from __future__ import annotations
+
+import asyncio
+import json
+import threading
+from typing import Any, Dict, Optional
+
+import ray_tpu
+
+from .controller import CONTROLLER_NAME
+from .handle import DeploymentHandle, DeploymentNotFoundError
+
+
+class HTTPProxy:
+    def __init__(self, host: str = "127.0.0.1", port: int = 8000):
+        self.host = host
+        self.port = port
+        self._handles: Dict[str, DeploymentHandle] = {}
+        self._routes: Dict[str, str] = {}
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self._started = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._runner = None
+
+    # ----------------------------------------------------------------- serve
+
+    def start(self) -> None:
+        self._thread.start()
+        if not self._started.wait(10):
+            raise RuntimeError("HTTP proxy failed to start")
+
+    def _refresh_routes(self) -> None:
+        ctrl = ray_tpu.get_actor(CONTROLLER_NAME)
+        self._routes = ray_tpu.get(ctrl.get_route_table.remote())
+
+    def _match(self, path: str) -> Optional[str]:
+        best = None
+        for prefix, name in self._routes.items():
+            if path == prefix or path.startswith(prefix.rstrip("/") + "/") \
+                    or prefix == "/":
+                if best is None or len(prefix) > len(best[0]):
+                    best = (prefix, name)
+        return best[1] if best else None
+
+    async def _handle(self, request):
+        from aiohttp import web
+
+        name = self._match(request.path)
+        if name is None:
+            self._refresh_routes()
+            name = self._match(request.path)
+        if name is None:
+            return web.json_response(
+                {"error": f"no route for {request.path}"}, status=404)
+        if request.method == "GET":
+            arg: Any = dict(request.query)
+        else:
+            body = await request.read()
+            try:
+                arg = json.loads(body) if body else None
+            except json.JSONDecodeError:
+                arg = body.decode()
+        handle = self._handles.setdefault(name, DeploymentHandle(name))
+        try:
+            resp = await asyncio.get_running_loop().run_in_executor(
+                None, lambda: handle.remote(arg).result(timeout=60))
+        except DeploymentNotFoundError:
+            # Deployment was deleted: drop the stale route + handle.
+            self._handles.pop(name, None)
+            self._refresh_routes()
+            return web.json_response(
+                {"error": f"deployment {name} not found"}, status=404)
+        except Exception as e:
+            return web.json_response({"error": str(e)}, status=500)
+        if isinstance(resp, (dict, list, int, float, bool)) or resp is None:
+            return web.json_response({"result": resp})
+        return web.Response(text=str(resp))
+
+    def _run(self) -> None:
+        from aiohttp import web
+
+        self._loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(self._loop)
+        app = web.Application()
+        app.router.add_route("*", "/{tail:.*}", self._handle)
+        self._runner = web.AppRunner(app)
+
+        async def _start():
+            await self._runner.setup()
+            site = web.TCPSite(self._runner, self.host, self.port)
+            await site.start()
+
+        self._loop.run_until_complete(_start())
+        self._started.set()
+        self._loop.run_forever()
+
+    def stop(self) -> None:
+        if self._loop is None:
+            return
+
+        async def _cleanup():
+            await self._runner.cleanup()
+            self._loop.stop()
+
+        asyncio.run_coroutine_threadsafe(_cleanup(), self._loop)
+        self._thread.join(timeout=5)

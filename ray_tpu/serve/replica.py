@@ -1,0 +1,74 @@
+"""ReplicaActor: hosts one copy of the user's deployment callable.
+
+Parity: reference serve/_private/replica.py:231 (ReplicaActor,
+UserCallableWrapper :737): constructs the user class (or wraps the
+function), executes requests, tracks ongoing-request count for the
+power-of-two router and the autoscaler, and exposes health checks +
+user_config reconfiguration.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import cloudpickle
+
+
+class ReplicaActor:
+    def __init__(self, serialized_callable: bytes, init_args: Tuple,
+                 init_kwargs: Dict, user_config: Optional[Dict] = None):
+        func_or_class = cloudpickle.loads(serialized_callable)
+        if isinstance(func_or_class, type):
+            self._callable = func_or_class(*init_args, **init_kwargs)
+            self._is_function = False
+        else:
+            self._callable = func_or_class
+            self._is_function = True
+        self._ongoing = 0
+        self._total = 0
+        self._lock = threading.Lock()
+        if user_config is not None:
+            self.reconfigure(user_config)
+
+    # ---------------------------------------------------------------- serving
+
+    def handle_request(self, method_name: str, args: Tuple, kwargs: Dict):
+        with self._lock:
+            self._ongoing += 1
+            self._total += 1
+        try:
+            if self._is_function:
+                return self._callable(*args, **kwargs)
+            if method_name == "__call__":
+                return self._callable(*args, **kwargs)
+            return getattr(self._callable, method_name)(*args, **kwargs)
+        finally:
+            with self._lock:
+                self._ongoing -= 1
+
+    # ----------------------------------------------------------------- state
+
+    def queue_len(self) -> int:
+        return self._ongoing
+
+    def stats(self) -> Dict[str, int]:
+        return {"ongoing": self._ongoing, "total": self._total}
+
+    def check_health(self) -> bool:
+        user_check = getattr(self._callable, "check_health", None)
+        if callable(user_check):
+            user_check()
+        return True
+
+    def reconfigure(self, user_config: Dict) -> None:
+        fn = getattr(self._callable, "reconfigure", None)
+        if callable(fn):
+            fn(user_config)
+
+    def prepare_shutdown(self) -> None:
+        fn = getattr(self._callable, "__del__", None)
+        if callable(fn):
+            try:
+                fn()
+            except Exception:
+                pass

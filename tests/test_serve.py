@@ -1,0 +1,166 @@
+"""Serve tests: deployments, handles, composition, batching, HTTP ingress,
+replica recovery (reference test model: most serve tests run against a real
+local instance, SURVEY.md §4.3)."""
+import json
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+
+
+@pytest.fixture(scope="module")
+def serve_instance():
+    ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
+    yield
+    serve.shutdown()
+    ray_tpu.shutdown()
+
+
+def test_function_deployment_and_handle(serve_instance):
+    @serve.deployment
+    def doubler(x):
+        return x * 2
+
+    handle = serve.run(doubler.bind(), route_prefix="/doubler")
+    assert handle.remote(21).result(timeout=30) == 42
+    # parallel requests
+    resps = [handle.remote(i) for i in range(8)]
+    assert [r.result(timeout=30) for r in resps] == [i * 2 for i in range(8)]
+
+
+def test_class_deployment_with_replicas(serve_instance):
+    @serve.deployment(num_replicas=2)
+    class Counter:
+        def __init__(self, start):
+            self.start = start
+
+        def __call__(self, x):
+            return self.start + x
+
+        def which(self):
+            import os
+
+            return os.getpid()
+
+    handle = serve.run(Counter.bind(100), route_prefix="/counter")
+    assert handle.remote(5).result(timeout=30) == 105
+    # two replicas -> requests spread over two processes eventually
+    pids = {handle.which.remote().result(timeout=30) for _ in range(20)}
+    assert len(pids) == 2
+    assert serve.status()["Counter"]["num_replicas"] == 2
+
+
+def test_model_composition(serve_instance):
+    @serve.deployment
+    class Preprocess:
+        def __call__(self, x):
+            return x + 1
+
+    @serve.deployment
+    class Ensemble:
+        def __init__(self, pre_handle):
+            self.pre = pre_handle
+
+        def __call__(self, x):
+            y = self.pre.remote(x).result(timeout=30)
+            return y * 10
+
+    app = Ensemble.bind(Preprocess.bind())
+    handle = serve.run(app, route_prefix="/ensemble")
+    assert handle.remote(4).result(timeout=60) == 50
+
+
+def test_batching(serve_instance):
+    @serve.deployment(max_ongoing_requests=16)
+    class BatchModel:
+        def __init__(self):
+            self.batch_sizes = []
+
+        @serve.batch(max_batch_size=8, batch_wait_timeout_s=1.5)
+        def handle_batch(self, items):
+            self.batch_sizes.append(len(items))
+            return [i * 3 for i in items]
+
+        def __call__(self, x):
+            return self.handle_batch(x)
+
+        def seen_batches(self):
+            return self.batch_sizes
+
+    handle = serve.run(BatchModel.bind(), route_prefix="/batch")
+    resps = [handle.remote(i) for i in range(8)]
+    assert [r.result(timeout=30) for r in resps] == [i * 3 for i in range(8)]
+    sizes = handle.seen_batches.remote().result(timeout=30)
+    assert max(sizes) > 1, f"batching never coalesced: {sizes}"
+
+
+def test_http_proxy(serve_instance):
+    @serve.deployment
+    def echo(payload):
+        return {"got": payload}
+
+    serve.run(echo.bind(), route_prefix="/echo", _http=True, http_port=8123)
+    req = urllib.request.Request(
+        "http://127.0.0.1:8123/echo", data=json.dumps({"a": 1}).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=30) as resp:
+        body = json.loads(resp.read())
+    assert body == {"result": {"got": {"a": 1}}}
+    # 404 for unknown route
+    try:
+        urllib.request.urlopen("http://127.0.0.1:8123/nope", timeout=30)
+        assert False, "expected 404"
+    except urllib.error.HTTPError as e:
+        assert e.code == 404
+
+
+def test_autoscaling_up(serve_instance):
+    @serve.deployment(
+        max_ongoing_requests=32,
+        autoscaling_config={"min_replicas": 1, "max_replicas": 3,
+                            "target_ongoing_requests": 2.0,
+                            "upscale_delay_s": 0.0,
+                            "downscale_delay_s": 60.0})
+    class Slow:
+        def __call__(self, x):
+            time.sleep(0.4)
+            return x
+
+    handle = serve.run(Slow.bind(), route_prefix="/slow")
+    # Sustained concurrent load >> target_ongoing_requests per replica.
+    t_end = time.time() + 8
+    grew = False
+    while time.time() < t_end and not grew:
+        resps = [handle.remote(i) for i in range(12)]
+        for r in resps:
+            r.result(timeout=30)
+        grew = serve.status()["Slow"]["num_replicas"] > 1
+    assert grew, "autoscaler never scaled up under sustained load"
+
+
+def test_replica_recovery(serve_instance):
+    @serve.deployment(num_replicas=1)
+    def stable(x):
+        return x
+
+    handle = serve.run(stable.bind(), route_prefix="/stable")
+    assert handle.remote(1).result(timeout=30) == 1
+    # Kill the replica out from under the controller.
+    ctrl = ray_tpu.get_actor("SERVE_CONTROLLER")
+    _, reps = ray_tpu.get(ctrl.get_replicas.remote("stable"))
+    ray_tpu.kill(reps[0])
+    # The control loop (1s period) must restore a replica; requests retry.
+    deadline = time.time() + 30
+    ok = False
+    while time.time() < deadline:
+        try:
+            if handle.remote(2).result(timeout=10) == 2:
+                ok = True
+                break
+        except Exception:
+            time.sleep(0.5)
+    assert ok, "deployment did not recover after replica kill"
