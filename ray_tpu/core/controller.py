@@ -35,7 +35,7 @@ from typing import Any, Dict, List, Optional, Set, Tuple
 
 from . import protocol
 from .ids import ActorID, NodeID, ObjectID, PlacementGroupID, TaskID, WorkerID
-from .object_store import ObjectLocation, free_segment
+from .object_store import ObjectLocation, free_location
 
 # Worker processes a node may grow to (the reference caps via resources; this
 # is a backstop against runaway spawning on the 1-CPU CI host).
@@ -143,6 +143,12 @@ class Controller:
         self._sched_task: Optional[asyncio.Task] = None
         self._closing = False
         self.start_time = time.time()
+        # Node-wide native object arena (plasma-equivalent, src/store).
+        # Created here so worker spawns inherit RTPU_ARENA via env; falls
+        # back to per-object segments when the native lib is unavailable.
+        from . import native_store
+
+        self._arena = native_store.create_node_arena(uuid.uuid4().hex)
 
     # ------------------------------------------------------------------ setup
 
@@ -185,9 +191,11 @@ class Controller:
                 except Exception:
                     pass
         for loc in self.objects.values():
-            if loc.shm_name:
-                free_segment(loc.shm_name)
+            free_location(loc)
         self.objects.clear()
+        from . import native_store
+
+        native_store.close_arena(destroy=True)
         if self._sched_task is not None:
             self._sched_task.cancel()
         if self.server is not None:
@@ -356,8 +364,8 @@ class Controller:
     async def _h_free_objects(self, conn, msg):
         for oid in msg["object_ids"]:
             loc = self.objects.pop(oid, None)
-            if loc is not None and loc.shm_name:
-                free_segment(loc.shm_name)
+            if loc is not None:
+                free_location(loc)
         return {"ok": True}
 
     async def _h_register_function(self, conn, msg):

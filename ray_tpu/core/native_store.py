@@ -1,0 +1,258 @@
+"""ctypes binding to the native shared-memory object arena.
+
+Role-equivalent to the reference's plasma client (ray:
+python/ray/_private: plasma usage via CoreWorkerPlasmaStoreProvider,
+src/ray/object_manager/plasma/client.cc), minus the store daemon: every
+process maps the same arena and calls into librtpu_store.so directly; the
+robust in-arena mutex replaces the client/server socket protocol.
+
+The library is built on demand from src/store (g++, no deps) and cached in
+ray_tpu/_native/. Everything degrades gracefully: if the toolchain or the
+arena is unavailable, callers fall back to the per-object SharedMemory path
+in object_store.py.
+"""
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import threading
+from typing import Dict, Optional, Tuple
+
+logger = logging.getLogger(__name__)
+
+_PKG_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_LIB_PATH = os.path.join(_PKG_ROOT, "_native", "librtpu_store.so")
+_SRC_DIR = os.path.join(os.path.dirname(_PKG_ROOT), "src", "store")
+
+_lib = None
+_lib_lock = threading.Lock()
+
+
+def _build() -> bool:
+    if not os.path.isdir(_SRC_DIR):
+        return False
+    try:
+        subprocess.run(["make", "-s"], cwd=_SRC_DIR, check=True,
+                       capture_output=True, timeout=120)
+        return os.path.exists(_LIB_PATH)
+    except Exception as e:
+        logger.warning("native store build failed: %r", e)
+        return False
+
+
+def load_library():
+    """Load (building if needed) the native library; None if unavailable."""
+    global _lib
+    if _lib is not None:
+        return _lib
+    with _lib_lock:
+        if _lib is not None:
+            return _lib
+        if not os.path.exists(_LIB_PATH) and not _build():
+            return None
+        try:
+            lib = ctypes.CDLL(_LIB_PATH)
+        except OSError as e:
+            logger.warning("native store load failed: %r", e)
+            return None
+        lib.rtpu_store_create.restype = ctypes.c_void_p
+        lib.rtpu_store_create.argtypes = [ctypes.c_char_p, ctypes.c_uint64]
+        lib.rtpu_store_attach.restype = ctypes.c_void_p
+        lib.rtpu_store_attach.argtypes = [ctypes.c_char_p]
+        lib.rtpu_store_base.restype = ctypes.c_void_p
+        lib.rtpu_store_base.argtypes = [ctypes.c_void_p]
+        lib.rtpu_store_alloc.restype = ctypes.c_uint64
+        lib.rtpu_store_alloc.argtypes = [ctypes.c_void_p, ctypes.c_uint64,
+                                         ctypes.c_uint64]
+        lib.rtpu_store_seal.restype = ctypes.c_int
+        lib.rtpu_store_seal.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+        lib.rtpu_store_get.restype = ctypes.c_uint64
+        lib.rtpu_store_get.argtypes = [ctypes.c_void_p, ctypes.c_uint64,
+                                       ctypes.POINTER(ctypes.c_uint64)]
+        lib.rtpu_store_release.restype = ctypes.c_int
+        lib.rtpu_store_release.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+        lib.rtpu_store_delete.restype = ctypes.c_int
+        lib.rtpu_store_delete.argtypes = [ctypes.c_void_p, ctypes.c_uint64,
+                                          ctypes.c_int]
+        lib.rtpu_store_contains.restype = ctypes.c_int
+        lib.rtpu_store_contains.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+        lib.rtpu_store_stats.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint64),
+            ctypes.POINTER(ctypes.c_uint64), ctypes.POINTER(ctypes.c_uint64)]
+        lib.rtpu_store_detach.argtypes = [ctypes.c_void_p]
+        lib.rtpu_store_unlink.restype = ctypes.c_int
+        lib.rtpu_store_unlink.argtypes = [ctypes.c_char_p]
+        _lib = lib
+        return _lib
+
+
+class NativeArena:
+    """One mapped arena (create or attach)."""
+
+    def __init__(self, name: str, handle: int, lib, owner: bool):
+        self.name = name
+        self._h = handle
+        self._lib = lib
+        self._owner = owner
+        self._base = lib.rtpu_store_base(handle)
+        self._lock = threading.Lock()
+
+    # ------------------------------------------------------------- factory
+
+    @classmethod
+    def create(cls, name: str, size: int) -> Optional["NativeArena"]:
+        lib = load_library()
+        if lib is None:
+            return None
+        h = lib.rtpu_store_create(name.encode(), size)
+        if not h:
+            return None
+        return cls(name, h, lib, owner=True)
+
+    @classmethod
+    def attach(cls, name: str) -> Optional["NativeArena"]:
+        lib = load_library()
+        if lib is None:
+            return None
+        h = lib.rtpu_store_attach(name.encode())
+        if not h:
+            return None
+        return cls(name, h, lib, owner=False)
+
+    # ------------------------------------------------------------- objects
+
+    def create_object(self, oid: int, size: int) -> Optional[memoryview]:
+        """Writable view of a newly allocated (unsealed) object."""
+        off = self._lib.rtpu_store_alloc(self._h, oid, size)
+        if not off:
+            return None
+        buf = (ctypes.c_char * size).from_address(self._base + off)
+        return memoryview(buf).cast("B")
+
+    def seal(self, oid: int) -> bool:
+        return self._lib.rtpu_store_seal(self._h, oid) == 0
+
+    def get(self, oid: int) -> Optional[memoryview]:
+        """Read view of a sealed object; pins it until release(oid)."""
+        size = ctypes.c_uint64()
+        off = self._lib.rtpu_store_get(self._h, oid, ctypes.byref(size))
+        if not off:
+            return None
+        buf = (ctypes.c_char * size.value).from_address(self._base + off)
+        return memoryview(buf).cast("B")
+
+    def release(self, oid: int) -> None:
+        self._lib.rtpu_store_release(self._h, oid)
+
+    def delete(self, oid: int, force: bool = False) -> bool:
+        return self._lib.rtpu_store_delete(self._h, oid, int(force)) == 0
+
+    def contains(self, oid: int) -> bool:
+        return bool(self._lib.rtpu_store_contains(self._h, oid))
+
+    def stats(self) -> Dict[str, int]:
+        used = ctypes.c_uint64()
+        cap = ctypes.c_uint64()
+        n = ctypes.c_uint64()
+        self._lib.rtpu_store_stats(self._h, ctypes.byref(used),
+                                   ctypes.byref(cap), ctypes.byref(n))
+        return {"used": used.value, "capacity": cap.value,
+                "num_objects": n.value}
+
+    # ------------------------------------------------------------ lifetime
+
+    def detach(self) -> None:
+        if self._h:
+            self._lib.rtpu_store_detach(self._h)
+            self._h = 0
+
+    def destroy(self) -> None:
+        """Unmap and unlink the arena (owner/controller only)."""
+        name = self.name
+        self.detach()
+        try:
+            self._lib.rtpu_store_unlink(name.encode())
+        except Exception:
+            pass
+
+
+# ------------------------------------------------------- per-process state
+
+_arena: Optional[NativeArena] = None
+_attached: Dict[str, NativeArena] = {}  # arenas attached by explicit name
+_arena_state_lock = threading.Lock()
+_ARENA_ENV = "RTPU_ARENA"
+_ARENA_SIZE_ENV = "RTPU_ARENA_SIZE"
+DEFAULT_ARENA_SIZE = 256 * 1024 * 1024
+
+
+def arena_name_for_node(node_id: str) -> str:
+    return f"/rtpu_arena_{node_id[:24]}"
+
+
+def create_node_arena(node_id: str) -> Optional[NativeArena]:
+    """Controller-side: create this node's arena and advertise it via env
+    (workers inherit the env at spawn)."""
+    global _arena
+    if os.environ.get("RTPU_NATIVE_STORE", "1") != "1":
+        return None
+    with _arena_state_lock:
+        if _arena is not None:
+            return _arena
+        size = int(os.environ.get(_ARENA_SIZE_ENV, DEFAULT_ARENA_SIZE))
+        name = arena_name_for_node(node_id)
+        arena = NativeArena.create(name, size)
+        if arena is None:
+            # Stale arena from a crashed run: unlink and retry once.
+            lib = load_library()
+            if lib is not None:
+                lib.rtpu_store_unlink(name.encode())
+                arena = NativeArena.create(name, size)
+        if arena is not None:
+            os.environ[_ARENA_ENV] = name
+            _arena = arena
+        return arena
+
+
+def get_arena() -> Optional[NativeArena]:
+    """Worker/driver-side: attach to the node's arena if advertised."""
+    global _arena
+    if _arena is not None:
+        return _arena
+    name = os.environ.get(_ARENA_ENV)
+    if not name or os.environ.get("RTPU_NATIVE_STORE", "1") != "1":
+        return None
+    with _arena_state_lock:
+        if _arena is None:
+            _arena = NativeArena.attach(name)
+        return _arena
+
+
+def attach_named(name: str) -> Optional[NativeArena]:
+    """Attach (and cache) an arena by explicit shm name — the path for
+    processes that didn't inherit RTPU_ARENA (e.g. a driver connecting to an
+    existing cluster): the ObjectLocation carries the arena name."""
+    with _arena_state_lock:
+        if _arena is not None and _arena.name == name:
+            return _arena
+        a = _attached.get(name)
+        if a is None:
+            a = NativeArena.attach(name)
+            if a is not None:
+                _attached[name] = a
+        return a
+
+
+def close_arena(destroy: bool = False) -> None:
+    global _arena
+    with _arena_state_lock:
+        if _arena is None:
+            return
+        if destroy:
+            _arena.destroy()
+        else:
+            _arena.detach()
+        _arena = None
+        os.environ.pop(_ARENA_ENV, None)

@@ -47,7 +47,8 @@ def _untrack(name: str) -> None:
 
 @dataclass
 class ObjectLocation:
-    """Where an object's bytes live. Exactly one of `inline` / `shm_name` set."""
+    """Where an object's bytes live. Exactly one of `inline` / `shm_name` /
+    `arena` is set."""
 
     object_id: str
     size: int
@@ -55,11 +56,16 @@ class ObjectLocation:
     shm_name: Optional[str] = None
     node_id: Optional[str] = None
     is_error: bool = False
-    # Buffer table for out-of-band pickle5 buffers: (offset, length) pairs.
+    # Buffer table for out-of-band pickle5 buffers: (offset, length) pairs,
+    # relative to the object's data region.
     buffers: List[Tuple[int, int]] = field(default_factory=list)
-    # Offset of the pickle stream inside the segment.
+    # Offset of the pickle stream inside the segment / arena object.
     pickle_off: int = 0
     pickle_len: int = 0
+    # Native arena placement (C++ store, native_store.py): the arena's shm
+    # name + the object's 64-bit id within it.
+    arena: Optional[str] = None
+    arena_oid: int = 0
 
 
 def serialize(value: Any) -> Tuple[bytes, List[pickle.PickleBuffer]]:
@@ -70,7 +76,8 @@ def serialize(value: Any) -> Tuple[bytes, List[pickle.PickleBuffer]]:
 
 
 def put_bytes(value: Any, object_id: str, node_id: str) -> ObjectLocation:
-    """Serialize `value`; inline small results, spill large ones to shm."""
+    """Serialize `value`; inline small results, spill large ones to the
+    native arena (preferred) or a per-object shm segment (fallback)."""
     data, oob = serialize(value)
     total = len(data) + sum(len(b.raw()) for b in oob)
     if total <= INLINE_THRESHOLD:
@@ -78,6 +85,10 @@ def put_bytes(value: Any, object_id: str, node_id: str) -> ObjectLocation:
         if oob:
             data = pickle.dumps(value, protocol=pickle.HIGHEST_PROTOCOL)
         return ObjectLocation(object_id=object_id, size=len(data), inline=data, node_id=node_id)
+
+    loc = _put_arena(data, oob, total, object_id, node_id)
+    if loc is not None:
+        return loc
 
     # Layout: [pickle stream][buf0][buf1]... with a location-table in metadata.
     name = "rtpu_" + secrets.token_hex(8)
@@ -106,6 +117,42 @@ def put_bytes(value: Any, object_id: str, node_id: str) -> ObjectLocation:
     )
     seg.close()
     return loc
+
+
+def _arena_oid(object_id: str) -> int:
+    oid = int(object_id[:15], 16) if object_id else 0
+    return oid or 1
+
+
+def _put_arena(data, oob, total, object_id, node_id) -> Optional[ObjectLocation]:
+    """Write into the node's native arena; None -> caller falls back."""
+    from . import native_store
+
+    arena = native_store.get_arena()
+    if arena is None:
+        return None
+    oid = _arena_oid(object_id)
+    view = arena.create_object(oid, total)
+    if view is None:  # arena OOM / oid collision
+        return None
+    off = 0
+    view[off:off + len(data)] = data
+    pickle_off, pickle_len = off, len(data)
+    off += len(data)
+    table: List[Tuple[int, int]] = []
+    for b in oob:
+        raw = b.raw()
+        n = raw.nbytes
+        view[off:off + n] = raw
+        table.append((off, n))
+        off += n
+        b.release()
+    del view
+    arena.seal(oid)
+    return ObjectLocation(
+        object_id=object_id, size=total, node_id=node_id,
+        buffers=table, pickle_off=pickle_off, pickle_len=pickle_len,
+        arena=arena.name, arena_oid=oid)
 
 
 class _SegmentCache:
@@ -151,6 +198,8 @@ def get_bytes(loc: ObjectLocation, copy: bool = True) -> Any:
     """
     if loc.inline is not None:
         return pickle.loads(loc.inline)
+    if loc.arena is not None:
+        return _get_arena_bytes(loc, copy)
     assert loc.shm_name is not None
     seg = _segments.attach(loc.shm_name)
     data = bytes(seg.buf[loc.pickle_off : loc.pickle_off + loc.pickle_len])
@@ -159,6 +208,54 @@ def get_bytes(loc: ObjectLocation, copy: bool = True) -> Any:
         view = seg.buf[off : off + n]
         bufs.append(bytes(view) if copy else view)
     return pickle.loads(data, buffers=bufs)
+
+
+def _get_arena_bytes(loc: ObjectLocation, copy: bool) -> Any:
+    from . import native_store
+
+    arena = native_store.get_arena()
+    if arena is None or (arena.name != loc.arena):
+        # Didn't inherit RTPU_ARENA (driver attached to an existing
+        # cluster): the location itself names the arena — attach directly.
+        arena = native_store.attach_named(loc.arena)
+    if arena is None:
+        raise RuntimeError(
+            f"object {loc.object_id} lives in arena {loc.arena!r} which this "
+            f"process could not attach")
+    view = arena.get(loc.arena_oid)
+    if view is None:
+        raise KeyError(f"object {loc.object_id} missing from arena "
+                       f"(freed under a zero-copy reader?)")
+    try:
+        data = bytes(view[loc.pickle_off:loc.pickle_off + loc.pickle_len])
+        bufs = []
+        for off, n in loc.buffers:
+            b = view[off:off + n]
+            bufs.append(b if not copy else bytes(b))
+        value = pickle.loads(data, buffers=bufs)
+    finally:
+        if copy:
+            del bufs, view
+            arena.release(loc.arena_oid)
+        # copy=False: the pin stays — the object can't be reclaimed while
+        # this process may still alias it (released at process exit; the
+        # controller can force-delete, same contract as plasma).
+    return value
+
+
+def free_location(loc: ObjectLocation) -> None:
+    """Free an object's storage, whichever backend holds it."""
+    if loc.arena is not None:
+        from . import native_store
+
+        arena = native_store.get_arena()
+        if arena is None or arena.name != loc.arena:
+            arena = native_store.attach_named(loc.arena)
+        if arena is not None:
+            arena.delete(loc.arena_oid)
+        return
+    if loc.shm_name:
+        free_segment(loc.shm_name)
 
 
 def free_segment(shm_name: str) -> None:

@@ -108,6 +108,23 @@ class IMPALA(Algorithm):
             except Exception:
                 manager._healthy[i] = False
 
+    def _heal_and_arm(self, manager, cfg) -> None:
+        """Every step: restore what can be restored and (re)arm any healthy
+        runner with no in-flight sample. This is the ONLY reliable recovery
+        trigger — a runner that died outside the drain path (e.g. during a
+        weight broadcast) has no pending ref to error and would otherwise
+        silently drop out of the rotation forever."""
+        manager.restore_unhealthy()
+        armed = set(self._inflight.values())
+        idle = [i for i in manager.healthy_actor_ids() if i not in armed]
+        if idle:
+            # Unarmed runners may be fresh restores: give them weights first.
+            weights = self.learner_group.get_weights()
+            ok = {i for i, _ in manager.foreach_actor(
+                "set_weights", weights, actor_ids=idle)}
+            self._arm(manager, [i for i in idle if i in ok],
+                      cfg.rollout_fragment_length)
+
     def _update_from_episodes(self, episodes) -> Dict[str, float]:
         cfg = self._algo_config
         self._record_episodes(episodes)
@@ -141,11 +158,7 @@ class IMPALA(Algorithm):
 
         # Async path: keep every healthy runner armed with one in-flight
         # sample; drain ready futures and update.
-        if not self._inflight:
-            self.env_runner_group.sync_weights(
-                self.learner_group.get_weights())
-            self._arm(manager, manager.healthy_actor_ids(),
-                      cfg.rollout_fragment_length)
+        self._heal_and_arm(manager, cfg)
         done_updates = 0
         while done_updates < cfg.updates_per_step and self._inflight:
             ready, _ = ray_tpu.wait(list(self._inflight.keys()),
@@ -157,21 +170,12 @@ class IMPALA(Algorithm):
             try:
                 episodes = ray_tpu.get(ref)
             except Exception:
+                # Don't re-arm the dead handle here (busy-loop on
+                # ActorDiedError once past the restart budget); the
+                # _heal_and_arm pass at the next training_step restores
+                # and re-arms whatever is restorable.
                 manager._healthy[actor_id] = False
-                before = set(manager.healthy_actor_ids())
-                manager.restore_unhealthy()
-                # Re-arm ONLY actually-restored runners (fresh actors need
-                # weights first or sample() asserts); a runner past its
-                # restart budget stays un-armed — re-arming its dead handle
-                # would busy-loop on ActorDiedError forever.
-                restored = [i for i in manager.healthy_actor_ids()
-                            if i not in before]
-                if restored:
-                    manager.foreach_actor(
-                        "set_weights", self.learner_group.get_weights(),
-                        actor_ids=restored)
-                    self._arm(manager, restored,
-                              cfg.rollout_fragment_length)
+                self._heal_and_arm(manager, cfg)
                 continue
             metrics = self._update_from_episodes(episodes)
             done_updates += 1

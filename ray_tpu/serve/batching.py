@@ -11,6 +11,7 @@ from __future__ import annotations
 import functools
 import queue
 import threading
+import time
 from typing import Any, Callable, List, Optional
 
 
@@ -37,9 +38,6 @@ class _Batcher:
             item, slot = self._queue.get()
             batch = [(item, slot)]
             # Coalesce: wait up to timeout_s for more, cap at max size.
-            deadline = threading.Event()
-            import time
-
             t_end = time.time() + self.timeout_s
             while len(batch) < self.max_batch_size:
                 remaining = t_end - time.time()

@@ -37,9 +37,11 @@ class _DeploymentInfo:
         self.replicas: List[Any] = []  # ActorHandles
         self.version = 0
         self.last_error: Optional[str] = None
-        # autoscaling bookkeeping
-        self.last_scale_up = 0.0
-        self.last_scale_down = 0.0
+        # autoscaling bookkeeping: when the metric FIRST crossed the
+        # threshold (None = currently below it) — delays require sustained
+        # load, not merely time-since-last-event.
+        self.above_since: Optional[float] = None
+        self.below_since: Optional[float] = None
 
 
 class ServeController:
@@ -136,28 +138,36 @@ class ServeController:
             pass
 
     def _reconcile(self) -> None:
-        # Runs under _lock: deploy()/delete_deployment() on other mailbox
-        # threads mutate info.replicas and the deployments dict; an unlocked
-        # reconcile pass could resurrect just-killed old-version replicas
-        # into info.replicas without a version bump (routers would then hold
-        # dead handles until the next pass).
+        # Snapshot under _lock, health-check OUTSIDE it (a hung replica
+        # costs a 10s RPC timeout; holding the lock through that would stall
+        # every deploy/delete), then re-acquire and commit only if the
+        # deployment wasn't concurrently redeployed — otherwise a stale pass
+        # could resurrect just-killed old-version replicas.
         with self._lock:
-            for info in list(self._deployments.values()):
-                # Health-check existing replicas; drop the dead.
-                alive = []
-                for r in info.replicas:
+            snapshot = [(info, list(info.replicas)) for info in
+                        self._deployments.values()]
+        for info, replicas in snapshot:
+            alive = []
+            dead = []
+            for r in replicas:
+                try:
+                    ray_tpu.get(r.check_health.remote(), timeout=10.0)
+                    alive.append(r)
+                except Exception as e:
+                    logger.warning("replica of %s failed health check",
+                                   info.name)
+                    info.last_error = repr(e)
+                    dead.append(r)
+            with self._lock:
+                if (self._deployments.get(info.name) is not info
+                        or info.replicas != replicas):
+                    continue  # redeployed/deleted meanwhile: skip this pass
+                for r in dead:
                     try:
-                        ray_tpu.get(r.check_health.remote(), timeout=10.0)
-                        alive.append(r)
-                    except Exception as e:
-                        logger.warning("replica of %s failed health check",
-                                       info.name)
-                        info.last_error = repr(e)
-                        try:
-                            ray_tpu.kill(r)
-                        except Exception:
-                            pass
-                changed = len(alive) != len(info.replicas)
+                        ray_tpu.kill(r)
+                    except Exception:
+                        pass
+                changed = len(alive) != len(replicas)
                 while len(alive) < info.target_replicas:
                     alive.append(self._make_replica(info))
                     changed = True
@@ -191,14 +201,25 @@ class ServeController:
             n = max(1, len(info.replicas))
             per = ongoing / n
             target = info.target_replicas
-            if per > ac["target_ongoing_requests"] and (
-                    now - info.last_scale_up >= ac["upscale_delay_s"]):
-                target = min(ac["max_replicas"], info.target_replicas + 1)
-                info.last_scale_up = now
-            elif per < ac["target_ongoing_requests"] * 0.5 and (
-                    now - info.last_scale_down >= ac["downscale_delay_s"]):
-                target = max(ac["min_replicas"], info.target_replicas - 1)
-                info.last_scale_down = now
+            if per > ac["target_ongoing_requests"]:
+                info.below_since = None
+                if info.above_since is None:
+                    info.above_since = now
+                if now - info.above_since >= ac["upscale_delay_s"]:
+                    target = min(ac["max_replicas"],
+                                 info.target_replicas + 1)
+                    info.above_since = now  # next step needs a fresh window
+            elif per < ac["target_ongoing_requests"] * 0.5:
+                info.above_since = None
+                if info.below_since is None:
+                    info.below_since = now
+                if now - info.below_since >= ac["downscale_delay_s"]:
+                    target = max(ac["min_replicas"],
+                                 info.target_replicas - 1)
+                    info.below_since = now
+            else:
+                info.above_since = None
+                info.below_since = None
             info.target_replicas = target
 
     # ------------------------------------------------------------ the loop

@@ -162,18 +162,32 @@ class DeploymentHandle:
         self._router = None
 
     def options(self, *, method_name: str) -> "DeploymentHandle":
-        return DeploymentHandle(self.deployment_name, method_name)
+        h = DeploymentHandle(self.deployment_name, method_name)
+        h._router = self._ensure_router()
+        return h
 
     @property
     def method(self):
         return self._method_name
 
+    def _ensure_router(self) -> Router:
+        if self._router is None:
+            self._router = Router(self.deployment_name)
+        return self._router
+
     def __getattr__(self, name: str):
         if name.startswith("_"):
             raise AttributeError(name)
-        return DeploymentHandle(self.deployment_name, name)
+        # Cache method-handles and share THIS handle's router: a fresh
+        # router per attribute access would cold-RPC the controller on every
+        # call and lose the in-flight counts pow-2 routing depends on.
+        cache = self.__dict__.setdefault("_method_cache", {})
+        h = cache.get(name)
+        if h is None:
+            h = DeploymentHandle(self.deployment_name, name)
+            h._router = self._ensure_router()
+            cache[name] = h
+        return h
 
     def remote(self, *args, **kwargs) -> DeploymentResponse:
-        if self._router is None:
-            self._router = Router(self.deployment_name)
-        return self._router.assign(self._method_name, args, kwargs)
+        return self._ensure_router().assign(self._method_name, args, kwargs)

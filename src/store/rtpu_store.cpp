@@ -1,0 +1,382 @@
+// rtpu_store: shared-memory object arena (plasma-store equivalent).
+//
+// Role-equivalent to the reference's plasma store (ray:
+// src/ray/object_manager/plasma/store.h, object_lifecycle_manager,
+// PlasmaAllocator over dlmalloc) redesigned for the TPU-host setting: no
+// separate store daemon and no fd-passing socket protocol — one mmap'd
+// POSIX shm arena per host that every worker attaches directly, with a
+// process-shared robust mutex guarding an in-arena object table and a
+// first-fit free-list allocator with coalescing. Object lifecycle:
+//   alloc(oid, size) -> [write bytes] -> seal(oid) -> get/release -> delete
+// get() pins (refcount) sealed objects; delete is deferred until the
+// refcount drains. A crashed holder is survivable: the mutex is ROBUST and
+// pins are advisory (the controller GC can force-delete).
+//
+// Pure C ABI for ctypes; no dependencies beyond libc/pthread.
+
+#include <cerrno>
+#include <cstdint>
+#include <cstring>
+
+#include <fcntl.h>
+#include <pthread.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+namespace {
+
+constexpr uint64_t kMagic = 0x525450555354524aULL;  // "RTPUSTRJ"
+constexpr uint32_t kMaxObjects = 65536;
+
+// Object table entry states. kTombstone marks a deleted entry that is still
+// part of open-addressing probe chains: treating it as empty would truncate
+// the chain and strand colliding live entries (unfindable + unfreeable).
+enum : uint32_t { kFree = 0, kCreating = 1, kSealed = 2, kTombstone = 3 };
+
+struct Entry {
+  uint64_t oid;       // 0 = empty slot
+  uint64_t offset;    // data offset from arena base
+  uint64_t size;      // payload size
+  uint32_t state;
+  int32_t refcount;
+  uint32_t deleted;   // delete requested; free when refcount drains
+  uint32_t pad;
+};
+
+// Free block header kept inside the data heap itself.
+struct FreeBlock {
+  uint64_t size;      // includes this header
+  uint64_t next_off;  // offset of next free block (0 = end)
+};
+
+struct Header {
+  uint64_t magic;
+  uint64_t arena_size;
+  uint64_t heap_off;      // start of the data heap
+  uint64_t heap_size;
+  uint64_t free_head;     // offset of first free block (0 = none)
+  uint64_t used_bytes;
+  uint64_t num_objects;
+  pthread_mutex_t mutex;
+  Entry table[kMaxObjects];
+};
+
+struct Handle {
+  Header* hdr;
+  uint8_t* base;
+  uint64_t map_size;
+  char name[256];
+};
+
+uint64_t align8(uint64_t v) { return (v + 7) & ~7ULL; }
+
+// Robust-mutex lock that recovers ownership if a holder died.
+int lock(Header* h) {
+  int rc = pthread_mutex_lock(&h->mutex);
+  if (rc == EOWNERDEAD) {
+    pthread_mutex_consistent(&h->mutex);
+    rc = 0;
+  }
+  return rc;
+}
+
+Entry* find(Header* h, uint64_t oid) {
+  uint32_t slot = (uint32_t)(oid % kMaxObjects);
+  for (uint32_t i = 0; i < kMaxObjects; i++) {
+    Entry* e = &h->table[(slot + i) % kMaxObjects];
+    if (e->oid == oid && e->state != kFree && e->state != kTombstone) return e;
+    if (e->state == kFree) return nullptr;  // true empty = chain end
+    // kTombstone: keep probing.
+  }
+  return nullptr;
+}
+
+// Callers must have checked find(oid)==nullptr first (no duplicates), so
+// reusing the first tombstone is safe and keeps chains short.
+Entry* find_slot(Header* h, uint64_t oid) {
+  uint32_t slot = (uint32_t)(oid % kMaxObjects);
+  for (uint32_t i = 0; i < kMaxObjects; i++) {
+    Entry* e = &h->table[(slot + i) % kMaxObjects];
+    if (e->state == kFree || e->state == kTombstone) return e;
+  }
+  return nullptr;
+}
+
+// First-fit allocation from the free list; splits blocks.
+uint64_t heap_alloc(Header* h, uint8_t* base, uint64_t want) {
+  want = align8(want);
+  uint64_t prev_off = 0;
+  uint64_t cur = h->free_head;
+  while (cur) {
+    FreeBlock* fb = (FreeBlock*)(base + cur);
+    if (fb->size >= want + sizeof(FreeBlock)) {
+      uint64_t remain = fb->size - want - sizeof(FreeBlock);
+      uint64_t data_off;
+      if (remain >= sizeof(FreeBlock) + 64) {
+        // Split: allocate from the tail of this block.
+        fb->size -= want + sizeof(FreeBlock);
+        uint64_t alloc_off = cur + fb->size;
+        FreeBlock* ah = (FreeBlock*)(base + alloc_off);
+        ah->size = want + sizeof(FreeBlock);
+        ah->next_off = 0;  // not on free list
+        data_off = alloc_off + sizeof(FreeBlock);
+      } else {
+        // Take the whole block.
+        if (prev_off) {
+          ((FreeBlock*)(base + prev_off))->next_off = fb->next_off;
+        } else {
+          h->free_head = fb->next_off;
+        }
+        fb->next_off = 0;
+        data_off = cur + sizeof(FreeBlock);
+      }
+      h->used_bytes += ((FreeBlock*)(base + data_off - sizeof(FreeBlock)))->size;
+      return data_off;
+    }
+    prev_off = cur;
+    cur = fb->next_off;
+  }
+  return 0;  // OOM
+}
+
+// Insert block back, keeping the free list address-ordered + coalescing.
+void heap_free(Header* h, uint8_t* base, uint64_t data_off) {
+  uint64_t blk = data_off - sizeof(FreeBlock);
+  FreeBlock* fb = (FreeBlock*)(base + blk);
+  h->used_bytes -= fb->size;
+  uint64_t prev = 0, cur = h->free_head;
+  while (cur && cur < blk) {
+    prev = cur;
+    cur = ((FreeBlock*)(base + cur))->next_off;
+  }
+  fb->next_off = cur;
+  if (prev) {
+    ((FreeBlock*)(base + prev))->next_off = blk;
+  } else {
+    h->free_head = blk;
+  }
+  // Coalesce with next.
+  if (cur && blk + fb->size == cur) {
+    FreeBlock* nb = (FreeBlock*)(base + cur);
+    fb->size += nb->size;
+    fb->next_off = nb->next_off;
+  }
+  // Coalesce with prev.
+  if (prev) {
+    FreeBlock* pb = (FreeBlock*)(base + prev);
+    if (prev + pb->size == blk) {
+      pb->size += fb->size;
+      pb->next_off = fb->next_off;
+    }
+  }
+}
+
+void entry_free(Header* h, uint8_t* base, Entry* e) {
+  heap_free(h, base, e->offset);
+  e->oid = 0;
+  e->state = kTombstone;
+  e->refcount = 0;
+  e->deleted = 0;
+  h->num_objects--;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Create a new arena of `size` bytes under shm name `name`.
+// Returns an opaque handle or nullptr.
+void* rtpu_store_create(const char* name, uint64_t size) {
+  if (size < sizeof(Header) + (1 << 20)) return nullptr;
+  int fd = shm_open(name, O_CREAT | O_EXCL | O_RDWR, 0600);
+  if (fd < 0) return nullptr;
+  if (ftruncate(fd, (off_t)size) != 0) {
+    close(fd);
+    shm_unlink(name);
+    return nullptr;
+  }
+  void* mem = mmap(nullptr, size, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  close(fd);
+  if (mem == MAP_FAILED) {
+    shm_unlink(name);
+    return nullptr;
+  }
+  Header* h = (Header*)mem;
+  memset(h, 0, sizeof(Header));
+  h->magic = kMagic;
+  h->arena_size = size;
+  h->heap_off = align8(sizeof(Header));
+  h->heap_size = size - h->heap_off;
+  pthread_mutexattr_t attr;
+  pthread_mutexattr_init(&attr);
+  pthread_mutexattr_setpshared(&attr, PTHREAD_PROCESS_SHARED);
+  pthread_mutexattr_setrobust(&attr, PTHREAD_MUTEX_ROBUST);
+  pthread_mutex_init(&h->mutex, &attr);
+  pthread_mutexattr_destroy(&attr);
+  // One big free block spanning the heap.
+  FreeBlock* fb = (FreeBlock*)((uint8_t*)mem + h->heap_off);
+  fb->size = h->heap_size;
+  fb->next_off = 0;
+  h->free_head = h->heap_off;
+
+  Handle* hd = new Handle();
+  hd->hdr = h;
+  hd->base = (uint8_t*)mem;
+  hd->map_size = size;
+  strncpy(hd->name, name, sizeof(hd->name) - 1);
+  return hd;
+}
+
+void* rtpu_store_attach(const char* name) {
+  int fd = shm_open(name, O_RDWR, 0600);
+  if (fd < 0) return nullptr;
+  struct stat st;
+  if (fstat(fd, &st) != 0) {
+    close(fd);
+    return nullptr;
+  }
+  void* mem =
+      mmap(nullptr, (size_t)st.st_size, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  close(fd);
+  if (mem == MAP_FAILED) return nullptr;
+  Header* h = (Header*)mem;
+  if (h->magic != kMagic) {
+    munmap(mem, (size_t)st.st_size);
+    return nullptr;
+  }
+  Handle* hd = new Handle();
+  hd->hdr = h;
+  hd->base = (uint8_t*)mem;
+  hd->map_size = (uint64_t)st.st_size;
+  strncpy(hd->name, name, sizeof(hd->name) - 1);
+  return hd;
+}
+
+uint8_t* rtpu_store_base(void* handle) { return ((Handle*)handle)->base; }
+
+// Allocate an object; returns the data offset from base, or 0 on failure
+// (OOM / duplicate oid / table full).
+uint64_t rtpu_store_alloc(void* handle, uint64_t oid, uint64_t size) {
+  Handle* hd = (Handle*)handle;
+  Header* h = hd->hdr;
+  if (oid == 0) return 0;
+  lock(h);
+  if (find(h, oid)) {
+    pthread_mutex_unlock(&h->mutex);
+    return 0;
+  }
+  Entry* e = find_slot(h, oid);
+  if (!e) {
+    pthread_mutex_unlock(&h->mutex);
+    return 0;
+  }
+  uint64_t off = heap_alloc(h, hd->base, size ? size : 1);
+  if (!off) {
+    pthread_mutex_unlock(&h->mutex);
+    return 0;
+  }
+  e->oid = oid;
+  e->offset = off;
+  e->size = size;
+  e->state = kCreating;
+  e->refcount = 0;
+  e->deleted = 0;
+  h->num_objects++;
+  pthread_mutex_unlock(&h->mutex);
+  return off;
+}
+
+int rtpu_store_seal(void* handle, uint64_t oid) {
+  Header* h = ((Handle*)handle)->hdr;
+  lock(h);
+  Entry* e = find(h, oid);
+  int rc = -1;
+  if (e && e->state == kCreating) {
+    e->state = kSealed;
+    rc = 0;
+  }
+  pthread_mutex_unlock(&h->mutex);
+  return rc;
+}
+
+// Pin + locate a sealed object. Returns data offset (size in *size_out),
+// 0 if absent/unsealed.
+uint64_t rtpu_store_get(void* handle, uint64_t oid, uint64_t* size_out) {
+  Header* h = ((Handle*)handle)->hdr;
+  lock(h);
+  Entry* e = find(h, oid);
+  uint64_t off = 0;
+  if (e && e->state == kSealed && !e->deleted) {
+    e->refcount++;
+    off = e->offset;
+    if (size_out) *size_out = e->size;
+  }
+  pthread_mutex_unlock(&h->mutex);
+  return off;
+}
+
+int rtpu_store_release(void* handle, uint64_t oid) {
+  Handle* hd = (Handle*)handle;
+  Header* h = hd->hdr;
+  lock(h);
+  Entry* e = find(h, oid);
+  int rc = -1;
+  if (e && e->refcount > 0) {
+    e->refcount--;
+    rc = 0;
+    if (e->deleted && e->refcount == 0) entry_free(h, hd->base, e);
+  }
+  pthread_mutex_unlock(&h->mutex);
+  return rc;
+}
+
+// Request deletion; frees now if unpinned, else deferred to last release.
+// force=1 frees immediately regardless of pins (controller GC after a
+// worker crash — pins are advisory).
+int rtpu_store_delete(void* handle, uint64_t oid, int force) {
+  Handle* hd = (Handle*)handle;
+  Header* h = hd->hdr;
+  lock(h);
+  Entry* e = find(h, oid);
+  int rc = -1;
+  if (e) {
+    rc = 0;
+    if (e->refcount <= 0 || force) {
+      entry_free(h, hd->base, e);
+    } else {
+      e->deleted = 1;
+    }
+  }
+  pthread_mutex_unlock(&h->mutex);
+  return rc;
+}
+
+int rtpu_store_contains(void* handle, uint64_t oid) {
+  Header* h = ((Handle*)handle)->hdr;
+  lock(h);
+  Entry* e = find(h, oid);
+  int rc = (e && e->state == kSealed && !e->deleted) ? 1 : 0;
+  pthread_mutex_unlock(&h->mutex);
+  return rc;
+}
+
+void rtpu_store_stats(void* handle, uint64_t* used, uint64_t* capacity,
+                      uint64_t* num_objects) {
+  Header* h = ((Handle*)handle)->hdr;
+  lock(h);
+  if (used) *used = h->used_bytes;
+  if (capacity) *capacity = h->heap_size;
+  if (num_objects) *num_objects = h->num_objects;
+  pthread_mutex_unlock(&h->mutex);
+}
+
+void rtpu_store_detach(void* handle) {
+  Handle* hd = (Handle*)handle;
+  munmap(hd->base, hd->map_size);
+  delete hd;
+}
+
+int rtpu_store_unlink(const char* name) { return shm_unlink(name); }
+
+}  // extern "C"
