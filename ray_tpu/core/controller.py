@@ -143,6 +143,13 @@ class Controller:
         self._sched_task: Optional[asyncio.Task] = None
         self._closing = False
         self.start_time = time.time()
+        # Bounded task-event history: feeds the state API (`ray list tasks`,
+        # summarize) and chrome-trace timeline export (reference:
+        # TaskEventBuffer -> GcsTaskManager, task_event_buffer.h:206).
+        import collections
+
+        self.task_events: "collections.deque" = collections.deque(
+            maxlen=int(os.environ.get("RTPU_TASK_EVENTS_MAX", "50000")))
         # Node-wide native object arena (plasma-equivalent, src/store).
         # Created here so worker spawns inherit RTPU_ARENA via env; falls
         # back to per-object segments when the native lib is unavailable.
@@ -378,10 +385,22 @@ class Controller:
             raise KeyError(f"function {msg['func_id']} not found in function table")
         return blob
 
+    def _record_task_event(self, spec, event: str, **extra) -> None:
+        self.task_events.append({
+            "task_id": spec.get("task_id"),
+            "label": spec.get("label"),
+            "actor_id": spec.get("actor_id"),
+            "event": event,
+            "ts": time.time(),
+            "worker_id": extra.get("worker_id") or spec.get("_worker_id"),
+            "node_id": extra.get("node_id") or spec.get("sched_node"),
+        })
+
     async def _h_submit_task(self, conn, msg):
         spec = msg["spec"]
         self.tasks[spec["task_id"]] = spec
         spec["state"] = "waiting_deps"
+        self._record_task_event(spec, "submitted")
         await self._resolve_deps_then_queue(spec)
         return {"ok": True}
 
@@ -419,12 +438,17 @@ class Controller:
 
     def _fail_task(self, spec, err: Exception) -> None:
         self.tasks.pop(spec["task_id"], None)
+        self._record_task_event(spec, "failed")
         for oid in spec["return_ids"]:
             self._store_error(oid, err)
 
     async def _h_task_done(self, conn, msg):
         task_id = msg["task_id"]
         spec = self.tasks.pop(task_id, None)
+        if spec is not None:
+            self._record_task_event(
+                spec, "failed" if msg.get("is_error") else "finished",
+                worker_id=msg.get("worker_id"))
         for loc in msg.get("locations", []):
             self._store_location(loc)
         if msg.get("error_locations"):
