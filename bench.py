@@ -2,17 +2,32 @@
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 value = tokens/sec/chip on a llama-family ~350M model, bf16 activations,
-adamw, remat off. vs_baseline = achieved MFU / 0.45 (the Llama north-star MFU
+adamw. vs_baseline = achieved MFU / 0.45 (the Llama north-star MFU
 target from BASELINE.json; the reference publishes no tokens/sec numbers —
 BASELINE.md).
+
+Structure: ``main()`` is an orchestrator that runs the real benchmark in a
+subprocess so that a hung or failed TPU backend init (the round-1 failure:
+``jax.devices()`` raised before any fallback could fire) can never prevent
+the JSON line. Attempt order: TPU (default platform), TPU retry, forced CPU.
+Role parity: the always-emits harness of reference
+python/ray/_private/ray_perf.py:93.
 """
 from __future__ import annotations
 
 import json
+import os
+import subprocess
+import sys
 import time
 
+# Wall-clock budget per child attempt (first TPU compile can take minutes on
+# the axon relay; the CPU fallback needs far less).
+_TPU_TIMEOUT_S = int(os.environ.get("RTPU_BENCH_TPU_TIMEOUT", "1500"))
+_CPU_TIMEOUT_S = int(os.environ.get("RTPU_BENCH_CPU_TIMEOUT", "900"))
 
-def main() -> None:
+
+def _run_benchmark() -> None:
     from ray_tpu.util.jaxenv import ensure_platform
 
     ensure_platform()  # honor JAX_PLATFORMS even where a site config forces it
@@ -80,5 +95,71 @@ def main() -> None:
     )
 
 
+def _attempt(env_overrides: dict, timeout_s: int) -> str | None:
+    """Run the child benchmark; return its JSON line or None."""
+    env = dict(os.environ)
+    env.update(env_overrides)
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--run"],
+            capture_output=True,
+            text=True,
+            timeout=timeout_s,
+            env=env,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+    except subprocess.TimeoutExpired as te:
+        # The child may have printed the JSON line and then hung in TPU
+        # runtime teardown (the axon failure mode this harness exists for):
+        # salvage the measurement from the captured partial stdout.
+        partial = te.stdout or b""
+        if isinstance(partial, bytes):
+            partial = partial.decode("utf-8", "replace")
+        for line in reversed(partial.splitlines()):
+            line = line.strip()
+            if line.startswith("{") and '"metric"' in line:
+                print(f"bench attempt timed out after {timeout_s}s but had "
+                      f"already emitted a result; using it", file=sys.stderr)
+                return line
+        print(f"bench attempt timed out after {timeout_s}s "
+              f"(env={env_overrides})", file=sys.stderr)
+        return None
+    for line in reversed(proc.stdout.splitlines()):
+        line = line.strip()
+        if line.startswith("{") and '"metric"' in line:
+            return line
+    tail = (proc.stderr or proc.stdout or "").strip().splitlines()[-8:]
+    print("bench attempt failed (rc=%d, env=%s):\n%s"
+          % (proc.returncode, env_overrides, "\n".join(tail)), file=sys.stderr)
+    return None
+
+
+def main() -> None:
+    attempts = [
+        ({}, _TPU_TIMEOUT_S),          # TPU (or whatever the default is)
+        ({}, min(_TPU_TIMEOUT_S, 420)),  # short retry: axon init is flaky
+        ({"JAX_PLATFORMS": "cpu", "RTPU_JAX_PLATFORM": "cpu"}, _CPU_TIMEOUT_S),
+    ]
+    # If the caller already forced CPU, don't burn time on TPU attempts.
+    if os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
+        attempts = attempts[-1:]
+    for env_overrides, timeout_s in attempts:
+        line = _attempt(env_overrides, timeout_s)
+        if line is not None:
+            print(line)
+            return
+    # Last-resort: emit a zero line rather than no line at all.
+    print(json.dumps({
+        "metric": "train_tokens_per_sec_per_chip_350m",
+        "value": 0.0,
+        "unit": "tokens/s",
+        "vs_baseline": 0.0,
+        "error": "all benchmark attempts failed (tpu x2, cpu x1)",
+    }))
+
+
 if __name__ == "__main__":
-    main()
+    if "--run" in sys.argv:
+        _run_benchmark()
+    else:
+        main()

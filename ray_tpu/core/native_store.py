@@ -136,6 +136,8 @@ class NativeArena:
 
     def get(self, oid: int) -> Optional[memoryview]:
         """Read view of a sealed object; pins it until release(oid)."""
+        if not self._h:
+            return None
         size = ctypes.c_uint64()
         off = self._lib.rtpu_store_get(self._h, oid, ctypes.byref(size))
         if not off:
@@ -144,13 +146,16 @@ class NativeArena:
         return memoryview(buf).cast("B")
 
     def release(self, oid: int) -> None:
-        self._lib.rtpu_store_release(self._h, oid)
+        if self._h:  # guard: detach() during shutdown NULLs the handle
+            self._lib.rtpu_store_release(self._h, oid)
 
     def delete(self, oid: int, force: bool = False) -> bool:
+        if not self._h:
+            return False
         return self._lib.rtpu_store_delete(self._h, oid, int(force)) == 0
 
     def contains(self, oid: int) -> bool:
-        return bool(self._lib.rtpu_store_contains(self._h, oid))
+        return bool(self._h) and bool(self._lib.rtpu_store_contains(self._h, oid))
 
     def stats(self) -> Dict[str, int]:
         used = ctypes.c_uint64()

@@ -237,10 +237,32 @@ def _get_arena_bytes(loc: ObjectLocation, copy: bool) -> Any:
         if copy:
             del bufs, view
             arena.release(loc.arena_oid)
-        # copy=False: the pin stays — the object can't be reclaimed while
-        # this process may still alias it (released at process exit; the
-        # controller can force-delete, same contract as plasma).
+        else:
+            # copy=False: the pin stays — the object can't be reclaimed
+            # while this process may still alias it. Record it so the
+            # atexit hook drains it (the refcount lives in shared memory,
+            # so process death alone cannot); the controller can still
+            # force-delete, same contract as plasma.
+            _zero_copy_pins.append((arena, loc.arena_oid))
     return value
+
+
+# (arena, oid) pins taken by copy=False reads, drained at interpreter exit.
+_zero_copy_pins: list = []
+
+
+def _release_zero_copy_pins() -> None:
+    pins, _zero_copy_pins[:] = list(_zero_copy_pins), []
+    for arena, oid in pins:
+        try:
+            arena.release(oid)
+        except Exception:
+            pass  # arena may already be detached/unlinked at shutdown
+
+
+import atexit as _atexit
+
+_atexit.register(_release_zero_copy_pins)
 
 
 def free_location(loc: ObjectLocation) -> None:

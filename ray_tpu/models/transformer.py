@@ -226,7 +226,15 @@ def _layer_body(cfg: TransformerConfig, x: jax.Array, layer: Params, positions: 
 def forward(params: Params, tokens: jax.Array, cfg: TransformerConfig) -> jax.Array:
     """tokens [B, S] int32 -> logits [B, S, V] (f32)."""
     B, S = tokens.shape
-    x = params["embed"].astype(cfg.dtype)[tokens]
+    # Replicate the table for the lookup (FSDP all-gather-at-use): a gather
+    # from a vocab/embed-sharded operand forces GSPMD into involuntary full
+    # rematerialization when resharding the output onto the batch/seq axes
+    # (MULTICHIP_r01). With a replicated operand the gather partitions
+    # trivially along the token sharding; the vocab-sharded original still
+    # feeds the lm_head matmul below, and the backward scatter-add
+    # reduce-scatters back into the sharded param layout.
+    tbl = maybe_constrain(params["embed"].astype(cfg.dtype), (None, None))
+    x = tbl[tokens]
     x = maybe_constrain(x, ("batch", "seq_act", "embed"))
     positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32)[None], (B, S))
     if cfg.positional == "learned":

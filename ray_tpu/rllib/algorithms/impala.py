@@ -122,8 +122,12 @@ class IMPALA(Algorithm):
             weights = self.learner_group.get_weights()
             ok = {i for i, _ in manager.foreach_actor(
                 "set_weights", weights, actor_ids=idle)}
+            # Per-env fragment semantics: EnvRunner.sample counts timesteps
+            # across all vector envs, so scale by num_envs (matches the
+            # synchronous path and reference per-env fragment semantics).
             self._arm(manager, [i for i in idle if i in ok],
-                      cfg.rollout_fragment_length)
+                      cfg.rollout_fragment_length
+                      * cfg.num_envs_per_env_runner)
 
     def _update_from_episodes(self, episodes) -> Dict[str, float]:
         cfg = self._algo_config
@@ -186,7 +190,9 @@ class IMPALA(Algorithm):
                 manager.foreach_actor("set_weights", weights)
                 self._updates_since_broadcast = 0
             if manager._healthy.get(actor_id):
-                self._arm(manager, [actor_id], cfg.rollout_fragment_length)
+                self._arm(manager, [actor_id],
+                          cfg.rollout_fragment_length
+                          * cfg.num_envs_per_env_runner)
         return self._result(metrics)
 
     def _result(self, metrics: Dict[str, float]) -> Dict[str, Any]:

@@ -12,6 +12,7 @@ import functools
 import queue
 import threading
 import time
+import weakref
 from typing import Any, Callable, List, Optional
 
 
@@ -22,10 +23,18 @@ class _Batcher:
         self.max_batch_size = max_batch_size
         self.timeout_s = timeout_s
         self._queue: "queue.Queue" = queue.Queue()
-        self._thread = threading.Thread(target=self._loop, daemon=True)
+        # The worker holds only a weakref to this batcher: a bound-method
+        # target would keep batcher→fn-closure→owner alive forever, making
+        # every batched deployment instance immortal. With the weakref the
+        # owner↔batcher cycle is ordinary GC fodder and the thread exits
+        # once the batcher is collected.
+        self._thread = threading.Thread(
+            target=_batcher_loop, args=(weakref.ref(self),), daemon=True)
         self._thread.start()
 
     def submit(self, item: Any) -> Any:
+        # Note: the caller's frame keeps `self` strongly referenced for the
+        # duration, so the batcher cannot be collected mid-request.
         slot: "queue.Queue" = queue.Queue(1)
         self._queue.put((item, slot))
         result = slot.get()
@@ -33,32 +42,53 @@ class _Batcher:
             raise result.exc
         return result
 
-    def _loop(self) -> None:
-        while True:
-            item, slot = self._queue.get()
-            batch = [(item, slot)]
-            # Coalesce: wait up to timeout_s for more, cap at max size.
-            t_end = time.time() + self.timeout_s
-            while len(batch) < self.max_batch_size:
-                remaining = t_end - time.time()
-                if remaining <= 0:
-                    break
-                try:
-                    batch.append(self._queue.get(timeout=remaining))
-                except queue.Empty:
-                    break
-            items = [b[0] for b in batch]
+
+def _batcher_loop(ref: "weakref.ref[_Batcher]") -> None:
+    while True:
+        self = ref()
+        if self is None:
+            return
+        q = self._queue
+        timeout_s, max_bs = self.timeout_s, self.max_batch_size
+        del self  # hold no strong ref (to batcher OR owner) while blocked
+        try:
+            item, slot = q.get(timeout=1.0)
+        except queue.Empty:
+            continue
+        # Deref fn only now: fetching it before the blocking get would root
+        # the owner<->batcher cycle through this frame for the whole wait,
+        # defeating collection. A submitter's frame holds the batcher
+        # strongly for the duration of its request, so ref() cannot die
+        # between enqueue and here.
+        self = ref()
+        if self is None:
+            return
+        fn = self.fn
+        del self
+        batch = [(item, slot)]
+        # Coalesce: wait up to timeout_s for more, cap at max size.
+        t_end = time.time() + timeout_s
+        while len(batch) < max_bs:
+            remaining = t_end - time.time()
+            if remaining <= 0:
+                break
             try:
-                results = self.fn(items)
-                if len(results) != len(items):
-                    raise ValueError(
-                        f"batch fn returned {len(results)} results for "
-                        f"{len(items)} inputs")
-                for (_, s), r in zip(batch, results):
-                    s.put(r)
-            except Exception as e:
-                for _, s in batch:
-                    s.put(_Err(e))
+                batch.append(q.get(timeout=remaining))
+            except queue.Empty:
+                break
+        items = [b[0] for b in batch]
+        try:
+            results = fn(items)
+            if len(results) != len(items):
+                raise ValueError(
+                    f"batch fn returned {len(results)} results for "
+                    f"{len(items)} inputs")
+            for (_, s), r in zip(batch, results):
+                s.put(r)
+        except Exception as e:
+            for _, s in batch:
+                s.put(_Err(e))
+        del fn
 
 
 class _Err:
@@ -72,6 +102,25 @@ class _Err:
 # decorated deployment classes uncloudpicklable.
 _CREATE_LOCK = threading.Lock()
 
+# Fallback batcher store for owners with __slots__ (no instance dict):
+# weak-keyed so entries die with the instance.
+_weak_state: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
+
+
+def _weak_get(owner):
+    try:
+        return _weak_state.get(owner)
+    except TypeError:  # not weakref-able
+        return None
+
+
+def _weak_set(owner, batcher) -> bool:
+    try:
+        _weak_state[owner] = batcher
+        return True
+    except TypeError:  # not weakref-able
+        return False
+
 
 def batch(_fn: Optional[Callable] = None, *, max_batch_size: int = 8,
           batch_wait_timeout_s: float = 0.01):
@@ -80,29 +129,49 @@ def batch(_fn: Optional[Callable] = None, *, max_batch_size: int = 8,
     def wrap(fn):
         state: dict = {}
 
+        # Per-instance batchers live ON the instance (attribute keyed by the
+        # wrapped method's name): id(owner) keys can be recycled by CPython
+        # after GC, silently routing a new instance's calls to a dead
+        # instance's batcher; an instance attribute dies with the instance.
+        attr = f"__rtpu_batcher_{fn.__qualname__.replace('.', '_')}"
+
         @functools.wraps(fn)
         def wrapper(*args):
+            # Import-at-call: referencing the module-global lock by name
+            # would snapshot the (unpicklable) lock into this closure's
+            # globals when cloudpickle ships the deployment by value.
+            from ray_tpu.serve.batching import _CREATE_LOCK as lock
+
             # Bound method: args = (self, item); function: (item,)
             if len(args) == 2:
                 owner, item = args
-                key = id(owner)
-                caller = lambda items: fn(owner, items)
+                b = (getattr(owner, attr, None) or _weak_get(owner)
+                     or state.get(id(owner)))
+                if b is None:
+                    with lock:
+                        b = (getattr(owner, attr, None) or _weak_get(owner)
+                         or state.get(id(owner)))
+                        if b is None:
+                            b = _Batcher(lambda items: fn(owner, items),
+                                         max_batch_size, batch_wait_timeout_s)
+                            try:
+                                object.__setattr__(owner, attr, b)
+                            except (AttributeError, TypeError):
+                                # __slots__ owners: key weakly by instance
+                                # (dies with it, no id-recycling hazard).
+                                # Not even weakref-able: last resort,
+                                # id-keyed (leaks only for such owners).
+                                if not _weak_set(owner, b):
+                                    state[id(owner)] = b
             else:
                 (item,) = args
-                key = None
-                caller = fn
-            b = state.get(key)
-            if b is None:
-                # Import-at-call: referencing the module-global lock by name
-                # would snapshot the (unpicklable) lock into this closure's
-                # globals when cloudpickle ships the deployment by value.
-                from ray_tpu.serve.batching import _CREATE_LOCK as lock
-
-                with lock:
-                    b = state.get(key)
-                    if b is None:
-                        b = state[key] = _Batcher(
-                            caller, max_batch_size, batch_wait_timeout_s)
+                b = state.get(None)
+                if b is None:
+                    with lock:
+                        b = state.get(None)
+                        if b is None:
+                            b = state[None] = _Batcher(
+                                fn, max_batch_size, batch_wait_timeout_s)
             return b.submit(item)
 
         return wrapper

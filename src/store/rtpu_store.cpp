@@ -14,9 +14,11 @@
 //
 // Pure C ABI for ctypes; no dependencies beyond libc/pthread.
 
+#include <algorithm>
 #include <cerrno>
 #include <cstdint>
 #include <cstring>
+#include <vector>
 
 #include <fcntl.h>
 #include <pthread.h>
@@ -71,15 +73,116 @@ struct Handle {
 
 uint64_t align8(uint64_t v) { return (v + 7) & ~7ULL; }
 
-// Robust-mutex lock that recovers ownership if a holder died.
-int lock(Header* h) {
+// Rebuild heap metadata from the object table after a holder died mid-
+// mutation. The table is the authoritative record of allocations (entries
+// are only written while the heap is already self-consistent); the free
+// list / block headers may be half-mutated by a crashed heap_alloc or
+// heap_free. Strategy: drop entries with out-of-bounds extents, rewrite
+// every live allocation's block header to its minimal size (any slack from
+// a whole-block take is returned to the heap), and re-derive the free list
+// as the complement of the live allocations.
+void rebuild_heap(Header* h, uint8_t* base) {
+  struct Span {
+    uint64_t blk;   // block start (header) offset
+    uint64_t size;  // block size incl. header
+    Entry* entry;   // owning table entry (tombstoned if span is dropped)
+  };
+  std::vector<Span> span_buf(kMaxObjects);  // rare recovery path: heap is fine
+  Span* spans = span_buf.data();
+  uint32_t n = 0;
+  uint64_t heap_lo = h->heap_off;
+  uint64_t heap_hi = h->heap_off + h->heap_size;
+  for (uint32_t i = 0; i < kMaxObjects; i++) {
+    Entry* e = &h->table[i];
+    if (e->state != kCreating && e->state != kSealed) continue;
+    uint64_t blk = e->offset - sizeof(FreeBlock);
+    uint64_t bsz = align8(e->size ? e->size : 1) + sizeof(FreeBlock);
+    if (e->oid == 0 || e->offset < heap_lo + sizeof(FreeBlock) ||
+        blk + bsz > heap_hi || n == kMaxObjects) {
+      // Corrupt extent (the crash hit between heap and table updates):
+      // drop the entry rather than risk overlapping allocations.
+      e->oid = 0;
+      e->state = kTombstone;
+      e->refcount = 0;
+      e->deleted = 0;
+      continue;
+    }
+    spans[n].blk = blk;
+    spans[n].size = bsz;
+    spans[n].entry = e;
+    n++;
+  }
+  std::sort(spans, spans + n,
+            [](const Span& a, const Span& b) { return a.blk < b.blk; });
+  // Overlapping spans mean table corruption beyond repair for the later
+  // entry: tombstone it outright (keeping it would leave two live entries
+  // over the same memory and scribbling a header inside the kept object's
+  // payload). Data loss is confined to objects the crashed process was
+  // mutating.
+  uint64_t used = 0;
+  uint64_t live_kept = 0;
+  uint64_t cursor = heap_lo;   // next unclaimed heap offset
+  uint64_t prev_free = 0;      // offset of last free block emitted
+  h->free_head = 0;
+  for (uint32_t i = 0; i < n; i++) {
+    if (spans[i].blk < cursor) {  // overlaps a kept allocation: drop entry
+      Entry* e = spans[i].entry;
+      e->oid = 0;
+      e->state = kTombstone;
+      e->refcount = 0;
+      e->deleted = 0;
+      continue;
+    }
+    uint64_t blk = spans[i].blk;
+    uint64_t end = blk + spans[i].size;
+    if (blk > cursor && blk - cursor >= sizeof(FreeBlock)) {
+      FreeBlock* fb = (FreeBlock*)(base + cursor);
+      fb->size = blk - cursor;
+      fb->next_off = 0;
+      if (prev_free) {
+        ((FreeBlock*)(base + prev_free))->next_off = cursor;
+      } else {
+        h->free_head = cursor;
+      }
+      prev_free = cursor;
+    }
+    // Rewrite the allocation's header so heap_free sees a sane size.
+    FreeBlock* ah = (FreeBlock*)(base + blk);
+    ah->size = spans[i].size;
+    ah->next_off = 0;
+    used += spans[i].size;
+    live_kept++;
+    cursor = end;
+  }
+  if (heap_hi > cursor && heap_hi - cursor >= sizeof(FreeBlock)) {
+    FreeBlock* fb = (FreeBlock*)(base + cursor);
+    fb->size = heap_hi - cursor;
+    fb->next_off = 0;
+    if (prev_free) {
+      ((FreeBlock*)(base + prev_free))->next_off = cursor;
+    } else {
+      h->free_head = cursor;
+    }
+  }
+  h->used_bytes = used;
+  h->num_objects = live_kept;
+}
+
+// Robust-mutex lock that recovers ownership if a holder died. Handles are
+// per-process; the base pointer for this mapping lives alongside in Handle,
+// so recovery (which must repair heap state, not just the mutex) is routed
+// through lock_h below. lock() remains for call sites via Handle.
+int lock_h(Header* h, uint8_t* base) {
   int rc = pthread_mutex_lock(&h->mutex);
   if (rc == EOWNERDEAD) {
+    rebuild_heap(h, base);
     pthread_mutex_consistent(&h->mutex);
     rc = 0;
   }
   return rc;
 }
+
+int lock(Handle* hd) { return lock_h(hd->hdr, hd->base); }
 
 Entry* find(Header* h, uint64_t oid) {
   uint32_t slot = (uint32_t)(oid % kMaxObjects);
@@ -204,7 +307,6 @@ void* rtpu_store_create(const char* name, uint64_t size) {
   }
   Header* h = (Header*)mem;
   memset(h, 0, sizeof(Header));
-  h->magic = kMagic;
   h->arena_size = size;
   h->heap_off = align8(sizeof(Header));
   h->heap_size = size - h->heap_off;
@@ -219,6 +321,10 @@ void* rtpu_store_create(const char* name, uint64_t size) {
   fb->size = h->heap_size;
   fb->next_off = 0;
   h->free_head = h->heap_off;
+  // Publish the magic LAST (release barrier): a concurrent attach_named on
+  // this shm name uses the magic check as its initialization-complete check,
+  // so all header/mutex/heap init must be visible before it.
+  __atomic_store_n(&h->magic, kMagic, __ATOMIC_RELEASE);
 
   Handle* hd = new Handle();
   hd->hdr = h;
@@ -241,7 +347,7 @@ void* rtpu_store_attach(const char* name) {
   close(fd);
   if (mem == MAP_FAILED) return nullptr;
   Header* h = (Header*)mem;
-  if (h->magic != kMagic) {
+  if (__atomic_load_n(&h->magic, __ATOMIC_ACQUIRE) != kMagic) {
     munmap(mem, (size_t)st.st_size);
     return nullptr;
   }
@@ -261,7 +367,7 @@ uint64_t rtpu_store_alloc(void* handle, uint64_t oid, uint64_t size) {
   Handle* hd = (Handle*)handle;
   Header* h = hd->hdr;
   if (oid == 0) return 0;
-  lock(h);
+  lock(hd);
   if (find(h, oid)) {
     pthread_mutex_unlock(&h->mutex);
     return 0;
@@ -288,8 +394,9 @@ uint64_t rtpu_store_alloc(void* handle, uint64_t oid, uint64_t size) {
 }
 
 int rtpu_store_seal(void* handle, uint64_t oid) {
-  Header* h = ((Handle*)handle)->hdr;
-  lock(h);
+  Handle* hd = (Handle*)handle;
+  Header* h = hd->hdr;
+  lock(hd);
   Entry* e = find(h, oid);
   int rc = -1;
   if (e && e->state == kCreating) {
@@ -303,8 +410,9 @@ int rtpu_store_seal(void* handle, uint64_t oid) {
 // Pin + locate a sealed object. Returns data offset (size in *size_out),
 // 0 if absent/unsealed.
 uint64_t rtpu_store_get(void* handle, uint64_t oid, uint64_t* size_out) {
-  Header* h = ((Handle*)handle)->hdr;
-  lock(h);
+  Handle* hd = (Handle*)handle;
+  Header* h = hd->hdr;
+  lock(hd);
   Entry* e = find(h, oid);
   uint64_t off = 0;
   if (e && e->state == kSealed && !e->deleted) {
@@ -319,7 +427,7 @@ uint64_t rtpu_store_get(void* handle, uint64_t oid, uint64_t* size_out) {
 int rtpu_store_release(void* handle, uint64_t oid) {
   Handle* hd = (Handle*)handle;
   Header* h = hd->hdr;
-  lock(h);
+  lock(hd);
   Entry* e = find(h, oid);
   int rc = -1;
   if (e && e->refcount > 0) {
@@ -337,7 +445,7 @@ int rtpu_store_release(void* handle, uint64_t oid) {
 int rtpu_store_delete(void* handle, uint64_t oid, int force) {
   Handle* hd = (Handle*)handle;
   Header* h = hd->hdr;
-  lock(h);
+  lock(hd);
   Entry* e = find(h, oid);
   int rc = -1;
   if (e) {
@@ -353,8 +461,9 @@ int rtpu_store_delete(void* handle, uint64_t oid, int force) {
 }
 
 int rtpu_store_contains(void* handle, uint64_t oid) {
-  Header* h = ((Handle*)handle)->hdr;
-  lock(h);
+  Handle* hd = (Handle*)handle;
+  Header* h = hd->hdr;
+  lock(hd);
   Entry* e = find(h, oid);
   int rc = (e && e->state == kSealed && !e->deleted) ? 1 : 0;
   pthread_mutex_unlock(&h->mutex);
@@ -363,8 +472,9 @@ int rtpu_store_contains(void* handle, uint64_t oid) {
 
 void rtpu_store_stats(void* handle, uint64_t* used, uint64_t* capacity,
                       uint64_t* num_objects) {
-  Header* h = ((Handle*)handle)->hdr;
-  lock(h);
+  Handle* hd = (Handle*)handle;
+  Header* h = hd->hdr;
+  lock(hd);
   if (used) *used = h->used_bytes;
   if (capacity) *capacity = h->heap_size;
   if (num_objects) *num_objects = h->num_objects;
@@ -378,5 +488,19 @@ void rtpu_store_detach(void* handle) {
 }
 
 int rtpu_store_unlink(const char* name) { return shm_unlink(name); }
+
+// TEST-ONLY hook: acquire the arena mutex and clobber heap metadata the way
+// a holder crashing inside heap_alloc/heap_free would, WITHOUT unlocking.
+// The calling process must _exit immediately after; the next locker then
+// observes EOWNERDEAD and must repair via rebuild_heap. Never called by
+// production code (see tests/test_native_store.py).
+int rtpu_store_test_seize_and_corrupt(void* handle) {
+  Handle* hd = (Handle*)handle;
+  Header* h = hd->hdr;
+  lock(hd);
+  h->free_head = h->heap_off + 8;  // dangling, misaligned free pointer
+  h->used_bytes = ~0ULL;           // accounting garbage
+  return 0;
+}
 
 }  // extern "C"
