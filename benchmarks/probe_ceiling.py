@@ -1,0 +1,133 @@
+"""Probe achievable TPU throughput through the axon tunnel:
+1. pure big-matmul loop (MXU ceiling),
+2. transformer fwd only vs fwd+bwd+adam,
+3. flash vs reference attention on bench shapes.
+Prints one JSON line per probe.
+"""
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def fence(x):
+    jax.tree_util.tree_map(
+        lambda a: a.block_until_ready() if hasattr(a, "block_until_ready") else a, x
+    )
+    # honest barrier: D2H a scalar
+    leaf = jax.tree_util.tree_leaves(x)[0]
+    float(jnp.sum(leaf).astype(jnp.float32))
+
+
+def probe_matmul(n=4096, steps=30):
+    k = jax.random.key(0)
+    a = jax.random.normal(k, (n, n), jnp.bfloat16)
+    b = jax.random.normal(k, (n, n), jnp.bfloat16)
+
+    @jax.jit
+    def mm(a, b):
+        return a @ b
+
+    c = mm(a, b)
+    fence(c)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        c = mm(c, b)
+    fence(c)
+    dt = time.perf_counter() - t0
+    fl = 2 * n**3 * steps
+    return {"probe": f"matmul{n}", "tflops": round(fl / dt / 1e12, 1),
+            "ms_per": round(dt / steps * 1e3, 2)}
+
+
+def probe_dispatch_latency(steps=50):
+    """Tiny op, serialized by carry: measures per-dispatch overhead."""
+    x = jnp.ones((8, 8), jnp.float32)
+
+    @jax.jit
+    def f(x):
+        return x + 1.0
+
+    y = f(x)
+    fence(y)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        y = f(y)
+    fence(y)
+    dt = time.perf_counter() - t0
+    return {"probe": "dispatch", "us_per": round(dt / steps * 1e6, 1)}
+
+
+def probe_attention(batch=8, seq=1024, heads=16, hd=64, steps=20):
+    from ray_tpu.ops.attention import reference_attention
+    from ray_tpu.ops.flash_attention import flash_attention
+
+    k = jax.random.key(0)
+    q = jax.random.normal(k, (batch, seq, heads, hd), jnp.bfloat16)
+    kk = jax.random.normal(k, (batch, seq, heads, hd), jnp.bfloat16)
+    v = jax.random.normal(k, (batch, seq, heads, hd), jnp.bfloat16)
+    out = {}
+    for name, fn in [("flash", flash_attention), ("reference", reference_attention)]:
+        try:
+            g = jax.jit(lambda q, k, v: fn(q, k, v, causal=True))
+            o = g(q, kk, v)
+            fence(o)
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                o = g(q, kk, v)
+            fence(o)
+            dt = time.perf_counter() - t0
+            out[name + "_ms"] = round(dt / steps * 1e3, 3)
+        except Exception as e:
+            out[name + "_error"] = str(e)[:120]
+    return {"probe": "attention_fwd", **out}
+
+
+def probe_transformer(fwd_only: bool, steps=10):
+    from ray_tpu.models.configs import bench_350m
+    from ray_tpu.models import transformer as tfm
+    from ray_tpu.parallel import MeshSpec, RULES_DP, make_mesh
+    from ray_tpu.train.step import transformer_train_step
+
+    cfg = bench_350m(remat=True, remat_policy="dots")
+    batch, seq = 8, 1024
+    tokens = np.random.default_rng(0).integers(0, cfg.vocab_size, (batch, seq + 1), dtype=np.int32)
+    if fwd_only:
+        params = jax.jit(lambda k: tfm.init_params(k, cfg))(jax.random.key(0))
+        f = jax.jit(lambda p, b: tfm.loss_fn(p, b, cfg))
+        b = {"tokens": jnp.asarray(tokens)}
+        l = f(params, b)
+        fence(l)
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            l = f(params, b)
+        fence(l)
+        dt = time.perf_counter() - t0
+        return {"probe": "fwd_only", "ms_per": round(dt / steps * 1e3, 2)}
+    mesh = make_mesh(MeshSpec(), devices=[jax.devices()[0]])
+    ts = transformer_train_step(cfg, mesh, rules=RULES_DP)
+    params, opt = ts.init(jax.random.key(0))
+    b = ts.shard_batch({"tokens": tokens})
+    params, opt, l = ts.step(params, opt, b)
+    fence(l)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        params, opt, l = ts.step(params, opt, b)
+    fence(l)
+    dt = time.perf_counter() - t0
+    return {"probe": "train_step", "ms_per": round(dt / steps * 1e3, 2)}
+
+
+if __name__ == "__main__":
+    for fn in (probe_dispatch_latency, probe_matmul,
+               probe_attention,
+               lambda: probe_transformer(True),
+               lambda: probe_transformer(False)):
+        try:
+            print(json.dumps(fn()), flush=True)
+        except Exception as e:
+            print(json.dumps({"error": repr(e)[:300]}), flush=True)

@@ -1,0 +1,56 @@
+"""Confirm per-loop-iteration overhead on the axon platform: same 20-matmul
+chain as probe_mxu, but unrolled in the traced program vs lax.fori_loop."""
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+
+
+def run(n, inner, mode):
+    k = jax.random.key(0)
+    a = jax.random.normal(k, (n, n), jnp.bfloat16)
+    b = jax.random.normal(k, (n, n), jnp.bfloat16)
+
+    if mode == "unrolled":
+
+        @jax.jit
+        def chain(a, b):
+            x = a
+            for _ in range(inner):
+                x = (x @ b) * jnp.bfloat16(1.0 / n)
+            return x
+
+    else:
+
+        @jax.jit
+        def chain(a, b):
+            def body(i, x):
+                return (x @ b) * jnp.bfloat16(1.0 / n)
+
+            return jax.lax.fori_loop(0, inner, body, a)
+
+    c = chain(a, b)
+    float(jnp.sum(c.astype(jnp.float32)))
+    best = None
+    for _ in range(3):
+        t0 = time.perf_counter()
+        c = chain(a, b)
+        float(jnp.sum(c.astype(jnp.float32)))
+        dt = time.perf_counter() - t0
+        best = dt if best is None else min(best, dt)
+    fl = 2 * n**3 * inner
+    return {"probe": f"{mode}_{n}x{inner}", "tflops": round(fl / best / 1e12, 1),
+            "ms_total": round(best * 1e3, 2),
+            "ms_per_mm": round(best / inner * 1e3, 3)}
+
+
+if __name__ == "__main__":
+    for mode in ("unrolled", "fori"):
+        for n in (2048, 4096):
+            try:
+                print(json.dumps(run(n, 20, mode)), flush=True)
+            except Exception as e:
+                print(json.dumps({"mode": mode, "n": n, "error": repr(e)[:200]}), flush=True)

@@ -78,7 +78,15 @@ def init(
 
         host, port_s = address.rsplit(":", 1)
         client = CoreClient(host, int(port_s), handler=_driver_handler)
-        client.request({"kind": "register", "role": "driver"})
+        reg = client.request({"kind": "register", "role": "driver"})
+        # A driver on a host with no pull server (neither the controller's
+        # host nor an agent's) cannot serve its shm objects to workers: its
+        # puts must travel inline on the control plane.
+        from .object_store import current_host_id
+
+        ctrl_host = (reg or {}).get("controller_host_id")
+        if ctrl_host is not None and ctrl_host != current_host_id():
+            os.environ["RTPU_FORCE_INLINE"] = "1"
         if not node_id:
             state = client.request({"kind": "cluster_state"})
             node_id = state["nodes"][0]["node_id"] if state["nodes"] else ""
@@ -122,9 +130,12 @@ def shutdown() -> None:
         _owned_controller = None
         _controller_io = None
         ctx.set_worker_context(None)
+        os.environ.pop("RTPU_FORCE_INLINE", None)
         from .object_store import close_process_segments
+        from .transfer import reset_transfer_caches
 
         close_process_segments()
+        reset_transfer_caches()
 
 
 def is_initialized() -> bool:

@@ -1,31 +1,101 @@
-"""In-process virtual multi-node cluster for tests.
+"""Multi-node cluster harness for tests.
 
 Reference: python/ray/cluster_utils.py:135 `Cluster.add_node` — the mechanism
-by which "multi-node" behavior is tested on one machine. Here a virtual node
-is a resource pool in the controller with its own worker-process pool.
+by which "multi-node" behavior is tested on one machine. Two node flavors:
+
+- virtual (default): a resource pool inside the controller with its own
+  worker-process pool — cheap, single-host by construction.
+- remote (``remote=True``): a real `ray_tpu.core.host_agent` subprocess with
+  its own object arena, pull server, heartbeats, and worker pool. Passing a
+  distinct ``host_id`` simulates a second machine: every cross-host object
+  read then streams over TCP through the agent (reference:
+  src/ray/raylet/main.cc daemon startup + object_manager push/pull).
 """
 from __future__ import annotations
 
-from typing import Dict, Optional
+import json
+import subprocess
+import sys
+import time
+from typing import Dict, List, Optional
 
 from . import api, context as ctx
 
 
 class Cluster:
-    """Drive the controller owned by `ray_tpu.init()` to add virtual nodes."""
+    """Drive the controller owned by `ray_tpu.init()` to add nodes."""
 
     def __init__(self, initialize_head: bool = True, head_resources: Optional[Dict[str, float]] = None):
         self.head_handle = None
+        self._agent_procs: List[subprocess.Popen] = []
         if initialize_head:
             res = dict(head_resources or {"CPU": 1})
             num_cpus = int(res.pop("CPU", 1))
             self.head_handle = api.init(num_cpus=num_cpus, resources=res)
 
-    def add_node(self, resources: Dict[str, float], labels: Optional[Dict[str, str]] = None) -> str:
+    def add_node(
+        self,
+        resources: Dict[str, float],
+        labels: Optional[Dict[str, str]] = None,
+        *,
+        remote: bool = False,
+        host_id: Optional[str] = None,
+        timeout: float = 20.0,
+    ) -> str:
         wc = ctx.get_worker_context()
-        return wc.client.request(
-            {"kind": "add_node", "resources": dict(resources), "labels": labels or {}}
-        )["node_id"]
+        if not remote:
+            return wc.client.request(
+                {"kind": "add_node", "resources": dict(resources), "labels": labels or {}}
+            )["node_id"]
+
+        before = {n["node_id"] for n in wc.client.request({"kind": "cluster_state"})["nodes"]}
+        cmd = [
+            sys.executable, "-m", "ray_tpu.core.host_agent",
+            "--controller", wc.extra.get("address", ""),
+            "--resources", json.dumps(dict(resources)),
+        ]
+        if labels:
+            cmd += ["--labels", json.dumps(labels)]
+        if host_id:
+            cmd += ["--host-id", host_id]
+        import os
+
+        env = dict(os.environ)
+        env.pop("RTPU_ARENA", None)  # the agent owns its *own* arena
+        env.pop("RTPU_HOST_ID", None)
+        pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+        env["PYTHONPATH"] = pkg_root + os.pathsep + env.get("PYTHONPATH", "")
+        proc = subprocess.Popen(cmd, env=env)
+        self._agent_procs.append(proc)
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            state = wc.client.request({"kind": "cluster_state"})
+            new = [n for n in state["nodes"] if n["node_id"] not in before]
+            if new:
+                return new[0]["node_id"]
+            if proc.poll() is not None:
+                raise RuntimeError(
+                    f"host agent exited rc={proc.returncode} before registering"
+                )
+            time.sleep(0.05)
+        raise TimeoutError("host agent did not register within timeout")
+
+    def kill_node_agent(self, index: int = 0) -> None:
+        """Hard-kill a remote agent process (chaos testing: node failure)."""
+        proc = self._agent_procs[index]
+        proc.kill()
+        proc.wait(timeout=5)
 
     def shutdown(self) -> None:
         api.shutdown()
+        for proc in self._agent_procs:
+            if proc.poll() is None:
+                try:
+                    proc.terminate()
+                    proc.wait(timeout=3)
+                except Exception:
+                    try:
+                        proc.kill()
+                    except Exception:
+                        pass
+        self._agent_procs.clear()

@@ -67,6 +67,14 @@ class NodeInfo:
     spawning: int = 0
     spawning_tpu: int = 0
     workers: Set[str] = field(default_factory=set)
+    # Host-agent fields (None for in-controller virtual nodes): the agent's
+    # control connection, its pull-server address, and its host identity
+    # (reference: raylet registration with the GCS, gcs_node_manager.h).
+    agent_conn: Optional[protocol.Connection] = None
+    agent_addr: Optional[Tuple[str, int]] = None
+    host_id: Optional[str] = None
+    last_heartbeat: float = 0.0
+    arena_stats: Dict[str, int] = field(default_factory=dict)
 
 
 @dataclass
@@ -78,6 +86,7 @@ class WorkerInfo:
     current_task: Optional[str] = None
     actor_ids: Set[str] = field(default_factory=set)
     proc: Optional[subprocess.Popen] = None
+    spawn_token: Optional[str] = None  # set for agent-spawned workers
     # TPU-capable workers carry the accelerator runtime (axon/PJRT plugin)
     # and cost seconds to start; plain workers skip it and start in ~0.3s.
     tpu_capable: bool = False
@@ -139,8 +148,10 @@ class Controller:
         self._node_counter = 0
         self._spawned_procs: Dict[str, subprocess.Popen] = {}  # spawn_token -> proc
         self._tpu_spawn_tokens: Set[str] = set()  # tokens of TPU-capable spawns
+        self._agent_spawns: Dict[str, str] = {}  # outstanding agent spawn token -> node_id
         self._sched_wakeup = asyncio.Event()
         self._sched_task: Optional[asyncio.Task] = None
+        self._health_task: Optional[asyncio.Task] = None
         self._closing = False
         self.start_time = time.time()
         # Bounded task-event history: feeds the state API (`ray list tasks`,
@@ -154,15 +165,19 @@ class Controller:
         # Created here so worker spawns inherit RTPU_ARENA via env; falls
         # back to per-object segments when the native lib is unavailable.
         from . import native_store
+        from .object_store import current_host_id
 
         self._arena = native_store.create_node_arena(uuid.uuid4().hex)
+        self.host_id = current_host_id()
 
     # ------------------------------------------------------------------ setup
 
     async def start(self) -> Tuple[str, int]:
         self.server = await asyncio.start_server(self._on_connection, self.host, self.port)
         self.port = self.server.sockets[0].getsockname()[1]
-        self._sched_task = asyncio.get_running_loop().create_task(self._scheduler_loop())
+        loop = asyncio.get_running_loop()
+        self._sched_task = loop.create_task(self._scheduler_loop())
+        self._health_task = loop.create_task(self._health_check_loop())
         return self.host, self.port
 
     def add_node(
@@ -190,6 +205,12 @@ class Controller:
                 await w.conn.send({"kind": "shutdown"})
             except Exception:
                 pass
+        for n in self.nodes.values():
+            if n.agent_conn is not None:
+                try:
+                    await n.agent_conn.send({"kind": "shutdown"})
+                except Exception:
+                    pass
         await asyncio.sleep(0.05)
         for w in list(self.workers.values()):
             if w.proc is not None and w.proc.poll() is None:
@@ -198,6 +219,8 @@ class Controller:
                 except Exception:
                     pass
         for loc in self.objects.values():
+            if loc.host_id is not None and loc.host_id != self.host_id:
+                continue  # remote bytes die with their agent's arena
             free_location(loc)
         self.objects.clear()
         from . import native_store
@@ -205,6 +228,8 @@ class Controller:
         native_store.close_arena(destroy=True)
         if self._sched_task is not None:
             self._sched_task.cancel()
+        if self._health_task is not None:
+            self._health_task.cancel()
         if self.server is not None:
             self.server.close()
 
@@ -233,9 +258,56 @@ class Controller:
         if self._closing:
             return
         self.driver_conns.discard(conn)
+        for node in self.nodes.values():
+            if node.agent_conn is conn:
+                await self._on_node_death(node)
+                return
         dead = [w for w in self.workers.values() if w.conn is conn]
         for w in dead:
             await self._on_worker_death(w)
+
+    async def _on_node_death(self, node: NodeInfo) -> None:
+        """Agent connection lost (or heartbeat timed out): the whole host is
+        gone. Reference: GCS node-failure handling, gcs_node_manager.h —
+        every worker and actor on the node dies with it."""
+        if not node.alive:
+            return
+        node.alive = False
+        node.agent_conn = None
+        node.agent_addr = None
+        for wid in list(node.workers):
+            w = self.workers.get(wid)
+            if w is not None:
+                await self._on_worker_death(w)
+                try:
+                    await w.conn.close()
+                except Exception:
+                    pass
+        node.workers.clear()
+        node.spawning = 0
+        node.spawning_tpu = 0
+        for tok, nid in list(self._agent_spawns.items()):
+            if nid == node.node_id:
+                self._agent_spawns.pop(tok, None)
+                self._tpu_spawn_tokens.discard(tok)
+        # Objects whose bytes lived only on the dead host are lost: replace
+        # their locations with a clear error so a later get() doesn't dial a
+        # dead pull server (pre-lineage semantics; object reconstruction is
+        # the recovery layer's job, reference object_recovery_manager.h).
+        for oid, loc in list(self.objects.items()):
+            if (
+                loc.inline is None
+                and loc.host_id is not None
+                and loc.host_id == node.host_id
+            ):
+                self._store_error(
+                    oid,
+                    ObjectLostError(
+                        f"object {oid[:8]} was lost when node "
+                        f"{node.node_id[:8]} died"
+                    ),
+                )
+        self._wake_scheduler()
 
     async def _on_worker_death(self, w: WorkerInfo) -> None:
         self.workers.pop(w.worker_id, None)
@@ -273,7 +345,7 @@ class Controller:
         role = msg["role"]
         if role == "driver":
             self.driver_conns.add(conn)
-            return {"ok": True}
+            return {"ok": True, "controller_host_id": self.host_id}
         worker_id = msg["worker_id"]
         node_id = msg["node_id"]
         w = self.workers.get(worker_id)
@@ -292,6 +364,9 @@ class Controller:
             proc = self._spawned_procs.pop(token, None)
             if proc is not None:
                 w.proc = proc
+            else:
+                w.spawn_token = token  # agent-spawned: proc lives on the agent
+                self._agent_spawns.pop(token, None)  # no longer outstanding
             was_tpu_spawn = token in self._tpu_spawn_tokens
             self._tpu_spawn_tokens.discard(token)
         node = self.nodes.get(node_id)
@@ -371,8 +446,18 @@ class Controller:
     async def _h_free_objects(self, conn, msg):
         for oid in msg["object_ids"]:
             loc = self.objects.pop(oid, None)
-            if loc is not None:
-                free_location(loc)
+            if loc is None:
+                continue
+            if loc.host_id is not None and loc.host_id != self.host_id:
+                # Bytes live on another host: route the free to its agent.
+                node = self.nodes.get(loc.node_id or "")
+                if node is not None and node.agent_conn is not None:
+                    try:
+                        await node.agent_conn.send({"kind": "free_object", "loc": loc})
+                    except Exception:
+                        pass
+                continue
+            free_location(loc)
         return {"ok": True}
 
     async def _h_register_function(self, conn, msg):
@@ -601,6 +686,15 @@ class Controller:
                     w.proc.terminate()
                 except Exception:
                     pass
+            elif w.spawn_token is not None:
+                node = self.nodes.get(w.node_id)
+                if node is not None and node.agent_conn is not None:
+                    try:
+                        await node.agent_conn.send(
+                            {"kind": "kill_worker", "spawn_token": w.spawn_token}
+                        )
+                    except Exception:
+                        pass
             await self._on_worker_death(w)
         return {"ok": True}
 
@@ -760,6 +854,82 @@ class Controller:
     async def _h_ping(self, conn, msg):
         return {"pong": True, "t": time.time()}
 
+    # host agents -------------------------------------------------------------
+
+    async def _h_register_node(self, conn, msg):
+        """A host agent joins the cluster (reference: raylet node
+        registration with the GCS, gcs_node_manager.h)."""
+        nid = msg["node_id"]
+        self._node_counter += 1
+        self.nodes[nid] = NodeInfo(
+            node_id=nid,
+            resources=dict(msg["resources"]),
+            available=dict(msg["resources"]),
+            index=self._node_counter,
+            labels=msg.get("labels") or {},
+            agent_conn=conn,
+            agent_addr=tuple(msg["agent_addr"]),
+            host_id=msg.get("host_id"),
+            last_heartbeat=time.monotonic(),
+        )
+        self._wake_scheduler()
+        return {"ok": True, "controller_host_id": self.host_id}
+
+    async def _h_heartbeat(self, conn, msg):
+        node = self.nodes.get(msg["node_id"])
+        if node is not None:
+            node.last_heartbeat = time.monotonic()
+            node.arena_stats = msg.get("arena") or {}
+        return None
+
+    async def _h_spawn_exited(self, conn, msg):
+        """Agent reports a spawned worker process exited. If it never
+        registered, unwind the spawning counters (local spawns use
+        _watch_spawn for the same purpose). Registered workers are cleaned
+        up via their own conn drop — their token is no longer outstanding,
+        so this must not decrement some other pending spawn's count."""
+        token = msg["spawn_token"]
+        node_id = self._agent_spawns.pop(token, None)
+        node = self.nodes.get(node_id or "")
+        if node is not None:
+            node.spawning = max(0, node.spawning - 1)
+            if token in self._tpu_spawn_tokens:
+                node.spawning_tpu = max(0, node.spawning_tpu - 1)
+        self._tpu_spawn_tokens.discard(token)
+        self._wake_scheduler()
+        return None
+
+    async def _h_get_node_agent(self, conn, msg):
+        """Resolve the pull-serving address for a node: its agent, or this
+        controller for in-controller (head/virtual) nodes."""
+        node = self.nodes.get(msg.get("node_id") or "")
+        if node is not None and node.agent_addr is not None:
+            return {"host": node.agent_addr[0], "port": node.agent_addr[1]}
+        return {"host": self.host, "port": self.port}
+
+    async def _h_pull_chunk(self, conn, msg):
+        """Serve object bytes for head-host locations (the controller is the
+        head node's agent)."""
+        from .transfer import read_location_range
+
+        return read_location_range(msg["loc"], msg["offset"], msg["length"])
+
+    async def _health_check_loop(self) -> None:
+        """Mark agent nodes dead when heartbeats stop (reference:
+        gcs_health_check_manager.h:39 periodic health checks)."""
+        timeout = float(os.environ.get("RTPU_NODE_TIMEOUT_S", "10"))
+        while True:
+            await asyncio.sleep(min(2.0, timeout / 3))
+            now = time.monotonic()
+            for node in list(self.nodes.values()):
+                if (
+                    node.alive
+                    and node.agent_conn is not None
+                    and node.last_heartbeat
+                    and now - node.last_heartbeat > timeout
+                ):
+                    await self._on_node_death(node)
+
     # ---------------------------------------------------------- object helpers
 
     def _store_location(self, loc: ObjectLocation) -> None:
@@ -914,6 +1084,25 @@ class Controller:
         if needs_tpu:
             node.spawning_tpu += 1
         spawn_token = uuid.uuid4().hex
+        if node.agent_conn is not None:
+            # Delegate to the host agent (lease-style spawn: the reference's
+            # raylet owns its worker pool, worker_pool.h:159; the controller
+            # only grants the lease).
+            self._agent_spawns[spawn_token] = node.node_id
+            if needs_tpu:
+                self._tpu_spawn_tokens.add(spawn_token)
+            sys_path = os.pathsep.join(p or os.getcwd() for p in sys.path)
+            asyncio.get_running_loop().create_task(
+                node.agent_conn.send(
+                    {
+                        "kind": "spawn_worker",
+                        "spawn_token": spawn_token,
+                        "tpu": needs_tpu,
+                        "sys_path": sys_path,
+                    }
+                )
+            )
+            return
         env = dict(os.environ)
         env["RTPU_CONTROLLER"] = f"{self.host}:{self.port}"
         env["RTPU_NODE_ID"] = node.node_id
@@ -1017,6 +1206,11 @@ class WorkerCrashedError(RayTpuError):
 
 class ActorDiedError(RayTpuError):
     pass
+
+
+class ObjectLostError(RayTpuError):
+    """The bytes of an object died with their host and no lineage could
+    reconstruct them (reference: ray.exceptions.ObjectLostError)."""
 
 
 class DependencyError(RayTpuError):

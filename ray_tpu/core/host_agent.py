@@ -1,0 +1,278 @@
+"""Per-host daemon: the node-level agent of the cluster.
+
+Role-equivalent to the reference's raylet (ray: src/ray/raylet/main.cc:123
+starting NodeManager + local object manager, node_manager.h:119): one agent
+per host, it
+
+- registers its host as a node with the controller over TCP,
+- owns the host's object arena (creates it; local workers inherit it),
+- spawns and supervises worker processes on *its* host when the controller
+  grants a lease (spawn delegation replaces the controller's local Popen),
+- serves chunked object pulls to remote peers (core.transfer protocol,
+  reference object_manager.proto Push/Pull),
+- heartbeats node health + arena stats to the controller
+  (gcs_health_check_manager.h:39 semantics),
+- fate-shares: when the controller connection drops, it kills its workers
+  and exits (raylet workers fate-share with their raylet).
+
+Entrypoint: ``python -m ray_tpu.core.host_agent --controller HOST:PORT``.
+Tests simulate a second host on one machine by overriding RTPU_HOST_ID
+(--host-id), which forces every cross-"host" object read through the real
+TCP pull path.
+"""
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+import uuid
+from typing import Any, Dict, Optional
+
+from . import native_store, protocol
+from .ids import NodeID
+from .transfer import read_location_range
+
+HEARTBEAT_S = float(os.environ.get("RTPU_HEARTBEAT_S", "2.0"))
+
+
+class HostAgent:
+    def __init__(
+        self,
+        controller_addr: str,
+        *,
+        resources: Optional[Dict[str, float]] = None,
+        labels: Optional[Dict[str, str]] = None,
+        host_id: Optional[str] = None,
+        serve_host: str = "127.0.0.1",
+        serve_port: int = 0,
+    ):
+        self.controller_addr = controller_addr
+        self.node_id = NodeID.generate()
+        self.resources = dict(resources or {"CPU": float(os.cpu_count() or 1)})
+        self.labels = dict(labels or {})
+        self.serve_host = serve_host
+        self.serve_port = serve_port
+        self.ctrl: Optional[protocol.Connection] = None
+        self.server: Optional[asyncio.base_events.Server] = None
+        self.procs: Dict[str, subprocess.Popen] = {}  # spawn_token -> proc
+        self.worker_tokens: Dict[str, str] = {}  # worker_id -> spawn_token
+        self._stop = asyncio.Event()
+        if host_id:
+            os.environ["RTPU_HOST_ID"] = host_id
+        from .object_store import current_host_id
+
+        self.host_id = current_host_id()
+        # The agent owns this host's arena; spawned workers inherit RTPU_ARENA.
+        self.arena = native_store.create_node_arena(self.node_id)
+
+    # ---------------------------------------------------------------- startup
+
+    async def start(self) -> None:
+        self.server = await asyncio.start_server(
+            self._on_peer, self.serve_host, self.serve_port
+        )
+        self.serve_port = self.server.sockets[0].getsockname()[1]
+        host, port = self.controller_addr.rsplit(":", 1)
+        self.ctrl = await protocol.connect(
+            host, int(port), self._on_controller_msg, name="agent->controller"
+        )
+        await self.ctrl.request(
+            {
+                "kind": "register_node",
+                "node_id": self.node_id,
+                "resources": self.resources,
+                "labels": self.labels,
+                "agent_addr": [self.serve_host, self.serve_port],
+                "host_id": self.host_id,
+                "arena": self.arena.name if self.arena else None,
+            }
+        )
+        loop = asyncio.get_running_loop()
+        loop.create_task(self._heartbeat_loop())
+        loop.create_task(self._watch_controller())
+        loop.create_task(self._reap_loop())
+
+    async def _watch_controller(self) -> None:
+        await self.ctrl.closed.wait()
+        # Fate-share: controller gone -> this node is orphaned.
+        self._terminate_workers()
+        self._stop.set()
+
+    async def run_forever(self) -> None:
+        await self._stop.wait()
+        if self.server is not None:
+            self.server.close()
+        self._terminate_workers()
+        native_store.close_arena(destroy=True)
+
+    def _terminate_workers(self) -> None:
+        for proc in self.procs.values():
+            if proc.poll() is None:
+                try:
+                    proc.terminate()
+                except Exception:
+                    pass
+        self.procs.clear()
+
+    # --------------------------------------------------------- controller rpc
+
+    async def _on_controller_msg(self, conn, msg: Dict[str, Any]) -> Any:
+        kind = msg["kind"]
+        if kind == "spawn_worker":
+            return self._spawn_worker(msg)
+        if kind == "kill_worker":
+            tok = msg.get("spawn_token") or self.worker_tokens.get(
+                msg.get("worker_id", "")
+            )
+            proc = self.procs.pop(tok, None) if tok else None
+            if proc is not None and proc.poll() is None:
+                try:
+                    proc.terminate()
+                except Exception:
+                    pass
+            return {"ok": True}
+        if kind == "free_object":
+            loc = msg["loc"]
+            from .object_store import free_location
+
+            try:
+                free_location(loc)
+            except Exception:
+                pass
+            return {"ok": True}
+        if kind == "shutdown":
+            self._stop.set()
+            return {"ok": True}
+        if kind == "pull_chunk":
+            return read_location_range(msg["loc"], msg["offset"], msg["length"])
+        raise ValueError(f"host_agent: unknown message kind {kind!r}")
+
+    def _spawn_worker(self, msg: Dict[str, Any]) -> Dict[str, Any]:
+        spawn_token = msg["spawn_token"]
+        env = dict(os.environ)
+        env["RTPU_CONTROLLER"] = self.controller_addr
+        env["RTPU_NODE_ID"] = self.node_id
+        env["RTPU_SPAWN_TOKEN"] = spawn_token
+        env["RTPU_HOST_ID"] = self.host_id
+        if self.arena is not None:
+            env["RTPU_ARENA"] = self.arena.name
+        if msg.get("tpu"):
+            env["RTPU_TPU_WORKER"] = "1"
+        else:
+            env.pop("PALLAS_AXON_POOL_IPS", None)
+        pkg_root = os.path.dirname(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        )
+        env["PYTHONPATH"] = pkg_root + os.pathsep + env.get("PYTHONPATH", "")
+        if msg.get("sys_path"):
+            env["RTPU_SYS_PATH"] = msg["sys_path"]
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu.core.worker_main"],
+            env=env,
+        )
+        self.procs[spawn_token] = proc
+        return {"ok": True, "pid": proc.pid}
+
+    async def _reap_loop(self) -> None:
+        """Report workers that die before (or after) registering so the
+        controller's spawning counters and worker table stay truthful."""
+        while not self._stop.is_set():
+            await asyncio.sleep(0.2)
+            for tok, proc in list(self.procs.items()):
+                if proc.poll() is not None:
+                    self.procs.pop(tok, None)
+                    try:
+                        await self.ctrl.send(
+                            {"kind": "spawn_exited", "spawn_token": tok,
+                             "node_id": self.node_id,
+                             "returncode": proc.returncode}
+                        )
+                    except Exception:
+                        pass
+
+    async def _heartbeat_loop(self) -> None:
+        while not self._stop.is_set():
+            stats = self.arena.stats() if self.arena else {}
+            try:
+                await self.ctrl.send(
+                    {
+                        "kind": "heartbeat",
+                        "node_id": self.node_id,
+                        "t": time.time(),
+                        "arena": stats,
+                        "num_workers": len(self.procs),
+                    }
+                )
+            except Exception:
+                pass
+            try:
+                await asyncio.wait_for(self._stop.wait(), HEARTBEAT_S)
+            except asyncio.TimeoutError:
+                pass
+
+    # ------------------------------------------------------------ pull server
+
+    async def _on_peer(self, reader, writer) -> None:
+        conn = protocol.Connection(reader, writer, self._on_peer_msg, name="agent-peer")
+        conn.start()
+        await conn.closed.wait()
+
+    async def _on_peer_msg(self, conn, msg: Dict[str, Any]) -> Any:
+        kind = msg["kind"]
+        if kind == "pull_chunk":
+            # Range reads touch shm only; run inline (no blocking I/O).
+            return read_location_range(msg["loc"], msg["offset"], msg["length"])
+        if kind == "ping":
+            return {"pong": True, "node_id": self.node_id}
+        raise ValueError(f"host_agent peer: unknown message kind {kind!r}")
+
+
+async def _amain(args) -> int:
+    agent = HostAgent(
+        args.controller,
+        resources=json.loads(args.resources) if args.resources else None,
+        labels=json.loads(args.labels) if args.labels else None,
+        host_id=args.host_id or None,
+        serve_port=args.port,
+    )
+
+    def _sig(*_a):
+        agent._stop.set()
+
+    loop = asyncio.get_running_loop()
+    for s in (signal.SIGTERM, signal.SIGINT):
+        try:
+            loop.add_signal_handler(s, _sig)
+        except NotImplementedError:
+            pass
+    try:
+        await agent.start()
+    except (ConnectionError, OSError) as e:
+        sys.stderr.write(f"host_agent: cannot reach controller: {e!r}\n")
+        return 2
+    await agent.run_forever()
+    return 0
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description="ray_tpu per-host agent daemon")
+    ap.add_argument("--controller", required=True, help="controller HOST:PORT")
+    ap.add_argument("--resources", default="", help='JSON, e.g. {"CPU": 4}')
+    ap.add_argument("--labels", default="", help="JSON labels")
+    ap.add_argument("--host-id", default="", help="override host identity (tests)")
+    ap.add_argument("--port", type=int, default=0, help="pull-server port")
+    args = ap.parse_args()
+    if args.host_id:
+        # Must be set before the arena env leaks to children.
+        os.environ["RTPU_HOST_ID"] = args.host_id
+    return asyncio.run(_amain(args))
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -181,6 +181,7 @@ class NativeArena:
             self._lib.rtpu_store_unlink(name.encode())
         except Exception:
             pass
+        _unregister_arena(name)
 
 
 # ------------------------------------------------------- per-process state
@@ -197,6 +198,68 @@ def arena_name_for_node(node_id: str) -> str:
     return f"/rtpu_arena_{node_id[:24]}"
 
 
+_REGISTRY_DIR = "/tmp/rtpu_arenas"
+
+
+def _register_arena(name: str) -> None:
+    """Record creator pid so a later process can GC arenas whose creator was
+    SIGKILLed (a hard-killed agent cannot unlink its own arena; the reference
+    raylet has the same problem and relies on external cleanup)."""
+    try:
+        os.makedirs(_REGISTRY_DIR, exist_ok=True)
+        with open(os.path.join(_REGISTRY_DIR, name.lstrip("/")), "w") as f:
+            f.write(str(os.getpid()))
+    except OSError:
+        pass
+
+
+def _unregister_arena(name: str) -> None:
+    try:
+        os.unlink(os.path.join(_REGISTRY_DIR, name.lstrip("/")))
+    except OSError:
+        pass
+
+
+def gc_stale_arenas() -> int:
+    """Unlink arenas whose creator process is dead. Runs at every arena
+    create; returns the number reclaimed."""
+    lib = load_library()
+    if lib is None:
+        return 0
+    n = 0
+    try:
+        entries = os.listdir(_REGISTRY_DIR)
+    except OSError:
+        return 0
+    for entry in entries:
+        path = os.path.join(_REGISTRY_DIR, entry)
+        try:
+            with open(path) as f:
+                pid = int(f.read().strip() or "0")
+        except (OSError, ValueError):
+            pid = 0
+        alive = False
+        if pid > 0:
+            try:
+                os.kill(pid, 0)
+                alive = True
+            except ProcessLookupError:
+                alive = False
+            except PermissionError:
+                alive = True
+        if not alive:
+            try:
+                lib.rtpu_store_unlink(("/" + entry).encode())
+            except Exception:
+                pass
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+            n += 1
+    return n
+
+
 def create_node_arena(node_id: str) -> Optional[NativeArena]:
     """Controller-side: create this node's arena and advertise it via env
     (workers inherit the env at spawn)."""
@@ -206,6 +269,7 @@ def create_node_arena(node_id: str) -> Optional[NativeArena]:
     with _arena_state_lock:
         if _arena is not None:
             return _arena
+        gc_stale_arenas()
         size = int(os.environ.get(_ARENA_SIZE_ENV, DEFAULT_ARENA_SIZE))
         name = arena_name_for_node(node_id)
         arena = NativeArena.create(name, size)
@@ -218,6 +282,7 @@ def create_node_arena(node_id: str) -> Optional[NativeArena]:
         if arena is not None:
             os.environ[_ARENA_ENV] = name
             _arena = arena
+            _register_arena(name)
         return arena
 
 

@@ -22,6 +22,7 @@ memcpy'd directly into the shared-memory segment.
 """
 from __future__ import annotations
 
+import os
 import pickle
 import secrets
 from dataclasses import dataclass, field
@@ -31,6 +32,37 @@ from typing import Any, Dict, List, Optional, Tuple
 INLINE_THRESHOLD = 256 * 1024
 
 _HDR = 8  # u64 little-endian length of the pickle stream, then buffer table
+
+_machine_id_cache: Optional[str] = None
+
+
+def current_host_id() -> str:
+    """Identity of the host this process runs on, for same-host detection.
+
+    Processes with equal host ids share POSIX shm (arena / per-object
+    segments); differing ids force the inter-node transfer path
+    (core.transfer). RTPU_HOST_ID overrides the machine identity so tests can
+    simulate a remote host on one machine — the bytes then really stream over
+    TCP via the host agent (reference: node_manager's object manager serving
+    Push/Pull, src/ray/object_manager/object_manager.h).
+    """
+    env = os.environ.get("RTPU_HOST_ID")
+    if env:
+        return env
+    global _machine_id_cache
+    if _machine_id_cache is None:
+        mid = None
+        try:
+            with open("/etc/machine-id") as f:
+                mid = f.read().strip()
+        except OSError:
+            pass
+        if not mid:
+            import socket
+
+            mid = socket.gethostname()
+        _machine_id_cache = mid
+    return _machine_id_cache
 
 
 def _untrack(name: str) -> None:
@@ -66,6 +98,9 @@ class ObjectLocation:
     # name + the object's 64-bit id within it.
     arena: Optional[str] = None
     arena_oid: int = 0
+    # Host identity of the producing process (current_host_id()); a reader on
+    # a different host fetches via the owner node's agent instead of shm.
+    host_id: Optional[str] = None
 
 
 def serialize(value: Any) -> Tuple[bytes, List[pickle.PickleBuffer]]:
@@ -80,8 +115,11 @@ def put_bytes(value: Any, object_id: str, node_id: str) -> ObjectLocation:
     native arena (preferred) or a per-object shm segment (fallback)."""
     data, oob = serialize(value)
     total = len(data) + sum(len(b.raw()) for b in oob)
-    if total <= INLINE_THRESHOLD:
+    if total <= INLINE_THRESHOLD or os.environ.get("RTPU_FORCE_INLINE") == "1":
         # Re-pickle in-band: cheap at this size, keeps the inline path simple.
+        # RTPU_FORCE_INLINE covers processes with no pull-server on their host
+        # (a driver connected to a remote cluster): shm there is unreachable
+        # by every consumer, so bytes must ride the control plane.
         if oob:
             data = pickle.dumps(value, protocol=pickle.HIGHEST_PROTOCOL)
         return ObjectLocation(object_id=object_id, size=len(data), inline=data, node_id=node_id)
@@ -114,6 +152,7 @@ def put_bytes(value: Any, object_id: str, node_id: str) -> ObjectLocation:
         buffers=table,
         pickle_off=pickle_off,
         pickle_len=pickle_len,
+        host_id=current_host_id(),
     )
     seg.close()
     return loc
@@ -152,7 +191,7 @@ def _put_arena(data, oob, total, object_id, node_id) -> Optional[ObjectLocation]
     return ObjectLocation(
         object_id=object_id, size=total, node_id=node_id,
         buffers=table, pickle_off=pickle_off, pickle_len=pickle_len,
-        arena=arena.name, arena_oid=oid)
+        arena=arena.name, arena_oid=oid, host_id=current_host_id())
 
 
 class _SegmentCache:
@@ -198,6 +237,10 @@ def get_bytes(loc: ObjectLocation, copy: bool = True) -> Any:
     """
     if loc.inline is not None:
         return pickle.loads(loc.inline)
+    if loc.host_id is not None and loc.host_id != current_host_id():
+        from .transfer import fetch_remote_value
+
+        return fetch_remote_value(loc)
     if loc.arena is not None:
         return _get_arena_bytes(loc, copy)
     assert loc.shm_name is not None

@@ -1,0 +1,133 @@
+"""Inter-node object transfer: chunked pull of object bytes over TCP.
+
+Role-equivalent to the reference's object manager push/pull protocol
+(ray: src/ray/object_manager/object_manager.h, object_manager.proto Push/Pull
+chunked transfer), collapsed to a pull-only design: the consumer asks the
+node that *produced* an object for byte ranges and reassembles locally.
+
+Serving side: `read_location_range(loc, offset, length)` — runs on any
+process on the producer's host (the host agent, or the controller for the
+head node); it attaches the arena / shm segment named in the location and
+returns raw bytes. No per-agent object directory is needed: the
+ObjectLocation itself is the capability.
+
+Consumer side: `fetch_remote_value(loc)` — resolves the producer node's
+serving address via the controller (cached), pulls `PULL_CHUNK`-sized ranges,
+and unpickles with the out-of-band buffer table from the location.
+"""
+from __future__ import annotations
+
+import pickle
+import threading
+from typing import Dict, Optional, Tuple
+
+from .object_store import ObjectLocation
+
+PULL_CHUNK = 4 * 1024 * 1024
+
+
+def read_location_range(loc: ObjectLocation, offset: int, length: int) -> bytes:
+    """Serve `length` bytes at `offset` of the object at `loc` (local host)."""
+    if loc.inline is not None:
+        return bytes(loc.inline[offset : offset + length])
+    if loc.arena is not None:
+        from . import native_store
+
+        arena = native_store.get_arena()
+        if arena is None or arena.name != loc.arena:
+            arena = native_store.attach_named(loc.arena)
+        if arena is None:
+            raise RuntimeError(f"cannot attach arena {loc.arena!r} to serve pull")
+        view = arena.get(loc.arena_oid)
+        if view is None:
+            raise KeyError(f"object {loc.object_id[:8]} missing from arena")
+        try:
+            return bytes(view[offset : offset + length])
+        finally:
+            del view
+            arena.release(loc.arena_oid)
+    assert loc.shm_name is not None
+    from .object_store import _segments
+
+    seg = _segments.attach(loc.shm_name)
+    return bytes(seg.buf[offset : offset + length])
+
+
+def decode_value(loc: ObjectLocation, buf: bytes):
+    """Unpickle an object's assembled bytes using the location's layout."""
+    data = buf[loc.pickle_off : loc.pickle_off + loc.pickle_len]
+    mv = memoryview(buf)
+    bufs = [mv[off : off + n] for off, n in loc.buffers]
+    return pickle.loads(data, buffers=bufs)
+
+
+# ---------------------------------------------------------------- pull client
+
+_agent_addr_cache: Dict[str, Tuple[str, int]] = {}  # node_id -> (host, port)
+_conn_cache: Dict[Tuple[str, int], "object"] = {}  # addr -> CoreClient
+_cache_lock = threading.Lock()
+
+
+def _resolve_serving_addr(node_id: Optional[str]) -> Tuple[str, int]:
+    from . import context as ctx
+
+    with _cache_lock:
+        addr = _agent_addr_cache.get(node_id or "")
+    if addr is not None:
+        return addr
+    wc = ctx.get_worker_context()
+    info = wc.client.request({"kind": "get_node_agent", "node_id": node_id})
+    addr = (info["host"], int(info["port"]))
+    with _cache_lock:
+        _agent_addr_cache[node_id or ""] = addr
+    return addr
+
+
+def _serving_client(addr: Tuple[str, int]):
+    from .client import CoreClient
+
+    with _cache_lock:
+        cli = _conn_cache.get(addr)
+    if cli is not None:
+        return cli
+    cli = CoreClient(addr[0], addr[1])
+    with _cache_lock:
+        prev = _conn_cache.get(addr)
+        if prev is not None:
+            cli.close()
+            return prev
+        _conn_cache[addr] = cli
+    return cli
+
+
+def fetch_remote_value(loc: ObjectLocation):
+    """Pull a remote object's bytes from its producer host and decode."""
+    addr = _resolve_serving_addr(loc.node_id)
+    cli = _serving_client(addr)
+    buf = bytearray(loc.size)
+    off = 0
+    while off < loc.size:
+        n = min(PULL_CHUNK, loc.size - off)
+        chunk = cli.request(
+            {"kind": "pull_chunk", "loc": loc, "offset": off, "length": n}
+        )
+        if not chunk:
+            raise ConnectionError(
+                f"short pull of object {loc.object_id[:8]} at offset {off}"
+            )
+        buf[off : off + len(chunk)] = chunk
+        off += len(chunk)
+    return decode_value(loc, bytes(buf))
+
+
+def reset_transfer_caches() -> None:
+    """Drop cached agent addresses/connections (shutdown / re-init)."""
+    with _cache_lock:
+        conns = list(_conn_cache.values())
+        _conn_cache.clear()
+        _agent_addr_cache.clear()
+    for c in conns:
+        try:
+            c.close()
+        except Exception:
+            pass
