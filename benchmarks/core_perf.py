@@ -1,0 +1,123 @@
+"""Core control/data-plane microbenchmarks.
+
+Role parity: the reference's python/ray/_private/ray_perf.py:93 +
+release/microbenchmark suite — the committed scalability-envelope numbers
+(BASELINE.md rows: tasks queued, plasma objects in one get/wait, object
+sizes). Prints one JSON line per metric; run from the repo root:
+
+    python benchmarks/core_perf.py
+
+Numbers are committed to benchmarks/PERF.json; tests/test_perf_regression.py
+asserts conservative floors so control-plane regressions fail CI.
+"""
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+import ray_tpu
+
+
+def bench(name, n, fn, unit="ops/s"):
+    t0 = time.perf_counter()
+    fn()
+    dt = time.perf_counter() - t0
+    rate = n / dt
+    out = {"metric": name, "value": round(rate, 1), "unit": unit,
+           "n": n, "wall_s": round(dt, 3)}
+    print(json.dumps(out), flush=True)
+    return out
+
+
+def main():
+    import os
+
+    # Size the arena for the 512MB put working set: steady-state arena
+    # throughput is the number of interest, not fallback-segment churn.
+    os.environ.setdefault("RTPU_ARENA_SIZE", str(1 << 30))
+    results = []
+    ray_tpu.init(num_cpus=4)
+
+    @ray_tpu.remote
+    def nop():
+        return None
+
+    @ray_tpu.remote
+    class Nop:
+        def call(self):
+            return None
+
+    # Warm the worker pool so spawn latency isn't measured.
+    ray_tpu.get([nop.remote() for _ in range(8)])
+
+    # 1. task submit+get round-trips, serial batches
+    results.append(bench(
+        "tasks_per_s", 500,
+        lambda: ray_tpu.get([nop.remote() for _ in range(500)])))
+
+    # 2. actor method calls
+    a = Nop.remote()
+    ray_tpu.get(a.call.remote())
+    results.append(bench(
+        "actor_calls_per_s", 500,
+        lambda: ray_tpu.get([a.call.remote() for _ in range(500)])))
+
+    # 3. put throughput (64MB arrays through the arena)
+    arr = np.random.default_rng(0).standard_normal(8 * 1024 * 1024)  # 64MB
+    refs = []
+
+    def puts():
+        for _ in range(8):
+            refs.append(ray_tpu.put(arr))
+
+    r = bench("put_gbps", 8 * arr.nbytes / 1e9, puts, unit="GB/s")
+    results.append(r)
+
+    # 4. get throughput (same objects back)
+    results.append(bench(
+        "get_gbps", 8 * arr.nbytes / 1e9,
+        lambda: [ray_tpu.get(x) for x in refs], unit="GB/s"))
+    ray_tpu.free(refs)
+
+    # 5. many small puts (control-plane inline path)
+    results.append(bench(
+        "small_puts_per_s", 2000,
+        lambda: [ray_tpu.put(i) for i in range(2000)]))
+
+    # 6. 10k-object wait (the envelope row: 10k+ plasma objects in one
+    # ray.get/wait). Objects land while wait is outstanding.
+    many = [ray_tpu.put(i) for i in range(10_000)]
+    t0 = time.perf_counter()
+    ready, not_ready = ray_tpu.wait(many, num_returns=10_000, timeout=60)
+    dt = time.perf_counter() - t0
+    out = {"metric": "wait_10k_objects_s", "value": round(dt, 3), "unit": "s",
+           "ready": len(ready)}
+    print(json.dumps(out), flush=True)
+    results.append(out)
+    ray_tpu.free(many)
+
+    # 7. wide dependency fan-in: one task consuming 1000 object args' refs
+    deps = [ray_tpu.put(1) for _ in range(1000)]
+
+    @ray_tpu.remote
+    def count(xs):
+        return len(xs)
+
+    t0 = time.perf_counter()
+    got = ray_tpu.get(count.remote(deps))  # refs pass through (not resolved)
+    dt = time.perf_counter() - t0
+    out = {"metric": "fanin_1000_refs_s", "value": round(dt, 3), "unit": "s",
+           "got": got}
+    print(json.dumps(out), flush=True)
+    results.append(out)
+
+    ray_tpu.shutdown()
+    return results
+
+
+if __name__ == "__main__":
+    rs = main()
+    with open(__file__.replace("core_perf.py", "PERF.json"), "w") as f:
+        json.dump({r["metric"]: r for r in rs}, f, indent=1)

@@ -20,7 +20,7 @@ from ray_tpu.train.step import transformer_train_step
 from ray_tpu.util.accelerators import peak_flops_per_chip
 
 
-def run_variant(remat, policy, batch, seq, steps, warmup=3):
+def run_variant(remat, policy, batch, seq, steps, warmup=2):
     cfg = bench_350m(remat=remat, remat_policy=policy)
     dev = jax.devices()[0]
     mesh = make_mesh(MeshSpec(), devices=[dev])
@@ -85,17 +85,13 @@ if __name__ == "__main__":
             print(json.dumps({"check": "flash_hlo", "error": str(e)[:200]}), flush=True)
 
     variants = [
-        (True, "full", 8),    # round-2 configuration (baseline)
-        (False, None, 8),
-        (True, "dots", 8),
-        (False, None, 16),
-        (False, None, 32),
-        (True, "dots", 32),
+        (True, "dots", 10, 1024),
+        (True, "dots", 12, 1024),
     ]
-    for remat, policy, batch in variants:
+    for remat, policy, batch, seq in variants:
         try:
-            r = run_variant(remat, policy, batch, 1024, args.steps)
+            r = run_variant(remat, policy, batch, seq, args.steps)
         except Exception as e:
-            r = {"remat": remat, "policy": policy, "batch": batch,
+            r = {"remat": remat, "policy": policy, "batch": batch, "seq": seq,
                  "error": str(e)[:300]}
         print(json.dumps(r), flush=True)

@@ -1,0 +1,52 @@
+"""Isolate the b>=16 remote-compile failure: compile-only over variants of
+batch x attention-impl x flash block size."""
+from __future__ import annotations
+
+import json
+
+import jax
+import numpy as np
+
+
+def try_compile(batch, seq, attn, block):
+    import ray_tpu.ops.attention as att
+    from ray_tpu.models.configs import bench_350m
+    from ray_tpu.parallel import MeshSpec, RULES_DP, make_mesh
+    from ray_tpu.train.step import transformer_train_step
+
+    orig = att.attention
+    if attn == "reference":
+        att.attention = lambda q, k, v, **kw: att.reference_attention(
+            q, k, v, causal=kw.get("causal", True), scale=kw.get("scale"))
+    elif attn == "flash":
+        from ray_tpu.ops.flash_attention import flash_attention
+
+        att.attention = lambda q, k, v, **kw: flash_attention(
+            q, k, v, causal=kw.get("causal", True), scale=kw.get("scale"),
+            block_q=block, block_k=block)
+    try:
+        cfg = bench_350m(remat=True, remat_policy="dots")
+        mesh = make_mesh(MeshSpec(), devices=[jax.devices()[0]])
+        ts = transformer_train_step(cfg, mesh, rules=RULES_DP)
+        params, opt = ts.init(jax.random.key(0))
+        tokens = np.zeros((batch, seq + 1), dtype=np.int32)
+        b = ts.shard_batch({"tokens": tokens})
+        ts.lower_step(params, opt, b).compile()
+        return {"batch": batch, "seq": seq, "attn": attn, "block": block, "ok": True}
+    except Exception as e:
+        return {"batch": batch, "seq": seq, "attn": attn, "block": block,
+                "ok": False, "error": str(e)[:150]}
+    finally:
+        att.attention = orig
+
+
+if __name__ == "__main__":
+    cases = [
+        (16, 1024, "flash", 512),
+        (16, 1024, "flash", 256),
+        (16, 1024, "flash", 128),
+        (16, 1024, "reference", 0),
+        (32, 1024, "reference", 0),
+    ]
+    for c in cases:
+        print(json.dumps(try_compile(*c)), flush=True)

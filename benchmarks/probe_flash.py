@@ -1,0 +1,65 @@
+"""Flash kernel block-size sweep on the real chip: fwd and fwd+bwd timing
+at bench shapes, vs the XLA reference attention."""
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+
+
+def fence(x):
+    leaf = jax.tree_util.tree_leaves(x)[0]
+    float(jnp.sum(leaf.astype(jnp.float32)))
+
+
+def run(fn, args, steps=15):
+    o = fn(*args)
+    fence(o)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        o = fn(*args)
+    fence(o)
+    return (time.perf_counter() - t0) / steps * 1e3
+
+
+if __name__ == "__main__":
+    from ray_tpu.ops.attention import reference_attention
+    from ray_tpu.ops.flash_attention import flash_attention
+
+    B, S, H, Dh = 8, 1024, 16, 64
+    k = jax.random.key(0)
+    q = jax.random.normal(k, (B, S, H, Dh), jnp.bfloat16)
+    kk = jax.random.normal(jax.random.key(1), (B, S, H, Dh), jnp.bfloat16)
+    v = jax.random.normal(jax.random.key(2), (B, S, H, Dh), jnp.bfloat16)
+
+    def loss_of(fn):
+        def f(q, k, v):
+            return jnp.sum(fn(q, k, v).astype(jnp.float32))
+
+        return f
+
+    # reference
+    try:
+        ref_f = jax.jit(lambda q, k, v: reference_attention(q, k, v, causal=True))
+        ms = run(ref_f, (q, kk, v))
+        ref_g = jax.jit(jax.grad(loss_of(lambda q, k, v: reference_attention(q, k, v, causal=True)), argnums=(0, 1, 2)))
+        msg = run(ref_g, (q, kk, v))
+        print(json.dumps({"impl": "reference", "fwd_ms": round(ms, 2), "grad_ms": round(msg, 2)}), flush=True)
+    except Exception as e:
+        print(json.dumps({"impl": "reference", "error": repr(e)[:200]}), flush=True)
+
+    for bq, bk in [(128, 128), (256, 256), (256, 512), (512, 512), (512, 1024), (1024, 1024)]:
+        try:
+            fn = jax.jit(lambda q, k, v, bq=bq, bk=bk: flash_attention(
+                q, k, v, causal=True, block_q=bq, block_k=bk))
+            ms = run(fn, (q, kk, v))
+            gfn = jax.jit(jax.grad(loss_of(
+                lambda q, k, v, bq=bq, bk=bk: flash_attention(
+                    q, k, v, causal=True, block_q=bq, block_k=bk)), argnums=(0, 1, 2)))
+            msg = run(gfn, (q, kk, v))
+            print(json.dumps({"impl": f"flash_{bq}x{bk}", "fwd_ms": round(ms, 2),
+                              "grad_ms": round(msg, 2)}), flush=True)
+        except Exception as e:
+            print(json.dumps({"impl": f"flash_{bq}x{bk}", "error": repr(e)[:200]}), flush=True)

@@ -20,6 +20,7 @@ from __future__ import annotations
 from .core.api import (
     ActorClass,
     ActorHandle,
+    ObjectRefGenerator,
     RemoteFunction,
     available_resources,
     cluster_resources,
@@ -39,6 +40,7 @@ from .core.api import (
 from .core.controller import (
     ActorDiedError,
     DependencyError,
+    ObjectLostError,
     GetTimeoutError,
     RayTpuError,
     TaskError,
@@ -67,6 +69,8 @@ __all__ = [
     "placement_group",
     "remove_placement_group",
     "ObjectRef",
+    "ObjectRefGenerator",
+    "ObjectLostError",
     "ActorHandle",
     "ActorClass",
     "RemoteFunction",

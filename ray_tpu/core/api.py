@@ -266,6 +266,70 @@ def _normalize_strategy(scheduling_strategy: Any) -> Tuple[Dict[str, Any], Optio
     raise ValueError(f"unknown scheduling strategy {scheduling_strategy!r}")
 
 
+class ObjectRefGenerator:
+    """Iterator over the refs of a streaming task's yields (reference:
+    StreamingObjectRefGenerator, python/ray/_raylet.pyx:273). Each __next__
+    blocks until the producer reports the item — the consumer can hold item
+    0 while the producer is still running."""
+
+    def __init__(self, task_id: str):
+        self._task_id = task_id
+        self._index = 0
+        self._exhausted = False
+
+    def __iter__(self) -> "ObjectRefGenerator":
+        return self
+
+    def __next__(self) -> ObjectRef:
+        wc = ctx.get_worker_context()
+        r = wc.client.request(
+            {"kind": "generator_next", "task_id": self._task_id, "index": self._index}
+        )
+        if r.get("done"):
+            self._exhausted = True
+            raise StopIteration
+        self._index += 1
+        return ObjectRef(r["object_id"])
+
+    def close(self) -> None:
+        """Tell the controller this consumer is gone so a producer stalled
+        in the backpressure window is released and state is reclaimed.
+
+        MUST be fire-and-forget: __del__ can run on any thread during GC —
+        including an event-loop thread — where a blocking request deadlocks
+        the loop against itself (observed: GC inside a controller handler
+        collecting a stale generator wedged the whole control plane)."""
+        if self._exhausted:
+            return
+        self._exhausted = True
+        try:
+            wc = ctx.get_worker_context()
+            wc.client.request_async(
+                {"kind": "generator_close", "task_id": self._task_id}
+            )
+        except Exception:
+            pass
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    def __reduce__(self):
+        # Pickling hands ownership to the receiver: disarm close-on-del in
+        # this copy so its destruction doesn't cancel the remote consumer.
+        self._exhausted = True
+        return (ObjectRefGenerator, (self._task_id,))
+
+
+def _streaming_spec_opts(opts: Dict[str, Any], spec: Dict[str, Any]) -> None:
+    spec["streaming"] = True
+    spec["backpressure"] = int(
+        opts.get("_generator_backpressure_num_objects", 16) or 16
+    )
+
+
 class RemoteFunction:
     """Handle produced by @remote on a function (reference:
     python/ray/remote_function.py:266 RemoteFunction._remote)."""
@@ -298,13 +362,15 @@ class RemoteFunction:
         func_id = self._ensure_registered(wc)
         opts = self._options
         num_returns = opts.get("num_returns", 1)
+        streaming = num_returns == "streaming"
         resources = dict(opts.get("resources", {}) or {})
         resources["CPU"] = float(opts.get("num_cpus", 1 if "num_tpus" not in opts else 0))
         if opts.get("num_tpus"):
             resources["TPU"] = float(opts["num_tpus"])
         strategy, pg = _normalize_strategy(opts.get("scheduling_strategy"))
         args_blob, deps = pack_args(args, kwargs)
-        return_ids = [ObjectID.generate() for _ in range(max(num_returns, 0))]
+        n_rets = 0 if streaming else max(num_returns, 0)
+        return_ids = [ObjectID.generate() for _ in range(n_rets)]
         spec = {
             "task_id": TaskID.generate(),
             "func_id": func_id,
@@ -316,7 +382,11 @@ class RemoteFunction:
             "pg": pg,
             "label": getattr(self._fn, "__name__", "task"),
         }
+        if streaming:
+            _streaming_spec_opts(opts, spec)
         wc.client.request({"kind": "submit_task", "spec": spec})
+        if streaming:
+            return ObjectRefGenerator(spec["task_id"])
         refs = [ObjectRef(oid) for oid in return_ids]
         if num_returns == 1:
             return refs[0]
@@ -335,12 +405,12 @@ class RemoteFunction:
 
 
 class ActorMethod:
-    def __init__(self, handle: "ActorHandle", name: str, num_returns: int = 1):
+    def __init__(self, handle: "ActorHandle", name: str, num_returns=1):
         self._handle = handle
         self._name = name
         self._num_returns = num_returns
 
-    def options(self, num_returns: int = 1) -> "ActorMethod":
+    def options(self, num_returns=1) -> "ActorMethod":
         return ActorMethod(self._handle, self._name, num_returns)
 
     def remote(self, *args, **kwargs):
@@ -361,10 +431,12 @@ class ActorHandle:
             raise AttributeError(f"actor has no method {name!r}")
         return ActorMethod(self, name)
 
-    def _submit(self, method: str, args, kwargs, num_returns: int):
+    def _submit(self, method: str, args, kwargs, num_returns):
         wc = ctx.get_worker_context()
+        streaming = num_returns == "streaming"
         args_blob, deps = pack_args(args, kwargs)
-        return_ids = [ObjectID.generate() for _ in range(max(num_returns, 0))]
+        n_rets = 0 if streaming else max(num_returns, 0)
+        return_ids = [ObjectID.generate() for _ in range(n_rets)]
         spec = {
             "task_id": TaskID.generate(),
             "actor_id": self._actor_id,
@@ -375,7 +447,11 @@ class ActorHandle:
             "resources": {},
             "label": f"actor.{method}",
         }
+        if streaming:
+            _streaming_spec_opts({}, spec)
         wc.client.request({"kind": "submit_actor_task", "spec": spec})
+        if streaming:
+            return ObjectRefGenerator(spec["task_id"])
         refs = [ObjectRef(oid) for oid in return_ids]
         if num_returns == 1:
             return refs[0]

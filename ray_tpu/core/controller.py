@@ -110,6 +110,24 @@ class ActorInfo:
 
 
 @dataclass
+class GeneratorState:
+    """Server-side state of one streaming task (reference: streaming
+    generator returns, core_worker.proto ReportGeneratorItemReturns +
+    _raylet.pyx:273). Items are ordinary objects; this tracks their order,
+    completion, and the consumer-driven backpressure window."""
+
+    task_id: str
+    window: int = 16
+    items: List[str] = field(default_factory=list)
+    consumed: int = 0
+    done: bool = False
+    closed: bool = False  # consumer dropped the generator
+    error: Optional[Exception] = None
+    wake: asyncio.Event = field(default_factory=asyncio.Event)  # consumers
+    drain: asyncio.Event = field(default_factory=asyncio.Event)  # producer
+
+
+@dataclass
 class Bundle:
     resources: Dict[str, float]
     node_id: Optional[str] = None
@@ -137,8 +155,12 @@ class Controller:
         self.named_actors: Dict[Tuple[str, str], str] = {}  # (namespace, name) -> actor_id
         self.objects: Dict[str, ObjectLocation] = {}
         self.object_waiters: Dict[str, List[asyncio.Event]] = {}
+        # oid -> callbacks fired (once) when the object's location lands;
+        # the incremental path used by wait (vs the Event-based get path).
+        self.object_callbacks: Dict[str, List[Any]] = {}
         self.tasks: Dict[str, Dict[str, Any]] = {}  # pending/running task specs
         self.pending_queue: List[str] = []  # task_ids awaiting scheduling
+        self.generators: Dict[str, GeneratorState] = {}  # streaming tasks
         self.functions: Dict[str, bytes] = {}  # function/class table (gcs_function_manager)
         self.kv: Dict[Tuple[str, str], bytes] = {}
         self.pgs: Dict[str, PGInfo] = {}
@@ -321,6 +343,7 @@ class Controller:
             err = WorkerCrashedError(
                 f"worker {w.worker_id[:8]} died while running task {spec.get('label', '')}"
             )
+            self._finalize_generator(spec["task_id"], err)
             for oid in spec["return_ids"]:
                 self._store_error(oid, err)
         # Mark hosted actors dead.
@@ -419,29 +442,62 @@ class Controller:
         return out
 
     async def _h_wait(self, conn, msg):
+        """O(n) wait: one callback registration per missing object, arrivals
+        drained incrementally (the previous design re-registered a waiter
+        future for every not-ready id on every wake — O(n^2) registrations
+        for large batches; reference envelope is a 10k-object wait,
+        release/benchmarks/README.md)."""
         ids: List[str] = msg["object_ids"]
         num_returns: int = msg["num_returns"]
         timeout = msg.get("timeout")
         deadline = None if timeout is None else time.monotonic() + timeout
-        while True:
-            ready = [oid for oid in ids if oid in self.objects]
-            if len(ready) >= num_returns:
-                return ready[:num_returns]
-            if deadline is not None and time.monotonic() >= deadline:
-                return ready
-            waiters = [
-                asyncio.ensure_future(self._wait_for_object(oid, deadline))
-                for oid in ids
-                if oid not in self.objects
-            ]
-            remaining = None if deadline is None else max(1e-6, deadline - time.monotonic())
-            done, pend = await asyncio.wait(
-                waiters, timeout=remaining, return_when=asyncio.FIRST_COMPLETED
-            )
-            for p in pend:
-                p.cancel()
-            if pend:
-                await asyncio.gather(*pend, return_exceptions=True)
+        ready: List[str] = []
+        missing: List[str] = []
+        for oid in ids:
+            (ready if oid in self.objects else missing).append(oid)
+        if len(ready) >= num_returns:
+            return ready[:num_returns]
+        arrived: List[str] = []
+        wake = asyncio.Event()
+
+        def notify(oid: str) -> None:
+            arrived.append(oid)
+            wake.set()
+
+        for oid in missing:
+            self.object_callbacks.setdefault(oid, []).append(notify)
+        def drain() -> None:
+            ready.extend(arrived)
+            arrived.clear()
+
+        try:
+            while True:
+                if deadline is None:
+                    await wake.wait()
+                else:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        drain()  # arrivals that raced the deadline count
+                        return ready[:num_returns]
+                    try:
+                        await asyncio.wait_for(wake.wait(), remaining)
+                    except asyncio.TimeoutError:
+                        drain()
+                        return ready[:num_returns]
+                wake.clear()
+                drain()
+                if len(ready) >= num_returns:
+                    return ready[:num_returns]
+        finally:
+            for oid in missing:
+                cbs = self.object_callbacks.get(oid)
+                if cbs is not None:
+                    try:
+                        cbs.remove(notify)
+                    except ValueError:
+                        pass
+                    if not cbs:
+                        self.object_callbacks.pop(oid, None)
 
     async def _h_free_objects(self, conn, msg):
         for oid in msg["object_ids"]:
@@ -485,8 +541,71 @@ class Controller:
         spec = msg["spec"]
         self.tasks[spec["task_id"]] = spec
         spec["state"] = "waiting_deps"
+        if spec.get("streaming"):
+            self.generators[spec["task_id"]] = GeneratorState(
+                task_id=spec["task_id"],
+                window=int(spec.get("backpressure", 16)),
+            )
         self._record_task_event(spec, "submitted")
         await self._resolve_deps_then_queue(spec)
+        return {"ok": True}
+
+    # streaming generators ----------------------------------------------------
+
+    async def _h_generator_item(self, conn, msg):
+        """Producer reports one yielded item (reference:
+        ReportGeneratorItemReturns, core_worker.proto:462). The reply is
+        withheld while the consumer lags more than the backpressure window,
+        which stalls the producing worker thread — flow control without a
+        second channel."""
+        gen = self.generators.get(msg["task_id"])
+        self._store_location(msg["loc"])
+        if gen is None:
+            return {"ok": True}
+        gen.items.append(msg["loc"].object_id)
+        gen.wake.set()
+        while (
+            len(gen.items) - gen.consumed > gen.window
+            and not gen.done
+            and not gen.closed
+        ):
+            gen.drain.clear()
+            await gen.drain.wait()
+        return {"ok": True, "closed": gen.closed}
+
+    async def _h_generator_next(self, conn, msg):
+        """Consumer requests item `index`; blocks until produced, raises the
+        task's error, or reports exhaustion."""
+        gen = self.generators.get(msg["task_id"])
+        if gen is None:
+            raise ValueError(f"unknown streaming task {msg['task_id'][:8]}")
+        index = msg["index"]
+        while True:
+            if index < len(gen.items):
+                gen.consumed = max(gen.consumed, index + 1)
+                gen.drain.set()
+                return {"object_id": gen.items[index]}
+            if gen.error is not None:
+                self.generators.pop(msg["task_id"], None)
+                raise gen.error
+            if gen.done:
+                self.generators.pop(msg["task_id"], None)
+                return {"done": True}
+            gen.wake.clear()
+            await gen.wake.wait()
+
+    async def _h_generator_close(self, conn, msg):
+        """Consumer dropped the generator: release a producer stalled in the
+        backpressure wait and let state be reclaimed (reference: streaming
+        generator cancellation on deleted ObjectRefGenerator)."""
+        gen = self.generators.get(msg["task_id"])
+        if gen is None:
+            return {"ok": True}
+        gen.closed = True
+        gen.drain.set()
+        gen.wake.set()
+        if gen.done:
+            self.generators.pop(msg["task_id"], None)
         return {"ok": True}
 
     async def _resolve_deps_then_queue(self, spec: Dict[str, Any]) -> None:
@@ -524,11 +643,38 @@ class Controller:
     def _fail_task(self, spec, err: Exception) -> None:
         self.tasks.pop(spec["task_id"], None)
         self._record_task_event(spec, "failed")
+        self._finalize_generator(spec["task_id"], err)
         for oid in spec["return_ids"]:
             self._store_error(oid, err)
 
+    def _finalize_generator(self, task_id: str, err: Optional[Exception]) -> None:
+        gen = self.generators.get(task_id)
+        if gen is not None and not gen.done:
+            gen.error = gen.error or err
+            gen.done = True
+            gen.wake.set()
+            gen.drain.set()
+
     async def _h_task_done(self, conn, msg):
         task_id = msg["task_id"]
+        gen = self.generators.get(task_id)
+        if gen is not None:
+            if msg.get("is_error") or msg.get("error_locations"):
+                err_locs = msg.get("error_locations") or []
+                if err_locs:
+                    import pickle as _p
+
+                    try:
+                        gen.error = _p.loads(err_locs[0].inline)
+                    except Exception:
+                        gen.error = WorkerCrashedError("streaming task failed")
+                else:
+                    gen.error = WorkerCrashedError("streaming task failed")
+            gen.done = True
+            gen.wake.set()
+            gen.drain.set()
+            if gen.closed:
+                self.generators.pop(task_id, None)
         spec = self.tasks.pop(task_id, None)
         if spec is not None:
             self._record_task_event(
@@ -633,8 +779,14 @@ class Controller:
         actor = self.actors.get(spec["actor_id"])
         if actor is None:
             raise ValueError(f"unknown actor {spec['actor_id']}")
+        if spec.get("streaming"):
+            self.generators[spec["task_id"]] = GeneratorState(
+                task_id=spec["task_id"],
+                window=int(spec.get("backpressure", 16)),
+            )
         if actor.state == "dead":
             err = actor.creation_error or ActorDiedError(f"actor {actor.actor_id[:8]} is dead")
+            self._finalize_generator(spec["task_id"], err)
             for oid in spec["return_ids"]:
                 self._store_error(oid, err)
             return {"ok": True}
@@ -936,6 +1088,11 @@ class Controller:
         self.objects[loc.object_id] = loc
         for ev in self.object_waiters.pop(loc.object_id, []):
             ev.set()
+        for cb in self.object_callbacks.pop(loc.object_id, []):
+            try:
+                cb(loc.object_id)
+            except Exception:
+                pass
 
     def _store_error(self, object_id: str, err: Exception) -> None:
         import pickle as _p

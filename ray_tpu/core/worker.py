@@ -31,16 +31,26 @@ from .serialization import ArgRef, ObjectRef
 
 
 class ActorMailbox:
-    """Ordered (or bounded-concurrency) execution context for one actor."""
+    """Ordered (or bounded-concurrency) execution context for one actor.
+
+    Actors whose classes define ``async def`` methods additionally get a
+    persistent asyncio event loop on its own thread: coroutine methods are
+    scheduled there and genuinely interleave while awaiting (reference:
+    async actors on a per-actor eventloop, core_worker/fiber.h + ray's
+    AsyncioActor; the round-1 per-call asyncio.run() serialized them)."""
 
     def __init__(self, runtime: "WorkerRuntime", actor_id: str, max_concurrency: int):
         self.runtime = runtime
         self.actor_id = actor_id
         self.instance: Any = None
         self.q: "queue.Queue[Optional[Dict[str, Any]]]" = queue.Queue()
+        self.aio_loop: Any = None  # created lazily for async actors
+        self.aio_sem: Any = None
+        self._aio_lock = threading.Lock()
+        self.max_concurrency = max(1, max_concurrency)
         self.threads = [
             threading.Thread(target=self._loop, name=f"actor-{actor_id[:8]}-{i}", daemon=True)
-            for i in range(max(1, max_concurrency))
+            for i in range(self.max_concurrency)
         ]
         for t in self.threads:
             t.start()
@@ -51,6 +61,37 @@ class ActorMailbox:
     def stop(self) -> None:
         for _ in self.threads:
             self.q.put(None)
+        if self.aio_loop is not None:
+            self.aio_loop.call_soon_threadsafe(self.aio_loop.stop)
+
+    def ensure_aio_loop(self):
+        """Start the persistent event loop (first async method / creation).
+        Locked: with a multi-threaded mailbox, two first-async-calls racing
+        here could otherwise each build a loop and strand one's coroutines
+        on a loop no thread runs."""
+        with self._aio_lock:
+            if self.aio_loop is None:
+                import asyncio
+
+                loop = asyncio.new_event_loop()
+                # Async actors interleave up to max_concurrency coroutines; a
+                # plain actor that happens to have one async method still gets
+                # real concurrency (ray default for async actors is high).
+                n = self.max_concurrency if self.max_concurrency > 1 else 100
+                self.aio_sem = asyncio.Semaphore(n)
+                self.aio_loop = loop
+                t = threading.Thread(
+                    target=self._run_aio, name=f"actor-aio-{self.actor_id[:8]}",
+                    daemon=True,
+                )
+                t.start()
+            return self.aio_loop
+
+    def _run_aio(self) -> None:
+        import asyncio
+
+        asyncio.set_event_loop(self.aio_loop)
+        self.aio_loop.run_forever()
 
     def _loop(self) -> None:
         while True:
@@ -60,7 +101,7 @@ class ActorMailbox:
             if "__create__" in spec:
                 spec["__create__"]()
                 continue
-            self.runtime.run_task(spec, actor_instance=self.instance)
+            self.runtime.run_task(spec, actor_instance=self.instance, mailbox=self)
 
 
 class WorkerRuntime:
@@ -145,7 +186,12 @@ class WorkerRuntime:
         kwargs = {k: resolve(v) for k, v in kwargs.items()}
         return args, kwargs
 
-    def run_task(self, spec: Dict[str, Any], actor_instance: Any = None) -> None:
+    def run_task(
+        self,
+        spec: Dict[str, Any],
+        actor_instance: Any = None,
+        mailbox: Optional["ActorMailbox"] = None,
+    ) -> None:
         task_id = spec["task_id"]
         tls = ctx.task_local
         tls.task_id = task_id
@@ -161,45 +207,174 @@ class WorkerRuntime:
             if _is_coroutine(result):
                 import asyncio
 
+                if spec.get("streaming"):
+                    raise TypeError(
+                        "num_returns='streaming' requires a (sync or async) "
+                        "generator; this method is a plain coroutine"
+                    )
+                if mailbox is not None:
+                    # Async actor method: hand the coroutine to the actor's
+                    # persistent loop and release the mailbox thread — the
+                    # next call dispatches immediately, so awaits interleave.
+                    loop = mailbox.ensure_aio_loop()
+                    sem = mailbox.aio_sem
+
+                    async def drive(result=result, spec=spec):
+                        async with sem:
+                            try:
+                                value = await result
+                            except BaseException as e:  # noqa: BLE001
+                                self._complete_error(spec, e, traceback.format_exc())
+                            else:
+                                self._complete_ok(spec, value)
+
+                    asyncio.run_coroutine_threadsafe(drive(), loop)
+                    return
                 result = asyncio.run(result)
+            if _is_async_gen(result):
+                if not spec.get("streaming"):
+                    raise TypeError(
+                        "async generator methods require "
+                        "num_returns='streaming'"
+                    )
+                if mailbox is not None:
+                    self._run_streaming_async(spec, result, mailbox)
+                    return
+                raise TypeError(
+                    "async generators are only supported on actors"
+                )
+            if spec.get("streaming"):
+                self._run_streaming(spec, result)
+                return
+            self._complete_ok(spec, result)
+        except BaseException as e:  # noqa: BLE001 — every task error is captured
+            self._complete_error(spec, e, traceback.format_exc())
+        finally:
+            tls.task_id = None
+
+    def _complete_ok(self, spec: Dict[str, Any], result: Any) -> None:
+        try:
             locations = self._store_returns(spec, result)
+        except BaseException as e:  # noqa: BLE001
+            self._complete_error(spec, e, traceback.format_exc())
+            return
+        self.client.request(
+            {
+                "kind": "task_done",
+                "task_id": spec["task_id"],
+                "worker_id": self.worker_id,
+                "locations": locations,
+            }
+        )
+
+    def _complete_error(self, spec: Dict[str, Any], e: BaseException, tb: str) -> None:
+        label = spec.get("label", spec["task_id"][:8])
+        err = TaskError(label, e, tb)
+        try:
+            data = pickle.dumps(err)
+        except Exception:
+            # Unpicklable cause (socket, lock, ...): degrade to a string
+            # rendition so the error still reaches the caller instead of
+            # hanging the task forever.
+            err = TaskError(label, RuntimeError(f"{type(e).__name__}: {e}"), tb)
+            data = pickle.dumps(err)
+        err_ids = list(spec["return_ids"])
+        if not err_ids and spec.get("streaming"):
+            # Streaming tasks have no pre-allocated return ids; ship the
+            # error as a synthetic location so the consumer sees the real
+            # exception on next() rather than a generic crash.
+            from .ids import ObjectID
+
+            err_ids = [ObjectID.generate()]
+        err_locs = [
+            ObjectLocation(object_id=oid, size=len(data), inline=data, is_error=True)
+            for oid in err_ids
+        ]
+        try:
             self.client.request(
                 {
                     "kind": "task_done",
-                    "task_id": task_id,
+                    "task_id": spec["task_id"],
                     "worker_id": self.worker_id,
-                    "locations": locations,
+                    "error_locations": err_locs,
                 }
             )
-        except BaseException as e:  # noqa: BLE001 — every task error is captured
-            tb = traceback.format_exc()
-            label = spec.get("label", task_id[:8])
-            err = TaskError(label, e, tb)
+        except Exception:
+            pass
+
+    def _run_streaming(self, spec: Dict[str, Any], result: Any) -> None:
+        """Drive a generator task: each yielded value becomes its own object,
+        reported immediately (reference: streaming generator protocol,
+        _raylet.pyx:273 execute_streaming_generator). The controller holds
+        the report reply while the consumer lags past the backpressure
+        window, so this thread self-throttles."""
+        import inspect
+
+        from .ids import ObjectID
+
+        task_id = spec["task_id"]
+        if not inspect.isgenerator(result):
+            raise TypeError(
+                f"num_returns='streaming' requires a generator function, "
+                f"got {type(result).__name__}"
+            )
+        for value in result:
+            oid = ObjectID.generate()
+            loc = put_bytes(value, oid, self.node_id)
+            ack = self.client.request(
+                {"kind": "generator_item", "task_id": task_id, "loc": loc}
+            )
+            if isinstance(ack, dict) and ack.get("closed"):
+                # Consumer dropped the generator: stop producing.
+                result.close()
+                break
+        self.client.request(
+            {
+                "kind": "task_done",
+                "task_id": task_id,
+                "worker_id": self.worker_id,
+                "locations": [],
+            }
+        )
+
+    def _run_streaming_async(self, spec: Dict[str, Any], agen: Any,
+                             mailbox: "ActorMailbox") -> None:
+        """Drive an async generator on the actor's persistent loop; item
+        reports run in the default executor so awaits keep interleaving."""
+        import asyncio
+
+        from .ids import ObjectID
+
+        loop = mailbox.ensure_aio_loop()
+        task_id = spec["task_id"]
+
+        async def drive():
             try:
-                data = pickle.dumps(err)
-            except Exception:
-                # Unpicklable cause (socket, lock, ...): degrade to a string
-                # rendition so the error still reaches the caller instead of
-                # hanging the task forever.
-                err = TaskError(label, RuntimeError(f"{type(e).__name__}: {e}"), tb)
-                data = pickle.dumps(err)
-            err_locs = [
-                ObjectLocation(object_id=oid, size=len(data), inline=data, is_error=True)
-                for oid in spec["return_ids"]
-            ]
-            try:
-                self.client.request(
-                    {
-                        "kind": "task_done",
-                        "task_id": task_id,
-                        "worker_id": self.worker_id,
-                        "error_locations": err_locs,
-                    }
-                )
-            except Exception:
-                pass
-        finally:
-            tls.task_id = None
+                async for value in agen:
+                    oid = ObjectID.generate()
+                    loc = put_bytes(value, oid, self.node_id)
+                    ack = await asyncio.get_running_loop().run_in_executor(
+                        None,
+                        lambda loc=loc: self.client.request(
+                            {"kind": "generator_item", "task_id": task_id,
+                             "loc": loc}
+                        ),
+                    )
+                    if isinstance(ack, dict) and ack.get("closed"):
+                        await agen.aclose()
+                        break
+            except BaseException as e:  # noqa: BLE001
+                self._complete_error(spec, e, traceback.format_exc())
+                return
+            await asyncio.get_running_loop().run_in_executor(
+                None,
+                lambda: self.client.request(
+                    {"kind": "task_done", "task_id": task_id,
+                     "worker_id": self.worker_id, "locations": []}
+                ),
+            )
+
+        asyncio.run_coroutine_threadsafe(drive(), loop)
 
     def _store_returns(self, spec: Dict[str, Any], result: Any) -> List[ObjectLocation]:
         return_ids: List[str] = spec["return_ids"]
@@ -256,3 +431,9 @@ def _is_coroutine(x: Any) -> bool:
     import inspect
 
     return inspect.iscoroutine(x)
+
+
+def _is_async_gen(x: Any) -> bool:
+    import inspect
+
+    return inspect.isasyncgen(x)

@@ -115,10 +115,13 @@ class FileDatasource(Datasource):
                 continue
             files = [self.paths[i] for i in g]
 
-            def read(files=files) -> Block:
-                from .block import concat_blocks
-
-                return concat_blocks([self.read_file(f) for f in files])
+            def read(files=files):
+                # Generator: one block per file, so the streaming read task
+                # reports each block as it is parsed and downstream stages
+                # start before the whole group is read (reference: streaming
+                # generator read tasks, data/_internal/planner/plan_read_op.py).
+                for f in files:
+                    yield self.read_file(f)
 
             tasks.append(ReadTask(read))
         return tasks

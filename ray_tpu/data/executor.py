@@ -132,11 +132,43 @@ class StreamingExecutor:
         parallelism = op.parallelism if op.parallelism > 0 else self.ctx.read_parallelism
         tasks = op.datasource.get_read_tasks(parallelism)
 
-        @rt.remote
+        @rt.remote(num_returns="streaming")
         def do_read(task):
-            return task()
+            out = task()
+            import inspect
 
-        return self._bounded_submit((do_read.remote(t) for t in tasks), "read", len(tasks))
+            if inspect.isgenerator(out):
+                # Multi-block read task (e.g. one block per file): each block
+                # streams out as it is parsed, so downstream map stages start
+                # on block 0 while the reader is still on block 1+.
+                for block in out:
+                    yield block
+            else:
+                yield out
+
+        def stream() -> Iterator[Any]:
+            import collections
+
+            t0 = time.perf_counter()
+            n = 0
+            cap = max(1, self.ctx.max_tasks_in_flight)
+            it = iter(tasks)
+            pending: "collections.deque" = collections.deque()
+            for t in it:
+                pending.append(do_read.remote(t))
+                if len(pending) >= cap:
+                    break
+            while pending:
+                gen = pending.popleft()
+                for ref in gen:
+                    n += 1
+                    yield ref
+                nxt = next(it, None)
+                if nxt is not None:
+                    pending.append(do_read.remote(nxt))
+            self.stats.append(("read", time.perf_counter() - t0, n))
+
+        return stream()
 
     def _task_map_stage(self, inputs: Iterator[Any], stage: List[L.LogicalOp]) -> Iterator[Any]:
         apply = _compile_map_stage(stage, self.ctx.default_batch_format)

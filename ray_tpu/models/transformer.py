@@ -23,6 +23,7 @@ from typing import Any, Dict, Optional, Tuple
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.ad_checkpoint import checkpoint_name
 
 from ray_tpu.ops.attention import attention
 from ray_tpu.parallel.sharding import maybe_constrain
@@ -47,11 +48,19 @@ class TransformerConfig:
     dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
     remat: bool = False
-    # Remat granularity when remat=True: "full" recomputes the whole layer
-    # body in the backward (max memory saving, ~33% extra FLOPs); "dots"
-    # saves matmul outputs and recomputes only cheap elementwise/norm work
-    # (small memory cost, near-zero FLOP overhead) — the right default at
-    # short sequence lengths where HBM is not the binding constraint.
+    # Remat granularity when remat=True:
+    # - "full": recompute the whole layer body in the backward (max memory
+    #   saving, ~33% extra FLOPs).
+    # - "dots": save matmul outputs, recompute elementwise/norm work — BUT
+    #   also recomputes the flash-attention forward (a Pallas custom call is
+    #   not a dot), which dominates at long sequence lengths.
+    # - "min": save everything except the two fat fused-projection outputs
+    #   (qkv and gate_up, tagged via checkpoint_name below) — flash
+    #   residuals stay saved, recompute is one einsum + elementwise. The
+    #   cheapest policy that still bounds activation memory.
+    # Default "dots": the axon AOT compile helper crashes (HTTP 500) on the
+    # larger live sets "min"/no-remat produce at bench shapes; "dots" is the
+    # fastest policy that reliably compiles there (benchmarks/mfu_sweep.py).
     remat_policy: str = "dots"
 
     @property
@@ -108,20 +117,28 @@ def init_params(key: jax.Array, cfg: TransformerConfig) -> Params:
         ks = jax.random.split(k, L)
         return jnp.stack([initializer(ks[i], shape, cfg.param_dtype) for i in range(L)])
 
+    # Projections are FUSED into single matmuls (one MXU op instead of 2-3:
+    # q/k/v together for MHA, k/v together for GQA, gate/up together for
+    # swiglu). The fusion factor is its own array dim — NOT folded into the
+    # feature dim — so tensor-parallel sharding of heads/mlp stays aligned
+    # to shard boundaries (Megatron fused-qkv, done the GSPMD-friendly way).
     layers = {
         "attn_norm": jnp.ones((L, d), cfg.param_dtype),
-        "wq": stack(_dense_init, (d, H * hd), keys[0]),
-        "wk": stack(_dense_init, (d, KVH * hd), keys[1]),
-        "wv": stack(_dense_init, (d, KVH * hd), keys[2]),
         "wo": stack(lambda k, s, pd: _dense_init(k, s, pd, scale=1.0 / math.sqrt(2 * L * s[0])),
                     (H * hd, d), keys[3]),
         "mlp_norm": jnp.ones((L, d), cfg.param_dtype),
-        "w_up": stack(_dense_init, (d, F), keys[4]),
         "w_down": stack(lambda k, s, pd: _dense_init(k, s, pd, scale=1.0 / math.sqrt(2 * L * s[0])),
                         (F, d), keys[5]),
     }
+    if KVH == H:
+        layers["wqkv"] = stack(_dense_init, (d, 3, H, hd), keys[0])
+    else:
+        layers["wq"] = stack(_dense_init, (d, H, hd), keys[0])
+        layers["wkv"] = stack(_dense_init, (d, 2, KVH, hd), keys[1])
     if cfg.activation == "swiglu":
-        layers["w_gate"] = stack(_dense_init, (d, F), keys[6])
+        layers["w_gate_up"] = stack(_dense_init, (d, 2, F), keys[4])
+    else:
+        layers["w_up"] = stack(_dense_init, (d, F), keys[4])
     if cfg.norm == "layernorm":
         layers["attn_norm_b"] = jnp.zeros((L, d), cfg.param_dtype)
         layers["mlp_norm_b"] = jnp.zeros((L, d), cfg.param_dtype)
@@ -147,16 +164,19 @@ def param_logical_specs(cfg: TransformerConfig) -> Params:
     (consumed by parallel.sharding.tree_shardings)."""
     layers = {
         "attn_norm": (None, None),
-        "wq": (None, "embed", "heads"),
-        "wk": (None, "embed", "kv_heads"),
-        "wv": (None, "embed", "kv_heads"),
         "wo": (None, "heads", "embed"),
         "mlp_norm": (None, None),
-        "w_up": (None, "embed", "mlp"),
         "w_down": (None, "mlp", "embed"),
     }
+    if cfg.kv_heads == cfg.n_heads:
+        layers["wqkv"] = (None, "embed", None, "heads", None)
+    else:
+        layers["wq"] = (None, "embed", "heads", None)
+        layers["wkv"] = (None, "embed", None, "kv_heads", None)
     if cfg.activation == "swiglu":
-        layers["w_gate"] = (None, "embed", "mlp")
+        layers["w_gate_up"] = (None, "embed", None, "mlp")
+    else:
+        layers["w_up"] = (None, "embed", "mlp")
     if cfg.norm == "layernorm":
         layers["attn_norm_b"] = (None, None)
         layers["mlp_norm_b"] = (None, None)
@@ -206,9 +226,15 @@ def _layer_body(cfg: TransformerConfig, x: jax.Array, layer: Params, positions: 
     H, KVH, hd = cfg.n_heads, cfg.kv_heads, cfg.head_dim
 
     h = _norm(x, layer["attn_norm"], layer.get("attn_norm_b"), cfg.norm)
-    q = (h @ layer["wq"].astype(cfg.dtype)).reshape(B, S, H, hd)
-    k = (h @ layer["wk"].astype(cfg.dtype)).reshape(B, S, KVH, hd)
-    v = (h @ layer["wv"].astype(cfg.dtype)).reshape(B, S, KVH, hd)
+    if "wqkv" in layer:
+        qkv = jnp.einsum("bsd,dcnh->bscnh", h, layer["wqkv"].astype(cfg.dtype))
+        qkv = checkpoint_name(qkv, "qkv_proj")
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+    else:
+        q = jnp.einsum("bsd,dnh->bsnh", h, layer["wq"].astype(cfg.dtype))
+        kv = jnp.einsum("bsd,dcnh->bscnh", h, layer["wkv"].astype(cfg.dtype))
+        kv = checkpoint_name(kv, "qkv_proj")
+        k, v = kv[:, :, 0], kv[:, :, 1]
     if cfg.positional == "rope":
         q = _rope(q, positions, cfg.rope_theta)
         k = _rope(k, positions, cfg.rope_theta)
@@ -218,12 +244,14 @@ def _layer_body(cfg: TransformerConfig, x: jax.Array, layer: Params, positions: 
     x = maybe_constrain(x, ("batch", "seq_act", "embed"))
 
     h = _norm(x, layer["mlp_norm"], layer.get("mlp_norm_b"), cfg.norm)
-    up = h @ layer["w_up"].astype(cfg.dtype)
     if cfg.activation == "swiglu":
-        gate = h @ layer["w_gate"].astype(cfg.dtype)
-        act = jax.nn.silu(gate) * up
+        gu = jnp.einsum("bsd,dcf->bscf", h, layer["w_gate_up"].astype(cfg.dtype))
+        gu = checkpoint_name(gu, "gate_up")
+        act = jax.nn.silu(gu[:, :, 0]) * gu[:, :, 1]
     else:
-        act = jax.nn.gelu(up)
+        act = checkpoint_name(
+            h @ layer["w_up"].astype(cfg.dtype), "gate_up")
+        act = jax.nn.gelu(act)
     x = x + act @ layer["w_down"].astype(cfg.dtype)
     x = maybe_constrain(x, ("batch", "seq_act", "embed"))
     return x
@@ -252,6 +280,13 @@ def forward(params: Params, tokens: jax.Array, cfg: TransformerConfig) -> jax.Ar
             body = jax.checkpoint(
                 body,
                 policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+            )
+        elif cfg.remat_policy == "min":
+            body = jax.checkpoint(
+                body,
+                policy=jax.checkpoint_policies.save_anything_except_these_names(
+                    "qkv_proj", "gate_up"
+                ),
             )
         else:
             body = jax.checkpoint(body)

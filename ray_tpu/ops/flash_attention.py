@@ -26,7 +26,10 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-DEFAULT_BLOCK = 128
+# 512-blocks win on v5e at bench shapes (benchmarks/probe_flash.py: fwd
+# 8.1ms @128 -> 5.3ms @512, grad 14.7 -> 7.2); VMEM for the [bq, bk] f32
+# score tile stays at 1MB.
+DEFAULT_BLOCK = 512
 _NEG_INF = -1e30
 
 
@@ -59,19 +62,23 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
 
     @pl.when(needed)
     def _block():
-        q = q_ref[0, 0].astype(jnp.float32)  # [bq, D]
-        k = k_ref[0, 0].astype(jnp.float32)  # [bk, D]
-        v = v_ref[0, 0].astype(jnp.float32)  # [bk, D]
+        # Dots take the native bf16 operands (MXU full rate) and accumulate
+        # in f32 via preferred_element_type; only the softmax statistics are
+        # carried in f32. Casting inputs to f32 would drop the MXU to a
+        # quarter of its bf16 rate.
+        q = q_ref[0, 0]                       # [bq, D] bf16
+        k = k_ref[0, 0]                       # [bk, D] bf16
+        v = v_ref[0, 0]                       # [bk, D] bf16
         if seq_len % bk:
             # Padded kv rows hold uninitialized garbage (possibly NaN/inf);
             # a masked p of exactly 0 still yields 0*NaN=NaN in the dot.
             kv_valid = (ik * bk + jax.lax.broadcasted_iota(
                 jnp.int32, (bk, 1), 0)) < seq_len
-            k = jnp.where(kv_valid, k, 0.0)
-            v = jnp.where(kv_valid, v, 0.0)
+            k = jnp.where(kv_valid, k, jnp.zeros_like(k))
+            v = jnp.where(kv_valid, v, jnp.zeros_like(v))
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale  # [bq, bk]
+            preferred_element_type=jnp.float32) * scale  # [bq, bk] f32
         if causal or seq_len % bk:
             rows = iq * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
             cols = ik * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
@@ -81,12 +88,12 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
             s = jnp.where(valid, s, _NEG_INF)
         m_prev = m_scr[:]                     # [bq, 1]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
-        p = jnp.exp(s - m_new)                # [bq, bk]
+        p = jnp.exp(s - m_new)                # [bq, bk] f32
         alpha = jnp.exp(m_prev - m_new)       # [bq, 1]
         m_scr[:] = m_new
         l_scr[:] = l_scr[:] * alpha + jnp.sum(p, axis=1, keepdims=True)
         acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())),
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
     @pl.when(ik == nk - 1)
@@ -161,17 +168,17 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 
     @pl.when(needed)
     def _block():
-        q = q_ref[0, 0].astype(jnp.float32)
-        k = k_ref[0, 0].astype(jnp.float32)
-        v = v_ref[0, 0].astype(jnp.float32)
-        do = do_ref[0, 0].astype(jnp.float32)
-        lse = lse_ref[0, 0]                   # [bq, 1]
-        delta = delta_ref[0, 0]               # [bq, 1]
+        q = q_ref[0, 0]                       # bf16
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
+        do = do_ref[0, 0]
+        lse = lse_ref[0, 0]                   # [bq, 1] f32
+        delta = delta_ref[0, 0]               # [bq, 1] f32
         if seq_len % bk:
             kv_valid = (ik * bk + jax.lax.broadcasted_iota(
                 jnp.int32, (bk, 1), 0)) < seq_len
-            k = jnp.where(kv_valid, k, 0.0)
-            v = jnp.where(kv_valid, v, 0.0)
+            k = jnp.where(kv_valid, k, jnp.zeros_like(k))
+            v = jnp.where(kv_valid, v, jnp.zeros_like(v))
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
@@ -182,13 +189,13 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
             if causal:
                 valid &= rows >= cols
             s = jnp.where(valid, s, _NEG_INF)
-        p = jnp.exp(s - lse)                  # [bq, bk]
+        p = jnp.exp(s - lse)                  # [bq, bk] f32
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
         ds = p * (dp - delta) * scale
         dq_scr[:] += jax.lax.dot_general(
-            ds, k, (((1,), (0,)), ((), ())),
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
     @pl.when(ik == nk - 1)
@@ -213,17 +220,17 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     @pl.when(needed)
     def _block():
-        q = q_ref[0, 0].astype(jnp.float32)
-        k = k_ref[0, 0].astype(jnp.float32)
-        v = v_ref[0, 0].astype(jnp.float32)
-        do = do_ref[0, 0].astype(jnp.float32)
-        lse = lse_ref[0, 0]                   # [bq, 1]
-        delta = delta_ref[0, 0]               # [bq, 1]
+        q = q_ref[0, 0]                       # bf16
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
+        do = do_ref[0, 0]
+        lse = lse_ref[0, 0]                   # [bq, 1] f32
+        delta = delta_ref[0, 0]               # [bq, 1] f32
         if seq_len % bq:
             q_valid = (iq * bq + jax.lax.broadcasted_iota(
                 jnp.int32, (bq, 1), 0)) < seq_len
-            q = jnp.where(q_valid, q, 0.0)
-            do = jnp.where(q_valid, do, 0.0)
+            q = jnp.where(q_valid, q, jnp.zeros_like(q))
+            do = jnp.where(q_valid, do, jnp.zeros_like(do))
             delta = jnp.where(q_valid, delta, 0.0)
         # s^T directly: [bk, bq]
         st = jax.lax.dot_general(
@@ -237,17 +244,17 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         if causal:
             valid &= rows >= cols
         st = jnp.where(valid, st, _NEG_INF)
-        pt = jnp.exp(st - lse.T)              # [bk, bq]
+        pt = jnp.exp(st - lse.T)              # [bk, bq] f32
         pt = jnp.where(valid, pt, 0.0)
         dv_scr[:] += jax.lax.dot_general(
-            pt, do, (((1,), (0,)), ((), ())),
+            pt.astype(do.dtype), do, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         dpt = jax.lax.dot_general(
             v, do, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)  # [bk, bq]
         dst = pt * (dpt - delta.T) * scale
         dk_scr[:] += jax.lax.dot_general(
-            dst, q, (((1,), (0,)), ((), ())),
+            dst.astype(q.dtype), q, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
     @pl.when(iq == nq - 1)

@@ -13,7 +13,8 @@ from .api import (delete, get_app_handle, get_deployment_handle, run,
                   shutdown, start, status)
 from .batching import batch
 from .deployment import Application, AutoscalingConfig, Deployment, deployment
-from .handle import DeploymentHandle, DeploymentResponse
+from .handle import (DeploymentHandle, DeploymentResponse,
+                     DeploymentStreamingResponse)
 
 __all__ = [
     "deployment",
@@ -22,6 +23,7 @@ __all__ = [
     "AutoscalingConfig",
     "DeploymentHandle",
     "DeploymentResponse",
+    "DeploymentStreamingResponse",
     "run",
     "start",
     "shutdown",

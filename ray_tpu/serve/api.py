@@ -79,6 +79,7 @@ def run(target: Application, *, name: str = "default",
             "autoscaling_config": (
                 vars(dep.config.autoscaling_config)
                 if dep.config.autoscaling_config else None),
+            "stream": dep.config.stream,
         }
         prefix = route_prefix if node is target else None
         ray_tpu.get(ctrl.deploy.remote(

@@ -110,6 +110,18 @@ class ServeController:
     def get_route_table(self) -> Dict[str, str]:
         return dict(self._route_prefixes)
 
+    def get_route_info(self) -> Dict[str, Dict[str, Any]]:
+        """Route table with per-deployment metadata the proxy needs (stream
+        flag for chunked responses)."""
+        out: Dict[str, Dict[str, Any]] = {}
+        for prefix, name in self._route_prefixes.items():
+            info = self._deployments.get(name)
+            out[prefix] = {
+                "name": name,
+                "stream": bool(info and info.config.get("stream")),
+            }
+        return out
+
     def get_last_error(self, name: str) -> Optional[str]:
         info = self._deployments.get(name)
         return info.last_error if info else None

@@ -32,6 +32,9 @@ class DeploymentConfig:
     autoscaling_config: Optional[AutoscalingConfig] = None
     health_check_period_s: float = 10.0
     user_config: Optional[Dict[str, Any]] = None
+    # Handler returns a generator; calls stream item-by-item and the HTTP
+    # proxy writes a chunked response (reference: serve streaming responses).
+    stream: bool = False
 
 
 class Deployment:
@@ -86,11 +89,13 @@ def deployment(
     ray_actor_options: Optional[Dict[str, Any]] = None,
     autoscaling_config: Optional[Union[AutoscalingConfig, Dict]] = None,
     user_config: Optional[Dict[str, Any]] = None,
+    stream: bool = False,
 ):
     """@serve.deployment decorator (reference serve/api.py:deployment)."""
 
     def wrap(fc):
         cfg = DeploymentConfig()
+        cfg.stream = bool(stream)
         if num_replicas is not None:
             cfg.num_replicas = num_replicas
         if max_ongoing_requests is not None:

@@ -59,6 +59,48 @@ class DeploymentResponse:
         return self._ref
 
 
+class DeploymentStreamingResponse:
+    """Iterator over a streaming deployment call's items (reference:
+    DeploymentResponseGenerator, serve/handle.py). Yields VALUES; the
+    underlying transport is the core streaming-generator protocol."""
+
+    def __init__(self, ref_gen, router, replica_key):
+        self._gen = ref_gen
+        self._router = router
+        self._replica_key = replica_key
+        self._done = False
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        try:
+            ref = next(self._gen)
+        except StopIteration:
+            self._release()
+            raise
+        except Exception:
+            self._release()
+            raise
+        return ray_tpu.get(ref)
+
+    def _release(self) -> None:
+        if not self._done:
+            self._done = True
+            self._router._on_done(self._replica_key)
+            close = getattr(self._gen, "close", None)
+            if close is not None:
+                # Frees a producer stalled in the backpressure window when
+                # the consumer walks away mid-stream (HTTP client hangup).
+                close()
+
+    def __del__(self):
+        try:
+            self._release()
+        except Exception:
+            pass
+
+
 class Router:
     REFRESH_PERIOD_S = 3.0
 
@@ -121,7 +163,7 @@ class Router:
                 self._inflight[key] -= 1
 
     def assign(self, method_name: str, args, kwargs,
-               retries: int = 3) -> DeploymentResponse:
+               retries: int = 3, stream: bool = False):
         self._refresh()
         last_err: Optional[Exception] = None
         for attempt in range(retries):
@@ -133,6 +175,12 @@ class Router:
                 self._refresh(force=True)
                 continue
             try:
+                if stream:
+                    ref_gen = replica.handle_request_streaming.options(
+                        num_returns="streaming"
+                    ).remote(method_name, args, kwargs)
+                    return DeploymentStreamingResponse(
+                        ref_gen, self, replica._actor_id)
                 ref = replica.handle_request.remote(
                     method_name, args, kwargs)
                 return DeploymentResponse(ref, self, replica._actor_id)
@@ -145,24 +193,33 @@ class Router:
 
 
 class DeploymentHandle:
-    def __init__(self, deployment_name: str, method_name: str = "__call__"):
+    def __init__(self, deployment_name: str, method_name: str = "__call__",
+                 stream: bool = False):
         self.deployment_name = deployment_name
         self._method_name = method_name
+        self._stream = stream
         self._router: Optional[Router] = None
 
     # Routers hold runtime state; rebuild lazily after pickling (handles are
     # injected into replica constructors for composition).
     def __getstate__(self):
         return {"deployment_name": self.deployment_name,
-                "_method_name": self._method_name}
+                "_method_name": self._method_name,
+                "_stream": self._stream}
 
     def __setstate__(self, state):
         self.deployment_name = state["deployment_name"]
         self._method_name = state["_method_name"]
+        self._stream = state.get("_stream", False)
         self._router = None
 
-    def options(self, *, method_name: str) -> "DeploymentHandle":
-        h = DeploymentHandle(self.deployment_name, method_name)
+    def options(self, *, method_name: Optional[str] = None,
+                stream: Optional[bool] = None) -> "DeploymentHandle":
+        h = DeploymentHandle(
+            self.deployment_name,
+            method_name if method_name is not None else self._method_name,
+            stream if stream is not None else self._stream,
+        )
         h._router = self._ensure_router()
         return h
 
@@ -189,5 +246,6 @@ class DeploymentHandle:
             cache[name] = h
         return h
 
-    def remote(self, *args, **kwargs) -> DeploymentResponse:
-        return self._ensure_router().assign(self._method_name, args, kwargs)
+    def remote(self, *args, **kwargs):
+        return self._ensure_router().assign(
+            self._method_name, args, kwargs, stream=self._stream)

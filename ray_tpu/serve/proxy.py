@@ -40,27 +40,28 @@ class HTTPProxy:
 
     def _refresh_routes(self) -> None:
         ctrl = ray_tpu.get_actor(CONTROLLER_NAME)
-        self._routes = ray_tpu.get(ctrl.get_route_table.remote())
+        self._routes = ray_tpu.get(ctrl.get_route_info.remote())
 
-    def _match(self, path: str) -> Optional[str]:
+    def _match(self, path: str) -> Optional[Dict[str, Any]]:
         best = None
-        for prefix, name in self._routes.items():
+        for prefix, info in self._routes.items():
             if path == prefix or path.startswith(prefix.rstrip("/") + "/") \
                     or prefix == "/":
                 if best is None or len(prefix) > len(best[0]):
-                    best = (prefix, name)
+                    best = (prefix, info)
         return best[1] if best else None
 
     async def _handle(self, request):
         from aiohttp import web
 
-        name = self._match(request.path)
-        if name is None:
+        info = self._match(request.path)
+        if info is None:
             self._refresh_routes()
-            name = self._match(request.path)
-        if name is None:
+            info = self._match(request.path)
+        if info is None:
             return web.json_response(
                 {"error": f"no route for {request.path}"}, status=404)
+        name = info["name"]
         if request.method == "GET":
             arg: Any = dict(request.query)
         else:
@@ -70,6 +71,8 @@ class HTTPProxy:
             except json.JSONDecodeError:
                 arg = body.decode()
         handle = self._handles.setdefault(name, DeploymentHandle(name))
+        if info.get("stream"):
+            return await self._handle_streaming(request, handle, name, arg)
         try:
             resp = await asyncio.get_running_loop().run_in_executor(
                 None, lambda: handle.remote(arg).result(timeout=60))
@@ -84,6 +87,43 @@ class HTTPProxy:
         if isinstance(resp, (dict, list, int, float, bool)) or resp is None:
             return web.json_response({"result": resp})
         return web.Response(text=str(resp))
+
+    async def _handle_streaming(self, request, handle, name: str, arg):
+        """Chunked-transfer response fed by a streaming deployment call
+        (reference: serve HTTP streaming responses over the generator
+        protocol). Each yielded item becomes one chunk; str/bytes pass
+        through, anything else is JSON + newline."""
+        from aiohttp import web
+
+        loop = asyncio.get_running_loop()
+        try:
+            # assign() does blocking controller/replica RPCs — keep them off
+            # the proxy event loop (the non-streaming path does the same).
+            gen = await loop.run_in_executor(
+                None, lambda: iter(handle.options(stream=True).remote(arg)))
+        except Exception as e:
+            return web.json_response({"error": str(e)}, status=500)
+        resp = web.StreamResponse()
+        resp.enable_chunked_encoding()
+        await resp.prepare(request)
+        _END = object()
+        while True:
+            try:
+                item = await loop.run_in_executor(
+                    None, lambda: next(gen, _END))
+            except Exception:
+                break  # mid-stream failure: terminate the chunked body
+            if item is _END:
+                break
+            if isinstance(item, bytes):
+                data = item
+            elif isinstance(item, str):
+                data = item.encode()
+            else:
+                data = (json.dumps(item) + "\n").encode()
+            await resp.write(data)
+        await resp.write_eof()
+        return resp
 
     def _run(self) -> None:
         from aiohttp import web

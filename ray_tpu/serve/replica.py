@@ -46,6 +46,28 @@ class ReplicaActor:
             with self._lock:
                 self._ongoing -= 1
 
+    def handle_request_streaming(self, method_name: str, args: Tuple,
+                                 kwargs: Dict):
+        """Generator variant: the user handler returns a generator/iterable
+        whose items stream to the caller one object at a time (reference:
+        serve streaming responses over streaming generator returns,
+        serve/_private/replica.py handle_request_streaming)."""
+        with self._lock:
+            self._ongoing += 1
+            self._total += 1
+        try:
+            if self._is_function:
+                result = self._callable(*args, **kwargs)
+            elif method_name == "__call__":
+                result = self._callable(*args, **kwargs)
+            else:
+                result = getattr(self._callable, method_name)(*args, **kwargs)
+            for item in result:
+                yield item
+        finally:
+            with self._lock:
+                self._ongoing -= 1
+
     # ----------------------------------------------------------------- state
 
     def queue_len(self) -> int:

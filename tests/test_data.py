@@ -185,3 +185,31 @@ def test_add_drop_select_columns():
     assert ds.take(1)[0]["sq"] == 0
     assert ds.select_columns(["sq"]).columns() == ["sq"]
     assert ds.drop_columns(["sq"]).columns() == ["id"]
+
+
+def test_read_streams_blocks_incrementally():
+    """First block is consumable while the read task is still producing
+    later blocks (streaming-generator read tasks)."""
+    import time as _time
+
+    import numpy as np
+
+    from ray_tpu import data as rtd
+    from ray_tpu.data.datasource import Datasource, ReadTask
+
+    class SlowSource(Datasource):
+        def get_read_tasks(self, parallelism):
+            def read():
+                for i in range(4):
+                    yield {"x": np.full(8, i)}
+                    _time.sleep(0.3)
+
+            return [ReadTask(read)]
+
+    ds = rtd.read_datasource(SlowSource())
+    t0 = _time.perf_counter()
+    it = ds.iter_batches(batch_size=None)
+    first = next(iter(it))
+    t_first = _time.perf_counter() - t0
+    assert list(first["x"]) == [0] * 8
+    assert t_first < 1.0, f"first block took {t_first:.2f}s — reads not streaming"

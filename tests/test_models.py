@@ -107,11 +107,16 @@ def test_sharded_matches_single_device():
 
 def test_remat_matches_no_remat():
     cfg = llama_tiny()
-    cfg_r = llama_tiny(remat=True)
     params = tfm.init_params(jax.random.key(0), cfg)
     tokens = np.random.RandomState(2).randint(0, cfg.vocab_size, (2, 32)).astype(np.int32)
     batch = {"tokens": tokens}
     g1 = jax.grad(lambda p: tfm.loss_fn(p, batch, cfg))(params)
-    g2 = jax.grad(lambda p: tfm.loss_fn(p, batch, cfg_r))(params)
-    for a, b in zip(jax.tree.leaves(g1), jax.tree.leaves(g2)):
-        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4)
+    # Remat recomputes the layer body in the backward; XLA fuses the remat
+    # and no-remat programs differently, so individual bf16 activations can
+    # round one ulp apart (observed: 1 element in 65536 at 2^-11). Gradients
+    # must agree to bf16 resolution, not bitwise.
+    for policy in ("full", "dots"):
+        cfg_r = llama_tiny(remat=True, remat_policy=policy)
+        g2 = jax.grad(lambda p: tfm.loss_fn(p, batch, cfg_r))(params)
+        for a, b in zip(jax.tree.leaves(g1), jax.tree.leaves(g2)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-3)

@@ -1,0 +1,63 @@
+"""Control-plane performance regression floors.
+
+Reference role: release/microbenchmark CI + the scalability envelope rows in
+release/benchmarks/README.md (10k+ objects in one wait, 1M+ queued tasks).
+Floors are deliberately ~10x below observed numbers on the 1-CPU CI host
+(benchmarks/PERF.json) so only order-of-magnitude regressions trip them.
+"""
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+
+
+def test_wait_3k_objects_fast(ray_start_regular):
+    """3k-object wait must complete in O(n): the O(n^2) waiter-registration
+    design took seconds at this size."""
+    refs = [ray_tpu.put(i) for i in range(3000)]
+    t0 = time.perf_counter()
+    ready, not_ready = ray_tpu.wait(refs, num_returns=3000, timeout=30)
+    dt = time.perf_counter() - t0
+    assert len(ready) == 3000
+    assert dt < 2.0, f"3k wait took {dt:.2f}s"
+    ray_tpu.free(refs)
+
+
+def test_task_throughput_floor(ray_start_regular):
+    @ray_tpu.remote
+    def nop():
+        return None
+
+    ray_tpu.get([nop.remote() for _ in range(8)])  # warm the pool
+    t0 = time.perf_counter()
+    ray_tpu.get([nop.remote() for _ in range(200)])
+    dt = time.perf_counter() - t0
+    assert 200 / dt > 30, f"task throughput {200/dt:.0f}/s below floor"
+
+
+def test_actor_call_throughput_floor(ray_start_regular):
+    @ray_tpu.remote
+    class A:
+        def f(self):
+            return None
+
+    a = A.remote()
+    ray_tpu.get(a.f.remote())
+    t0 = time.perf_counter()
+    ray_tpu.get([a.f.remote() for _ in range(300)])
+    dt = time.perf_counter() - t0
+    assert 300 / dt > 100, f"actor call throughput {300/dt:.0f}/s below floor"
+
+
+def test_large_object_bandwidth_floor(ray_start_regular):
+    arr = np.ones(4 * 1024 * 1024, dtype=np.float64)  # 32MB
+    t0 = time.perf_counter()
+    ref = ray_tpu.put(arr)
+    out = ray_tpu.get(ref)
+    dt = time.perf_counter() - t0
+    gbps = 2 * arr.nbytes / dt / 1e9
+    assert out.shape == arr.shape
+    assert gbps > 0.2, f"put+get bandwidth {gbps:.2f} GB/s below floor"
+    ray_tpu.free([ref])

@@ -1,0 +1,182 @@
+"""Streaming generators + async actor concurrency.
+
+Reference behaviors matched: streaming generator returns
+(python/ray/_raylet.pyx:273, core_worker.proto ReportGeneratorItemReturns)
+and async actors on a persistent per-actor event loop (core_worker/fiber.h,
+ray async actor semantics).
+"""
+import time
+
+import pytest
+
+import ray_tpu
+
+
+def test_generator_streams_incrementally(ray_start_regular):
+    """Consumer receives item 0 while the producer is still yielding."""
+
+    @ray_tpu.remote(num_returns="streaming")
+    def produce():
+        for i in range(5):
+            yield i
+            time.sleep(0.3)
+
+    gen = produce.remote()
+    t0 = time.perf_counter()
+    first_ref = next(gen)
+    first = ray_tpu.get(first_ref)
+    t_first = time.perf_counter() - t0
+    assert first == 0
+    # Producer sleeps 0.3s after each yield: total runtime >= 1.5s. Getting
+    # item 0 this early proves items stream before the task completes.
+    assert t_first < 1.2, f"first item took {t_first:.2f}s — not streaming"
+    rest = [ray_tpu.get(r) for r in gen]
+    assert rest == [1, 2, 3, 4]
+
+
+def test_generator_backpressure_window(ray_start_regular):
+    """Producer cannot run more than `window` items ahead of the consumer."""
+
+    @ray_tpu.remote(num_returns="streaming", _generator_backpressure_num_objects=2)
+    def produce():
+        for i in range(20):
+            yield time.time()
+
+    gen = produce.remote()
+    refs = [next(gen) for _ in range(3)]
+    time.sleep(1.0)  # give the producer time to run ahead if unthrottled
+    # Items 0-2 consumed; window 2 means item ~5+ can't have been produced
+    # yet. Consume the rest and check yield timestamps show stalls.
+    stamps = [ray_tpu.get(r) for r in refs] + [ray_tpu.get(r) for r in gen]
+    assert len(stamps) == 20
+    # The producer was created before the sleep; if unthrottled, all 20
+    # yields happen within ~100ms. With the window, late items are yielded
+    # after the consumer drained them (i.e. after the 1s sleep).
+    assert stamps[-1] - stamps[0] > 0.8, "producer ran unthrottled past the window"
+
+
+def test_generator_error_propagates(ray_start_regular):
+    @ray_tpu.remote(num_returns="streaming")
+    def boom():
+        yield 1
+        raise ValueError("mid-stream failure")
+
+    gen = boom.remote()
+    assert ray_tpu.get(next(gen)) == 1
+    with pytest.raises(Exception) as ei:
+        for r in gen:
+            ray_tpu.get(r)
+    assert "mid-stream failure" in str(ei.value)
+
+
+def test_non_generator_with_streaming_errors(ray_start_regular):
+    @ray_tpu.remote(num_returns="streaming")
+    def not_a_gen():
+        return 42
+
+    gen = not_a_gen.remote()
+    with pytest.raises(Exception):
+        next(gen)
+
+
+def test_actor_streaming_method(ray_start_regular):
+    @ray_tpu.remote
+    class Producer:
+        def stream(self, n):
+            for i in range(n):
+                yield i * 10
+
+    p = Producer.remote()
+    gen = p.stream.options(num_returns="streaming").remote(4)
+    assert [ray_tpu.get(r) for r in gen] == [0, 10, 20, 30]
+
+
+def test_async_actor_calls_interleave(ray_start_regular):
+    """10 concurrent 0.4s awaits must overlap (wall << serial 4s)."""
+    import asyncio
+
+    @ray_tpu.remote
+    class AsyncWorker:
+        async def slow(self, i):
+            await asyncio.sleep(0.4)
+            return i
+
+    a = AsyncWorker.remote()
+    ray_tpu.get(a.slow.remote(-1))  # warm
+    t0 = time.perf_counter()
+    out = ray_tpu.get([a.slow.remote(i) for i in range(10)])
+    dt = time.perf_counter() - t0
+    assert out == list(range(10))
+    assert dt < 2 * 0.4 + 0.8, f"10 async calls took {dt:.2f}s — serialized"
+
+
+def test_async_actor_state_is_shared(ray_start_regular):
+    import asyncio
+
+    @ray_tpu.remote
+    class Accum:
+        def __init__(self):
+            self.total = 0
+
+        async def add(self, x):
+            self.total += x
+            await asyncio.sleep(0.01)
+            return self.total
+
+        def read(self):
+            return self.total
+
+    a = Accum.remote()
+    ray_tpu.get([a.add.remote(1) for _ in range(20)])
+    assert ray_tpu.get(a.read.remote()) == 20
+
+
+def test_abandoned_generator_releases_producer(ray_start_regular):
+    """Dropping the consumer mid-stream must unstick a producer blocked in
+    the backpressure window (otherwise the worker thread wedges forever)."""
+
+    @ray_tpu.remote
+    class Tracker:
+        def __init__(self):
+            self.stopped = False
+
+        def mark(self):
+            self.stopped = True
+
+        def check(self):
+            return self.stopped
+
+    tracker = Tracker.remote()
+
+    @ray_tpu.remote(num_returns="streaming", _generator_backpressure_num_objects=2)
+    def produce(tracker):
+        try:
+            for i in range(1000):
+                yield i
+        finally:
+            tracker.mark.remote()
+
+    gen = produce.remote(tracker)
+    assert ray_tpu.get(next(gen)) == 0
+    gen.close()
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        if ray_tpu.get(tracker.check.remote()):
+            break
+        time.sleep(0.1)
+    assert ray_tpu.get(tracker.check.remote()), "producer still wedged after close()"
+
+
+def test_async_generator_streaming(ray_start_regular):
+    import asyncio
+
+    @ray_tpu.remote
+    class AsyncProducer:
+        async def stream(self, n):
+            for i in range(n):
+                await asyncio.sleep(0.05)
+                yield i * 2
+
+    p = AsyncProducer.remote()
+    gen = p.stream.options(num_returns="streaming").remote(5)
+    assert [ray_tpu.get(r) for r in gen] == [0, 2, 4, 6, 8]
