@@ -68,7 +68,10 @@ def _run_benchmark() -> None:
     if on_tpu:
         try:  # assert the Pallas flash kernel is on the compiled path
             hlo = ts.lower_step(params, opt_state, b).compile().as_text()
-            flash_in_hlo = "tpu_custom_call" in hlo or "custom-call" in hlo
+            # Pallas kernels lower to custom_call_target="tpu_custom_call";
+            # a generic "custom-call" match would also hit unrelated runtime
+            # calls and mask a silent fallback to reference attention.
+            flash_in_hlo = "tpu_custom_call" in hlo
         except Exception:
             flash_in_hlo = None
 

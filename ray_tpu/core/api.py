@@ -281,6 +281,10 @@ class ObjectRefGenerator:
         return self
 
     def __next__(self) -> ObjectRef:
+        if self._exhausted:
+            # The controller pops generator state at exhaustion; re-asking
+            # for the task would error instead of honoring the protocol.
+            raise StopIteration
         wc = ctx.get_worker_context()
         r = wc.client.request(
             {"kind": "generator_next", "task_id": self._task_id, "index": self._index}
@@ -381,6 +385,7 @@ class RemoteFunction:
             "scheduling": strategy,
             "pg": pg,
             "label": getattr(self._fn, "__name__", "task"),
+            "max_retries": int(opts.get("max_retries", 0)),
         }
         if streaming:
             _streaming_spec_opts(opts, spec)
@@ -520,6 +525,7 @@ class ActorClass:
             "namespace": wc.namespace,
             "detached": opts.get("lifetime") == "detached",
             "max_concurrency": opts.get("max_concurrency", 1),
+            "max_restarts": int(opts.get("max_restarts", 0)),
             "label": f"{self._cls.__name__}.__init__",
         }
         wc.client.request({"kind": "create_actor", "spec": spec})

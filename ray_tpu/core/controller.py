@@ -96,7 +96,7 @@ class WorkerInfo:
 class ActorInfo:
     actor_id: str
     name: Optional[str]
-    state: str = "pending"  # pending | alive | dead
+    state: str = "pending"  # pending | alive | restarting | dead
     worker_id: Optional[str] = None
     node_id: Optional[str] = None
     resources: Dict[str, float] = field(default_factory=dict)
@@ -107,6 +107,11 @@ class ActorInfo:
     reserved: bool = False
     creation_task_id: Optional[str] = None
     order_lock: asyncio.Lock = field(default_factory=asyncio.Lock)
+    # Fault tolerance (reference: gcs_actor_manager.h:88 restart-on-failure):
+    # the creation spec is kept so the actor can be rebuilt elsewhere.
+    max_restarts: int = 0
+    restart_count: int = 0
+    creation_spec: Optional[Dict[str, Any]] = None
 
 
 @dataclass
@@ -161,6 +166,14 @@ class Controller:
         self.tasks: Dict[str, Dict[str, Any]] = {}  # pending/running task specs
         self.pending_queue: List[str] = []  # task_ids awaiting scheduling
         self.generators: Dict[str, GeneratorState] = {}  # streaming tasks
+        # Bounded lineage: completed task specs keyed by their return object
+        # ids, so a lost object's producing task can re-execute (reference:
+        # object_recovery_manager.h + lineage in reference_count.h).
+        import collections as _collections
+
+        self.lineage: "_collections.OrderedDict[str, Dict[str, Any]]" = (
+            _collections.OrderedDict())
+        self.lineage_max = int(os.environ.get("RTPU_LINEAGE_MAX", "10000"))
         self.functions: Dict[str, bytes] = {}  # function/class table (gcs_function_manager)
         self.kv: Dict[Tuple[str, str], bytes] = {}
         self.pgs: Dict[str, PGInfo] = {}
@@ -312,16 +325,20 @@ class Controller:
             if nid == node.node_id:
                 self._agent_spawns.pop(tok, None)
                 self._tpu_spawn_tokens.discard(tok)
-        # Objects whose bytes lived only on the dead host are lost: replace
-        # their locations with a clear error so a later get() doesn't dial a
-        # dead pull server (pre-lineage semantics; object reconstruction is
-        # the recovery layer's job, reference object_recovery_manager.h).
+        # Objects whose bytes lived only on the dead host are lost. If the
+        # producing task's spec is in the lineage table and its deps are
+        # still resolvable, re-execute it (reference:
+        # object_recovery_manager.h ReconstructObject); otherwise store a
+        # clear error so a later get() doesn't dial a dead pull server.
+        resubmitted: Set[str] = set()
         for oid, loc in list(self.objects.items()):
             if (
                 loc.inline is None
                 and loc.host_id is not None
                 and loc.host_id == node.host_id
             ):
+                if self._maybe_reconstruct(oid, resubmitted):
+                    continue
                 self._store_error(
                     oid,
                     ObjectLostError(
@@ -331,27 +348,135 @@ class Controller:
                 )
         self._wake_scheduler()
 
+    def _maybe_reconstruct(self, oid: str, resubmitted: Set[str]) -> bool:
+        """Resubmit the producing task of a lost object. Single-level: deps
+        must still be present (a missing dep chain errors out rather than
+        recursing)."""
+        spec = self.lineage.get(oid)
+        if spec is None:
+            return False
+        if spec["task_id"] in resubmitted:
+            self.objects.pop(oid, None)  # resubmit already queued covers it
+            return True
+        if spec["task_id"] in self.tasks:
+            self.objects.pop(oid, None)
+            return True
+        recon = int(spec.get("_reconstructions", 0))
+        if recon >= int(os.environ.get("RTPU_MAX_RECONSTRUCTIONS", "3")):
+            return False
+        for dep in spec.get("deps", []):
+            loc = self.objects.get(dep)
+            if loc is None:
+                # Gone entirely: ok only if its producer is already being
+                # re-run (the dep waiter picks up the new location); a freed
+                # dep would stall the resubmit forever.
+                dspec = self.lineage.get(dep)
+                if dspec is None or (
+                    dspec["task_id"] not in resubmitted
+                    and dspec["task_id"] not in self.tasks
+                ):
+                    return False
+            elif loc.is_error:
+                return False
+        spec["_reconstructions"] = recon + 1
+        spec["state"] = "pending"
+        spec.pop("sched_node", None)
+        spec.pop("blocked", None)
+        # Drop the stale locations so consumers re-wait on the new result.
+        for rid in spec["return_ids"]:
+            self.objects.pop(rid, None)
+        resubmitted.add(spec["task_id"])
+        self.tasks[spec["task_id"]] = spec
+        self.pending_queue.append(spec["task_id"])
+        self._record_task_event(spec, "reconstruct")
+        return True
+
     async def _on_worker_death(self, w: WorkerInfo) -> None:
         self.workers.pop(w.worker_id, None)
         node = self.nodes.get(w.node_id)
         if node:
             node.workers.discard(w.worker_id)
-        # Fail the running task, if any.
+        # Fail — or retry — the running task (reference: task resubmission on
+        # worker failure, core_worker/task_manager.h max_retries).
         if w.current_task and w.current_task in self.tasks:
             spec = self.tasks.pop(w.current_task)
             self._release_task_resources(spec)
             err = WorkerCrashedError(
                 f"worker {w.worker_id[:8]} died while running task {spec.get('label', '')}"
             )
-            self._finalize_generator(spec["task_id"], err)
-            for oid in spec["return_ids"]:
-                self._store_error(oid, err)
-        # Mark hosted actors dead.
+            if not self._maybe_retry_task(spec):
+                self._finalize_generator(spec["task_id"], err)
+                for oid in spec["return_ids"]:
+                    self._store_error(oid, err)
+        # Restart or mark dead hosted actors.
         for aid in list(w.actor_ids):
             actor = self.actors.get(aid)
             if actor and actor.state != "dead":
-                self._mark_actor_dead(actor, WorkerCrashedError(f"actor {aid[:8]} process died"))
+                err = WorkerCrashedError(f"actor {aid[:8]} process died")
+                if not self._maybe_restart_actor(actor, err):
+                    self._mark_actor_dead(actor, err)
         self._wake_scheduler()
+
+    def _maybe_retry_task(self, spec: Dict[str, Any]) -> bool:
+        """Resubmit a task killed by a system failure (worker/node death),
+        up to max_retries times. Application errors never retry here — they
+        reach _h_task_done as error locations, not a dead connection."""
+        if spec.get("is_actor_creation") or spec.get("actor_id"):
+            return False
+        retries = int(spec.get("max_retries", 0))
+        used = int(spec.get("_retry_count", 0))
+        if used >= retries:
+            return False
+        if spec.get("streaming") and spec["task_id"] in self.generators:
+            gen = self.generators[spec["task_id"]]
+            if gen.items:
+                # Items already observed by the consumer can't be replayed
+                # consistently; only an unstarted stream retries.
+                return False
+        spec["_retry_count"] = used + 1
+        spec["state"] = "pending"
+        spec.pop("sched_node", None)
+        spec.pop("blocked", None)
+        self.tasks[spec["task_id"]] = spec
+        self.pending_queue.append(spec["task_id"])
+        self._record_task_event(spec, "retry")
+        self._wake_scheduler()
+        return True
+
+    def _maybe_restart_actor(self, actor: ActorInfo, err: Exception) -> bool:
+        """Re-instantiate a crashed actor from its creation spec (reference:
+        gcs_actor_manager RestartActor, max_restarts semantics). In-flight
+        calls fail (at-most-once actor tasks); calls submitted while
+        restarting buffer and replay on actor_ready."""
+        spec = actor.creation_spec
+        if spec is None or actor.restart_count >= actor.max_restarts:
+            return False
+        actor.restart_count += 1
+        actor.state = "restarting"
+        # Fail calls already forwarded to the dead worker — but NOT calls
+        # still buffered in pending_calls (never dispatched): those replay
+        # after restart, and erroring them here would double-signal.
+        buffered = {p["task_id"] for p in actor.pending_calls}
+        for tid, t in list(self.tasks.items()):
+            if (
+                t.get("actor_id") == actor.actor_id
+                and not t.get("is_actor_creation")
+                and tid not in buffered
+            ):
+                self._fail_task(t, err)
+        node = self.nodes.get(actor.node_id or "")
+        if node and actor.reserved:
+            actor.reserved = False
+            self._release_reservation(actor.resources, node, actor.pg)
+        actor.worker_id = None
+        actor.node_id = None
+        spec["state"] = "pending"
+        spec.pop("sched_node", None)
+        self.tasks[spec["task_id"]] = spec
+        self.pending_queue.append(spec["task_id"])
+        self._record_task_event(spec, "actor_restart")
+        self._wake_scheduler()
+        return True
 
     # ------------------------------------------------------------ msg routing
 
@@ -692,8 +817,27 @@ class Controller:
                 w.state = "idle"
         if spec is not None:
             self._release_task_resources(spec)
+            self._record_lineage(spec, msg)
         self._wake_scheduler()
         return {"ok": True}
+
+    def _record_lineage(self, spec: Dict[str, Any], msg: Dict[str, Any]) -> None:
+        """Remember the spec of a successfully finished plain task so its
+        outputs can be reconstructed after a node loss."""
+        if (
+            msg.get("is_error")
+            or msg.get("error_locations")
+            or spec.get("actor_id")
+            or spec.get("is_actor_creation")
+            or spec.get("streaming")
+            or not spec.get("return_ids")
+        ):
+            return
+        for oid in spec["return_ids"]:
+            self.lineage[oid] = spec
+            self.lineage.move_to_end(oid)
+        while len(self.lineage) > self.lineage_max:
+            self.lineage.popitem(last=False)
 
     async def _h_task_blocked(self, conn, msg):
         # A task blocked in get() releases its CPU so child tasks can run
@@ -739,6 +883,8 @@ class Controller:
             pg=spec.get("pg"),
             detached=spec.get("detached", False),
             creation_task_id=spec["task_id"],
+            max_restarts=int(spec.get("max_restarts", 0)),
+            creation_spec=spec,
         )
         self.actors[actor_id] = actor
         spec["is_actor_creation"] = True
@@ -791,7 +937,7 @@ class Controller:
                 self._store_error(oid, err)
             return {"ok": True}
         self.tasks[spec["task_id"]] = spec
-        if actor.state == "pending":
+        if actor.state in ("pending", "restarting"):
             actor.pending_calls.append(spec)
         else:
             await self._dispatch_actor_call(actor, spec)

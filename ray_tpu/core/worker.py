@@ -224,9 +224,18 @@ class WorkerRuntime:
                             try:
                                 value = await result
                             except BaseException as e:  # noqa: BLE001
-                                self._complete_error(spec, e, traceback.format_exc())
+                                tb = traceback.format_exc()
+                                await asyncio.get_running_loop().run_in_executor(
+                                    None,
+                                    lambda: self._complete_error(spec, e, tb),
+                                )
                             else:
-                                self._complete_ok(spec, value)
+                                # Serialization + the controller round-trip
+                                # block; keep them off the actor loop so
+                                # other in-flight awaits keep interleaving.
+                                await asyncio.get_running_loop().run_in_executor(
+                                    None, lambda: self._complete_ok(spec, value)
+                                )
 
                     asyncio.run_coroutine_threadsafe(drive(), loop)
                     return

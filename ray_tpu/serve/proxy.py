@@ -30,6 +30,13 @@ class HTTPProxy:
         self._started = threading.Event()
         self._thread = threading.Thread(target=self._run, daemon=True)
         self._runner = None
+        # Streaming responses park a thread per open connection between
+        # chunks; a dedicated pool keeps slow streams from starving the
+        # default executor that serves every non-streaming request.
+        from concurrent.futures import ThreadPoolExecutor
+
+        self._stream_pool = ThreadPoolExecutor(
+            max_workers=64, thread_name_prefix="proxy-stream")
 
     # ----------------------------------------------------------------- serve
 
@@ -100,7 +107,8 @@ class HTTPProxy:
             # assign() does blocking controller/replica RPCs — keep them off
             # the proxy event loop (the non-streaming path does the same).
             gen = await loop.run_in_executor(
-                None, lambda: iter(handle.options(stream=True).remote(arg)))
+                self._stream_pool,
+                lambda: iter(handle.options(stream=True).remote(arg)))
         except Exception as e:
             return web.json_response({"error": str(e)}, status=500)
         resp = web.StreamResponse()
@@ -110,7 +118,7 @@ class HTTPProxy:
         while True:
             try:
                 item = await loop.run_in_executor(
-                    None, lambda: next(gen, _END))
+                    self._stream_pool, lambda: next(gen, _END))
             except Exception:
                 break  # mid-stream failure: terminate the chunked body
             if item is _END:

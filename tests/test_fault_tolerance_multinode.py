@@ -1,0 +1,41 @@
+"""Lineage reconstruction across node failure (own module: owns its cluster,
+must not share the module-scoped single-node fixture)."""
+import time
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.core.cluster_utils import Cluster
+from ray_tpu.util.scheduling_strategies import NodeAffinitySchedulingStrategy
+
+
+def test_object_reconstruction_on_node_death():
+    """An object whose bytes died with its node is recomputed from lineage
+    when the producing task is known and retryable."""
+    cluster = Cluster(head_resources={"CPU": 2})
+    try:
+        nid = cluster.add_node({"CPU": 2}, remote=True, host_id="recon-host-b")
+
+        @ray_tpu.remote(
+            max_retries=1,
+            scheduling_strategy=NodeAffinitySchedulingStrategy(
+                node_id=nid, soft=True),
+        )
+        def produce():
+            return np.arange(500_000, dtype=np.float64)  # 4MB, not inline
+
+        ref = produce.remote()
+        first = ray_tpu.get(ref, timeout=60)
+        assert first.shape == (500_000,)
+        cluster.kill_node_agent(0)
+        # Wait for the controller to notice the node death.
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            nodes = {n["node_id"]: n for n in ray_tpu.nodes()}
+            if not nodes[nid]["alive"]:
+                break
+            time.sleep(0.2)
+        out = ray_tpu.get(ref, timeout=60)  # reconstructed on the head node
+        np.testing.assert_array_equal(out, first)
+    finally:
+        cluster.shutdown()
