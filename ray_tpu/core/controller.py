@@ -213,6 +213,19 @@ class Controller:
         loop = asyncio.get_running_loop()
         self._sched_task = loop.create_task(self._scheduler_loop())
         self._health_task = loop.create_task(self._health_check_loop())
+        # Prometheus scrape endpoint (GET /metrics) on an ephemeral port,
+        # advertised via cluster_state.metrics_port.
+        try:
+            self._metrics_server = await asyncio.start_server(
+                self._serve_metrics_http, self.host,
+                int(os.environ.get("RTPU_METRICS_PORT", "0")))
+            self.metrics_port = self._metrics_server.sockets[0].getsockname()[1]
+        except Exception as e:
+            sys.stderr.write(
+                f"[controller] metrics endpoint disabled: {e!r} "
+                f"(RTPU_METRICS_PORT={os.environ.get('RTPU_METRICS_PORT')})\n")
+            self._metrics_server = None
+            self.metrics_port = 0
         return self.host, self.port
 
     def add_node(
@@ -265,6 +278,8 @@ class Controller:
             self._sched_task.cancel()
         if self._health_task is not None:
             self._health_task.cancel()
+        if getattr(self, "_metrics_server", None) is not None:
+            self._metrics_server.close()
         if self.server is not None:
             self.server.close()
 
@@ -897,7 +912,9 @@ class Controller:
         if actor is None:
             return {"ok": False}
         if actor.creation_task_id:
-            self.tasks.pop(actor.creation_task_id, None)
+            spec = self.tasks.pop(actor.creation_task_id, None)
+            if spec is not None:
+                self._record_task_event(spec, "finished")
         actor.state = "alive"
         calls, actor.pending_calls = actor.pending_calls, []
         for call in calls:
@@ -909,7 +926,9 @@ class Controller:
         if actor is None:
             return {"ok": False}
         if actor.creation_task_id:
-            self.tasks.pop(actor.creation_task_id, None)
+            spec = self.tasks.pop(actor.creation_task_id, None)
+            if spec is not None:
+                self._record_task_event(spec, "failed")
         actor.creation_error = msg["error"]
         self._mark_actor_dead(actor, msg["error"])
         w = self.workers.get(actor.worker_id or "")
@@ -958,6 +977,8 @@ class Controller:
                 self._fail_task(spec, err)
                 return
             spec["sched_node"] = actor.node_id
+            self._record_task_event(spec, "running", worker_id=w.worker_id,
+                                    node_id=actor.node_id)
             await w.conn.send({"kind": "execute_actor_task", "spec": spec})
 
     async def _h_get_named_actor(self, conn, msg):
@@ -1122,6 +1143,155 @@ class Controller:
                 pass
         return {"ok": True}
 
+    async def _h_list_state(self, conn, msg):
+        """State API backend (reference: python/ray/util/state/api.py:110 —
+        list tasks/actors/nodes/workers/objects + task summaries), reading
+        the live tables and the bounded task-event history."""
+        what = msg["what"]
+        limit = int(msg.get("limit", 1000))
+        if what == "tasks":
+            latest = self._latest_task_events()
+            out = [
+                {
+                    "task_id": tid,
+                    "name": ev.get("label"),
+                    "state": {"submitted": "PENDING", "running": "RUNNING",
+                              "finished": "FINISHED", "failed": "FAILED",
+                              "retry": "PENDING", "reconstruct": "PENDING",
+                              "actor_restart": "PENDING"}.get(
+                                  ev["event"], ev["event"].upper()),
+                    "actor_id": ev.get("actor_id"),
+                    "worker_id": ev.get("worker_id"),
+                    "node_id": ev.get("node_id"),
+                    "ts": ev["ts"],
+                }
+                for tid, ev in latest.items()
+            ]
+            return out[-limit:]
+        if what == "actors":
+            return [
+                {
+                    "actor_id": a.actor_id,
+                    "state": a.state.upper(),
+                    "name": a.name,
+                    "node_id": a.node_id,
+                    "worker_id": a.worker_id,
+                    "restarts": a.restart_count,
+                }
+                for a in list(self.actors.values())[:limit]
+            ]
+        if what == "nodes":
+            return (await self._h_cluster_state(conn, msg))["nodes"][:limit]
+        if what == "workers":
+            return [
+                {
+                    "worker_id": w.worker_id,
+                    "node_id": w.node_id,
+                    "state": w.state,
+                    "current_task": w.current_task,
+                    "tpu_capable": w.tpu_capable,
+                }
+                for w in list(self.workers.values())[:limit]
+            ]
+        if what == "objects":
+            return [
+                {
+                    "object_id": oid,
+                    "size": loc.size,
+                    "backend": ("inline" if loc.inline is not None
+                                else "arena" if loc.arena else "shm"),
+                    "node_id": loc.node_id,
+                    "is_error": loc.is_error,
+                }
+                for oid, loc in list(self.objects.items())[:limit]
+            ]
+        if what == "summary":
+            counts: Dict[str, Dict[str, int]] = {}
+            for ev in self._latest_task_events().values():
+                row = counts.setdefault(ev.get("label") or "?", {})
+                row[ev["event"]] = row.get(ev["event"], 0) + 1
+            return counts
+        raise ValueError(f"unknown state listing {what!r}")
+
+    def _latest_task_events(self) -> Dict[str, Dict[str, Any]]:
+        """task_id -> its most recent event (events append in order)."""
+        latest: Dict[str, Dict[str, Any]] = {}
+        for ev in self.task_events:
+            latest[ev["task_id"]] = ev
+        return latest
+
+    async def _h_task_events(self, conn, msg):
+        """Raw event stream for the chrome-trace timeline export
+        (reference: GlobalState.chrome_tracing_dump, _private/state.py:434)."""
+        return list(self.task_events)
+
+    def _metrics_text(self) -> str:
+        """Prometheus text exposition (reference: _private/metrics_agent.py
+        + ray_metrics_export — collapsed to a controller-local scrape)."""
+        counts: Dict[str, int] = {}
+        for ev in self._latest_task_events().values():
+            counts[ev["event"]] = counts.get(ev["event"], 0) + 1
+        # Gauge, not counter: the value is "tasks currently in state X" over
+        # a bounded event window — it goes down on transitions/eviction,
+        # which would break Prometheus rate() on a counter type.
+        lines = [
+            "# TYPE rtpu_tasks gauge",
+        ]
+        for state, n in sorted(counts.items()):
+            lines.append(f'rtpu_tasks{{state="{state}"}} {n}')
+        lines += [
+            "# TYPE rtpu_pending_tasks gauge",
+            f"rtpu_pending_tasks {len(self.pending_queue)}",
+            "# TYPE rtpu_workers gauge",
+            f"rtpu_workers {len(self.workers)}",
+            "# TYPE rtpu_actors gauge",
+            f"rtpu_actors {len(self.actors)}",
+            "# TYPE rtpu_nodes_alive gauge",
+            f"rtpu_nodes_alive {sum(1 for n in self.nodes.values() if n.alive)}",
+            "# TYPE rtpu_objects gauge",
+            f"rtpu_objects {len(self.objects)}",
+            "# TYPE rtpu_uptime_seconds counter",
+            f"rtpu_uptime_seconds {time.time() - self.start_time:.1f}",
+        ]
+        if self._arena is not None:
+            st = self._arena.stats()
+            lines += [
+                "# TYPE rtpu_arena_used_bytes gauge",
+                f"rtpu_arena_used_bytes {st['used']}",
+                "# TYPE rtpu_arena_capacity_bytes gauge",
+                f"rtpu_arena_capacity_bytes {st['capacity']}",
+            ]
+        for n in self.nodes.values():
+            if n.arena_stats:
+                lines.append(
+                    f'rtpu_node_arena_used_bytes{{node="{n.node_id[:12]}"}} '
+                    f"{n.arena_stats.get('used', 0)}")
+        return "\n".join(lines) + "\n"
+
+    async def _serve_metrics_http(self, reader, writer) -> None:
+        """Minimal HTTP/1.0 responder for GET /metrics — no web framework in
+        the core control plane."""
+        try:
+            await asyncio.wait_for(reader.readline(), 5)
+            while True:
+                line = await asyncio.wait_for(reader.readline(), 5)
+                if not line or line in (b"\r\n", b"\n"):
+                    break
+            body = self._metrics_text().encode()
+            writer.write(
+                b"HTTP/1.0 200 OK\r\nContent-Type: text/plain; version=0.0.4"
+                b"\r\nContent-Length: " + str(len(body)).encode()
+                + b"\r\n\r\n" + body
+            )
+            await writer.drain()
+        except Exception:
+            pass
+        finally:
+            try:
+                writer.close()
+            except Exception:
+                pass
+
     async def _h_cluster_state(self, conn, msg):
         return {
             "nodes": [
@@ -1143,6 +1313,7 @@ class Controller:
             },
             "pending_tasks": len(self.pending_queue),
             "uptime_s": time.time() - self.start_time,
+            "metrics_port": getattr(self, "metrics_port", 0),
         }
 
     async def _h_add_node(self, conn, msg):
@@ -1456,6 +1627,8 @@ class Controller:
                 return
 
     async def _dispatch(self, spec: Dict[str, Any], node: NodeInfo, w: WorkerInfo) -> None:
+        self._record_task_event(spec, "running", worker_id=w.worker_id,
+                                node_id=node.node_id)
         if spec.get("is_actor_creation"):
             actor = self.actors[spec["actor_id"]]
             actor.worker_id = w.worker_id

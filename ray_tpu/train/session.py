@@ -138,3 +138,39 @@ def get_mesh() -> Any:
 def collective_group_name() -> Optional[str]:
     """Name of the host-collective group joined by this worker (backend-set)."""
     return _get_session().collective_group
+
+
+def start_profile(logdir: str) -> None:
+    """Start an xprof/TensorBoard trace capture on this train worker
+    (SURVEY.md §5.1: the TPU-native replacement for the reference's py-spy /
+    torch-profiler hooks — jax.profiler traces show XLA ops, TPU step time,
+    and host/device transfers; view with tensorboard --logdir)."""
+    _get_session()  # must be inside a training session
+    import jax
+
+    jax.profiler.start_trace(logdir)
+
+
+def stop_profile() -> None:
+    """Stop the trace started by start_profile and flush it to the logdir."""
+    import jax
+
+    jax.profiler.stop_trace()
+
+
+class profile:
+    """Context manager: ``with session.profile(logdir): train_steps()``."""
+
+    def __init__(self, logdir: str):
+        self.logdir = logdir
+
+    def __enter__(self):
+        start_profile(self.logdir)
+        return self
+
+    def __exit__(self, *exc):
+        try:
+            stop_profile()
+        except Exception:
+            pass
+        return False

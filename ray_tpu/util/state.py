@@ -1,0 +1,104 @@
+"""State observability API.
+
+Parity surface with the reference's state API + timeline export:
+- list_tasks/list_actors/list_nodes/list_workers/list_objects + summarize
+  (ray: python/ray/util/state/api.py:110, state_manager queries),
+- timeline() chrome-trace export (ray: GlobalState.chrome_tracing_dump,
+  python/ray/_private/state.py:434) — open the file in chrome://tracing or
+  Perfetto,
+- metrics_address() for the controller's Prometheus scrape endpoint
+  (ray: _private/metrics_agent.py role, collapsed to a controller-local
+  /metrics listener).
+"""
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List, Optional
+
+from ray_tpu.core import context as ctx
+
+
+def _req(msg: Dict[str, Any]) -> Any:
+    return ctx.get_worker_context().client.request(msg)
+
+
+def list_tasks(limit: int = 1000) -> List[Dict[str, Any]]:
+    return _req({"kind": "list_state", "what": "tasks", "limit": limit})
+
+
+def list_actors(limit: int = 1000) -> List[Dict[str, Any]]:
+    return _req({"kind": "list_state", "what": "actors", "limit": limit})
+
+
+def list_nodes(limit: int = 1000) -> List[Dict[str, Any]]:
+    return _req({"kind": "list_state", "what": "nodes", "limit": limit})
+
+
+def list_workers(limit: int = 1000) -> List[Dict[str, Any]]:
+    return _req({"kind": "list_state", "what": "workers", "limit": limit})
+
+
+def list_objects(limit: int = 1000) -> List[Dict[str, Any]]:
+    return _req({"kind": "list_state", "what": "objects", "limit": limit})
+
+
+def summarize_tasks() -> Dict[str, Dict[str, int]]:
+    """Per-function counts of task events (reference: `ray summary tasks`)."""
+    return _req({"kind": "list_state", "what": "summary"})
+
+
+def metrics_address() -> Optional[str]:
+    """host:port of the controller's Prometheus /metrics endpoint."""
+    state = _req({"kind": "cluster_state"})
+    port = state.get("metrics_port")
+    if not port:
+        return None
+    host = ctx.get_worker_context().client.host
+    return f"{host}:{port}"
+
+
+def timeline(filename: Optional[str] = None) -> Any:
+    """Export task events as a chrome-trace JSON (trace-event format).
+
+    Pairs each task's "running" event with its terminal event into one
+    complete ("ph": "X") slice; rows are (node, worker). Load the file in
+    chrome://tracing or https://ui.perfetto.dev.
+    """
+    events = _req({"kind": "task_events"})
+    starts: Dict[str, Dict[str, Any]] = {}
+    trace: List[Dict[str, Any]] = []
+    for ev in events:
+        tid = ev["task_id"]
+        if ev["event"] == "running":
+            starts[tid] = ev
+        elif ev["event"] in ("finished", "failed") and tid in starts:
+            s = starts.pop(tid)
+            trace.append(
+                {
+                    "name": s.get("label") or tid[:8],
+                    "cat": "actor_task" if s.get("actor_id") else "task",
+                    "ph": "X",
+                    "ts": s["ts"] * 1e6,
+                    "dur": max(1.0, (ev["ts"] - s["ts"]) * 1e6),
+                    "pid": (s.get("node_id") or "node")[:12],
+                    "tid": (s.get("worker_id") or "worker")[:12],
+                    "args": {"task_id": tid, "outcome": ev["event"]},
+                }
+            )
+    # Still-running tasks appear as begin events so they show in the view.
+    for tid, s in starts.items():
+        trace.append(
+            {
+                "name": s.get("label") or tid[:8],
+                "cat": "task",
+                "ph": "B",
+                "ts": s["ts"] * 1e6,
+                "pid": (s.get("node_id") or "node")[:12],
+                "tid": (s.get("worker_id") or "worker")[:12],
+            }
+        )
+    if filename is not None:
+        with open(filename, "w") as f:
+            json.dump(trace, f)
+        return filename
+    return trace

@@ -1,0 +1,79 @@
+"""State API, chrome-trace timeline, Prometheus metrics.
+
+Reference surfaces matched: python/ray/util/state/api.py:110 (list_*),
+GlobalState.chrome_tracing_dump (_private/state.py:434), and the metrics
+agent's Prometheus exposition (_private/metrics_agent.py).
+"""
+import json
+import time
+import urllib.request
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util import state
+
+
+def test_list_tasks_and_summary(ray_start_regular):
+    @ray_tpu.remote
+    def labeled_task(x):
+        return x + 1
+
+    ray_tpu.get([labeled_task.remote(i) for i in range(5)])
+    tasks = state.list_tasks()
+    mine = [t for t in tasks if t["name"] == "labeled_task"]
+    assert len(mine) >= 5
+    assert all(t["state"] == "FINISHED" for t in mine)
+    summary = state.summarize_tasks()
+    assert summary.get("labeled_task", {}).get("finished", 0) >= 5
+
+
+def test_list_actors_workers_nodes(ray_start_regular):
+    @ray_tpu.remote
+    class Obs:
+        def ping(self):
+            return 1
+
+    a = Obs.remote()
+    ray_tpu.get(a.ping.remote())
+    actors = state.list_actors()
+    assert any(x["actor_id"] == a._actor_id and x["state"] == "ALIVE"
+               for x in actors)
+    assert len(state.list_workers()) >= 1
+    assert len(state.list_nodes()) >= 1
+
+
+def test_timeline_chrome_trace(ray_start_regular, tmp_path):
+    @ray_tpu.remote
+    def traced():
+        time.sleep(0.05)
+        return 1
+
+    ray_tpu.get([traced.remote() for _ in range(3)])
+    path = str(tmp_path / "timeline.json")
+    state.timeline(path)
+    with open(path) as f:
+        trace = json.load(f)
+    slices = [e for e in trace if e["ph"] == "X" and e["name"] == "traced"]
+    assert len(slices) >= 3
+    for e in slices:
+        assert e["dur"] >= 1.0 and "ts" in e and "pid" in e and "tid" in e
+
+
+def test_prometheus_metrics_scrape(ray_start_regular):
+    @ray_tpu.remote
+    def m():
+        return 1
+
+    ray_tpu.get(m.remote())
+    addr = state.metrics_address()
+    assert addr, "metrics endpoint not advertised"
+    with urllib.request.urlopen(f"http://{addr}/metrics", timeout=10) as resp:
+        text = resp.read().decode()
+    assert "rtpu_tasks" in text
+    assert "rtpu_workers" in text
+    # Arena stats appear only when the native store built/loaded.
+    from ray_tpu.core import native_store
+
+    if native_store.get_arena() is not None:
+        assert "rtpu_arena_used_bytes" in text

@@ -165,3 +165,34 @@ def test_worker_context_ranks(ray_start_regular, tmp_path):
     result = trainer.fit()
     assert result.metrics["world_size"] == 2
     assert result.metrics["world_rank"] == 0
+
+
+def test_session_profile_capture(ray_start_regular, tmp_path):
+    """session.profile wraps jax.profiler trace capture on a train worker
+    (SURVEY §5.1 xprof hook). The trace directory must be created and
+    non-empty after a profiled step."""
+    logdir = str(tmp_path / "xprof")
+
+    def loop(config):
+        from ray_tpu import train
+        from ray_tpu.util.jaxenv import ensure_platform
+
+        ensure_platform("cpu")
+        import jax.numpy as jnp
+
+        with train.session.profile(config["logdir"]):
+            x = jnp.ones((64, 64))
+            (x @ x).block_until_ready()
+        train.report({"done": 1})
+
+    trainer = DataParallelTrainer(
+        train_loop_per_worker=loop,
+        train_loop_config={"logdir": logdir},
+        scaling_config=ScalingConfig(num_workers=1),
+    )
+    result = trainer.fit()
+    assert result.metrics["done"] == 1
+    import glob
+
+    assert glob.glob(os.path.join(logdir, "**", "*"), recursive=True), \
+        "no xprof trace files written"
