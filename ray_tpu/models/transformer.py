@@ -162,24 +162,27 @@ def init_params(key: jax.Array, cfg: TransformerConfig) -> Params:
 def param_logical_specs(cfg: TransformerConfig) -> Params:
     """Pytree of logical axis names matching init_params' structure
     (consumed by parallel.sharding.tree_shardings)."""
+    # The leading dim is the layer stack: logical axis "layers" maps onto
+    # the `pipe` mesh axis so each pipeline stage holds a contiguous range
+    # of layers (parallel/pipeline.py).
     layers = {
-        "attn_norm": (None, None),
-        "wo": (None, "heads", "embed"),
-        "mlp_norm": (None, None),
-        "w_down": (None, "mlp", "embed"),
+        "attn_norm": ("layers", None),
+        "wo": ("layers", "heads", "embed"),
+        "mlp_norm": ("layers", None),
+        "w_down": ("layers", "mlp", "embed"),
     }
     if cfg.kv_heads == cfg.n_heads:
-        layers["wqkv"] = (None, "embed", None, "heads", None)
+        layers["wqkv"] = ("layers", "embed", None, "heads", None)
     else:
-        layers["wq"] = (None, "embed", "heads", None)
-        layers["wkv"] = (None, "embed", None, "kv_heads", None)
+        layers["wq"] = ("layers", "embed", "heads", None)
+        layers["wkv"] = ("layers", "embed", None, "kv_heads", None)
     if cfg.activation == "swiglu":
-        layers["w_gate_up"] = (None, "embed", None, "mlp")
+        layers["w_gate_up"] = ("layers", "embed", None, "mlp")
     else:
-        layers["w_up"] = (None, "embed", "mlp")
+        layers["w_up"] = ("layers", "embed", "mlp")
     if cfg.norm == "layernorm":
-        layers["attn_norm_b"] = (None, None)
-        layers["mlp_norm_b"] = (None, None)
+        layers["attn_norm_b"] = ("layers", None)
+        layers["mlp_norm_b"] = ("layers", None)
     specs: Params = {
         "embed": ("vocab", "embed"),
         "final_norm": (None,),
@@ -257,8 +260,8 @@ def _layer_body(cfg: TransformerConfig, x: jax.Array, layer: Params, positions: 
     return x
 
 
-def forward(params: Params, tokens: jax.Array, cfg: TransformerConfig) -> jax.Array:
-    """tokens [B, S] int32 -> logits [B, S, V] (f32)."""
+def embed_tokens(params: Params, tokens: jax.Array, cfg: TransformerConfig) -> jax.Array:
+    """tokens [B, S] -> embeddings [B, S, d] (cfg.dtype)."""
     B, S = tokens.shape
     # Replicate the table for the lookup (FSDP all-gather-at-use): a gather
     # from a vocab/embed-sharded operand forces GSPMD into involuntary full
@@ -270,10 +273,14 @@ def forward(params: Params, tokens: jax.Array, cfg: TransformerConfig) -> jax.Ar
     tbl = maybe_constrain(params["embed"].astype(cfg.dtype), (None, None))
     x = tbl[tokens]
     x = maybe_constrain(x, ("batch", "seq_act", "embed"))
-    positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32)[None], (B, S))
     if cfg.positional == "learned":
         x = x + params["pos_embed"].astype(cfg.dtype)[:S][None]
+    return x
 
+
+def layer_scan_body(cfg: TransformerConfig, positions: jax.Array):
+    """The (remat-wrapped) per-layer scan body; shared by the plain forward
+    and the pipeline-parallel stage apply (parallel/pipeline.py)."""
     body = lambda carry, layer: (_layer_body(cfg, carry, layer, positions), None)
     if cfg.remat:
         if cfg.remat_policy == "dots":
@@ -290,32 +297,38 @@ def forward(params: Params, tokens: jax.Array, cfg: TransformerConfig) -> jax.Ar
             )
         else:
             body = jax.checkpoint(body)
-    x, _ = jax.lax.scan(body, x, params["layers"])
+    return body
 
+
+def forward(params: Params, tokens: jax.Array, cfg: TransformerConfig) -> jax.Array:
+    """tokens [B, S] int32 -> logits [B, S, V] (f32)."""
+    B, S = tokens.shape
+    x = embed_tokens(params, tokens, cfg)
+    positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32)[None], (B, S))
+    x, _ = jax.lax.scan(layer_scan_body(cfg, positions), x, params["layers"])
+    return lm_head(params, x, cfg)
+
+
+def lm_head(params: Params, x: jax.Array, cfg: TransformerConfig) -> jax.Array:
+    """Final norm + (tied) output projection: hidden [B,S,d] -> logits f32."""
     x = _norm(x, params["final_norm"], params.get("final_norm_b"), cfg.norm)
     head = params.get("lm_head", None)
     if head is None:
         head = params["embed"].T
-    logits = x @ head.astype(cfg.dtype)
-    return logits.astype(jnp.float32)
+    return (x @ head.astype(cfg.dtype)).astype(jnp.float32)
 
 
-def loss_fn(params: Params, batch: Dict[str, jax.Array], cfg: TransformerConfig) -> jax.Array:
-    """Next-token cross-entropy. batch: tokens [B,S]; loss over tokens[1:].
+def next_token_loss(logits: jax.Array, batch: Dict[str, jax.Array]) -> jax.Array:
+    """Next-token cross-entropy over logits [B,S,V]; loss over tokens[1:].
 
-    The forward runs on the FULL sequence (the final position's logits are
-    masked out of the loss) so the activation sequence length stays divisible
-    by the `seq` mesh axis under context parallelism — slicing to S-1 would
-    break ring-attention sharding for power-of-two S.
+    Fused: ll = logits[target] - logsumexp(logits) avoids materializing a
+    second [B, S, V] f32 log-softmax tensor (at V=32k that tensor dominates
+    HBM traffic for the loss epilogue).
     """
     tokens = batch["tokens"]
     B, S = tokens.shape
-    logits = forward(params, tokens, cfg)  # [B, S, V]
     targets = jnp.concatenate(
         [tokens[:, 1:], jnp.zeros((B, 1), tokens.dtype)], axis=1)
-    # Fused cross-entropy: ll = logits[target] - logsumexp(logits) avoids
-    # materializing a second [B, S, V] f32 log-softmax tensor (at V=32k that
-    # tensor dominates HBM traffic for the loss epilogue).
     lse = jax.scipy.special.logsumexp(logits, axis=-1)
     at_target = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
     ll = at_target - lse
@@ -328,3 +341,15 @@ def loss_fn(params: Params, batch: Dict[str, jax.Array], cfg: TransformerConfig)
             [mask[:, 1:], jnp.zeros((B, 1), mask.dtype)], axis=1)
         valid = valid * shifted.astype(jnp.float32)
     return -(ll * valid).sum() / jnp.maximum(valid.sum(), 1.0)
+
+
+def loss_fn(params: Params, batch: Dict[str, jax.Array], cfg: TransformerConfig) -> jax.Array:
+    """Next-token cross-entropy. batch: tokens [B,S]; loss over tokens[1:].
+
+    The forward runs on the FULL sequence (the final position's logits are
+    masked out of the loss) so the activation sequence length stays divisible
+    by the `seq` mesh axis under context parallelism — slicing to S-1 would
+    break ring-attention sharding for power-of-two S.
+    """
+    logits = forward(params, batch["tokens"], cfg)  # [B, S, V]
+    return next_token_loss(logits, batch)

@@ -12,6 +12,7 @@ from .mesh import (
     make_mesh,
     single_device_mesh,
 )
+from .pipeline import pipeline_apply, pipeline_loss_fn
 from .sharding import (
     DEFAULT_RULES,
     RULES_DP,
@@ -26,6 +27,8 @@ from .sharding import (
 )
 
 __all__ = [
+    "pipeline_apply",
+    "pipeline_loss_fn",
     "AXIS_ORDER",
     "MeshSpec",
     "MeshBootstrap",

@@ -1,0 +1,151 @@
+"""Pipeline parallelism over the `pipe` mesh axis: a microbatched GPipe
+schedule inside ONE jitted step.
+
+SURVEY.md §5.7 names pipeline parallelism a first-class requirement; the
+reference has no in-graph pipeline engine at all (its compiled-DAG pipelines
+actors at the task layer, dag/compiled_dag_node.py:291 — a different altitude).
+The TPU-native design runs the whole schedule inside XLA:
+
+- The layer stack [L, ...] is sharded over `pipe` (logical axis "layers"),
+  so each stage owns a contiguous block of L/P layers — zero repartitioning.
+- shard_map makes the mesh manual; each device runs `lax.scan` over its
+  local layers, and `lax.ppermute` hands activations to the next stage.
+- The schedule is GPipe: with M microbatches and P stages it runs M+P-1
+  ticks; bubbles compute garbage that output masking discards. Backward is
+  plain autodiff through the scan — ppermute transposes to the reverse
+  permutation, giving the symmetric backward pipeline for free.
+
+Embedding and the LM head run OUTSIDE the shard_map in ordinary GSPMD land,
+so vocab/fsdp sharding of those params keeps working unchanged.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ray_tpu.parallel import sharding as shd
+
+
+def _check_layer_specs_pipe_only(cfg, mesh: Mesh, rules) -> None:
+    """The stage body runs _layer_body in plain (non-collective) form, so
+    layer params may be sharded over `pipe` ONLY. Megatron-style manual TP
+    inside the pipeline (psum after row-parallel matmuls) is not implemented
+    — composing pipe with tensor/fsdp ON PARAMS must fail loudly, not
+    silently all-gather and replicate compute."""
+    from ray_tpu.models.transformer import param_logical_specs
+
+    for spec in jax.tree.leaves(
+        param_logical_specs(cfg)["layers"],
+        is_leaf=lambda x: isinstance(x, tuple),
+    ):
+        mesh_spec = shd.logical_to_mesh_spec(spec, rules, mesh)
+        extra = [a for a in jax.tree.leaves(tuple(mesh_spec)) if a != "pipe"]
+        if extra:
+            raise NotImplementedError(
+                f"pipeline parallelism composes with data-parallel batch "
+                f"sharding only; layer param spec {spec} maps onto mesh "
+                f"axes {extra} (tensor/fsdp on params inside the pipeline "
+                f"is not supported — use a mesh with those axes = 1)"
+            )
+
+
+def pipeline_apply(
+    cfg,
+    layers: Dict[str, jax.Array],
+    x: jax.Array,  # [M, mb, S, d] microbatched activations
+    mesh: Mesh,
+    rules: Optional[Dict] = None,
+) -> jax.Array:
+    """Run the layer stack as a P-stage pipeline; returns [M, mb, S, d]."""
+    from ray_tpu.models.transformer import layer_scan_body
+
+    rules = rules or shd.DEFAULT_RULES
+    num_stages = mesh.shape["pipe"]
+    M, mb, S, d = x.shape
+    num_ticks = M + num_stages - 1
+    _check_layer_specs_pipe_only(cfg, mesh, rules)
+    # Same mapping shard_batch/maybe_constrain use for the batch dim.
+    mb_spec = shd.logical_to_mesh_spec(("batch",), rules, mesh)[0]
+
+    layer_specs = jax.tree.map(lambda a: P("pipe"), layers)
+    x_spec = P(None, mb_spec, None, None)
+    out_spec = P("pipe", None, mb_spec, None, None)
+
+    def body(layers_local, x_local):
+        # x_local: [M, mb_local, S, d]; layers_local leaves: [L/P, ...]
+        stage = lax.axis_index("pipe")
+        positions = jnp.broadcast_to(
+            jnp.arange(S, dtype=jnp.int32)[None], (x_local.shape[1], S))
+        scan_body = layer_scan_body(cfg, positions)
+
+        def run_local(h):
+            with shd.no_sharding_ctx():
+                out, _ = lax.scan(scan_body, h, layers_local)
+            return out
+
+        state0 = jnp.zeros(x_local.shape[1:], x_local.dtype)
+        outputs0 = jnp.zeros_like(x_local)
+
+        def tick(carry, t):
+            state, outputs = carry
+            inject = x_local[jnp.minimum(t, M - 1)]
+            cur = jnp.where(stage == 0, inject, state)
+            cur = run_local(cur)
+            out_idx = t - (num_stages - 1)
+            valid = (stage == num_stages - 1) & (out_idx >= 0)
+            idx = jnp.clip(out_idx, 0, M - 1)
+            outputs = outputs.at[idx].set(
+                jnp.where(valid, cur, outputs[idx]))
+            nxt = lax.ppermute(
+                cur, "pipe",
+                [(i, (i + 1) % num_stages) for i in range(num_stages)])
+            return (nxt, outputs), None
+
+        (_, outputs), _ = lax.scan(
+            tick, (state0, outputs0), jnp.arange(num_ticks))
+        # Stack per-stage buffers along a new leading axis; only the last
+        # stage's buffer is real — the caller slices it out (pure data
+        # movement, no collective).
+        return outputs[None]
+
+    fn = jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(layer_specs, x_spec),
+        out_specs=out_spec,
+        check_vma=False,
+    )
+    return fn(layers, x)[-1]
+
+
+def pipeline_loss_fn(cfg, mesh: Mesh, *, rules=None, num_microbatches: int = 4):
+    """Build loss_fn(params, batch) running the decoder as a GPipe pipeline.
+
+    Drop-in for models.transformer.loss_fn wherever the mesh has pipe>1;
+    wire into ShardedTrainStep via train.step.transformer_train_step(...,
+    pipeline_microbatches=M).
+    """
+    from ray_tpu.models import transformer as tfm
+
+    rules = rules or shd.DEFAULT_RULES
+    M = num_microbatches
+
+    def loss_fn(params, batch):
+        tokens = batch["tokens"]
+        B, S = tokens.shape
+        if B % M != 0:
+            raise ValueError(
+                f"batch {B} not divisible by num_microbatches {M}")
+        x = tfm.embed_tokens(params, tokens, cfg)  # [B, S, d]
+        x = x.reshape(M, B // M, S, -1)
+        y = pipeline_apply(cfg, params["layers"], x, mesh, rules)
+        y = y.reshape(B, S, -1)
+        y = shd.maybe_constrain(y, ("batch", "seq_act", "embed"))
+        logits = tfm.lm_head(params, y, cfg)
+        return tfm.next_token_loss(logits, batch)
+
+    return loss_fn

@@ -40,6 +40,7 @@ RULES_FSDP: Rules = {
 
 RULES_TP: Rules = {
     "batch": _BATCH,
+    "layers": "pipe",  # layer stack split across pipeline stages
     "vocab": "tensor",
     "mlp": "tensor",
     "heads": "tensor",
@@ -140,6 +141,18 @@ def sharding_ctx(mesh: Mesh, rules: Optional[Rules] = None):
 
 def current_sharding_ctx() -> Optional[Tuple[Mesh, Rules]]:
     return getattr(_ctx, "val", None)
+
+
+@contextlib.contextmanager
+def no_sharding_ctx():
+    """Suspend the context (inside shard_map bodies, where the mesh is fully
+    manual and with_sharding_constraint would be ill-formed)."""
+    prev = getattr(_ctx, "val", None)
+    _ctx.val = None
+    try:
+        yield
+    finally:
+        _ctx.val = prev
 
 
 def maybe_constrain(x: jax.Array, logical: LogicalSpec) -> jax.Array:

@@ -97,13 +97,27 @@ def transformer_train_step(
     *,
     rules: Optional[shd.Rules] = None,
     optimizer: Optional[optax.GradientTransformation] = None,
+    pipeline_microbatches: Optional[int] = None,
 ) -> ShardedTrainStep:
-    """Convenience: wire a models.transformer config into a ShardedTrainStep."""
+    """Convenience: wire a models.transformer config into a ShardedTrainStep.
+
+    When the mesh has pipe>1, the decoder runs as an in-graph GPipe pipeline
+    (parallel/pipeline.py) with `pipeline_microbatches` microbatches
+    (default: 2x the stage count, a reasonable bubble/memory tradeoff)."""
     from ray_tpu.models import transformer as tfm
+
+    if "pipe" in mesh.axis_names and mesh.shape["pipe"] > 1:
+        from ray_tpu.parallel.pipeline import pipeline_loss_fn
+
+        M = pipeline_microbatches or 2 * mesh.shape["pipe"]
+        loss = pipeline_loss_fn(
+            cfg, mesh, rules=rules or shd.DEFAULT_RULES, num_microbatches=M)
+    else:
+        loss = lambda params, batch: tfm.loss_fn(params, batch, cfg)
 
     return ShardedTrainStep(
         init_params_fn=lambda rng: tfm.init_params(rng, cfg),
-        loss_fn=lambda params, batch: tfm.loss_fn(params, batch, cfg),
+        loss_fn=loss,
         logical_specs=tfm.param_logical_specs(cfg),
         mesh=mesh,
         rules=rules,

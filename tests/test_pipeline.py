@@ -1,0 +1,63 @@
+"""Pipeline parallelism (GPipe over the `pipe` mesh axis) on the virtual CPU
+mesh. Numeric ground truth is the plain single-mesh forward/backward on the
+same params (SURVEY §5.7 done bar: pipe=2 matches single-device numerics)."""
+import jax
+import numpy as np
+import pytest
+
+from ray_tpu.models import transformer as tfm
+from ray_tpu.models.configs import llama_tiny, gpt2_tiny
+from ray_tpu.parallel import MeshSpec, RULES_TP, make_mesh
+from ray_tpu.parallel.pipeline import pipeline_loss_fn
+from ray_tpu.train.step import transformer_train_step
+
+
+def _tokens(cfg, batch=4, seq=32, seed=0):
+    rng = np.random.RandomState(seed)
+    return rng.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int32)
+
+
+@pytest.mark.parametrize("cfgname", ["llama", "gpt2"])
+def test_pipeline_matches_single_device(cfgname):
+    cfg = llama_tiny(n_layers=4) if cfgname == "llama" else gpt2_tiny(n_layers=4)
+    params = tfm.init_params(jax.random.key(0), cfg)
+    batch = {"tokens": _tokens(cfg, batch=8)}
+
+    ref_loss = float(tfm.loss_fn(params, batch, cfg))
+    ref_grads = jax.grad(lambda p: tfm.loss_fn(p, batch, cfg))(params)
+
+    mesh = make_mesh(MeshSpec(pipe=2, data=2), devices=jax.devices()[:4])
+    loss_fn = pipeline_loss_fn(cfg, mesh, rules=RULES_TP, num_microbatches=4)
+    pl = float(loss_fn(params, batch))
+    assert abs(pl - ref_loss) < 2e-3, (pl, ref_loss)
+
+    pl_grads = jax.grad(lambda p: loss_fn(p, batch))(params)
+    for a, b in zip(jax.tree.leaves(ref_grads), jax.tree.leaves(pl_grads)):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=2e-3, rtol=2e-2)
+
+
+def test_pipeline_train_step_runs(tmp_path):
+    cfg = llama_tiny(n_layers=4)
+    mesh = make_mesh(MeshSpec(pipe=2, data=2), devices=jax.devices()[:4])
+    ts = transformer_train_step(cfg, mesh, rules=RULES_TP,
+                                pipeline_microbatches=4)
+    params, opt = ts.init(jax.random.key(0))
+    b = ts.shard_batch({"tokens": _tokens(cfg, batch=8)})
+    losses = []
+    for _ in range(4):
+        params, opt, loss = ts.step(params, opt, b)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0], losses  # it learns on a fixed batch
+
+
+def test_pipeline_rejects_tensor_sharded_params():
+    """Composing pipe with tensor/fsdp on params is not implemented and must
+    fail loudly instead of silently all-gathering the weights."""
+    cfg = llama_tiny(n_layers=4)
+    mesh = make_mesh(MeshSpec(pipe=2, tensor=2, data=2),
+                     devices=jax.devices()[:8])
+    params = tfm.init_params(jax.random.key(0), cfg)
+    loss_fn = pipeline_loss_fn(cfg, mesh, rules=RULES_TP, num_microbatches=4)
+    with pytest.raises(NotImplementedError):
+        loss_fn(params, {"tokens": _tokens(cfg, batch=8)})
