@@ -197,7 +197,14 @@ def get(refs: Union[ObjectRef, Sequence[ObjectRef]], *, timeout: Optional[float]
     out = []
     for oid in ids:
         loc = locs[oid]
-        val = get_bytes(loc)
+        try:
+            val = get_bytes(loc)
+        except KeyError:
+            # The copy moved (arena object spilled to disk between location
+            # resolution and the read): refresh the location once.
+            loc = wc.client.request(
+                {"kind": "get_locations", "object_ids": [oid]})[oid]
+            val = get_bytes(loc)
         if loc.is_error:
             if isinstance(val, BaseException):
                 raise val

@@ -163,6 +163,11 @@ class Controller:
         # oid -> callbacks fired (once) when the object's location lands;
         # the incremental path used by wait (vs the Event-based get path).
         self.object_callbacks: Dict[str, List[Any]] = {}
+        # Last-touched times drive cold-object selection for arena spilling.
+        self.object_touch: Dict[str, float] = {}
+        self.spilled_count = 0
+        # (due_time, arena_oid) for spilled arena copies awaiting deletion.
+        self._deferred_arena_deletes: List[Tuple[float, int]] = []
         self.tasks: Dict[str, Dict[str, Any]] = {}  # pending/running task specs
         self.pending_queue: List[str] = []  # task_ids awaiting scheduling
         self.generators: Dict[str, GeneratorState] = {}  # streaming tasks
@@ -574,9 +579,11 @@ class Controller:
         timeout = msg.get("timeout")
         deadline = None if timeout is None else time.monotonic() + timeout
         out: Dict[str, ObjectLocation] = {}
+        now = time.monotonic()
         for oid in ids:
             try:
                 out[oid] = await self._wait_for_object(oid, deadline)
+                self.object_touch[oid] = now
             except asyncio.TimeoutError:
                 raise GetTimeoutError(f"object {oid[:8]} not ready within {timeout}s") from None
         return out
@@ -642,6 +649,7 @@ class Controller:
     async def _h_free_objects(self, conn, msg):
         for oid in msg["object_ids"]:
             loc = self.objects.pop(oid, None)
+            self.object_touch.pop(oid, None)
             if loc is None:
                 continue
             if loc.host_id is not None and loc.host_id != self.host_id:
@@ -1199,6 +1207,7 @@ class Controller:
                     "object_id": oid,
                     "size": loc.size,
                     "backend": ("inline" if loc.inline is not None
+                                else "spill" if loc.spill_path
                                 else "arena" if loc.arena else "shm"),
                     "node_id": loc.node_id,
                     "is_error": loc.is_error,
@@ -1252,6 +1261,8 @@ class Controller:
             f"rtpu_objects {len(self.objects)}",
             "# TYPE rtpu_uptime_seconds counter",
             f"rtpu_uptime_seconds {time.time() - self.start_time:.1f}",
+            "# TYPE rtpu_objects_spilled_total counter",
+            f"rtpu_objects_spilled_total {self.spilled_count}",
         ]
         if self._arena is not None:
             st = self._arena.stats()
@@ -1385,7 +1396,9 @@ class Controller:
 
     async def _health_check_loop(self) -> None:
         """Mark agent nodes dead when heartbeats stop (reference:
-        gcs_health_check_manager.h:39 periodic health checks)."""
+        gcs_health_check_manager.h:39 periodic health checks); also runs the
+        arena memory-pressure check (spill cold objects past the high
+        watermark, reference local_object_manager.h:103-122)."""
         timeout = float(os.environ.get("RTPU_NODE_TIMEOUT_S", "10"))
         while True:
             await asyncio.sleep(min(2.0, timeout / 3))
@@ -1398,11 +1411,88 @@ class Controller:
                     and now - node.last_heartbeat > timeout
                 ):
                     await self._on_node_death(node)
+            try:
+                await self._maybe_spill_cold_objects()
+            except Exception as e:  # pragma: no cover — keep the loop alive
+                sys.stderr.write(f"[controller] spill error: {e!r}\n")
+
+    async def _maybe_spill_cold_objects(self) -> None:
+        """When the head arena passes the high watermark, move the coldest
+        sealed objects to disk until usage drops below the low watermark.
+        (Agent arenas spill at put time on their own hosts; proactive remote
+        eviction rides the same loc rewrite via the agent's free+spill.)
+
+        The arena copy is NOT deleted immediately: a worker may hold the old
+        location for an in-flight read, so deletion defers for a grace
+        period and retries while zero-copy pins block it."""
+        if self._arena is not None:
+            await self._drain_deferred_deletes()
+            high = float(os.environ.get("RTPU_SPILL_HIGH", "0.8"))
+            low = float(os.environ.get("RTPU_SPILL_LOW", "0.6"))
+            st = self._arena.stats()
+            cap = st["capacity"] or 1
+            if st["used"] / cap < high:
+                return
+            my_arena = self._arena.name
+            victims = sorted(
+                (
+                    (self.object_touch.get(oid, 0.0), oid, loc)
+                    for oid, loc in self.objects.items()
+                    if loc.arena == my_arena and not loc.is_error
+                ),
+            )
+            from .object_store import spill_dir
+            from .transfer import read_location_range
+
+            grace = float(os.environ.get("RTPU_SPILL_DELETE_GRACE_S", "10"))
+            spilled_bytes = 0
+            need = st["used"] - low * cap
+            for _, oid, loc in victims:
+                if spilled_bytes >= need:
+                    break
+                path = os.path.join(spill_dir(), f"{oid[:32]}.bin")
+
+                def write_one(loc=loc, path=path):
+                    raw = read_location_range(loc, 0, loc.size)
+                    with open(path, "wb") as f:
+                        f.write(raw)
+
+                try:
+                    # Whole-object read+write off the event loop: a spill
+                    # sweep must not stall RPC handling.
+                    await asyncio.to_thread(write_one)
+                except Exception:
+                    continue
+                import dataclasses as _dc
+
+                new_loc = _dc.replace(loc, arena=None, arena_oid=0,
+                                      spill_path=path)
+                self.objects[oid] = new_loc
+                self._deferred_arena_deletes.append(
+                    (time.monotonic() + grace, loc.arena_oid))
+                spilled_bytes += loc.size
+                self.spilled_count += 1
+
+    async def _drain_deferred_deletes(self) -> None:
+        now = time.monotonic()
+        keep = []
+        for due, arena_oid in self._deferred_arena_deletes:
+            if due > now:
+                keep.append((due, arena_oid))
+                continue
+            # delete() refuses while a zero-copy pin holds the object; retry
+            # later rather than leaking the slot forever.
+            if not self._arena.delete(arena_oid):
+                keep.append((now + 5.0, arena_oid))
+        self._deferred_arena_deletes = keep
 
     # ---------------------------------------------------------- object helpers
 
     def _store_location(self, loc: ObjectLocation) -> None:
         self.objects[loc.object_id] = loc
+        # Fresh objects are the HOTTEST, not coldest: without this a
+        # just-put batch ties at 0.0 and gets spilled first.
+        self.object_touch.setdefault(loc.object_id, time.monotonic())
         for ev in self.object_waiters.pop(loc.object_id, []):
             ev.set()
         for cb in self.object_callbacks.pop(loc.object_id, []):

@@ -101,6 +101,10 @@ class ObjectLocation:
     # Host identity of the producing process (current_host_id()); a reader on
     # a different host fetches via the owner node's agent instead of shm.
     host_id: Optional[str] = None
+    # Spilled-to-disk placement (reference: raylet local_object_manager
+    # spill, local_object_manager.h:103-122): same byte layout as the arena
+    # object, in a file.
+    spill_path: Optional[str] = None
 
 
 def serialize(value: Any) -> Tuple[bytes, List[pickle.PickleBuffer]]:
@@ -127,6 +131,16 @@ def put_bytes(value: Any, object_id: str, node_id: str) -> ObjectLocation:
     loc = _put_arena(data, oob, total, object_id, node_id)
     if loc is not None:
         return loc
+    from . import native_store
+
+    if native_store.get_arena() is not None:
+        # Arena exists but is full: overflow to disk so working sets larger
+        # than the arena complete instead of exhausting shm (reference:
+        # local_object_manager spill-on-OOM). Disk latency is the natural
+        # backpressure on the putting task.
+        loc = _put_spill(data, oob, total, object_id, node_id)
+        if loc is not None:
+            return loc
 
     # Layout: [pickle stream][buf0][buf1]... with a location-table in metadata.
     name = "rtpu_" + secrets.token_hex(8)
@@ -194,6 +208,60 @@ def _put_arena(data, oob, total, object_id, node_id) -> Optional[ObjectLocation]
         arena=arena.name, arena_oid=oid, host_id=current_host_id())
 
 
+def spill_dir() -> str:
+    d = os.environ.get("RTPU_SPILL_DIR")
+    if not d:
+        import tempfile
+
+        d = os.path.join(tempfile.gettempdir(),
+                         f"rtpu_spill_{current_host_id()[:16]}")
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def _put_spill(data, oob, total, object_id, node_id) -> Optional[ObjectLocation]:
+    """Write the object's bytes (same layout as the arena) to a spill file.
+
+    Buffers are released only after the whole file lands: a mid-write
+    failure must leave them intact so put_bytes' shm fallback can still
+    serialize them (and must not leave a partial file behind).
+    """
+    path = os.path.join(spill_dir(), f"{object_id[:32]}.bin")
+    try:
+        with open(path, "wb") as f:
+            f.write(data)
+            pickle_off, pickle_len = 0, len(data)
+            off = len(data)
+            table: List[Tuple[int, int]] = []
+            for b in oob:
+                raw = b.raw()
+                n = raw.nbytes
+                f.write(raw)
+                table.append((off, n))
+                off += n
+    except OSError:
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
+        return None
+    for b in oob:
+        b.release()
+    return ObjectLocation(
+        object_id=object_id, size=total, node_id=node_id,
+        buffers=table, pickle_off=pickle_off, pickle_len=pickle_len,
+        spill_path=path, host_id=current_host_id())
+
+
+def _get_spilled(loc: ObjectLocation) -> Any:
+    with open(loc.spill_path, "rb") as f:
+        buf = f.read()
+    data = buf[loc.pickle_off : loc.pickle_off + loc.pickle_len]
+    mv = memoryview(buf)
+    bufs = [mv[off : off + n] for off, n in loc.buffers]
+    return pickle.loads(data, buffers=bufs)
+
+
 class _SegmentCache:
     """Per-process cache of attached read-only segments."""
 
@@ -241,6 +309,8 @@ def get_bytes(loc: ObjectLocation, copy: bool = True) -> Any:
         from .transfer import fetch_remote_value
 
         return fetch_remote_value(loc)
+    if loc.spill_path is not None:
+        return _get_spilled(loc)
     if loc.arena is not None:
         return _get_arena_bytes(loc, copy)
     assert loc.shm_name is not None
@@ -310,6 +380,12 @@ _atexit.register(_release_zero_copy_pins)
 
 def free_location(loc: ObjectLocation) -> None:
     """Free an object's storage, whichever backend holds it."""
+    if loc.spill_path is not None:
+        try:
+            os.unlink(loc.spill_path)
+        except OSError:
+            pass
+        return
     if loc.arena is not None:
         from . import native_store
 

@@ -30,6 +30,10 @@ def read_location_range(loc: ObjectLocation, offset: int, length: int) -> bytes:
     """Serve `length` bytes at `offset` of the object at `loc` (local host)."""
     if loc.inline is not None:
         return bytes(loc.inline[offset : offset + length])
+    if loc.spill_path is not None:
+        with open(loc.spill_path, "rb") as f:
+            f.seek(offset)
+            return f.read(length)
     if loc.arena is not None:
         from . import native_store
 

@@ -176,7 +176,14 @@ class WorkerRuntime:
         def resolve(v: Any) -> Any:
             if isinstance(v, ArgRef):
                 loc = locs[v.object_id]
-                val = get_bytes(loc)
+                try:
+                    val = get_bytes(loc)
+                except KeyError:
+                    # Copy moved (spilled) since resolution: refresh once.
+                    loc = self.client.request(
+                        {"kind": "get_locations",
+                         "object_ids": [v.object_id]})[v.object_id]
+                    val = get_bytes(loc)
                 if loc.is_error:
                     raise val if isinstance(val, BaseException) else RuntimeError(val)
                 return val
