@@ -22,7 +22,7 @@ from . import context as ctx
 from .client import CoreClient, EventLoopThread
 from .controller import Controller, GetTimeoutError, TaskError
 from .ids import ActorID, NodeID, ObjectID, TaskID
-from .object_store import get_bytes, put_bytes
+from .object_store import get_bytes, get_bytes_with_refresh, put_bytes
 from .serialization import ObjectRef, pack_args
 
 _init_lock = threading.RLock()
@@ -41,6 +41,7 @@ def init(
     resources: Optional[Dict[str, float]] = None,
     namespace: str = "default",
     ignore_reinit_error: bool = False,
+    runtime_env: Optional[Dict[str, Any]] = None,
 ) -> "ClusterHandle":
     """Start (or connect to) a cluster and bind this process as the driver.
 
@@ -92,6 +93,10 @@ def init(
             node_id = state["nodes"][0]["node_id"] if state["nodes"] else ""
         wc = ctx.WorkerContext(client=client, node_id=node_id, role="driver", namespace=namespace)
         wc.extra["address"] = address
+        if runtime_env:
+            # Job-level default env (reference: ray.init(runtime_env=...));
+            # applied to every task/actor unless overridden per-call.
+            wc.extra["default_runtime_env"] = dict(runtime_env)
         ctx.set_worker_context(wc)
         atexit.register(_atexit_shutdown)
         return ClusterHandle(wc)
@@ -197,14 +202,7 @@ def get(refs: Union[ObjectRef, Sequence[ObjectRef]], *, timeout: Optional[float]
     out = []
     for oid in ids:
         loc = locs[oid]
-        try:
-            val = get_bytes(loc)
-        except KeyError:
-            # The copy moved (arena object spilled to disk between location
-            # resolution and the read): refresh the location once.
-            loc = wc.client.request(
-                {"kind": "get_locations", "object_ids": [oid]})[oid]
-            val = get_bytes(loc)
+        val, loc = get_bytes_with_refresh(loc, oid, wc.client.request)
         if loc.is_error:
             if isinstance(val, BaseException):
                 raise val
@@ -341,6 +339,29 @@ def _streaming_spec_opts(opts: Dict[str, Any], spec: Dict[str, Any]) -> None:
     )
 
 
+def _attach_runtime_env(wc: ctx.WorkerContext, opts: Dict[str, Any],
+                        spec: Dict[str, Any]) -> None:
+    """Resolve the effective runtime env (call option > job default) into
+    the spec. Normalization (zip + KV upload) is cached per raw-env content
+    so repeated calls don't re-zip."""
+    raw = opts.get("runtime_env") or wc.extra.get("default_runtime_env")
+    if not raw:
+        return
+    import json as _json
+
+    from . import runtime_env as renv
+
+    cache = wc.extra.setdefault("_renv_cache", {})
+    key = _json.dumps(raw, sort_keys=True, default=str)
+    norm = cache.get(key)
+    if norm is None:
+        norm = renv.normalize(raw, wc.client)
+        cache[key] = norm
+    if norm:
+        spec["runtime_env"] = norm
+        spec["env_hash"] = norm["hash"]
+
+
 class RemoteFunction:
     """Handle produced by @remote on a function (reference:
     python/ray/remote_function.py:266 RemoteFunction._remote)."""
@@ -394,6 +415,7 @@ class RemoteFunction:
             "label": getattr(self._fn, "__name__", "task"),
             "max_retries": int(opts.get("max_retries", 0)),
         }
+        _attach_runtime_env(wc, opts, spec)
         if streaming:
             _streaming_spec_opts(opts, spec)
         wc.client.request({"kind": "submit_task", "spec": spec})
@@ -535,6 +557,7 @@ class ActorClass:
             "max_restarts": int(opts.get("max_restarts", 0)),
             "label": f"{self._cls.__name__}.__init__",
         }
+        _attach_runtime_env(wc, opts, spec)
         wc.client.request({"kind": "create_actor", "spec": spec})
         wc.client.request(
             {"kind": "kv_put", "ns": "__actor_methods__", "key": actor_id,

@@ -87,6 +87,9 @@ class WorkerInfo:
     actor_ids: Set[str] = field(default_factory=set)
     proc: Optional[subprocess.Popen] = None
     spawn_token: Optional[str] = None  # set for agent-spawned workers
+    # Runtime-env identity: a worker only runs tasks with the same env hash
+    # (reference: worker_pool.h runtime_env_hash pool keying).
+    env_hash: str = ""
     # TPU-capable workers carry the accelerator runtime (axon/PJRT plugin)
     # and cost seconds to start; plain workers skip it and start in ~0.3s.
     tpu_capable: bool = False
@@ -437,6 +440,18 @@ class Controller:
                     self._mark_actor_dead(actor, err)
         self._wake_scheduler()
 
+    def _fail_env_tasks(self, env_hash: str, err: Exception) -> None:
+        """A runtime env cannot materialize: every task queued for it would
+        otherwise retry the broken install forever."""
+        for tid in list(self.pending_queue):
+            spec = self.tasks.get(tid)
+            if spec is not None and (spec.get("env_hash") or "") == env_hash:
+                self.pending_queue.remove(tid)
+                self._fail_task(
+                    spec,
+                    RuntimeEnvSetupError(f"runtime env setup failed: {err}"),
+                )
+
     def _maybe_retry_task(self, spec: Dict[str, Any]) -> bool:
         """Resubmit a task killed by a system failure (worker/node death),
         up to max_retries times. Application errors never retry here — they
@@ -521,7 +536,8 @@ class Controller:
             w.conn = conn  # reconnect
         else:
             w = WorkerInfo(worker_id=worker_id, node_id=node_id, conn=conn,
-                           tpu_capable=bool(msg.get("tpu_capable")))
+                           tpu_capable=bool(msg.get("tpu_capable")),
+                           env_hash=msg.get("env_hash") or "")
             self.workers[worker_id] = w
         # Exact proc adoption via startup token (reference: worker startup
         # tokens, worker_pool.h:251) — heuristic matching can swap proc handles
@@ -1376,6 +1392,13 @@ class Controller:
             if token in self._tpu_spawn_tokens:
                 node.spawning_tpu = max(0, node.spawning_tpu - 1)
         self._tpu_spawn_tokens.discard(token)
+        if msg.get("env_failed"):
+            # The agent could not materialize the runtime env: fail the
+            # queued tasks rather than retrying the broken install forever.
+            self._fail_env_tasks(
+                msg["env_failed"],
+                RuntimeError(msg.get("env_error") or "runtime env setup failed"),
+            )
         self._wake_scheduler()
         return None
 
@@ -1462,6 +1485,15 @@ class Controller:
                     # sweep must not stall RPC handling.
                     await asyncio.to_thread(write_one)
                 except Exception:
+                    continue
+                if self.objects.get(oid) is not loc:
+                    # Freed (or replaced) while the write was in flight:
+                    # the free path already handled the arena copy — don't
+                    # resurrect the object or defer a bogus delete.
+                    try:
+                        os.unlink(path)
+                    except OSError:
+                        pass
                     continue
                 import dataclasses as _dc
 
@@ -1577,21 +1609,23 @@ class Controller:
             if not _res_fits(bundle.available, resources):
                 return False
             needs_tpu = resources.get("TPU", 0) > 0
-            w = self._find_idle_worker(node, needs_tpu)
+            env_hash = spec.get("env_hash") or ""
+            w = self._find_idle_worker(node, needs_tpu, env_hash)
             if w is None:
-                self._maybe_spawn_worker(node, needs_tpu)
+                self._maybe_spawn_worker(node, needs_tpu, spec.get("runtime_env"))
                 return False
             _res_sub(bundle.available, resources)
             spec["sched_node"] = node.node_id
             await self._dispatch(spec, node, w)
             return True
         needs_tpu = resources.get("TPU", 0) > 0
+        env_hash = spec.get("env_hash") or ""
         for node in self._eligible_nodes(spec):
             if not _res_fits(node.available, resources):
                 continue
-            w = self._find_idle_worker(node, needs_tpu)
+            w = self._find_idle_worker(node, needs_tpu, env_hash)
             if w is None:
-                self._maybe_spawn_worker(node, needs_tpu)
+                self._maybe_spawn_worker(node, needs_tpu, spec.get("runtime_env"))
                 continue
             _res_sub(node.available, resources)
             spec["sched_node"] = node.node_id
@@ -1600,14 +1634,15 @@ class Controller:
         return False
 
     def _find_idle_worker(
-        self, node: NodeInfo, needs_tpu: bool = False
+        self, node: NodeInfo, needs_tpu: bool = False, env_hash: str = ""
     ) -> Optional[WorkerInfo]:
         # Plain work prefers plain workers so the scarce, seconds-to-start
-        # TPU-capable workers stay free for TPU tasks.
+        # TPU-capable workers stay free for TPU tasks. Runtime envs match
+        # strictly: an env worker's cwd/sys.path/venv are already mutated.
         fallback: Optional[WorkerInfo] = None
         for wid in node.workers:
             w = self.workers.get(wid)
-            if w is None or w.state != "idle":
+            if w is None or w.state != "idle" or w.env_hash != env_hash:
                 continue
             if needs_tpu:
                 if w.tpu_capable:
@@ -1618,7 +1653,12 @@ class Controller:
                 return w
         return fallback
 
-    def _maybe_spawn_worker(self, node: NodeInfo, needs_tpu: bool = False) -> None:
+    def _maybe_spawn_worker(
+        self,
+        node: NodeInfo,
+        needs_tpu: bool = False,
+        runtime_env: Optional[Dict[str, Any]] = None,
+    ) -> None:
         if node.spawning >= 4:
             return
         # One in-flight TPU-capable spawn satisfies any number of queued TPU
@@ -1628,17 +1668,24 @@ class Controller:
         if needs_tpu and node.spawning_tpu > 0:
             return
         if len(node.workers) + node.spawning >= MAX_WORKERS_PER_NODE:
-            # At the cap, a TPU task must not starve behind idle plain
-            # workers: reap one to make room (reference: worker_pool.cc idle
-            # worker killing to satisfy the pool cap).
-            if not needs_tpu:
+            # At the cap, a task needing a worker flavor (TPU or a runtime
+            # env) that no idle worker matches must not starve behind idle
+            # mismatched workers: reap one to make room (reference:
+            # worker_pool.cc idle worker killing to satisfy the pool cap).
+            want_env = (runtime_env or {}).get("hash", "")
+            if not needs_tpu and not want_env:
                 return
             victim = None
             for wid in list(node.workers):
                 w = self.workers.get(wid)
-                if w is not None and w.state == "idle" and not w.tpu_capable:
-                    victim = w
-                    break
+                if w is None or w.state != "idle":
+                    continue
+                if needs_tpu and w.tpu_capable:
+                    continue  # never reap the flavor being requested
+                if not needs_tpu and w.env_hash == want_env:
+                    continue
+                victim = w
+                break
             if victim is None:
                 return
             node.workers.discard(victim.worker_id)
@@ -1663,6 +1710,7 @@ class Controller:
                         "spawn_token": spawn_token,
                         "tpu": needs_tpu,
                         "sys_path": sys_path,
+                        "runtime_env": runtime_env,
                     }
                 )
             )
@@ -1689,6 +1737,36 @@ class Controller:
         # Workers never grab the real TPU by default: the mesh layer assigns
         # device visibility explicitly when a training world is formed.
         env.setdefault("JAX_PLATFORMS", "cpu")
+        if runtime_env:
+            import json as _json
+
+            env["RTPU_RUNTIME_ENV"] = _json.dumps(runtime_env)
+        if runtime_env and runtime_env.get("pip"):
+            # venv materialization can take tens of seconds: run it off the
+            # event loop, then launch with the venv's interpreter.
+            async def _spawn_with_venv():
+                from . import runtime_env as renv
+
+                try:
+                    python = await asyncio.to_thread(
+                        renv.spawner_python, runtime_env)
+                except Exception as e:
+                    sys.stderr.write(f"[controller] pip env failed: {e!r}\n")
+                    node.spawning = max(0, node.spawning - 1)
+                    if spawn_token in self._tpu_spawn_tokens:
+                        self._tpu_spawn_tokens.discard(spawn_token)
+                        node.spawning_tpu = max(0, node.spawning_tpu - 1)
+                    self._fail_env_tasks(runtime_env.get("hash", ""), e)
+                    self._wake_scheduler()
+                    return
+                proc = subprocess.Popen(
+                    [python, "-m", "ray_tpu.core.worker_main"], env=env)
+                self._spawned_procs[spawn_token] = proc
+                asyncio.get_running_loop().create_task(
+                    self._watch_spawn(node.node_id, spawn_token, proc))
+
+            asyncio.get_running_loop().create_task(_spawn_with_venv())
+            return
         proc = subprocess.Popen(
             [sys.executable, "-m", "ray_tpu.core.worker_main"],
             env=env,
@@ -1777,6 +1855,11 @@ class ActorDiedError(RayTpuError):
 class ObjectLostError(RayTpuError):
     """The bytes of an object died with their host and no lineage could
     reconstruct them (reference: ray.exceptions.ObjectLostError)."""
+
+
+class RuntimeEnvSetupError(RayTpuError):
+    """A task's runtime environment could not be materialized (reference:
+    ray.exceptions.RuntimeEnvSetupError)."""
 
 
 class DependencyError(RayTpuError):

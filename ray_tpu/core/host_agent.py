@@ -124,6 +124,23 @@ class HostAgent:
     async def _on_controller_msg(self, conn, msg: Dict[str, Any]) -> Any:
         kind = msg["kind"]
         if kind == "spawn_worker":
+            renv = msg.get("runtime_env")
+            if renv and renv.get("pip"):
+                # venv creation takes seconds: keep the agent loop live.
+                from .runtime_env import spawner_python
+
+                try:
+                    python = await asyncio.to_thread(spawner_python, renv)
+                except Exception as e:
+                    sys.stderr.write(f"[host_agent] pip env failed: {e!r}\n")
+                    await self.ctrl.send(
+                        {"kind": "spawn_exited",
+                         "spawn_token": msg["spawn_token"],
+                         "node_id": self.node_id, "returncode": -1,
+                         "env_failed": renv.get("hash", ""),
+                         "env_error": str(e)[:500]})
+                    return {"ok": False}
+                return self._spawn_worker(msg, python=python)
             return self._spawn_worker(msg)
         if kind == "kill_worker":
             tok = msg.get("spawn_token") or self.worker_tokens.get(
@@ -152,9 +169,12 @@ class HostAgent:
             return read_location_range(msg["loc"], msg["offset"], msg["length"])
         raise ValueError(f"host_agent: unknown message kind {kind!r}")
 
-    def _spawn_worker(self, msg: Dict[str, Any]) -> Dict[str, Any]:
+    def _spawn_worker(self, msg: Dict[str, Any],
+                      python: Optional[str] = None) -> Dict[str, Any]:
         spawn_token = msg["spawn_token"]
         env = dict(os.environ)
+        if msg.get("runtime_env"):
+            env["RTPU_RUNTIME_ENV"] = json.dumps(msg["runtime_env"])
         env["RTPU_CONTROLLER"] = self.controller_addr
         env["RTPU_NODE_ID"] = self.node_id
         env["RTPU_SPAWN_TOKEN"] = spawn_token
@@ -173,7 +193,7 @@ class HostAgent:
             env["RTPU_SYS_PATH"] = msg["sys_path"]
         env.setdefault("JAX_PLATFORMS", "cpu")
         proc = subprocess.Popen(
-            [sys.executable, "-m", "ray_tpu.core.worker_main"],
+            [python or sys.executable, "-m", "ray_tpu.core.worker_main"],
             env=env,
         )
         self.procs[spawn_token] = proc

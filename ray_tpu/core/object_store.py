@@ -378,6 +378,22 @@ import atexit as _atexit
 _atexit.register(_release_zero_copy_pins)
 
 
+def get_bytes_with_refresh(loc: ObjectLocation, object_id: str, request_fn):
+    """get_bytes with a single location refresh when the copy moved (the
+    arena object was spilled between location resolution and the read).
+    The refresh carries a short timeout: if the object was freed rather
+    than spilled, the caller gets a timely error instead of waiting on an
+    id that will never reappear."""
+    try:
+        return get_bytes(loc), loc
+    except KeyError:
+        locs = request_fn(
+            {"kind": "get_locations", "object_ids": [object_id], "timeout": 5}
+        )
+        loc = locs[object_id]
+        return get_bytes(loc), loc
+
+
 def free_location(loc: ObjectLocation) -> None:
     """Free an object's storage, whichever backend holds it."""
     if loc.spill_path is not None:

@@ -1,0 +1,226 @@
+"""Runtime environments: per-task/actor working_dir, pip deps, env vars.
+
+Parity: reference runtime-env system
+(python/ray/_private/runtime_env/ARCHITECTURE.md, working_dir.py, pip.py)
+redesigned for this control plane:
+
+- ``working_dir``: the driver zips the directory (deterministic walk,
+  junk excluded), content-hashes it, and uploads it to the controller KV
+  under ``working_dir://<sha256>`` — at most once per content (URI cache,
+  reference working_dir.py upload_package_if_needed). Workers download and
+  extract once per host into a shared cache and run with cwd + sys.path
+  pointing at it.
+- ``pip``: the SPAWNER (controller or host agent — it is on the right
+  host) materializes a venv per sorted-package-list hash
+  (``--system-site-packages`` so the framework's own deps stay importable),
+  installs the packages, and launches the worker with the venv's
+  interpreter (reference pip.py creating virtualenvs keyed by spec hash).
+- ``env_vars``: applied in the worker before user code runs.
+
+An env's identity is the hash of all three parts; the scheduler only
+dispatches a task to a worker with the same env hash (the reference keys
+its worker pool the same way, worker_pool.h runtime_env_hash).
+"""
+from __future__ import annotations
+
+import hashlib
+import io
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import zipfile
+from typing import Any, Dict, List, Optional
+
+_EXCLUDE_DIRS = {"__pycache__", ".git", ".venv", "node_modules", ".eggs"}
+_KV_NS = "__runtime_env__"
+
+
+def _cache_root() -> str:
+    d = os.environ.get("RTPU_RUNTIME_ENV_CACHE") or os.path.join(
+        tempfile.gettempdir(), "rtpu_runtime_envs")
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+# ----------------------------------------------------------------- normalize
+
+
+def normalize(runtime_env: Optional[Dict[str, Any]], client) -> Optional[Dict[str, Any]]:
+    """Driver-side: resolve a user runtime_env dict into its transportable
+    form (working_dir replaced by a content URI, env hash computed) and
+    upload the working_dir zip to the controller KV if new."""
+    if not runtime_env:
+        return None
+    out: Dict[str, Any] = {}
+    wd = runtime_env.get("working_dir")
+    if wd:
+        uri, blob = _package_working_dir(wd)
+        # overwrite=False: the controller reports whether the URI was new —
+        # unchanged directories upload exactly once (URI cache).
+        client.request({"kind": "kv_put", "ns": _KV_NS, "key": uri,
+                        "value": blob, "overwrite": False})
+        out["working_dir_uri"] = uri
+    pip = runtime_env.get("pip")
+    if pip:
+        out["pip"] = sorted(str(p) for p in pip)
+    env_vars = runtime_env.get("env_vars")
+    if env_vars:
+        out["env_vars"] = {str(k): str(v) for k, v in env_vars.items()}
+    if not out:
+        return None
+    out["hash"] = env_hash(out)
+    return out
+
+
+def env_hash(norm: Dict[str, Any]) -> str:
+    payload = json.dumps(
+        {k: norm[k] for k in ("working_dir_uri", "pip", "env_vars")
+         if k in norm},
+        sort_keys=True,
+    )
+    return hashlib.sha256(payload.encode()).hexdigest()[:16]
+
+
+def _package_working_dir(path: str):
+    """Zip `path` deterministically; return (content URI, zip bytes)."""
+    path = os.path.abspath(path)
+    if not os.path.isdir(path):
+        raise ValueError(f"working_dir {path!r} is not a directory")
+    entries = []
+    for root, dirs, files in os.walk(path):
+        dirs[:] = sorted(d for d in dirs if d not in _EXCLUDE_DIRS)
+        for f in sorted(files):
+            if f.endswith(".pyc"):
+                continue
+            full = os.path.join(root, f)
+            entries.append((os.path.relpath(full, path), full))
+    buf = io.BytesIO()
+    with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as z:
+        for rel, full in entries:
+            # Fixed date_time => identical content hashes to identical zips.
+            info = zipfile.ZipInfo(rel, date_time=(2020, 1, 1, 0, 0, 0))
+            with open(full, "rb") as fh:
+                z.writestr(info, fh.read())
+    blob = buf.getvalue()
+    digest = hashlib.sha256(blob).hexdigest()[:24]
+    return f"working_dir://{digest}", blob
+
+
+# ------------------------------------------------------------- worker side
+
+
+def apply_in_worker(norm: Dict[str, Any], client) -> None:
+    """Apply env_vars + working_dir in a freshly spawned worker (before user
+    code loads). The pip part was already satisfied by the spawner: this
+    interpreter IS the venv's when pip was requested."""
+    for k, v in (norm.get("env_vars") or {}).items():
+        os.environ[k] = v
+    uri = norm.get("working_dir_uri")
+    if uri:
+        target = os.path.join(_cache_root(), uri.split("://", 1)[1])
+        marker = os.path.join(target, ".rtpu_ready")
+        if not os.path.exists(marker):
+            blob = client.request({"kind": "kv_get", "ns": _KV_NS, "key": uri})
+            if blob is None:
+                raise RuntimeError(f"runtime env package {uri} missing from KV")
+            tmp = target + f".tmp{os.getpid()}"
+            os.makedirs(tmp, exist_ok=True)
+            with zipfile.ZipFile(io.BytesIO(blob)) as z:
+                z.extractall(tmp)
+            open(os.path.join(tmp, ".rtpu_ready"), "w").close()
+            try:
+                os.rename(tmp, target)
+            except OSError:
+                # Another worker won the race; its extraction is complete.
+                import shutil
+
+                shutil.rmtree(tmp, ignore_errors=True)
+        os.chdir(target)
+        if target not in sys.path:
+            sys.path.insert(0, target)
+
+
+# ------------------------------------------------------------ spawner side
+
+
+_pip_env_lock = None
+
+
+def ensure_pip_env(pip: List[str]) -> str:
+    """Materialize (or reuse) a venv with `pip` installed; returns its
+    python executable. Cached per sorted-package-list hash. Builds are
+    serialized in-process: concurrent spawns for the same env must not race
+    one tmp dir into a half-installed venv."""
+    global _pip_env_lock
+    import threading
+    import uuid
+
+    if _pip_env_lock is None:
+        _pip_env_lock = threading.Lock()
+    key = hashlib.sha256(json.dumps(sorted(pip)).encode()).hexdigest()[:16]
+    root = os.path.join(_cache_root(), f"pip_{key}")
+    py = os.path.join(root, "bin", "python")
+    marker = os.path.join(root, ".rtpu_ready")
+    if os.path.exists(marker):
+        return py
+    with _pip_env_lock:
+        if os.path.exists(marker):  # built while we waited
+            return py
+        return _build_pip_env(pip, root, py, uuid.uuid4().hex[:8])
+
+
+def _build_pip_env(pip: List[str], root: str, py: str, tag: str) -> str:
+    tmp = root + f".tmp{tag}"
+    import venv
+
+    venv.EnvBuilder(system_site_packages=True, with_pip=True).create(tmp)
+    tmp_py = os.path.join(tmp, "bin", "python")
+    # When this process itself runs in a venv, system_site_packages chains
+    # to the BASE interpreter, skipping the parent venv's site-packages
+    # (where e.g. setuptools lives). Chain them explicitly so the child env
+    # sees everything the spawner could import.
+    import site as _site
+
+    parent_sites = [p for p in _site.getsitepackages() if os.path.isdir(p)]
+    child_sites = [
+        os.path.join(tmp, "lib", d, "site-packages")
+        for d in os.listdir(os.path.join(tmp, "lib"))
+    ]
+    for cs in child_sites:
+        if os.path.isdir(cs):
+            with open(os.path.join(cs, "rtpu_parent.pth"), "w") as f:
+                f.write("\n".join(parent_sites) + "\n")
+    # --no-build-isolation: build against the venv's (system) setuptools
+    # rather than fetching build deps — this framework targets zero-egress
+    # TPU pods where only local/pre-mirrored packages install anyway.
+    subprocess.run(
+        [tmp_py, "-m", "pip", "install", "--no-input",
+         "--no-build-isolation", *pip],
+        check=True, capture_output=True, timeout=600,
+    )
+    # venv scripts embed the build path: relocate by rebuilding the pyvenv
+    # prefix is unnecessary since we exec `bin/python -m`, which resolves
+    # through the symlinked interpreter regardless of the directory name.
+    open(os.path.join(tmp, ".rtpu_ready"), "w").close()
+    try:
+        os.rename(tmp, root)
+    except OSError:
+        import shutil
+
+        shutil.rmtree(tmp, ignore_errors=True)
+    return py
+
+
+def spawner_python(norm: Optional[Dict[str, Any]]) -> str:
+    """Interpreter to launch a worker with for this runtime env."""
+    if norm and norm.get("pip"):
+        try:
+            return ensure_pip_env(norm["pip"])
+        except subprocess.CalledProcessError as e:
+            raise RuntimeError(
+                f"pip runtime env install failed: "
+                f"{(e.stderr or b'').decode()[-500:]}"
+            ) from e
+    return sys.executable

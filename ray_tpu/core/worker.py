@@ -26,7 +26,8 @@ from . import context as ctx
 from .client import CoreClient
 from .controller import ActorDiedError, TaskError
 from .ids import WorkerID
-from .object_store import ObjectLocation, get_bytes, put_bytes
+from .object_store import (ObjectLocation, get_bytes, get_bytes_with_refresh,
+                           put_bytes)
 from .serialization import ArgRef, ObjectRef
 
 
@@ -117,6 +118,20 @@ class WorkerRuntime:
         # Context must be live before registration: the controller may push a
         # task the instant the register request lands.
         ctx.set_worker_context(ctx.WorkerContext(client=self.client, node_id=node_id, role="worker"))
+        # Apply the runtime env BEFORE registering: the controller may push
+        # a task the moment registration lands, and the env (cwd, sys.path,
+        # env_vars) must already be in place (the pip venv part was applied
+        # by the spawner — this interpreter is the venv's).
+        env_hash = ""
+        renv_json = os.environ.get("RTPU_RUNTIME_ENV")
+        if renv_json:
+            import json as _json
+
+            from . import runtime_env as renv
+
+            norm = _json.loads(renv_json)
+            renv.apply_in_worker(norm, self.client)
+            env_hash = norm.get("hash", "")
         self.client.request(
             {
                 "kind": "register",
@@ -125,6 +140,7 @@ class WorkerRuntime:
                 "node_id": node_id,
                 "spawn_token": os.environ.get("RTPU_SPAWN_TOKEN"),
                 "tpu_capable": bool(os.environ.get("RTPU_TPU_WORKER")),
+                "env_hash": env_hash,
             }
         )
 
@@ -175,15 +191,8 @@ class WorkerRuntime:
 
         def resolve(v: Any) -> Any:
             if isinstance(v, ArgRef):
-                loc = locs[v.object_id]
-                try:
-                    val = get_bytes(loc)
-                except KeyError:
-                    # Copy moved (spilled) since resolution: refresh once.
-                    loc = self.client.request(
-                        {"kind": "get_locations",
-                         "object_ids": [v.object_id]})[v.object_id]
-                    val = get_bytes(loc)
+                val, loc = get_bytes_with_refresh(
+                    locs[v.object_id], v.object_id, self.client.request)
                 if loc.is_error:
                     raise val if isinstance(val, BaseException) else RuntimeError(val)
                 return val
