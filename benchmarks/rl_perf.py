@@ -1,0 +1,89 @@
+"""RLlib PPO throughput microbenchmark (BASELINE.json config 4 proxy).
+
+Measures env-steps/s on CartPole with vectorized env-runner actors:
+1. pure sampling throughput (no learning),
+2. full training iterations (sample -> GAE/batch -> learner update ->
+   weight broadcast).
+
+Prints one JSON line per metric; run from the repo root:
+    JAX_PLATFORMS=cpu python benchmarks/rl_perf.py
+"""
+from __future__ import annotations
+
+import json
+import time
+
+from ray_tpu.util.jaxenv import ensure_platform
+
+ensure_platform("cpu")  # the driver's learner/GAE must not ride the relay
+
+import ray_tpu
+from ray_tpu.rllib.algorithms.ppo import PPOConfig
+
+
+def main(iters=6, warmup=2):
+    ray_tpu.init(num_cpus=4)
+    config = (
+        PPOConfig()
+        .environment("CartPole-v1")
+        .env_runners(num_env_runners=2, num_envs_per_env_runner=8,
+                     rollout_fragment_length=128)
+        .training(train_batch_size=2048, minibatch_size=512,
+                  num_epochs=4, lr=3e-4)
+    )
+    algo = config.build()
+
+    # Pure sampling rate (actors sample concurrently).
+    group = algo.env_runner_group
+    group.sync_weights(algo.learner_group.get_weights())
+    t0 = time.perf_counter()
+    n = 0
+    for _ in range(4):
+        eps = group.sample(total_timesteps=2048)
+        n += sum(len(e) for e in eps)
+    dt = time.perf_counter() - t0
+    print(json.dumps({"metric": "ppo_sample_steps_per_s",
+                      "value": round(n / dt, 1), "unit": "env-steps/s"}),
+          flush=True)
+
+    for _ in range(warmup):
+        algo.train()
+    t0 = time.perf_counter()
+    steps = 0
+    for _ in range(iters):
+        result = algo.train()
+        steps += result["env_steps_this_iter"]
+    dt = time.perf_counter() - t0
+    print(json.dumps({"metric": "ppo_train_steps_per_s",
+                      "value": round(steps / dt, 1), "unit": "env-steps/s",
+                      "iters": iters}), flush=True)
+    algo.stop()
+    ray_tpu.shutdown()
+
+
+if __name__ == "__main__":
+    import io, os, contextlib
+
+    buf = io.StringIO()
+
+    class Tee(io.TextIOBase):
+        def __init__(self, *sinks): self.sinks = sinks
+        def write(self, t):
+            for s_ in self.sinks: s_.write(t)
+            return len(t)
+        def flush(self):
+            for s_ in self.sinks: s_.flush()
+
+    import sys as _sys
+    with contextlib.redirect_stdout(Tee(_sys.stdout, buf)):
+        main()
+    out = {}
+    for line in buf.getvalue().splitlines():
+        try:
+            r = json.loads(line)
+            out[r["metric"]] = r
+        except Exception:
+            pass
+    with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "RL_PERF.json"), "w") as f:
+        json.dump(out, f, indent=1)

@@ -353,6 +353,10 @@ def _attach_runtime_env(wc: ctx.WorkerContext, opts: Dict[str, Any],
 
     cache = wc.extra.setdefault("_renv_cache", {})
     key = _json.dumps(raw, sort_keys=True, default=str)
+    if raw.get("working_dir"):
+        # Editing files between submissions must ship the new content: key
+        # the cache by a cheap directory fingerprint, not the path string.
+        key += "|" + renv.working_dir_fingerprint(raw["working_dir"])
     norm = cache.get(key)
     if norm is None:
         norm = renv.normalize(raw, wc.client)

@@ -66,6 +66,9 @@ class NodeInfo:
     alive: bool = True
     spawning: int = 0
     spawning_tpu: int = 0
+    # env_hash -> in-flight spawn count: one pending env spawn satisfies all
+    # queued wakeups for that env (same rationale as spawning_tpu).
+    spawning_envs: Dict[str, int] = field(default_factory=dict)
     workers: Set[str] = field(default_factory=set)
     # Host-agent fields (None for in-controller virtual nodes): the agent's
     # control connection, its pull-server address, and its host identity
@@ -192,6 +195,7 @@ class Controller:
         self._spawned_procs: Dict[str, subprocess.Popen] = {}  # spawn_token -> proc
         self._tpu_spawn_tokens: Set[str] = set()  # tokens of TPU-capable spawns
         self._agent_spawns: Dict[str, str] = {}  # outstanding agent spawn token -> node_id
+        self._spawn_env_hash: Dict[str, str] = {}  # spawn token -> env hash
         self._sched_wakeup = asyncio.Event()
         self._sched_task: Optional[asyncio.Task] = None
         self._health_task: Optional[asyncio.Task] = None
@@ -559,8 +563,17 @@ class Controller:
             node.spawning = max(0, node.spawning - 1)
             if was_tpu_spawn:
                 node.spawning_tpu = max(0, node.spawning_tpu - 1)
+            if token:
+                self._release_env_spawn(node, token)
         self._wake_scheduler()
         return {"ok": True}
+
+    def _release_env_spawn(self, node: Optional[NodeInfo], token: str) -> None:
+        eh = self._spawn_env_hash.pop(token, None)
+        if eh and node is not None and node.spawning_envs.get(eh, 0) > 0:
+            node.spawning_envs[eh] -= 1
+            if not node.spawning_envs[eh]:
+                node.spawning_envs.pop(eh, None)
 
     async def _h_put_location(self, conn, msg):
         loc: ObjectLocation = msg["loc"]
@@ -1391,6 +1404,7 @@ class Controller:
             node.spawning = max(0, node.spawning - 1)
             if token in self._tpu_spawn_tokens:
                 node.spawning_tpu = max(0, node.spawning_tpu - 1)
+        self._release_env_spawn(node, token)
         self._tpu_spawn_tokens.discard(token)
         if msg.get("env_failed"):
             # The agent could not materialize the runtime env: fail the
@@ -1664,28 +1678,37 @@ class Controller:
         # One in-flight TPU-capable spawn satisfies any number of queued TPU
         # tasks' wakeups during its multi-second startup; without this guard
         # every scheduler pass reaps another idle plain worker and launches a
-        # surplus TPU worker.
+        # surplus TPU worker. Env spawns (venv builds can take tens of
+        # seconds) get the same dedup, keyed by env hash.
         if needs_tpu and node.spawning_tpu > 0:
+            return
+        want_env = (runtime_env or {}).get("hash", "")
+        if want_env and node.spawning_envs.get(want_env, 0) > 0:
             return
         if len(node.workers) + node.spawning >= MAX_WORKERS_PER_NODE:
             # At the cap, a task needing a worker flavor (TPU or a runtime
             # env) that no idle worker matches must not starve behind idle
             # mismatched workers: reap one to make room (reference:
             # worker_pool.cc idle worker killing to satisfy the pool cap).
-            want_env = (runtime_env or {}).get("hash", "")
+            # Scarce TPU workers are victimized only as a last resort, and
+            # only by a TPU-flavored request.
             if not needs_tpu and not want_env:
                 return
             victim = None
+            last_resort = None
             for wid in list(node.workers):
                 w = self.workers.get(wid)
                 if w is None or w.state != "idle":
                     continue
-                if needs_tpu and w.tpu_capable:
-                    continue  # never reap the flavor being requested
-                if not needs_tpu and w.env_hash == want_env:
+                if w.tpu_capable:
+                    if needs_tpu and w.env_hash != want_env:
+                        last_resort = last_resort or w
                     continue
+                if not needs_tpu and w.env_hash == want_env:
+                    continue  # never reap the flavor being requested
                 victim = w
                 break
+            victim = victim or last_resort
             if victim is None:
                 return
             node.workers.discard(victim.worker_id)
@@ -1695,6 +1718,10 @@ class Controller:
         if needs_tpu:
             node.spawning_tpu += 1
         spawn_token = uuid.uuid4().hex
+        if want_env:
+            node.spawning_envs[want_env] = (
+                node.spawning_envs.get(want_env, 0) + 1)
+            self._spawn_env_hash[spawn_token] = want_env
         if node.agent_conn is not None:
             # Delegate to the host agent (lease-style spawn: the reference's
             # raylet owns its worker pool, worker_pool.h:159; the controller
@@ -1756,6 +1783,7 @@ class Controller:
                     if spawn_token in self._tpu_spawn_tokens:
                         self._tpu_spawn_tokens.discard(spawn_token)
                         node.spawning_tpu = max(0, node.spawning_tpu - 1)
+                    self._release_env_spawn(node, spawn_token)
                     self._fail_env_tasks(runtime_env.get("hash", ""), e)
                     self._wake_scheduler()
                     return
@@ -1790,6 +1818,7 @@ class Controller:
                     node.spawning = max(0, node.spawning - 1)
                     if spawn_token in self._tpu_spawn_tokens:
                         node.spawning_tpu = max(0, node.spawning_tpu - 1)
+                self._release_env_spawn(node, spawn_token)
                 self._tpu_spawn_tokens.discard(spawn_token)
                 self._wake_scheduler()
                 return

@@ -83,12 +83,37 @@ def env_hash(norm: Dict[str, Any]) -> str:
     return hashlib.sha256(payload.encode()).hexdigest()[:16]
 
 
+def working_dir_fingerprint(path: str) -> str:
+    """Cheap content fingerprint (relpath, size, mtime) of a directory —
+    used to invalidate the driver-side normalization cache when files
+    change without re-zipping on every submit."""
+    path = os.path.abspath(path)
+    h = hashlib.sha256()
+    for root, dirs, files in os.walk(path):
+        dirs[:] = sorted(d for d in dirs if d not in _EXCLUDE_DIRS)
+        for f in sorted(files):
+            if f.endswith(".pyc"):
+                continue
+            full = os.path.join(root, f)
+            try:
+                st = os.stat(full)
+            except OSError:
+                continue
+            h.update(
+                f"{os.path.relpath(full, path)}|{st.st_size}|{st.st_mtime_ns}"
+                .encode())
+    return h.hexdigest()[:16]
+
+
 def _package_working_dir(path: str):
     """Zip `path` deterministically; return (content URI, zip bytes)."""
     path = os.path.abspath(path)
     if not os.path.isdir(path):
         raise ValueError(f"working_dir {path!r} is not a directory")
+    max_bytes = int(os.environ.get(
+        "RTPU_WORKING_DIR_MAX_BYTES", str(100 * 1024 * 1024)))
     entries = []
+    total = 0
     for root, dirs, files in os.walk(path):
         dirs[:] = sorted(d for d in dirs if d not in _EXCLUDE_DIRS)
         for f in sorted(files):
@@ -96,6 +121,17 @@ def _package_working_dir(path: str):
                 continue
             full = os.path.join(root, f)
             entries.append((os.path.relpath(full, path), full))
+            try:
+                total += os.path.getsize(full)
+            except OSError:
+                pass
+            if total > max_bytes:
+                raise ValueError(
+                    f"working_dir {path!r} exceeds "
+                    f"{max_bytes // (1024 * 1024)}MiB "
+                    f"(reference default cap); exclude data/checkpoint "
+                    f"files or raise RTPU_WORKING_DIR_MAX_BYTES"
+                )
     buf = io.BytesIO()
     with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as z:
         for rel, full in entries:
@@ -145,7 +181,9 @@ def apply_in_worker(norm: Dict[str, Any], client) -> None:
 # ------------------------------------------------------------ spawner side
 
 
-_pip_env_lock = None
+import threading as _threading
+
+_pip_env_lock = _threading.Lock()
 
 
 def ensure_pip_env(pip: List[str]) -> str:
@@ -153,12 +191,8 @@ def ensure_pip_env(pip: List[str]) -> str:
     python executable. Cached per sorted-package-list hash. Builds are
     serialized in-process: concurrent spawns for the same env must not race
     one tmp dir into a half-installed venv."""
-    global _pip_env_lock
-    import threading
     import uuid
 
-    if _pip_env_lock is None:
-        _pip_env_lock = threading.Lock()
     key = hashlib.sha256(json.dumps(sorted(pip)).encode()).hexdigest()[:16]
     root = os.path.join(_cache_root(), f"pip_{key}")
     py = os.path.join(root, "bin", "python")

@@ -86,6 +86,11 @@ def postprocess_episodes(
     # gamma folds each row's bootstrap into its last reward, so GAE is exact
     # per row regardless of padding (see episodes_to_batch docstring).
     bt = episodes_to_batch(episodes, max_t, gamma=gamma)
+    # Pow2-bucket [B, T] so the jitted GAE compiles a handful of shapes
+    # total instead of one per (num_episodes, max_len) the sampler emits.
+    from ..utils.episodes import pad_batch_to_buckets
+
+    bt = pad_batch_to_buckets(bt)
     adv, vtarg = compute_gae(
         bt["rewards"], bt["vf_preds"], bt["dones"], bt["bootstrap_value"],
         gamma=gamma, lam=lam)

@@ -7,10 +7,13 @@ flow instead of the reference's Python/torch loops.
 """
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
 
 
+@functools.partial(jax.jit, static_argnames=("gamma", "lam"))
 def compute_gae(
     rewards: jax.Array,      # [T] or [B, T]
     values: jax.Array,       # same shape
@@ -20,7 +23,12 @@ def compute_gae(
     gamma: float = 0.99,
     lam: float = 0.95,
 ):
-    """Returns (advantages, value_targets), same shape as rewards."""
+    """Returns (advantages, value_targets), same shape as rewards.
+
+    jitted (static gamma/lam): the reversed-time scan would otherwise run
+    eagerly — one dispatch per step, pathological on remote-dispatch
+    platforms. Callers bound recompilation by padding [B, T] to powers of
+    two (episodes.pad_batch_to_buckets)."""
     if rewards.ndim == 1:
         adv, vt = compute_gae(rewards[None], values[None], dones[None],
                               jnp.asarray(bootstrap_value)[None],
