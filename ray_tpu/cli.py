@@ -1,0 +1,231 @@
+"""Operator CLI: start/stop nodes, inspect state, submit jobs.
+
+Parity: reference python/ray/scripts/scripts.py (`ray start --head`,
+`ray start --address`, `ray stop`, `ray status`, `ray summary`, `ray
+timeline`) + `ray job submit/status/logs/list/stop` (dashboard job CLI).
+
+Usage (no console-script install needed):
+
+    python -m ray_tpu.cli start --head [--port 6380] [--num-cpus N]
+    python -m ray_tpu.cli start --address HOST:PORT [--num-cpus N]
+    python -m ray_tpu.cli status  [--address HOST:PORT]
+    python -m ray_tpu.cli summary [--address HOST:PORT]
+    python -m ray_tpu.cli timeline --out trace.json
+    python -m ray_tpu.cli job submit -- python my_script.py
+    python -m ray_tpu.cli job logs <job_id>
+    python -m ray_tpu.cli stop
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import sys
+import tempfile
+import time
+
+_PIDFILE = os.path.join(tempfile.gettempdir(), "rtpu_head.pid")
+_ADDRFILE = os.path.join(tempfile.gettempdir(), "rtpu_head.addr")
+
+
+def _resolve_address(args) -> str:
+    addr = getattr(args, "address", None) or os.environ.get("RTPU_ADDRESS")
+    if not addr and os.path.exists(_ADDRFILE):
+        addr = open(_ADDRFILE).read().strip()
+    if not addr:
+        sys.exit("no cluster address: pass --address, set RTPU_ADDRESS, or "
+                 "start a head with `python -m ray_tpu.cli start --head`")
+    return addr
+
+
+def cmd_start(args) -> int:
+    if args.head:
+        import asyncio
+
+        from ray_tpu.core.controller import Controller
+
+        async def run_head():
+            controller = Controller(port=args.port)
+            host, port = await controller.start()
+            from ray_tpu.util.accelerators import detect_tpu_chips
+
+            res = {"CPU": float(args.num_cpus or os.cpu_count() or 1)}
+            tpus = detect_tpu_chips()
+            if tpus:
+                res["TPU"] = float(tpus)
+            controller.add_node(res, labels={"head": "1"})
+            addr = f"{host}:{port}"
+            with open(_ADDRFILE, "w") as f:
+                f.write(addr)
+            with open(_PIDFILE, "w") as f:
+                f.write(str(os.getpid()))
+            print(f"rtpu head started at {addr}")
+            print(f"  connect with: ray_tpu.init(address={addr!r})")
+            print(f"  metrics:      http://{host}:{controller.metrics_port}/metrics")
+            stop = asyncio.Event()
+            loop = asyncio.get_running_loop()
+            for s in (signal.SIGTERM, signal.SIGINT):
+                try:
+                    loop.add_signal_handler(s, stop.set)
+                except NotImplementedError:
+                    pass
+            await stop.wait()
+            await controller.shutdown()
+
+        asyncio.run(run_head())
+        return 0
+    # worker node: join an existing cluster as a host agent
+    address = _resolve_address(args)
+    from ray_tpu.core.host_agent import _amain
+
+    class A:
+        controller = address
+        resources = json.dumps(
+            {"CPU": float(args.num_cpus or os.cpu_count() or 1)})
+        labels = ""
+        host_id = ""
+        port = 0
+
+    import asyncio
+
+    return asyncio.run(_amain(A()))
+
+
+def cmd_stop(args) -> int:
+    if not os.path.exists(_PIDFILE):
+        print("no head pidfile; nothing to stop")
+        return 0
+    pid = int(open(_PIDFILE).read().strip() or 0)
+    try:
+        os.kill(pid, signal.SIGTERM)
+        print(f"sent SIGTERM to head (pid {pid})")
+    except ProcessLookupError:
+        print("head already gone")
+    for f in (_PIDFILE, _ADDRFILE):
+        try:
+            os.unlink(f)
+        except OSError:
+            pass
+    return 0
+
+
+def _connect(args):
+    import ray_tpu
+
+    ray_tpu.init(address=_resolve_address(args))
+    return ray_tpu
+
+
+def cmd_status(args) -> int:
+    rt = _connect(args)
+    from ray_tpu.core import context as ctx
+
+    state = ctx.get_worker_context().client.request({"kind": "cluster_state"})
+    print(json.dumps(state, indent=1, default=str))
+    rt.shutdown()
+    return 0
+
+
+def cmd_summary(args) -> int:
+    rt = _connect(args)
+    from ray_tpu.util import state
+
+    print(json.dumps(state.summarize_tasks(), indent=1))
+    rt.shutdown()
+    return 0
+
+
+def cmd_timeline(args) -> int:
+    rt = _connect(args)
+    from ray_tpu.util import state
+
+    state.timeline(args.out)
+    print(f"wrote {args.out} (open in chrome://tracing or ui.perfetto.dev)")
+    rt.shutdown()
+    return 0
+
+
+def cmd_job(args) -> int:
+    rt = _connect(args)
+    from ray_tpu.jobs import JobSubmissionClient
+
+    client = JobSubmissionClient()
+    if args.job_cmd == "submit":
+        entrypoint = " ".join(args.entrypoint)
+        renv = {}
+        if args.working_dir:
+            renv["working_dir"] = args.working_dir
+        job_id = client.submit_job(entrypoint=entrypoint,
+                                   runtime_env=renv or None)
+        print(job_id)
+        if args.wait:
+            status = client.wait_until_finished(job_id, timeout=args.timeout)
+            print(client.get_job_logs(job_id), end="")
+            print(f"job {job_id}: {status}")
+            rt.shutdown()
+            return 0 if status == "SUCCEEDED" else 1
+    elif args.job_cmd == "status":
+        print(client.get_job_status(args.job_id))
+    elif args.job_cmd == "logs":
+        print(client.get_job_logs(args.job_id), end="")
+    elif args.job_cmd == "stop":
+        client.stop_job(args.job_id)
+        print("stopped")
+    elif args.job_cmd == "list":
+        for d in client.list_jobs():
+            print(f"{d.job_id}\t{d.status}\t{d.entrypoint}")
+    rt.shutdown()
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="rtpu", description=__doc__)
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    p = sub.add_parser("start", help="start a head or worker node")
+    p.add_argument("--head", action="store_true")
+    p.add_argument("--address", default=None, help="join an existing head")
+    p.add_argument("--port", type=int, default=0)
+    p.add_argument("--num-cpus", type=int, default=None)
+    p.set_defaults(fn=cmd_start)
+
+    p = sub.add_parser("stop", help="stop the head started on this machine")
+    p.set_defaults(fn=cmd_stop)
+
+    for name, fn in (("status", cmd_status), ("summary", cmd_summary)):
+        p = sub.add_parser(name)
+        p.add_argument("--address", default=None)
+        p.set_defaults(fn=fn)
+
+    p = sub.add_parser("timeline")
+    p.add_argument("--address", default=None)
+    p.add_argument("--out", default="timeline.json")
+    p.set_defaults(fn=cmd_timeline)
+
+    p = sub.add_parser("job")
+    p.add_argument("--address", default=None)
+    jsub = p.add_subparsers(dest="job_cmd", required=True)
+    j = jsub.add_parser("submit")
+    j.add_argument("--working-dir", default=None)
+    j.add_argument("--wait", action="store_true")
+    j.add_argument("--timeout", type=float, default=600.0)
+    j.add_argument("entrypoint", nargs=argparse.REMAINDER,
+                   help="command after --")
+    for name in ("status", "logs", "stop"):
+        j = jsub.add_parser(name)
+        j.add_argument("job_id")
+    jsub.add_parser("list")
+    p.set_defaults(fn=cmd_job)
+
+    args = ap.parse_args(argv)
+    if args.cmd == "job":
+        # strip a leading "--" in the remainder
+        ep = getattr(args, "entrypoint", None)
+        if ep and ep[0] == "--":
+            args.entrypoint = ep[1:]
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -57,6 +57,11 @@ def init(
             raise RuntimeError("ray_tpu.init() called twice; pass ignore_reinit_error=True")
 
         if address is None:
+            # Job entrypoints / `rtpu` CLI processes inherit the cluster
+            # address via env (reference: RAY_ADDRESS).
+            address = os.environ.get("RTPU_ADDRESS") or None
+
+        if address is None:
             from ray_tpu.util.accelerators import detect_tpu_chips
 
             io = EventLoopThread(name="rtpu-controller")
@@ -266,7 +271,7 @@ def _normalize_strategy(scheduling_strategy: Any) -> Tuple[Dict[str, Any], Optio
         pg = scheduling_strategy.placement_group
         idx = scheduling_strategy.placement_group_bundle_index
         if idx is None or idx < 0:
-            idx = 0
+            idx = -1  # reference semantics: any bundle in the group
         return {"type": "DEFAULT"}, (pg.id, idx)
     raise ValueError(f"unknown scheduling strategy {scheduling_strategy!r}")
 

@@ -1258,6 +1258,46 @@ class Controller:
             latest[ev["task_id"]] = ev
         return latest
 
+    async def _h_autoscaler_state(self, conn, msg):
+        """Demand/usage snapshot for the autoscaler (reference: the load
+        metrics the monitor feeds StandardAutoscaler,
+        autoscaler/_private/load_metrics.py)."""
+        demands = []
+        for tid in self.pending_queue:
+            spec = self.tasks.get(tid)
+            if spec is not None:
+                demands.append(dict(spec.get("resources", {})))
+        nodes = []
+        for n in self.nodes.values():
+            busy = False
+            for wid in n.workers:
+                w = self.workers.get(wid)
+                if w is not None and (w.state != "idle" or w.actor_ids):
+                    busy = True
+                    break
+            nodes.append({
+                "node_id": n.node_id,
+                "alive": n.alive,
+                "is_agent": n.agent_conn is not None,
+                "busy": busy,
+                "resources": dict(n.resources),
+                "available": dict(n.available),
+                "labels": dict(n.labels),
+            })
+        return {"demands": demands, "nodes": nodes}
+
+    async def _h_drop_node(self, conn, msg):
+        """Autoscaler-initiated scale-down of an agent node: tell its agent
+        to exit; the normal death path cleans up."""
+        node = self.nodes.get(msg["node_id"])
+        if node is None or node.agent_conn is None:
+            return {"ok": False}
+        try:
+            await node.agent_conn.send({"kind": "shutdown"})
+        except Exception:
+            pass
+        return {"ok": True}
+
     async def _h_task_events(self, conn, msg):
         """Raw event stream for the chrome-trace timeline export
         (reference: GlobalState.chrome_tracing_dump, _private/state.py:434)."""
@@ -1618,7 +1658,21 @@ class Controller:
                 return True
             if pg.state != "ready":
                 return False
-            bundle = pg.bundles[pg_ref[1]]
+            idx = pg_ref[1]
+            if idx == -1:
+                # "Any bundle" (reference bundle_index=-1): first fitting
+                # bundle wins. The spec is rebound only at DISPATCH — a
+                # failed attempt must stay -1 so the next pass can pick a
+                # different bundle (pinning here would re-create the
+                # starve-on-bundle-0 behavior the feature removes).
+                idx = next(
+                    (i for i, b in enumerate(pg.bundles)
+                     if _res_fits(b.available, resources)),
+                    None,
+                )
+                if idx is None:
+                    return False
+            bundle = pg.bundles[idx]
             node = self.nodes[bundle.node_id]
             if not _res_fits(bundle.available, resources):
                 return False
@@ -1629,6 +1683,7 @@ class Controller:
                 self._maybe_spawn_worker(node, needs_tpu, spec.get("runtime_env"))
                 return False
             _res_sub(bundle.available, resources)
+            spec["pg"] = (pg_ref[0], idx)  # bind so release credits this bundle
             spec["sched_node"] = node.node_id
             await self._dispatch(spec, node, w)
             return True
@@ -1831,6 +1886,9 @@ class Controller:
             actor.worker_id = w.worker_id
             actor.node_id = node.node_id
             actor.reserved = True
+            # bundle_index=-1 rebinds to the bundle actually used at
+            # placement; the actor's release must credit that bundle.
+            actor.pg = spec.get("pg", actor.pg)
             w.state = "actor"
             w.actor_ids.add(actor.actor_id)
             await w.conn.send({"kind": "instantiate_actor", "spec": spec})

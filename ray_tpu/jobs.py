@@ -1,0 +1,168 @@
+"""Job submission: run an entrypoint command on the cluster, track status.
+
+Parity: reference dashboard/modules/job/ (JobSubmissionClient job_sdk,
+JobManager spawning a supervisor actor per job that runs the entrypoint as a
+subprocess and streams logs — dashboard/modules/job/job_manager.py). Here
+the supervisor is a detached named actor; logs and status live in the
+controller KV so any driver can query them.
+"""
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import threading
+import time
+import uuid
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional
+
+import ray_tpu
+
+_KV_NS = "__jobs__"
+
+
+class JobStatus:
+    PENDING = "PENDING"
+    RUNNING = "RUNNING"
+    SUCCEEDED = "SUCCEEDED"
+    FAILED = "FAILED"
+    STOPPED = "STOPPED"
+
+
+class _JobSupervisor:
+    """Detached actor owning one job's entrypoint subprocess."""
+
+    def __init__(self, job_id: str, entrypoint: str,
+                 env_vars: Optional[Dict[str, str]] = None,
+                 working_dir: Optional[str] = None):
+        self.job_id = job_id
+        self.entrypoint = entrypoint
+        self.log_lines: List[str] = []
+        self.status = JobStatus.PENDING
+        self.returncode: Optional[int] = None
+        env = dict(os.environ)
+        env.update(env_vars or {})
+        # The job's driver connects to THIS cluster.
+        from ray_tpu.core import context as ctx
+
+        env["RTPU_ADDRESS"] = ctx.get_worker_context().extra.get(
+            "address", "") or os.environ.get("RTPU_CONTROLLER", "")
+        self.proc = subprocess.Popen(
+            entrypoint, shell=True, env=env, cwd=working_dir or None,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+        self.status = JobStatus.RUNNING
+        self._pump = threading.Thread(target=self._pump_logs, daemon=True)
+        self._pump.start()
+
+    def _pump_logs(self) -> None:
+        for line in self.proc.stdout:
+            self.log_lines.append(line)
+            if len(self.log_lines) > 10_000:
+                del self.log_lines[:1000]
+        rc = self.proc.wait()
+        self.returncode = rc
+        if self.status != JobStatus.STOPPED:
+            self.status = JobStatus.SUCCEEDED if rc == 0 else JobStatus.FAILED
+
+    def get_status(self) -> Dict[str, Any]:
+        return {"job_id": self.job_id, "status": self.status,
+                "returncode": self.returncode, "entrypoint": self.entrypoint}
+
+    def get_logs(self) -> str:
+        return "".join(self.log_lines)
+
+    def stop(self) -> None:
+        if self.proc.poll() is None:
+            self.status = JobStatus.STOPPED
+            self.proc.terminate()
+
+
+@dataclass
+class JobDetails:
+    job_id: str
+    status: str
+    entrypoint: str
+    returncode: Optional[int] = None
+
+
+class JobSubmissionClient:
+    """Parity surface of ray.job_submission.JobSubmissionClient."""
+
+    def __init__(self, address: Optional[str] = None):
+        if not ray_tpu.is_initialized():
+            if address:
+                ray_tpu.init(address=address)
+            else:
+                raise RuntimeError(
+                    "pass address=... or ray_tpu.init() first")
+
+    def submit_job(
+        self,
+        *,
+        entrypoint: str,
+        submission_id: Optional[str] = None,
+        runtime_env: Optional[Dict[str, Any]] = None,
+        entrypoint_num_cpus: float = 1.0,
+    ) -> str:
+        job_id = submission_id or f"rtpu-job-{uuid.uuid4().hex[:10]}"
+        renv = runtime_env or {}
+        sup = (
+            ray_tpu.remote(_JobSupervisor)
+            .options(name=f"_job:{job_id}", lifetime="detached",
+                     num_cpus=entrypoint_num_cpus)
+            .remote(job_id, entrypoint, renv.get("env_vars"),
+                    renv.get("working_dir"))
+        )
+        # Surface constructor errors now (bad working_dir etc.).
+        ray_tpu.get(sup.get_status.remote(), timeout=60)
+        self._kv_record(job_id)
+        return job_id
+
+    def _kv_record(self, job_id: str) -> None:
+        from ray_tpu.core import context as ctx
+
+        ctx.get_worker_context().client.request(
+            {"kind": "kv_put", "ns": _KV_NS, "key": job_id, "value": b"1"})
+
+    def _sup(self, job_id: str):
+        return ray_tpu.get_actor(f"_job:{job_id}")
+
+    def get_job_status(self, job_id: str) -> str:
+        return ray_tpu.get(self._sup(job_id).get_status.remote())["status"]
+
+    def get_job_info(self, job_id: str) -> JobDetails:
+        d = ray_tpu.get(self._sup(job_id).get_status.remote())
+        return JobDetails(job_id=d["job_id"], status=d["status"],
+                          entrypoint=d["entrypoint"],
+                          returncode=d["returncode"])
+
+    def get_job_logs(self, job_id: str) -> str:
+        return ray_tpu.get(self._sup(job_id).get_logs.remote())
+
+    def stop_job(self, job_id: str) -> bool:
+        ray_tpu.get(self._sup(job_id).stop.remote())
+        return True
+
+    def list_jobs(self) -> List[JobDetails]:
+        from ray_tpu.core import context as ctx
+
+        keys = ctx.get_worker_context().client.request(
+            {"kind": "kv_keys", "ns": _KV_NS, "prefix": ""})
+        out = []
+        for job_id in keys:
+            try:
+                out.append(self.get_job_info(job_id))
+            except Exception:
+                out.append(JobDetails(job_id=job_id, status="DEAD",
+                                      entrypoint="?"))
+        return out
+
+    def wait_until_finished(self, job_id: str, timeout: float = 300.0) -> str:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            st = self.get_job_status(job_id)
+            if st in (JobStatus.SUCCEEDED, JobStatus.FAILED, JobStatus.STOPPED):
+                return st
+            time.sleep(0.3)
+        raise TimeoutError(f"job {job_id} not finished within {timeout}s")

@@ -51,6 +51,7 @@ class Algorithm(Trainable):
             num_runners=cfg.num_env_runners,
             num_envs_per_runner=cfg.num_envs_per_env_runner,
             seed=cfg.seed,
+            connector_factory=cfg.env_to_module_connector,
         )
         self.learner_group = LearnerGroup(
             self._learner_factory(), num_learners=cfg.num_learners)
@@ -66,14 +67,26 @@ class Algorithm(Trainable):
         cfg = self._algo_config
         creator = cfg.make_env_creator()
         model_config = dict(cfg.model)
+        connector_factory = cfg.env_to_module_connector
 
         def factory():
+            import gymnasium as gym
+            import numpy as np
+
             from .core.catalog import module_for_space
 
             env = creator()
             try:
+                obs_space = env.observation_space
+                if connector_factory is not None:
+                    # The module sees connector OUTPUT shapes.
+                    shape = tuple(
+                        connector_factory().output_shape(obs_space.shape))
+                    obs_space = gym.spaces.Box(
+                        low=-np.inf, high=np.inf, shape=shape,
+                        dtype=np.float32)
                 return module_for_space(
-                    env.observation_space, env.action_space, model_config)
+                    obs_space, env.action_space, model_config)
             finally:
                 env.close()
 

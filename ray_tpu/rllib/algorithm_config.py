@@ -22,6 +22,7 @@ class AlgorithmConfig:
         self.num_env_runners: int = 0
         self.num_envs_per_env_runner: int = 1
         self.rollout_fragment_length: int = 200
+        self.env_to_module_connector: Optional[Any] = None
         # training()
         self.lr: float = 3e-4
         self.gamma: float = 0.99
@@ -59,7 +60,8 @@ class AlgorithmConfig:
 
     def env_runners(self, *, num_env_runners: Optional[int] = None,
                     num_envs_per_env_runner: Optional[int] = None,
-                    rollout_fragment_length: Optional[int] = None
+                    rollout_fragment_length: Optional[int] = None,
+                    env_to_module_connector: Optional[Any] = None,
                     ) -> "AlgorithmConfig":
         if num_env_runners is not None:
             self.num_env_runners = num_env_runners
@@ -67,6 +69,10 @@ class AlgorithmConfig:
             self.num_envs_per_env_runner = num_envs_per_env_runner
         if rollout_fragment_length is not None:
             self.rollout_fragment_length = rollout_fragment_length
+        if env_to_module_connector is not None:
+            # Zero-arg factory returning a ConnectorV2 / ConnectorPipeline
+            # (reference: config.env_runners(env_to_module_connector=...)).
+            self.env_to_module_connector = env_to_module_connector
         return self
 
     def training(self, **kwargs) -> "AlgorithmConfig":

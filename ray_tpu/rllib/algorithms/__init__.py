@@ -1,4 +1,5 @@
-from .ppo import PPO, PPOConfig
+from .dqn import DQN, DQNConfig
 from .impala import IMPALA, IMPALAConfig
+from .ppo import PPO, PPOConfig
 
-__all__ = ["PPO", "PPOConfig", "IMPALA", "IMPALAConfig"]
+__all__ = ["PPO", "PPOConfig", "IMPALA", "IMPALAConfig", "DQN", "DQNConfig"]

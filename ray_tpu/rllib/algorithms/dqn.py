@@ -1,0 +1,234 @@
+"""DQN on the jax learner stack.
+
+Parity: reference rllib/algorithms/dqn/ (training_step: rollout ->
+replay-buffer add -> TD updates with a periodically synced target network;
+epsilon-greedy exploration). TPU-native shape: the TD update is one jitted
+program; the target params ride along in the batch pytree so the update
+stays functional; epsilon lives IN the weights so the existing
+sync_weights broadcast carries the schedule to every env runner.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..algorithm import Algorithm
+from ..algorithm_config import AlgorithmConfig
+from ..core.learner import JaxLearner
+from ..core.rl_module import MLPModule, RLModule
+from ..utils.episodes import SingleAgentEpisode
+
+
+class DQNConfig(AlgorithmConfig):
+    def __init__(self, algo_class=None):
+        super().__init__(algo_class=algo_class or DQN)
+        self.replay_buffer_capacity: int = 50_000
+        self.learning_starts: int = 1_000
+        self.target_network_update_freq: int = 500  # in sampled env-steps
+        self.epsilon_initial: float = 1.0
+        self.epsilon_final: float = 0.05
+        self.epsilon_timesteps: int = 10_000
+        self.num_td_updates_per_iter: int = 32
+        self.gamma: float = 0.99
+
+
+class DQNModule(RLModule):
+    """Q-network wrapper: logits ARE Q-values; exploration is
+    epsilon-greedy with epsilon carried in the params pytree."""
+
+    def __init__(self, obs_dim: int, num_actions: int, hiddens=(64, 64)):
+        self._mlp = MLPModule(obs_dim, num_actions, hiddens)
+        self.num_actions = num_actions
+
+    def init(self, rng: jax.Array):
+        params = self._mlp.init(rng)
+        params["epsilon"] = jnp.asarray(1.0, jnp.float32)
+        return params
+
+    def forward(self, params, obs):
+        out = self._mlp.forward(params, obs)
+        # vf = max-Q: gives the runners a value estimate for logging.
+        out["vf"] = jnp.max(out["logits"], axis=-1)
+        return out
+
+    def forward_exploration(self, params, obs, rng):
+        out = self.forward(params, obs)
+        q = out["logits"]
+        greedy = jnp.argmax(q, axis=-1)
+        r1, r2 = jax.random.split(rng)
+        rand_a = jax.random.randint(r1, greedy.shape, 0, self.num_actions)
+        explore = jax.random.uniform(r2, greedy.shape) < params["epsilon"]
+        action = jnp.where(explore, rand_a, greedy)
+        # logp is not meaningful for epsilon-greedy; report 0 (unused).
+        return action, jnp.zeros_like(q[..., 0]), out["vf"]
+
+
+class DQNLearner(JaxLearner):
+    def __init__(self, module, cfg: DQNConfig, **kw):
+        self.cfg = cfg
+        super().__init__(module, lr=cfg.lr, grad_clip=cfg.grad_clip, **kw)
+        self._target_params = jax.tree.map(lambda x: x, self.params)
+
+    def loss(self, params, batch, rng):
+        cfg = self.cfg
+        q = self.module.forward(params, batch["obs"])["logits"]
+        q_sa = jnp.take_along_axis(
+            q, batch["actions"][:, None].astype(jnp.int32), axis=1)[:, 0]
+        q_next = self.module.forward(
+            batch["target_params"], batch["next_obs"])["logits"]
+        target = batch["rewards"] + cfg.gamma * (
+            1.0 - batch["dones"]) * jnp.max(q_next, axis=-1)
+        target = jax.lax.stop_gradient(target)
+        err = q_sa - target
+        # Huber loss (reference default).
+        huber = jnp.where(jnp.abs(err) < 1.0, 0.5 * err**2,
+                          jnp.abs(err) - 0.5)
+        loss = jnp.mean(huber)
+        return loss, {"td_loss": loss, "mean_q": jnp.mean(q_sa)}
+
+    def sync_target(self) -> None:
+        """Copy current params into the target network — called only at
+        target_network_update_freq, so the big pytree never rides the
+        per-update RPC."""
+        self._target_params = jax.tree.map(lambda x: x, self.params)
+
+    def update_td(self, batch: Dict[str, np.ndarray]) -> Dict[str, float]:
+        # One full-batch jitted TD step. The target params join the batch
+        # pytree directly (no row indexing may touch them).
+        dev = self._shard_batch(batch)
+        dev["target_params"] = self._target_params
+        self.params, self.opt_state, metrics = self._jit_update(
+            self.params, self.opt_state, dev, self._consume_rng())
+        return {k: float(v) for k, v in metrics.items()}
+
+
+class ReplayBuffer:
+    """Uniform FIFO transition buffer (reference:
+    utils/replay_buffers/replay_buffer.py)."""
+
+    def __init__(self, capacity: int, obs_shape: Tuple[int, ...]):
+        self.capacity = capacity
+        self.size = 0
+        self.pos = 0
+        self.obs = np.zeros((capacity, *obs_shape), np.float32)
+        self.next_obs = np.zeros((capacity, *obs_shape), np.float32)
+        self.actions = np.zeros((capacity,), np.int32)
+        self.rewards = np.zeros((capacity,), np.float32)
+        self.dones = np.zeros((capacity,), np.float32)
+
+    def add_episodes(self, episodes: List[SingleAgentEpisode]) -> int:
+        n = 0
+        for ep in episodes:
+            T = len(ep.actions)
+            for t in range(T):
+                nxt = ep.observations[t + 1] if t + 1 < len(ep.observations) \
+                    else ep.observations[t]
+                done = float(ep.terminated and t == T - 1)
+                i = self.pos
+                self.obs[i] = ep.observations[t]
+                self.next_obs[i] = nxt
+                self.actions[i] = ep.actions[t]
+                self.rewards[i] = ep.rewards[t]
+                self.dones[i] = done
+                self.pos = (self.pos + 1) % self.capacity
+                self.size = min(self.size + 1, self.capacity)
+                n += 1
+        return n
+
+    def sample(self, batch_size: int, rng: np.random.Generator):
+        idx = rng.integers(0, self.size, batch_size)
+        return {
+            "obs": self.obs[idx],
+            "next_obs": self.next_obs[idx],
+            "actions": self.actions[idx],
+            "rewards": self.rewards[idx],
+            "dones": self.dones[idx],
+        }
+
+
+class DQN(Algorithm):
+    config_cls = DQNConfig
+
+    def _module_factory(self):
+        cfg = self._algo_config
+        creator = cfg.make_env_creator()
+        connector_factory = cfg.env_to_module_connector
+
+        def factory():
+            env = creator()
+            try:
+                shape = env.observation_space.shape
+                if connector_factory is not None:
+                    shape = tuple(connector_factory().output_shape(shape))
+                obs_dim = int(np.prod(shape))
+                return DQNModule(obs_dim, env.action_space.n,
+                                 tuple(cfg.model.get("fcnet_hiddens",
+                                                     (64, 64))))
+            finally:
+                env.close()
+
+        return factory
+
+    def _learner_factory(self):
+        cfg = self._algo_config
+        module_factory = self._module_factory()
+
+        def factory():
+            return DQNLearner(module_factory(), cfg, mesh=cfg.learner_mesh,
+                              seed=cfg.seed)
+
+        return factory
+
+    def _setup_extra(self) -> None:
+        cfg = self._algo_config
+        env = cfg.make_env_creator()()
+        try:
+            obs_shape = env.observation_space.shape
+        finally:
+            env.close()
+        if cfg.env_to_module_connector is not None:
+            # The buffer stores CONNECTED observations (what the module sees).
+            obs_shape = tuple(
+                cfg.env_to_module_connector().output_shape(obs_shape))
+        self._buffer = ReplayBuffer(cfg.replay_buffer_capacity, obs_shape)
+        self.learner_group.call("sync_target")
+        self._steps_since_target_sync = 0
+        self._np_rng = np.random.default_rng(cfg.seed)
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg = self._algo_config
+        if not hasattr(self, "_buffer"):
+            self._setup_extra()
+        weights = self.learner_group.get_weights()
+        # Epsilon schedule, carried inside the weights.
+        frac = min(1.0, self._timesteps_total / max(1, cfg.epsilon_timesteps))
+        eps = cfg.epsilon_initial + frac * (
+            cfg.epsilon_final - cfg.epsilon_initial)
+        weights["epsilon"] = np.float32(eps)
+        self.learner_group.set_weights(weights)
+        self.env_runner_group.sync_weights(weights)
+
+        episodes = self.env_runner_group.sample(cfg.train_batch_size)
+        self._record_episodes(episodes)
+        added = self._buffer.add_episodes(episodes)
+        self._steps_since_target_sync += added
+
+        metrics: Dict[str, Any] = {}
+        if self._buffer.size >= cfg.learning_starts:
+            for _ in range(cfg.num_td_updates_per_iter):
+                batch = self._buffer.sample(cfg.minibatch_size, self._np_rng)
+                metrics = self.learner_group.call("update_td", batch)
+            if self._steps_since_target_sync >= cfg.target_network_update_freq:
+                self.learner_group.call("sync_target")
+                self._steps_since_target_sync = 0
+
+        out = dict(metrics)
+        out["epsilon"] = float(eps)
+        out["buffer_size"] = self._buffer.size
+        out["episode_return_mean"] = self.episode_return_mean
+        out["num_episodes"] = len(episodes)
+        out["env_steps_this_iter"] = added
+        return out

@@ -1,0 +1,5 @@
+from .connector import (ConnectorPipeline, ConnectorV2, FlattenObs,
+                        FrameStack, NormalizeObs)
+
+__all__ = ["ConnectorV2", "ConnectorPipeline", "FlattenObs", "NormalizeObs",
+           "FrameStack"]

@@ -24,6 +24,9 @@ class _LearnerActor:
     def update(self, batch, **kw):
         return self.learner.update(batch, **kw)
 
+    def call(self, method, *args, **kw):
+        return getattr(self.learner, method)(*args, **kw)
+
     def get_weights(self):
         return self.learner.get_weights()
 
@@ -65,6 +68,13 @@ class LearnerGroup:
         if self._remote:
             return ray_tpu.get(self._actor.update.remote(batch, **kw))
         return self._learner.update(batch, **kw)
+
+    def call(self, method: str, *args, **kw) -> Any:
+        """Invoke an algorithm-specific learner method (e.g. DQN's
+        update_td) in whichever process hosts the learner."""
+        if self._remote:
+            return ray_tpu.get(self._actor.call.remote(method, *args, **kw))
+        return getattr(self._learner, method)(*args, **kw)
 
     def get_weights(self) -> Any:
         if self._remote:

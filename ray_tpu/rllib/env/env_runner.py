@@ -24,6 +24,7 @@ class SingleAgentEnvRunner:
         num_envs: int = 1,
         seed: int = 0,
         worker_index: int = 0,
+        connector_factory: Optional[Callable[[], Any]] = None,
     ):
         import gymnasium as gym
 
@@ -39,12 +40,18 @@ class SingleAgentEnvRunner:
         self.num_envs = num_envs
         self.module = module_factory()
         self.params = None
+        # env-to-module connector pipeline (reference ConnectorV2): runs on
+        # the raw vector observations BEFORE the policy forward; episodes
+        # record the transformed obs so the learner sees the same view.
+        self._connector_factory = connector_factory
+        self.connector = connector_factory() if connector_factory else None
         self._rng = jax.random.key(seed * 10_007 + worker_index)
         self._explore_fn = jax.jit(self.module.forward_exploration)
         self._value_fn = jax.jit(
             lambda p, o: self.module.forward(p, o)["vf"])
         seed_val = int(seed * 65_537 + worker_index)
-        self._obs, _ = self.envs.reset(seed=seed_val)
+        raw_obs, _ = self.envs.reset(seed=seed_val)
+        self._obs = self._connect(raw_obs)
         self._episodes = [SingleAgentEpisode() for _ in range(num_envs)]
         for i in range(num_envs):
             self._episodes[i].observations.append(self._obs[i].copy())
@@ -54,6 +61,9 @@ class SingleAgentEnvRunner:
         self._needs_reset = np.zeros(num_envs, dtype=bool)
 
     # ----------------------------------------------------------------- state
+
+    def _connect(self, raw_obs):
+        return self.connector(raw_obs) if self.connector is not None else raw_obs
 
     def set_weights(self, weights) -> None:
         self.params = weights
@@ -78,7 +88,8 @@ class SingleAgentEnvRunner:
             actions = np.asarray(actions)
             logp = np.asarray(logp)
             vf = np.asarray(vf)
-            next_obs, rewards, terms, truncs, _ = self.envs.step(actions)
+            raw_next, rewards, terms, truncs, _ = self.envs.step(actions)
+            next_obs = self._connect(raw_next)
             vf_next: Optional[np.ndarray] = None  # lazy V(next_obs)
             for i in range(self.num_envs):
                 if self._needs_reset[i]:
@@ -108,6 +119,10 @@ class SingleAgentEnvRunner:
                     out.append(ep)
                     self._episodes[i] = SingleAgentEpisode()
                     self._needs_reset[i] = True
+                    # Stateful connectors (frame stacks) restart with the
+                    # new episode.
+                    if self.connector is not None:
+                        self.connector.reset(i)
                 else:
                     ep.observations.append(next_obs[i].copy())
             self._obs = next_obs
@@ -133,11 +148,19 @@ class SingleAgentEnvRunner:
 
         env = self.envs.env_fns[0]()
         jax = self._jax
+        # Evaluation gets its own connector instance: sharing the sampling
+        # pipeline's per-env state would corrupt in-flight frame stacks.
+        conn = (self._connector_factory()
+                if self._connector_factory is not None else None)
+
+        def trans(o):
+            return conn(np.asarray(o)[None]) if conn is not None \
+                else np.asarray(o)[None]
+
         obs, _ = env.reset()
         total = 0.0
         for _ in range(max_steps):
-            action = self.module.forward_inference(
-                self.params, np.asarray(obs)[None])
+            action = self.module.forward_inference(self.params, trans(obs))
             obs, r, term, trunc, _ = env.step(int(np.asarray(action)[0]))
             total += float(r)
             if term or trunc:

@@ -27,12 +27,14 @@ class EnvRunnerGroup:
         seed: int = 0,
         runner_resources: Optional[Dict[str, float]] = None,
         max_restarts: int = 3,
+        connector_factory: Optional[Callable[[], Any]] = None,
     ):
         self.num_runners = num_runners
         if num_runners == 0:
             self._local = SingleAgentEnvRunner(
                 env_creator, module_factory,
-                num_envs=num_envs_per_runner, seed=seed, worker_index=0)
+                num_envs=num_envs_per_runner, seed=seed, worker_index=0,
+                connector_factory=connector_factory)
             self._manager = None
         else:
             self._local = None
@@ -43,7 +45,8 @@ class EnvRunnerGroup:
                 return cls.remote(
                     env_creator, module_factory,
                     num_envs=num_envs_per_runner, seed=seed,
-                    worker_index=i + 1)
+                    worker_index=i + 1,
+                    connector_factory=connector_factory)
 
             self._manager = FaultTolerantActorManager(
                 factory, num_runners, max_restarts=max_restarts)

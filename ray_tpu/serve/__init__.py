@@ -12,6 +12,7 @@ Parity map (reference python/ray/serve/, SURVEY.md §2.6):
 from .api import (delete, get_app_handle, get_deployment_handle, run,
                   shutdown, start, status)
 from .batching import batch
+from .multiplex import get_multiplexed_model_id, multiplexed
 from .deployment import Application, AutoscalingConfig, Deployment, deployment
 from .handle import (DeploymentHandle, DeploymentResponse,
                      DeploymentStreamingResponse)
@@ -24,6 +25,8 @@ __all__ = [
     "DeploymentHandle",
     "DeploymentResponse",
     "DeploymentStreamingResponse",
+    "get_multiplexed_model_id",
+    "multiplexed",
     "run",
     "start",
     "shutdown",

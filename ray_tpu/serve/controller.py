@@ -75,9 +75,23 @@ class ServeController:
                     self._kill_replica(r)
                 info.replicas = []
                 info.version += 1
+                self._publish_update(name, info.version)
             if route_prefix:
                 self._route_prefixes[route_prefix] = name
         self._reconcile()
+
+    def _publish_update(self, name: str, version: int) -> None:
+        """Push-based config propagation (reference: serve LongPollHost
+        notifying handles on replica-set changes, long_poll.py:173) — the
+        core pubsub replaces per-call version polling in routers."""
+        try:
+            from ray_tpu.core import context as ctx
+
+            ctx.get_worker_context().client.request(
+                {"kind": "publish", "channel": "serve_updates",
+                 "data": {"name": name, "version": version}})
+        except Exception:
+            pass  # routers still have the periodic refresh as backstop
 
     def delete_deployment(self, name: str) -> None:
         with self._lock:
@@ -189,6 +203,7 @@ class ServeController:
                 if changed:
                     info.replicas = alive
                     info.version += 1
+                    self._publish_update(info.name, info.version)
 
     # --------------------------------------------------------- autoscaling
 

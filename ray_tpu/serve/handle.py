@@ -101,6 +101,40 @@ class DeploymentStreamingResponse:
             pass
 
 
+import weakref
+
+_routers: "weakref.WeakSet" = weakref.WeakSet()
+_subscribed_tokens: set = set()
+
+
+def _ensure_push_subscription() -> None:
+    """Subscribe this process once to serve's long-poll push channel
+    (reference LongPollClient): replica-set changes invalidate router
+    caches immediately instead of waiting out the poll period."""
+    from ray_tpu.core import context as ctx
+
+    try:
+        wc = ctx.get_worker_context()
+    except Exception:
+        return
+    token = wc.client.token
+    if token in _subscribed_tokens:
+        return
+    _subscribed_tokens.add(token)
+
+    def on_update(data) -> None:
+        name = (data or {}).get("name")
+        for r in list(_routers):
+            if r.name == name:
+                r._last_refresh = 0.0  # next assign() refreshes
+
+    try:
+        ctx.on_pubsub("serve_updates", on_update)
+        wc.client.request({"kind": "subscribe", "channel": "serve_updates"})
+    except Exception:
+        _subscribed_tokens.discard(token)
+
+
 class Router:
     REFRESH_PERIOD_S = 3.0
 
@@ -112,6 +146,8 @@ class Router:
         self._inflight: Dict[str, int] = {}
         self._controller = None
         self._last_refresh = 0.0
+        _routers.add(self)
+        _ensure_push_subscription()
 
     def _ctrl(self):
         if self._controller is None:
@@ -162,13 +198,38 @@ class Router:
             if key in self._inflight and self._inflight[key] > 0:
                 self._inflight[key] -= 1
 
+    def _pick_affine(self, model_id: str, exclude: Optional[set] = None):
+        """Model-affine pick: rendezvous hash over replicas, so one model's
+        requests land where it is already loaded (reference model-multiplex
+        routing). `exclude` holds replicas that already failed this call —
+        the deterministic hash would otherwise retry the same dead one."""
+        import hashlib
+
+        with self._lock:
+            reps = [r for r in self._replicas
+                    if not exclude or r._actor_id not in exclude]
+            if not reps:
+                raise RuntimeError(f"no replicas for {self.name}")
+            r = max(
+                reps,
+                key=lambda rep: hashlib.md5(
+                    f"{model_id}|{rep._actor_id}".encode()).digest(),
+            )
+            self._inflight[r._actor_id] = self._inflight.get(r._actor_id, 0) + 1
+            return r
+
     def assign(self, method_name: str, args, kwargs,
-               retries: int = 3, stream: bool = False):
+               retries: int = 3, stream: bool = False,
+               multiplexed_model_id: str = ""):
         self._refresh()
         last_err: Optional[Exception] = None
+        failed: set = set()
         for attempt in range(retries):
             try:
-                replica = self._pick()
+                if multiplexed_model_id:
+                    replica = self._pick_affine(multiplexed_model_id, failed)
+                else:
+                    replica = self._pick()
             except RuntimeError as e:
                 last_err = e
                 time.sleep(0.2 * (attempt + 1))
@@ -178,14 +239,16 @@ class Router:
                 if stream:
                     ref_gen = replica.handle_request_streaming.options(
                         num_returns="streaming"
-                    ).remote(method_name, args, kwargs)
+                    ).remote(method_name, args, kwargs,
+                             multiplexed_model_id)
                     return DeploymentStreamingResponse(
                         ref_gen, self, replica._actor_id)
                 ref = replica.handle_request.remote(
-                    method_name, args, kwargs)
+                    method_name, args, kwargs, multiplexed_model_id)
                 return DeploymentResponse(ref, self, replica._actor_id)
             except Exception as e:  # dead replica: drop + refresh
                 last_err = e
+                failed.add(replica._actor_id)
                 self._on_done(replica._actor_id)
                 self._refresh(force=True)
         raise RuntimeError(
@@ -194,10 +257,11 @@ class Router:
 
 class DeploymentHandle:
     def __init__(self, deployment_name: str, method_name: str = "__call__",
-                 stream: bool = False):
+                 stream: bool = False, multiplexed_model_id: str = ""):
         self.deployment_name = deployment_name
         self._method_name = method_name
         self._stream = stream
+        self._multiplexed_model_id = multiplexed_model_id
         self._router: Optional[Router] = None
 
     # Routers hold runtime state; rebuild lazily after pickling (handles are
@@ -205,20 +269,25 @@ class DeploymentHandle:
     def __getstate__(self):
         return {"deployment_name": self.deployment_name,
                 "_method_name": self._method_name,
-                "_stream": self._stream}
+                "_stream": self._stream,
+                "_multiplexed_model_id": self._multiplexed_model_id}
 
     def __setstate__(self, state):
         self.deployment_name = state["deployment_name"]
         self._method_name = state["_method_name"]
         self._stream = state.get("_stream", False)
+        self._multiplexed_model_id = state.get("_multiplexed_model_id", "")
         self._router = None
 
     def options(self, *, method_name: Optional[str] = None,
-                stream: Optional[bool] = None) -> "DeploymentHandle":
+                stream: Optional[bool] = None,
+                multiplexed_model_id: Optional[str] = None) -> "DeploymentHandle":
         h = DeploymentHandle(
             self.deployment_name,
             method_name if method_name is not None else self._method_name,
             stream if stream is not None else self._stream,
+            (multiplexed_model_id if multiplexed_model_id is not None
+             else self._multiplexed_model_id),
         )
         h._router = self._ensure_router()
         return h
@@ -248,4 +317,5 @@ class DeploymentHandle:
 
     def remote(self, *args, **kwargs):
         return self._ensure_router().assign(
-            self._method_name, args, kwargs, stream=self._stream)
+            self._method_name, args, kwargs, stream=self._stream,
+            multiplexed_model_id=self._multiplexed_model_id)

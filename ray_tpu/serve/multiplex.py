@@ -1,0 +1,85 @@
+"""Model multiplexing: many models share one deployment's replicas.
+
+Parity: reference serve/api.py @serve.multiplexed +
+serve.get_multiplexed_model_id (serve/_private/... model multiplex wrapper
+with per-replica LRU) and model-affinity routing. The loader is wrapped
+with a per-replica LRU cache; requests carry a model id, the router keeps
+per-model affinity (rendezvous hash over healthy replicas) so repeated
+requests for one model land where it is already loaded.
+"""
+from __future__ import annotations
+
+import contextvars
+import functools
+import threading
+from collections import OrderedDict
+from typing import Any, Callable, Optional
+
+_model_id_ctx: "contextvars.ContextVar[str]" = contextvars.ContextVar(
+    "rtpu_multiplexed_model_id", default="")
+
+
+def get_multiplexed_model_id() -> str:
+    """Inside a replica handling a multiplexed request: the model id the
+    caller asked for (reference serve.get_multiplexed_model_id)."""
+    return _model_id_ctx.get()
+
+
+def _set_model_id(model_id: str):
+    return _model_id_ctx.set(model_id or "")
+
+
+def multiplexed(func: Optional[Callable] = None, *,
+                max_num_models_per_replica: int = 3):
+    """Decorate a model-loader method: calls are cached per model id in a
+    per-replica LRU of size max_num_models_per_replica; evicted models are
+    dropped (their __del__ releases resources)."""
+
+    def wrap(loader: Callable) -> Callable:
+        # Cache + lock are created LAZILY in the replica process (stored on
+        # the instance, or in this module for free functions): the decorated
+        # class is cloudpickled to replicas, and a Lock captured in the
+        # closure would make it unpicklable.
+        state_attr = f"_rtpu_mux_{loader.__name__}"
+
+        def _state(owner):
+            st = getattr(owner, state_attr, None)
+            if st is None:
+                st = {"lock": threading.Lock(), "cache": OrderedDict()}
+                setattr(owner, state_attr, st)
+            return st
+
+        @functools.wraps(loader)
+        def wrapper(self_or_id=None, model_id: Optional[str] = None):
+            # Support both method (self, model_id?) and free-function forms.
+            if isinstance(self_or_id, str) and model_id is None:
+                bound_self, mid = None, self_or_id
+            else:
+                bound_self, mid = self_or_id, model_id
+            if mid is None:
+                mid = get_multiplexed_model_id()
+            if not mid:
+                raise ValueError(
+                    "no model id: pass one or call via "
+                    "handle.options(multiplexed_model_id=...)")
+            st = _state(bound_self if bound_self is not None else wrapper)
+            lock, cache = st["lock"], st["cache"]
+            with lock:
+                if mid in cache:
+                    cache.move_to_end(mid)
+                    return cache[mid]
+            model = loader(bound_self, mid) if bound_self is not None \
+                else loader(mid)
+            with lock:
+                cache[mid] = model
+                cache.move_to_end(mid)
+                while len(cache) > max_num_models_per_replica:
+                    cache.popitem(last=False)
+            return model
+
+        wrapper._rtpu_multiplexed = True  # noqa: SLF001
+        return wrapper
+
+    if func is not None:
+        return wrap(func)
+    return wrap

@@ -32,10 +32,14 @@ class ReplicaActor:
 
     # ---------------------------------------------------------------- serving
 
-    def handle_request(self, method_name: str, args: Tuple, kwargs: Dict):
+    def handle_request(self, method_name: str, args: Tuple, kwargs: Dict,
+                       multiplexed_model_id: str = ""):
+        from .multiplex import _set_model_id
+
         with self._lock:
             self._ongoing += 1
             self._total += 1
+        token = _set_model_id(multiplexed_model_id)
         try:
             if self._is_function:
                 return self._callable(*args, **kwargs)
@@ -43,18 +47,25 @@ class ReplicaActor:
                 return self._callable(*args, **kwargs)
             return getattr(self._callable, method_name)(*args, **kwargs)
         finally:
+            from .multiplex import _model_id_ctx
+
+            _model_id_ctx.reset(token)
             with self._lock:
                 self._ongoing -= 1
 
     def handle_request_streaming(self, method_name: str, args: Tuple,
-                                 kwargs: Dict):
+                                 kwargs: Dict,
+                                 multiplexed_model_id: str = ""):
         """Generator variant: the user handler returns a generator/iterable
         whose items stream to the caller one object at a time (reference:
         serve streaming responses over streaming generator returns,
         serve/_private/replica.py handle_request_streaming)."""
+        from .multiplex import _set_model_id
+
         with self._lock:
             self._ongoing += 1
             self._total += 1
+        _set_model_id(multiplexed_model_id)
         try:
             if self._is_function:
                 result = self._callable(*args, **kwargs)

@@ -4,6 +4,7 @@ from .backend_executor import BackendExecutor, TrainingFailedError, TrainingIter
 from .checkpoint import Checkpoint
 from .config import CheckpointConfig, FailureConfig, RunConfig, ScalingConfig
 from .data_parallel_trainer import DataParallelTrainer, JaxTrainer, Result
+from .predictor import BatchPredictor, JaxPredictor, Predictor
 from .session import (
     get_checkpoint,
     get_context,
@@ -27,7 +28,10 @@ from .worker_group import RayTrainWorker, WorkerGroup
 __all__ = [
     "Backend",
     "BackendExecutor",
+    "BatchPredictor",
     "Checkpoint",
+    "JaxPredictor",
+    "Predictor",
     "CheckpointConfig",
     "CheckpointManager",
     "DataParallelTrainer",

@@ -1,0 +1,119 @@
+"""Predictors: checkpoint -> batch inference, standalone or over a Dataset.
+
+Parity: reference python/ray/train/predictor.py (Predictor.from_checkpoint,
+predict) + batch_predictor.py (BatchPredictor.predict = map_batches with a
+class UDF over an actor pool). The TPU-native shape is BASELINE.json
+config 5: ViT-class batch inference on a TPU-device-aware actor pool — each
+pool actor reserves its chips via num_tpus and runs one jitted apply.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Union
+
+import numpy as np
+
+from .checkpoint import Checkpoint
+
+
+class Predictor:
+    """Base predictor: subclass and implement _predict_numpy."""
+
+    @classmethod
+    def from_checkpoint(cls, checkpoint: Checkpoint, **kwargs) -> "Predictor":
+        raise NotImplementedError
+
+    def predict(self, data: Union[Dict[str, np.ndarray], np.ndarray],
+                **kwargs) -> Union[Dict[str, np.ndarray], np.ndarray]:
+        single_col = not isinstance(data, dict)
+        batch = {"__value__": data} if single_col else data
+        out = self._predict_numpy(batch, **kwargs)
+        if single_col and isinstance(out, dict) and set(out) == {"__value__"}:
+            return out["__value__"]
+        return out
+
+    def _predict_numpy(self, batch: Dict[str, np.ndarray], **kwargs):
+        raise NotImplementedError
+
+
+class JaxPredictor(Predictor):
+    """Predictor over a jitted pure function: apply_fn(params, batch_array).
+
+    The checkpoint holds {"params": pytree}; `input_column` selects the
+    feature column, outputs land in `output_column`.
+    """
+
+    def __init__(self, apply_fn: Callable[[Any, Any], Any], params: Any,
+                 *, input_column: str = "__value__",
+                 output_column: str = "predictions"):
+        import jax
+
+        self._apply = jax.jit(apply_fn)
+        self._params = params
+        self._input_column = input_column
+        self._output_column = output_column
+
+    @classmethod
+    def from_checkpoint(cls, checkpoint: Checkpoint,
+                        apply_fn: Callable[[Any, Any], Any],
+                        **kwargs) -> "JaxPredictor":
+        state = checkpoint.to_dict()
+        params = state.get("params", state)
+        return cls(apply_fn, params, **kwargs)
+
+    def _predict_numpy(self, batch: Dict[str, np.ndarray], **kwargs):
+        col = self._input_column
+        if col not in batch:
+            if len(batch) == 1:
+                col = next(iter(batch))
+            else:
+                raise KeyError(
+                    f"input column {self._input_column!r} not in batch "
+                    f"columns {list(batch)}")
+        out = np.asarray(self._apply(self._params, batch[col]))
+        if self._input_column == "__value__" and col == "__value__":
+            return {"__value__": out}
+        return {**batch, self._output_column: out}
+
+
+class BatchPredictor:
+    """Scalable inference: predictor per pool actor, dataset.map_batches.
+
+    Parity: reference train/batch_predictor.py:125 (predict -> map_batches
+    with ActorPoolStrategy). `num_tpus_per_actor` reserves chips so the
+    data layer lands one actor per TPU host.
+    """
+
+    def __init__(self, checkpoint: Checkpoint, predictor_cls, **predictor_kwargs):
+        self._checkpoint = checkpoint
+        self._predictor_cls = predictor_cls
+        self._predictor_kwargs = predictor_kwargs
+
+    def predict(
+        self,
+        dataset,
+        *,
+        batch_size: int = 4096,
+        min_scoring_workers: int = 1,
+        max_scoring_workers: int = 1,
+        num_cpus_per_actor: Optional[float] = None,
+        num_tpus_per_actor: Optional[float] = None,
+        **predict_kwargs,
+    ):
+        ckpt = self._checkpoint
+        cls = self._predictor_cls
+        kw = self._predictor_kwargs
+
+        class _ScoringActor:
+            def __init__(self):
+                self.predictor = cls.from_checkpoint(ckpt, **kw)
+
+            def __call__(self, batch):
+                return self.predictor.predict(batch, **predict_kwargs)
+
+        return dataset.map_batches(
+            _ScoringActor,
+            batch_size=batch_size,
+            concurrency=(min_scoring_workers, max_scoring_workers),
+            num_cpus=num_cpus_per_actor,
+            num_tpus=num_tpus_per_actor,
+        )

@@ -1,1 +1,6 @@
-"""Utilities: scheduling strategies, accelerators, collectives, actor pools."""
+"""Utilities: scheduling strategies, accelerators, collectives, actor pools,
+distributed queue, multiprocessing/joblib shims, state API."""
+from .actor_pool import ActorPool
+from .queue import Empty, Full, Queue
+
+__all__ = ["ActorPool", "Queue", "Empty", "Full"]

@@ -1,0 +1,85 @@
+"""joblib backend: scikit-learn's Parallel(n_jobs=...) over cluster tasks.
+
+Parity: reference python/ray/util/joblib/ (register_ray + RayBackend over
+the task API). Usage:
+
+    from ray_tpu.util.joblib import register_ray
+    register_ray()
+    with joblib.parallel_backend("ray_tpu"):
+        Parallel(n_jobs=8)(delayed(f)(x) for x in xs)
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, List
+
+
+def register_ray() -> None:
+    import threading
+
+    from joblib._parallel_backends import ParallelBackendBase
+    from joblib.parallel import register_parallel_backend
+
+    import ray_tpu
+
+    class RayTpuBackend(ParallelBackendBase):
+        """Each joblib batch (a callable of pre-bound work items) runs as
+        one remote task; joblib's own batching amortizes task overhead.
+        joblib >=1.3 drives backends through submit(func, callback)."""
+
+        supports_timeout = True
+        supports_retrieve_callback = False
+        uses_threads = False
+        supports_sharedmem = False
+
+        def effective_n_jobs(self, n_jobs: int) -> int:
+            if not ray_tpu.is_initialized():
+                ray_tpu.init()
+            cpus = int(ray_tpu.cluster_resources().get("CPU", 1))
+            if n_jobs == -1 or n_jobs is None:
+                return cpus
+            return max(1, min(n_jobs, cpus))
+
+        def configure(self, n_jobs: int = 1, parallel=None, **kwargs: Any) -> int:
+            if not ray_tpu.is_initialized():
+                ray_tpu.init()
+            self.parallel = parallel
+            return self.effective_n_jobs(n_jobs)
+
+        def submit(self, func: Callable[[], List[Any]], callback=None):
+            @ray_tpu.remote
+            def run_batch(f):
+                return f()
+
+            ref = run_batch.remote(func)
+
+            class _Future:
+                def get(self, timeout=None):
+                    return ray_tpu.get(ref, timeout=timeout)
+
+            fut = _Future()
+            if callback is not None:
+                # joblib schedules follow-up batches from the callback;
+                # fire it when the task actually completes.
+                def _notify():
+                    try:
+                        ray_tpu.wait([ref], num_returns=1)
+                    except Exception:
+                        pass
+                    callback(fut)
+
+                threading.Thread(target=_notify, daemon=True).start()
+            return fut
+
+        # Legacy alias (joblib <1.3 calls apply_async).
+        apply_async = submit
+
+        def terminate(self) -> None:
+            pass
+
+        def abort_everything(self, ensure_ready: bool = True) -> None:
+            if ensure_ready:
+                self.configure(
+                    n_jobs=getattr(self.parallel, "n_jobs", 1),
+                    parallel=self.parallel)
+
+    register_parallel_backend("ray_tpu", RayTpuBackend)

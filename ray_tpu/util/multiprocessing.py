@@ -1,0 +1,129 @@
+"""multiprocessing.Pool API over the task plane.
+
+Parity: reference python/ray/util/multiprocessing/pool.py (Pool with map/
+starmap/imap/imap_unordered/apply/apply_async over remote tasks).
+"""
+from __future__ import annotations
+
+import itertools
+from typing import Any, Callable, Iterable, List, Optional
+
+import ray_tpu
+
+
+class AsyncResult:
+    def __init__(self, refs, single: bool):
+        self._refs = refs
+        self._single = single
+
+    def get(self, timeout: Optional[float] = None):
+        out = ray_tpu.get(self._refs, timeout=timeout)
+        return out[0] if self._single else out
+
+    def wait(self, timeout: Optional[float] = None) -> None:
+        ray_tpu.wait(self._refs, num_returns=len(self._refs), timeout=timeout)
+
+    def ready(self) -> bool:
+        ready, _ = ray_tpu.wait(self._refs, num_returns=len(self._refs),
+                                timeout=0)
+        return len(ready) == len(self._refs)
+
+    def successful(self) -> bool:
+        try:
+            self.get(timeout=0.001)
+            return True
+        except Exception:
+            return False
+
+
+class Pool:
+    """Process-pool semantics over cluster tasks: `processes` bounds
+    in-flight tasks (the cluster's CPUs are the real pool)."""
+
+    def __init__(self, processes: Optional[int] = None, initializer=None,
+                 initargs=(), **_ignored):
+        if not ray_tpu.is_initialized():
+            ray_tpu.init()
+        self._processes = processes or int(
+            ray_tpu.cluster_resources().get("CPU", 1))
+        self._initializer = initializer
+        self._initargs = tuple(initargs)
+        self._closed = False
+
+    def _wrap(self, func):
+        init, initargs = self._initializer, self._initargs
+
+        @ray_tpu.remote
+        def call(*args):
+            if init is not None and not getattr(call, "_did_init", False):
+                init(*initargs)
+                call._did_init = True  # noqa: SLF001 — per-worker marker
+            return func(*args)
+
+        return call
+
+    def _chunked_submit(self, func, iterables) -> List[Any]:
+        if self._closed:
+            raise ValueError("Pool not running")
+        call = self._wrap(func)
+        refs: List[Any] = []
+        window: List[Any] = []
+        for args in iterables:
+            if len(window) >= self._processes:
+                _, window = ray_tpu.wait(window, num_returns=1)
+            ref = call.remote(*args)
+            refs.append(ref)
+            window.append(ref)
+        return refs
+
+    # ------------------------------------------------------------------ api
+
+    def apply(self, func, args=(), kwds=None):
+        return self.apply_async(func, args, kwds).get()
+
+    def apply_async(self, func, args=(), kwds=None) -> AsyncResult:
+        if kwds:
+            bound = lambda *a: func(*a, **kwds)  # noqa: E731
+        else:
+            bound = func
+        refs = self._chunked_submit(bound, [tuple(args)])
+        return AsyncResult(refs, single=True)
+
+    def map(self, func, iterable) -> List[Any]:
+        return self.map_async(func, iterable).get()
+
+    def map_async(self, func, iterable) -> AsyncResult:
+        refs = self._chunked_submit(func, ((x,) for x in iterable))
+        return AsyncResult(refs, single=False)
+
+    def starmap(self, func, iterable) -> List[Any]:
+        refs = self._chunked_submit(func, (tuple(a) for a in iterable))
+        return AsyncResult(refs, single=False).get()
+
+    def imap(self, func, iterable) -> Iterable[Any]:
+        refs = self._chunked_submit(func, ((x,) for x in iterable))
+        for ref in refs:
+            yield ray_tpu.get(ref)
+
+    def imap_unordered(self, func, iterable) -> Iterable[Any]:
+        refs = self._chunked_submit(func, ((x,) for x in iterable))
+        pending = list(refs)
+        while pending:
+            ready, pending = ray_tpu.wait(pending, num_returns=1)
+            yield ray_tpu.get(ready[0])
+
+    def close(self) -> None:
+        self._closed = True
+
+    def terminate(self) -> None:
+        self._closed = True
+
+    def join(self) -> None:
+        if not self._closed:
+            raise ValueError("Pool is still running")
+
+    def __enter__(self) -> "Pool":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.terminate()

@@ -1,0 +1,138 @@
+"""Distributed FIFO queue backed by an (async) actor.
+
+Parity: reference python/ray/util/queue.py (Queue over an asyncio actor —
+put/get with block/timeout, qsize/empty/full, put_nowait/get_nowait,
+batch variants). The backing actor uses async methods so blocked getters
+don't occupy mailbox threads.
+"""
+from __future__ import annotations
+
+import asyncio
+from typing import Any, List, Optional
+
+import ray_tpu
+
+
+class Empty(Exception):
+    pass
+
+
+class Full(Exception):
+    pass
+
+
+class _QueueActor:
+    def __init__(self, maxsize: int = 0):
+        self.q: "asyncio.Queue" = asyncio.Queue(maxsize=maxsize)
+
+    async def put(self, item, timeout: Optional[float] = None) -> bool:
+        try:
+            if timeout is None:
+                await self.q.put(item)
+            else:
+                await asyncio.wait_for(self.q.put(item), timeout)
+            return True
+        except asyncio.TimeoutError:
+            return False
+
+    async def get(self, timeout: Optional[float] = None):
+        try:
+            if timeout is None:
+                return True, await self.q.get()
+            return True, await asyncio.wait_for(self.q.get(), timeout)
+        except asyncio.TimeoutError:
+            return False, None
+
+    def put_nowait(self, item) -> bool:
+        try:
+            self.q.put_nowait(item)
+            return True
+        except asyncio.QueueFull:
+            return False
+
+    def get_nowait(self):
+        try:
+            return True, self.q.get_nowait()
+        except asyncio.QueueEmpty:
+            return False, None
+
+    def put_batch_nowait(self, items) -> bool:
+        """All-or-nothing (reference Queue.put_nowait_batch semantics)."""
+        if self.q.maxsize and self.q.qsize() + len(items) > self.q.maxsize:
+            return False
+        for item in items:
+            self.q.put_nowait(item)
+        return True
+
+    def get_batch_nowait(self, n: int):
+        """All-or-nothing: never consumes on failure."""
+        if self.q.qsize() < n:
+            return False, None
+        return True, [self.q.get_nowait() for _ in range(n)]
+
+    def qsize(self) -> int:
+        return self.q.qsize()
+
+    def maxsize(self) -> int:
+        return self.q.maxsize
+
+
+class Queue:
+    """Driver/worker-shared FIFO queue. Handles pickle freely: every copy
+    talks to the same backing actor."""
+
+    def __init__(self, maxsize: int = 0, *, actor_options: Optional[dict] = None):
+        opts = dict(actor_options or {})
+        opts.setdefault("num_cpus", 0)
+        self.maxsize = maxsize
+        self.actor = ray_tpu.remote(_QueueActor).options(**opts).remote(maxsize)
+
+    def put(self, item: Any, block: bool = True, timeout: Optional[float] = None) -> None:
+        if not block:
+            if not ray_tpu.get(self.actor.put_nowait.remote(item)):
+                raise Full
+            return
+        ok = ray_tpu.get(self.actor.put.remote(item, timeout))
+        if not ok:
+            raise Full
+
+    def get(self, block: bool = True, timeout: Optional[float] = None) -> Any:
+        if not block:
+            ok, item = ray_tpu.get(self.actor.get_nowait.remote())
+            if not ok:
+                raise Empty
+            return item
+        ok, item = ray_tpu.get(self.actor.get.remote(timeout))
+        if not ok:
+            raise Empty
+        return item
+
+    def put_nowait(self, item: Any) -> None:
+        self.put(item, block=False)
+
+    def get_nowait(self) -> Any:
+        return self.get(block=False)
+
+    def put_nowait_batch(self, items: List[Any]) -> None:
+        """Atomic: raises Full without inserting anything on overflow."""
+        if not ray_tpu.get(self.actor.put_batch_nowait.remote(list(items))):
+            raise Full
+
+    def get_nowait_batch(self, num_items: int) -> List[Any]:
+        """Atomic: raises Empty without consuming when fewer items exist."""
+        ok, items = ray_tpu.get(self.actor.get_batch_nowait.remote(num_items))
+        if not ok:
+            raise Empty
+        return items
+
+    def qsize(self) -> int:
+        return ray_tpu.get(self.actor.qsize.remote())
+
+    def empty(self) -> bool:
+        return self.qsize() == 0
+
+    def full(self) -> bool:
+        return self.maxsize > 0 and self.qsize() >= self.maxsize
+
+    def shutdown(self) -> None:
+        ray_tpu.kill(self.actor)

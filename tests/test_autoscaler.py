@@ -1,0 +1,50 @@
+"""Autoscaler: scale up on unsatisfied demand, scale down on idle timeout
+(reference: autoscaler/_private/autoscaler.py StandardAutoscaler.update).
+Own module: owns its cluster so node counts are deterministic."""
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.autoscaler import Autoscaler, AutoscalerConfig, LocalNodeProvider
+
+
+@pytest.fixture()
+def head_only_cluster():
+    handle = ray_tpu.init(num_cpus=1)
+    yield handle
+    ray_tpu.shutdown()
+
+
+def test_scale_up_then_down(head_only_cluster):
+    provider = LocalNodeProvider(head_only_cluster.address,
+                                 worker_resources={"CPU": 2})
+    scaler = Autoscaler(provider, AutoscalerConfig(
+        min_workers=0, max_workers=2, idle_timeout_s=3.0,
+        update_interval_s=0.5, worker_resources={"CPU": 2}))
+    try:
+        @ray_tpu.remote(num_cpus=2)
+        def heavy(x):
+            time.sleep(1.0)
+            return x * 2
+
+        # Head has 1 CPU: these 2-CPU tasks are unplaceable without growth.
+        refs = [heavy.remote(i) for i in range(4)]
+        scaler.start()
+        out = ray_tpu.get(refs, timeout=120)
+        assert out == [0, 2, 4, 6]
+        assert len(provider.non_terminated_nodes()) >= 1
+
+        # Idle: the autoscaled nodes terminate after the timeout.
+        deadline = time.monotonic() + 40
+        while time.monotonic() < deadline:
+            if not provider.non_terminated_nodes():
+                break
+            time.sleep(0.5)
+        assert not provider.non_terminated_nodes(), "idle nodes not reaped"
+        nodes_alive = [n for n in ray_tpu.nodes()
+                       if n["alive"] and n["labels"].get("autoscaled")]
+        assert not nodes_alive
+    finally:
+        scaler.stop()
+        provider.shutdown()

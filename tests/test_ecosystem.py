@@ -1,0 +1,94 @@
+"""Ecosystem shims: ActorPool, distributed Queue, multiprocessing.Pool,
+joblib backend (reference: python/ray/util/actor_pool.py, util/queue.py,
+util/multiprocessing/, util/joblib/)."""
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util import ActorPool, Empty, Queue
+
+
+@ray_tpu.remote
+class Doubler:
+    def double(self, x):
+        return x * 2
+
+
+def test_actor_pool_map_ordered(ray_start_regular):
+    pool = ActorPool([Doubler.remote() for _ in range(2)])
+    out = list(pool.map(lambda a, v: a.double.remote(v), range(8)))
+    assert out == [0, 2, 4, 6, 8, 10, 12, 14]
+
+
+def test_actor_pool_map_unordered(ray_start_regular):
+    pool = ActorPool([Doubler.remote() for _ in range(2)])
+    out = list(pool.map_unordered(lambda a, v: a.double.remote(v), range(8)))
+    assert sorted(out) == [0, 2, 4, 6, 8, 10, 12, 14]
+
+
+def test_actor_pool_submit_get_next(ray_start_regular):
+    pool = ActorPool([Doubler.remote()])
+    pool.submit(lambda a, v: a.double.remote(v), 10)
+    pool.submit(lambda a, v: a.double.remote(v), 20)
+    assert pool.has_next()
+    assert pool.get_next() == 20
+    assert pool.get_next() == 40
+    assert not pool.has_next()
+
+
+def test_queue_roundtrip(ray_start_regular):
+    q = Queue(maxsize=4)
+    q.put("a")
+    q.put("b")
+    assert q.qsize() == 2
+    assert q.get() == "a"
+    assert q.get() == "b"
+    with pytest.raises(Empty):
+        q.get_nowait()
+    q.shutdown()
+
+
+def test_queue_cross_task(ray_start_regular):
+    q = Queue()
+
+    @ray_tpu.remote
+    def producer(q, n):
+        for i in range(n):
+            q.put(i)
+        return "done"
+
+    ref = producer.remote(q, 5)
+    got = [q.get(timeout=20) for _ in range(5)]
+    assert got == list(range(5))
+    assert ray_tpu.get(ref) == "done"
+    q.shutdown()
+
+
+def test_multiprocessing_pool(ray_start_regular):
+    from ray_tpu.util.multiprocessing import Pool
+
+    def sq(x):
+        return x * x
+
+    with Pool(processes=2) as p:
+        assert p.map(sq, range(6)) == [0, 1, 4, 9, 16, 25]
+        assert sorted(p.imap_unordered(sq, [2, 3])) == [4, 9]
+        assert p.apply(sq, (7,)) == 49
+        assert p.starmap(lambda a, b: a + b, [(1, 2), (3, 4)]) == [3, 7]
+
+
+def test_joblib_backend(ray_start_regular):
+    import joblib
+
+    from ray_tpu.util.joblib import register_ray
+
+    register_ray()
+
+    def cube(x):
+        return x ** 3
+
+    with joblib.parallel_backend("ray_tpu"):
+        out = joblib.Parallel(n_jobs=2)(
+            joblib.delayed(cube)(i) for i in range(5))
+    assert out == [0, 1, 8, 27, 64]

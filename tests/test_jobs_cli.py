@@ -1,0 +1,73 @@
+"""Job submission + operator CLI (reference: dashboard/modules/job/
+job_manager.py supervisor-actor jobs; scripts/scripts.py `ray start/stop/
+status`)."""
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.jobs import JobStatus, JobSubmissionClient
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_job_submit_and_logs(ray_start_regular):
+    client = JobSubmissionClient()
+    job_id = client.submit_job(
+        entrypoint=f"{sys.executable} -c \"print('hello from job 42')\"")
+    status = client.wait_until_finished(job_id, timeout=120)
+    assert status == JobStatus.SUCCEEDED
+    assert "hello from job 42" in client.get_job_logs(job_id)
+    assert any(d.job_id == job_id for d in client.list_jobs())
+
+
+def test_job_failure_reported(ray_start_regular):
+    client = JobSubmissionClient()
+    job_id = client.submit_job(
+        entrypoint=f"{sys.executable} -c \"import sys; sys.exit(3)\"")
+    status = client.wait_until_finished(job_id, timeout=120)
+    assert status == JobStatus.FAILED
+    assert client.get_job_info(job_id).returncode == 3
+
+
+def test_job_connects_to_cluster(ray_start_regular):
+    """The entrypoint inherits RTPU_ADDRESS and can drive the SAME cluster."""
+    client = JobSubmissionClient()
+    script = (
+        "import ray_tpu; ray_tpu.init(); "
+        "print('cluster cpus:', ray_tpu.cluster_resources().get('CPU'))"
+    )
+    job_id = client.submit_job(entrypoint=f"{sys.executable} -c \"{script}\"")
+    assert client.wait_until_finished(job_id, timeout=180) == JobStatus.SUCCEEDED
+    assert "cluster cpus:" in client.get_job_logs(job_id)
+
+
+def test_cli_head_status_stop(tmp_path):
+    """`start --head` + `status` + `stop` round-trip as real processes."""
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    head = subprocess.Popen(
+        [sys.executable, "-m", "ray_tpu.cli", "start", "--head",
+         "--num-cpus", "2"],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+    try:
+        addrfile = "/tmp/rtpu_head.addr"
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline and not os.path.exists(addrfile):
+            time.sleep(0.2)
+        assert os.path.exists(addrfile), "head never wrote its address"
+        out = subprocess.run(
+            [sys.executable, "-m", "ray_tpu.cli", "status"],
+            env=env, capture_output=True, text=True, timeout=60)
+        assert out.returncode == 0, out.stdout + out.stderr
+        state = json.loads(out.stdout)
+        assert state["nodes"][0]["resources"]["CPU"] == 2.0
+    finally:
+        subprocess.run(
+            [sys.executable, "-m", "ray_tpu.cli", "stop"],
+            env=env, capture_output=True, text=True, timeout=30)
+        head.wait(timeout=20)

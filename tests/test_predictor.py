@@ -1,0 +1,48 @@
+"""Predictor / BatchPredictor batch inference (reference:
+python/ray/train/predictor.py + batch_predictor.py; BASELINE config 5 —
+batch inference over a device-aware actor pool)."""
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import data as rtd
+from ray_tpu.train import BatchPredictor, Checkpoint, JaxPredictor
+
+
+def _apply(params, x):
+    import jax.numpy as jnp
+
+    h = jnp.maximum(x @ params["w1"], 0.0)
+    return h @ params["w2"]
+
+
+def _ckpt():
+    rng = np.random.default_rng(0)
+    return Checkpoint.from_dict({"params": {
+        "w1": rng.standard_normal((8, 16)).astype(np.float32),
+        "w2": rng.standard_normal((16, 2)).astype(np.float32),
+    }})
+
+
+def test_jax_predictor_direct(ray_start_regular):
+    pred = JaxPredictor.from_checkpoint(_ckpt(), _apply)
+    x = np.random.default_rng(1).standard_normal((32, 8)).astype(np.float32)
+    out = pred.predict(x)
+    assert out.shape == (32, 2)
+    params = _ckpt().to_dict()["params"]
+    expect = np.maximum(x @ params["w1"], 0) @ params["w2"]
+    np.testing.assert_allclose(out, expect, rtol=1e-4)
+
+
+def test_batch_predictor_over_dataset(ray_start_regular):
+    bp = BatchPredictor(_ckpt(), JaxPredictor, apply_fn=_apply,
+                        input_column="data")
+    x = np.random.default_rng(2).standard_normal((64, 8)).astype(np.float32)
+    ds = rtd.from_numpy(x)
+    scored = bp.predict(ds, batch_size=16, max_scoring_workers=2)
+    rows = scored.take_all()
+    assert len(rows) == 64
+    preds = np.stack([r["predictions"] for r in rows])
+    params = _ckpt().to_dict()["params"]
+    expect = np.maximum(x @ params["w1"], 0) @ params["w2"]
+    np.testing.assert_allclose(preds, expect, rtol=1e-3, atol=1e-4)

@@ -321,3 +321,85 @@ def test_ppo_checkpoint_roundtrip(tmp_path):
 
     jax.tree.map(lambda a, b: np.testing.assert_allclose(a, b), w1, w2)
     algo2.stop()
+
+
+def test_dqn_trains_cartpole(ray_start_regular):
+    """DQN mechanics: buffer fills, epsilon decays, TD updates run with a
+    periodically synced target network, and the policy improves enough to
+    beat a random policy on CartPole."""
+    from ray_tpu.rllib.algorithms.dqn import DQNConfig
+
+    config = (
+        DQNConfig()
+        .environment("CartPole-v1")
+        .env_runners(num_env_runners=0, num_envs_per_env_runner=8,
+                     rollout_fragment_length=64)
+        .training(train_batch_size=512, minibatch_size=128, lr=1e-3)
+    )
+    config.learning_starts = 300
+    config.epsilon_timesteps = 2500
+    config.num_td_updates_per_iter = 48
+    config.target_network_update_freq = 250
+    algo = config.build()
+    first = algo.train()
+    assert first["buffer_size"] >= 300 or first["epsilon"] > 0.9
+    qs, returns = [], []
+    r = first
+    for _ in range(15):
+        r = algo.train()
+        returns.append(r["episode_return_mean"])
+        if "mean_q" in r:
+            qs.append(r["mean_q"])
+    assert r["epsilon"] < 0.2  # schedule decayed
+    assert r["buffer_size"] > 2000
+    assert "td_loss" in r and np.isfinite(r["td_loss"])
+    # Value learning is underway: Q estimates grow from ~0 toward the
+    # discounted-return scale (full CartPole convergence needs ~50k steps —
+    # too slow for CI; PPO's test covers end-to-end learning).
+    assert qs and qs[-1] > qs[0] + 3.0, qs
+    assert returns[-1] > 10, returns
+    algo.stop()
+
+
+def test_connector_pipeline_ppo(ray_start_regular):
+    """env-to-module connectors transform observations identically in
+    sampling and learning (reference ConnectorV2 pipelines): PPO still
+    learns CartPole through a FrameStack+Flatten pipeline."""
+    from ray_tpu.rllib.connectors import (ConnectorPipeline, FlattenObs,
+                                          FrameStack)
+
+    def make_pipeline():
+        return ConnectorPipeline([FrameStack(k=2), FlattenObs()])
+
+    config = (
+        PPOConfig()
+        .environment("CartPole-v1")
+        .env_runners(num_env_runners=0, num_envs_per_env_runner=8,
+                     rollout_fragment_length=128,
+                     env_to_module_connector=make_pipeline)
+        .training(train_batch_size=1024, minibatch_size=256, num_epochs=4,
+                  lr=3e-4)
+    )
+    algo = config.build()
+    first = algo.train()
+    returns = [algo.train()["episode_return_mean"] for _ in range(8)]
+    assert max(returns) > first["episode_return_mean"] + 10, (
+        first["episode_return_mean"], returns)
+    algo.stop()
+
+
+def test_connector_shapes():
+    import numpy as np
+
+    from ray_tpu.rllib.connectors import (ConnectorPipeline, FlattenObs,
+                                          FrameStack, NormalizeObs)
+
+    pipe = ConnectorPipeline([FrameStack(k=3), FlattenObs()])
+    obs = np.ones((2, 4), np.float32)
+    out = pipe(obs)
+    assert out.shape == (2, 12)
+    assert pipe.output_shape((4,)) == (12,)
+    norm = NormalizeObs()
+    x = np.random.default_rng(0).standard_normal((64, 4)).astype(np.float32) * 5
+    y = norm(x)
+    assert y.shape == x.shape and np.isfinite(y).all()

@@ -82,3 +82,34 @@ def test_pg_resources_released_on_remove(three_nodes):
     ray_tpu.remove_placement_group(pg)
     after = ray_tpu.available_resources()["CPU"]
     assert after == before
+
+
+def test_pg_bundle_index_any_spreads(ray_start_cluster):
+    """bundle_index=-1 means ANY bundle (reference semantics): tasks fill
+    whichever bundle has room instead of all packing into bundle 0."""
+    import time
+
+    import ray_tpu
+    from ray_tpu.util.scheduling_strategies import (
+        PlacementGroupSchedulingStrategy)
+
+    cluster = ray_start_cluster
+    cluster.add_node({"CPU": 2})
+    pg = ray_tpu.placement_group([{"CPU": 1}, {"CPU": 1}], strategy="PACK")
+    assert pg.ready(timeout=30)
+
+    @ray_tpu.remote(
+        num_cpus=1,
+        scheduling_strategy=PlacementGroupSchedulingStrategy(
+            placement_group=pg, placement_group_bundle_index=-1))
+    def hold(t):
+        time.sleep(t)
+        return ray_tpu.get_runtime_context().get_node_id()
+
+    # Two concurrent 1-CPU tasks: bundle 0 alone cannot host both; with
+    # any-bundle semantics the second lands in bundle 1 and they overlap.
+    t0 = time.monotonic()
+    out = ray_tpu.get([hold.remote(1.0), hold.remote(1.0)], timeout=60)
+    wall = time.monotonic() - t0
+    assert wall < 1.9, f"tasks serialized ({wall:.1f}s): -1 pinned to bundle 0"
+    ray_tpu.remove_placement_group(pg)

@@ -206,3 +206,36 @@ def test_streaming_http_chunked(serve_instance):
     with urllib.request.urlopen(req, timeout=30) as resp:
         body = resp.read().decode()
     assert body == "chunk0\nchunk1\nchunk2\n"
+
+
+def test_model_multiplexing(serve_instance):
+    """@serve.multiplexed LRU-caches models per replica; requests carry the
+    model id and route with per-model affinity (reference: serve model
+    multiplexing)."""
+
+    @serve.deployment(num_replicas=2)
+    class MultiModel:
+        def __init__(self):
+            self.loads = []
+
+        @serve.multiplexed(max_num_models_per_replica=2)
+        def get_model(self, model_id: str):
+            self.loads.append(model_id)
+            return {"id": model_id, "scale": int(model_id.split("-")[1])}
+
+        def __call__(self, x):
+            mid = serve.get_multiplexed_model_id()
+            model = self.get_model(mid)
+            return {"model": model["id"], "y": x * model["scale"],
+                    "loads": len(self.loads)}
+
+    handle = serve.run(MultiModel.bind(), route_prefix="/multi")
+    r1 = handle.options(multiplexed_model_id="m-3").remote(10).result(timeout=30)
+    assert r1 == {"model": "m-3", "y": 30, "loads": 1}
+    # Same model again: cache hit on the SAME replica (affinity), no reload.
+    r2 = handle.options(multiplexed_model_id="m-3").remote(7).result(timeout=30)
+    assert r2["model"] == "m-3" and r2["y"] == 21
+    assert r2["loads"] == 1, "model reloaded despite LRU + affinity"
+    # A different model loads independently.
+    r3 = handle.options(multiplexed_model_id="m-5").remote(2).result(timeout=30)
+    assert r3["model"] == "m-5" and r3["y"] == 10
