@@ -85,8 +85,9 @@ if __name__ == "__main__":
             print(json.dumps({"check": "flash_hlo", "error": str(e)[:200]}), flush=True)
 
     variants = [
-        (True, "dots", 10, 1024),
-        (True, "dots", 12, 1024),
+        (True, "half_full", 8, 1024),
+        (True, "half_dots", 8, 1024),
+        (True, "half_full", 12, 1024),
     ]
     for remat, policy, batch, seq in variants:
         try:

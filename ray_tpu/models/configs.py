@@ -91,3 +91,22 @@ def bench_350m(**overrides) -> TransformerConfig:
     )
     kw.update(overrides)
     return TransformerConfig(**kw)
+
+
+def moe_tiny(**overrides) -> TransformerConfig:
+    """Tiny mixture-of-experts decoder for CPU tests and EP dry-runs."""
+    kw = dict(
+        vocab_size=512,
+        d_model=128,
+        n_layers=2,
+        n_heads=4,
+        max_seq_len=128,
+        norm="rmsnorm",
+        activation="swiglu",
+        positional="rope",
+        tie_embeddings=True,
+        moe_num_experts=4,
+        moe_experts_per_token=2,
+    )
+    kw.update(overrides)
+    return TransformerConfig(**kw)

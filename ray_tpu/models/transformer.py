@@ -62,6 +62,12 @@ class TransformerConfig:
     # larger live sets "min"/no-remat produce at bench shapes; "dots" is the
     # fastest policy that reliably compiles there (benchmarks/mfu_sweep.py).
     remat_policy: str = "dots"
+    # Mixture-of-Experts MLP (ops/moe.py, GShard capacity-based top-k):
+    # 0 = dense. The expert dim shards over the `expert` mesh axis.
+    moe_num_experts: int = 0
+    moe_experts_per_token: int = 2
+    moe_capacity_factor: float = 1.25
+    moe_aux_coef: float = 0.01
 
     @property
     def kv_heads(self) -> int:
@@ -85,7 +91,9 @@ class TransformerConfig:
         d, L, V = self.d_model, self.n_layers, self.vocab_size
         h = self.head_dim
         attn = d * (self.n_heads * h) + 2 * d * (self.kv_heads * h) + (self.n_heads * h) * d
-        if self.activation == "swiglu":
+        if self.moe_num_experts:
+            mlp = self.moe_num_experts * 3 * d * self.ff_dim + d * self.moe_num_experts
+        elif self.activation == "swiglu":
             mlp = 3 * d * self.ff_dim
         else:
             mlp = 2 * d * self.ff_dim
@@ -96,10 +104,22 @@ class TransformerConfig:
         pos = 0 if self.positional == "rope" else self.max_seq_len * d
         return L * (attn + mlp) + norms + emb + pos
 
+    def num_active_params(self) -> int:
+        """Params touched per token: for MoE, only experts_per_token of the
+        E experts execute, so compute-oriented uses (FLOPs/MFU) must not
+        count the full expert bank."""
+        if not self.moe_num_experts:
+            return self.num_params()
+        d, L, F = self.d_model, self.n_layers, self.ff_dim
+        full_mlp = self.moe_num_experts * 3 * d * F
+        active_mlp = self.moe_experts_per_token * 3 * d * F
+        return self.num_params() - L * (full_mlp - active_mlp)
+
     def flops_per_token(self, seq_len: Optional[int] = None) -> float:
-        """Forward+backward FLOPs/token ≈ 6*N + 12*L*S*d_head*n_heads (attn)."""
+        """Forward+backward FLOPs/token ≈ 6*N_active + 12*L*S*d (attn)."""
         S = seq_len or self.max_seq_len
-        return 6.0 * self.num_params() + 12.0 * self.n_layers * S * self.d_model
+        return (6.0 * self.num_active_params()
+                + 12.0 * self.n_layers * S * self.d_model)
 
 
 def _dense_init(key, shape, param_dtype, scale: Optional[float] = None):
@@ -127,15 +147,30 @@ def init_params(key: jax.Array, cfg: TransformerConfig) -> Params:
         "wo": stack(lambda k, s, pd: _dense_init(k, s, pd, scale=1.0 / math.sqrt(2 * L * s[0])),
                     (H * hd, d), keys[3]),
         "mlp_norm": jnp.ones((L, d), cfg.param_dtype),
-        "w_down": stack(lambda k, s, pd: _dense_init(k, s, pd, scale=1.0 / math.sqrt(2 * L * s[0])),
-                        (F, d), keys[5]),
     }
+    if not cfg.moe_num_experts:
+        layers["w_down"] = stack(
+            lambda k, s, pd: _dense_init(k, s, pd,
+                                         scale=1.0 / math.sqrt(2 * L * s[0])),
+            (F, d), keys[5])
     if KVH == H:
         layers["wqkv"] = stack(_dense_init, (d, 3, H, hd), keys[0])
     else:
         layers["wq"] = stack(_dense_init, (d, H, hd), keys[0])
         layers["wkv"] = stack(_dense_init, (d, 2, KVH, hd), keys[1])
-    if cfg.activation == "swiglu":
+    if cfg.moe_num_experts:
+        E = cfg.moe_num_experts
+        layers["router"] = stack(_dense_init, (d, E), keys[6])
+        # Explicit scales: _dense_init's shape[0] fan-in heuristic would read
+        # E (the expert dim) instead of the real matmul fan-ins d and F.
+        layers["moe_w_gate_up"] = stack(
+            lambda k, s, pd: _dense_init(k, s, pd, scale=1.0 / math.sqrt(d)),
+            (E, d, 2, F), keys[4])
+        layers["moe_w_down"] = stack(
+            lambda k, s, pd: _dense_init(k, s, pd,
+                                         scale=1.0 / math.sqrt(2 * L * F)),
+            (E, F, d), keys[5])
+    elif cfg.activation == "swiglu":
         layers["w_gate_up"] = stack(_dense_init, (d, 2, F), keys[4])
     else:
         layers["w_up"] = stack(_dense_init, (d, F), keys[4])
@@ -169,14 +204,19 @@ def param_logical_specs(cfg: TransformerConfig) -> Params:
         "attn_norm": ("layers", None),
         "wo": ("layers", "heads", "embed"),
         "mlp_norm": ("layers", None),
-        "w_down": ("layers", "mlp", "embed"),
     }
+    if not cfg.moe_num_experts:
+        layers["w_down"] = ("layers", "mlp", "embed")
     if cfg.kv_heads == cfg.n_heads:
         layers["wqkv"] = ("layers", "embed", None, "heads", None)
     else:
         layers["wq"] = ("layers", "embed", "heads", None)
         layers["wkv"] = ("layers", "embed", None, "kv_heads", None)
-    if cfg.activation == "swiglu":
+    if cfg.moe_num_experts:
+        layers["router"] = ("layers", "embed", None)
+        layers["moe_w_gate_up"] = ("layers", "expert", "embed", None, "mlp")
+        layers["moe_w_down"] = ("layers", "expert", "mlp", "embed")
+    elif cfg.activation == "swiglu":
         layers["w_gate_up"] = ("layers", "embed", None, "mlp")
     else:
         layers["w_up"] = ("layers", "embed", "mlp")
@@ -247,17 +287,28 @@ def _layer_body(cfg: TransformerConfig, x: jax.Array, layer: Params, positions: 
     x = maybe_constrain(x, ("batch", "seq_act", "embed"))
 
     h = _norm(x, layer["mlp_norm"], layer.get("mlp_norm_b"), cfg.norm)
-    if cfg.activation == "swiglu":
+    aux = jnp.zeros((), jnp.float32)
+    if cfg.moe_num_experts:
+        from ray_tpu.ops.moe import moe_ffn
+
+        moe_out, aux = moe_ffn(
+            h, layer["router"], layer["moe_w_gate_up"], layer["moe_w_down"],
+            experts_per_token=cfg.moe_experts_per_token,
+            capacity_factor=cfg.moe_capacity_factor,
+            dtype=cfg.dtype)
+        x = x + moe_out
+    elif cfg.activation == "swiglu":
         gu = jnp.einsum("bsd,dcf->bscf", h, layer["w_gate_up"].astype(cfg.dtype))
         gu = checkpoint_name(gu, "gate_up")
         act = jax.nn.silu(gu[:, :, 0]) * gu[:, :, 1]
+        x = x + act @ layer["w_down"].astype(cfg.dtype)
     else:
         act = checkpoint_name(
             h @ layer["w_up"].astype(cfg.dtype), "gate_up")
         act = jax.nn.gelu(act)
-    x = x + act @ layer["w_down"].astype(cfg.dtype)
+        x = x + act @ layer["w_down"].astype(cfg.dtype)
     x = maybe_constrain(x, ("batch", "seq_act", "embed"))
-    return x
+    return x, aux
 
 
 def embed_tokens(params: Params, tokens: jax.Array, cfg: TransformerConfig) -> jax.Array:
@@ -280,8 +331,9 @@ def embed_tokens(params: Params, tokens: jax.Array, cfg: TransformerConfig) -> j
 
 def layer_scan_body(cfg: TransformerConfig, positions: jax.Array):
     """The (remat-wrapped) per-layer scan body; shared by the plain forward
-    and the pipeline-parallel stage apply (parallel/pipeline.py)."""
-    body = lambda carry, layer: (_layer_body(cfg, carry, layer, positions), None)
+    and the pipeline-parallel stage apply (parallel/pipeline.py). The scan's
+    per-layer output is the MoE aux loss (zeros for dense layers)."""
+    body = lambda carry, layer: _layer_body(cfg, carry, layer, positions)
     if cfg.remat:
         if cfg.remat_policy == "dots":
             body = jax.checkpoint(
@@ -295,18 +347,53 @@ def layer_scan_body(cfg: TransformerConfig, positions: jax.Array):
                     "qkv_proj", "gate_up"
                 ),
             )
-        else:
+        elif cfg.remat_policy == "full":
             body = jax.checkpoint(body)
+        else:
+            # "half_*" is resolved by forward_with_aux (it splits the stack
+            # and re-enters here with full/dots/remat=False); any other name
+            # reaching this point is a config error — a silent full-remat
+            # fallback would mis-measure the policy being asked for.
+            raise ValueError(
+                f"unhandled remat_policy {cfg.remat_policy!r} at the scan "
+                f"level (half_* composes only through the plain forward, "
+                f"not the pipeline path)")
     return body
 
 
 def forward(params: Params, tokens: jax.Array, cfg: TransformerConfig) -> jax.Array:
     """tokens [B, S] int32 -> logits [B, S, V] (f32)."""
+    return forward_with_aux(params, tokens, cfg)[0]
+
+
+def forward_with_aux(
+    params: Params, tokens: jax.Array, cfg: TransformerConfig
+) -> Tuple[jax.Array, jax.Array]:
+    """forward + summed MoE load-balancing aux loss (0 for dense stacks)."""
     B, S = tokens.shape
     x = embed_tokens(params, tokens, cfg)
     positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32)[None], (B, S))
-    x, _ = jax.lax.scan(layer_scan_body(cfg, positions), x, params["layers"])
-    return lm_head(params, x, cfg)
+    if cfg.remat and cfg.remat_policy.startswith("half"):
+        # Mixed remat: the FIRST half of the stack checkpoints (its saved
+        # activations would live longest — from forward until the very end
+        # of the backward), the second half keeps activations. Halves the
+        # backward recompute at roughly half of full-remat's memory saving,
+        # using only standard policies the AOT helper accepts.
+        inner = dataclasses.replace(
+            cfg, remat_policy="dots" if cfg.remat_policy == "half_dots"
+            else "full")
+        plain = dataclasses.replace(cfg, remat=False)
+        half = cfg.n_layers // 2
+        first = jax.tree.map(lambda a: a[:half], params["layers"])
+        second = jax.tree.map(lambda a: a[half:], params["layers"])
+        x, aux1 = jax.lax.scan(layer_scan_body(inner, positions), x, first)
+        x, aux2 = jax.lax.scan(layer_scan_body(plain, positions), x, second)
+        aux = aux1.sum() + aux2.sum()
+    else:
+        x, auxs = jax.lax.scan(
+            layer_scan_body(cfg, positions), x, params["layers"])
+        aux = auxs.sum()
+    return lm_head(params, x, cfg), aux
 
 
 def lm_head(params: Params, x: jax.Array, cfg: TransformerConfig) -> jax.Array:
@@ -351,5 +438,8 @@ def loss_fn(params: Params, batch: Dict[str, jax.Array], cfg: TransformerConfig)
     by the `seq` mesh axis under context parallelism — slicing to S-1 would
     break ring-attention sharding for power-of-two S.
     """
-    logits = forward(params, batch["tokens"], cfg)  # [B, S, V]
-    return next_token_loss(logits, batch)
+    logits, aux = forward_with_aux(params, batch["tokens"], cfg)  # [B, S, V]
+    loss = next_token_loss(logits, batch)
+    if cfg.moe_num_experts:
+        loss = loss + cfg.moe_aux_coef * aux
+    return loss

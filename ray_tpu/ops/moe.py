@@ -1,0 +1,117 @@
+"""Mixture-of-Experts block: GShard-style capacity-based top-k dispatch.
+
+SURVEY.md §5.7 lists MoE/expert parallelism as a first-class requirement;
+the reference has no MoE kernels (torch territory). The TPU-native design is
+the GShard/Switch einsum formulation: routing produces one-hot dispatch and
+weighted combine tensors, tokens move into per-expert buffers with a single
+einsum, the expert FFNs run as ONE batched matmul over the expert dim, and
+a second einsum combines results. Sharding the expert dim over the `expert`
+mesh axis turns those einsums into all-to-alls emitted by GSPMD — exactly
+the layout the scaling-book recipe prescribes (no hand-written collectives).
+
+Over-capacity tokens are dropped (their combine weight is zero and the
+residual connection carries them through unchanged) — standard
+capacity-factor semantics.
+"""
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def moe_ffn(
+    x: jax.Array,          # [B, S, d] (cfg.dtype)
+    router_w: jax.Array,   # [d, E]
+    w_gate_up: jax.Array,  # [E, d, 2, F]
+    w_down: jax.Array,     # [E, F, d]
+    *,
+    experts_per_token: int = 2,
+    capacity_factor: float = 1.25,
+    group_size: int = 4096,
+    dtype=jnp.bfloat16,
+) -> Tuple[jax.Array, jax.Array]:
+    """Returns (output [B, S, d], aux load-balancing loss scalar).
+
+    Tokens route within fixed-size GROUPS (GShard's grouping): dispatch
+    memory is O(groups * g * C) with C = O(k*g/E) — linear in total tokens —
+    instead of the quadratic O(T * k*T/E) of ungrouped routing.
+    """
+    B, S, d = x.shape
+    tokens = B * S
+    # Largest power-of-two divisor of T up to group_size keeps shapes exact.
+    g = 1
+    while g * 2 <= min(group_size, tokens) and tokens % (g * 2) == 0:
+        g *= 2
+    xg = x.reshape(tokens // g, g, d)
+
+    def per_group(xf):
+        return _moe_group(
+            xf, router_w, w_gate_up, w_down,
+            experts_per_token=experts_per_token,
+            capacity_factor=capacity_factor, dtype=dtype)
+
+    out, aux = jax.vmap(per_group)(xg)
+    return out.reshape(B, S, d), aux.mean()
+
+
+def _moe_group(
+    xf: jax.Array,         # [T, d] one routing group
+    router_w: jax.Array,
+    w_gate_up: jax.Array,
+    w_down: jax.Array,
+    *,
+    experts_per_token: int,
+    capacity_factor: float,
+    dtype,
+) -> Tuple[jax.Array, jax.Array]:
+    tokens, d = xf.shape
+    E = router_w.shape[-1]
+    k = experts_per_token
+    capacity = max(1, int(capacity_factor * tokens * k / E))
+
+    logits = (xf.astype(jnp.float32) @ router_w.astype(jnp.float32))  # [T,E]
+    probs = jax.nn.softmax(logits, axis=-1)
+
+    # Top-k expert choice per token.
+    gate_vals, expert_idx = jax.lax.top_k(probs, k)  # [T, k]
+    # Renormalize the chosen gates (Mixtral/GShard convention).
+    gate_vals = gate_vals / jnp.maximum(
+        gate_vals.sum(axis=-1, keepdims=True), 1e-9)
+
+    # Position of each (token, choice) within its expert's buffer: cumsum
+    # over the one-hot assignment, choices flattened in priority order so
+    # k=0 assignments win buffer slots before k=1.
+    onehot = jax.nn.one_hot(expert_idx, E, dtype=jnp.int32)  # [T, k, E]
+    flat = onehot.transpose(1, 0, 2).reshape(k * tokens, E)  # [k*T, E]
+    pos_flat = jnp.cumsum(flat, axis=0) - flat               # [k*T, E]
+    pos = pos_flat.reshape(k, tokens, E).transpose(1, 0, 2)  # [T, k, E]
+    position = (pos * onehot).sum(-1)                        # [T, k]
+    keep = position < capacity                               # [T, k]
+
+    # Dispatch/combine tensors [T, k] -> [T, E, C].
+    cap_onehot = jax.nn.one_hot(position, capacity, dtype=jnp.float32)
+    disp = (onehot.astype(jnp.float32)[..., None]
+            * cap_onehot[:, :, None, :]
+            * keep[..., None, None])                         # [T, k, E, C]
+    combine = (disp * gate_vals[..., None, None]).sum(1)     # [T, E, C]
+    dispatch = disp.sum(1)                                   # [T, E, C]
+
+    # Route tokens to expert buffers: [E, C, d].
+    expert_in = jnp.einsum(
+        "tec,td->ecd", dispatch.astype(dtype), xf.astype(dtype))
+    # Batched expert FFN (swiglu), ONE einsum per projection over E.
+    gu = jnp.einsum("ecd,edgf->ecgf", expert_in, w_gate_up.astype(dtype))
+    act = jax.nn.silu(gu[:, :, 0]) * gu[:, :, 1]             # [E, C, F]
+    expert_out = jnp.einsum("ecf,efd->ecd", act, w_down.astype(dtype))
+    out = jnp.einsum(
+        "tec,ecd->td", combine.astype(dtype), expert_out)    # [T, d]
+
+    # Load-balancing aux loss (Switch: E * mean(frac_tokens * frac_probs)).
+    assigned = onehot[:, 0].astype(jnp.float32)              # top-1 [T, E]
+    frac_tokens = assigned.mean(axis=0)
+    frac_probs = probs.mean(axis=0)
+    aux = E * jnp.sum(frac_tokens * frac_probs)
+
+    return out, aux

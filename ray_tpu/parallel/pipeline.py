@@ -133,6 +133,12 @@ def pipeline_loss_fn(cfg, mesh: Mesh, *, rules=None, num_microbatches: int = 4):
 
     rules = rules or shd.DEFAULT_RULES
     M = num_microbatches
+    if getattr(cfg, "moe_num_experts", 0):
+        raise NotImplementedError(
+            "MoE under pipeline parallelism is not supported yet: the "
+            "load-balancing aux loss would be silently dropped by the "
+            "stage scan. Use expert parallelism (mesh expert axis) without "
+            "pipe, or a dense config with pipe.")
 
     def loss_fn(params, batch):
         tokens = batch["tokens"]
