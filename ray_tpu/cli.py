@@ -43,6 +43,9 @@ def cmd_start(args) -> int:
     if args.head:
         import asyncio
 
+        if getattr(args, "state_path", None):
+            os.environ["RTPU_STATE_PATH"] = args.state_path
+
         from ray_tpu.core.controller import Controller
 
         async def run_head():
@@ -188,6 +191,9 @@ def main(argv=None) -> int:
     p.add_argument("--address", default=None, help="join an existing head")
     p.add_argument("--port", type=int, default=0)
     p.add_argument("--num-cpus", type=int, default=None)
+    p.add_argument("--state-path", default=None,
+                   help="persist controller state (KV, detached actors) "
+                        "across head restarts")
     p.set_defaults(fn=cmd_start)
 
     p = sub.add_parser("stop", help="stop the head started on this machine")

@@ -216,6 +216,13 @@ class Controller:
 
         self._arena = native_store.create_node_arena(uuid.uuid4().hex)
         self.host_id = current_host_id()
+        # Durable control-plane state (reference: gcs_storage Redis
+        # persistence, ray_config_def.h:402): KV, function table, and
+        # detached actors survive controller restarts when a state path is
+        # configured (RTPU_STATE_PATH or the CLI's --state-path).
+        self.persist_path = os.environ.get("RTPU_STATE_PATH") or None
+        self._state_dirty = False
+        self._restore_state()
 
     # ------------------------------------------------------------------ setup
 
@@ -260,6 +267,7 @@ class Controller:
 
     async def shutdown(self) -> None:
         self._closing = True
+        self._snapshot_state()
         for w in list(self.workers.values()):
             try:
                 await w.conn.send({"kind": "shutdown"})
@@ -695,6 +703,7 @@ class Controller:
 
     async def _h_register_function(self, conn, msg):
         self.functions[msg["func_id"]] = msg["blob"]
+        self._state_dirty = True
         return {"ok": True}
 
     async def _h_fetch_function(self, conn, msg):
@@ -939,6 +948,8 @@ class Controller:
             creation_spec=spec,
         )
         self.actors[actor_id] = actor
+        if actor.detached:
+            self._state_dirty = True
         spec["is_actor_creation"] = True
         self.tasks[spec["task_id"]] = spec
         await self._resolve_deps_then_queue(spec)
@@ -1056,6 +1067,8 @@ class Controller:
 
     def _mark_actor_dead(self, actor: ActorInfo, err: Exception) -> None:
         actor.state = "dead"
+        if actor.detached:
+            self._state_dirty = True
         actor.creation_error = actor.creation_error or err
         for call in actor.pending_calls:
             self._fail_task(call, err)
@@ -1154,6 +1167,7 @@ class Controller:
         exists = key in self.kv
         if msg.get("overwrite", True) or not exists:
             self.kv[key] = msg["value"]
+            self._state_dirty = True
             return {"added": not exists}
         return {"added": False}
 
@@ -1161,7 +1175,10 @@ class Controller:
         return self.kv.get((msg.get("ns", ""), msg["key"]))
 
     async def _h_kv_del(self, conn, msg):
-        return {"deleted": self.kv.pop((msg.get("ns", ""), msg["key"]), None) is not None}
+        deleted = self.kv.pop((msg.get("ns", ""), msg["key"]), None) is not None
+        if deleted:
+            self._state_dirty = True
+        return {"deleted": deleted}
 
     async def _h_kv_keys(self, conn, msg):
         ns = msg.get("ns", "")
@@ -1471,6 +1488,103 @@ class Controller:
 
         return read_location_range(msg["loc"], msg["offset"], msg["length"])
 
+    def _restore_state(self) -> None:
+        if not self.persist_path or not os.path.exists(self.persist_path):
+            return
+        import pickle as _p
+
+        try:
+            with open(self.persist_path, "rb") as f:
+                snap = _p.load(f)
+        except Exception as e:
+            sys.stderr.write(f"[controller] state restore failed: {e!r}\n")
+            return
+        self.kv.update(snap.get("kv", {}))
+        self.functions.update(snap.get("functions", {}))
+        # Only resume detached actors that can actually be rebuilt: creation
+        # deps died with the old process's object plane, and placement
+        # groups are not persisted — resuming those would leave actors
+        # permanently pending with callers hanging.
+        resumable = []
+        for spec in snap.get("detached_actors", []):
+            if spec.get("deps") or spec.get("pg"):
+                sys.stderr.write(
+                    f"[controller] not resuming detached actor "
+                    f"{spec.get('name') or spec['actor_id'][:8]}: creation "
+                    f"{'deps' if spec.get('deps') else 'placement group'} "
+                    f"did not survive the restart\n")
+                continue
+            resumable.append(spec)
+        resumed_ids = {s["actor_id"] for s in resumable}
+        # Names must only point at actors that exist (now or imminently);
+        # dangling entries would KeyError every lookup forever.
+        self.named_actors.update({
+            k: v for k, v in snap.get("named_actors", {}).items()
+            if v in resumed_ids
+        })
+        self._restored_detached = resumable
+        # Register the ActorInfos NOW so get_actor() between start and the
+        # first scheduler pass sees a pending actor, not a missing name.
+        self._resume_detached_actors()
+
+    def _resume_detached_actors(self) -> None:
+        """Re-create detached actors from their persisted creation specs
+        (reference: GCS restart reconstructing actors from storage,
+        gcs_actor_manager RestartActor on GCS failover)."""
+        specs = getattr(self, "_restored_detached", None) or []
+        self._restored_detached = []
+        for spec in specs:
+            actor_id = spec["actor_id"]
+            if actor_id in self.actors:
+                continue
+            actor = ActorInfo(
+                actor_id=actor_id,
+                name=spec.get("name"),
+                resources=spec.get("resources", {}),
+                pg=spec.get("pg"),
+                detached=True,
+                creation_task_id=spec["task_id"],
+                max_restarts=int(spec.get("max_restarts", 0)),
+                creation_spec=spec,
+            )
+            self.actors[actor_id] = actor
+            spec["state"] = "pending"
+            spec.pop("sched_node", None)
+            self.tasks[spec["task_id"]] = spec
+            self.pending_queue.append(spec["task_id"])
+        if specs:
+            self._wake_scheduler()
+
+    def _snapshot_state(self, force: bool = False) -> None:
+        if not self.persist_path:
+            return
+        if not force and not self._state_dirty:
+            return  # nothing changed: skip the pickle + disk write
+        self._state_dirty = False
+        import pickle as _p
+
+        detached = [
+            a.creation_spec for a in self.actors.values()
+            if a.detached and a.creation_spec is not None
+            and a.state != "dead"
+        ]
+        live_ids = {s["actor_id"] for s in detached}
+        snap = {
+            "kv": dict(self.kv),
+            "functions": dict(self.functions),
+            "named_actors": {
+                k: v for k, v in self.named_actors.items() if v in live_ids
+            },
+            "detached_actors": detached,
+        }
+        tmp = self.persist_path + f".tmp{os.getpid()}"
+        try:
+            with open(tmp, "wb") as f:
+                _p.dump(snap, f)
+            os.replace(tmp, self.persist_path)
+        except Exception as e:
+            sys.stderr.write(f"[controller] state snapshot failed: {e!r}\n")
+
     async def _health_check_loop(self) -> None:
         """Mark agent nodes dead when heartbeats stop (reference:
         gcs_health_check_manager.h:39 periodic health checks); also runs the
@@ -1492,6 +1606,8 @@ class Controller:
                 await self._maybe_spill_cold_objects()
             except Exception as e:  # pragma: no cover — keep the loop alive
                 sys.stderr.write(f"[controller] spill error: {e!r}\n")
+            self._resume_detached_actors()
+            self._snapshot_state()
 
     async def _maybe_spill_cold_objects(self) -> None:
         """When the head arena passes the high watermark, move the coldest

@@ -25,6 +25,7 @@ from .proxy import HTTPProxy
 logger = logging.getLogger(__name__)
 
 _proxy: Optional[HTTPProxy] = None
+_grpc_proxy = None
 
 
 def _get_or_create_controller():
@@ -52,9 +53,12 @@ def start(*, http_host: str = "127.0.0.1", http_port: int = 8000,
 
 def run(target: Application, *, name: str = "default",
         route_prefix: Optional[str] = "/", blocking: bool = False,
-        _http: bool = False, http_port: int = 8000) -> DeploymentHandle:
+        _http: bool = False, http_port: int = 8000,
+        _grpc: bool = False, grpc_port: int = 9000) -> DeploymentHandle:
     """Deploy an application graph; returns a handle to the ingress
-    deployment. `_http=True` also starts the HTTP proxy on http_port."""
+    deployment. `_http=True` also starts the HTTP proxy on http_port;
+    `_grpc=True` starts the gRPC ingress (JSON-envelope generic service,
+    grpc_proxy.py) on grpc_port."""
     if not isinstance(target, Application):
         raise TypeError("serve.run expects Deployment.bind(...)")
     ctrl = _get_or_create_controller()
@@ -104,6 +108,13 @@ def run(target: Application, *, name: str = "default",
         time.sleep(0.1)
     if _http:
         start(http_port=http_port)
+    if _grpc:
+        global _grpc_proxy
+        if _grpc_proxy is None:
+            from .grpc_proxy import GRPCProxy
+
+            _grpc_proxy = GRPCProxy(port=grpc_port)
+            _grpc_proxy.start()
     handle = DeploymentHandle(target.deployment.name)
     if blocking:
         try:
@@ -137,13 +148,19 @@ def status() -> Dict[str, Any]:
 
 
 def shutdown() -> None:
-    global _proxy
+    global _proxy, _grpc_proxy
     if _proxy is not None:
         try:
             _proxy.stop()
         except Exception:
             pass
         _proxy = None
+    if _grpc_proxy is not None:
+        try:
+            _grpc_proxy.stop()
+        except Exception:
+            pass
+        _grpc_proxy = None
     if not ray_tpu.is_initialized():
         return
     try:

@@ -1,0 +1,117 @@
+"""gRPC ingress for serve deployments.
+
+Parity: reference serve gRPC proxy (serve/_private/proxy.py gRPCProxy —
+user-schema gRPC ingress alongside HTTP). This implementation uses gRPC's
+generic handler with a JSON-over-bytes envelope instead of per-app protoc
+stubs: method /rtpu.serve/Call takes {"route": "/prefix", "input": ...} and
+returns {"result": ...}; /rtpu.serve/CallStream is the server-streaming
+variant for stream=True deployments (one JSON message per yielded item).
+Routing, replica choice, and multiplexing all ride the same DeploymentHandle
+path as HTTP and Python callers.
+"""
+from __future__ import annotations
+
+import json
+from concurrent import futures
+from typing import Any, Dict, Optional
+
+import ray_tpu
+
+from .controller import CONTROLLER_NAME
+from .handle import DeploymentHandle
+
+
+def _ser(obj) -> bytes:
+    return json.dumps(obj).encode()
+
+
+def _de(data: bytes):
+    return json.loads(data.decode()) if data else {}
+
+
+class GRPCProxy:
+    def __init__(self, host: str = "127.0.0.1", port: int = 9000):
+        self.host = host
+        self.port = port
+        self._handles: Dict[str, DeploymentHandle] = {}
+        self._routes: Dict[str, Dict[str, Any]] = {}
+        self._server = None
+
+    # ----------------------------------------------------------------- serve
+
+    def start(self) -> None:
+        import grpc
+
+        proxy = self
+
+        class Handler(grpc.GenericRpcHandler):
+            def service(self, handler_call_details):
+                if handler_call_details.method == "/rtpu.serve/Call":
+                    return grpc.unary_unary_rpc_method_handler(
+                        proxy._call,
+                        request_deserializer=_de,
+                        response_serializer=_ser,
+                    )
+                if handler_call_details.method == "/rtpu.serve/CallStream":
+                    return grpc.unary_stream_rpc_method_handler(
+                        proxy._call_stream,
+                        request_deserializer=_de,
+                        response_serializer=_ser,
+                    )
+                return None
+
+        self._server = grpc.server(
+            futures.ThreadPoolExecutor(max_workers=16,
+                                       thread_name_prefix="grpc-proxy"))
+        self._server.add_generic_rpc_handlers((Handler(),))
+        self.port = self._server.add_insecure_port(f"{self.host}:{self.port}")
+        self._server.start()
+
+    def stop(self) -> None:
+        if self._server is not None:
+            self._server.stop(grace=1)
+
+    # --------------------------------------------------------------- routing
+
+    def _refresh_routes(self) -> None:
+        ctrl = ray_tpu.get_actor(CONTROLLER_NAME)
+        self._routes = ray_tpu.get(ctrl.get_route_info.remote())
+
+    def _resolve(self, route: str) -> Optional[Dict[str, Any]]:
+        info = self._routes.get(route)
+        if info is None:
+            self._refresh_routes()
+            info = self._routes.get(route)
+        return info
+
+    def _handle_for(self, request):
+        route = request.get("route")
+        info = self._resolve(route or "")
+        if info is None:
+            raise KeyError(f"no deployment at route {route!r}")
+        handle = self._handles.setdefault(
+            info["name"], DeploymentHandle(info["name"]))
+        if request.get("multiplexed_model_id"):
+            handle = handle.options(
+                multiplexed_model_id=request["multiplexed_model_id"])
+        return handle, info
+
+    def _call(self, request, context):
+        try:
+            handle, _ = self._handle_for(request)
+            result = handle.remote(request.get("input")).result(timeout=60)
+            return {"result": result}
+        except Exception as e:
+            import grpc
+
+            context.abort(grpc.StatusCode.INTERNAL, str(e))
+
+    def _call_stream(self, request, context):
+        try:
+            handle, _ = self._handle_for(request)
+            for item in handle.options(stream=True).remote(request.get("input")):
+                yield {"item": item}
+        except Exception as e:
+            import grpc
+
+            context.abort(grpc.StatusCode.INTERNAL, str(e))

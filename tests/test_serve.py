@@ -239,3 +239,40 @@ def test_model_multiplexing(serve_instance):
     # A different model loads independently.
     r3 = handle.options(multiplexed_model_id="m-5").remote(2).result(timeout=30)
     assert r3["model"] == "m-5" and r3["y"] == 10
+
+
+def test_grpc_ingress(serve_instance):
+    """gRPC ingress (generic JSON-envelope service): unary call + server
+    streaming (reference: serve gRPC proxy)."""
+    import grpc
+
+    @serve.deployment
+    def griddle(x):
+        return {"doubled": (x or 0) * 2}
+
+    @serve.deployment(stream=True)
+    def gstream(n):
+        for i in range(int(n or 0)):
+            yield {"i": i}
+
+    serve.run(griddle.bind(), route_prefix="/g", _grpc=True, grpc_port=0)
+    serve.run(gstream.bind(), route_prefix="/gs")
+    from ray_tpu.serve import api as serve_api
+
+    port = serve_api._grpc_proxy.port
+    ch = grpc.insecure_channel(f"127.0.0.1:{port}")
+    call = ch.unary_unary(
+        "/rtpu.serve/Call",
+        request_serializer=lambda o: json.dumps(o).encode(),
+        response_deserializer=lambda b: json.loads(b.decode()))
+    out = call({"route": "/g", "input": 21}, timeout=30)
+    assert out == {"result": {"doubled": 42}}
+
+    stream = ch.unary_stream(
+        "/rtpu.serve/CallStream",
+        request_serializer=lambda o: json.dumps(o).encode(),
+        response_deserializer=lambda b: json.loads(b.decode()))
+    items = [m["item"] for m in stream({"route": "/gs", "input": 3},
+                                       timeout=30)]
+    assert items == [{"i": 0}, {"i": 1}, {"i": 2}]
+    ch.close()

@@ -1,0 +1,119 @@
+"""Controller concurrency-stress and chaos tests (VERDICT round-2 weak #6;
+reference: TSan CI + ResourceKiller chaos in _private/test_utils.py:1430 and
+the release scalability envelope)."""
+import os
+import signal
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.util import state
+
+
+def test_task_flood(ray_start_regular):
+    """Thousands of small tasks through one controller: completes, no
+    drops, no wedged scheduler."""
+
+    @ray_tpu.remote
+    def tiny(i):
+        return i
+
+    ray_tpu.get([tiny.remote(i) for i in range(8)])  # warm pool
+    n = 3000
+    t0 = time.perf_counter()
+    refs = [tiny.remote(i) for i in range(n)]
+    out = ray_tpu.get(refs, timeout=180)
+    dt = time.perf_counter() - t0
+    assert out == list(range(n))
+    assert dt < 120, f"{n} tasks took {dt:.0f}s"
+
+
+def test_many_actors(ray_start_regular):
+    """A wide actor fleet on one node (actors take 0 CPU; the envelope row
+    is 40k cluster-wide — scaled to CI)."""
+
+    @ray_tpu.remote
+    class A:
+        def __init__(self, i):
+            self.i = i
+
+        def who(self):
+            return self.i
+
+    # One worker process per actor; stay under MAX_WORKERS_PER_NODE (32).
+    n = 24
+    actors = [A.remote(i) for i in range(n)]
+    out = ray_tpu.get([a.who.remote() for a in actors], timeout=180)
+    assert out == list(range(n))
+    for a in actors:
+        ray_tpu.kill(a)
+
+
+def test_kill_worker_mid_large_put(ray_start_regular):
+    """SIGKILL a worker while it streams large objects; retried tasks
+    complete and every surviving object reads back intact."""
+
+    import tempfile
+    import uuid
+
+    marker = os.path.join(tempfile.gettempdir(),
+                          f"rtpu_stress_{uuid.uuid4().hex}")
+
+    @ray_tpu.remote(max_retries=2)
+    def produce(i, marker):
+        import os as _os
+        import signal as _signal
+        import time as _time
+
+        data = np.full(500_000, i, dtype=np.float64)  # 4MB
+        if i == 2 and not _os.path.exists(marker):
+            open(marker, "w").close()  # crash exactly once, cluster-wide
+            _time.sleep(0.05)
+            _os.kill(_os.getpid(), _signal.SIGKILL)
+        return data
+
+    refs = [produce.remote(i, marker) for i in range(6)]
+    out = ray_tpu.get(refs, timeout=120)
+    for i, arr in enumerate(out):
+        assert (arr == i).all()
+    os.unlink(marker)
+
+
+def test_wait_flood_with_straggler(ray_start_regular):
+    """A large wait with one slow producer: returns the fast ones promptly
+    (exercises the O(n) wait path under load)."""
+
+    @ray_tpu.remote
+    def slow():
+        time.sleep(5)
+        return "late"
+
+    fast = [ray_tpu.put(i) for i in range(2000)]
+    straggler = slow.remote()
+    t0 = time.perf_counter()
+    ready, not_ready = ray_tpu.wait(
+        fast + [straggler], num_returns=2000, timeout=30)
+    dt = time.perf_counter() - t0
+    assert len(ready) == 2000
+    assert dt < 5, f"wait returned in {dt:.1f}s — blocked on the straggler"
+    ray_tpu.get(straggler, timeout=30)
+    ray_tpu.free(fast)
+
+
+def test_controller_survives_handler_errors(ray_start_regular):
+    """Bad requests must error the CALLER, not the control plane."""
+    from ray_tpu.core import context as ctx
+
+    wc = ctx.get_worker_context()
+    with pytest.raises(Exception):
+        wc.client.request({"kind": "definitely_not_a_handler"})
+    with pytest.raises(Exception):
+        wc.client.request({"kind": "list_state", "what": "nope"})
+
+    @ray_tpu.remote
+    def ok():
+        return "fine"
+
+    assert ray_tpu.get(ok.remote(), timeout=30) == "fine"
