@@ -89,7 +89,7 @@ def __getattr__(name):
     # first touch so core stays jax-free for lightweight worker processes.
     import importlib
 
-    if name in ("train", "data", "tune", "rllib", "serve", "parallel", "models", "ops", "util", "workflow"):
+    if name in ("train", "data", "tune", "rllib", "serve", "parallel", "models", "ops", "util", "workflow", "dag"):
         mod = importlib.import_module(f"ray_tpu.{name}")
         globals()[name] = mod
         return mod

@@ -391,11 +391,21 @@ class RemoteFunction:
     def _ensure_registered(self, wc: ctx.WorkerContext) -> str:
         key = wc.client.token
         if self._func_id is None or self._registered_with != key:
-            blob = cloudpickle.dumps(self._fn)
+            # Assign the id BEFORE pickling: if the function's closure
+            # references this handle (recursive remote fn / workflow
+            # continuation), the nested __reduce__ must see a settled id
+            # instead of re-entering registration forever.
             func_id = TaskID.generate()
-            wc.client.request({"kind": "register_function", "func_id": func_id, "blob": blob})
             self._func_id = func_id
             self._registered_with = key
+            try:
+                blob = cloudpickle.dumps(self._fn)
+                wc.client.request({"kind": "register_function",
+                                   "func_id": func_id, "blob": blob})
+            except BaseException:
+                self._func_id = None
+                self._registered_with = None
+                raise
         return self._func_id
 
     def remote(self, *args, **kwargs):
@@ -437,11 +447,75 @@ class RemoteFunction:
             return None
         return refs
 
+    def bind(self, *args, **kwargs):
+        """Author a lazy DAG node instead of submitting (reference
+        python/ray/dag/function_node.py; used by ray_tpu.workflow and
+        ray_tpu.dag.compiled_dag)."""
+        from ray_tpu.dag.dag_node import FunctionNode
+
+        return FunctionNode(self, args, kwargs)
+
+    def __reduce__(self):
+        # RemoteFunction handles travel inside task results (workflow
+        # continuations return DAG nodes holding one). Pickling the wrapped
+        # fn by value recurses when its closure references the handle itself
+        # (e.g. a recursive continuation), so ship it *by function-table id*
+        # — the blob is already exported via register_function. Without a
+        # live session (plain copy.deepcopy of a config holding a handle)
+        # fall back to by-value, the pre-session behavior.
+        if not is_initialized():
+            return (_rebuild_remote_function_value,
+                    (cloudpickle.dumps(self._fn), self._options))
+        wc = ctx.get_worker_context()
+        func_id = self._ensure_registered(wc)
+        return (_rebuild_remote_function, (func_id, self._options))
+
     def __call__(self, *args, **kwargs):
         raise TypeError(
             f"remote function {self.__name__!r} cannot be called directly; "
             f"use .remote() or access the underlying function via ._fn"
         )
+
+
+# Rebuild bookkeeping for by-table-id function handles. ``_rebuilding`` is
+# keyed per-thread: a function whose closure references its own handle
+# re-enters _rebuild_remote_function while its blob loads and must get the
+# same placeholder back, but another thread must NOT observe the partially
+# initialized object — it performs its own fetch instead. ``_fn_cache``
+# memoizes completed loads so repeat deserializations of the same func_id
+# (deep workflow continuations) skip the fetch RPC + unpickle.
+_rebuilding: Dict[Any, "RemoteFunction"] = {}
+_fn_cache: Dict[Any, Callable] = {}
+
+
+def _rebuild_remote_function(func_id: str, options) -> "RemoteFunction":
+    import threading
+
+    wc = ctx.get_worker_context()
+    cache_key = (wc.client.token, func_id)
+    local_key = (threading.get_ident(),) + cache_key
+    if local_key in _rebuilding:
+        return _rebuilding[local_key]
+    fn = _fn_cache.get(cache_key)
+    if fn is not None:
+        rf = RemoteFunction(fn, options)
+    else:
+        rf = RemoteFunction.__new__(RemoteFunction)
+        _rebuilding[local_key] = rf
+        try:
+            blob = wc.client.request(
+                {"kind": "fetch_function", "func_id": func_id})
+            rf.__init__(cloudpickle.loads(blob), options)
+            _fn_cache[cache_key] = rf._fn
+        finally:
+            del _rebuilding[local_key]
+    rf._func_id = func_id
+    rf._registered_with = wc.client.token
+    return rf
+
+
+def _rebuild_remote_function_value(fn_blob: bytes, options) -> "RemoteFunction":
+    return RemoteFunction(cloudpickle.loads(fn_blob), options)
 
 
 # ------------------------------------------------------------------- actors
@@ -458,6 +532,12 @@ class ActorMethod:
 
     def remote(self, *args, **kwargs):
         return self._handle._submit(self._name, args, kwargs, self._num_returns)
+
+    def bind(self, *args, **kwargs):
+        """Lazy DAG node for this method on an existing actor handle."""
+        from ray_tpu.dag.dag_node import ClassMethodNode
+
+        return ClassMethodNode(self._handle, self._name, args, kwargs)
 
 
 class ActorHandle:
@@ -573,6 +653,12 @@ class ActorClass:
              "value": cloudpickle.dumps(method_names)}
         )
         return ActorHandle(actor_id, method_names)
+
+    def bind(self, *args, **kwargs):
+        """Lazy actor construction node (reference python/ray/dag/class_node.py)."""
+        from ray_tpu.dag.dag_node import ClassNode
+
+        return ClassNode(self, args, kwargs)
 
 
 def remote(*args, **kwargs):

@@ -1,0 +1,28 @@
+"""Lazy DAG authoring + compiled actor pipelines.
+
+Parity: reference python/ray/dag/ (dag_node.py, function_node.py,
+class_node.py, input_node.py, output_node.py, compiled_dag_node.py).
+"""
+from ray_tpu.dag.dag_node import (
+    ClassMethodNode,
+    ClassNode,
+    DAGNode,
+    FunctionNode,
+    InputAttributeNode,
+    InputNode,
+    MultiOutputNode,
+)
+from ray_tpu.dag.compiled_dag import CompiledDAG, CompiledDAGRef, compile_dag
+
+__all__ = [
+    "DAGNode",
+    "FunctionNode",
+    "ClassNode",
+    "ClassMethodNode",
+    "InputNode",
+    "InputAttributeNode",
+    "MultiOutputNode",
+    "CompiledDAG",
+    "CompiledDAGRef",
+    "compile_dag",
+]

@@ -1,0 +1,30 @@
+"""Durable workflows over the ray_tpu DAG layer.
+
+Parity: reference python/ray/workflow/ — storage-backed resume,
+continuations, catch_exceptions, lifecycle API.
+"""
+from ray_tpu.workflow.api import (
+    cancel,
+    delete,
+    get_output,
+    get_status,
+    list_all,
+    resume,
+    resume_async,
+    run,
+    run_async,
+)
+from ray_tpu.workflow.storage import WorkflowStorage
+
+__all__ = [
+    "run",
+    "run_async",
+    "resume",
+    "resume_async",
+    "get_status",
+    "get_output",
+    "list_all",
+    "cancel",
+    "delete",
+    "WorkflowStorage",
+]

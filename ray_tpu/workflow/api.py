@@ -1,0 +1,138 @@
+"""Workflow public API: run / resume / inspect durable DAG executions.
+
+Parity: reference python/ray/workflow/api.py (``workflow.run``,
+``run_async``, ``resume``, ``resume_async``, ``get_status``,
+``get_output``, ``list_all``, ``cancel``, ``delete``). Authoring uses the
+same ``.bind()`` DAG surface as the reference (a workflow *is* a DAG plus
+durability), so any ``ray_tpu.dag`` graph is runnable here.
+"""
+from __future__ import annotations
+
+import threading
+import uuid
+from concurrent.futures import Future
+from typing import Any, Dict, List, Optional
+
+from ray_tpu.dag.dag_node import DAGNode
+from ray_tpu.workflow.executor import WorkflowCanceled, WorkflowExecutor
+from ray_tpu.workflow.storage import WorkflowStorage, list_workflows
+
+# In-process registry of live runs so cancel() can interrupt them.
+_running: Dict[str, threading.Event] = {}
+_lock = threading.Lock()
+
+
+def _execute(storage: WorkflowStorage, dag: DAGNode) -> Any:
+    cancel = threading.Event()
+    with _lock:
+        _running[storage.workflow_id] = cancel
+    storage.set_status("RUNNING")
+    try:
+        result = WorkflowExecutor(storage, cancel).run(dag)
+    except WorkflowCanceled:
+        storage.set_status("CANCELED")
+        raise
+    except Exception:
+        storage.set_status("FAILED")
+        raise
+    else:
+        storage.save_step_result("__output__", result)
+        storage.set_status("SUCCESSFUL")
+        return result
+    finally:
+        with _lock:
+            _running.pop(storage.workflow_id, None)
+
+
+def run(dag: DAGNode, *, workflow_id: Optional[str] = None,
+        storage: Optional[str] = None) -> Any:
+    """Execute a DAG durably; blocks until the final output is computed."""
+    if not isinstance(dag, DAGNode):
+        raise TypeError("workflow.run expects a DAG node (use .bind())")
+    workflow_id = workflow_id or f"workflow-{uuid.uuid4().hex[:8]}"
+    st = WorkflowStorage(workflow_id, storage)
+    st.save_dag(dag)
+    return _execute(st, dag)
+
+
+def run_async(dag: DAGNode, *, workflow_id: Optional[str] = None,
+              storage: Optional[str] = None) -> Future:
+    workflow_id = workflow_id or f"workflow-{uuid.uuid4().hex[:8]}"
+    st = WorkflowStorage(workflow_id, storage)
+    st.save_dag(dag)
+    fut: Future = Future()
+
+    def body():
+        try:
+            fut.set_result(_execute(st, dag))
+        except BaseException as e:
+            fut.set_exception(e)
+
+    threading.Thread(target=body, daemon=True,
+                     name=f"workflow-{workflow_id}").start()
+    return fut
+
+
+def resume(workflow_id: str, *, storage: Optional[str] = None) -> Any:
+    """Re-drive a stored workflow; completed steps load from checkpoints."""
+    st = WorkflowStorage(workflow_id, storage)
+    if not st.has_dag():
+        raise ValueError(f"no stored workflow {workflow_id!r}")
+    if st.get_meta().get("status") == "SUCCESSFUL":
+        return st.load_step_result("__output__")
+    return _execute(st, st.load_dag())
+
+
+def resume_async(workflow_id: str, *, storage: Optional[str] = None) -> Future:
+    fut: Future = Future()
+
+    def body():
+        try:
+            fut.set_result(resume(workflow_id, storage=storage))
+        except BaseException as e:
+            fut.set_exception(e)
+
+    threading.Thread(target=body, daemon=True).start()
+    return fut
+
+
+def get_status(workflow_id: str, *, storage: Optional[str] = None) -> str:
+    st = WorkflowStorage(workflow_id, storage)
+    status = st.get_meta().get("status")
+    if status == "RUNNING" and workflow_id not in _running:
+        # The driving process died mid-run; the stored state is resumable.
+        return "RESUMABLE"
+    return status or "UNKNOWN"
+
+
+def get_output(workflow_id: str, *, storage: Optional[str] = None) -> Any:
+    st = WorkflowStorage(workflow_id, storage)
+    if st.get_meta().get("status") != "SUCCESSFUL":
+        raise ValueError(
+            f"workflow {workflow_id!r} has no output "
+            f"(status={st.get_meta().get('status')})")
+    return st.load_step_result("__output__")
+
+
+def list_all(*, storage: Optional[str] = None) -> List[Dict[str, Any]]:
+    rows = list_workflows(storage)
+    for r in rows:
+        if r.get("status") == "RUNNING" and r["workflow_id"] not in _running:
+            r["status"] = "RESUMABLE"
+    return rows
+
+
+def cancel(workflow_id: str, *, storage: Optional[str] = None) -> None:
+    with _lock:
+        ev = _running.get(workflow_id)
+    if ev is not None:
+        ev.set()
+    else:
+        WorkflowStorage(workflow_id, storage).set_status("CANCELED")
+
+
+def delete(workflow_id: str, *, storage: Optional[str] = None) -> None:
+    import shutil
+
+    st = WorkflowStorage(workflow_id, storage)
+    shutil.rmtree(st.dir, ignore_errors=True)

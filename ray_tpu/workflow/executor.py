@@ -1,0 +1,186 @@
+"""Workflow executor: wave-parallel DAG execution with per-step checkpoints.
+
+Parity: reference python/ray/workflow/workflow_executor.py +
+task_executor.py. Semantics kept from the reference:
+
+- every step's *value* is checkpointed before dependents consume it, so
+  resume never re-runs a completed step;
+- independent branches run concurrently (ready steps are all submitted,
+  completion harvested with ``api.wait``);
+- a step returning a DAG node is a **continuation** (reference
+  ``workflow.continuation``): the sub-DAG is executed under the step's
+  namespace and its output becomes the step's value;
+- ``catch_exceptions`` on a step converts its outcome to
+  ``(result, None) | (None, exception)``;
+- task-level ``max_retries`` rides the core runtime's retry machinery
+  rather than being re-implemented here.
+
+Step ids are assigned by deterministic topological traversal (same DAG →
+same ids), which is what makes the checkpoint store addressable across
+driver restarts.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional
+
+from ray_tpu.core import api
+from ray_tpu.dag.dag_node import (
+    ClassMethodNode,
+    ClassNode,
+    DAGNode,
+    FunctionNode,
+    InputAttributeNode,
+    InputNode,
+    MultiOutputNode,
+)
+from ray_tpu.workflow.storage import WorkflowStorage
+
+
+class WorkflowCanceled(RuntimeError):
+    pass
+
+
+def assign_step_ids(output: DAGNode) -> Dict[int, str]:
+    """Stable ids: topological position + a human hint."""
+    ids: Dict[int, str] = {}
+    counts: Dict[str, int] = {}
+    for node in output.topological():
+        hint = node._name_hint()
+        n = counts.get(hint, 0)
+        counts[hint] = n + 1
+        ids[id(node)] = f"{hint}.{n}"
+    return ids
+
+
+class WorkflowExecutor:
+    def __init__(self, storage: WorkflowStorage,
+                 cancel_event: Optional[threading.Event] = None):
+        self.storage = storage
+        self.cancel_event = cancel_event or threading.Event()
+
+    # The executor walks the DAG in dependency waves. ``memo`` maps node id
+    # -> computed *value* (not ref): workflow steps are checkpointed at the
+    # driver, so values are already local when dependents are submitted.
+    def run(self, output: DAGNode, run_input=((), {})) -> Any:
+        ids = assign_step_ids(output)
+        nodes = output.topological()
+        memo: Dict[int, Any] = {"__input__": run_input}
+
+        # Dependency bookkeeping over checkpointable nodes.
+        pending: Dict[int, DAGNode] = {id(n): n for n in nodes}
+        inflight: Dict[str, tuple] = {}  # object id str -> (node, ref)
+
+        def checkpointable(n: DAGNode) -> bool:
+            return isinstance(n, (FunctionNode, ClassMethodNode))
+
+        def deps_ready(n: DAGNode) -> bool:
+            return all(id(u) in memo for u in n._upstream())
+
+        def resolve_local(n: DAGNode) -> Any:
+            """Evaluate non-task nodes (input selectors, actor creation)."""
+            if isinstance(n, InputNode) or isinstance(n, InputAttributeNode) \
+                    or isinstance(n, MultiOutputNode) or isinstance(n, ClassNode):
+                sub = dict(memo)
+                return n._execute_memo(sub)
+            raise AssertionError(type(n))
+
+        while pending:
+            if self.cancel_event.is_set():
+                raise WorkflowCanceled(self.storage.workflow_id)
+            progressed = False
+            for nid, node in list(pending.items()):
+                if not deps_ready(node):
+                    continue
+                step_id = ids[nid]
+                if not checkpointable(node):
+                    memo[nid] = resolve_local(node)
+                    del pending[nid]
+                    progressed = True
+                    continue
+                state = self.storage.step_state(step_id)
+                if state == "SUCCESSFUL":
+                    value = self.storage.load_step_result(step_id)
+                    memo[nid] = self._maybe_continue(step_id, value)
+                    del pending[nid]
+                    progressed = True
+                    continue
+                # Submit: upstream values are plain objects in memo.
+                sub = dict(memo)
+                ref = node._execute_impl(sub)
+                self.storage.log_event("step_started", step=step_id)
+                # Normalize num_returns variants: a list of refs (wait on
+                # the first, get them all) or None for num_returns=0.
+                refs = ref if isinstance(ref, list) else (
+                    [] if ref is None else [ref])
+                if not refs:
+                    self.storage.save_step_result(step_id, None)
+                    self.storage.log_event("step_finished", step=step_id)
+                    memo[nid] = None
+                else:
+                    inflight[refs[0].object_id] = (node, ref, step_id)
+                del pending[nid]
+                progressed = True
+
+            if inflight:
+                first_refs = [
+                    (r[1][0] if isinstance(r[1], list) else r[1])
+                    for r in inflight.values()
+                ]
+                ready, _ = api.wait(first_refs, num_returns=1, timeout=1.0)
+                for r in ready:
+                    node, ref, step_id = inflight.pop(r.object_id)
+                    fn_opts = getattr(
+                        getattr(node, "_remote_fn", None), "_options", {}) or {}
+                    catch = bool(
+                        getattr(node, "_options", {}).get("catch_exceptions")
+                        or fn_opts.get("catch_exceptions"))
+                    try:
+                        value = api.get(ref)
+                    except Exception as e:  # step failed
+                        if catch:
+                            value = (None, e)
+                            self.storage.save_step_result(step_id, value)
+                            self.storage.log_event("step_finished",
+                                                   step=step_id, caught=True)
+                        else:
+                            self.storage.save_step_result(
+                                step_id, e, is_exception=True)
+                            self.storage.log_event("step_failed", step=step_id,
+                                                   error=repr(e))
+                            raise
+                    else:
+                        if catch:
+                            value = (value, None)
+                        if isinstance(value, DAGNode):
+                            # Continuation: checkpoint the step as SUCCESSFUL
+                            # with the DAG node as its value BEFORE driving
+                            # the sub-DAG — a crash mid-continuation must not
+                            # re-run this step's body (side effects!). Resume
+                            # then re-enters the continuation via
+                            # _maybe_continue on the stored DAGNode value.
+                            self.storage.save_step_result(step_id, value)
+                            value = self._maybe_continue(step_id, value)
+                        else:
+                            self.storage.save_step_result(step_id, value)
+                        self.storage.log_event("step_finished", step=step_id)
+                    memo[id(node)] = value
+                progressed = True
+            elif not progressed and pending:
+                raise RuntimeError(
+                    f"workflow deadlock: unsatisfiable deps for "
+                    f"{[ids[i] for i in pending]}")
+        return memo[id(output)]
+
+    def _maybe_continue(self, step_id: str, value: Any):
+        """Execute (or resume) a dynamic continuation of a finished step.
+
+        The continuation DAG *is* the step's checkpointed value; its own
+        steps checkpoint under ``steps/<id>.sub/``, so resume re-enters
+        here (via the loaded value) and skips completed sub-steps.
+        """
+        if not isinstance(value, DAGNode):
+            return value
+        sub = WorkflowExecutor(self.storage.sub_storage(step_id),
+                               self.cancel_event)
+        return sub.run(value)

@@ -1,0 +1,166 @@
+"""Durable workflow storage: filesystem event-sourced step state.
+
+Parity: reference python/ray/workflow/workflow_storage.py (880 LoC) —
+step results, the serialized DAG, and lifecycle events are persisted so a
+workflow can resume after driver/cluster death. Layout::
+
+    <root>/<workflow_id>/
+        workflow.json          # status + timestamps
+        dag.pkl                # cloudpickled output DAGNode
+        events.jsonl           # append-only lifecycle log
+        steps/<step_id>.pkl    # checkpointed result (or exception)
+        steps/<step_id>.json   # per-step state
+
+The root defaults to ``$RTPU_WORKFLOW_STORAGE`` or
+``~/.ray_tpu/workflows`` so durability survives cluster restarts (the
+reference defaults to ``~/.ray/workflow_data``-style local storage too).
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Dict, List, Optional
+
+import cloudpickle
+
+
+def _write_json_atomic(path: str, obj) -> None:
+    # Same tmp+replace discipline as result pkls: a crash or concurrent
+    # reader must never see truncated JSON (that would make the workflow
+    # unresumable).
+    tmp = f"{path}.tmp{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(obj, f)
+    os.replace(tmp, path)
+
+
+def _rebuild_durable(kind: str, blob: bytes, options):
+    from ray_tpu.core.api import ActorClass, RemoteFunction
+
+    target = cloudpickle.loads(blob)
+    return (ActorClass if kind == "actor" else RemoteFunction)(target, options)
+
+
+class _DurablePickler(cloudpickle.Pickler):
+    """Serialize RemoteFunction/ActorClass *by value* for storage.
+
+    The in-flight ``__reduce__`` ships them by controller function-table id
+    (cheap; survives restarts only with RTPU_STATE_PATH persistence). A
+    stored workflow should carry its code, so the wrapped callable goes
+    into the blob as a nested cloudpickle payload. A self-referential
+    closure (recursive continuation: fn → handle → fn) terminates because
+    the *nested* dump serializes the inner handle by table id.
+    """
+
+    def reducer_override(self, obj):
+        from ray_tpu.core.api import ActorClass, RemoteFunction
+
+        if isinstance(obj, RemoteFunction):
+            return (_rebuild_durable,
+                    ("fn", cloudpickle.dumps(obj._fn), dict(obj._options)))
+        if isinstance(obj, ActorClass):
+            return (_rebuild_durable,
+                    ("actor", cloudpickle.dumps(obj._cls), dict(obj._options)))
+        return NotImplemented
+
+
+def default_storage_root() -> str:
+    return os.environ.get(
+        "RTPU_WORKFLOW_STORAGE",
+        os.path.join(os.path.expanduser("~"), ".ray_tpu", "workflows"),
+    )
+
+
+class WorkflowStorage:
+    def __init__(self, workflow_id: str, root: Optional[str] = None):
+        self.workflow_id = workflow_id
+        self.root = root or default_storage_root()
+        self.dir = os.path.join(self.root, workflow_id)
+        os.makedirs(os.path.join(self.dir, "steps"), exist_ok=True)
+
+    # -- workflow-level ----------------------------------------------------
+    def save_dag(self, node: Any, name: str = "dag.pkl") -> None:
+        with open(os.path.join(self.dir, name), "wb") as f:
+            _DurablePickler(f).dump(node)
+
+    def load_dag(self, name: str = "dag.pkl") -> Any:
+        with open(os.path.join(self.dir, name), "rb") as f:
+            return cloudpickle.load(f)
+
+    def has_dag(self, name: str = "dag.pkl") -> bool:
+        return os.path.exists(os.path.join(self.dir, name))
+
+    def set_status(self, status: str) -> None:
+        meta = self.get_meta()
+        meta["status"] = status
+        meta.setdefault("created_at", time.time())
+        if status in ("SUCCESSFUL", "FAILED", "CANCELED"):
+            meta["finished_at"] = time.time()
+        _write_json_atomic(os.path.join(self.dir, "workflow.json"), meta)
+
+    def get_meta(self) -> Dict[str, Any]:
+        path = os.path.join(self.dir, "workflow.json")
+        if not os.path.exists(path):
+            return {}
+        with open(path) as f:
+            return json.load(f)
+
+    def log_event(self, event: str, **fields) -> None:
+        rec = {"ts": time.time(), "event": event, **fields}
+        with open(os.path.join(self.dir, "events.jsonl"), "a") as f:
+            f.write(json.dumps(rec) + "\n")
+
+    # -- step-level --------------------------------------------------------
+    def _step_paths(self, step_id: str):
+        base = os.path.join(self.dir, "steps", step_id)
+        return base + ".pkl", base + ".json"
+
+    def save_step_result(self, step_id: str, value: Any,
+                         *, is_exception: bool = False) -> None:
+        pkl, meta = self._step_paths(step_id)
+        tmp = pkl + ".tmp"
+        with open(tmp, "wb") as f:
+            # DurablePickler: a continuation checkpoint is a DAGNode holding
+            # RemoteFunction handles — those must carry their code.
+            _DurablePickler(f).dump(value)
+        os.replace(tmp, pkl)  # atomic: a crash never leaves a half checkpoint
+        _write_json_atomic(
+            meta,
+            {"state": "FAILED" if is_exception else "SUCCESSFUL",
+             "ts": time.time()})
+
+    def step_state(self, step_id: str) -> Optional[str]:
+        _, meta = self._step_paths(step_id)
+        if not os.path.exists(meta):
+            return None
+        with open(meta) as f:
+            return json.load(f).get("state")
+
+    def load_step_result(self, step_id: str) -> Any:
+        pkl, _ = self._step_paths(step_id)
+        with open(pkl, "rb") as f:
+            return cloudpickle.load(f)
+
+    def sub_storage(self, step_id: str) -> "WorkflowStorage":
+        """Namespaced storage for a dynamic continuation of one step."""
+        sub = WorkflowStorage.__new__(WorkflowStorage)
+        sub.workflow_id = self.workflow_id
+        sub.root = self.root
+        sub.dir = os.path.join(self.dir, "steps", step_id + ".sub")
+        os.makedirs(os.path.join(sub.dir, "steps"), exist_ok=True)
+        return sub
+
+
+def list_workflows(root: Optional[str] = None) -> List[Dict[str, Any]]:
+    root = root or default_storage_root()
+    out = []
+    if not os.path.isdir(root):
+        return out
+    for wid in sorted(os.listdir(root)):
+        meta_path = os.path.join(root, wid, "workflow.json")
+        if not os.path.isfile(meta_path):
+            continue  # stray files / unrelated dirs are not workflows
+        with open(meta_path) as f:
+            out.append({"workflow_id": wid, **json.load(f)})
+    return out
