@@ -149,6 +149,25 @@ def cmd_timeline(args) -> int:
     return 0
 
 
+def cmd_dashboard(args) -> int:
+    """Serve the web dashboard against a running cluster (reference:
+    the dashboard head process, dashboard/head.py)."""
+    import time
+
+    import ray_tpu
+    from ray_tpu.dashboard import start_dashboard
+
+    ray_tpu.init(address=_resolve_address(args))
+    dash = start_dashboard(host=args.host, port=args.dash_port)
+    print(f"dashboard at http://{args.host}:{dash.port}")
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        dash.stop()
+    return 0
+
+
 def cmd_job(args) -> int:
     rt = _connect(args)
     from ray_tpu.jobs import JobSubmissionClient
@@ -208,6 +227,12 @@ def main(argv=None) -> int:
     p.add_argument("--address", default=None)
     p.add_argument("--out", default="timeline.json")
     p.set_defaults(fn=cmd_timeline)
+
+    p = sub.add_parser("dashboard", help="serve the web dashboard")
+    p.add_argument("--address", default=None)
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--dash-port", type=int, default=8265)
+    p.set_defaults(fn=cmd_dashboard)
 
     p = sub.add_parser("job")
     p.add_argument("--address", default=None)

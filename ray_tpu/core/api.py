@@ -464,8 +464,23 @@ class RemoteFunction:
         # live session (plain copy.deepcopy of a config holding a handle)
         # fall back to by-value, the pre-session behavior.
         if not is_initialized():
+            # Re-entrancy guard mirroring the session path: a recursive
+            # handle (fn's closure → this object) would otherwise nest
+            # cloudpickle.dumps forever. First entry dumps the fn under a
+            # token; nested entries reduce to a by-token backreference that
+            # the (equally nested) load resolves to the same object.
+            state = _value_pickle_state()
+            token = state["dumping"].get(id(self))
+            if token is not None:
+                return (_rebuild_value_backref, (token,))
+            token = f"rf-{id(self):x}-{len(state['dumping'])}"
+            state["dumping"][id(self)] = token
+            try:
+                blob = cloudpickle.dumps(self._fn)
+            finally:
+                del state["dumping"][id(self)]
             return (_rebuild_remote_function_value,
-                    (cloudpickle.dumps(self._fn), self._options))
+                    (token, blob, self._options))
         wc = ctx.get_worker_context()
         func_id = self._ensure_registered(wc)
         return (_rebuild_remote_function, (func_id, self._options))
@@ -489,8 +504,6 @@ _fn_cache: Dict[Any, Callable] = {}
 
 
 def _rebuild_remote_function(func_id: str, options) -> "RemoteFunction":
-    import threading
-
     wc = ctx.get_worker_context()
     cache_key = (wc.client.token, func_id)
     local_key = (threading.get_ident(),) + cache_key
@@ -514,8 +527,29 @@ def _rebuild_remote_function(func_id: str, options) -> "RemoteFunction":
     return rf
 
 
-def _rebuild_remote_function_value(fn_blob: bytes, options) -> "RemoteFunction":
-    return RemoteFunction(cloudpickle.loads(fn_blob), options)
+_value_tl = threading.local()
+
+
+def _value_pickle_state() -> Dict[str, Dict]:
+    if not hasattr(_value_tl, "state"):
+        _value_tl.state = {"dumping": {}, "loading": {}}
+    return _value_tl.state
+
+
+def _rebuild_remote_function_value(token: str, fn_blob: bytes,
+                                   options) -> "RemoteFunction":
+    state = _value_pickle_state()
+    rf = RemoteFunction.__new__(RemoteFunction)
+    state["loading"][token] = rf
+    try:
+        rf.__init__(cloudpickle.loads(fn_blob), options)
+    finally:
+        del state["loading"][token]
+    return rf
+
+
+def _rebuild_value_backref(token: str) -> "RemoteFunction":
+    return _value_pickle_state()["loading"][token]
 
 
 # ------------------------------------------------------------------- actors

@@ -63,21 +63,8 @@ class CompiledDAG:
         self._max_in_flight = max(1, int(max_in_flight))
         self._inflight: deque = deque()
         self._torn_down = False
-        # Instantiate every ClassNode once; these handles persist across
-        # executions (the defining difference from DAGNode.execute()).
-        # Constructors therefore cannot depend on per-execution input.
-        self._actor_handles: Dict[int, Any] = {}
-        boot_memo: Dict[int, Any] = {}
-        for n in self._nodes:
-            if isinstance(n, ClassNode):
-                for up in n.topological():
-                    if isinstance(up, (InputNode, InputAttributeNode)):
-                        raise TypeError(
-                            "compiled DAG: actor constructor args cannot "
-                            "reference InputNode — actors are built once at "
-                            "compile time, not per execution"
-                        )
-                self._actor_handles[id(n)] = n._execute_memo(boot_memo)
+        # Validate the whole graph BEFORE creating anything: a rejected
+        # graph must not leak half-instantiated actors.
         for n in self._nodes:
             if not isinstance(
                 n,
@@ -87,6 +74,21 @@ class CompiledDAG:
                 raise TypeError(
                     f"cannot compile node type {type(n).__name__}"
                 )
+            if isinstance(n, ClassNode):
+                for up in n.topological():
+                    if isinstance(up, (InputNode, InputAttributeNode)):
+                        raise TypeError(
+                            "compiled DAG: actor constructor args cannot "
+                            "reference InputNode — actors are built once at "
+                            "compile time, not per execution"
+                        )
+        # Instantiate every ClassNode once; these handles persist across
+        # executions (the defining difference from DAGNode.execute()).
+        self._actor_handles: Dict[int, Any] = {}
+        boot_memo: Dict[int, Any] = {}
+        for n in self._nodes:
+            if isinstance(n, ClassNode):
+                self._actor_handles[id(n)] = n._execute_memo(boot_memo)
 
     def execute(self, *args, **kwargs) -> CompiledDAGRef:
         if self._torn_down:

@@ -1,0 +1,258 @@
+"""Web dashboard: cluster overview UI + JSON API over the state surface.
+
+Parity: reference dashboard head (dashboard/head.py + http_server_head.py,
+modules under dashboard/modules/: node, actor, job, serve, state, metrics,
+healthz, reporter). The reference runs a separate aiohttp process per
+cluster plus a per-node agent; here one aiohttp server embeds in (or
+attaches to) the driver process and reads everything through the same
+controller RPC the state API uses — the controller is already the
+aggregation point (its task-event buffer and Prometheus endpoint), so a
+second aggregator daemon would be redundant at this scale. Per-node
+cpu/mem comes from psutil sampled by the serving process for the local
+host and from host-agent heartbeats for remote nodes.
+
+Endpoints:
+    GET /                    HTML overview (auto-refreshing)
+    GET /api/cluster         resources + node table
+    GET /api/nodes           state API list_nodes
+    GET /api/actors          state API list_actors
+    GET /api/tasks           state API list_tasks (+ ?summary=1)
+    GET /api/workers         state API list_workers
+    GET /api/objects         state API list_objects
+    GET /api/jobs            job list (ray_tpu.jobs)
+    GET /api/serve           serve application status (if running)
+    GET /api/timeline        chrome-trace events (open in chrome://tracing)
+    GET /api/usage           local host cpu/mem (reporter_agent.py role)
+    GET /healthz             200 ok (dashboard/modules/healthz)
+    GET /metrics             proxied controller Prometheus text
+"""
+from __future__ import annotations
+
+import json
+import threading
+from typing import Any, Optional
+
+from ray_tpu.util import state as state_api
+
+
+def _local_usage() -> dict:
+    try:
+        import psutil
+
+        vm = psutil.virtual_memory()
+        return {
+            "cpu_percent": psutil.cpu_percent(interval=None),
+            "mem_total": vm.total,
+            "mem_used": vm.used,
+            "mem_percent": vm.percent,
+        }
+    except Exception:
+        return {}
+
+
+_PAGE = """<!doctype html>
+<html><head><title>ray_tpu dashboard</title>
+<meta http-equiv="refresh" content="5">
+<style>
+ body {{ font-family: system-ui, sans-serif; margin: 2rem; color: #1a1a2e; }}
+ h1 {{ font-size: 1.3rem; }} h2 {{ font-size: 1.05rem; margin-top: 1.5rem; }}
+ table {{ border-collapse: collapse; width: 100%; font-size: .85rem; }}
+ th, td {{ text-align: left; padding: .3rem .6rem; border-bottom: 1px solid #ddd; }}
+ th {{ background: #f4f4f8; }}
+ .pill {{ padding: .1rem .5rem; border-radius: 999px; font-size: .75rem; }}
+ .ok {{ background: #e0f2e9; }} .bad {{ background: #fde2e2; }}
+ code {{ background: #f4f4f8; padding: .05rem .3rem; }}
+</style></head><body>
+<h1>ray_tpu dashboard</h1>
+<p>{cluster}</p>
+<h2>Nodes</h2>{nodes}
+<h2>Actors</h2>{actors}
+<h2>Task summary</h2>{tasks}
+<h2>Jobs</h2>{jobs}
+<p style="margin-top:2rem;color:#888">JSON under <code>/api/*</code>;
+Prometheus at <code>/metrics</code>; timeline at
+<code>/api/timeline</code>.</p>
+</body></html>"""
+
+
+def _table(rows, cols) -> str:
+    if not rows:
+        return "<p><i>none</i></p>"
+    head = "".join(f"<th>{c}</th>" for c in cols)
+    body = "".join(
+        "<tr>" + "".join(f"<td>{r.get(c, '')}</td>" for c in cols) + "</tr>"
+        for r in rows[:200]
+    )
+    return f"<table><tr>{head}</tr>{body}</table>"
+
+
+class Dashboard:
+    """aiohttp server bound to a running ray_tpu session."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 8265):
+        self.host = host
+        self.port = port
+        self._runner = None
+        self._thread: Optional[threading.Thread] = None
+        self._started = threading.Event()
+        self._stop = None  # asyncio.Event inside the loop
+        self._loop = None
+
+    # -- request handlers --------------------------------------------------
+    async def _index(self, request):
+        from aiohttp import web
+
+        try:
+            import ray_tpu
+
+            res = ray_tpu.cluster_resources()
+            avail = ray_tpu.available_resources()
+            cluster = (
+                f"resources: <code>{json.dumps(res)}</code> · "
+                f"available: <code>{json.dumps(avail)}</code>"
+            )
+        except Exception as e:
+            cluster = f"cluster unavailable: {e!r}"
+        nodes = _table(self._safe(state_api.list_nodes),
+                       ["node_id", "alive", "resources", "labels"])
+        actors = _table(self._safe(state_api.list_actors),
+                        ["actor_id", "class_name", "state", "node_id", "name"])
+        summary = self._safe(state_api.summarize_tasks) or {}
+        tasks = _table(
+            [{"func": k, **v} for k, v in summary.items()],
+            ["func", "running", "finished", "failed", "pending"],
+        )
+        jobs = _table(self._safe(self._jobs),
+                      ["job_id", "status", "entrypoint"])
+        return web.Response(
+            text=_PAGE.format(cluster=cluster, nodes=nodes, actors=actors,
+                              tasks=tasks, jobs=jobs),
+            content_type="text/html")
+
+    @staticmethod
+    def _safe(fn):
+        try:
+            return fn()
+        except Exception:
+            return []
+
+    @staticmethod
+    def _jobs():
+        from ray_tpu.jobs import JobSubmissionClient
+
+        return [vars(j) for j in JobSubmissionClient().list_jobs()]
+
+    async def _api(self, request):
+        from aiohttp import web
+
+        kind = request.match_info["kind"]
+        try:
+            if kind == "cluster":
+                import ray_tpu
+
+                data: Any = {
+                    "resources": ray_tpu.cluster_resources(),
+                    "available": ray_tpu.available_resources(),
+                    "nodes": state_api.list_nodes(),
+                }
+            elif kind == "nodes":
+                data = state_api.list_nodes()
+            elif kind == "actors":
+                data = state_api.list_actors()
+            elif kind == "tasks":
+                data = (state_api.summarize_tasks()
+                        if request.query.get("summary")
+                        else state_api.list_tasks())
+            elif kind == "workers":
+                data = state_api.list_workers()
+            elif kind == "objects":
+                data = state_api.list_objects()
+            elif kind == "jobs":
+                data = self._jobs()
+            elif kind == "serve":
+                from ray_tpu.serve.api import status as serve_status
+
+                data = serve_status()
+            elif kind == "timeline":
+                data = state_api.timeline()
+            elif kind == "usage":
+                data = _local_usage()
+            else:
+                return web.Response(status=404, text=f"unknown: {kind}")
+        except Exception as e:
+            return web.json_response({"error": repr(e)}, status=500)
+        return web.json_response(data, dumps=lambda o: json.dumps(o, default=str))
+
+    async def _healthz(self, request):
+        from aiohttp import web
+
+        return web.Response(text="ok")
+
+    async def _metrics(self, request):
+        from aiohttp import web
+
+        addr = state_api.metrics_address()
+        if not addr:
+            return web.Response(status=503, text="# metrics disabled\n")
+        import urllib.request
+
+        try:
+            with urllib.request.urlopen(f"http://{addr}/metrics",
+                                        timeout=2) as resp:
+                return web.Response(text=resp.read().decode(),
+                                    content_type="text/plain")
+        except Exception as e:
+            return web.Response(status=502, text=f"# scrape failed: {e!r}\n")
+
+    # -- lifecycle ---------------------------------------------------------
+    async def _serve(self):
+        import asyncio
+
+        from aiohttp import web
+
+        app = web.Application()
+        app.router.add_get("/", self._index)
+        app.router.add_get("/api/{kind}", self._api)
+        app.router.add_get("/healthz", self._healthz)
+        app.router.add_get("/metrics", self._metrics)
+        self._runner = web.AppRunner(app)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, self.host, self.port)
+        await site.start()
+        self.port = site._server.sockets[0].getsockname()[1]
+        self._stop = asyncio.Event()
+        self._started.set()
+        await self._stop.wait()
+        await self._runner.cleanup()
+
+    def start(self) -> str:
+        import asyncio
+
+        def body():
+            self._loop = asyncio.new_event_loop()
+            asyncio.set_event_loop(self._loop)
+            try:
+                self._loop.run_until_complete(self._serve())
+            finally:
+                self._loop.close()
+
+        self._thread = threading.Thread(target=body, daemon=True,
+                                        name="rtpu-dashboard")
+        self._thread.start()
+        if not self._started.wait(timeout=10):
+            raise RuntimeError("dashboard failed to start")
+        return f"http://{self.host}:{self.port}"
+
+    def stop(self) -> None:
+        if self._loop is not None and self._stop is not None:
+            self._loop.call_soon_threadsafe(self._stop.set)
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+
+def start_dashboard(host: str = "127.0.0.1", port: int = 8265) -> Dashboard:
+    """Start the dashboard against the current session; returns the handle
+    (``.port`` is the bound port — pass port=0 for ephemeral)."""
+    dash = Dashboard(host, port)
+    dash.start()
+    return dash

@@ -23,9 +23,24 @@ _lock = threading.Lock()
 
 
 def _execute(storage: WorkflowStorage, dag: DAGNode) -> Any:
+    if storage.get_meta().get("status") == "RUNNING" and storage.owner_alive():
+        raise RuntimeError(
+            f"workflow {storage.workflow_id!r} is already being driven by "
+            f"another process — concurrent execution would duplicate steps")
     cancel = threading.Event()
     with _lock:
         _running[storage.workflow_id] = cancel
+    storage.clear_cancel()
+    storage.touch_owner()
+    hb_stop = threading.Event()
+
+    def heartbeat():
+        while not hb_stop.wait(storage.HEARTBEAT_S):
+            storage.touch_owner()
+
+    hb = threading.Thread(target=heartbeat, daemon=True,
+                          name=f"wf-heartbeat-{storage.workflow_id}")
+    hb.start()
     storage.set_status("RUNNING")
     try:
         result = WorkflowExecutor(storage, cancel).run(dag)
@@ -40,6 +55,8 @@ def _execute(storage: WorkflowStorage, dag: DAGNode) -> Any:
         storage.set_status("SUCCESSFUL")
         return result
     finally:
+        hb_stop.set()
+        storage.clear_owner()
         with _lock:
             _running.pop(storage.workflow_id, None)
 
@@ -99,8 +116,9 @@ def resume_async(workflow_id: str, *, storage: Optional[str] = None) -> Future:
 def get_status(workflow_id: str, *, storage: Optional[str] = None) -> str:
     st = WorkflowStorage(workflow_id, storage)
     status = st.get_meta().get("status")
-    if status == "RUNNING" and workflow_id not in _running:
-        # The driving process died mid-run; the stored state is resumable.
+    if status == "RUNNING" and not st.owner_alive():
+        # The driving process (any process — liveness is heartbeat-based,
+        # not this-process-based) died mid-run; the state is resumable.
         return "RESUMABLE"
     return status or "UNKNOWN"
 
@@ -117,18 +135,21 @@ def get_output(workflow_id: str, *, storage: Optional[str] = None) -> Any:
 def list_all(*, storage: Optional[str] = None) -> List[Dict[str, Any]]:
     rows = list_workflows(storage)
     for r in rows:
-        if r.get("status") == "RUNNING" and r["workflow_id"] not in _running:
+        if r.get("status") == "RUNNING" and not WorkflowStorage(
+                r["workflow_id"], storage).owner_alive():
             r["status"] = "RESUMABLE"
     return rows
 
 
 def cancel(workflow_id: str, *, storage: Optional[str] = None) -> None:
+    st = WorkflowStorage(workflow_id, storage)
     with _lock:
         ev = _running.get(workflow_id)
     if ev is not None:
-        ev.set()
-    else:
-        WorkflowStorage(workflow_id, storage).set_status("CANCELED")
+        ev.set()  # in-process: interrupt between waves immediately
+    st.request_cancel()  # cross-process: the owner's executor polls this
+    if not st.owner_alive() and st.get_meta().get("status") == "RUNNING":
+        st.set_status("CANCELED")
 
 
 def delete(workflow_id: str, *, storage: Optional[str] = None) -> None:

@@ -41,6 +41,12 @@ class WorkflowCanceled(RuntimeError):
     pass
 
 
+def _catches(node: DAGNode) -> bool:
+    fn_opts = getattr(getattr(node, "_remote_fn", None), "_options", {}) or {}
+    return bool(getattr(node, "_options", {}).get("catch_exceptions")
+                or fn_opts.get("catch_exceptions"))
+
+
 def assign_step_ids(output: DAGNode) -> Dict[int, str]:
     """Stable ids: topological position + a human hint."""
     ids: Dict[int, str] = {}
@@ -78,15 +84,19 @@ class WorkflowExecutor:
             return all(id(u) in memo for u in n._upstream())
 
         def resolve_local(n: DAGNode) -> Any:
-            """Evaluate non-task nodes (input selectors, actor creation)."""
+            """Evaluate non-task nodes (input selectors, actor creation).
+
+            Passing the live memo is safe: every upstream is already
+            resolved, so _execute_memo only reads (plus writes this node's
+            own entry, which the caller overwrites with the same value).
+            """
             if isinstance(n, InputNode) or isinstance(n, InputAttributeNode) \
                     or isinstance(n, MultiOutputNode) or isinstance(n, ClassNode):
-                sub = dict(memo)
-                return n._execute_memo(sub)
+                return n._execute_memo(memo)
             raise AssertionError(type(n))
 
         while pending:
-            if self.cancel_event.is_set():
+            if self.cancel_event.is_set() or self.storage.cancel_requested():
                 raise WorkflowCanceled(self.storage.workflow_id)
             progressed = False
             for nid, node in list(pending.items()):
@@ -101,13 +111,19 @@ class WorkflowExecutor:
                 state = self.storage.step_state(step_id)
                 if state == "SUCCESSFUL":
                     value = self.storage.load_step_result(step_id)
-                    memo[nid] = self._maybe_continue(step_id, value)
+                    if isinstance(value, DAGNode):
+                        # Stored continuation: drive/resume it, then apply
+                        # catch wrapping to its *final* value (mirrors the
+                        # fresh path below).
+                        value = self._maybe_continue(step_id, value)
+                        if _catches(node):
+                            value = (value, None)
+                    memo[nid] = value
                     del pending[nid]
                     progressed = True
                     continue
                 # Submit: upstream values are plain objects in memo.
-                sub = dict(memo)
-                ref = node._execute_impl(sub)
+                ref = node._execute_impl(memo)
                 self.storage.log_event("step_started", step=step_id)
                 # Normalize num_returns variants: a list of refs (wait on
                 # the first, get them all) or None for num_returns=0.
@@ -130,11 +146,7 @@ class WorkflowExecutor:
                 ready, _ = api.wait(first_refs, num_returns=1, timeout=1.0)
                 for r in ready:
                     node, ref, step_id = inflight.pop(r.object_id)
-                    fn_opts = getattr(
-                        getattr(node, "_remote_fn", None), "_options", {}) or {}
-                    catch = bool(
-                        getattr(node, "_options", {}).get("catch_exceptions")
-                        or fn_opts.get("catch_exceptions"))
+                    catch = _catches(node)
                     try:
                         value = api.get(ref)
                     except Exception as e:  # step failed
@@ -150,8 +162,6 @@ class WorkflowExecutor:
                                                    error=repr(e))
                             raise
                     else:
-                        if catch:
-                            value = (value, None)
                         if isinstance(value, DAGNode):
                             # Continuation: checkpoint the step as SUCCESSFUL
                             # with the DAG node as its value BEFORE driving
@@ -159,9 +169,15 @@ class WorkflowExecutor:
                             # re-run this step's body (side effects!). Resume
                             # then re-enters the continuation via
                             # _maybe_continue on the stored DAGNode value.
+                            # catch_exceptions wraps the continuation's FINAL
+                            # value, not the intermediate node.
                             self.storage.save_step_result(step_id, value)
                             value = self._maybe_continue(step_id, value)
+                            if catch:
+                                value = (value, None)
                         else:
+                            if catch:
+                                value = (value, None)
                             self.storage.save_step_result(step_id, value)
                         self.storage.log_event("step_finished", step=step_id)
                     memo[id(node)] = value

@@ -106,6 +106,53 @@ class WorkflowStorage:
         with open(path) as f:
             return json.load(f)
 
+    # -- ownership / liveness ---------------------------------------------
+    # A RUNNING status alone cannot distinguish "another process is driving
+    # this workflow right now" from "the driver died mid-run" — both matter:
+    # the first must refuse a concurrent resume (duplicate side effects),
+    # the second must surface as RESUMABLE. The driving process maintains a
+    # heartbeat file; liveness = heartbeat fresher than LIVENESS_S.
+    HEARTBEAT_S = 2.0
+    LIVENESS_S = 10.0
+
+    def _owner_path(self) -> str:
+        return os.path.join(self.dir, "owner.json")
+
+    def touch_owner(self) -> None:
+        import socket
+
+        _write_json_atomic(
+            self._owner_path(),
+            {"pid": os.getpid(), "host": socket.gethostname(),
+             "ts": time.time()})
+
+    def clear_owner(self) -> None:
+        try:
+            os.remove(self._owner_path())
+        except OSError:
+            pass
+
+    def owner_alive(self) -> bool:
+        try:
+            with open(self._owner_path()) as f:
+                ts = json.load(f).get("ts", 0)
+        except (OSError, ValueError):
+            return False
+        return (time.time() - ts) < self.LIVENESS_S
+
+    def request_cancel(self) -> None:
+        _write_json_atomic(os.path.join(self.dir, "cancel.json"),
+                           {"ts": time.time()})
+
+    def cancel_requested(self) -> bool:
+        return os.path.exists(os.path.join(self.dir, "cancel.json"))
+
+    def clear_cancel(self) -> None:
+        try:
+            os.remove(os.path.join(self.dir, "cancel.json"))
+        except OSError:
+            pass
+
     def log_event(self, event: str, **fields) -> None:
         rec = {"ts": time.time(), "event": event, **fields}
         with open(os.path.join(self.dir, "events.jsonl"), "a") as f:
@@ -119,7 +166,7 @@ class WorkflowStorage:
     def save_step_result(self, step_id: str, value: Any,
                          *, is_exception: bool = False) -> None:
         pkl, meta = self._step_paths(step_id)
-        tmp = pkl + ".tmp"
+        tmp = f"{pkl}.tmp{os.getpid()}"
         with open(tmp, "wb") as f:
             # DurablePickler: a continuation checkpoint is a DAGNode holding
             # RemoteFunction handles — those must carry their code.

@@ -1,0 +1,77 @@
+"""Web dashboard: HTML overview + JSON API endpoints.
+
+Reference behaviors matched: dashboard head HTTP server
+(dashboard/http_server_head.py) serving node/actor/task/job state
+(dashboard/modules/*), healthz, and the metrics surface.
+"""
+import json
+import urllib.request
+
+import pytest
+
+import ray_tpu
+from ray_tpu.dashboard import start_dashboard
+
+
+@pytest.fixture(scope="module")
+def dash(ray_start_regular):
+    d = start_dashboard(port=0)  # ephemeral port
+    yield d
+    d.stop()
+
+
+def _get(d, path):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{d.port}{path}", timeout=10) as r:
+        return r.status, r.read().decode()
+
+
+def test_healthz_and_index(dash):
+    status, body = _get(dash, "/healthz")
+    assert status == 200 and body == "ok"
+    status, body = _get(dash, "/")
+    assert status == 200
+    assert "ray_tpu dashboard" in body
+    assert "Nodes" in body and "Actors" in body
+
+
+def test_api_cluster_nodes_actors(dash):
+    @ray_tpu.remote
+    class Pinger:
+        def ping(self):
+            return "pong"
+
+    a = Pinger.options(name="dash-pinger").remote()
+    assert ray_tpu.get(a.ping.remote()) == "pong"
+
+    status, body = _get(dash, "/api/cluster")
+    data = json.loads(body)
+    assert status == 200 and "CPU" in data["resources"]
+    assert len(data["nodes"]) >= 1
+
+    status, body = _get(dash, "/api/actors")
+    actors = json.loads(body)
+    assert any(x.get("name") == "dash-pinger" for x in actors)
+
+    status, body = _get(dash, "/api/tasks?summary=1")
+    assert status == 200
+    ray_tpu.kill(a)
+
+
+def test_api_usage_and_unknown(dash):
+    status, body = _get(dash, "/api/usage")
+    data = json.loads(body)
+    assert status == 200 and "cpu_percent" in data
+    with pytest.raises(urllib.error.HTTPError):
+        _get(dash, "/api/nope")
+
+
+def test_timeline_endpoint(dash):
+    @ray_tpu.remote
+    def traced():
+        return 1
+
+    ray_tpu.get(traced.remote())
+    status, body = _get(dash, "/api/timeline")
+    events = json.loads(body)
+    assert status == 200 and isinstance(events, list)
