@@ -1,0 +1,138 @@
+"""Attention implementation shootout at bench shapes (B=8,S=1024,H=16,D=64).
+
+Compares our Pallas flash kernel (several block configs) against plain XLA
+attention and the jax-shipped Pallas kernels, fwd and fwd+bwd, everything
+looped inside one jit to mask the ~3ms axon dispatch latency.
+
+Usage: PYTHONPATH=/root/repo python benchmarks/probe_attn2.py
+"""
+from __future__ import annotations
+
+import functools
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from ray_tpu.util.jaxenv import ensure_platform
+
+ensure_platform()
+
+import jax
+import jax.numpy as jnp
+
+B, S, H, D = 8, 1024, 16, 64
+FWD_FLOPS = 2 * 2 * B * H * S * S * D * 0.5  # causal
+BWD_FLOPS = FWD_FLOPS * 2.5
+
+
+def timeit(fn, args, iters=3):
+    out = fn(*args)
+    jax.tree.map(lambda a: a.block_until_ready(), out)
+    best = float("inf")
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        out = fn(*args)
+        jax.tree.map(lambda a: a.block_until_ready(), out)
+        float(jnp.asarray(jax.tree.leaves(out)[0]).ravel()[0])
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def make_inputs():
+    ks = jax.random.split(jax.random.key(0), 3)
+    q = jax.random.normal(ks[0], (B, S, H, D), jnp.bfloat16)
+    k = jax.random.normal(ks[1], (B, S, H, D), jnp.bfloat16)
+    v = jax.random.normal(ks[2], (B, S, H, D), jnp.bfloat16)
+    return q, k, v
+
+
+def bench_fwd(name, attn_fn, inner=20):
+    q, k, v = make_inputs()
+
+    @jax.jit
+    def f(q, k, v):
+        def body(_, c):
+            o = attn_fn(c, k, v)
+            return o.astype(jnp.bfloat16)
+        return jax.lax.fori_loop(0, inner, body, q)
+
+    dt = timeit(f, (q, k, v)) / inner
+    return {"probe": f"{name}_fwd", "ms": round(dt * 1e3, 3),
+            "tflops": round(FWD_FLOPS / dt / 1e12, 1)}
+
+
+def bench_bwd(name, attn_fn, inner=10):
+    q, k, v = make_inputs()
+
+    def loss(q, k, v):
+        return attn_fn(q, k, v).astype(jnp.float32).sum()
+
+    g = jax.grad(loss, argnums=(0, 1, 2))
+
+    @jax.jit
+    def f(q, k, v):
+        def body(_, c):
+            dq, dk, dv = g(*c)
+            return (dq.astype(jnp.bfloat16), dk.astype(jnp.bfloat16),
+                    dv.astype(jnp.bfloat16))
+        return jax.lax.fori_loop(0, inner, body, (q, k, v))
+
+    dt = timeit(f, (q, k, v)) / inner
+    return {"probe": f"{name}_fwdbwd", "ms": round(dt * 1e3, 3),
+            "tflops": round((FWD_FLOPS + BWD_FLOPS) / dt / 1e12, 1)}
+
+
+def ours(bq, bk):
+    from ray_tpu.ops.flash_attention import flash_attention
+
+    return lambda q, k, v: flash_attention(q, k, v, causal=True,
+                                           block_q=bq, block_k=bk)
+
+
+def xla_ref(q, k, v):
+    from ray_tpu.ops.attention import reference_attention
+
+    return reference_attention(q, k, v, causal=True)
+
+
+def jax_flash(q, k, v):
+    from jax.experimental.pallas.ops.tpu.flash_attention import (
+        BlockSizes, flash_attention as fa)
+
+    # expects [B, H, S, D]
+    qt, kt, vt = (jnp.swapaxes(x, 1, 2) for x in (q, k, v))
+    o = fa(qt, kt, vt, causal=True, sm_scale=D ** -0.5)
+    return jnp.swapaxes(o, 1, 2)
+
+
+def jax_splash(q, k, v):
+    from jax.experimental.pallas.ops.tpu.splash_attention import (
+        splash_attention_kernel as sk, splash_attention_mask as sm)
+
+    mask = sm.MultiHeadMask([sm.CausalMask((S, S)) for _ in range(H)])
+    kernel = sk.make_splash_mha_single_device(mask=mask)
+    qt, kt, vt = (jnp.swapaxes(x, 1, 2) for x in (q, k, v))
+    out = jax.vmap(kernel)(qt * (D ** -0.5), kt, vt)
+    return jnp.swapaxes(out, 1, 2)
+
+
+if __name__ == "__main__":
+    jobs = [
+        ("ours_b512", ours(512, 512)),
+        ("ours_b256", ours(256, 256)),
+        ("ours_b128", ours(128, 128)),
+        ("ours_bq256_bk1024", ours(256, 1024)),
+        ("xla_ref", xla_ref),
+        ("jax_flash", jax_flash),
+        ("jax_splash", jax_splash),
+    ]
+    for name, fn in jobs:
+        for bench in (bench_fwd, bench_bwd):
+            try:
+                print(json.dumps(bench(name, fn)), flush=True)
+            except Exception as e:
+                print(json.dumps({"probe": name, "error": repr(e)[:200]}),
+                      flush=True)

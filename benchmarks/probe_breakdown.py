@@ -1,0 +1,187 @@
+"""Per-component MFU breakdown on the real chip.
+
+Every probe loops INSIDE one jitted program (lax.fori_loop / scan) so the
+~3ms axon per-dispatch latency (benchmarks/probe_ceiling.py "dispatch")
+cannot pollute the measurement. Prints one JSON line per probe.
+
+Usage: PYTHONPATH=/root/repo python benchmarks/probe_breakdown.py
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from ray_tpu.util.jaxenv import ensure_platform
+
+ensure_platform()
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def timeit(fn, *args, iters=3):
+    """fn must be jitted and internally looped; returns best wall seconds."""
+    out = fn(*args)
+    jax.tree.map(lambda a: a.block_until_ready(), out)
+    best = float("inf")
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        out = fn(*args)
+        jax.tree.map(lambda a: a.block_until_ready(), out)
+        # honest fence: D2H one scalar
+        float(jnp.asarray(jax.tree.leaves(out)[0]).ravel()[0])
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def probe_matmul_fused(n=4096, inner=50):
+    """True MXU ceiling: chained matmuls inside ONE jit."""
+    a = jax.random.normal(jax.random.key(0), (n, n), jnp.bfloat16)
+    b = jax.random.normal(jax.random.key(1), (n, n), jnp.bfloat16)
+
+    @jax.jit
+    def f(a, b):
+        def body(_, x):
+            return (x @ b) * (1.0 / n)
+        return jax.lax.fori_loop(0, inner, body, a)
+
+    dt = timeit(f, a, b)
+    fl = 2 * n**3 * inner
+    return {"probe": f"matmul{n}_fused", "tflops": round(fl / dt / 1e12, 1)}
+
+
+def probe_flash(batch=8, seq=1024, heads=16, hd=64, inner=20, bwd=False):
+    from ray_tpu.ops.flash_attention import flash_attention
+
+    k = jax.random.key(0)
+    q = jax.random.normal(k, (batch, seq, heads, hd), jnp.bfloat16)
+    kk = jax.random.normal(jax.random.key(1), q.shape, jnp.bfloat16)
+    v = jax.random.normal(jax.random.key(2), q.shape, jnp.bfloat16)
+
+    if bwd:
+        def one(q, k, v):
+            f = lambda q, k, v: flash_attention(q, k, v, causal=True).astype(
+                jnp.float32).sum()
+            return jax.grad(f, argnums=(0, 1, 2))(q, k, v)
+
+        @jax.jit
+        def f(q, kk, v):
+            def body(_, c):
+                dq, dk, dv = one(c[0], c[1], c[2])
+                return (dq.astype(jnp.bfloat16), dk.astype(jnp.bfloat16),
+                        dv.astype(jnp.bfloat16))
+            return jax.lax.fori_loop(0, inner, body, (q, kk, v))
+    else:
+        @jax.jit
+        def f(q, kk, v):
+            def body(_, c):
+                return flash_attention(c, kk, v, causal=True)
+            return jax.lax.fori_loop(0, inner, body, q)
+
+    dt = timeit(f, q, kk, v)
+    # causal attention flops: 2 matmuls * B*H*S*S*hd * 0.5 (causal) fwd;
+    # bwd adds ~2.5x fwd
+    fwd_fl = 2 * 2 * batch * heads * seq * seq * hd * 0.5
+    fl = (fwd_fl * 3.5 if bwd else fwd_fl) * inner
+    return {"probe": "flash_bwd" if bwd else "flash_fwd",
+            "ms_per": round(dt / inner * 1e3, 3),
+            "tflops": round(fl / dt / 1e12, 1)}
+
+
+def probe_lm_head_loss(batch=8, seq=1024, d=1024, V=32000, inner=10):
+    """embed-lookup + lm_head + fused CE loss, fwd+bwd."""
+    from ray_tpu.models import transformer as tfm
+    from ray_tpu.models.configs import bench_350m
+
+    cfg = bench_350m()
+    emb = jax.random.normal(jax.random.key(0), (V, d), jnp.float32) * 0.02
+    x = jax.random.normal(jax.random.key(1), (batch, seq, d), jnp.bfloat16)
+    fnorm = jnp.ones((d,), jnp.float32)
+    tokens = jnp.asarray(
+        np.random.default_rng(0).integers(0, V, (batch, seq), dtype=np.int32))
+
+    def loss(emb, x):
+        params = {"embed": emb, "final_norm": fnorm}
+        logits = tfm.lm_head(params, x, cfg)
+        return tfm.next_token_loss(logits, {"tokens": tokens})
+
+    g = jax.grad(loss, argnums=(0, 1))
+
+    @jax.jit
+    def f(emb, x):
+        def body(_, c):
+            de, dx = g(c[0], c[1].astype(jnp.bfloat16))
+            return (c[0] - 1e-9 * de, dx)
+        return jax.lax.fori_loop(0, inner, body, (emb, x))
+
+    dt = timeit(f, emb, x)
+    fl = 6 * batch * seq * d * V * inner  # fwd+bwd of the [BS,d]x[d,V] matmul
+    return {"probe": "lm_head_loss_fwdbwd",
+            "ms_per": round(dt / inner * 1e3, 2),
+            "tflops": round(fl / dt / 1e12, 1)}
+
+
+def probe_layers_only(batch=8, seq=1024, remat=False, policy="dots", inner=4):
+    """Scan over 24 layers, fwd+bwd, NO embed/lm_head — isolates the stack."""
+    import dataclasses
+
+    from ray_tpu.models import transformer as tfm
+    from ray_tpu.models.configs import bench_350m
+
+    cfg = bench_350m(remat=remat, remat_policy=policy)
+    L = cfg.n_layers
+    key = jax.random.key(0)
+    params = jax.jit(lambda k: tfm.init_params(k, cfg))(key)
+    layers = params["layers"]
+    x = jax.random.normal(jax.random.key(1), (batch, seq, cfg.d_model),
+                          jnp.bfloat16)
+    positions = jnp.broadcast_to(
+        jnp.arange(seq, dtype=jnp.int32)[None], (batch, seq))
+
+    def stack_loss(layers, x):
+        body = tfm.layer_scan_body(cfg, positions)
+        out, aux = jax.lax.scan(body, x, layers)
+        return out.astype(jnp.float32).mean()
+
+    g = jax.value_and_grad(stack_loss)
+
+    @jax.jit
+    def f(layers, x):
+        def body(_, c):
+            ly, xx = c
+            loss, dl = g(ly, xx)
+            ly = jax.tree.map(lambda p, d: p - 1e-9 * d, ly, dl)
+            return (ly, xx)
+        return jax.lax.fori_loop(0, inner, body, (layers, x))
+
+    dt = timeit(f, layers, x)
+    # per-token flops in the stack: 6*(stack params) + attn term
+    stack_params = sum(int(np.prod(a.shape)) for a in jax.tree.leaves(layers))
+    fl = (6 * stack_params + 12 * L * seq * cfg.d_model) * batch * seq * inner
+    return {"probe": "layers_fwdbwd", "remat": remat,
+            "policy": policy if remat else None,
+            "ms_per": round(dt / inner * 1e3, 1),
+            "tflops": round(fl / dt / 1e12, 1)}
+
+
+if __name__ == "__main__":
+    jobs = [
+        lambda: probe_matmul_fused(4096),
+        lambda: probe_matmul_fused(8192, inner=15),
+        lambda: probe_flash(bwd=False),
+        lambda: probe_flash(bwd=True, inner=10),
+        lambda: probe_lm_head_loss(),
+        lambda: probe_layers_only(remat=False),
+        lambda: probe_layers_only(remat=True, policy="dots"),
+        lambda: probe_layers_only(remat=True, policy="min"),
+    ]
+    for fn in jobs:
+        try:
+            print(json.dumps(fn()), flush=True)
+        except Exception as e:
+            print(json.dumps({"error": repr(e)[:300]}), flush=True)

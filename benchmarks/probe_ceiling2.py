@@ -1,0 +1,165 @@
+"""Second-round ceiling probes.
+
+1. MXU sustained rate: accumulate c += a@b (no chain rescale) at several
+   sizes, bf16 and int8, inside one jit.
+2. Attention-in-context: the 24-layer bench stack fwd+bwd with
+   flash / XLA reference / identity attention — isolates what attention
+   actually costs inside the compiled model vs standalone probes.
+
+Usage: PYTHONPATH=/root/repo python benchmarks/probe_ceiling2.py
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+from unittest import mock
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from ray_tpu.util.jaxenv import ensure_platform
+
+ensure_platform()
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def timeit(fn, args, iters=3):
+    out = fn(*args)
+    jax.tree.map(lambda a: a.block_until_ready(), out)
+    best = float("inf")
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        out = fn(*args)
+        jax.tree.map(lambda a: a.block_until_ready(), out)
+        float(jnp.asarray(jax.tree.leaves(out)[0]).ravel()[0])
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def probe_mxu_acc(n, inner=30, dtype="bf16"):
+    if dtype == "int8":
+        a = jnp.ones((n, n), jnp.int8)
+        b = jnp.ones((n, n), jnp.int8)
+        acc0 = jnp.zeros((n, n), jnp.int32)
+        pet = jnp.int32
+    else:
+        a = jax.random.normal(jax.random.key(0), (n, n), jnp.bfloat16)
+        b = jax.random.normal(jax.random.key(1), (n, n), jnp.bfloat16)
+        acc0 = jnp.zeros((n, n), jnp.float32)
+        pet = jnp.float32
+
+    @jax.jit
+    def f(a, b, acc):
+        def body(i, acc):
+            # a is scaled by i so the matmul can't be hoisted as
+            # loop-invariant; the scale is rank-0 (free on VPU).
+            return acc + jax.lax.dot_general(
+                a * i.astype(a.dtype), b, (((1,), (0,)), ((), ())),
+                preferred_element_type=pet)
+        return jax.lax.fori_loop(0, inner, body, acc)
+
+    dt = timeit(f, (a, b, acc0))
+    fl = 2 * n**3 * inner
+    return {"probe": f"mxu_acc_{dtype}_{n}",
+            "tflops": round(fl / dt / 1e12, 1)}
+
+
+def probe_stack(attn_mode: str, inner=4):
+    from ray_tpu.models import transformer as tfm
+    from ray_tpu.models.configs import bench_350m
+    from ray_tpu.ops import attention as attn_mod
+
+    cfg = bench_350m(remat=True, remat_policy="dots")
+    batch, seq = 8, 1024
+    params = jax.jit(lambda k: tfm.init_params(k, cfg))(jax.random.key(0))
+    layers = params["layers"]
+    x = jax.random.normal(jax.random.key(1), (batch, seq, cfg.d_model),
+                          jnp.bfloat16)
+    positions = jnp.broadcast_to(
+        jnp.arange(seq, dtype=jnp.int32)[None], (batch, seq))
+
+    if attn_mode == "identity":
+        patcher = mock.patch.object(
+            attn_mod, "attention", lambda q, k, v, **kw: q)
+    elif attn_mode == "reference":
+        patcher = mock.patch.object(
+            attn_mod, "attention",
+            lambda q, k, v, **kw: attn_mod.reference_attention(
+                q, k, v, causal=True))
+    else:
+        patcher = None
+
+    def build():
+        def stack_loss(layers, x):
+            body = tfm.layer_scan_body(cfg, positions)
+            out, _ = jax.lax.scan(body, x, layers)
+            return out.astype(jnp.float32).mean()
+
+        g = jax.value_and_grad(stack_loss)
+
+        @jax.jit
+        def f(layers, x):
+            def body(_, c):
+                ly, xx = c
+                loss, dl = g(ly, xx)
+                ly = jax.tree.map(lambda p, d: p - 1e-9 * d, ly, dl)
+                return (ly, xx)
+            return jax.lax.fori_loop(0, inner, body, (layers, x))
+
+        return f
+
+    # transformer.py imports `attention` by name — patch there too.
+    if patcher:
+        with patcher:
+            with mock.patch.object(tfm, "attention",
+                                   attn_mod.attention):
+                f = build()
+                dt = timeit(f, (layers, x))
+    else:
+        f = build()
+        dt = timeit(f, (layers, x))
+    return {"probe": f"stack24_{attn_mode}",
+            "ms_per_step": round(dt / inner * 1e3, 1)}
+
+
+def probe_single_flash_calls(n_calls=24):
+    """n_calls chained flash fwd in one jit — mirrors the scan's usage."""
+    from ray_tpu.ops.flash_attention import flash_attention
+
+    B, S, H, D = 8, 1024, 16, 64
+    ks = jax.random.split(jax.random.key(0), 3)
+    q = jax.random.normal(ks[0], (B, S, H, D), jnp.bfloat16)
+    k = jax.random.normal(ks[1], (B, S, H, D), jnp.bfloat16)
+    v = jax.random.normal(ks[2], (B, S, H, D), jnp.bfloat16)
+
+    @jax.jit
+    def f(q, k, v):
+        def body(_, c):
+            return flash_attention(c, k, v, causal=True).astype(jnp.bfloat16)
+        return jax.lax.fori_loop(0, n_calls, body, q)
+
+    dt = timeit(f, (q, k, v))
+    return {"probe": "flash_fwd_x24_fori", "ms_per_call":
+            round(dt / n_calls * 1e3, 3)}
+
+
+if __name__ == "__main__":
+    jobs = [
+        lambda: probe_mxu_acc(4096),
+        lambda: probe_mxu_acc(8192, inner=15),
+        lambda: probe_mxu_acc(16384, inner=6),
+        lambda: probe_mxu_acc(8192, inner=15, dtype="int8"),
+        lambda: probe_stack("flash"),
+        lambda: probe_stack("reference"),
+        lambda: probe_stack("identity"),
+        probe_single_flash_calls,
+    ]
+    for fn in jobs:
+        try:
+            print(json.dumps(fn()), flush=True)
+        except Exception as e:
+            print(json.dumps({"error": repr(e)[:300]}), flush=True)

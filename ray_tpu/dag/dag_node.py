@@ -228,6 +228,13 @@ class InputNode(DAGNode):
 
     def _execute_impl(self, memo):
         args, kwargs = memo.get("__input__", ((), {}))
+        if kwargs and args:
+            # Silently returning only args would make inp['key'] selectors
+            # read wrong data; mirror the reference's DAGInputData contract
+            # by refusing the ambiguous mix outright.
+            raise TypeError(
+                "DAG execute() got both positional and keyword inputs; "
+                "pass one or the other (use a dict input for named access)")
         if kwargs and not args:
             return kwargs
         if len(args) == 1 and not kwargs:

@@ -28,6 +28,7 @@ Endpoints:
 """
 from __future__ import annotations
 
+import html
 import json
 import threading
 from typing import Any, Optional
@@ -78,9 +79,13 @@ Prometheus at <code>/metrics</code>; timeline at
 def _table(rows, cols) -> str:
     if not rows:
         return "<p><i>none</i></p>"
-    head = "".join(f"<th>{c}</th>" for c in cols)
+    # Every cell is user-controlled data (actor names, job entrypoints,
+    # labels) — escape or a crafted name is stored XSS in the viewer.
+    head = "".join(f"<th>{html.escape(str(c))}</th>" for c in cols)
     body = "".join(
-        "<tr>" + "".join(f"<td>{r.get(c, '')}</td>" for c in cols) + "</tr>"
+        "<tr>"
+        + "".join(f"<td>{html.escape(str(r.get(c, '')))}</td>" for c in cols)
+        + "</tr>"
         for r in rows[:200]
     )
     return f"<table><tr>{head}</tr>{body}</table>"
@@ -108,11 +113,11 @@ class Dashboard:
             res = ray_tpu.cluster_resources()
             avail = ray_tpu.available_resources()
             cluster = (
-                f"resources: <code>{json.dumps(res)}</code> · "
-                f"available: <code>{json.dumps(avail)}</code>"
+                f"resources: <code>{html.escape(json.dumps(res))}</code> · "
+                f"available: <code>{html.escape(json.dumps(avail))}</code>"
             )
         except Exception as e:
-            cluster = f"cluster unavailable: {e!r}"
+            cluster = f"cluster unavailable: {html.escape(repr(e))}"
         nodes = _table(self._safe(state_api.list_nodes),
                        ["node_id", "alive", "resources", "labels"])
         actors = _table(self._safe(state_api.list_actors),
@@ -194,13 +199,20 @@ class Dashboard:
         addr = state_api.metrics_address()
         if not addr:
             return web.Response(status=503, text="# metrics disabled\n")
+        import asyncio
         import urllib.request
 
-        try:
+        def scrape() -> str:
             with urllib.request.urlopen(f"http://{addr}/metrics",
                                         timeout=2) as resp:
-                return web.Response(text=resp.read().decode(),
-                                    content_type="text/plain")
+                return resp.read().decode()
+
+        try:
+            # Blocking scrape goes to a thread: a slow/hung controller must
+            # not stall every other dashboard request for the 2s timeout.
+            text = await asyncio.get_running_loop().run_in_executor(
+                None, scrape)
+            return web.Response(text=text, content_type="text/plain")
         except Exception as e:
             return web.Response(status=502, text=f"# scrape failed: {e!r}\n")
 

@@ -68,15 +68,35 @@ def run(dag: DAGNode, *, workflow_id: Optional[str] = None,
         raise TypeError("workflow.run expects a DAG node (use .bind())")
     workflow_id = workflow_id or f"workflow-{uuid.uuid4().hex[:8]}"
     st = WorkflowStorage(workflow_id, storage)
-    st.save_dag(dag)
+    _claim_fresh(st, dag)
     return _execute(st, dag)
+
+
+def _claim_fresh(st: WorkflowStorage, dag: DAGNode) -> None:
+    """Atomically claim a workflow id by publishing its DAG.
+
+    A second run() with the same id would overwrite dag.pkl while step
+    checkpoints from the OLD dag still exist; colliding step ids would then
+    replay stale results into the new DAG. The reference resumes the stored
+    workflow unchanged or errors; we raise and point at resume()/delete().
+    The claim is an exclusive link (no check-then-act window), so two
+    concurrent run() calls on one id cannot both start executing.
+    """
+    try:
+        st.save_dag(dag, exclusive=True)
+    except FileExistsError:
+        raise ValueError(
+            f"workflow {st.workflow_id!r} already exists "
+            f"(status={st.get_meta().get('status')}). Use workflow.resume() "
+            f"to continue it, or workflow.delete() before reusing the id."
+        ) from None
 
 
 def run_async(dag: DAGNode, *, workflow_id: Optional[str] = None,
               storage: Optional[str] = None) -> Future:
     workflow_id = workflow_id or f"workflow-{uuid.uuid4().hex[:8]}"
     st = WorkflowStorage(workflow_id, storage)
-    st.save_dag(dag)
+    _claim_fresh(st, dag)
     fut: Future = Future()
 
     def body():
@@ -143,6 +163,9 @@ def list_all(*, storage: Optional[str] = None) -> List[Dict[str, Any]]:
 
 def cancel(workflow_id: str, *, storage: Optional[str] = None) -> None:
     st = WorkflowStorage(workflow_id, storage)
+    if not st.has_dag():
+        # No such workflow: writing cancel.json would litter an empty dir.
+        return
     with _lock:
         ev = _running.get(workflow_id)
     if ev is not None:

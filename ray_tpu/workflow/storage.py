@@ -77,12 +77,35 @@ class WorkflowStorage:
         self.workflow_id = workflow_id
         self.root = root or default_storage_root()
         self.dir = os.path.join(self.root, workflow_id)
+
+    def _ensure_dir(self) -> None:
+        # Created lazily on first WRITE: read-only calls (get_status on a
+        # typo'd id, list_all) must not litter empty workflow dirs.
         os.makedirs(os.path.join(self.dir, "steps"), exist_ok=True)
 
     # -- workflow-level ----------------------------------------------------
-    def save_dag(self, node: Any, name: str = "dag.pkl") -> None:
-        with open(os.path.join(self.dir, name), "wb") as f:
-            _DurablePickler(f).dump(node)
+    def save_dag(self, node: Any, name: str = "dag.pkl",
+                 *, exclusive: bool = False) -> None:
+        """Atomically persist the DAG (tmp + rename: a crash mid-pickle must
+        never leave a truncated dag.pkl that wedges the id). With
+        ``exclusive`` the publish is an os.link, which fails with
+        FileExistsError if another racer already claimed the id — the
+        atomic claim backing workflow.run()'s fresh-id check."""
+        self._ensure_dir()
+        path = os.path.join(self.dir, name)
+        tmp = f"{path}.tmp{os.getpid()}"
+        try:
+            with open(tmp, "wb") as f:
+                _DurablePickler(f).dump(node)
+            if exclusive:
+                os.link(tmp, path)  # atomic create-if-absent
+            else:
+                os.replace(tmp, path)
+        finally:
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
 
     def load_dag(self, name: str = "dag.pkl") -> Any:
         with open(os.path.join(self.dir, name), "rb") as f:
@@ -92,6 +115,7 @@ class WorkflowStorage:
         return os.path.exists(os.path.join(self.dir, name))
 
     def set_status(self, status: str) -> None:
+        self._ensure_dir()
         meta = self.get_meta()
         meta["status"] = status
         meta.setdefault("created_at", time.time())
@@ -121,6 +145,7 @@ class WorkflowStorage:
     def touch_owner(self) -> None:
         import socket
 
+        self._ensure_dir()
         _write_json_atomic(
             self._owner_path(),
             {"pid": os.getpid(), "host": socket.gethostname(),
@@ -141,6 +166,7 @@ class WorkflowStorage:
         return (time.time() - ts) < self.LIVENESS_S
 
     def request_cancel(self) -> None:
+        self._ensure_dir()
         _write_json_atomic(os.path.join(self.dir, "cancel.json"),
                            {"ts": time.time()})
 
@@ -154,6 +180,7 @@ class WorkflowStorage:
             pass
 
     def log_event(self, event: str, **fields) -> None:
+        self._ensure_dir()
         rec = {"ts": time.time(), "event": event, **fields}
         with open(os.path.join(self.dir, "events.jsonl"), "a") as f:
             f.write(json.dumps(rec) + "\n")
@@ -165,6 +192,7 @@ class WorkflowStorage:
 
     def save_step_result(self, step_id: str, value: Any,
                          *, is_exception: bool = False) -> None:
+        self._ensure_dir()
         pkl, meta = self._step_paths(step_id)
         tmp = f"{pkl}.tmp{os.getpid()}"
         with open(tmp, "wb") as f:
@@ -195,7 +223,6 @@ class WorkflowStorage:
         sub.workflow_id = self.workflow_id
         sub.root = self.root
         sub.dir = os.path.join(self.dir, "steps", step_id + ".sub")
-        os.makedirs(os.path.join(sub.dir, "steps"), exist_ok=True)
         return sub
 
 

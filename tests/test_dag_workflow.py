@@ -242,3 +242,43 @@ def test_workflow_delete_and_async(ray_start_regular, tmp_path):
     assert fut.result(timeout=30) == 42
     workflow.delete("wfa", storage=str(tmp_path))
     assert workflow.list_all(storage=str(tmp_path)) == []
+
+
+# ------------------------------------------------------- round-4 regressions
+
+
+def test_input_node_mixed_args_kwargs_raises(ray_start_regular):
+    """Mixed positional+keyword execute() input is ambiguous — must raise,
+    not silently drop the kwargs (round-3 advisor finding)."""
+    with InputNode() as inp:
+        dag = double.bind(inp)
+    with pytest.raises(Exception, match="positional and keyword"):
+        ray_tpu.get(dag.execute(1, y=2))
+
+
+def test_workflow_run_refuses_reused_id(ray_start_regular, tmp_path):
+    """run() with an existing workflow id must not mix stale checkpoints
+    from a different DAG into the new run (round-3 advisor finding)."""
+    assert workflow.run(add.bind(1, 2), workflow_id="wreuse",
+                        storage=str(tmp_path)) == 3
+    with pytest.raises(ValueError, match="already exists"):
+        workflow.run(add.bind(5, 6), workflow_id="wreuse",
+                     storage=str(tmp_path))
+    # resume still returns the stored result; delete frees the id.
+    assert workflow.resume("wreuse", storage=str(tmp_path)) == 3
+    workflow.delete("wreuse", storage=str(tmp_path))
+    assert workflow.run(add.bind(5, 6), workflow_id="wreuse",
+                        storage=str(tmp_path)) == 11
+
+
+def test_workflow_reads_do_not_create_dirs(tmp_path):
+    """get_status/list on a nonexistent id must not litter empty dirs."""
+    import os
+
+    from ray_tpu.workflow.storage import WorkflowStorage
+
+    st = WorkflowStorage("no-such-wf", str(tmp_path))
+    assert st.get_meta() == {}
+    assert not st.has_dag()
+    assert workflow.get_status("no-such-wf", storage=str(tmp_path)) == "UNKNOWN"
+    assert not os.path.exists(os.path.join(str(tmp_path), "no-such-wf"))
