@@ -9,7 +9,11 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
+import sys
 import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax
 import numpy as np
@@ -20,11 +24,11 @@ from ray_tpu.train.step import transformer_train_step
 from ray_tpu.util.accelerators import peak_flops_per_chip
 
 
-def run_variant(remat, policy, batch, seq, steps, warmup=2):
+def run_variant(remat, policy, batch, seq, steps, warmup=2, shift=False):
     cfg = bench_350m(remat=remat, remat_policy=policy)
     dev = jax.devices()[0]
     mesh = make_mesh(MeshSpec(), devices=[dev])
-    ts = transformer_train_step(cfg, mesh, rules=RULES_DP)
+    ts = transformer_train_step(cfg, mesh, rules=RULES_DP, shift_inputs=shift)
     params, opt_state = ts.init(jax.random.key(0))
     tokens = np.random.default_rng(0).integers(
         0, cfg.vocab_size, (batch, seq + 1), dtype=np.int32
@@ -49,7 +53,7 @@ def run_variant(remat, policy, batch, seq, steps, warmup=2):
     mfu = tok_s * cfg.flops_per_token(seq) / peak_flops_per_chip()
     return {
         "remat": remat, "policy": policy if remat else None,
-        "batch": batch, "seq": seq,
+        "batch": batch, "seq": seq, "shift": shift,
         "tok_s": round(tok_s, 1), "mfu": round(mfu, 4),
         "step_ms": round(dt / steps * 1e3, 2), "loss": round(final, 4),
     }
@@ -84,15 +88,20 @@ if __name__ == "__main__":
         except Exception as e:
             print(json.dumps({"check": "flash_hlo", "error": str(e)[:200]}), flush=True)
 
+    # (remat, policy, batch, seq, shift)
     variants = [
-        (True, "half_full", 8, 1024),
-        (True, "half_dots", 8, 1024),
-        (True, "half_full", 12, 1024),
+        (True, "dots", 8, 1024, False),       # round-3 baseline
+        (True, "dots", 8, 1024, True),        # aligned S
+        (True, "dots_attn", 8, 1024, True),   # + no flash-fwd recompute
+        (True, "dots_attn", 16, 1024, True),  # + bigger matmul M
+        (True, "dots_attn", 32, 1024, True),
+        (False, None, 8, 1024, True),         # no remat (may crash helper)
     ]
-    for remat, policy, batch, seq in variants:
+    for remat, policy, batch, seq, shift in variants:
         try:
-            r = run_variant(remat, policy, batch, seq, args.steps)
+            r = run_variant(remat, policy, batch, seq, args.steps,
+                            shift=shift)
         except Exception as e:
             r = {"remat": remat, "policy": policy, "batch": batch, "seq": seq,
-                 "error": str(e)[:300]}
+                 "shift": shift, "error": str(e)[:300]}
         print(json.dumps(r), flush=True)

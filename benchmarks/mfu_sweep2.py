@@ -1,0 +1,86 @@
+"""Round-4 second sweep: flash block sizes, half-remat policies, batch 12/16.
+
+Each variant runs in a SUBPROCESS: a compile-helper HTTP 500 (the axon
+failure mode for large programs) must not kill the remaining variants, and a
+fresh process gives each variant a clean compile cache.
+
+Usage: python benchmarks/mfu_sweep2.py
+"""
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+ROOT = os.path.dirname(HERE)
+
+CHILD = r"""
+import json, os, sys, time
+sys.path.insert(0, {root!r})
+from ray_tpu.util.jaxenv import ensure_platform
+ensure_platform()
+import jax
+import numpy as np
+import ray_tpu.ops.flash_attention as fa
+fa.DEFAULT_BLOCK = {block}
+from ray_tpu.models.configs import bench_350m
+from ray_tpu.parallel import MeshSpec, RULES_DP, make_mesh
+from ray_tpu.train.step import transformer_train_step
+from ray_tpu.util.accelerators import peak_flops_per_chip
+
+remat, policy, batch, seq, steps = {remat}, {policy!r}, {batch}, {seq}, 12
+cfg = bench_350m(remat=remat, remat_policy=policy)
+mesh = make_mesh(MeshSpec(), devices=[jax.devices()[0]])
+ts = transformer_train_step(cfg, mesh, rules=RULES_DP, shift_inputs=True)
+params, opt_state = ts.init(jax.random.key(0))
+tokens = np.random.default_rng(0).integers(
+    0, cfg.vocab_size, (batch, seq + 1), dtype=np.int32)
+b = ts.shard_batch({{"tokens": tokens}})
+for _ in range(2):
+    params, opt_state, loss = ts.step(params, opt_state, b)
+float(loss)
+t0 = time.perf_counter()
+for _ in range(steps):
+    params, opt_state, loss = ts.step(params, opt_state, b)
+final = float(loss)
+dt = time.perf_counter() - t0
+tok_s = batch * seq * steps / dt
+mfu = tok_s * cfg.flops_per_token(seq) / peak_flops_per_chip()
+print(json.dumps({{
+    "remat": remat, "policy": policy, "batch": batch, "block": {block},
+    "tok_s": round(tok_s, 1), "mfu": round(mfu, 4),
+    "step_ms": round(dt / steps * 1e3, 2), "loss": round(final, 4)}}))
+"""
+
+
+def run(remat, policy, batch, block, seq=1024, timeout=900):
+    code = CHILD.format(root=ROOT, remat=remat, policy=policy, batch=batch,
+                        seq=seq, block=block)
+    try:
+        p = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                           text=True, timeout=timeout)
+    except subprocess.TimeoutExpired:
+        return {"remat": remat, "policy": policy, "batch": batch,
+                "block": block, "error": "timeout"}
+    for line in reversed(p.stdout.splitlines()):
+        if line.startswith("{"):
+            return json.loads(line)
+    return {"remat": remat, "policy": policy, "batch": batch, "block": block,
+            "error": (p.stderr or "no output").strip()[-300:]}
+
+
+if __name__ == "__main__":
+    variants = [
+        # (remat, policy, batch, flash_block)
+        (True, "dots", 8, 1024),       # bigger flash blocks
+        (True, "dots", 8, 256),
+        (True, "half_dots", 8, 512),   # less recompute than dots
+        (True, "half_full", 8, 512),
+        (True, "dots", 16, 512),       # bigger matmul M, plain dots
+        (True, "dots", 12, 512),
+        (True, "full", 8, 512),        # smallest program: maybe helper-safe
+    ]
+    for v in variants:
+        print(json.dumps(run(*v)), flush=True)

@@ -11,6 +11,8 @@ NodeProvider subclasses too).
 """
 from __future__ import annotations
 
+from ray_tpu import flags
+
 import json
 import os
 import subprocess
@@ -48,7 +50,7 @@ class LocalNodeProvider(NodeProvider):
     def create_node(self, resources: Optional[Dict[str, float]] = None) -> str:
         res = dict(resources or self.worker_resources)
         tag = f"auto-{uuid.uuid4().hex[:8]}"
-        env = dict(os.environ)
+        env = flags.child_env()
         env.pop("RTPU_ARENA", None)
         env.pop("RTPU_HOST_ID", None)
         pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))

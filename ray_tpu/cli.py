@@ -17,6 +17,8 @@ Usage (no console-script install needed):
 """
 from __future__ import annotations
 
+from ray_tpu import flags
+
 import argparse
 import json
 import os
@@ -30,7 +32,7 @@ _ADDRFILE = os.path.join(tempfile.gettempdir(), "rtpu_head.addr")
 
 
 def _resolve_address(args) -> str:
-    addr = getattr(args, "address", None) or os.environ.get("RTPU_ADDRESS")
+    addr = getattr(args, "address", None) or flags.get("RTPU_ADDRESS")
     if not addr and os.path.exists(_ADDRFILE):
         addr = open(_ADDRFILE).read().strip()
     if not addr:
@@ -44,7 +46,7 @@ def cmd_start(args) -> int:
         import asyncio
 
         if getattr(args, "state_path", None):
-            os.environ["RTPU_STATE_PATH"] = args.state_path
+            flags.set_env("RTPU_STATE_PATH", args.state_path)
 
         from ray_tpu.core.controller import Controller
 

@@ -8,6 +8,8 @@ TPU: per-step data movement belongs to XLA programs, not to this layer.
 """
 from __future__ import annotations
 
+from ray_tpu import flags
+
 import atexit
 import functools
 import os
@@ -59,7 +61,7 @@ def init(
         if address is None:
             # Job entrypoints / `rtpu` CLI processes inherit the cluster
             # address via env (reference: RAY_ADDRESS).
-            address = os.environ.get("RTPU_ADDRESS") or None
+            address = flags.get("RTPU_ADDRESS") or None
 
         if address is None:
             from ray_tpu.util.accelerators import detect_tpu_chips
@@ -92,7 +94,7 @@ def init(
 
         ctrl_host = (reg or {}).get("controller_host_id")
         if ctrl_host is not None and ctrl_host != current_host_id():
-            os.environ["RTPU_FORCE_INLINE"] = "1"
+            flags.set_env("RTPU_FORCE_INLINE", "1")
         if not node_id:
             state = client.request({"kind": "cluster_state"})
             node_id = state["nodes"][0]["node_id"] if state["nodes"] else ""
@@ -140,7 +142,7 @@ def shutdown() -> None:
         _owned_controller = None
         _controller_io = None
         ctx.set_worker_context(None)
-        os.environ.pop("RTPU_FORCE_INLINE", None)
+        flags.unset_env("RTPU_FORCE_INLINE")
         from .object_store import close_process_segments
         from .transfer import reset_transfer_caches
 

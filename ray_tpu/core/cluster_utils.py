@@ -13,6 +13,8 @@ by which "multi-node" behavior is tested on one machine. Two node flavors:
 """
 from __future__ import annotations
 
+from ray_tpu import flags
+
 import json
 import subprocess
 import sys
@@ -60,7 +62,7 @@ class Cluster:
             cmd += ["--host-id", host_id]
         import os
 
-        env = dict(os.environ)
+        env = flags.child_env()
         env.pop("RTPU_ARENA", None)  # the agent owns its *own* arena
         env.pop("RTPU_HOST_ID", None)
         pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

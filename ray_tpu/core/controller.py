@@ -24,6 +24,8 @@ exchange coordinator addresses; every per-step byte moves inside XLA programs.
 """
 from __future__ import annotations
 
+from ray_tpu import flags
+
 import asyncio
 import os
 import subprocess
@@ -39,7 +41,7 @@ from .object_store import ObjectLocation, free_location
 
 # Worker processes a node may grow to (the reference caps via resources; this
 # is a backstop against runaway spawning on the 1-CPU CI host).
-MAX_WORKERS_PER_NODE = int(os.environ.get("RTPU_MAX_WORKERS_PER_NODE", "32"))
+MAX_WORKERS_PER_NODE = flags.get("RTPU_MAX_WORKERS_PER_NODE")
 
 
 def _res_fits(avail: Dict[str, float], need: Dict[str, float]) -> bool:
@@ -184,7 +186,7 @@ class Controller:
 
         self.lineage: "_collections.OrderedDict[str, Dict[str, Any]]" = (
             _collections.OrderedDict())
-        self.lineage_max = int(os.environ.get("RTPU_LINEAGE_MAX", "10000"))
+        self.lineage_max = flags.get("RTPU_LINEAGE_MAX")
         self.functions: Dict[str, bytes] = {}  # function/class table (gcs_function_manager)
         self.kv: Dict[Tuple[str, str], bytes] = {}
         self.pgs: Dict[str, PGInfo] = {}
@@ -207,7 +209,7 @@ class Controller:
         import collections
 
         self.task_events: "collections.deque" = collections.deque(
-            maxlen=int(os.environ.get("RTPU_TASK_EVENTS_MAX", "50000")))
+            maxlen=flags.get("RTPU_TASK_EVENTS_MAX"))
         # Node-wide native object arena (plasma-equivalent, src/store).
         # Created here so worker spawns inherit RTPU_ARENA via env; falls
         # back to per-object segments when the native lib is unavailable.
@@ -220,7 +222,7 @@ class Controller:
         # persistence, ray_config_def.h:402): KV, function table, and
         # detached actors survive controller restarts when a state path is
         # configured (RTPU_STATE_PATH or the CLI's --state-path).
-        self.persist_path = os.environ.get("RTPU_STATE_PATH") or None
+        self.persist_path = flags.get("RTPU_STATE_PATH")
         self._state_dirty = False
         self._restore_state()
 
@@ -237,12 +239,14 @@ class Controller:
         try:
             self._metrics_server = await asyncio.start_server(
                 self._serve_metrics_http, self.host,
-                int(os.environ.get("RTPU_METRICS_PORT", "0")))
+                flags.get("RTPU_METRICS_PORT"))
             self.metrics_port = self._metrics_server.sockets[0].getsockname()[1]
         except Exception as e:
+            # raw read: flags.get would re-raise on a malformed value, and
+            # this handler exists precisely to survive that.
             sys.stderr.write(
                 f"[controller] metrics endpoint disabled: {e!r} "
-                f"(RTPU_METRICS_PORT={os.environ.get('RTPU_METRICS_PORT')})\n")
+                f"(RTPU_METRICS_PORT={flags.raw('RTPU_METRICS_PORT')})\n")
             self._metrics_server = None
             self.metrics_port = 0
         return self.host, self.port
@@ -397,7 +401,7 @@ class Controller:
             self.objects.pop(oid, None)
             return True
         recon = int(spec.get("_reconstructions", 0))
-        if recon >= int(os.environ.get("RTPU_MAX_RECONSTRUCTIONS", "3")):
+        if recon >= flags.get("RTPU_MAX_RECONSTRUCTIONS"):
             return False
         for dep in spec.get("deps", []):
             loc = self.objects.get(dep)
@@ -1590,7 +1594,7 @@ class Controller:
         gcs_health_check_manager.h:39 periodic health checks); also runs the
         arena memory-pressure check (spill cold objects past the high
         watermark, reference local_object_manager.h:103-122)."""
-        timeout = float(os.environ.get("RTPU_NODE_TIMEOUT_S", "10"))
+        timeout = flags.get("RTPU_NODE_TIMEOUT_S")
         while True:
             await asyncio.sleep(min(2.0, timeout / 3))
             now = time.monotonic()
@@ -1620,8 +1624,8 @@ class Controller:
         period and retries while zero-copy pins block it."""
         if self._arena is not None:
             await self._drain_deferred_deletes()
-            high = float(os.environ.get("RTPU_SPILL_HIGH", "0.8"))
-            low = float(os.environ.get("RTPU_SPILL_LOW", "0.6"))
+            high = flags.get("RTPU_SPILL_HIGH")
+            low = flags.get("RTPU_SPILL_LOW")
             st = self._arena.stats()
             cap = st["capacity"] or 1
             if st["used"] / cap < high:
@@ -1637,7 +1641,7 @@ class Controller:
             from .object_store import spill_dir
             from .transfer import read_location_range
 
-            grace = float(os.environ.get("RTPU_SPILL_DELETE_GRACE_S", "10"))
+            grace = flags.get("RTPU_SPILL_DELETE_GRACE_S")
             spilled_bytes = 0
             need = st["used"] - low * cap
             for _, oid, loc in victims:
@@ -1913,7 +1917,7 @@ class Controller:
                 )
             )
             return
-        env = dict(os.environ)
+        env = flags.child_env()
         env["RTPU_CONTROLLER"] = f"{self.host}:{self.port}"
         env["RTPU_NODE_ID"] = node.node_id
         env["RTPU_SPAWN_TOKEN"] = spawn_token

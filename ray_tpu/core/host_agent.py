@@ -22,6 +22,8 @@ TCP pull path.
 """
 from __future__ import annotations
 
+from ray_tpu import flags
+
 import argparse
 import asyncio
 import json
@@ -37,7 +39,7 @@ from . import native_store, protocol
 from .ids import NodeID
 from .transfer import read_location_range
 
-HEARTBEAT_S = float(os.environ.get("RTPU_HEARTBEAT_S", "2.0"))
+HEARTBEAT_S = flags.get("RTPU_HEARTBEAT_S")
 
 
 class HostAgent:
@@ -63,7 +65,7 @@ class HostAgent:
         self.worker_tokens: Dict[str, str] = {}  # worker_id -> spawn_token
         self._stop = asyncio.Event()
         if host_id:
-            os.environ["RTPU_HOST_ID"] = host_id
+            flags.set_env("RTPU_HOST_ID", host_id)
         from .object_store import current_host_id
 
         self.host_id = current_host_id()
@@ -172,7 +174,7 @@ class HostAgent:
     def _spawn_worker(self, msg: Dict[str, Any],
                       python: Optional[str] = None) -> Dict[str, Any]:
         spawn_token = msg["spawn_token"]
-        env = dict(os.environ)
+        env = flags.child_env()
         if msg.get("runtime_env"):
             env["RTPU_RUNTIME_ENV"] = json.dumps(msg["runtime_env"])
         env["RTPU_CONTROLLER"] = self.controller_addr
@@ -290,7 +292,7 @@ def main() -> int:
     args = ap.parse_args()
     if args.host_id:
         # Must be set before the arena env leaks to children.
-        os.environ["RTPU_HOST_ID"] = args.host_id
+        flags.set_env("RTPU_HOST_ID", args.host_id)
     return asyncio.run(_amain(args))
 
 

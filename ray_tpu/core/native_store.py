@@ -13,6 +13,8 @@ in object_store.py.
 """
 from __future__ import annotations
 
+from ray_tpu import flags
+
 import ctypes
 import logging
 import os
@@ -264,13 +266,13 @@ def create_node_arena(node_id: str) -> Optional[NativeArena]:
     """Controller-side: create this node's arena and advertise it via env
     (workers inherit the env at spawn)."""
     global _arena
-    if os.environ.get("RTPU_NATIVE_STORE", "1") != "1":
+    if not flags.get("RTPU_NATIVE_STORE"):
         return None
     with _arena_state_lock:
         if _arena is not None:
             return _arena
         gc_stale_arenas()
-        size = int(os.environ.get(_ARENA_SIZE_ENV, DEFAULT_ARENA_SIZE))
+        size = flags.get("RTPU_ARENA_SIZE", default=DEFAULT_ARENA_SIZE)
         name = arena_name_for_node(node_id)
         arena = NativeArena.create(name, size)
         if arena is None:
@@ -280,7 +282,7 @@ def create_node_arena(node_id: str) -> Optional[NativeArena]:
                 lib.rtpu_store_unlink(name.encode())
                 arena = NativeArena.create(name, size)
         if arena is not None:
-            os.environ[_ARENA_ENV] = name
+            flags.set_env("RTPU_ARENA", name)
             _arena = arena
             _register_arena(name)
         return arena
@@ -291,8 +293,8 @@ def get_arena() -> Optional[NativeArena]:
     global _arena
     if _arena is not None:
         return _arena
-    name = os.environ.get(_ARENA_ENV)
-    if not name or os.environ.get("RTPU_NATIVE_STORE", "1") != "1":
+    name = flags.get("RTPU_ARENA")
+    if not name or not flags.get("RTPU_NATIVE_STORE"):
         return None
     with _arena_state_lock:
         if _arena is None:
@@ -325,4 +327,4 @@ def close_arena(destroy: bool = False) -> None:
         else:
             _arena.detach()
         _arena = None
-        os.environ.pop(_ARENA_ENV, None)
+        flags.unset_env("RTPU_ARENA")

@@ -22,6 +22,8 @@ memcpy'd directly into the shared-memory segment.
 """
 from __future__ import annotations
 
+from ray_tpu import flags
+
 import os
 import pickle
 import secrets
@@ -46,7 +48,7 @@ def current_host_id() -> str:
     TCP via the host agent (reference: node_manager's object manager serving
     Push/Pull, src/ray/object_manager/object_manager.h).
     """
-    env = os.environ.get("RTPU_HOST_ID")
+    env = flags.get("RTPU_HOST_ID")
     if env:
         return env
     global _machine_id_cache
@@ -119,7 +121,7 @@ def put_bytes(value: Any, object_id: str, node_id: str) -> ObjectLocation:
     native arena (preferred) or a per-object shm segment (fallback)."""
     data, oob = serialize(value)
     total = len(data) + sum(len(b.raw()) for b in oob)
-    if total <= INLINE_THRESHOLD or os.environ.get("RTPU_FORCE_INLINE") == "1":
+    if total <= INLINE_THRESHOLD or flags.get("RTPU_FORCE_INLINE"):
         # Re-pickle in-band: cheap at this size, keeps the inline path simple.
         # RTPU_FORCE_INLINE covers processes with no pull-server on their host
         # (a driver connected to a remote cluster): shm there is unreachable
@@ -209,7 +211,7 @@ def _put_arena(data, oob, total, object_id, node_id) -> Optional[ObjectLocation]
 
 
 def spill_dir() -> str:
-    d = os.environ.get("RTPU_SPILL_DIR")
+    d = flags.get("RTPU_SPILL_DIR")
     if not d:
         import tempfile
 
@@ -262,6 +264,67 @@ def _get_spilled(loc: ObjectLocation) -> Any:
     return pickle.loads(data, buffers=bufs)
 
 
+class _Pin:
+    """A shared-memory read pin released when the last consumer value dies.
+
+    One _Pin per zero-copy get; every out-of-band buffer handed to pickle
+    holds a strong reference, so the arena refcount drops (or the segment
+    mapping closes) exactly when Python can no longer reach any view of the
+    bytes — plasma's client-buffer lifetime contract, driven by GC instead
+    of an explicit Release RPC. Release is idempotent: interpreter exit
+    drains whatever pins GC has not collected yet (the refcount lives in
+    shared memory, so process death alone cannot drop it).
+    """
+
+    __slots__ = ("_release", "_done", "__weakref__")
+
+    def __init__(self, release) -> None:
+        self._release = release
+        self._done = False
+        _live_pins.add(self)
+
+    def release(self) -> None:
+        if self._done:
+            return
+        self._done = True
+        try:
+            self._release()
+        except Exception:
+            pass  # arena may already be detached/unlinked at shutdown
+
+    def __del__(self) -> None:
+        self.release()
+
+
+class PinnedBuffer:
+    """Read-only buffer view that keeps a _Pin alive (PEP 688).
+
+    numpy arrays reconstructed from pickle5 out-of-band buffers keep their
+    buffer object as ``.base`` — so the array's lifetime transitively holds
+    the pin, and mutation is blocked because the exported view is read-only
+    (same contract as plasma: values from get() are immutable).
+    """
+
+    __slots__ = ("_mv", "_pin")
+
+    def __init__(self, mv: memoryview, pin: _Pin) -> None:
+        self._mv = mv.toreadonly()
+        self._pin = pin
+
+    def __buffer__(self, flags: int) -> memoryview:
+        return self._mv
+
+    def __len__(self) -> int:
+        return self._mv.nbytes
+
+
+import weakref
+
+# Weak refs only: a pin stays alive through the PinnedBuffers that hold it,
+# and this set lets the atexit hook drain stragglers.
+_live_pins: "weakref.WeakSet[_Pin]" = weakref.WeakSet()
+
+
 class _SegmentCache:
     """Per-process cache of attached read-only segments."""
 
@@ -294,14 +357,14 @@ class _SegmentCache:
 _segments = _SegmentCache()
 
 
-def get_bytes(loc: ObjectLocation, copy: bool = True) -> Any:
+def get_bytes(loc: ObjectLocation, copy: bool = False) -> Any:
     """Reconstruct the value at `loc`.
 
-    With ``copy=False`` out-of-band numpy buffers alias the shared-memory
-    segment zero-copy (consumers must treat results as read-only and must not
-    outlive a free() — same contract as plasma). The default copies, which
-    keeps segment lifetime decoupled from value lifetime; perf-sensitive
-    internal paths (data-loading into device buffers) opt into zero-copy.
+    Default is ZERO-COPY (plasma get semantics, reference
+    src/ray/object_manager/plasma/store.h): out-of-band numpy buffers alias
+    the shared memory read-only, each holding a GC-driven pin (_Pin) so the
+    storage outlives every view. ``copy=True`` materializes private copies
+    — for consumers that must mutate results in place.
     """
     if loc.inline is not None:
         return pickle.loads(loc.inline)
@@ -316,10 +379,18 @@ def get_bytes(loc: ObjectLocation, copy: bool = True) -> Any:
     assert loc.shm_name is not None
     seg = _segments.attach(loc.shm_name)
     data = bytes(seg.buf[loc.pickle_off : loc.pickle_off + loc.pickle_len])
-    bufs = []
-    for off, n in loc.buffers:
-        view = seg.buf[off : off + n]
-        bufs.append(bytes(view) if copy else view)
+    if copy or not loc.buffers:
+        # bytearray: a copy exists to be mutated (bytes would reconstruct
+        # read-only numpy arrays).
+        bufs: List[Any] = [bytearray(seg.buf[off:off + n])
+                           for off, n in loc.buffers]
+    else:
+        # The release closure holds the SharedMemory object so the mapping
+        # stays alive even if the cache drops it (free_segment) while views
+        # are exported; POSIX keeps unlinked memory valid until munmap.
+        pin = _Pin(lambda seg=seg: None)
+        bufs = [PinnedBuffer(seg.buf[off:off + n], pin)
+                for off, n in loc.buffers]
     return pickle.loads(data, buffers=bufs)
 
 
@@ -335,42 +406,31 @@ def _get_arena_bytes(loc: ObjectLocation, copy: bool) -> Any:
         raise RuntimeError(
             f"object {loc.object_id} lives in arena {loc.arena!r} which this "
             f"process could not attach")
-    view = arena.get(loc.arena_oid)
+    view = arena.get(loc.arena_oid)  # takes a shared-memory read pin
     if view is None:
         raise KeyError(f"object {loc.object_id} missing from arena "
                        f"(freed under a zero-copy reader?)")
-    try:
-        data = bytes(view[loc.pickle_off:loc.pickle_off + loc.pickle_len])
-        bufs = []
-        for off, n in loc.buffers:
-            b = view[off:off + n]
-            bufs.append(b if not copy else bytes(b))
-        value = pickle.loads(data, buffers=bufs)
-    finally:
-        if copy:
-            del bufs, view
+    data = bytes(view[loc.pickle_off:loc.pickle_off + loc.pickle_len])
+    if copy or not loc.buffers:
+        try:
+            bufs: List[Any] = [bytearray(view[off:off + n])
+                               for off, n in loc.buffers]
+            return pickle.loads(data, buffers=bufs)
+        finally:
+            del view
             arena.release(loc.arena_oid)
-        else:
-            # copy=False: the pin stays — the object can't be reclaimed
-            # while this process may still alias it. Record it so the
-            # atexit hook drains it (the refcount lives in shared memory,
-            # so process death alone cannot); the controller can still
-            # force-delete, same contract as plasma.
-            _zero_copy_pins.append((arena, loc.arena_oid))
-    return value
-
-
-# (arena, oid) pins taken by copy=False reads, drained at interpreter exit.
-_zero_copy_pins: list = []
+    # Zero-copy: each buffer holds the pin; the arena read-pin drops when
+    # the last aliasing value is garbage-collected (or at interpreter
+    # exit via the atexit drain). The controller can still force-delete —
+    # same contract as plasma.
+    pin = _Pin(lambda a=arena, o=loc.arena_oid: a.release(o))
+    bufs = [PinnedBuffer(view[off:off + n], pin) for off, n in loc.buffers]
+    return pickle.loads(data, buffers=bufs)
 
 
 def _release_zero_copy_pins() -> None:
-    pins, _zero_copy_pins[:] = list(_zero_copy_pins), []
-    for arena, oid in pins:
-        try:
-            arena.release(oid)
-        except Exception:
-            pass  # arena may already be detached/unlinked at shutdown
+    for pin in list(_live_pins):
+        pin.release()
 
 
 import atexit as _atexit

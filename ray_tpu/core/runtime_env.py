@@ -23,6 +23,8 @@ its worker pool the same way, worker_pool.h runtime_env_hash).
 """
 from __future__ import annotations
 
+from ray_tpu import flags
+
 import hashlib
 import io
 import json
@@ -38,7 +40,7 @@ _KV_NS = "__runtime_env__"
 
 
 def _cache_root() -> str:
-    d = os.environ.get("RTPU_RUNTIME_ENV_CACHE") or os.path.join(
+    d = flags.get("RTPU_RUNTIME_ENV_CACHE") or os.path.join(
         tempfile.gettempdir(), "rtpu_runtime_envs")
     os.makedirs(d, exist_ok=True)
     return d
@@ -110,8 +112,7 @@ def _package_working_dir(path: str):
     path = os.path.abspath(path)
     if not os.path.isdir(path):
         raise ValueError(f"working_dir {path!r} is not a directory")
-    max_bytes = int(os.environ.get(
-        "RTPU_WORKING_DIR_MAX_BYTES", str(100 * 1024 * 1024)))
+    max_bytes = flags.get("RTPU_WORKING_DIR_MAX_BYTES")
     entries = []
     total = 0
     for root, dirs, files in os.walk(path):
@@ -152,7 +153,7 @@ def apply_in_worker(norm: Dict[str, Any], client) -> None:
     code loads). The pip part was already satisfied by the spawner: this
     interpreter IS the venv's when pip was requested."""
     for k, v in (norm.get("env_vars") or {}).items():
-        os.environ[k] = v
+        flags.set_raw(k, v)
     uri = norm.get("working_dir_uri")
     if uri:
         target = os.path.join(_cache_root(), uri.split("://", 1)[1])

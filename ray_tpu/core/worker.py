@@ -12,6 +12,8 @@ Workers import neither jax nor any ML library at startup — a worker stays a
 """
 from __future__ import annotations
 
+from ray_tpu import flags
+
 import os
 import pickle
 import queue
@@ -123,7 +125,7 @@ class WorkerRuntime:
         # env_vars) must already be in place (the pip venv part was applied
         # by the spawner — this interpreter is the venv's).
         env_hash = ""
-        renv_json = os.environ.get("RTPU_RUNTIME_ENV")
+        renv_json = flags.get("RTPU_RUNTIME_ENV")
         if renv_json:
             import json as _json
 
@@ -138,8 +140,8 @@ class WorkerRuntime:
                 "role": "worker",
                 "worker_id": self.worker_id,
                 "node_id": node_id,
-                "spawn_token": os.environ.get("RTPU_SPAWN_TOKEN"),
-                "tpu_capable": bool(os.environ.get("RTPU_TPU_WORKER")),
+                "spawn_token": flags.get("RTPU_SPAWN_TOKEN"),
+                "tpu_capable": flags.get("RTPU_TPU_WORKER"),
                 "env_hash": env_hash,
             }
         )

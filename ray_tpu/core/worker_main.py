@@ -3,17 +3,19 @@ python/ray/_private/workers/default_worker.py). Spawned by the controller with
 RTPU_CONTROLLER / RTPU_NODE_ID in the environment."""
 from __future__ import annotations
 
+from ray_tpu import flags
+
 import os
 import sys
 
 
 def main() -> int:
-    addr = os.environ.get("RTPU_CONTROLLER")
-    node_id = os.environ.get("RTPU_NODE_ID")
+    addr = flags.get("RTPU_CONTROLLER")
+    node_id = flags.get("RTPU_NODE_ID")
     if not addr or not node_id:
         sys.stderr.write("worker_main: RTPU_CONTROLLER / RTPU_NODE_ID not set\n")
         return 2
-    extra_path = os.environ.get("RTPU_SYS_PATH")
+    extra_path = flags.get("RTPU_SYS_PATH")
     if extra_path:
         for p in reversed(extra_path.split(os.pathsep)):
             if p and p not in sys.path:

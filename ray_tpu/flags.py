@@ -1,0 +1,205 @@
+"""Central registry of every ray_tpu configuration knob.
+
+One place defining each ``RTPU_*`` environment flag with its type, default,
+and documentation — the reference concentrates its ~217 knobs in
+``src/ray/common/ray_config_def.h`` for the same reason: scattering
+``os.environ.get(...)`` at point of use means no single list of what can be
+tuned, no defaults audit, and typo'd names that silently fall back.
+
+Rules:
+- Every module reads flags through :func:`get` (call-time lookup, so flags
+  set by a parent before spawning a worker, or by a test, are honored).
+- Writes (the few flags that double as process-tree plumbing, e.g.
+  ``RTPU_HOST_ID``) go through :func:`set_env` / :func:`unset_env`.
+- External variables we consume-but-don't-own (``JAX_PLATFORMS``,
+  ``XLA_FLAGS``, ``TPU_ACCELERATOR_TYPE``) are registered as EXTERNAL for
+  documentation and read through the same accessors.
+- ``child_env()`` is the sanctioned way to snapshot the environment when
+  spawning subprocesses.
+
+``python -m ray_tpu.flags`` prints the full flag table.
+"""
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Any, Dict, Optional
+
+
+@dataclasses.dataclass(frozen=True)
+class Flag:
+    name: str
+    type: type
+    default: Any
+    doc: str
+    external: bool = False  # owned by another system (jax/libtpu/GCE)
+
+
+REGISTRY: Dict[str, Flag] = {}
+
+
+def _define(name: str, type_: type, default: Any, doc: str,
+            external: bool = False) -> None:
+    REGISTRY[name] = Flag(name, type_, default, doc, external)
+
+
+# -- session / addressing ----------------------------------------------------
+_define("RTPU_ADDRESS", str, None,
+        "Controller address host:port a driver connects to when "
+        "init(address=...) is not given (reference RAY_ADDRESS).")
+_define("RTPU_CONTROLLER", str, None,
+        "Controller address injected into spawned workers/job drivers; "
+        "internal process-tree plumbing.")
+_define("RTPU_NODE_ID", str, None,
+        "Node id a spawning agent assigns to its workers (internal).")
+_define("RTPU_HOST_ID", str, None,
+        "Logical host id of this process; set by the host agent so object "
+        "plane chooses shm vs TCP pulls (multi-host tests force distinct "
+        "ids to exercise real transfers).")
+_define("RTPU_SPAWN_TOKEN", str, None,
+        "Opaque token tying a spawned worker back to its lease (internal).")
+_define("RTPU_SYS_PATH", str, None,
+        "Extra sys.path entry for workers (working_dir runtime env).")
+_define("RTPU_STATE_PATH", str, None,
+        "Controller persistence snapshot path; enables restart recovery.")
+_define("RTPU_TPU_WORKER", bool, False,
+        "Marks a worker as TPU-capable (set on workers granted TPU "
+        "resources; gates device initialization).")
+
+# -- controller tunables -----------------------------------------------------
+_define("RTPU_MAX_WORKERS_PER_NODE", int, 32,
+        "Upper bound on workers the controller spawns per node.")
+_define("RTPU_LINEAGE_MAX", int, 10000,
+        "Bounded lineage log length for object reconstruction.")
+_define("RTPU_TASK_EVENTS_MAX", int, 50000,
+        "Ring-buffer size of task events feeding the state API/timeline.")
+_define("RTPU_METRICS_PORT", int, 0,
+        "Controller Prometheus port (0 = disabled).")
+_define("RTPU_MAX_RECONSTRUCTIONS", int, 3,
+        "Max lineage re-executions per object before giving up.")
+_define("RTPU_NODE_TIMEOUT_S", float, 10.0,
+        "Heartbeat silence after which a node is declared dead.")
+_define("RTPU_HEARTBEAT_S", float, 2.0,
+        "Host-agent heartbeat period.")
+
+# -- object store / spilling -------------------------------------------------
+_define("RTPU_NATIVE_STORE", bool, True,
+        "Use the C++ shm arena when available (0 forces pickle fallback).")
+_define("RTPU_ARENA", str, None,
+        "Name of the shm arena segment (internal, set by the creator).")
+_define("RTPU_ARENA_SIZE", int, 1 << 30,
+        "Arena segment size in bytes.")
+_define("RTPU_FORCE_INLINE", bool, False,
+        "Force inline (in-band) object payloads; chaos/multinode tests.")
+_define("RTPU_SPILL_DIR", str, None,
+        "Directory for spilled objects (enables arena spilling).")
+_define("RTPU_SPILL_HIGH", float, 0.8,
+        "Arena fill fraction that triggers spilling.")
+_define("RTPU_SPILL_LOW", float, 0.6,
+        "Arena fill fraction spilling drains down to.")
+_define("RTPU_SPILL_DELETE_GRACE_S", float, 10.0,
+        "Grace period before spilled files of freed objects are deleted.")
+
+# -- runtime env -------------------------------------------------------------
+_define("RTPU_RUNTIME_ENV", str, None,
+        "Serialized runtime env JSON applied inside a worker (internal).")
+_define("RTPU_RUNTIME_ENV_CACHE", str, None,
+        "Cache dir for working_dir zips and pip venvs "
+        "(default ~/.ray_tpu/runtime_env).")
+_define("RTPU_WORKING_DIR_MAX_BYTES", int, 100 * 1024 * 1024,
+        "Refuse to package working_dirs larger than this "
+        "(reference default cap).")
+
+# -- accelerators / jax ------------------------------------------------------
+_define("RTPU_NUM_TPUS", int, None,
+        "Override detected local TPU chip count.")
+_define("RTPU_TPU_GENERATION", str, None,
+        "Override detected TPU generation (v4/v5e/v5p/v6e).")
+_define("RTPU_JAX_PLATFORM", str, None,
+        "Force the JAX platform ray_tpu initializes (cpu/tpu).")
+_define("RTPU_WORKFLOW_STORAGE", str, None,
+        "Workflow durability root (default ~/.ray_tpu/workflows).")
+
+# -- bench -------------------------------------------------------------------
+_define("RTPU_BENCH_TPU_TIMEOUT", int, 1500,
+        "bench.py per-attempt TPU wall clock budget (seconds).")
+_define("RTPU_BENCH_CPU_TIMEOUT", int, 900,
+        "bench.py CPU-fallback wall clock budget (seconds).")
+
+# -- external (documented, not owned) ----------------------------------------
+_define("JAX_PLATFORMS", str, None,
+        "JAX platform list; ray_tpu honors and may set it to 'cpu' for "
+        "virtual-mesh tests.", external=True)
+_define("XLA_FLAGS", str, None,
+        "XLA flags; cpu_mesh_env appends "
+        "--xla_force_host_platform_device_count.", external=True)
+_define("TPU_ACCELERATOR_TYPE", str, None,
+        "GCE metadata accelerator type (e.g. v5litepod-16); used for "
+        "generation detection.", external=True)
+
+
+def get(name: str, default: Any = None) -> Any:
+    """Read a registered flag from the environment (call-time).
+
+    ``default`` overrides the registered default when the flag is unset
+    (for call sites with contextual fallbacks).
+    """
+    f = REGISTRY[name]
+    raw = os.environ.get(name)
+    if raw is None:
+        return default if default is not None else f.default
+    if f.type is bool:
+        return raw.strip().lower() not in ("0", "", "false", "no")
+    if f.type in (int, float):
+        return f.type(raw)
+    return raw
+
+
+def is_set(name: str) -> bool:
+    REGISTRY[name]  # typo guard
+    return name in os.environ
+
+
+def raw(name: str) -> Optional[str]:
+    """Uncoerced environment value — for error paths that must not re-raise
+    on a malformed value."""
+    REGISTRY[name]
+    return os.environ.get(name)
+
+
+def set_env(name: str, value: Any) -> None:
+    """Set a registered flag in this process's environment (the sanctioned
+    write path for process-tree plumbing flags)."""
+    REGISTRY[name]  # typo guard
+    os.environ[name] = str(value)
+
+
+def unset_env(name: str) -> None:
+    REGISTRY[name]
+    os.environ.pop(name, None)
+
+
+def set_raw(name: str, value: str) -> None:
+    """Set an UNregistered environment variable (user runtime_env env_vars —
+    arbitrary names the registry cannot enumerate)."""
+    os.environ[name] = value
+
+
+def child_env(**overrides: str) -> Dict[str, str]:
+    """Snapshot of the current environment for spawning subprocesses."""
+    env = dict(os.environ)
+    env.update({k: str(v) for k, v in overrides.items()})
+    return env
+
+
+def describe() -> str:
+    lines = []
+    for f in sorted(REGISTRY.values(), key=lambda f: (f.external, f.name)):
+        tag = " (external)" if f.external else ""
+        lines.append(f"{f.name}{tag} [{f.type.__name__}, "
+                     f"default={f.default!r}]\n    {f.doc}")
+    return "\n".join(lines)
+
+
+if __name__ == "__main__":
+    print(describe())

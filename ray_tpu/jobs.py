@@ -8,6 +8,8 @@ controller KV so any driver can query them.
 """
 from __future__ import annotations
 
+from ray_tpu import flags
+
 import os
 import subprocess
 import sys
@@ -41,13 +43,13 @@ class _JobSupervisor:
         self.log_lines: List[str] = []
         self.status = JobStatus.PENDING
         self.returncode: Optional[int] = None
-        env = dict(os.environ)
+        env = flags.child_env()
         env.update(env_vars or {})
         # The job's driver connects to THIS cluster.
         from ray_tpu.core import context as ctx
 
         env["RTPU_ADDRESS"] = ctx.get_worker_context().extra.get(
-            "address", "") or os.environ.get("RTPU_CONTROLLER", "")
+            "address", "") or flags.get("RTPU_CONTROLLER", default="")
         self.proc = subprocess.Popen(
             entrypoint, shell=True, env=env, cwd=working_dir or None,
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)

@@ -54,6 +54,11 @@ class TransformerConfig:
     # - "dots": save matmul outputs, recompute elementwise/norm work — BUT
     #   also recomputes the flash-attention forward (a Pallas custom call is
     #   not a dot), which dominates at long sequence lengths.
+    # - "dots_attn": "dots" plus the attention output (tagged "attn_out") —
+    #   the backward no longer re-runs the flash forward kernel (a Pallas
+    #   custom call is not a dot, so plain "dots" recomputes it; measured
+    #   ~1/3 of the in-model attention cost, benchmarks/probe_ceiling2.py).
+    #   One extra [B,S,H*hd] bf16 residual per layer.
     # - "min": save everything except the two fat fused-projection outputs
     #   (qkv and gate_up, tagged via checkpoint_name below) — flash
     #   residuals stay saved, recompute is one einsum + elementwise. The
@@ -282,7 +287,7 @@ def _layer_body(cfg: TransformerConfig, x: jax.Array, layer: Params, positions: 
         q = _rope(q, positions, cfg.rope_theta)
         k = _rope(k, positions, cfg.rope_theta)
     q = maybe_constrain(q, ("batch", "seq_act", "heads", None))
-    o = attention(q, k, v, causal=True)
+    o = checkpoint_name(attention(q, k, v, causal=True), "attn_out")
     x = x + o.reshape(B, S, H * hd) @ layer["wo"].astype(cfg.dtype)
     x = maybe_constrain(x, ("batch", "seq_act", "embed"))
 
@@ -339,6 +344,14 @@ def layer_scan_body(cfg: TransformerConfig, positions: jax.Array):
             body = jax.checkpoint(
                 body,
                 policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+            )
+        elif cfg.remat_policy == "dots_attn":
+            body = jax.checkpoint(
+                body,
+                policy=jax.checkpoint_policies.save_from_both_policies(
+                    jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+                    jax.checkpoint_policies.save_only_these_names("attn_out"),
+                ),
             )
         elif cfg.remat_policy == "min":
             body = jax.checkpoint(
@@ -405,20 +418,39 @@ def lm_head(params: Params, x: jax.Array, cfg: TransformerConfig) -> jax.Array:
     return (x @ head.astype(cfg.dtype)).astype(jnp.float32)
 
 
-def next_token_loss(logits: jax.Array, batch: Dict[str, jax.Array]) -> jax.Array:
-    """Next-token cross-entropy over logits [B,S,V]; loss over tokens[1:].
+def token_cross_entropy(logits: jax.Array, targets: jax.Array,
+                        valid: jax.Array) -> jax.Array:
+    """Mean CE of logits [B,S,V] vs targets [B,S] over positions where
+    ``valid`` (f32 weights) is nonzero.
 
     Fused: ll = logits[target] - logsumexp(logits) avoids materializing a
     second [B, S, V] f32 log-softmax tensor (at V=32k that tensor dominates
     HBM traffic for the loss epilogue).
     """
+    lse = jax.scipy.special.logsumexp(logits, axis=-1)
+    at_target = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
+    ll = at_target - lse
+    return -(ll * valid).sum() / jnp.maximum(valid.sum(), 1.0)
+
+
+def shift_targets_valid(tokens: jax.Array, mask: Optional[jax.Array] = None):
+    """targets/valid weights for the shift_inputs convention: tokens is
+    [B,S+1], the forward ran on tokens[:, :-1]. Shared by loss_fn and
+    parallel.pipeline.pipeline_loss_fn so the convention cannot drift."""
+    targets = tokens[:, 1:]
+    valid = jnp.ones(targets.shape, jnp.float32)
+    if mask is not None:
+        valid = valid * mask[:, 1:].astype(jnp.float32)
+    return targets, valid
+
+
+def next_token_loss(logits: jax.Array, batch: Dict[str, jax.Array]) -> jax.Array:
+    """Next-token CE over logits [B,S,V]; loss over tokens[1:] (the final
+    position is masked out — in-place convention, see loss_fn)."""
     tokens = batch["tokens"]
     B, S = tokens.shape
     targets = jnp.concatenate(
         [tokens[:, 1:], jnp.zeros((B, 1), tokens.dtype)], axis=1)
-    lse = jax.scipy.special.logsumexp(logits, axis=-1)
-    at_target = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
-    ll = at_target - lse
     valid = jnp.concatenate(
         [jnp.ones((B, S - 1), jnp.float32), jnp.zeros((B, 1), jnp.float32)],
         axis=1)
@@ -427,19 +459,36 @@ def next_token_loss(logits: jax.Array, batch: Dict[str, jax.Array]) -> jax.Array
         shifted = jnp.concatenate(
             [mask[:, 1:], jnp.zeros((B, 1), mask.dtype)], axis=1)
         valid = valid * shifted.astype(jnp.float32)
-    return -(ll * valid).sum() / jnp.maximum(valid.sum(), 1.0)
+    return token_cross_entropy(logits, targets, valid)
 
 
-def loss_fn(params: Params, batch: Dict[str, jax.Array], cfg: TransformerConfig) -> jax.Array:
-    """Next-token cross-entropy. batch: tokens [B,S]; loss over tokens[1:].
+def loss_fn(params: Params, batch: Dict[str, jax.Array], cfg: TransformerConfig,
+            *, shift_inputs: bool = False) -> jax.Array:
+    """Next-token cross-entropy.
 
-    The forward runs on the FULL sequence (the final position's logits are
-    masked out of the loss) so the activation sequence length stays divisible
-    by the `seq` mesh axis under context parallelism — slicing to S-1 would
-    break ring-attention sharding for power-of-two S.
+    Two token conventions:
+    - in-place (default): batch tokens [B,S]; the forward runs on the FULL
+      sequence and the final position's logits are masked out of the loss.
+      Keeps the activation sequence length equal to the (power-of-two)
+      input length, which the `seq` mesh axis divides under context
+      parallelism.
+    - shift_inputs: batch tokens [B,S+1]; forward on tokens[:, :-1],
+      targets tokens[:, 1:], every position valid. This is the
+      high-throughput convention: with S+1 fed through the in-place path
+      the whole model would run at an odd length (e.g. 1025), misaligning
+      every matmul tile and forcing an extra padded+masked block row/col
+      into the flash grid — measured ~12% step-time overhead at bench
+      shapes. The sliced length S is the power of two, so context
+      parallelism composes too.
     """
-    logits, aux = forward_with_aux(params, batch["tokens"], cfg)  # [B, S, V]
-    loss = next_token_loss(logits, batch)
+    tokens = batch["tokens"]
+    if shift_inputs:
+        logits, aux = forward_with_aux(params, tokens[:, :-1], cfg)
+        targets, valid = shift_targets_valid(tokens, batch.get("mask"))
+        loss = token_cross_entropy(logits, targets, valid)
+    else:
+        logits, aux = forward_with_aux(params, tokens, cfg)  # [B, S, V]
+        loss = next_token_loss(logits, batch)
     if cfg.moe_num_experts:
         loss = loss + cfg.moe_aux_coef * aux
     return loss

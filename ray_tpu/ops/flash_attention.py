@@ -28,7 +28,8 @@ from jax.experimental.pallas import tpu as pltpu
 
 # 512-blocks win on v5e at bench shapes (benchmarks/probe_flash.py: fwd
 # 8.1ms @128 -> 5.3ms @512, grad 14.7 -> 7.2); VMEM for the [bq, bk] f32
-# score tile stays at 1MB.
+# score tile stays at 1MB. Module-level so benchmarks/mfu_sweep.py can
+# tune without threading kwargs through every model layer.
 DEFAULT_BLOCK = 512
 _NEG_INF = -1e30
 
@@ -381,10 +382,12 @@ def flash_attention(
     *,
     causal: bool = True,
     scale: Optional[float] = None,
-    block_q: int = DEFAULT_BLOCK,
-    block_k: int = DEFAULT_BLOCK,
+    block_q: Optional[int] = None,
+    block_k: Optional[int] = None,
 ) -> jax.Array:
     """Flash attention in model layout [B, S, H, D]; differentiable."""
+    block_q = block_q or DEFAULT_BLOCK
+    block_k = block_k or DEFAULT_BLOCK
     D = q.shape[-1]
     scale = scale if scale is not None else D ** -0.5
     qt = jnp.swapaxes(q, 1, 2)  # [B, H, S, D]

@@ -122,12 +122,14 @@ def pipeline_apply(
     return fn(layers, x)[-1]
 
 
-def pipeline_loss_fn(cfg, mesh: Mesh, *, rules=None, num_microbatches: int = 4):
+def pipeline_loss_fn(cfg, mesh: Mesh, *, rules=None, num_microbatches: int = 4,
+                     shift_inputs: bool = False):
     """Build loss_fn(params, batch) running the decoder as a GPipe pipeline.
 
     Drop-in for models.transformer.loss_fn wherever the mesh has pipe>1;
     wire into ShardedTrainStep via train.step.transformer_train_step(...,
-    pipeline_microbatches=M).
+    pipeline_microbatches=M). ``shift_inputs`` selects the [B,S+1]-tokens
+    convention (models.transformer.loss_fn docstring).
     """
     from ray_tpu.models import transformer as tfm
 
@@ -142,16 +144,21 @@ def pipeline_loss_fn(cfg, mesh: Mesh, *, rules=None, num_microbatches: int = 4):
 
     def loss_fn(params, batch):
         tokens = batch["tokens"]
-        B, S = tokens.shape
+        inputs = tokens[:, :-1] if shift_inputs else tokens
+        B, S = inputs.shape
         if B % M != 0:
             raise ValueError(
                 f"batch {B} not divisible by num_microbatches {M}")
-        x = tfm.embed_tokens(params, tokens, cfg)  # [B, S, d]
+        x = tfm.embed_tokens(params, inputs, cfg)  # [B, S, d]
         x = x.reshape(M, B // M, S, -1)
         y = pipeline_apply(cfg, params["layers"], x, mesh, rules)
         y = y.reshape(B, S, -1)
         y = shd.maybe_constrain(y, ("batch", "seq_act", "embed"))
         logits = tfm.lm_head(params, y, cfg)
+        if shift_inputs:
+            targets, valid = tfm.shift_targets_valid(
+                tokens, batch.get("mask"))
+            return tfm.token_cross_entropy(logits, targets, valid)
         return tfm.next_token_loss(logits, batch)
 
     return loss_fn

@@ -98,12 +98,15 @@ def transformer_train_step(
     rules: Optional[shd.Rules] = None,
     optimizer: Optional[optax.GradientTransformation] = None,
     pipeline_microbatches: Optional[int] = None,
+    shift_inputs: bool = False,
 ) -> ShardedTrainStep:
     """Convenience: wire a models.transformer config into a ShardedTrainStep.
 
     When the mesh has pipe>1, the decoder runs as an in-graph GPipe pipeline
     (parallel/pipeline.py) with `pipeline_microbatches` microbatches
-    (default: 2x the stage count, a reasonable bubble/memory tradeoff)."""
+    (default: 2x the stage count, a reasonable bubble/memory tradeoff).
+    ``shift_inputs`` selects the [B,S+1]-tokens convention (models.
+    transformer.loss_fn docstring) — the high-throughput path."""
     from ray_tpu.models import transformer as tfm
 
     if "pipe" in mesh.axis_names and mesh.shape["pipe"] > 1:
@@ -111,9 +114,11 @@ def transformer_train_step(
 
         M = pipeline_microbatches or 2 * mesh.shape["pipe"]
         loss = pipeline_loss_fn(
-            cfg, mesh, rules=rules or shd.DEFAULT_RULES, num_microbatches=M)
+            cfg, mesh, rules=rules or shd.DEFAULT_RULES, num_microbatches=M,
+            shift_inputs=shift_inputs)
     else:
-        loss = lambda params, batch: tfm.loss_fn(params, batch, cfg)
+        loss = lambda params, batch: tfm.loss_fn(
+            params, batch, cfg, shift_inputs=shift_inputs)
 
     return ShardedTrainStep(
         init_params_fn=lambda rng: tfm.init_params(rng, cfg),

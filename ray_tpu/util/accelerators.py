@@ -8,6 +8,8 @@ and the mesh layer (ray_tpu.parallel) owns device topology.
 """
 from __future__ import annotations
 
+from ray_tpu import flags
+
 import glob
 import os
 from typing import Dict, Optional
@@ -23,9 +25,9 @@ TPU_PEAK_TFLOPS_BF16: Dict[str, float] = {
 
 def detect_tpu_chips() -> int:
     """Count local TPU chips without importing jax (workers stay light)."""
-    env = os.environ.get("RTPU_NUM_TPUS")
+    env = flags.get("RTPU_NUM_TPUS")
     if env is not None:
-        return int(env)
+        return env
     chips = glob.glob("/dev/accel*")
     if chips:
         return len(chips)
@@ -37,10 +39,10 @@ def detect_tpu_chips() -> int:
 
 def detect_tpu_generation() -> Optional[str]:
     """Best-effort generation string ("v4", "v5e", "v5p", "v6e")."""
-    env = os.environ.get("RTPU_TPU_GENERATION")
+    env = flags.get("RTPU_TPU_GENERATION")
     if env:
         return env
-    accel_type = os.environ.get("TPU_ACCELERATOR_TYPE", "")  # e.g. "v5litepod-16"
+    accel_type = flags.get("TPU_ACCELERATOR_TYPE", default="")  # e.g. "v5litepod-16"
     if accel_type.startswith("v5lite"):
         return "v5e"
     for gen in ("v6e", "v5p", "v5e", "v4"):

@@ -10,6 +10,8 @@ from __future__ import annotations
 import os
 from typing import Optional
 
+from ray_tpu import flags as _flags
+
 
 def ensure_platform(platform: Optional[str] = None) -> None:
     """Force the JAX platform (before any computation initializes backends).
@@ -19,8 +21,8 @@ def ensure_platform(platform: Optional[str] = None) -> None:
     """
     platform = (
         platform
-        or os.environ.get("RTPU_JAX_PLATFORM")
-        or os.environ.get("JAX_PLATFORMS")
+        or _flags.get("RTPU_JAX_PLATFORM")
+        or _flags.get("JAX_PLATFORMS")
     )
     if not platform:
         return
@@ -41,10 +43,11 @@ def ensure_platform(platform: Optional[str] = None) -> None:
 def cpu_mesh_env(n_devices: int = 8) -> None:
     """Configure this process for an n-device virtual CPU mesh (test ring 2,
     SURVEY.md §4.4). Must run before jax initializes a backend."""
-    flags = os.environ.get("XLA_FLAGS", "")
-    if "xla_force_host_platform_device_count" not in flags:
-        os.environ["XLA_FLAGS"] = (
-            flags + f" --xla_force_host_platform_device_count={n_devices}"
-        ).strip()
-    os.environ["JAX_PLATFORMS"] = "cpu"
+    xf = _flags.get("XLA_FLAGS", default="")
+    if "xla_force_host_platform_device_count" not in xf:
+        _flags.set_env(
+            "XLA_FLAGS",
+            (xf + f" --xla_force_host_platform_device_count={n_devices}"
+             ).strip())
+    _flags.set_env("JAX_PLATFORMS", "cpu")
     ensure_platform("cpu")

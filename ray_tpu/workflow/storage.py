@@ -17,6 +17,8 @@ reference defaults to ``~/.ray/workflow_data``-style local storage too).
 """
 from __future__ import annotations
 
+from ray_tpu import flags
+
 import json
 import os
 import time
@@ -66,9 +68,9 @@ class _DurablePickler(cloudpickle.Pickler):
 
 
 def default_storage_root() -> str:
-    return os.environ.get(
+    return flags.get(
         "RTPU_WORKFLOW_STORAGE",
-        os.path.join(os.path.expanduser("~"), ".ray_tpu", "workflows"),
+        default=os.path.join(os.path.expanduser("~"), ".ray_tpu", "workflows"),
     )
 
 

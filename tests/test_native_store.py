@@ -284,3 +284,43 @@ def test_eownerdead_rebuilds_heap(arena):
         assert bytes(g) == bytes([oid % 256]) * 2048
         del g
         arena.release(oid)
+
+
+def test_zero_copy_get_pins_and_releases(monkeypatch):
+    """Default get is zero-copy: arrays alias the arena read-only, the read
+    pin is held by the value, and GC of the value releases it (plasma
+    buffer-lifetime semantics)."""
+    import gc
+
+    from ray_tpu.core import native_store, object_store
+
+    name = "/rtpu_test_" + secrets.token_hex(4)
+    a = NativeArena.create(name, 32 * 1024 * 1024)
+    assert a is not None
+    monkeypatch.setattr(native_store, "_arena", a)
+    try:
+        arr = np.arange(300_000, dtype=np.float32)
+        loc = object_store.put_bytes({"x": arr}, "cd" * 16, "n1")
+        assert loc.arena == name
+
+        out = object_store.get_bytes(loc)  # default: zero-copy
+        np.testing.assert_array_equal(out["x"], arr)
+        assert not out["x"].flags.writeable  # plasma immutability contract
+        # Delete defers while the value's pin is held: the object goes
+        # invisible but its memory is not reclaimed.
+        a.delete(loc.arena_oid)
+        assert a.stats()["num_objects"] == 1
+
+        del out
+        gc.collect()
+        # Pin released by GC -> the deferred delete completed.
+        assert a.stats()["num_objects"] == 0
+
+        # copy=True still hands out private, mutable values.
+        loc2 = object_store.put_bytes({"x": arr}, "ef" * 16, "n1")
+        out2 = object_store.get_bytes(loc2, copy=True)
+        out2["x"][0] = 42.0  # must not raise
+        object_store.free_location(loc2)
+    finally:
+        monkeypatch.setattr(native_store, "_arena", None)
+        a.destroy()
