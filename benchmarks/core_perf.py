@@ -12,6 +12,11 @@ asserts conservative floors so control-plane regressions fail CI.
 """
 from __future__ import annotations
 
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 import json
 import time
 

@@ -2,6 +2,11 @@
 at bench shapes, vs the XLA reference attention."""
 from __future__ import annotations
 
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 import json
 import time
 

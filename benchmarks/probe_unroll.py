@@ -2,6 +2,11 @@
 chain as probe_mxu, but unrolled in the traced program vs lax.fori_loop."""
 from __future__ import annotations
 
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 import json
 import time
 

@@ -10,6 +10,11 @@ Prints one JSON line per metric; run from the repo root:
 """
 from __future__ import annotations
 
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 import json
 import time
 

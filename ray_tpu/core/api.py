@@ -128,6 +128,7 @@ def shutdown() -> None:
         if not ctx.is_initialized():
             return
         wc = ctx.get_worker_context()
+        _reset_direct_state(wc)
         if _owned_controller is not None and _controller_io is not None:
             try:
                 _controller_io.call(_owned_controller.shutdown(), timeout=5)
@@ -170,7 +171,12 @@ def put(value: Any) -> ObjectRef:
     wc = ctx.get_worker_context()
     oid = ObjectID.generate()
     loc = put_bytes(value, oid, wc.node_id)
-    wc.client.request({"kind": "put_location", "loc": loc})
+    # The producer knows the location — cache it so get() of own puts never
+    # asks the controller; the directory registration is pipelined (same
+    # connection, so any subsequent submit referencing this ref is ordered
+    # after it, and remote consumers block in get_locations until it lands).
+    _cache_loc(loc)
+    _pipelined_submit(wc, {"kind": "put_location", "loc": loc}, (oid,))
     return ObjectRef(oid)
 
 
@@ -199,16 +205,36 @@ def get(refs: Union[ObjectRef, Sequence[ObjectRef]], *, timeout: Optional[float]
         if not isinstance(r, ObjectRef):
             raise TypeError(f"get() expects ObjectRef(s), got {type(r)}")
     ids = [r.object_id for r in ref_list]
+    # Direct-call results are cached locally — only unknown ids hit the
+    # controller (and skip the blocked-notify round trips entirely when
+    # everything is local). In-flight direct replies are awaited here
+    # rather than asking the controller for locations that are already on
+    # the wire. The controller deadline is reduced by the time spent
+    # waiting so the overall budget stays `timeout`.
+    t_start = time.monotonic()
+    if _inflight_direct:
+        _await_inflight(ids, timeout)
+    missing = [oid for oid in ids if oid not in _local_locs]
+    remaining_timeout = (None if timeout is None else
+                         max(0.0, timeout - (time.monotonic() - t_start)))
 
     def fetch():
         return wc.client.request(
-            {"kind": "get_locations", "object_ids": ids, "timeout": timeout}
+            {"kind": "get_locations", "object_ids": missing,
+             "timeout": remaining_timeout}
         )
 
-    locs = _with_block_notify(fetch)
+    locs = _with_block_notify(fetch) if missing else {}
     out = []
     for oid in ids:
-        loc = locs[oid]
+        loc = locs.get(oid) or _local_locs.get(oid)
+        if loc is None:
+            # Cached entry evicted/freed between the missing-computation
+            # and here (LRU bound or concurrent free): the controller is
+            # the authority.
+            loc = wc.client.request(
+                {"kind": "get_locations", "object_ids": [oid],
+                 "timeout": remaining_timeout})[oid]
         val, loc = get_bytes_with_refresh(loc, oid, wc.client.request)
         if loc.is_error:
             if isinstance(val, BaseException):
@@ -229,6 +255,11 @@ def wait(
     ids = [r.object_id for r in refs]
     if num_returns > len(ids):
         raise ValueError("num_returns exceeds number of refs")
+    local_ready = [oid for oid in ids if oid in _local_locs]
+    if len(local_ready) >= num_returns:
+        ready_ids = set(local_ready[:num_returns])
+        ready = [r for r in refs if r.object_id in ready_ids]
+        return ready, [r for r in refs if r.object_id not in ready_ids]
 
     def do():
         return wc.client.request(
@@ -243,6 +274,8 @@ def wait(
 
 def free(refs: Sequence[ObjectRef]) -> None:
     wc = ctx.get_worker_context()
+    for r in refs:
+        _local_locs.pop(r.object_id, None)
     wc.client.request({"kind": "free_objects", "object_ids": [r.object_id for r in refs]})
 
 
@@ -439,7 +472,8 @@ class RemoteFunction:
         _attach_runtime_env(wc, opts, spec)
         if streaming:
             _streaming_spec_opts(opts, spec)
-        wc.client.request({"kind": "submit_task", "spec": spec})
+        _pipelined_submit(wc, {"kind": "submit_task", "spec": spec},
+                          spec["return_ids"])
         if streaming:
             return ObjectRefGenerator(spec["task_id"])
         refs = [ObjectRef(oid) for oid in return_ids]
@@ -556,6 +590,224 @@ def _rebuild_value_backref(token: str) -> "RemoteFunction":
 
 # ------------------------------------------------------------------- actors
 
+# ---- direct dispatch (lease-then-push) -------------------------------------
+# Reference: src/ray/core_worker/transport/direct_task_transport.h:222 and
+# direct_actor_task_submitter.h:74 — resolve the actor's worker address once
+# via the controller, then push calls peer-to-peer. The controller keeps the
+# directory/health/GC roles; it is no longer on the per-call path. Result
+# locations return inline on the direct reply and are cached process-locally,
+# so the subsequent get() usually needs no controller round trip either (the
+# executing worker still fire-and-forget-reports task_done so third-party
+# consumers and the state API converge).
+
+from collections import OrderedDict
+
+_routes_lock = threading.Lock()
+_routes: Dict[Tuple[str, str], "_ActorRoute"] = {}
+_local_locs: "OrderedDict[str, Any]" = OrderedDict()
+_LOCAL_LOCS_MAX = 65536
+
+
+class _ActorRoute:
+    """Cached direct path to one actor (per client session)."""
+
+    def __init__(self) -> None:
+        self.conn = None  # protocol.Connection on the client's io loop
+        self.worker_id: Optional[str] = None
+        self.lock = threading.Lock()
+
+
+def _cache_loc(loc) -> None:
+    _local_locs[loc.object_id] = loc
+    while len(_local_locs) > _LOCAL_LOCS_MAX:
+        _local_locs.popitem(last=False)
+
+
+def _get_route(wc, actor_id: str) -> "_ActorRoute":
+    key = (wc.client.token, actor_id)
+    with _routes_lock:
+        route = _routes.get(key)
+        if route is None:
+            route = _routes[key] = _ActorRoute()
+        return route
+
+
+def _invalidate_route(wc, route: "_ActorRoute") -> None:
+    with route.lock:
+        conn, route.conn = route.conn, None
+        route.worker_id = None
+    if conn is not None:
+        try:
+            wc.client.io.call_nowait(conn.close())
+        except Exception:
+            pass
+
+
+def _resolve_route(wc, route: "_ActorRoute", actor_id: str) -> bool:
+    """Resolve + connect the direct path; False -> use the controller path."""
+    from . import protocol
+
+    with route.lock:
+        if route.conn is not None:
+            return True
+        try:
+            info = wc.client.request(
+                {"kind": "resolve_actor", "actor_id": actor_id})
+        except Exception:
+            return False
+        d = info.get("direct")
+        if info.get("state") != "alive" or not d:
+            return False
+        try:
+            route.conn = wc.client.io.call(
+                protocol.connect(d["host"], d["port"],
+                                 name=f"direct->{actor_id[:8]}"),
+                timeout=5)
+        except Exception:
+            route.conn = None
+            return False
+        route.worker_id = d["worker_id"]
+        return True
+
+
+# In-flight direct calls by return id: get() awaits these instead of asking
+# the controller for locations the reply will carry any moment.
+_inflight_direct: Dict[str, Any] = {}
+
+
+def _direct_submit(wc, route: "_ActorRoute", spec: Dict[str, Any]) -> bool:
+    conn = route.conn
+    if conn is None:
+        return False
+    try:
+        fut = conn.request_threadsafe(
+            {"kind": "direct_actor_task", "spec": spec})
+    except Exception:
+        _invalidate_route(wc, route)
+        return False
+    for oid in spec.get("return_ids", ()):
+        _inflight_direct[oid] = fut
+
+    def done(f, wc=wc, route=route, spec=spec):
+        for oid in spec.get("return_ids", ()):
+            _inflight_direct.pop(oid, None)
+        exc = f.exception()
+        if exc is None:
+            res = f.result() or {}
+            for loc in (res.get("locations") or ()):
+                _cache_loc(loc)
+            for loc in (res.get("error_locations") or ()):
+                _cache_loc(loc)
+        else:
+            # Runs on the io thread — hand recovery to a plain thread (it
+            # issues blocking controller RPCs).
+            threading.Thread(
+                target=_direct_failure, args=(wc, route, spec, exc),
+                daemon=True, name="direct-recover").start()
+
+    fut.add_done_callback(done)
+    return True
+
+
+def _direct_failure(wc, route: "_ActorRoute", spec: Dict[str, Any],
+                    exc: BaseException) -> None:
+    """The direct connection failed mid-call. Workers fate-share with the
+    controller, so this nearly always means the actor's worker died.
+    In-flight calls fail with ActorDiedError — the reference's default
+    actor-task semantics. NO automatic resubmission: the worker may have
+    already executed the call before the connection dropped, and silently
+    re-running a non-idempotent method would corrupt actor state.
+
+    The error publication is if_absent: the worker's own fire-and-forget
+    task_done may have carried real result locations before it died — a
+    completed call must stay completed for third-party consumers.
+    """
+    import pickle as _p
+
+    from .controller import ActorDiedError
+    from .object_store import ObjectLocation
+
+    _invalidate_route(wc, route)
+    err = ActorDiedError(
+        f"actor {spec['actor_id'][:8]} died during a direct call "
+        f"({type(exc).__name__}: {exc})")
+    data = _p.dumps(err)
+    for oid in spec.get("return_ids", ()):
+        loc = ObjectLocation(object_id=oid, size=len(data), inline=data,
+                             is_error=True)
+        if oid not in _local_locs:
+            _cache_loc(loc)
+        try:
+            wc.client.request(
+                {"kind": "put_location", "loc": loc, "if_absent": True})
+        except Exception:
+            pass
+
+
+def _reset_direct_state(wc=None) -> None:
+    if wc is not None:
+        for route in list(_routes.values()):
+            _invalidate_route(wc, route)  # closes the direct sockets
+    _routes.clear()
+    _local_locs.clear()
+    _inflight_direct.clear()
+
+
+def _pipelined_submit(wc, msg: Dict[str, Any], return_ids) -> None:
+    """Submit without waiting for the controller's ack (the reply is
+    pipelined on the connection, so ordering with later requests holds).
+    A submission failure surfaces as error locations on the return ids —
+    the same channel task-execution errors use."""
+    fut = wc.client.conn.request_threadsafe(msg)
+
+    def done(f, wc=wc, return_ids=tuple(return_ids)):
+        exc = f.exception()
+        if exc is None:
+            return
+        import pickle as _p
+        import sys as _sys
+
+        from .object_store import ObjectLocation
+
+        # Fire-and-forget callers never get() these refs — make sure the
+        # failure is at least visible somewhere.
+        _sys.stderr.write(f"[ray_tpu] pipelined submit failed: {exc!r}\n")
+        data = _p.dumps(exc if isinstance(exc, Exception)
+                        else RuntimeError(repr(exc)))
+        for oid in return_ids:
+            loc = ObjectLocation(object_id=oid, size=len(data), inline=data,
+                                 is_error=True)
+            _cache_loc(loc)
+            try:
+                wc.client.send_nowait({"kind": "put_location", "loc": loc})
+            except Exception:
+                pass
+
+    fut.add_done_callback(done)
+
+
+def _await_inflight(ids, timeout: Optional[float]) -> None:
+    """Wait for in-flight direct replies covering `ids` (their locations
+    land in _local_locs via the completion callback)."""
+    deadline = None if timeout is None else time.monotonic() + timeout
+    for oid in ids:
+        fut = _inflight_direct.get(oid)
+        if fut is None:
+            continue
+        try:
+            res = fut.result(None if deadline is None
+                             else max(0.0, deadline - time.monotonic()))
+        except Exception:
+            # Failure recovery (error locations) happens in the done
+            # callback / recovery thread; fall through to the controller.
+            continue
+        # Cache here too: the done-callback runs on the io thread and may
+        # not have fired yet when result() unblocks (idempotent with it).
+        for loc in ((res or {}).get("locations") or ()):
+            _cache_loc(loc)
+        for loc in ((res or {}).get("error_locations") or ()):
+            _cache_loc(loc)
+
 
 class ActorMethod:
     def __init__(self, handle: "ActorHandle", name: str, num_returns=1):
@@ -608,7 +860,17 @@ class ActorHandle:
         }
         if streaming:
             _streaming_spec_opts({}, spec)
-        wc.client.request({"kind": "submit_actor_task", "spec": spec})
+        submitted = False
+        if not streaming and flags.get("RTPU_DIRECT_DISPATCH"):
+            route = _get_route(wc, self._actor_id)
+            if route.conn is not None or _resolve_route(
+                    wc, route, self._actor_id):
+                hints = {d: _local_locs[d] for d in deps if d in _local_locs}
+                if hints:
+                    spec["loc_hints"] = hints
+                submitted = _direct_submit(wc, route, spec)
+        if not submitted:
+            wc.client.request({"kind": "submit_actor_task", "spec": spec})
         if streaming:
             return ObjectRefGenerator(spec["task_id"])
         refs = [ObjectRef(oid) for oid in return_ids]

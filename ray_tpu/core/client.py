@@ -75,6 +75,11 @@ class CoreClient:
     def send(self, msg: Dict[str, Any]) -> None:
         self.io.call(self.conn.send(msg))
 
+    def send_nowait(self, msg: Dict[str, Any]) -> None:
+        """Fire-and-forget without blocking the calling thread (hot-path
+        reports like direct-dispatch task_done)."""
+        self.io.call_nowait(self.conn.send(msg))
+
     def close(self) -> None:
         try:
             self.io.call(self.conn.close(), timeout=2)

@@ -98,6 +98,9 @@ class WorkerInfo:
     # TPU-capable workers carry the accelerator runtime (axon/PJRT plugin)
     # and cost seconds to start; plain workers skip it and start in ~0.3s.
     tpu_capable: bool = False
+    # Port of the worker's direct-dispatch server (0 = none); peers push
+    # actor tasks there without a controller hop.
+    direct_port: int = 0
 
 
 @dataclass
@@ -550,10 +553,12 @@ class Controller:
         w = self.workers.get(worker_id)
         if w is not None:
             w.conn = conn  # reconnect
+            w.direct_port = int(msg.get("direct_port") or 0)
         else:
             w = WorkerInfo(worker_id=worker_id, node_id=node_id, conn=conn,
                            tpu_capable=bool(msg.get("tpu_capable")),
-                           env_hash=msg.get("env_hash") or "")
+                           env_hash=msg.get("env_hash") or "",
+                           direct_port=int(msg.get("direct_port") or 0))
             self.workers[worker_id] = w
         # Exact proc adoption via startup token (reference: worker startup
         # tokens, worker_pool.h:251) — heuristic matching can swap proc handles
@@ -589,6 +594,10 @@ class Controller:
 
     async def _h_put_location(self, conn, msg):
         loc: ObjectLocation = msg["loc"]
+        if msg.get("if_absent") and loc.object_id in self.objects:
+            # Direct-dispatch failure reports must not clobber a real
+            # result the worker managed to deliver before dying.
+            return {"ok": True}
         self._store_location(loc)
         return {"ok": True}
 
@@ -967,10 +976,16 @@ class Controller:
             spec = self.tasks.pop(actor.creation_task_id, None)
             if spec is not None:
                 self._record_task_event(spec, "finished")
+        # Drain queued calls BEFORE flipping to alive: resolve_actor must
+        # not hand out the direct address while controller-queued calls are
+        # still being dispatched, or a fresh direct call could overtake them
+        # at the worker (per-caller ordering). Dispatch awaits, so new
+        # submissions can interleave and re-append — hence the loop.
+        while actor.pending_calls:
+            calls, actor.pending_calls = actor.pending_calls, []
+            for call in calls:
+                await self._dispatch_actor_call(actor, call)
         actor.state = "alive"
-        calls, actor.pending_calls = actor.pending_calls, []
-        for call in calls:
-            await self._dispatch_actor_call(actor, call)
         return {"ok": True}
 
     async def _h_actor_error(self, conn, msg):
@@ -1032,6 +1047,26 @@ class Controller:
             self._record_task_event(spec, "running", worker_id=w.worker_id,
                                     node_id=actor.node_id)
             await w.conn.send({"kind": "execute_actor_task", "spec": spec})
+
+    async def _h_resolve_actor(self, conn, msg):
+        """Lease-resolution for direct dispatch: where does this actor live?
+
+        Callers resolve once, cache, and push calls straight to the worker's
+        direct server (reference: direct_actor_task_submitter.h:74 — the
+        submitter caches the actor's rpc address from the GCS and pushes).
+        """
+        actor = self.actors.get(msg["actor_id"])
+        if actor is None:
+            raise ValueError(f"unknown actor {msg['actor_id']}")
+        w = self.workers.get(actor.worker_id or "")
+        direct = None
+        if actor.state == "alive" and w is not None and w.direct_port:
+            peer = w.conn.writer.get_extra_info("peername")
+            host = peer[0] if peer else "127.0.0.1"
+            direct = {"worker_id": w.worker_id, "host": host,
+                      "port": w.direct_port}
+        return {"state": actor.state, "direct": direct,
+                "restarts": actor.restart_count}
 
     async def _h_get_named_actor(self, conn, msg):
         key = (msg.get("namespace", "default"), msg["name"])
@@ -1736,12 +1771,30 @@ class Controller:
         for pg in self.pgs.values():
             self._try_reserve_pg(pg)
         remaining: List[str] = []
+        # Infeasibility memo: once a spec with a given (resources, strategy,
+        # pg, env) signature fails to place in this pass, identical later
+        # specs are skipped without re-scanning nodes/workers. A deep queue
+        # of homogeneous tasks (the common fan-out shape) costs one real
+        # placement attempt per pass instead of O(queue) — the scheduler
+        # wakes once per completion, so this is the difference between
+        # O(n) and O(n^2) total work for an n-task wave.
+        infeasible: set = set()
         for task_id in self.pending_queue:
             spec = self.tasks.get(task_id)
             if spec is None:
                 continue
+            sig = (
+                tuple(sorted(spec.get("resources", {}).items())),
+                repr(spec.get("scheduling")),
+                spec.get("pg"),
+                spec.get("env_hash") or "",
+            )
+            if sig in infeasible:
+                remaining.append(task_id)
+                continue
             placed = await self._try_place(spec)
             if not placed:
+                infeasible.add(sig)
                 remaining.append(task_id)
         self.pending_queue = remaining
 

@@ -76,7 +76,8 @@ class Connection:
         self.closed = asyncio.Event()
 
     def start(self) -> None:
-        self._reader_task = asyncio.get_running_loop().create_task(self._read_loop())
+        self._loop = asyncio.get_running_loop()
+        self._reader_task = self._loop.create_task(self._read_loop())
 
     async def _read_loop(self) -> None:
         try:
@@ -105,16 +106,31 @@ class Connection:
             except Exception:
                 pass
 
+    # Above this much buffered output, response writers start awaiting
+    # drain() so a slow reader applies backpressure instead of growing the
+    # transport buffer without bound (e.g. 10k-object get_locations bursts).
+    _DRAIN_ABOVE = 4 * 1024 * 1024
+
     async def _serve(self, msg: Dict[str, Any]) -> None:
         rid = msg.get("rid")
         try:
             result = await self.handler(self, msg)
             if rid is not None:
-                await self.send({"kind": "__response__", "rid": rid, "result": result})
+                # Sync write: this coroutine runs on the connection's loop,
+                # and write_msg has no await between its two writes, so
+                # frames cannot interleave; skipping the send lock + drain
+                # halves the per-response overhead on the hot path. Order is
+                # preserved (the later drain only waits, it doesn't write).
+                write_msg(self.writer, {"kind": "__response__", "rid": rid,
+                                        "result": result})
+                if (self.writer.transport.get_write_buffer_size()
+                        > self._DRAIN_ABOVE):
+                    await self.writer.drain()
         except Exception as e:  # noqa: BLE001 — errors propagate to the caller
             if rid is not None:
                 try:
-                    await self.send({"kind": "__response__", "rid": rid, "error": e})
+                    write_msg(self.writer, {"kind": "__response__",
+                                            "rid": rid, "error": e})
                 except Exception:
                     pass
 
@@ -134,6 +150,54 @@ class Connection:
         if timeout is None:
             return await fut
         return await asyncio.wait_for(fut, timeout)
+
+    def request_threadsafe(self, msg: Dict[str, Any]):
+        """Pipelined request from a non-loop thread.
+
+        Serialization happens on the calling thread (true parallelism under
+        the GIL); the loop thread only registers the pending future and
+        writes bytes. One call_soon_threadsafe instead of a full
+        run_coroutine_threadsafe round — the hot path for direct dispatch.
+        Returns a concurrent.futures.Future with the correlated response.
+        """
+        import concurrent.futures
+
+        rid = next(self._rid)
+        data = dumps(dict(msg, rid=rid))
+        cfut: "concurrent.futures.Future" = concurrent.futures.Future()
+
+        def _send() -> None:
+            if self.closed.is_set():
+                cfut.set_exception(
+                    ConnectionError(f"connection {self.name!r} closed"))
+                return
+            fut = self._loop.create_future()
+            self._pending[rid] = fut
+
+            def _done(f: "asyncio.Future") -> None:
+                if cfut.done():
+                    return
+                if f.cancelled():
+                    cfut.cancel()
+                elif f.exception() is not None:
+                    cfut.set_exception(f.exception())
+                else:
+                    cfut.set_result(f.result())
+
+            fut.add_done_callback(_done)
+            try:
+                self.writer.write(_LEN.pack(len(data)))
+                self.writer.write(data)
+            except Exception as e:  # noqa: BLE001
+                self._pending.pop(rid, None)
+                if not cfut.done():
+                    cfut.set_exception(e)
+
+        try:
+            self._loop.call_soon_threadsafe(_send)
+        except RuntimeError as e:  # loop closed
+            cfut.set_exception(ConnectionError(str(e)))
+        return cfut
 
     async def close(self) -> None:
         if self._reader_task is not None:

@@ -117,6 +117,12 @@ class WorkerRuntime:
         self.functions: Dict[str, Any] = {}
         self.actors: Dict[str, ActorMailbox] = {}
         self.shutdown_event = threading.Event()
+        # Direct-dispatch server: peers push actor tasks here without a
+        # controller hop (reference: direct task transport,
+        # src/ray/core_worker/transport/direct_task_transport.h:222 — the
+        # lease-then-push design keeping the control plane off the data
+        # path). Advertised to the controller in the register message.
+        self.direct_port = self._start_direct_server()
         # Context must be live before registration: the controller may push a
         # task the instant the register request lands.
         ctx.set_worker_context(ctx.WorkerContext(client=self.client, node_id=node_id, role="worker"))
@@ -143,6 +149,7 @@ class WorkerRuntime:
                 "spawn_token": flags.get("RTPU_SPAWN_TOKEN"),
                 "tpu_capable": flags.get("RTPU_TPU_WORKER"),
                 "env_hash": env_hash,
+                "direct_port": self.direct_port,
             }
         )
 
@@ -154,6 +161,63 @@ class WorkerRuntime:
             self.shutdown_event.set()
 
         self.client.io.call_nowait(_watch_conn())
+
+    # ------------------------------------------------------- direct dispatch
+
+    def _start_direct_server(self) -> int:
+        from . import protocol
+
+        async def serve():
+            async def on_conn(reader, writer):
+                conn = protocol.Connection(
+                    reader, writer, handler=self._handle_direct,
+                    name="direct")
+                conn.start()
+
+            return await __import__("asyncio").start_server(
+                on_conn, "0.0.0.0", 0)
+
+        self._direct_server = self.client.io.call(serve(), timeout=10)
+        return self._direct_server.sockets[0].getsockname()[1]
+
+    async def _handle_direct(self, conn, msg):
+        """Peer-pushed actor task: enqueue on the mailbox, answer with the
+        result locations when it completes. The response rides the same
+        connection (request/response correlation), so the caller gets the
+        locations with zero controller involvement."""
+        import asyncio
+
+        if msg["kind"] != "direct_actor_task":
+            raise ValueError(f"direct server: unknown kind {msg['kind']!r}")
+        spec = msg["spec"]
+        if spec.get("streaming"):
+            # Generator state lives in the controller; a direct streaming
+            # call would hang the caller's future forever.
+            raise ValueError("streaming calls must go through the controller")
+        mb = self.actors.get(spec["actor_id"])
+        if mb is None:
+            raise ActorDiedError(
+                f"actor {spec['actor_id'][:8]} is not hosted on this worker "
+                f"(died or restarted elsewhere)")
+        spec["__direct__"] = (asyncio.get_running_loop().create_future(),
+                              asyncio.get_running_loop())
+        mb.submit(spec)
+        return await spec["__direct__"][0]
+
+    def _finish_direct(self, spec: Dict[str, Any], payload: Dict[str, Any]) -> bool:
+        """Resolve a direct caller's future; returns True if this spec came
+        through the direct server."""
+        df = spec.pop("__direct__", None)
+        if df is None:
+            return False
+        fut, loop = df
+
+        def _set():
+            if not fut.done():
+                fut.set_result(payload)
+
+        loop.call_soon_threadsafe(_set)
+        return True
 
     # ----------------------------------------------------------- push handler
 
@@ -186,10 +250,13 @@ class WorkerRuntime:
 
     def _resolve_args(self, spec: Dict[str, Any]) -> tuple:
         args, kwargs = pickle.loads(spec["args_blob"])
-        ref_ids = [v.object_id for v in (*args, *kwargs.values()) if isinstance(v, ArgRef)]
-        locs: Dict[str, ObjectLocation] = {}
+        hints: Dict[str, ObjectLocation] = spec.get("loc_hints") or {}
+        ref_ids = [v.object_id for v in (*args, *kwargs.values())
+                   if isinstance(v, ArgRef) and v.object_id not in hints]
+        locs: Dict[str, ObjectLocation] = dict(hints)
         if ref_ids:
-            locs = self.client.request({"kind": "get_locations", "object_ids": ref_ids})
+            locs.update(self.client.request(
+                {"kind": "get_locations", "object_ids": ref_ids}))
 
         def resolve(v: Any) -> Any:
             if isinstance(v, ArgRef):
@@ -285,14 +352,18 @@ class WorkerRuntime:
         except BaseException as e:  # noqa: BLE001
             self._complete_error(spec, e, traceback.format_exc())
             return
-        self.client.request(
-            {
-                "kind": "task_done",
-                "task_id": spec["task_id"],
-                "worker_id": self.worker_id,
-                "locations": locations,
-            }
-        )
+        msg = {
+            "kind": "task_done",
+            "task_id": spec["task_id"],
+            "worker_id": self.worker_id,
+            "locations": locations,
+        }
+        self._finish_direct(spec, {"locations": locations})
+        # Fire-and-forget: nothing consumes the ack, and the worker is not
+        # eligible for new work until the controller processes this message
+        # anyway (state flips to idle there) — so dropping the round trip
+        # costs nothing and saves a response pickle + wakeup per task.
+        self.client.send_nowait(msg)
 
     def _complete_error(self, spec: Dict[str, Any], e: BaseException, tb: str) -> None:
         label = spec.get("label", spec["task_id"][:8])
@@ -317,15 +388,15 @@ class WorkerRuntime:
             ObjectLocation(object_id=oid, size=len(data), inline=data, is_error=True)
             for oid in err_ids
         ]
+        msg = {
+            "kind": "task_done",
+            "task_id": spec["task_id"],
+            "worker_id": self.worker_id,
+            "error_locations": err_locs,
+        }
+        self._finish_direct(spec, {"error_locations": err_locs})
         try:
-            self.client.request(
-                {
-                    "kind": "task_done",
-                    "task_id": spec["task_id"],
-                    "worker_id": self.worker_id,
-                    "error_locations": err_locs,
-                }
-            )
+            self.client.send_nowait(msg)
         except Exception:
             pass
 

@@ -66,6 +66,10 @@ _define("RTPU_TPU_WORKER", bool, False,
         "Marks a worker as TPU-capable (set on workers granted TPU "
         "resources; gates device initialization).")
 
+_define("RTPU_DIRECT_DISPATCH", bool, True,
+        "Push actor calls directly to the hosting worker (lease-then-push); "
+        "0 routes every call through the controller.")
+
 # -- controller tunables -----------------------------------------------------
 _define("RTPU_MAX_WORKERS_PER_NODE", int, 32,
         "Upper bound on workers the controller spawns per node.")
