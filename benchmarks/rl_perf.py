@@ -1,12 +1,19 @@
-"""RLlib PPO throughput microbenchmark (BASELINE.json config 4 proxy).
+"""RLlib sampling/training throughput (BASELINE.json config 4 proxy).
 
-Measures env-steps/s on CartPole with vectorized env-runner actors:
-1. pure sampling throughput (no learning),
-2. full training iterations (sample -> GAE/batch -> learner update ->
-   weight broadcast).
+Three metrics, one JSON line each (committed to benchmarks/RL_PERF.json):
 
-Prints one JSON line per metric; run from the repo root:
-    JAX_PLATFORMS=cpu python benchmarks/rl_perf.py
+1. cnn_sample_steps_per_s — fragment sampler + Nature-CNN policy on the
+   synthetic Atari-shaped CnnRolloutBenchEnv ([84,84,4] uint8, whole batch
+   steps in numpy). Measures the sampler + batched-inference architecture
+   (the reference's vectorized env runner path,
+   rllib/env/single_agent_env_runner.py:701); it is NOT a real game.
+   Runs the policy on the TPU when one is visible (batched device
+   inference), else CPU.
+2. ppo_sample_steps_per_s — fragment sampling on real gymnasium CartPole.
+3. ppo_train_steps_per_s — full PPO iterations (sample -> vectorized GAE
+   -> learner minibatch SGD -> weight broadcast).
+
+Run from the repo root: python benchmarks/rl_perf.py
 """
 from __future__ import annotations
 
@@ -18,15 +25,77 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import json
 import time
 
-from ray_tpu.util.jaxenv import ensure_platform
 
-ensure_platform("cpu")  # the driver's learner/GAE must not ride the relay
+def bench_cnn_sampler(device: str, num_envs=256, T=32, reps=3) -> dict:
+    import jax
 
-import ray_tpu
-from ray_tpu.rllib.algorithms.ppo import PPOConfig
+    from ray_tpu.rllib.core.catalog import CNNModule
+    from ray_tpu.rllib.env.env_runner import SingleAgentEnvRunner
+    from ray_tpu.rllib.env.vector_env import CnnRolloutBenchEnv
+
+    def make_batched(n):
+        return CnnRolloutBenchEnv(n)
+
+    make_batched.makes_batched_env = True
+
+    runner = SingleAgentEnvRunner(
+        make_batched, lambda: CNNModule((84, 84, 4), 6),
+        num_envs=num_envs, seed=0, device=device)
+    runner.set_weights(runner.module.init(jax.random.key(0)))
+    runner.sample_fragment(4)  # warm compile
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        runner.sample_fragment(T)
+        best = min(best, time.perf_counter() - t0)
+    steps = T * num_envs
+    return {"metric": "cnn_sample_steps_per_s",
+            "value": round(steps / best, 1), "unit": "env-steps/s",
+            "num_envs": num_envs, "fragment_len": T,
+            # report what jax ACTUALLY initialized, not the request —
+            # a host without a TPU silently falls back to CPU.
+            "policy_device": jax.devices()[0].platform,
+            "note": "synthetic Atari-shaped batched env (framework+inference "
+                    "ceiling; not a real game)"}
 
 
 def main(iters=6, warmup=2):
+    # CNN sampler runs in a SUBPROCESS: it may initialize the TPU backend,
+    # and once jax has a backend the parent's CPU pin below would silently
+    # no-op — the PPO numbers must stay CPU-measured and reproducible.
+    import subprocess
+
+    use_tpu = not os.environ.get("JAX_PLATFORMS", "").startswith("cpu")
+    child = (
+        "import sys, json; sys.path.insert(0, {root!r});"
+        "sys.path.insert(0, {here!r});"
+        "from rl_perf import bench_cnn_sampler;"
+        "print(json.dumps(bench_cnn_sampler({dev!r})))"
+    ).format(root=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+             here=os.path.dirname(os.path.abspath(__file__)),
+             dev="tpu" if use_tpu else "cpu")
+    out = {"metric": "cnn_sample_steps_per_s", "value": 0.0,
+           "error": "subprocess failed"}
+    try:
+        p = subprocess.run([sys.executable, "-c", child], capture_output=True,
+                           text=True, timeout=1200)
+        for line in reversed(p.stdout.splitlines()):
+            if line.startswith("{"):
+                out = json.loads(line)
+                break
+        else:
+            out["error"] = (p.stderr or "no output").strip()[-200:]
+    except subprocess.TimeoutExpired:
+        out["error"] = "timeout"
+    print(json.dumps(out), flush=True)
+
+    from ray_tpu.util.jaxenv import ensure_platform
+
+    ensure_platform("cpu")  # the driver learner/GAE must not ride the relay
+
+    import ray_tpu
+    from ray_tpu.rllib.algorithms.ppo import PPOConfig
+
     ray_tpu.init(num_cpus=4)
     config = (
         PPOConfig()
@@ -38,14 +107,15 @@ def main(iters=6, warmup=2):
     )
     algo = config.build()
 
-    # Pure sampling rate (actors sample concurrently).
+    # Pure fragment-sampling rate (actors sample concurrently).
     group = algo.env_runner_group
     group.sync_weights(algo.learner_group.get_weights())
+    group.sample_fragments(8)  # warm compiles
     t0 = time.perf_counter()
     n = 0
     for _ in range(4):
-        eps = group.sample(total_timesteps=2048)
-        n += sum(len(e) for e in eps)
+        frags = group.sample_fragments(128)
+        n += sum(int(f["valid"].sum()) for f in frags)
     dt = time.perf_counter() - t0
     print(json.dumps({"metric": "ppo_sample_steps_per_s",
                       "value": round(n / dt, 1), "unit": "env-steps/s"}),

@@ -1,56 +1,51 @@
 """Pipeline parallelism over the `pipe` mesh axis: a microbatched GPipe
-schedule inside ONE jitted step.
+schedule inside ONE jitted step, expressed entirely in GSPMD auto mode.
 
 SURVEY.md §5.7 names pipeline parallelism a first-class requirement; the
 reference has no in-graph pipeline engine at all (its compiled-DAG pipelines
-actors at the task layer, dag/compiled_dag_node.py:291 — a different altitude).
-The TPU-native design runs the whole schedule inside XLA:
+actors at the task layer, dag/compiled_dag_node.py:291 — a different
+altitude). The TPU-native design (the MaxText/praxis idiom) runs the whole
+schedule inside XLA with NO manual collectives:
 
-- The layer stack [L, ...] is sharded over `pipe` (logical axis "layers"),
-  so each stage owns a contiguous block of L/P layers — zero repartitioning.
-- shard_map makes the mesh manual; each device runs `lax.scan` over its
-  local layers, and `lax.ppermute` hands activations to the next stage.
+- The layer stack [L, ...] reshapes to [P, L/P, ...] with the leading stage
+  dim sharded over `pipe` — each device holds its stage's contiguous layer
+  block, zero repartitioning.
+- A state buffer [P, mb, S, d], also pipe-sharded on the stage dim, holds
+  the microbatch each stage is processing. Every tick vmaps the stage body
+  (a lax.scan over that stage's layers) across the stage dim — perfectly
+  SPMD — then hands activations to the next stage with jnp.roll along the
+  stage dim, which XLA lowers to a CollectivePermute over `pipe`.
+- Because everything is ordinary sharded computation, tensor/fsdp/expert
+  sharding INSIDE a stage needs nothing special: the same rule table that
+  shards the unpipelined model shards each stage's params and activations,
+  and GSPMD inserts the per-stage collectives. pipe x fsdp, pipe x tensor
+  and MoE-under-pipe compose by construction; autodiff is the standard
+  transpose (the roll transposes to the reverse roll — the backward
+  pipeline for free).
 - The schedule is GPipe: with M microbatches and P stages it runs M+P-1
-  ticks; bubbles compute garbage that output masking discards. Backward is
-  plain autodiff through the scan — ppermute transposes to the reverse
-  permutation, giving the symmetric backward pipeline for free.
+  ticks; bubble ticks compute garbage that output masking discards, and
+  the MoE aux-loss contribution of bubbles is masked out the same way.
 
-Embedding and the LM head run OUTSIDE the shard_map in ordinary GSPMD land,
-so vocab/fsdp sharding of those params keeps working unchanged.
+Embedding and the LM head run outside the scan in ordinary GSPMD land, so
+vocab/fsdp sharding of those params keeps working unchanged.
 """
 from __future__ import annotations
 
-from typing import Any, Dict, Optional
+from typing import Any, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax.sharding import Mesh, PartitionSpec as P
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ray_tpu.parallel import sharding as shd
 
 
-def _check_layer_specs_pipe_only(cfg, mesh: Mesh, rules) -> None:
-    """The stage body runs _layer_body in plain (non-collective) form, so
-    layer params may be sharded over `pipe` ONLY. Megatron-style manual TP
-    inside the pipeline (psum after row-parallel matmuls) is not implemented
-    — composing pipe with tensor/fsdp ON PARAMS must fail loudly, not
-    silently all-gather and replicate compute."""
-    from ray_tpu.models.transformer import param_logical_specs
-
-    for spec in jax.tree.leaves(
-        param_logical_specs(cfg)["layers"],
-        is_leaf=lambda x: isinstance(x, tuple),
-    ):
-        mesh_spec = shd.logical_to_mesh_spec(spec, rules, mesh)
-        extra = [a for a in jax.tree.leaves(tuple(mesh_spec)) if a != "pipe"]
-        if extra:
-            raise NotImplementedError(
-                f"pipeline parallelism composes with data-parallel batch "
-                f"sharding only; layer param spec {spec} maps onto mesh "
-                f"axes {extra} (tensor/fsdp on params inside the pipeline "
-                f"is not supported — use a mesh with those axes = 1)"
-            )
+def _stage_spec(rules: shd.Rules, mesh: Mesh, logical: Tuple) -> P:
+    """PartitionSpec for an array with a leading stage dim: ('pipe', then
+    the usual logical mapping for the remaining dims)."""
+    inner = shd.logical_to_mesh_spec(logical, rules, mesh)
+    return P("pipe", *tuple(inner))
 
 
 def pipeline_apply(
@@ -59,67 +54,97 @@ def pipeline_apply(
     x: jax.Array,  # [M, mb, S, d] microbatched activations
     mesh: Mesh,
     rules: Optional[Dict] = None,
-) -> jax.Array:
-    """Run the layer stack as a P-stage pipeline; returns [M, mb, S, d]."""
+) -> Tuple[jax.Array, jax.Array]:
+    """Run the layer stack as a P-stage GPipe pipeline.
+
+    Returns (activations [M, mb, S, d], summed MoE aux loss — zero for
+    dense stacks)."""
     from ray_tpu.models.transformer import layer_scan_body
 
     rules = rules or shd.DEFAULT_RULES
     num_stages = mesh.shape["pipe"]
     M, mb, S, d = x.shape
     num_ticks = M + num_stages - 1
-    _check_layer_specs_pipe_only(cfg, mesh, rules)
-    # Same mapping shard_batch/maybe_constrain use for the batch dim.
-    mb_spec = shd.logical_to_mesh_spec(("batch",), rules, mesh)[0]
 
-    layer_specs = jax.tree.map(lambda a: P("pipe"), layers)
-    x_spec = P(None, mb_spec, None, None)
-    out_spec = P("pipe", None, mb_spec, None, None)
+    # [L, ...] -> [P, L/P, ...], stage dim pinned to `pipe`; remaining dims
+    # keep their logical sharding (fsdp/tensor/expert) from the rule table.
+    from ray_tpu.models.transformer import param_logical_specs
 
-    def body(layers_local, x_local):
-        # x_local: [M, mb_local, S, d]; layers_local leaves: [L/P, ...]
-        stage = lax.axis_index("pipe")
-        positions = jnp.broadcast_to(
-            jnp.arange(S, dtype=jnp.int32)[None], (x_local.shape[1], S))
-        scan_body = layer_scan_body(cfg, positions)
+    lspecs = param_logical_specs(cfg)["layers"]
 
-        def run_local(h):
-            with shd.no_sharding_ctx():
-                out, _ = lax.scan(scan_body, h, layers_local)
-            return out
+    def stage_fold(a, spec):
+        L = a.shape[0]
+        if L % num_stages:
+            raise ValueError(
+                f"n_layers {L} not divisible by pipe={num_stages}")
+        staged = a.reshape(num_stages, L // num_stages, *a.shape[1:])
+        # [P, L/P, *param_dims]: pipe on the stage dim, None for the L/P
+        # dim, then the per-param logical mapping — off-by-one here would
+        # silently shard heads/mlp dims onto the wrong mesh axes.
+        inner = shd.logical_to_mesh_spec(tuple(spec)[1:], rules, mesh)
+        return jax.lax.with_sharding_constraint(
+            staged, NamedSharding(mesh, P("pipe", None, *tuple(inner))))
 
-        state0 = jnp.zeros(x_local.shape[1:], x_local.dtype)
-        outputs0 = jnp.zeros_like(x_local)
+    layers_staged = jax.tree.map(
+        stage_fold, layers, lspecs,
+        is_leaf=lambda v: not isinstance(v, dict))
 
-        def tick(carry, t):
-            state, outputs = carry
-            inject = x_local[jnp.minimum(t, M - 1)]
-            cur = jnp.where(stage == 0, inject, state)
-            cur = run_local(cur)
-            out_idx = t - (num_stages - 1)
-            valid = (stage == num_stages - 1) & (out_idx >= 0)
-            idx = jnp.clip(out_idx, 0, M - 1)
-            outputs = outputs.at[idx].set(
-                jnp.where(valid, cur, outputs[idx]))
-            nxt = lax.ppermute(
-                cur, "pipe",
-                [(i, (i + 1) % num_stages) for i in range(num_stages)])
-            return (nxt, outputs), None
+    act_logical = ("batch", "seq_act", "embed")
+    state_sharding = NamedSharding(mesh, _stage_spec(rules, mesh, act_logical))
 
-        (_, outputs), _ = lax.scan(
-            tick, (state0, outputs0), jnp.arange(num_ticks))
-        # Stack per-stage buffers along a new leading axis; only the last
-        # stage's buffer is real — the caller slices it out (pure data
-        # movement, no collective).
-        return outputs[None]
+    positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32)[None],
+                                 (mb, S))
+    scan_body = layer_scan_body(cfg, positions)
+    # Ring attention is a shard_map over `seq` and cannot nest inside the
+    # vmapped stage body; dropping the seq_act routing makes attention()
+    # use the dense per-stage kernel (context parallelism composes with
+    # pipe at the batch level instead).
+    inner_rules = {k: v for k, v in rules.items() if k != "seq_act"}
 
-    fn = jax.shard_map(
-        body,
-        mesh=mesh,
-        in_specs=(layer_specs, x_spec),
-        out_specs=out_spec,
-        check_vma=False,
-    )
-    return fn(layers, x)[-1]
+    def stage_apply(stage_layers, h):
+        with shd.sharding_ctx(mesh, inner_rules):
+            out, auxs = lax.scan(scan_body, h, stage_layers)
+        return out, auxs.sum()
+
+    vapply = jax.vmap(stage_apply)
+
+    state0 = jnp.zeros((num_stages, mb, S, d), x.dtype)
+    outputs0 = jnp.zeros_like(x)
+    stage_ids = jnp.arange(num_stages)
+
+    def tick(carry, t):
+        state, outputs, aux_acc = carry
+        # Stage 0 picks up microbatch t (bubble ticks recirculate garbage
+        # that the masks below ignore).
+        inject = x[jnp.minimum(t, M - 1)]
+        state = state.at[0].set(jnp.where(t < M, inject, state[0]))
+        state = jax.lax.with_sharding_constraint(state, state_sharding)
+        out, aux = vapply(layers_staged, state)  # [P, mb, S, d], [P]
+        # Stage s processes microbatch (t - s) this tick; outside [0, M)
+        # it's a bubble — mask its aux contribution.
+        mb_idx = t - stage_ids
+        valid = (mb_idx >= 0) & (mb_idx < M)
+        aux_acc = aux_acc + jnp.where(valid, aux, 0.0).sum()
+        # The last stage emits microbatch t-(P-1) once real work reaches it.
+        out_idx = t - (num_stages - 1)
+        idx = jnp.clip(out_idx, 0, M - 1)
+        outputs = outputs.at[idx].set(
+            jnp.where(out_idx >= 0, out[num_stages - 1], outputs[idx]))
+        # Hand activations to the next stage: a roll on the pipe-sharded
+        # stage dim = CollectivePermute over ICI. Slot 0's content is
+        # overwritten by the next injection.
+        state = jnp.roll(out, 1, axis=0)
+        state = jax.lax.with_sharding_constraint(state, state_sharding)
+        return (state, outputs, aux_acc), None
+
+    (_, outputs, aux_acc), _ = lax.scan(
+        tick, (state0, outputs0, jnp.zeros((), jnp.float32)),
+        jnp.arange(num_ticks))
+    # The per-layer aux loss is a token-MEAN (ops/moe.py); every microbatch
+    # contributes one mean per layer, so the accumulated sum is M x the
+    # full-batch value — normalize to match the unpipelined loss exactly
+    # (equal-size microbatches make mean-of-means = full mean).
+    return outputs, aux_acc / M
 
 
 def pipeline_loss_fn(cfg, mesh: Mesh, *, rules=None, num_microbatches: int = 4,
@@ -129,18 +154,14 @@ def pipeline_loss_fn(cfg, mesh: Mesh, *, rules=None, num_microbatches: int = 4,
     Drop-in for models.transformer.loss_fn wherever the mesh has pipe>1;
     wire into ShardedTrainStep via train.step.transformer_train_step(...,
     pipeline_microbatches=M). ``shift_inputs`` selects the [B,S+1]-tokens
-    convention (models.transformer.loss_fn docstring).
+    convention (models.transformer.loss_fn docstring). MoE stacks thread
+    their load-balancing aux loss through the schedule (bubble ticks
+    masked out).
     """
     from ray_tpu.models import transformer as tfm
 
     rules = rules or shd.DEFAULT_RULES
     M = num_microbatches
-    if getattr(cfg, "moe_num_experts", 0):
-        raise NotImplementedError(
-            "MoE under pipeline parallelism is not supported yet: the "
-            "load-balancing aux loss would be silently dropped by the "
-            "stage scan. Use expert parallelism (mesh expert axis) without "
-            "pipe, or a dense config with pipe.")
 
     def loss_fn(params, batch):
         tokens = batch["tokens"]
@@ -151,14 +172,18 @@ def pipeline_loss_fn(cfg, mesh: Mesh, *, rules=None, num_microbatches: int = 4,
                 f"batch {B} not divisible by num_microbatches {M}")
         x = tfm.embed_tokens(params, inputs, cfg)  # [B, S, d]
         x = x.reshape(M, B // M, S, -1)
-        y = pipeline_apply(cfg, params["layers"], x, mesh, rules)
+        y, aux = pipeline_apply(cfg, params["layers"], x, mesh, rules)
         y = y.reshape(B, S, -1)
         y = shd.maybe_constrain(y, ("batch", "seq_act", "embed"))
         logits = tfm.lm_head(params, y, cfg)
         if shift_inputs:
             targets, valid = tfm.shift_targets_valid(
                 tokens, batch.get("mask"))
-            return tfm.token_cross_entropy(logits, targets, valid)
-        return tfm.next_token_loss(logits, batch)
+            loss = tfm.token_cross_entropy(logits, targets, valid)
+        else:
+            loss = tfm.next_token_loss(logits, batch)
+        if cfg.moe_num_experts:
+            loss = loss + cfg.moe_aux_coef * aux
+        return loss
 
     return loss_fn

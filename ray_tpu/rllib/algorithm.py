@@ -52,6 +52,7 @@ class Algorithm(Trainable):
             num_envs_per_runner=cfg.num_envs_per_env_runner,
             seed=cfg.seed,
             connector_factory=cfg.env_to_module_connector,
+            vectorize_mode=cfg.vectorize_mode,
         )
         self.learner_group = LearnerGroup(
             self._learner_factory(), num_learners=cfg.num_learners)

@@ -23,6 +23,13 @@ class AlgorithmConfig:
         self.num_envs_per_env_runner: int = 1
         self.rollout_fragment_length: int = 200
         self.env_to_module_connector: Optional[Any] = None
+        # Fragment sampling ([T,N] columns, utils/rollout.py) is the
+        # throughput default for PPO; False restores the episode-based
+        # sampler (comparison/debug).
+        self.use_fragments: bool = True
+        # "sync" | "async": gym vector env backend (async = subprocess per
+        # env, for CPU-heavy env steps on many-core hosts).
+        self.vectorize_mode: str = "sync"
         # training()
         self.lr: float = 3e-4
         self.gamma: float = 0.99
@@ -62,6 +69,8 @@ class AlgorithmConfig:
                     num_envs_per_env_runner: Optional[int] = None,
                     rollout_fragment_length: Optional[int] = None,
                     env_to_module_connector: Optional[Any] = None,
+                    use_fragments: Optional[bool] = None,
+                    vectorize_mode: Optional[str] = None,
                     ) -> "AlgorithmConfig":
         if num_env_runners is not None:
             self.num_env_runners = num_env_runners
@@ -69,6 +78,10 @@ class AlgorithmConfig:
             self.num_envs_per_env_runner = num_envs_per_env_runner
         if rollout_fragment_length is not None:
             self.rollout_fragment_length = rollout_fragment_length
+        if use_fragments is not None:
+            self.use_fragments = use_fragments
+        if vectorize_mode is not None:
+            self.vectorize_mode = vectorize_mode
         if env_to_module_connector is not None:
             # Zero-arg factory returning a ConnectorV2 / ConnectorPipeline
             # (reference: config.env_runners(env_to_module_connector=...)).

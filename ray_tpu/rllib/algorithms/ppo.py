@@ -137,10 +137,12 @@ class PPO(Algorithm):
         # 1. broadcast current weights to the sampling fleet
         weights = self.learner_group.get_weights()
         self.env_runner_group.sync_weights(weights)
-        # 2. synchronous parallel sample
+        if cfg.use_fragments:
+            return self._training_step_fragments(cfg)
+        # Legacy episode-based path (kept for comparison/debug; the
+        # fragment path is the throughput-oriented default).
         episodes = self.env_runner_group.sample(cfg.train_batch_size)
         self._record_episodes(episodes)
-        # 3. postprocess (GAE + flatten) and minibatch-SGD over timesteps
         max_t = min(cfg.max_episode_len, max(len(e) for e in episodes))
         batch = postprocess_episodes(
             episodes, gamma=cfg.gamma, lam=cfg.lambda_, max_t=max_t)
@@ -154,4 +156,36 @@ class PPO(Algorithm):
         out["episode_return_mean"] = self.episode_return_mean
         out["num_episodes"] = len(episodes)
         out["env_steps_this_iter"] = int(sum(len(e) for e in episodes))
+        return out
+
+    def _training_step_fragments(self, cfg) -> Dict[str, Any]:
+        """Fragment path: [T, N] columns from every runner, vectorized GAE,
+        minibatch SGD over the flat (masked) transition batch."""
+        from ..utils.rollout import fragments_to_ppo_batch
+
+        frags = self.env_runner_group.sample_fragments(
+            cfg.rollout_fragment_length)
+        n_eps = 0
+        n_steps = 0
+        for f in frags:
+            rets = f.get("episode_returns") or []
+            n_eps += len(rets)
+            n_steps += int(f["valid"].sum())
+            self._recent_returns.extend(float(r) for r in rets)
+        self._episodes_total += n_eps
+        self._timesteps_total += n_steps
+        window = cfg.metrics_num_episodes_for_smoothing
+        self._recent_returns = self._recent_returns[-window:]
+        batch = fragments_to_ppo_batch(
+            frags, gamma=cfg.gamma, lam=cfg.lambda_)
+        metrics = self.learner_group.update(
+            batch,
+            minibatch_size=cfg.minibatch_size,
+            num_epochs=cfg.num_epochs,
+            shuffle=True,
+        )
+        out = dict(metrics)
+        out["episode_return_mean"] = self.episode_return_mean
+        out["num_episodes"] = n_eps
+        out["env_steps_this_iter"] = int(batch["mask"].sum())
         return out

@@ -25,18 +25,32 @@ class SingleAgentEnvRunner:
         seed: int = 0,
         worker_index: int = 0,
         connector_factory: Optional[Callable[[], Any]] = None,
+        vectorize_mode: str = "sync",
+        device: str = "cpu",
     ):
-        import gymnasium as gym
+        from .vector_env import GymVecEnv
 
-        # Sampling is pure CPU work; never grab the accelerator.
+        # Sampling policy inference defaults to CPU (env runners on CPU
+        # hosts never grab the accelerator); device="tpu" opts a runner
+        # into batched device inference — one forward per vector step
+        # across many envs (the reference's GPU-inference env runners).
         from ray_tpu.util.jaxenv import ensure_platform
 
-        ensure_platform("cpu")
+        if device == "cpu":
+            ensure_platform("cpu")
         import jax
 
         self._jax = jax
-        self.envs = gym.vector.SyncVectorEnv(
-            [env_creator for _ in range(num_envs)])
+        if getattr(env_creator, "makes_batched_env", False):
+            # The creator builds a whole BatchedEnv itself (vector_env.py
+            # protocol) — e.g. the CNN rollout bench or an envpool-style
+            # native vector env.
+            self.batched = env_creator(num_envs)
+            self.envs = None
+        else:
+            self.batched = GymVecEnv(env_creator, num_envs,
+                                     mode=vectorize_mode)
+            self.envs = self.batched.envs  # legacy episode-based sampler
         self.num_envs = num_envs
         self.module = module_factory()
         self.params = None
@@ -50,7 +64,7 @@ class SingleAgentEnvRunner:
         self._value_fn = jax.jit(
             lambda p, o: self.module.forward(p, o)["vf"])
         seed_val = int(seed * 65_537 + worker_index)
-        raw_obs, _ = self.envs.reset(seed=seed_val)
+        raw_obs = self.batched.reset(seed=seed_val)
         self._obs = self._connect(raw_obs)
         self._episodes = [SingleAgentEpisode() for _ in range(num_envs)]
         for i in range(num_envs):
@@ -59,6 +73,11 @@ class SingleAgentEnvRunner:
         # (AutoresetMode.NEXT_STEP): that step's action is ignored, so no
         # transition must be recorded for it.
         self._needs_reset = np.zeros(num_envs, dtype=bool)
+        # Fragment-path state (sample_fragment): reusable buffers + running
+        # per-env return accumulators, all vectorized.
+        self._frag_buffers: Optional[Dict[str, np.ndarray]] = None
+        self._ep_return = np.zeros(num_envs, np.float64)
+        self._completed_returns: List[float] = []
 
     # ----------------------------------------------------------------- state
 
@@ -73,11 +92,91 @@ class SingleAgentEnvRunner:
 
     # ---------------------------------------------------------------- sample
 
+    def sample_fragment(self, num_steps: int) -> Dict[str, Any]:
+        """Fixed-length rollout fragment: [T, N] arrays, zero per-env
+        Python in the hot loop (reference single_agent_env_runner.py:127
+        vector sampling; see utils/rollout.py for the layout).
+
+        One policy forward per vector step over all N envs; env stepping
+        and bookkeeping are whole-batch numpy ops. This is the
+        high-throughput path PPO/IMPALA train from.
+        """
+        assert self.params is not None, "set_weights before sample"
+        jax = self._jax
+        T, N = num_steps, self.num_envs
+        bufs = self._frag_buffers
+        if bufs is None or bufs["actions"].shape[0] != T:
+            obs_shape = self._obs.shape[1:]
+            bufs = self._frag_buffers = {
+                "obs": np.empty((T, N, *obs_shape), self._obs.dtype),
+                "actions": np.empty((T, N), np.int64),
+                "logp": np.empty((T, N), np.float32),
+                "vf": np.empty((T, N), np.float32),
+                "rewards": np.empty((T, N), np.float32),
+                "dones": np.empty((T, N), bool),
+                "truncs": np.empty((T, N), bool),
+                "valid": np.empty((T, N), np.float32),
+            }
+        next_step_mode = self.batched.autoreset_mode == "next_step"
+        for t in range(T):
+            self._rng, sub = jax.random.split(self._rng)
+            actions, logp, vf = self._explore_fn(self.params, self._obs, sub)
+            actions = np.asarray(actions)
+            bufs["obs"][t] = self._obs
+            bufs["actions"][t] = actions
+            bufs["logp"][t] = logp
+            bufs["vf"][t] = vf
+            invalid = (self._needs_reset.copy() if next_step_mode
+                       else np.zeros(N, bool))
+            bufs["valid"][t] = 1.0 - invalid.astype(np.float32)
+            raw_next, rewards, terms, truncs = self.batched.step(actions)
+            bufs["rewards"][t] = rewards
+            done = terms | truncs
+            bufs["dones"][t] = done & ~invalid
+            bufs["truncs"][t] = truncs & ~terms
+            # Vectorized episode-return tracking (only completed episodes
+            # surface; the loop below is over DONE envs only — rare).
+            live = ~invalid
+            self._ep_return += np.where(live, rewards, 0.0)
+            finished = done & live
+            if finished.any():
+                self._completed_returns.extend(
+                    self._ep_return[finished].tolist())
+                self._ep_return[finished] = 0.0
+            if next_step_mode:
+                self._needs_reset = done
+                # NEXT_STEP: raw_next at a done step is the FINAL obs —
+                # connect it with the old stack (its value is the
+                # truncation bootstrap), THEN reset; the reset state
+                # applies to the reset obs arriving next step.
+                self._obs = self._connect(raw_next)
+                if finished.any() and self.connector is not None:
+                    for i in np.nonzero(finished)[0]:
+                        self.connector.reset(int(i))
+            else:
+                # SAME_STEP: raw_next is already the new episode's start —
+                # reset the connector before it passes through.
+                if finished.any() and self.connector is not None:
+                    for i in np.nonzero(finished)[0]:
+                        self.connector.reset(int(i))
+                self._obs = self._connect(raw_next)
+        bootstrap = np.asarray(self._value_fn(self.params, self._obs))
+        returns, self._completed_returns = self._completed_returns, []
+        return {
+            **{k: v.copy() for k, v in bufs.items()},
+            "bootstrap": bootstrap.astype(np.float32),
+            "episode_returns": returns,
+        }
+
     def sample(self, num_timesteps: int) -> List[SingleAgentEpisode]:
         """Step the vector env ~num_timesteps (per runner, across its envs);
         returns episode CHUNKS (done or truncated-by-horizon or cut at the
         end of the rollout, with bootstrap values for the cut ones)."""
         assert self.params is not None, "set_weights before sample"
+        if self.envs is None:
+            raise RuntimeError(
+                "episode-based sample() requires a gym env; this runner "
+                "wraps a native BatchedEnv — use sample_fragment()")
         jax = self._jax
         out: List[SingleAgentEpisode] = []
         steps = 0
@@ -169,4 +268,4 @@ class SingleAgentEnvRunner:
         return total
 
     def stop(self) -> None:
-        self.envs.close()
+        self.batched.close()

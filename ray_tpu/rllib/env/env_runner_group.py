@@ -28,13 +28,15 @@ class EnvRunnerGroup:
         runner_resources: Optional[Dict[str, float]] = None,
         max_restarts: int = 3,
         connector_factory: Optional[Callable[[], Any]] = None,
+        vectorize_mode: str = "sync",
     ):
         self.num_runners = num_runners
         if num_runners == 0:
             self._local = SingleAgentEnvRunner(
                 env_creator, module_factory,
                 num_envs=num_envs_per_runner, seed=seed, worker_index=0,
-                connector_factory=connector_factory)
+                connector_factory=connector_factory,
+                vectorize_mode=vectorize_mode)
             self._manager = None
         else:
             self._local = None
@@ -46,7 +48,8 @@ class EnvRunnerGroup:
                     env_creator, module_factory,
                     num_envs=num_envs_per_runner, seed=seed,
                     worker_index=i + 1,
-                    connector_factory=connector_factory)
+                    connector_factory=connector_factory,
+                    vectorize_mode=vectorize_mode)
 
             self._manager = FaultTolerantActorManager(
                 factory, num_runners, max_restarts=max_restarts)
@@ -58,6 +61,15 @@ class EnvRunnerGroup:
             self._local.set_weights(weights)
         else:
             self._manager.foreach_actor("set_weights", weights)
+
+    def sample_fragments(self, fragment_len: int) -> List[Dict[str, Any]]:
+        """One fixed-length [T, N] fragment per healthy runner (the
+        high-throughput path; utils/rollout.py)."""
+        if self._local is not None:
+            return [self._local.sample_fragment(fragment_len)]
+        results = self._manager.foreach_actor("sample_fragment", fragment_len)
+        self._manager.restore_unhealthy()
+        return [frag for _, frag in results]
 
     def sample(self, total_timesteps: int) -> List[SingleAgentEpisode]:
         """Synchronous parallel sample of ~total_timesteps across runners."""

@@ -51,13 +51,56 @@ def test_pipeline_train_step_runs(tmp_path):
     assert losses[-1] < losses[0], losses  # it learns on a fixed batch
 
 
-def test_pipeline_rejects_tensor_sharded_params():
-    """Composing pipe with tensor/fsdp on params is not implemented and must
-    fail loudly instead of silently all-gathering the weights."""
+@pytest.mark.parametrize("axes", [
+    {"pipe": 2, "tensor": 2, "data": 2},
+    {"pipe": 2, "fsdp": 2, "data": 2},
+    {"pipe": 2, "fsdp": 2, "tensor": 2},
+])
+def test_pipeline_composes_with_tensor_fsdp(axes):
+    """pipe x tensor / pipe x fsdp: the GSPMD pipeline leaves stage-internal
+    sharding to the rule table, so layer params stay tensor/fsdp-sharded and
+    the loss matches the unpipelined model (round-3 verdict item 5)."""
     cfg = llama_tiny(n_layers=4)
-    mesh = make_mesh(MeshSpec(pipe=2, tensor=2, data=2),
-                     devices=jax.devices()[:8])
+    n = 1
+    for v in axes.values():
+        n *= v
+    mesh = make_mesh(MeshSpec(**axes), devices=jax.devices()[:n])
     params = tfm.init_params(jax.random.key(0), cfg)
+    batch = {"tokens": _tokens(cfg, batch=8)}
+    ref_loss = float(tfm.loss_fn(params, batch, cfg))
     loss_fn = pipeline_loss_fn(cfg, mesh, rules=RULES_TP, num_microbatches=4)
-    with pytest.raises(NotImplementedError):
-        loss_fn(params, {"tokens": _tokens(cfg, batch=8)})
+    pl = float(jax.jit(loss_fn)(params, batch))
+    assert abs(pl - ref_loss) < 2e-3, (axes, pl, ref_loss)
+
+
+def test_moe_under_pipe_matches_and_threads_aux():
+    """MoE under pipeline parallelism: aux loss threads through the stage
+    schedule (bubbles masked), loss matches the unpipelined MoE model."""
+    from ray_tpu.models.configs import moe_tiny
+
+    # capacity_factor high enough that NO tokens drop: capacity-based MoE
+    # drops per-chunk, so a microbatched pipeline legitimately drops a
+    # different token set than the full-batch forward — parity is only
+    # well-defined in the drop-free regime.
+    cfg = moe_tiny(n_layers=4, moe_capacity_factor=8.0)
+    params = tfm.init_params(jax.random.key(0), cfg)
+    batch = {"tokens": _tokens(cfg, batch=8)}
+    ref_loss = float(jax.jit(lambda p, b: tfm.loss_fn(p, b, cfg))(params, batch))
+
+    mesh = make_mesh(MeshSpec(pipe=2, expert=2, data=2),
+                     devices=jax.devices()[:8])
+    loss_fn = pipeline_loss_fn(cfg, mesh, rules=RULES_TP, num_microbatches=4)
+    pl = float(jax.jit(loss_fn)(params, batch))
+    # Looser than the dense parity bound: bf16 expert dispatch/combine
+    # accumulates in a different chunk grouping under microbatching.
+    assert abs(pl - ref_loss) < 8e-3, (pl, ref_loss)
+
+    # The aux term is actually present: with a zero coefficient the loss
+    # differs (guards against the aux silently vanishing in the schedule).
+    import dataclasses
+
+    cfg0 = dataclasses.replace(cfg, moe_aux_coef=0.0)
+    loss_fn0 = pipeline_loss_fn(cfg0, mesh, rules=RULES_TP,
+                                num_microbatches=4)
+    pl0 = float(jax.jit(loss_fn0)(params, batch))
+    assert abs(pl - pl0) > 1e-5, "MoE aux loss lost in the pipeline schedule"
