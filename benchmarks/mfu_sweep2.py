@@ -55,7 +55,7 @@ print(json.dumps({{
 """
 
 
-def run(remat, policy, batch, block, seq=1024, timeout=900):
+def run(remat, policy, batch, block, seq=1024, timeout=1500):
     code = CHILD.format(root=ROOT, remat=remat, policy=policy, batch=batch,
                         seq=seq, block=block)
     try:
@@ -74,13 +74,13 @@ def run(remat, policy, batch, block, seq=1024, timeout=900):
 if __name__ == "__main__":
     variants = [
         # (remat, policy, batch, flash_block)
-        (True, "dots", 8, 1024),       # bigger flash blocks
-        (True, "dots", 8, 256),
         (True, "half_dots", 8, 512),   # less recompute than dots
-        (True, "half_full", 8, 512),
         (True, "dots", 16, 512),       # bigger matmul M, plain dots
         (True, "dots", 12, 512),
+        (True, "half_full", 8, 512),
         (True, "full", 8, 512),        # smallest program: maybe helper-safe
+        (True, "dots", 8, 1024),       # bigger flash blocks
+        (True, "dots", 8, 256),
     ]
     for v in variants:
         print(json.dumps(run(*v)), flush=True)

@@ -110,8 +110,20 @@ def init(
 
 
 async def _driver_handler(conn, msg):
-    if msg.get("kind") == "pubsub":
+    kind = msg.get("kind")
+    if kind == "pubsub":
         ctx.deliver_pubsub(msg["channel"], msg["data"])
+    elif kind == "log":
+        # A worker's stdout/stderr line, prefixed like the reference's
+        # driver-side log tailing ("(pid=...) ...").
+        import sys
+
+        stream = sys.stderr if msg.get("stream") == "stderr" else sys.stdout
+        try:
+            stream.write(f"(worker pid={msg.get('pid')}) {msg['line']}\n")
+            stream.flush()
+        except Exception:
+            pass
     return None
 
 

@@ -196,6 +196,9 @@ class Controller:
         self.named_pgs: Dict[str, str] = {}
         self.subs: Dict[str, List[protocol.Connection]] = {}  # pubsub channel -> conns
         self.driver_conns: Set[protocol.Connection] = set()
+        # App-defined metrics (util/metrics.py): name -> {type, help,
+        # boundaries, data {tags_tuple: value|histogram-state}}.
+        self.app_metrics: Dict[str, dict] = {}
         self._node_counter = 0
         self._spawned_procs: Dict[str, subprocess.Popen] = {}  # spawn_token -> proc
         self._tpu_spawn_tokens: Set[str] = set()  # tokens of TPU-capable spawns
@@ -591,6 +594,58 @@ class Controller:
             node.spawning_envs[eh] -= 1
             if not node.spawning_envs[eh]:
                 node.spawning_envs.pop(eh, None)
+
+    async def _h_metric_update(self, conn, msg):
+        """App-metric deltas from workers/drivers (util/metrics.py;
+        reference python/ray/util/metrics.py -> metrics_agent). Counters
+        accumulate, gauges overwrite, histogram observations bucket-count
+        against the metric's boundaries."""
+        for m in msg.get("metrics", []):
+            name = m["name"]
+            st = self.app_metrics.setdefault(
+                name, {"type": m["type"], "help": m.get("help", ""),
+                       "boundaries": m.get("boundaries") or [],
+                       "data": {}})
+            for tags_list, value in m.get("data", []):
+                tags = tuple(tuple(t) for t in tags_list)
+                if m["type"] == "gauge":
+                    st["data"][tags] = value
+                elif m["type"] == "counter":
+                    st["data"][tags] = st["data"].get(tags, 0.0) + value
+                else:  # histogram: per-tag {bucket_counts, sum, count}
+                    h = st["data"].setdefault(
+                        tags, {"buckets": [0] * (len(st["boundaries"]) + 1),
+                               "sum": 0.0, "count": 0})
+                    for obs in value:
+                        i = 0
+                        for i, b in enumerate(st["boundaries"]):
+                            if obs <= b:
+                                break
+                        else:
+                            i = len(st["boundaries"])
+                        h["buckets"][i] += 1
+                        h["sum"] += obs
+                        h["count"] += 1
+        return {"ok": True}
+
+    async def _h_worker_log(self, conn, msg):
+        """Forward a worker's stdout/stderr line to every connected driver
+        (reference: _private/log_monitor.py tailing worker logs to the
+        driver). Fire-and-forget fanout; a dead driver conn is skipped."""
+        out = {"kind": "log", "line": msg.get("line", ""),
+               "pid": msg.get("pid"), "worker_id": msg.get("worker_id", ""),
+               "stream": msg.get("stream", "stdout")}
+        for dconn in list(self.driver_conns):
+            try:
+                # Drop lines to a stalled driver rather than queueing them:
+                # logs are lossy-by-contract, controller memory is not.
+                if (dconn.writer.transport.get_write_buffer_size()
+                        > 1 << 20):
+                    continue
+                protocol.write_msg(dconn.writer, out)
+            except Exception:
+                pass
+        return None
 
     async def _h_put_location(self, conn, msg):
         loc: ObjectLocation = msg["loc"]
@@ -1402,6 +1457,34 @@ class Controller:
                 lines.append(
                     f'rtpu_node_arena_used_bytes{{node="{n.node_id[:12]}"}} '
                     f"{n.arena_stats.get('used', 0)}")
+        # App-defined metrics (util/metrics.py).
+        def esc(v) -> str:
+            # Prometheus label-value escaping: one bad value must not
+            # corrupt the whole scrape payload.
+            return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+                    .replace("\n", "\\n"))
+
+        for name, m in sorted(self.app_metrics.items()):
+            if m["help"]:
+                lines.append(f"# HELP {name} {m['help']}")
+            ptype = "histogram" if m["type"] == "histogram" else m["type"]
+            lines.append(f"# TYPE {name} {ptype}")
+            for tags, v in sorted(m["data"].items()):
+                lbl = ",".join(f'{k}="{esc(val)}"' for k, val in tags)
+                if m["type"] == "histogram":
+                    cum = 0
+                    for i, b in enumerate(m["boundaries"]):
+                        cum += v["buckets"][i]
+                        le = (lbl + "," if lbl else "") + f'le="{b}"'
+                        lines.append(f"{name}_bucket{{{le}}} {cum}")
+                    le_inf = (lbl + "," if lbl else "") + 'le="+Inf"'
+                    lines.append(f"{name}_bucket{{{le_inf}}} {v['count']}")
+                    suffix = f"{{{lbl}}}" if lbl else ""
+                    lines.append(f"{name}_sum{suffix} {v['sum']}")
+                    lines.append(f"{name}_count{suffix} {v['count']}")
+                else:
+                    suffix = f"{{{lbl}}}" if lbl else ""
+                    lines.append(f"{name}{suffix} {v}")
         return "\n".join(lines) + "\n"
 
     async def _serve_metrics_http(self, reader, writer) -> None:
@@ -2016,7 +2099,9 @@ class Controller:
                     self._wake_scheduler()
                     return
                 proc = subprocess.Popen(
-                    [python, "-m", "ray_tpu.core.worker_main"], env=env)
+                    [python, "-m", "ray_tpu.core.worker_main"], env=env,
+                    stdout=self._worker_log_file(spawn_token),
+                    stderr=subprocess.STDOUT)
                 self._spawned_procs[spawn_token] = proc
                 asyncio.get_running_loop().create_task(
                     self._watch_spawn(node.node_id, spawn_token, proc))
@@ -2026,13 +2111,18 @@ class Controller:
         proc = subprocess.Popen(
             [sys.executable, "-m", "ray_tpu.core.worker_main"],
             env=env,
-            stdout=None,
-            stderr=None,
+            stdout=self._worker_log_file(spawn_token),
+            stderr=subprocess.STDOUT,
         )
         self._spawned_procs[spawn_token] = proc
         # The worker registers itself carrying the token (exact adoption in
         # _h_register); this task only reaps processes that die pre-register.
         asyncio.get_running_loop().create_task(self._watch_spawn(node.node_id, spawn_token, proc))
+
+    def _worker_log_file(self, spawn_token: str):
+        from .worker_logs import worker_log_file
+
+        return worker_log_file(spawn_token)
 
     async def _watch_spawn(self, node_id: str, spawn_token: str, proc: subprocess.Popen) -> None:
         for _ in range(600):

@@ -194,9 +194,14 @@ class HostAgent:
         if msg.get("sys_path"):
             env["RTPU_SYS_PATH"] = msg["sys_path"]
         env.setdefault("JAX_PLATFORMS", "cpu")
+        from .worker_logs import worker_log_file
+
+        log_f = worker_log_file(spawn_token)
         proc = subprocess.Popen(
             [python or sys.executable, "-m", "ray_tpu.core.worker_main"],
             env=env,
+            stdout=log_f,
+            stderr=subprocess.STDOUT if log_f else None,
         )
         self.procs[spawn_token] = proc
         return {"ok": True, "pid": proc.pid}

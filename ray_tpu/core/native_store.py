@@ -25,8 +25,15 @@ from typing import Dict, Optional, Tuple
 logger = logging.getLogger(__name__)
 
 _PKG_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-_LIB_PATH = os.path.join(_PKG_ROOT, "_native", "librtpu_store.so")
 _SRC_DIR = os.path.join(os.path.dirname(_PKG_ROOT), "src", "store")
+
+
+def _lib_path() -> str:
+    # RTPU_STORE_LIB selects an alternate build — the asan/tsan variants
+    # (src/store/Makefile) load through here so the sanitizer suite runs
+    # the exact same Python call paths against instrumented native code.
+    return flags.get("RTPU_STORE_LIB") or os.path.join(
+        _PKG_ROOT, "_native", "librtpu_store.so")
 
 _lib = None
 _lib_lock = threading.Lock()
@@ -38,7 +45,7 @@ def _build() -> bool:
     try:
         subprocess.run(["make", "-s"], cwd=_SRC_DIR, check=True,
                        capture_output=True, timeout=120)
-        return os.path.exists(_LIB_PATH)
+        return os.path.exists(_lib_path())
     except Exception as e:
         logger.warning("native store build failed: %r", e)
         return False
@@ -52,10 +59,10 @@ def load_library():
     with _lib_lock:
         if _lib is not None:
             return _lib
-        if not os.path.exists(_LIB_PATH) and not _build():
+        if not os.path.exists(_lib_path()) and not _build():
             return None
         try:
-            lib = ctypes.CDLL(_LIB_PATH)
+            lib = ctypes.CDLL(_lib_path())
         except OSError as e:
             logger.warning("native store load failed: %r", e)
             return None

@@ -162,6 +162,67 @@ class WorkerRuntime:
 
         self.client.io.call_nowait(_watch_conn())
 
+        # Tee stdout/stderr to the driver console via the controller
+        # (reference: _private/log_monitor.py tailing worker logs; here the
+        # worker pushes its own lines — no per-node tail daemon needed).
+        if flags.get("RTPU_LOG_TO_DRIVER"):
+            self._install_log_forwarder()
+
+    def _install_log_forwarder(self) -> None:
+        import sys
+
+        runtime = self
+
+        class _Tee:
+            # Forwarded lines cap at 8KB: \r-only writers (progress bars)
+            # must not grow the buffer without bound, and a never-ending
+            # line is forwarded in chunks rather than buffered forever.
+            _MAX_BUF = 8192
+
+            def __init__(self, inner, stream: str):
+                self._inner = inner
+                self._stream = stream
+                self._buf = ""
+                self._lock = threading.Lock()
+
+            def _emit(self, line: str) -> None:
+                if not line.strip():
+                    return
+                try:
+                    runtime.client.send_nowait({
+                        "kind": "worker_log", "line": line,
+                        "pid": os.getpid(),
+                        "worker_id": runtime.worker_id,
+                        "stream": self._stream,
+                    })
+                except Exception:
+                    pass
+
+            def write(self, text: str) -> int:
+                n = self._inner.write(text)
+                # The 32-thread task pool writes concurrently; _buf updates
+                # must be atomic or lines interleave/vanish.
+                with self._lock:
+                    self._buf += text
+                    self._buf = self._buf.replace("\r\n", "\n")
+                    lines = self._buf.replace("\r", "\n").split("\n")
+                    self._buf = lines.pop()
+                    if len(self._buf) > self._MAX_BUF:
+                        lines.append(self._buf)
+                        self._buf = ""
+                for line in lines:
+                    self._emit(line)
+                return n
+
+            def flush(self) -> None:
+                self._inner.flush()
+
+            def __getattr__(self, name):
+                return getattr(self._inner, name)
+
+        sys.stdout = _Tee(sys.stdout, "stdout")
+        sys.stderr = _Tee(sys.stderr, "stderr")
+
     # ------------------------------------------------------- direct dispatch
 
     def _start_direct_server(self) -> int:

@@ -1,0 +1,41 @@
+"""Per-worker log files (reference: session_latest/logs/worker-*.out).
+
+Spawners (controller, host agent) redirect worker stdout/stderr here; the
+worker's own tee (worker.py) forwards lines to drivers, so inheriting the
+console would print everything twice on single-host setups. The file is
+the durable copy, the driver console gets the prefixed stream.
+"""
+from __future__ import annotations
+
+import os
+import tempfile
+from typing import IO, Optional
+
+from ray_tpu import flags
+
+
+def log_dir() -> str:
+    return os.path.join(tempfile.gettempdir(), "rtpu_logs")
+
+
+def worker_log_file(spawn_token: str) -> Optional[IO[bytes]]:
+    """Open the spawn's log file for redirect; None -> inherit the console.
+
+    Restart-churned tokens reuse files; a file past RTPU_WORKER_LOG_MAX is
+    truncated on (re)open — the crude rotation that keeps a long-lived
+    autoscaling host from filling /tmp.
+    """
+    try:
+        d = log_dir()
+        os.makedirs(d, exist_ok=True)
+        path = os.path.join(d, f"worker-{spawn_token[:12]}.out")
+        cap = flags.get("RTPU_WORKER_LOG_MAX")
+        mode = "ab"
+        try:
+            if os.path.getsize(path) > cap:
+                mode = "wb"
+        except OSError:
+            pass
+        return open(path, mode)
+    except OSError:
+        return None

@@ -89,6 +89,8 @@ _define("RTPU_HEARTBEAT_S", float, 2.0,
 # -- object store / spilling -------------------------------------------------
 _define("RTPU_NATIVE_STORE", bool, True,
         "Use the C++ shm arena when available (0 forces pickle fallback).")
+_define("RTPU_STORE_LIB", str, None,
+        "Alternate librtpu_store build to load (sanitizer variants).")
 _define("RTPU_ARENA", str, None,
         "Name of the shm arena segment (internal, set by the creator).")
 _define("RTPU_ARENA_SIZE", int, 1 << 30,
@@ -123,6 +125,14 @@ _define("RTPU_JAX_PLATFORM", str, None,
         "Force the JAX platform ray_tpu initializes (cpu/tpu).")
 _define("RTPU_WORKFLOW_STORAGE", str, None,
         "Workflow durability root (default ~/.ray_tpu/workflows).")
+
+# -- observability -----------------------------------------------------------
+_define("RTPU_METRICS_FLUSH_S", float, 1.0,
+        "Flush period for app metrics (util/metrics.py) to the controller.")
+_define("RTPU_LOG_TO_DRIVER", bool, True,
+        "Tee worker stdout/stderr to connected drivers' consoles.")
+_define("RTPU_WORKER_LOG_MAX", int, 16 * 1024 * 1024,
+        "Truncate a worker's log file when it exceeds this on (re)open.")
 
 # -- bench -------------------------------------------------------------------
 _define("RTPU_BENCH_TPU_TIMEOUT", int, 1500,

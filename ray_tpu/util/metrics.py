@@ -1,0 +1,163 @@
+"""Application-defined metrics: Counter / Gauge / Histogram.
+
+Parity: reference python/ray/util/metrics.py — user code in any task/actor
+defines metrics and records values; they surface on the cluster's
+Prometheus endpoint. Here the controller IS the aggregation point (it
+already serves /metrics), so workers buffer updates locally and a daemon
+flusher ships deltas over the existing control connection fire-and-forget
+— no per-node metrics agent daemon, no OpenCensus dependency.
+
+Usage (same surface as the reference)::
+
+    from ray_tpu.util.metrics import Counter, Gauge, Histogram
+
+    requests = Counter("app_requests", description="...", tag_keys=("route",))
+    requests.inc(1.0, tags={"route": "/infer"})
+    inflight = Gauge("app_inflight")
+    inflight.set(3)
+    latency = Histogram("app_latency_s", boundaries=[0.01, 0.1, 1.0])
+    latency.observe(0.03)
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Optional, Sequence, Tuple
+
+from ray_tpu import flags
+
+_TagTuple = Tuple[Tuple[str, str], ...]
+
+
+def _tags_tuple(tags: Optional[Dict[str, str]]) -> _TagTuple:
+    return tuple(sorted((tags or {}).items()))
+
+
+class _Aggregator:
+    """Per-process buffer of metric updates, flushed to the controller."""
+
+    def __init__(self) -> None:
+        self.lock = threading.Lock()
+        # name -> {"type", "help", "boundaries", "data": {tags: value}}
+        # counters/histogram buckets accumulate deltas; gauges keep last.
+        self.pending: Dict[str, dict] = {}
+        self._thread: Optional[threading.Thread] = None
+
+    def record(self, name: str, mtype: str, help_: str, tags: _TagTuple,
+               value: float, boundaries: Sequence[float] = ()) -> None:
+        with self.lock:
+            m = self.pending.setdefault(
+                name, {"type": mtype, "help": help_,
+                       "boundaries": list(boundaries), "data": {}})
+            if mtype == "gauge":
+                m["data"][tags] = value
+            elif mtype == "counter":
+                m["data"][tags] = m["data"].get(tags, 0.0) + value
+            else:  # histogram: store raw observations, shipped as a list
+                m["data"].setdefault(tags, []).append(value)
+        self._ensure_flusher()
+
+    def _ensure_flusher(self) -> None:
+        if self._thread is not None and self._thread.is_alive():
+            return
+        self._thread = threading.Thread(
+            target=self._run, name="rtpu-metrics-flush", daemon=True)
+        self._thread.start()
+
+    def _run(self) -> None:
+        period = flags.get("RTPU_METRICS_FLUSH_S")
+        while True:
+            time.sleep(period)
+            self.flush()
+
+    def flush(self) -> None:
+        from ray_tpu.core import context as ctx
+
+        with self.lock:
+            if not self.pending:
+                return
+            batch, self.pending = self.pending, {}
+        wc = ctx.get_worker_context() if ctx.is_initialized() else None
+        if wc is None:
+            # No session: re-buffer (merging — a record that landed in the
+            # unlock window must not shadow the swapped-out batch) so
+            # metrics recorded before init() are not lost.
+            with self.lock:
+                for name, m in batch.items():
+                    cur = self.pending.get(name)
+                    if cur is None:
+                        self.pending[name] = m
+                        continue
+                    for tags, v in m["data"].items():
+                        if m["type"] == "counter":
+                            cur["data"][tags] = cur["data"].get(tags, 0.0) + v
+                        elif m["type"] == "histogram":
+                            cur["data"].setdefault(tags, []).extend(v)
+                        else:  # gauge: the newer pending value wins
+                            cur["data"].setdefault(tags, v)
+            return
+        wire = [
+            {"name": name, "type": m["type"], "help": m["help"],
+             "boundaries": m["boundaries"],
+             "data": [(list(k), v) for k, v in m["data"].items()]}
+            for name, m in batch.items()
+        ]
+        try:
+            wc.client.send_nowait({"kind": "metric_update", "metrics": wire})
+        except Exception:
+            pass
+
+
+_aggregator = _Aggregator()
+
+
+def flush_metrics() -> None:
+    """Force a flush (tests / shutdown hooks)."""
+    _aggregator.flush()
+
+
+class _Metric:
+    mtype = ""
+
+    def __init__(self, name: str, *, description: str = "",
+                 tag_keys: Sequence[str] = ()):
+        self.name = name
+        self.description = description
+        self.tag_keys = tuple(tag_keys)
+
+    def _record(self, value: float, tags: Optional[Dict[str, str]],
+                boundaries: Sequence[float] = ()) -> None:
+        _aggregator.record(self.name, self.mtype, self.description,
+                           _tags_tuple(tags), value, boundaries)
+
+
+class Counter(_Metric):
+    mtype = "counter"
+
+    def inc(self, value: float = 1.0,
+            tags: Optional[Dict[str, str]] = None) -> None:
+        if value < 0:
+            raise ValueError("counters only increase")
+        self._record(value, tags)
+
+
+class Gauge(_Metric):
+    mtype = "gauge"
+
+    def set(self, value: float,
+            tags: Optional[Dict[str, str]] = None) -> None:
+        self._record(float(value), tags)
+
+
+class Histogram(_Metric):
+    mtype = "histogram"
+
+    def __init__(self, name: str, *, description: str = "",
+                 boundaries: Sequence[float] = (0.01, 0.1, 1, 10),
+                 tag_keys: Sequence[str] = ()):
+        super().__init__(name, description=description, tag_keys=tag_keys)
+        self.boundaries = tuple(sorted(boundaries))
+
+    def observe(self, value: float,
+                tags: Optional[Dict[str, str]] = None) -> None:
+        self._record(float(value), tags, self.boundaries)
