@@ -15,6 +15,7 @@ from .read_api import (
     read_binary_files,
     read_csv,
     read_datasource,
+    read_images,
     read_json,
     read_numpy,
     read_parquet,
@@ -37,6 +38,7 @@ __all__ = [
     "read_binary_files",
     "read_csv",
     "read_datasource",
+    "read_images",
     "read_json",
     "read_numpy",
     "read_parquet",

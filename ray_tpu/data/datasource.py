@@ -170,6 +170,58 @@ class BinaryDatasource(FileDatasource):
         return pa.Table.from_pydict({"bytes": [data], "path": [path]})
 
 
+class ImageDatasource(FileDatasource):
+    """Decode images into {"image": ndarray} blocks (reference
+    python/ray/data/read_api.py:776 read_images). ``size=(h, w)`` resizes
+    at decode time — with a fixed size rows stack into one dense
+    [N, H, W, C] array (what the TPU batch-inference path wants); without
+    one, rows are ragged and ship as an object-dtype column (the
+    reference's variable-shaped tensor case). ``mode`` is a PIL
+    conversion mode; single-channel modes keep a trailing channel axis so
+    the [H, W, C] contract holds."""
+
+    _EXTS = (".png", ".jpg", ".jpeg", ".bmp", ".gif", ".webp",
+             ".tif", ".tiff")
+
+    def __init__(self, paths, size=None, mode: str = "RGB", **kwargs):
+        super().__init__(paths, **kwargs)
+        # Directories commonly hold labels.csv/README next to the images —
+        # only decode files with image extensions (reference read_images
+        # filters the same way).
+        explicit = [paths] if isinstance(paths, str) else list(paths)
+        keep = []
+        for p in self.paths:
+            if p.lower().endswith(self._EXTS) or p in explicit:
+                keep.append(p)
+        if not keep:
+            raise FileNotFoundError(f"no image files matched {paths}")
+        self.paths = keep
+        self.size = tuple(size) if size else None
+        self.mode = mode
+
+    def read_file(self, path: str) -> Block:
+        from PIL import Image
+
+        with Image.open(path) as im:
+            im = im.convert(self.mode)
+            if self.size is not None:
+                # PIL takes (width, height); size is (height, width) to
+                # match the ndarray [H, W, C] the caller sees.
+                im = im.resize((self.size[1], self.size[0]),
+                               Image.Resampling.BILINEAR)
+            arr = np.asarray(im)
+        if arr.ndim == 2:  # "L"/"1" modes: keep the channel axis
+            arr = arr[..., None]
+        if self.size is None:
+            # Ragged images cannot stack densely; an object column keeps
+            # concat/take working with per-row arrays.
+            col = np.empty(1, dtype=object)
+            col[0] = arr
+        else:
+            col = arr[None]
+        return {"image": col, "path": np.array([path])}
+
+
 # ------------------------------------------------------------------- writers
 
 

@@ -11,6 +11,7 @@ from .context import DataContext
 from .dataset import Dataset
 from .datasource import (
     BinaryDatasource,
+    ImageDatasource,
     CSVDatasource,
     Datasource,
     ItemsDatasource,
@@ -95,6 +96,14 @@ def read_numpy(paths, *, parallelism: int = -1, **kwargs) -> Dataset:
 
 def read_binary_files(paths, *, parallelism: int = -1) -> Dataset:
     return _mk(BinaryDatasource(paths), parallelism)
+
+
+def read_images(paths, *, size=None, mode: str = "RGB",
+                parallelism: int = -1) -> Dataset:
+    """Decode image files into {"image": [H,W,C] uint8, "path"} rows
+    (reference python/ray/data/read_api.py:776). ``size=(h, w)`` resizes
+    at decode time so the inference batches are uniform."""
+    return _mk(ImageDatasource(paths, size=size, mode=mode), parallelism)
 
 
 def read_datasource(datasource: Datasource, *, parallelism: int = -1) -> Dataset:

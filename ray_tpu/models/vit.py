@@ -1,0 +1,177 @@
+"""Vision Transformer encoder, TPU-first (BASELINE.json config 5: ViT-L
+batch inference on a TPU actor pool).
+
+The reference framework hosts torch ViTs; here the model is a first-class
+jax implementation sharing the decoder's building blocks (ops.attention
+with causal=False, the same logical-axis sharding names):
+
+- Patch embedding is a reshape + ONE matmul ([B, N, p*p*C] @ [p*p*C, d]) —
+  the im2col form XLA maps straight onto the MXU, instead of a strided
+  conv the TPU backend would have to rewrite into the same thing.
+- Encoder blocks are pre-LN MHA + GELU MLP over bf16 activations with
+  f32 params, stacked with lax.scan (one compiled body, O(1) compile
+  depth) exactly like models/transformer.py.
+- CLS-token classification head in f32.
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.ops.attention import attention
+from ray_tpu.parallel.sharding import maybe_constrain
+
+Params = Dict[str, Any]
+
+
+@dataclasses.dataclass(frozen=True)
+class ViTConfig:
+    image_size: int = 224
+    patch_size: int = 16
+    num_classes: int = 1000
+    d_model: int = 1024       # ViT-L
+    n_layers: int = 24
+    n_heads: int = 16
+    d_ff: int = 4096
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+
+    @property
+    def num_patches(self) -> int:
+        return (self.image_size // self.patch_size) ** 2
+
+    @property
+    def patch_dim(self) -> int:
+        return self.patch_size * self.patch_size * 3
+
+    def num_params(self) -> int:
+        d, L, F = self.d_model, self.n_layers, self.d_ff
+        per_layer = 4 * d * d + 2 * d * F + 4 * d
+        return (self.patch_dim * d + d + (self.num_patches + 1) * d + d
+                + L * per_layer + 2 * d + d * self.num_classes
+                + self.num_classes)
+
+
+def vit_l16(**overrides) -> ViTConfig:
+    return ViTConfig(**overrides)
+
+
+def vit_tiny(**overrides) -> ViTConfig:
+    kw = dict(image_size=32, patch_size=8, num_classes=10, d_model=64,
+              n_layers=2, n_heads=4, d_ff=128)
+    kw.update(overrides)
+    return ViTConfig(**kw)
+
+
+def init_params(key: jax.Array, cfg: ViTConfig) -> Params:
+    d, L, F = cfg.d_model, cfg.n_layers, cfg.d_ff
+    ks = jax.random.split(key, 8)
+    pd = cfg.param_dtype
+
+    def dense(k, shape, scale=None):
+        std = scale if scale is not None else 1.0 / math.sqrt(shape[0])
+        return (jax.random.normal(k, shape) * std).astype(pd)
+
+    def stack(k, shape, scale=None):
+        kk = jax.random.split(k, L)
+        return jnp.stack([dense(kk[i], shape, scale) for i in range(L)])
+
+    return {
+        "patch_embed": dense(ks[0], (cfg.patch_dim, d)),
+        "patch_bias": jnp.zeros((d,), pd),
+        "pos_embed": (jax.random.normal(ks[1], (cfg.num_patches + 1, d))
+                      * 0.02).astype(pd),
+        "cls_token": jnp.zeros((d,), pd),
+        "layers": {
+            "ln1": jnp.ones((L, d), pd),
+            "ln1_b": jnp.zeros((L, d), pd),
+            "wqkv": stack(ks[2], (d, 3, cfg.n_heads, d // cfg.n_heads)),
+            "wo": stack(ks[3], (d, d), scale=1.0 / math.sqrt(2 * L * d)),
+            "ln2": jnp.ones((L, d), pd),
+            "ln2_b": jnp.zeros((L, d), pd),
+            "w_up": stack(ks[4], (d, F)),
+            "w_down": stack(ks[5], (F, d), scale=1.0 / math.sqrt(2 * L * F)),
+        },
+        "final_ln": jnp.ones((d,), pd),
+        "final_ln_b": jnp.zeros((d,), pd),
+        "head": dense(ks[6], (d, cfg.num_classes), scale=0.02),
+        "head_b": jnp.zeros((cfg.num_classes,), pd),
+    }
+
+
+def param_logical_specs(cfg: ViTConfig) -> Params:
+    return {
+        "patch_embed": (None, "embed"),
+        "patch_bias": (None,),
+        "pos_embed": (None, "embed"),
+        "cls_token": (None,),
+        "layers": {
+            "ln1": ("layers", None),
+            "ln1_b": ("layers", None),
+            "wqkv": ("layers", "embed", None, "heads", None),
+            "wo": ("layers", "heads", "embed"),
+            "ln2": ("layers", None),
+            "ln2_b": ("layers", None),
+            "w_up": ("layers", "embed", "mlp"),
+            "w_down": ("layers", "mlp", "embed"),
+        },
+        "final_ln": (None,),
+        "final_ln_b": (None,),
+        "head": ("embed", "vocab"),
+        "head_b": (None,),
+    }
+
+
+def _ln(x, w, b):
+    xf = x.astype(jnp.float32)
+    mu = jnp.mean(xf, axis=-1, keepdims=True)
+    var = jnp.var(xf, axis=-1, keepdims=True)
+    out = (xf - mu) * jax.lax.rsqrt(var + 1e-6)
+    return (out * w.astype(jnp.float32) + b.astype(jnp.float32)).astype(x.dtype)
+
+
+def patchify(images: jax.Array, cfg: ViTConfig) -> jax.Array:
+    """[B, H, W, C] -> [B, N, p*p*C] (im2col via reshape/transpose only)."""
+    B, H, W, C = images.shape
+    p = cfg.patch_size
+    x = images.reshape(B, H // p, p, W // p, p, C)
+    x = x.transpose(0, 1, 3, 2, 4, 5)  # [B, Hp, Wp, p, p, C]
+    return x.reshape(B, (H // p) * (W // p), p * p * C)
+
+
+def forward(params: Params, images: jax.Array, cfg: ViTConfig) -> jax.Array:
+    """images [B, H, W, C] float -> logits [B, num_classes] f32."""
+    B = images.shape[0]
+    H = cfg.n_heads
+    hd = cfg.d_model // H
+    x = patchify(images.astype(cfg.dtype), cfg)
+    x = x @ params["patch_embed"].astype(cfg.dtype)
+    x = x + params["patch_bias"].astype(cfg.dtype)
+    cls = jnp.broadcast_to(params["cls_token"].astype(cfg.dtype),
+                           (B, 1, cfg.d_model))
+    x = jnp.concatenate([cls, x], axis=1)
+    x = x + params["pos_embed"].astype(cfg.dtype)[None]
+    x = maybe_constrain(x, ("batch", None, "embed"))
+
+    def block(h, layer):
+        S = h.shape[1]
+        y = _ln(h, layer["ln1"], layer["ln1_b"])
+        qkv = jnp.einsum("bsd,dcnh->bscnh", y, layer["wqkv"].astype(cfg.dtype))
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        o = attention(q, k, v, causal=False)
+        h = h + o.reshape(B, S, H * hd) @ layer["wo"].astype(cfg.dtype)
+        y = _ln(h, layer["ln2"], layer["ln2_b"])
+        y = jax.nn.gelu(y @ layer["w_up"].astype(cfg.dtype))
+        h = h + y @ layer["w_down"].astype(cfg.dtype)
+        return h, None
+
+    x, _ = jax.lax.scan(block, x, params["layers"])
+    cls_out = _ln(x[:, 0], params["final_ln"], params["final_ln_b"])
+    logits = (cls_out.astype(jnp.float32)
+              @ params["head"].astype(jnp.float32)
+              + params["head_b"].astype(jnp.float32))
+    return logits
