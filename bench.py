@@ -44,8 +44,10 @@ def _run_benchmark() -> None:
     if on_tpu:
         # "dots" remat is the fastest policy that reliably compiles through
         # the axon AOT helper at these shapes; batch 8 is the measured
-        # optimum (larger batches gain no per-token throughput and "min"/
-        # no-remat crash the helper — benchmarks/mfu_sweep.py history).
+        # optimum (larger batches and "min"/no-remat crash the helper —
+        # benchmarks/mfu_sweep.py history). shift_inputs runs the model at
+        # the aligned power-of-two length S instead of S+1: round-4's
+        # measured 374 -> 286 ms/step (MFU 26.1% -> 34.1%).
         cfg = bench_350m(remat=True, remat_policy="dots")
         batch, seq = 8, 1024
         steps, warmup = 20, 3
@@ -57,7 +59,7 @@ def _run_benchmark() -> None:
         steps, warmup = 3, 1
 
     mesh = make_mesh(MeshSpec(), devices=[dev])
-    ts = transformer_train_step(cfg, mesh, rules=RULES_DP)
+    ts = transformer_train_step(cfg, mesh, rules=RULES_DP, shift_inputs=True)
     params, opt_state = ts.init(jax.random.key(0))
     tokens = np.random.default_rng(0).integers(
         0, cfg.vocab_size, (batch, seq + 1), dtype=np.int32
