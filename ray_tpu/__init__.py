@@ -41,6 +41,7 @@ from .core.controller import (
     ActorDiedError,
     DependencyError,
     ObjectLostError,
+    OutOfMemoryError,
     GetTimeoutError,
     RayTpuError,
     TaskError,
@@ -71,6 +72,7 @@ __all__ = [
     "ObjectRef",
     "ObjectRefGenerator",
     "ObjectLostError",
+    "OutOfMemoryError",
     "ActorHandle",
     "ActorClass",
     "RemoteFunction",

@@ -81,6 +81,9 @@ class NodeInfo:
     host_id: Optional[str] = None
     last_heartbeat: float = 0.0
     arena_stats: Dict[str, int] = field(default_factory=dict)
+    # Host memory usage fraction (agent heartbeats / controller psutil for
+    # local nodes); drives the memory monitor's kill decisions.
+    mem_fraction: float = 0.0
 
 
 @dataclass
@@ -102,6 +105,10 @@ class WorkerInfo:
     # Port of the worker's direct-dispatch server (0 = none); peers push
     # actor tasks there without a controller hop.
     direct_port: int = 0
+    # When the current task was dispatched (memory-monitor victim order)
+    # and whether the monitor chose this worker (OOM error attribution).
+    task_started: float = 0.0
+    oom_killed: bool = False
 
 
 @dataclass
@@ -295,6 +302,8 @@ class Controller:
         loop = asyncio.get_running_loop()
         self._sched_task = loop.create_task(self._scheduler_loop())
         self._health_task = loop.create_task(self._health_check_loop())
+        if flags.get("RTPU_MEMORY_MONITOR"):
+            self._memory_task = loop.create_task(self._memory_monitor_loop())
         # Prometheus scrape endpoint (GET /metrics) on an ephemeral port,
         # advertised via cluster_state.metrics_port.
         try:
@@ -363,6 +372,8 @@ class Controller:
             self._sched_task.cancel()
         if self._health_task is not None:
             self._health_task.cancel()
+        if getattr(self, "_memory_task", None) is not None:
+            self._memory_task.cancel()
         if getattr(self, "_metrics_server", None) is not None:
             self._metrics_server.close()
         if self.server is not None:
@@ -501,9 +512,15 @@ class Controller:
         if w.current_task and w.current_task in self.tasks:
             spec = self.tasks.pop(w.current_task)
             self._release_task_resources(spec)
-            err = WorkerCrashedError(
-                f"worker {w.worker_id[:8]} died while running task {spec.get('label', '')}"
-            )
+            if w.oom_killed:
+                err: Exception = OutOfMemoryError(
+                    f"worker {w.worker_id[:8]} was killed by the memory "
+                    f"monitor while running task {spec.get('label', '')} "
+                    f"(host memory pressure)")
+            else:
+                err = WorkerCrashedError(
+                    f"worker {w.worker_id[:8]} died while running task "
+                    f"{spec.get('label', '')}")
             if not self._maybe_retry_task(spec):
                 self._finalize_generator(spec["task_id"], err)
                 for oid in spec["return_ids"]:
@@ -995,6 +1012,10 @@ class Controller:
             for loc in msg["error_locations"]:
                 self._store_location(loc)
         w = self.workers.get(msg["worker_id"])
+        if w is not None:
+            # It delivered a result: the memory-monitor kill (if any) did
+            # not take — a later unrelated death must not be blamed on OOM.
+            w.oom_killed = False
         if w is not None and w.current_task == task_id:
             w.current_task = None
             if w.state == "task":
@@ -1623,6 +1644,8 @@ class Controller:
         if node is not None:
             node.last_heartbeat = time.monotonic()
             node.arena_stats = msg.get("arena") or {}
+            if msg.get("mem_fraction") is not None:
+                node.mem_fraction = float(msg["mem_fraction"])
         return None
 
     async def _h_spawn_exited(self, conn, msg):
@@ -1761,6 +1784,94 @@ class Controller:
             os.replace(tmp, self.persist_path)
         except Exception as e:
             sys.stderr.write(f"[controller] state snapshot failed: {e!r}\n")
+
+    async def _memory_monitor_loop(self) -> None:
+        """Kill a worker when a host crosses the memory threshold
+        (reference: src/ray/common/memory_monitor.h:52 + the retriable-FIFO
+        worker killing policy, raylet/worker_killing_policy_retriable_fifo.h:
+        prefer the NEWEST retriable task — it has made the least progress
+        and will be retried — then the newest task of any kind; actors are
+        killed last since their state is not reconstructible). ONE victim
+        per tick, then resample: freed memory must be observed before the
+        next kill, or a single spike over-kills the whole pool."""
+        while True:
+            # Read per-iteration: operators tune these live (and tests
+            # lift the pressure mid-run to let a retried victim finish).
+            period = flags.get("RTPU_MEMORY_MONITOR_S")
+            threshold = flags.get("RTPU_MEMORY_USAGE_THRESHOLD")
+            await asyncio.sleep(period)
+            try:
+                local_frac = self._local_mem_fraction()
+                for node in self.nodes.values():
+                    if not node.alive:
+                        continue
+                    if node.agent_conn is not None:
+                        # Agent node: trust its heartbeat only — falling
+                        # back to the controller host's own usage would
+                        # misattribute local pressure to healthy remote
+                        # hosts (agents without psutil report nothing).
+                        frac = node.mem_fraction
+                    else:
+                        frac = local_frac
+                    if frac < threshold:
+                        continue
+                    victim = self._pick_oom_victim(node)
+                    if victim is None:
+                        continue
+                    victim.oom_killed = True
+                    sys.stderr.write(
+                        f"[controller] memory monitor: host at "
+                        f"{frac:.0%} >= {threshold:.0%}, killing worker "
+                        f"{victim.worker_id[:8]} "
+                        f"(task {victim.current_task or 'idle'})\n")
+                    await self._shutdown_worker(victim)
+                    if victim.spawn_token is not None:
+                        # Agent-spawned: no local proc handle — escalate to
+                        # the owning agent's SIGTERM (a busy worker ignores
+                        # the graceful shutdown message).
+                        if node.agent_conn is not None:
+                            try:
+                                await node.agent_conn.send(
+                                    {"kind": "kill_worker",
+                                     "spawn_token": victim.spawn_token})
+                            except Exception:
+                                pass
+                    break  # one victim per tick, then resample
+            except Exception as e:  # pragma: no cover — keep monitoring
+                sys.stderr.write(f"[controller] memory monitor error: {e!r}\n")
+
+    @staticmethod
+    def _local_mem_fraction() -> float:
+        try:
+            import psutil
+
+            return psutil.virtual_memory().percent / 100.0
+        except Exception:
+            return 0.0
+
+    def _pick_oom_victim(self, node: NodeInfo) -> Optional[WorkerInfo]:
+        running = [
+            w for wid in node.workers
+            if (w := self.workers.get(wid)) is not None and w.current_task
+        ]
+
+        def retriable(w: WorkerInfo) -> bool:
+            spec = self.tasks.get(w.current_task or "")
+            if spec is None:
+                return False
+            return (int(spec.get("max_retries", 0))
+                    - int(spec.get("_retry_count", 0))) > 0
+
+        pool = [w for w in running if retriable(w)] or running
+        if pool:
+            return max(pool, key=lambda w: w.task_started)
+        # Last resort: an actor worker (state lost; reference kills tasks
+        # first for exactly this reason).
+        actors = [
+            w for wid in node.workers
+            if (w := self.workers.get(wid)) is not None and w.actor_ids
+        ]
+        return max(actors, key=lambda w: w.task_started, default=None)
 
     async def _health_check_loop(self) -> None:
         """Mark agent nodes dead when heartbeats stop (reference:
@@ -2204,6 +2315,7 @@ class Controller:
         else:
             w.state = "task"
             w.current_task = spec["task_id"]
+            w.task_started = time.monotonic()
             await w.conn.send({"kind": "execute_task", "spec": spec})
 
     def _release_task_resources(self, spec: Dict[str, Any]) -> None:
@@ -2246,6 +2358,12 @@ class WorkerCrashedError(RayTpuError):
 
 class ActorDiedError(RayTpuError):
     pass
+
+
+class OutOfMemoryError(RayTpuError):
+    """A worker was killed by the memory monitor to relieve host memory
+    pressure (reference: ray.exceptions.OutOfMemoryError +
+    src/ray/common/memory_monitor.h)."""
 
 
 class ObjectLostError(RayTpuError):

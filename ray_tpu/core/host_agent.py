@@ -227,6 +227,12 @@ class HostAgent:
         while not self._stop.is_set():
             stats = self.arena.stats() if self.arena else {}
             try:
+                import psutil
+
+                mem_fraction = psutil.virtual_memory().percent / 100.0
+            except Exception:
+                mem_fraction = None
+            try:
                 await self.ctrl.send(
                     {
                         "kind": "heartbeat",
@@ -234,6 +240,7 @@ class HostAgent:
                         "t": time.time(),
                         "arena": stats,
                         "num_workers": len(self.procs),
+                        "mem_fraction": mem_fraction,
                     }
                 )
             except Exception:

@@ -64,6 +64,19 @@ def normalize(runtime_env: Optional[Dict[str, Any]], client) -> Optional[Dict[st
         client.request({"kind": "kv_put", "ns": _KV_NS, "key": uri,
                         "value": blob, "overwrite": False})
         out["working_dir_uri"] = uri
+    py_modules = runtime_env.get("py_modules")
+    if py_modules:
+        # Each module (a local package dir or single .py file) ships as its
+        # own content-addressed zip; workers extract each onto sys.path
+        # WITHOUT chdir — the difference from working_dir (reference
+        # _private/runtime_env/py_modules.py).
+        uris = []
+        for mod in py_modules:
+            uri, blob = _package_py_module(str(mod))
+            client.request({"kind": "kv_put", "ns": _KV_NS, "key": uri,
+                            "value": blob, "overwrite": False})
+            uris.append(uri)
+        out["py_module_uris"] = uris
     pip = runtime_env.get("pip")
     if pip:
         out["pip"] = sorted(str(p) for p in pip)
@@ -78,7 +91,8 @@ def normalize(runtime_env: Optional[Dict[str, Any]], client) -> Optional[Dict[st
 
 def env_hash(norm: Dict[str, Any]) -> str:
     payload = json.dumps(
-        {k: norm[k] for k in ("working_dir_uri", "pip", "env_vars")
+        {k: norm[k] for k in
+         ("working_dir_uri", "py_module_uris", "pip", "env_vars")
          if k in norm},
         sort_keys=True,
     )
@@ -107,13 +121,12 @@ def working_dir_fingerprint(path: str) -> str:
     return h.hexdigest()[:16]
 
 
-def _package_working_dir(path: str):
-    """Zip `path` deterministically; return (content URI, zip bytes)."""
-    path = os.path.abspath(path)
-    if not os.path.isdir(path):
-        raise ValueError(f"working_dir {path!r} is not a directory")
-    max_bytes = flags.get("RTPU_WORKING_DIR_MAX_BYTES")
-    entries = []
+def _zip_tree(z: "zipfile.ZipFile", path: str, prefix: str,
+              max_bytes: int, what: str) -> None:
+    """Deterministic walk of `path` into the open zip under `prefix`,
+    enforcing the shared size cap (one implementation for working_dir and
+    py_modules — the cap exists to keep multi-GB checkpoints out of the
+    controller KV)."""
     total = 0
     for root, dirs, files in os.walk(path):
         dirs[:] = sorted(d for d in dirs if d not in _EXCLUDE_DIRS)
@@ -121,28 +134,60 @@ def _package_working_dir(path: str):
             if f.endswith(".pyc"):
                 continue
             full = os.path.join(root, f)
-            entries.append((os.path.relpath(full, path), full))
             try:
                 total += os.path.getsize(full)
             except OSError:
                 pass
             if total > max_bytes:
                 raise ValueError(
-                    f"working_dir {path!r} exceeds "
+                    f"{what} {path!r} exceeds "
                     f"{max_bytes // (1024 * 1024)}MiB "
                     f"(reference default cap); exclude data/checkpoint "
-                    f"files or raise RTPU_WORKING_DIR_MAX_BYTES"
-                )
-    buf = io.BytesIO()
-    with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as z:
-        for rel, full in entries:
+                    f"files or raise RTPU_WORKING_DIR_MAX_BYTES")
+            rel = os.path.join(prefix, os.path.relpath(full, path)) \
+                if prefix else os.path.relpath(full, path)
             # Fixed date_time => identical content hashes to identical zips.
             info = zipfile.ZipInfo(rel, date_time=(2020, 1, 1, 0, 0, 0))
             with open(full, "rb") as fh:
                 z.writestr(info, fh.read())
+
+
+def _package_working_dir(path: str):
+    """Zip `path` deterministically; return (content URI, zip bytes)."""
+    path = os.path.abspath(path)
+    if not os.path.isdir(path):
+        raise ValueError(f"working_dir {path!r} is not a directory")
+    buf = io.BytesIO()
+    with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as z:
+        _zip_tree(z, path, "", flags.get("RTPU_WORKING_DIR_MAX_BYTES"),
+                  "working_dir")
     blob = buf.getvalue()
     digest = hashlib.sha256(blob).hexdigest()[:24]
     return f"working_dir://{digest}", blob
+
+
+def _package_py_module(path: str):
+    """Zip one python module (package dir or single .py) so extraction
+    yields an importable top-level name; returns (content URI, zip bytes).
+    Reference: _private/runtime_env/py_modules.py."""
+    path = os.path.abspath(path)
+    buf = io.BytesIO()
+    with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as z:
+        if os.path.isdir(path):
+            _zip_tree(z, path, os.path.basename(path.rstrip(os.sep)),
+                      flags.get("RTPU_WORKING_DIR_MAX_BYTES"), "py_module")
+        elif path.endswith(".py"):
+            info = zipfile.ZipInfo(os.path.basename(path),
+                                   date_time=(2020, 1, 1, 0, 0, 0))
+            with open(path, "rb") as fh:
+                z.writestr(info, fh.read())
+        else:
+            raise ValueError(
+                f"py_modules entry {path!r} is neither a package directory "
+                f"nor a .py file")
+    blob = buf.getvalue()
+    digest = hashlib.sha256(blob).hexdigest()[:24]
+    return f"py_module://{digest}", blob
 
 
 # ------------------------------------------------------------- worker side
@@ -154,29 +199,43 @@ def apply_in_worker(norm: Dict[str, Any], client) -> None:
     interpreter IS the venv's when pip was requested."""
     for k, v in (norm.get("env_vars") or {}).items():
         flags.set_raw(k, v)
+    for mod_uri in (norm.get("py_module_uris") or ()):
+        target = _fetch_and_extract(mod_uri, client)
+        # py_modules join sys.path WITHOUT chdir (the working_dir
+        # difference): user code imports them from wherever it runs.
+        if target not in sys.path:
+            sys.path.insert(0, target)
     uri = norm.get("working_dir_uri")
     if uri:
-        target = os.path.join(_cache_root(), uri.split("://", 1)[1])
-        marker = os.path.join(target, ".rtpu_ready")
-        if not os.path.exists(marker):
-            blob = client.request({"kind": "kv_get", "ns": _KV_NS, "key": uri})
-            if blob is None:
-                raise RuntimeError(f"runtime env package {uri} missing from KV")
-            tmp = target + f".tmp{os.getpid()}"
-            os.makedirs(tmp, exist_ok=True)
-            with zipfile.ZipFile(io.BytesIO(blob)) as z:
-                z.extractall(tmp)
-            open(os.path.join(tmp, ".rtpu_ready"), "w").close()
-            try:
-                os.rename(tmp, target)
-            except OSError:
-                # Another worker won the race; its extraction is complete.
-                import shutil
-
-                shutil.rmtree(tmp, ignore_errors=True)
+        target = _fetch_and_extract(uri, client)
         os.chdir(target)
         if target not in sys.path:
             sys.path.insert(0, target)
+
+
+def _fetch_and_extract(uri: str, client) -> str:
+    """Download a content-addressed package from the controller KV and
+    extract it into the local cache exactly once (ready-marker + rename
+    race discipline); returns the extraction dir."""
+    target = os.path.join(_cache_root(), uri.split("://", 1)[1])
+    marker = os.path.join(target, ".rtpu_ready")
+    if not os.path.exists(marker):
+        blob = client.request({"kind": "kv_get", "ns": _KV_NS, "key": uri})
+        if blob is None:
+            raise RuntimeError(f"runtime env package {uri} missing from KV")
+        tmp = target + f".tmp{os.getpid()}"
+        os.makedirs(tmp, exist_ok=True)
+        with zipfile.ZipFile(io.BytesIO(blob)) as z:
+            z.extractall(tmp)
+        open(os.path.join(tmp, ".rtpu_ready"), "w").close()
+        try:
+            os.rename(tmp, target)
+        except OSError:
+            # Another worker won the race; its extraction is complete.
+            import shutil
+
+            shutil.rmtree(tmp, ignore_errors=True)
+    return target
 
 
 # ------------------------------------------------------------ spawner side

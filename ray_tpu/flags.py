@@ -85,6 +85,13 @@ _define("RTPU_NODE_TIMEOUT_S", float, 10.0,
         "Heartbeat silence after which a node is declared dead.")
 _define("RTPU_HEARTBEAT_S", float, 2.0,
         "Host-agent heartbeat period.")
+_define("RTPU_MEMORY_MONITOR", bool, True,
+        "Kill a worker when a host crosses the memory threshold "
+        "(reference memory_monitor + retriable-FIFO kill policy).")
+_define("RTPU_MEMORY_USAGE_THRESHOLD", float, 0.95,
+        "Host memory fraction that triggers the memory monitor.")
+_define("RTPU_MEMORY_MONITOR_S", float, 2.0,
+        "Memory monitor sampling period.")
 
 # -- object store / spilling -------------------------------------------------
 _define("RTPU_NATIVE_STORE", bool, True,
@@ -125,6 +132,10 @@ _define("RTPU_JAX_PLATFORM", str, None,
         "Force the JAX platform ray_tpu initializes (cpu/tpu).")
 _define("RTPU_WORKFLOW_STORAGE", str, None,
         "Workflow durability root (default ~/.ray_tpu/workflows).")
+
+_define("RTPU_SP_MODE", str, "ring",
+        "Context-parallel attention scheme over the seq mesh axis: "
+        "ring | ulysses | auto (ulysses when head counts divide the axis).")
 
 # -- observability -----------------------------------------------------------
 _define("RTPU_METRICS_FLUSH_S", float, 1.0,

@@ -53,13 +53,19 @@ def reference_attention(
 
 
 def _seq_parallel_attention(q, k, v, mesh, rules, causal, scale):
-    """Embed ring attention in the jitted program via shard_map when the
-    mesh has a nontrivial `seq` axis: pjit keeps global array semantics
-    outside; inside, each device runs the ring over its sequence shard."""
+    """Embed context parallelism in the jitted program via shard_map when
+    the mesh has a nontrivial `seq` axis: pjit keeps global array semantics
+    outside; inside, each device works on its sequence shard. Two schemes
+    (SURVEY §5.7): ring (K/V rotation — any head count) and ulysses
+    (all-to-all head scattering — fewer collectives when the head counts
+    divide the axis). RTPU_SP_MODE selects: ring | ulysses | auto
+    (ulysses when divisible, else ring)."""
     from jax import shard_map
 
+    from ray_tpu import flags
     from ray_tpu.parallel.sharding import logical_to_mesh_spec
     from .ring_attention import ring_attention
+    from .ulysses_attention import ulysses_attention
 
     q_spec = logical_to_mesh_spec(("batch", "seq_act", "heads", None), rules, mesh)
     kv_spec = logical_to_mesh_spec(("batch", "seq_act", "kv_heads", None), rules, mesh)
@@ -69,9 +75,34 @@ def _seq_parallel_attention(q, k, v, mesh, rules, causal, scale):
         # replicated full-sequence "chunks" would silently double-count
         # keys. Fall back to dense attention.
         return None
+    mode = flags.get("RTPU_SP_MODE")
+    sp = mesh.shape["seq"]
+
+    def _extent(entry) -> int:
+        if entry is None:
+            return 1
+        axes = (entry,) if isinstance(entry, str) else tuple(entry)
+        n = 1
+        for a in axes:
+            n *= mesh.shape[a]
+        return n
+
+    # Divisibility is a PER-DEVICE property: the head dim may additionally
+    # be tensor-sharded by the in_specs, so the local head count inside
+    # shard_map is global // extent(head axes).
+    h_local = q.shape[2] // _extent(q_spec[2])
+    kvh_local = k.shape[2] // _extent(kv_spec[2])
+    divisible = h_local % sp == 0 and kvh_local % sp == 0
+    if mode in ("ulysses", "auto") and divisible:
+        body = lambda q, k, v: ulysses_attention(
+            q, k, v, "seq", causal=causal, scale=scale)
+    else:
+        # Ring handles any head count; an explicit ulysses ask that cannot
+        # divide falls back here rather than failing the whole step.
+        body = lambda q, k, v: ring_attention(
+            q, k, v, "seq", causal=causal, scale=scale)
     fn = shard_map(
-        lambda q, k, v: ring_attention(q, k, v, "seq", causal=causal,
-                                       scale=scale),
+        body,
         mesh=mesh,
         in_specs=(q_spec, kv_spec, kv_spec),
         out_specs=q_spec,

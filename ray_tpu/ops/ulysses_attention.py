@@ -1,0 +1,57 @@
+"""Ulysses-style sequence parallelism: all-to-all head scattering.
+
+The second context-parallel scheme SURVEY.md §5.7 calls for next to ring
+attention (the reference has neither). Where the ring rotates K/V chunks
+around the `seq` axis (P neighbor hops, exact attention composed from
+per-chunk statistics), Ulysses re-partitions ONCE per attention call:
+
+    [B, S/P, H, D]  --all-to-all-->  [B, S, H/P, D]
+
+— every device trades its sequence shard for a head shard, runs ordinary
+single-device (flash) attention over the FULL sequence for its heads, and
+the output all-to-alls back to sequence sharding. Two collectives per call
+instead of P ppermute steps, at the cost of requiring H (and KV heads) to
+divide the axis size. Both collectives are `lax.all_to_all`, which XLA
+lowers onto ICI directly; autodiff transposes them for free (all_to_all is
+its own transpose up to axis swap), so no custom_vjp is needed — the flash
+kernel's VJP handles the attention itself.
+
+Must be called inside `shard_map` over the `axis_name` mesh axis; inputs
+are per-device shards in model layout [B, S_local, H|KVH, D].
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def ulysses_attention(
+    q: jax.Array,  # [B, S_local, H, D]
+    k: jax.Array,  # [B, S_local, KVH, D]
+    v: jax.Array,  # [B, S_local, KVH, D]
+    axis_name: str,
+    *,
+    causal: bool = True,
+    scale: Optional[float] = None,
+) -> jax.Array:
+    """Exact attention over the full (sequence-sharded) sequence; returns
+    the caller's [B, S_local, H, D] shard."""
+    from .flash_attention import flash_attention
+
+    P = lax.axis_size(axis_name)
+    H, KVH = q.shape[2], k.shape[2]
+    if H % P or KVH % P:
+        raise ValueError(
+            f"ulysses attention needs head counts divisible by the seq "
+            f"axis: H={H}, KVH={KVH}, axis={P} (use ring attention)")
+    # Scatter heads, gather sequence: [B, S/P, H, D] -> [B, S, H/P, D].
+    qg = lax.all_to_all(q, axis_name, split_axis=2, concat_axis=1, tiled=True)
+    kg = lax.all_to_all(k, axis_name, split_axis=2, concat_axis=1, tiled=True)
+    vg = lax.all_to_all(v, axis_name, split_axis=2, concat_axis=1, tiled=True)
+    o = flash_attention(qg, kg, vg, causal=causal, scale=scale)
+    # Scatter sequence, gather heads: back to [B, S/P, H, D].
+    return lax.all_to_all(o, axis_name, split_axis=1, concat_axis=2,
+                          tiled=True)

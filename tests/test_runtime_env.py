@@ -112,3 +112,31 @@ def test_pip_local_package(renv_cluster, tmp_path):
     version2, _ = ray_tpu.get(read_version.remote(), timeout=60)
     assert version2 == "9.9.9"
     assert time.perf_counter() - t0 < 5.0
+
+
+def test_py_modules_importable_without_chdir(ray_start_regular, tmp_path):
+    """py_modules ship a package onto workers' sys.path WITHOUT changing
+    cwd (reference _private/runtime_env/py_modules.py)."""
+    import os
+
+    import ray_tpu
+
+    pkg = tmp_path / "mymod"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text("MAGIC = 'from-py-module'\n")
+    (pkg / "helper.py").write_text("def f():\n    return 41 + 1\n")
+
+    @ray_tpu.remote
+    def use():
+        import os
+
+        import mymod
+        from mymod.helper import f
+
+        return mymod.MAGIC, f(), os.getcwd()
+
+    magic, val, cwd = ray_tpu.get(
+        use.options(runtime_env={"py_modules": [str(pkg)]}).remote())
+    assert magic == "from-py-module" and val == 42
+    # cwd untouched — the working_dir behavior is NOT applied.
+    assert "runtime_env" not in cwd or not cwd.endswith("py_module")

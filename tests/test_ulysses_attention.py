@@ -1,0 +1,108 @@
+"""Ulysses (all-to-all head-scattering) sequence parallelism on the
+virtual CPU mesh. Ground truth: single-device dense attention on the
+unsharded inputs — exactness, not approximation (SURVEY §5.7)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ray_tpu.ops.attention import reference_attention
+from ray_tpu.ops.ulysses_attention import ulysses_attention
+
+
+def _mesh(sp=4):
+    return Mesh(np.array(jax.devices()[:sp]), ("seq",))
+
+
+def _qkv(B=2, S=64, H=8, KVH=8, D=16, seed=0):
+    ks = jax.random.split(jax.random.key(seed), 3)
+    q = jax.random.normal(ks[0], (B, S, H, D), jnp.float32)
+    k = jax.random.normal(ks[1], (B, S, KVH, D), jnp.float32)
+    v = jax.random.normal(ks[2], (B, S, KVH, D), jnp.float32)
+    return q, k, v
+
+
+def _sharded(mesh, fn, q, k, v, **kw):
+    spec = P(None, "seq", None, None)
+    f = jax.shard_map(
+        lambda q, k, v: fn(q, k, v, "seq", **kw),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False)
+    return jax.jit(f)(q, k, v)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_ulysses_matches_dense(causal):
+    mesh = _mesh(4)
+    q, k, v = _qkv()
+    ref = reference_attention(q, k, v, causal=causal)
+    out = _sharded(mesh, ulysses_attention, q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-3, rtol=2e-3)
+
+
+def test_ulysses_gqa():
+    mesh = _mesh(4)
+    q, k, v = _qkv(H=8, KVH=4)
+    ref = reference_attention(q, k, v, causal=True)
+    out = _sharded(mesh, ulysses_attention, q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-3, rtol=2e-3)
+
+
+def test_ulysses_grads_match_dense():
+    mesh = _mesh(4)
+    q, k, v = _qkv(S=32, H=4, KVH=4)
+
+    def loss_sharded(q, k, v):
+        o = _sharded(mesh, ulysses_attention, q, k, v, causal=True)
+        return (o * o).mean()
+
+    def loss_ref(q, k, v):
+        o = reference_attention(q, k, v, causal=True)
+        return (o * o).mean()
+
+    g1 = jax.grad(loss_sharded, argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=3e-3, rtol=3e-3)
+
+
+def test_indivisible_heads_rejected():
+    mesh = _mesh(4)
+    q, k, v = _qkv(H=6, KVH=6)
+    with pytest.raises(Exception, match="divisible"):
+        _sharded(mesh, ulysses_attention, q, k, v, causal=True)
+
+
+def test_train_step_with_ulysses_mode(monkeypatch):
+    """End-to-end: the attention dispatcher picks ulysses under
+    RTPU_SP_MODE=ulysses and the sharded loss matches single-device."""
+    from ray_tpu.models import transformer as tfm
+    from ray_tpu.models.configs import llama_tiny
+    from ray_tpu.parallel import MeshSpec, RULES_TP, make_mesh
+    from ray_tpu.train.step import transformer_train_step
+
+    monkeypatch.setenv("RTPU_SP_MODE", "ulysses")
+    # heads also shard over tensor=2 inside the step: local counts 4 and 2
+    # divide the seq=2 axis, so the dispatcher genuinely picks ulysses.
+    cfg = llama_tiny(n_heads=8, n_kv_heads=4)
+    mesh = make_mesh(MeshSpec(data=2, seq=2, tensor=2),
+                     devices=jax.devices()[:8])
+    ts = transformer_train_step(cfg, mesh, rules=RULES_TP)
+    params, opt = ts.init(jax.random.key(0))
+    tokens = np.random.default_rng(0).integers(
+        0, cfg.vocab_size, (4, 64), dtype=np.int32)
+    batch = ts.shard_batch({"tokens": tokens})
+    _, _, loss = ts.step(params, opt, batch)
+    assert np.isfinite(float(loss))
+
+    mesh1 = make_mesh(MeshSpec(), devices=jax.devices()[:1])
+    ts1 = transformer_train_step(cfg, mesh1, rules=RULES_TP)
+    params1, _ = ts1.init(jax.random.key(0))
+    l1 = float(ts1.eval_loss(params1, {"tokens": tokens}))
+    params_f, _ = ts.init(jax.random.key(0))
+    l0 = float(ts.eval_loss(params_f, batch))
+    np.testing.assert_allclose(l0, l1, rtol=2e-3)
