@@ -1,0 +1,84 @@
+"""Run ONE training-throughput variant in a fresh process and print one
+JSON line. Companion to mfu_sweep.py: the axon compile helper accumulates
+memory across compiles in one process and 500s on large programs, so
+shape/policy exploration runs each point isolated:
+
+    python benchmarks/mfu_one.py --batch 8 --seq 2048 --policy dots
+
+The flash block override (--block) patches ops.flash_attention.DEFAULT_BLOCK
+before the model is built (the kernel reads it at trace time).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--seq", type=int, default=1024)
+    ap.add_argument("--policy", default="dots")  # dots|dots_attn|min|full|none
+    ap.add_argument("--block", type=int, default=0)  # 0 = kernel default
+    ap.add_argument("--steps", type=int, default=15)
+    ap.add_argument("--warmup", type=int, default=2)
+    ap.add_argument("--no-shift", action="store_true")
+    args = ap.parse_args()
+
+    import jax
+    import numpy as np
+
+    if args.block:
+        import ray_tpu.ops.flash_attention as fa
+
+        fa.DEFAULT_BLOCK = args.block
+
+    from ray_tpu.models.configs import bench_350m
+    from ray_tpu.parallel import MeshSpec, RULES_DP, make_mesh
+    from ray_tpu.train.step import transformer_train_step
+    from ray_tpu.util.accelerators import peak_flops_per_chip
+
+    remat = args.policy != "none"
+    cfg = bench_350m(remat=remat,
+                     remat_policy=args.policy if remat else "dots")
+    dev = jax.devices()[0]
+    mesh = make_mesh(MeshSpec(), devices=[dev])
+    ts = transformer_train_step(cfg, mesh, rules=RULES_DP,
+                                shift_inputs=not args.no_shift)
+    params, opt_state = ts.init(jax.random.key(0))
+    tokens = np.random.default_rng(0).integers(
+        0, cfg.vocab_size, (args.batch, args.seq + 1), dtype=np.int32)
+    b = ts.shard_batch({"tokens": tokens})
+
+    for _ in range(args.warmup):
+        params, opt_state, loss = ts.step(params, opt_state, b)
+    float(loss)
+
+    t0 = time.perf_counter()
+    for _ in range(args.steps):
+        params, opt_state, loss = ts.step(params, opt_state, b)
+    final = float(loss)
+    dt = time.perf_counter() - t0
+
+    tok_s = args.batch * args.seq * args.steps / dt
+    mfu = tok_s * cfg.flops_per_token(args.seq) / peak_flops_per_chip()
+    print(json.dumps({
+        "batch": args.batch, "seq": args.seq, "policy": args.policy,
+        "block": args.block or None, "shift": not args.no_shift,
+        "tok_s": round(tok_s, 1), "mfu": round(mfu, 4),
+        "step_ms": round(dt / args.steps * 1e3, 2), "loss": round(final, 4),
+    }), flush=True)
+
+
+if __name__ == "__main__":
+    try:
+        main()
+    except Exception as e:
+        print(json.dumps({"error": str(e)[:300],
+                          "argv": sys.argv[1:]}), flush=True)
+        sys.exit(1)

@@ -14,14 +14,22 @@ from .algorithm_config import AlgorithmConfig
 from .algorithms import IMPALA, IMPALAConfig, PPO, PPOConfig
 from .core import JaxLearner, LearnerGroup, MLPModule, RLModule
 from .env import EnvRunnerGroup, SingleAgentEnvRunner
+from .env.multi_agent_env import (MultiAgentBatchedEnv, MultiAgentEnv,
+                                  make_multi_agent_creator)
+from .offline import BC, BCConfig
 from .utils import (FaultTolerantActorManager, SingleAgentEpisode,
                     compute_gae, episodes_to_batch, vtrace)
 
 __all__ = [
+    "MultiAgentBatchedEnv",
+    "MultiAgentEnv",
+    "make_multi_agent_creator",
     "Algorithm",
     "AlgorithmConfig",
     "PPO",
     "PPOConfig",
+    "BC",
+    "BCConfig",
     "IMPALA",
     "IMPALAConfig",
     "RLModule",

@@ -76,9 +76,23 @@ class Algorithm(Trainable):
 
             from .core.catalog import module_for_space
 
-            env = creator()
+            # Batched-env factories (vector_env.BatchedEnv protocol, incl.
+            # multi-agent wrappers) take a column count and expose
+            # single_* spaces; plain creators build one gym env.
+            if getattr(creator, "makes_batched_env", False):
+                env = creator(1)
+            else:
+                env = creator()
             try:
-                obs_space = env.observation_space
+                # Space access inside try: a space property that raises
+                # must not leak the constructed env (subprocess/socket
+                # envs stay open otherwise).
+                if getattr(creator, "makes_batched_env", False):
+                    obs_space = env.single_observation_space
+                    action_space = env.single_action_space
+                else:
+                    obs_space = env.observation_space
+                    action_space = env.action_space
                 if connector_factory is not None:
                     # The module sees connector OUTPUT shapes.
                     shape = tuple(
@@ -86,8 +100,8 @@ class Algorithm(Trainable):
                     obs_space = gym.spaces.Box(
                         low=-np.inf, high=np.inf, shape=shape,
                         dtype=np.float32)
-                return module_for_space(
-                    obs_space, env.action_space, model_config)
+                return module_for_space(obs_space, action_space,
+                                        model_config)
             finally:
                 env.close()
 

@@ -47,6 +47,9 @@ class SingleAgentEnvRunner:
             # native vector env.
             self.batched = env_creator(num_envs)
             self.envs = None
+            # A batched factory may round the column count (e.g. up to a
+            # multiple of the agent count) — its word is final.
+            num_envs = self.batched.num_envs
         else:
             self.batched = GymVecEnv(env_creator, num_envs,
                                      mode=vectorize_mode)
@@ -118,6 +121,10 @@ class SingleAgentEnvRunner:
                 "valid": np.empty((T, N), np.float32),
             }
         next_step_mode = self.batched.autoreset_mode == "next_step"
+        # Multi-agent batched envs expose dead columns (agents done before
+        # their instance's episode): their rows are masked like autoreset
+        # rows (env/multi_agent_env.py).
+        dead_fn = getattr(self.batched, "dead_mask", None)
         for t in range(T):
             self._rng, sub = jax.random.split(self._rng)
             actions, logp, vf = self._explore_fn(self.params, self._obs, sub)
@@ -128,6 +135,8 @@ class SingleAgentEnvRunner:
             bufs["vf"][t] = vf
             invalid = (self._needs_reset.copy() if next_step_mode
                        else np.zeros(N, bool))
+            if dead_fn is not None:
+                invalid |= dead_fn()
             bufs["valid"][t] = 1.0 - invalid.astype(np.float32)
             raw_next, rewards, terms, truncs = self.batched.step(actions)
             bufs["rewards"][t] = rewards

@@ -1,0 +1,5 @@
+from .io import JsonWriter, read_experiences, write_fragments
+from .bc import BC, BCConfig
+
+__all__ = ["BC", "BCConfig", "JsonWriter", "read_experiences",
+           "write_fragments"]

@@ -1,0 +1,84 @@
+"""Behavior Cloning: the offline-RL baseline algorithm.
+
+Parity: reference rllib/algorithms/bc (trains the policy head to imitate
+logged actions from offline data; the env is used only for the module's
+spaces and optional evaluation). Data comes from experience shards written
+by offline.io (the output side of the reference's offline_data pipeline).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax.numpy as jnp
+
+from ..algorithm import Algorithm
+from ..algorithm_config import AlgorithmConfig
+from ..core.learner import JaxLearner
+from .io import iter_offline_batches, load_columns
+
+
+class BCConfig(AlgorithmConfig):
+    def __init__(self, algo_class=None):
+        super().__init__(algo_class=algo_class or BC)
+        self.input_path: str = ""
+        self.steps_per_iteration: int = 32
+
+    def offline_data(self, *, input_path: str,
+                     steps_per_iteration: int = None) -> "BCConfig":
+        self.input_path = input_path
+        if steps_per_iteration is not None:
+            self.steps_per_iteration = steps_per_iteration
+        return self
+
+
+class BCLearner(JaxLearner):
+    """Negative log-likelihood of the logged actions (policy head only)."""
+
+    def loss(self, params, batch, rng):
+        out = self.module.forward(params, batch["obs"])
+        dist = self.module.action_dist(out["logits"])
+        logp = dist.logp(batch["actions"])
+        nll = -logp.mean()
+        return nll, {"bc_nll": nll, "entropy": dist.entropy().mean()}
+
+
+class BC(Algorithm):
+    config_cls = BCConfig
+
+    def _learner_factory(self):
+        cfg = self._algo_config
+        module_factory = self._module_factory()
+        mesh = cfg.learner_mesh
+
+        def factory():
+            return BCLearner(module_factory(), lr=cfg.lr,
+                             grad_clip=cfg.grad_clip, mesh=mesh,
+                             seed=cfg.seed)
+
+        return factory
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg = self._algo_config
+        if not cfg.input_path:
+            raise ValueError("BC requires offline_data(input_path=...)")
+        # Load the corpus once; only the shuffle varies per iteration.
+        cache = getattr(self, "_offline_columns", None)
+        if cache is None:
+            cache = self._offline_columns = load_columns(cfg.input_path)
+        it = iter_offline_batches(
+            cache, cfg.minibatch_size or 128,
+            seed=cfg.seed + self._iteration)
+        metrics: Dict[str, Any] = {}
+        steps = 0
+        for batch in it:
+            batch = dict(batch)
+            batch.setdefault(
+                "mask", jnp.ones(len(batch["actions"]), jnp.float32))
+            metrics = self.learner_group.update(batch)
+            steps += 1
+            if steps >= cfg.steps_per_iteration:
+                break
+        out = dict(metrics)
+        out["sgd_steps_this_iter"] = steps
+        out["env_steps_this_iter"] = 0
+        return out

@@ -14,9 +14,11 @@ from .sample import (
 )
 from .searcher import ConcurrencyLimiter, Searcher
 from .basic_variant import BasicVariantGenerator
+from .tpe import TPESearcher
 
 __all__ = [
     "BasicVariantGenerator",
+    "TPESearcher",
     "Categorical",
     "ConcurrencyLimiter",
     "Domain",

@@ -76,6 +76,7 @@ class TuneController:
         callbacks: Optional[List[Callback]] = None,
         resources_per_trial: Optional[Dict[str, float]] = None,
         trials: Optional[List[Trial]] = None,
+        num_samples: Optional[int] = None,
     ):
         import cloudpickle
 
@@ -88,6 +89,11 @@ class TuneController:
         self.max_failures = max_failures
         self.checkpoint_freq = checkpoint_freq
         self.checkpoint_at_end = checkpoint_at_end
+        # Trial-count cap applying to ANY searcher (reference semantics:
+        # num_samples bounds Optuna/HyperOpt searchers too, not just the
+        # basic variant generator). None falls back to the runaway
+        # backstop.
+        self.num_samples = num_samples
         self.stop_criteria = stop or {}
         self.callbacks = callbacks or []
         self.resources_per_trial = resources_per_trial or {"num_cpus": 1}
@@ -186,8 +192,13 @@ class TuneController:
 
     # ------------------------------------------------------------ trial intake
 
+    def _trial_cap(self) -> int:
+        """num_samples when set, else the runaway backstop. Trial intake and
+        the run loop's done-check MUST use the same cap or they diverge."""
+        return self.num_samples or 10_000
+
     def _maybe_request_trials(self) -> None:
-        while not self._searcher_done and len(self.trials) < 10_000:
+        while not self._searcher_done and len(self.trials) < self._trial_cap():
             live = sum(1 for t in self.trials if t.status in (PENDING, RUNNING, PAUSED))
             if live >= self.max_concurrent * 2:
                 return
@@ -306,7 +317,13 @@ class TuneController:
 
         if not self._inflight:
             live = [t for t in self.trials if t.status in (PENDING, RUNNING, PAUSED)]
-            return bool(live) or not self._searcher_done
+            # Done when nothing is live AND no further trial can be
+            # requested — either the searcher said FINISHED or the
+            # num_samples cap is reached (a searcher that never finishes,
+            # e.g. TPE without max_trials, must not spin this loop forever).
+            can_request = (not self._searcher_done
+                           and len(self.trials) < self._trial_cap())
+            return bool(live) or can_request
 
         refs = list(self._inflight.keys())
         ready, _ = ray_tpu.wait(refs, num_returns=1, timeout=10.0)

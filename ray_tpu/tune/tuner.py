@@ -215,6 +215,17 @@ class Tuner:
             callbacks=callbacks,
             resources_per_trial=resources,
             trials=restored_trials,
+            # The basic variant generator consumes num_samples itself
+            # (grid_size x num_samples trials, then FINISHED) — capping it
+            # at TuneConfig.num_samples (default 1) would drop its grid
+            # variants. The controller-level cap is for OTHER user-supplied
+            # searchers, which suggest forever (reference semantics:
+            # num_samples bounds Optuna/HyperOpt searchers too).
+            num_samples=(tc.num_samples
+                         if tc.search_alg is not None
+                         and not isinstance(tc.search_alg,
+                                            BasicVariantGenerator)
+                         else None),
         )
         trials = controller.run()
 

@@ -1,0 +1,181 @@
+"""Offline RL (experience IO + behavior cloning) and multi-agent envs.
+
+Reference behaviors matched: rllib/offline/ (json writer/reader +
+offline-data training loop), rllib/algorithms/bc (imitation of logged
+actions), rllib/env/multi_agent_env.py (dict-keyed protocol with "__all__",
+shared-policy/parameter-sharing training path).
+"""
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.rllib.env.multi_agent_env import (MultiAgentBatchedEnv,
+                                               MultiAgentEnv,
+                                               make_multi_agent_creator)
+
+
+# ------------------------------------------------------------- offline IO/BC
+
+
+def _make_fragments(seed=0, T=32, N=8):
+    rng = np.random.default_rng(seed)
+    obs = rng.random((T, N, 4)).astype(np.float32)
+    # Ground-truth policy the BC learner should recover: action = argmax of
+    # first two obs dims.
+    actions = (obs[..., 0] < obs[..., 1]).astype(np.int64)
+    return {
+        "obs": obs, "actions": actions,
+        "logp": np.zeros((T, N), np.float32),
+        "vf": np.zeros((T, N), np.float32),
+        "rewards": np.ones((T, N), np.float32),
+        "dones": np.zeros((T, N), bool),
+        "truncs": np.zeros((T, N), bool),
+        "valid": np.ones((T, N), np.float32),
+        "bootstrap": np.zeros(N, np.float32),
+        "episode_returns": [],
+    }
+
+
+def test_write_read_experiences_roundtrip(tmp_path, ray_start_regular):
+    from ray_tpu.rllib.offline import read_experiences, write_fragments
+
+    frag = _make_fragments()
+    frag["valid"][3, 2] = 0.0  # one invalid row must be dropped
+    write_fragments([frag], str(tmp_path))
+    ds = read_experiences(str(tmp_path))
+    rows = ds.take_all()
+    assert len(rows) == 32 * 8 - 1
+    assert rows[0]["obs"].shape == (4,)
+
+
+def test_bc_imitates_logged_policy(tmp_path, ray_start_regular):
+    from ray_tpu.rllib.offline import write_fragments
+    from ray_tpu.rllib.offline.bc import BCConfig
+
+    for s in range(3):
+        write_fragments([_make_fragments(seed=s)], str(tmp_path))
+
+    algo = (
+        BCConfig()
+        .environment(env_creator=lambda: _bc_spec_env())
+        .offline_data(input_path=str(tmp_path), steps_per_iteration=20)
+        .training(lr=2e-2, minibatch_size=256)
+        .build()
+    )
+    first = algo.train()["bc_nll"]
+    for _ in range(6):
+        last = algo.train()["bc_nll"]
+    assert last < first * 0.7, (first, last)
+    # The cloned policy reproduces the logged rule.
+    import jax
+
+    learner = algo.learner_group._learner
+    obs = np.random.default_rng(9).random((256, 4)).astype(np.float32)
+    out = learner.module.forward(learner.params, obs)
+    pred = np.asarray(out["logits"]).argmax(-1)
+    truth = (obs[:, 0] < obs[:, 1]).astype(np.int64)
+    assert (pred == truth).mean() > 0.9
+    algo.stop()
+
+
+def _bc_spec_env():
+    import gymnasium as gym
+
+    class SpecEnv(gym.Env):
+        observation_space = gym.spaces.Box(0, 1, (4,), np.float32)
+        action_space = gym.spaces.Discrete(2)
+
+        def reset(self, *, seed=None, options=None):
+            return np.zeros(4, np.float32), {}
+
+        def step(self, a):
+            return np.zeros(4, np.float32), 0.0, True, False, {}
+
+    return SpecEnv()
+
+
+# ------------------------------------------------------------- multi-agent
+
+
+class TagTeam(MultiAgentEnv):
+    """Two agents see the same state; +1 reward when both pick the state's
+    parity, episode of fixed length; agent 'b' truncates early to exercise
+    the dead-column masking."""
+
+    possible_agents = ("a", "b")
+
+    def __init__(self):
+        import gymnasium as gym
+
+        self.single_observation_space = gym.spaces.Box(0, 1, (3,), np.float32)
+        self.single_action_space = gym.spaces.Discrete(2)
+        self._t = 0
+        self._rng = np.random.default_rng(0)
+
+    def _obs(self):
+        o = self._rng.random(3).astype(np.float32)
+        self._parity = int(o[0] > 0.5)
+        return {a: o for a in self.possible_agents}
+
+    def reset(self, seed=None):
+        if seed is not None:
+            self._rng = np.random.default_rng(seed)
+        self._t = 0
+        self._dead_b = False
+        return self._obs()
+
+    def step(self, actions):
+        self._t += 1
+        rew = {a: float(actions[a] == self._parity)
+               for a in actions}
+        term = {"__all__": self._t >= 8}
+        trunc = {}
+        if self._t == 5 and not self._dead_b and "b" in actions:
+            self._dead_b = True
+            trunc["b"] = True
+        obs = self._obs()
+        if self._dead_b:
+            obs.pop("b", None)
+        return obs, rew, term, trunc
+
+
+def test_multi_agent_batched_env_columns():
+    env = MultiAgentBatchedEnv(TagTeam, num_instances=3, seed=0)
+    obs = env.reset(seed=0)
+    assert obs.shape == (6, 3)
+    obs, rew, term, trunc = env.step(np.zeros(6, np.int64))
+    assert rew.shape == (6,)
+    # Step to b's truncation: its columns go dead until "__all__".
+    for _ in range(4):
+        obs, rew, term, trunc = env.step(np.zeros(6, np.int64))
+    assert env.dead_mask()[1::2].all()  # all 'b' columns dead
+    for _ in range(3):
+        env.step(np.zeros(6, np.int64))
+    assert not env.dead_mask().any()  # episodes rolled over
+
+
+def test_shared_policy_ppo_on_multi_agent_env(ray_start_regular):
+    """Parameter-shared PPO trains on the flattened multi-agent columns via
+    the ordinary fragment path and improves the joint return."""
+    from ray_tpu.rllib.algorithms.ppo import PPOConfig
+
+    algo = (
+        PPOConfig()
+        .environment(env_creator=make_multi_agent_creator(TagTeam))
+        .env_runners(num_env_runners=0, num_envs_per_env_runner=8,
+                     rollout_fragment_length=64)
+        .training(lr=1e-2, minibatch_size=128, num_epochs=4)
+        .build()
+    )
+    first = None
+    for i in range(12):
+        r = algo.train()
+        if first is None and not np.isnan(r["episode_return_mean"]):
+            first = r["episode_return_mean"]
+    last = r["episode_return_mean"]
+    # Random = ~4 (8 steps x P(correct)=.5); perfect = 8 per agent column
+    # (a loses 3 masked steps... b truncates at 5). Learning must clearly
+    # beat random.
+    assert first is not None
+    assert last > first + 0.5, (first, last)
+    algo.stop()

@@ -157,16 +157,21 @@ def test_ppo_trains_on_fragments():
     from ray_tpu.rllib.algorithms.ppo import PPOConfig
 
     ray_tpu.init(num_cpus=2, ignore_reinit_error=True)
-    algo = (
-        PPOConfig()
-        .environment("CartPole-v1")
-        .env_runners(num_env_runners=0, num_envs_per_env_runner=4,
-                     rollout_fragment_length=64)
-        .training(lr=5e-3, minibatch_size=128, num_epochs=2)
-        .build()
-    )
-    for _ in range(3):
-        result = algo.train()
-    assert result["env_steps_this_iter"] > 0
-    assert np.isfinite(result["policy_loss"])
-    algo.stop()
+    try:
+        algo = (
+            PPOConfig()
+            .environment("CartPole-v1")
+            .env_runners(num_env_runners=0, num_envs_per_env_runner=4,
+                         rollout_fragment_length=64)
+            .training(lr=5e-3, minibatch_size=128, num_epochs=2)
+            .build()
+        )
+        for _ in range(3):
+            result = algo.train()
+        assert result["env_steps_this_iter"] > 0
+        assert np.isfinite(result["policy_loss"])
+        algo.stop()
+    finally:
+        # A leaked init breaks the next module's stricter init fixture
+        # (test_runtime_env's renv_cluster inits without reinit tolerance).
+        ray_tpu.shutdown()
