@@ -52,10 +52,17 @@ class Algorithm(Trainable):
             num_envs_per_runner=cfg.num_envs_per_env_runner,
             seed=cfg.seed,
             connector_factory=cfg.env_to_module_connector,
+            action_connector_factory=cfg.module_to_env_connector,
             vectorize_mode=cfg.vectorize_mode,
         )
         self.learner_group = LearnerGroup(
             self._learner_factory(), num_learners=cfg.num_learners)
+        # Learner-connector pipeline: sampled data passes through it before
+        # advantage estimation (reference learner connector position). The
+        # fragment path hands it [T, N] columns; the episode paths hand it
+        # per-episode [T] columns via _connect_episodes.
+        self._learner_connector = (cfg.learner_connector()
+                                   if cfg.learner_connector else None)
         self._timesteps_total = 0
         self._episodes_total = 0
         self._recent_returns: list = []
@@ -127,6 +134,32 @@ class Algorithm(Trainable):
         result.setdefault("episodes_total", self._episodes_total)
         result["time_this_iter_s"] = time.time() - t0
         return result
+
+    def _connect_episodes(self, episodes):
+        """Apply the learner-connector pipeline on the episode-based paths
+        (PPO use_fragments=False, IMPALA, DQN): each episode's columns pass
+        through as a [T]-shaped dict BEFORE batch assembly / advantage
+        estimation, mirroring the fragment path's position. Elementwise
+        connectors (ClipRewards) work identically on both."""
+        lc = self._learner_connector
+        if lc is None:
+            return episodes
+        for ep in episodes:
+            cols = {
+                "rewards": np.asarray(ep.rewards, np.float32),
+                "actions": np.asarray(ep.actions),
+                "logp": np.asarray(ep.logp, np.float32),
+                "vf_preds": np.asarray(ep.vf_preds, np.float32),
+            }
+            out = lc(cols)
+            ep.rewards = [float(r) for r in out["rewards"]]
+            if out["actions"] is not cols["actions"]:
+                ep.actions = list(out["actions"])
+            if out["logp"] is not cols["logp"]:
+                ep.logp = [float(x) for x in out["logp"]]
+            if out["vf_preds"] is not cols["vf_preds"]:
+                ep.vf_preds = [float(x) for x in out["vf_preds"]]
+        return episodes
 
     def _record_episodes(self, episodes) -> None:
         done = [e for e in episodes if e.is_done]

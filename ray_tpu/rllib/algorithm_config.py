@@ -23,6 +23,13 @@ class AlgorithmConfig:
         self.num_envs_per_env_runner: int = 1
         self.rollout_fragment_length: int = 200
         self.env_to_module_connector: Optional[Any] = None
+        # Zero-arg factory -> ConnectorV2 applied to ACTIONS before
+        # env.step (reference module_to_env pipeline).
+        self.module_to_env_connector: Optional[Any] = None
+        # Zero-arg factory -> LearnerConnector applied to fragments before
+        # advantage estimation (reference learner pipeline; set via
+        # .training(learner_connector=...)).
+        self.learner_connector: Optional[Any] = None
         # Fragment sampling ([T,N] columns, utils/rollout.py) is the
         # throughput default for PPO; False restores the episode-based
         # sampler (comparison/debug).
@@ -69,6 +76,7 @@ class AlgorithmConfig:
                     num_envs_per_env_runner: Optional[int] = None,
                     rollout_fragment_length: Optional[int] = None,
                     env_to_module_connector: Optional[Any] = None,
+                    module_to_env_connector: Optional[Any] = None,
                     use_fragments: Optional[bool] = None,
                     vectorize_mode: Optional[str] = None,
                     ) -> "AlgorithmConfig":
@@ -86,6 +94,8 @@ class AlgorithmConfig:
             # Zero-arg factory returning a ConnectorV2 / ConnectorPipeline
             # (reference: config.env_runners(env_to_module_connector=...)).
             self.env_to_module_connector = env_to_module_connector
+        if module_to_env_connector is not None:
+            self.module_to_env_connector = module_to_env_connector
         return self
 
     def training(self, **kwargs) -> "AlgorithmConfig":

@@ -213,6 +213,9 @@ class DQN(Algorithm):
 
         episodes = self.env_runner_group.sample(cfg.train_batch_size)
         self._record_episodes(episodes)
+        # Learner connector before replay insertion: TD targets must see
+        # the transformed (e.g. clipped) rewards.
+        episodes = self._connect_episodes(episodes)
         added = self._buffer.add_episodes(episodes)
         self._steps_since_target_sync += added
 

@@ -132,6 +132,7 @@ class IMPALA(Algorithm):
     def _update_from_episodes(self, episodes) -> Dict[str, float]:
         cfg = self._algo_config
         self._record_episodes(episodes)
+        episodes = self._connect_episodes(episodes)
         max_t = min(cfg.max_episode_len, max(len(e) for e in episodes))
         # gamma folds the bootstrap into the last valid reward and marks it
         # done: the v-trace reverse scan then can't pull V(padded-zero-obs)

@@ -143,6 +143,7 @@ class PPO(Algorithm):
         # fragment path is the throughput-oriented default).
         episodes = self.env_runner_group.sample(cfg.train_batch_size)
         self._record_episodes(episodes)
+        episodes = self._connect_episodes(episodes)
         max_t = min(cfg.max_episode_len, max(len(e) for e in episodes))
         batch = postprocess_episodes(
             episodes, gamma=cfg.gamma, lam=cfg.lambda_, max_t=max_t)
@@ -165,6 +166,8 @@ class PPO(Algorithm):
 
         frags = self.env_runner_group.sample_fragments(
             cfg.rollout_fragment_length)
+        if self._learner_connector is not None:
+            frags = [self._learner_connector(f) for f in frags]
         n_eps = 0
         n_steps = 0
         for f in frags:

@@ -1,12 +1,22 @@
-"""Connectors: composable observation transforms between env and module.
+"""Connectors: the three composable transform pipelines around the module.
 
-Parity: reference rllib/connectors/connector_v2.py (ConnectorV2 pipelines on
-the env-to-module path) — the round-2 verdict called out that transforms
-were hard-wired into episodes_to_batch. A ConnectorPipeline runs inside the
-env runner on the raw vectorized observations before the (jitted) policy
-forward, and the same pipeline is applied when replaying episodes into
-training batches, so the module always sees identically transformed
-observations in sampling and learning.
+Parity: reference rllib/connectors/ (connector_v2.py + env_to_module/,
+module_to_env/, learner/ pipeline packages):
+
+- **env-to-module** (`ConnectorV2` here): raw vector observations ->
+  module inputs, run inside the env runner before the (jitted) policy
+  forward. Image preprocessing (GrayScale/ResizeImage/ScaleObs/FrameStack)
+  lives on this path — the Atari chain of the reference's
+  FrameStackingEnvToModule + gym wrappers.
+- **module-to-env** (also `ConnectorV2`, applied to ACTIONS): module action
+  outputs -> env actions (clip/unsquash for continuous spaces; reference
+  module_to_env/unsquash_and_clip_actions). Buffers record the MODULE's
+  actions; only the env sees the transformed ones.
+- **learner** (`LearnerConnector`): [T, N] fragment columns -> fragment
+  columns, applied by the algorithm BEFORE advantage estimation (the
+  reference puts GAE itself in this pipeline; here GAE stays a jitted
+  function and the connector handles the data transforms around it, e.g.
+  Atari reward clipping).
 
 Connectors are plain objects with numpy __call__ (the env side is CPU
 work); stateful ones (FrameStack) keep per-env state and are reset on
@@ -119,3 +129,157 @@ class FrameStack(ConnectorV2):
         shape = list(input_shape)
         shape[-1] = shape[-1] * self.k
         return tuple(shape)
+
+
+# --------------------------------------------------------- image transforms
+
+
+class GrayScale(ConnectorV2):
+    """[N, H, W, C>=3] RGB -> [N, H, W, 1] luma; dtype preserved
+    (reference: gym AtariPreprocessing grayscale_obs)."""
+
+    _LUMA = np.array([0.299, 0.587, 0.114], np.float32)
+
+    def __call__(self, obs: np.ndarray) -> np.ndarray:
+        obs = np.asarray(obs)
+        gray = np.tensordot(obs[..., :3].astype(np.float32), self._LUMA,
+                            axes=([-1], [0]))
+        if np.issubdtype(obs.dtype, np.integer):
+            gray = np.clip(np.rint(gray), 0, 255)
+        return gray.astype(obs.dtype)[..., None]
+
+    def output_shape(self, input_shape):
+        return tuple(input_shape[:-1]) + (1,)
+
+
+class ResizeImage(ConnectorV2):
+    """[N, H, W, C] -> [N, h, w, C]: block-mean ("area") when the source
+    divides evenly, nearest-neighbor index maps otherwise (210x160 -> 84x84
+    takes the nearest path); dtype preserved. Pure numpy — no cv2/PIL in
+    this image."""
+
+    def __init__(self, height: int = 84, width: int = 84):
+        self.h, self.w = int(height), int(width)
+        self._idx: Dict[Any, Any] = {}
+
+    def _maps(self, H: int, W: int):
+        key = (H, W)
+        got = self._idx.get(key)
+        if got is None:
+            if H % self.h == 0 and W % self.w == 0:
+                got = ("area", H // self.h, W // self.w)
+            else:
+                ri = np.minimum((np.arange(self.h) + 0.5) * H / self.h,
+                                H - 1).astype(np.int64)
+                ci = np.minimum((np.arange(self.w) + 0.5) * W / self.w,
+                                W - 1).astype(np.int64)
+                got = ("nearest", ri, ci)
+            self._idx[key] = got
+        return got
+
+    def __call__(self, obs: np.ndarray) -> np.ndarray:
+        obs = np.asarray(obs)
+        N, H, W = obs.shape[:3]
+        kind, a, b = self._maps(H, W)
+        if kind == "area":
+            out = obs.reshape(N, self.h, a, self.w, b, *obs.shape[3:])
+            out = out.mean(axis=(2, 4))
+            if np.issubdtype(obs.dtype, np.integer):
+                out = np.rint(out)
+            return out.astype(obs.dtype)
+        return obs[:, a][:, :, b]
+
+    def output_shape(self, input_shape):
+        return (self.h, self.w) + tuple(input_shape[2:])
+
+
+class ScaleObs(ConnectorV2):
+    """uint8 pixels -> float32 in [0, 1] (reference: normalize_images)."""
+
+    def __init__(self, scale: float = 1.0 / 255.0):
+        self.scale = float(scale)
+
+    def __call__(self, obs: np.ndarray) -> np.ndarray:
+        return np.asarray(obs, np.float32) * self.scale
+
+
+def atari_preprocessor(k: int = 4, size: int = 84) -> ConnectorPipeline:
+    """The standard Atari chain: gray -> resize -> scale -> stack-k.
+    Pass the FUNCTION as env_to_module_connector (it is the factory).
+    FrameStack concatenates along the channel axis, so the module sees
+    [size, size, k] — the DQN-lineage CNN input layout."""
+    return ConnectorPipeline(
+        [GrayScale(), ResizeImage(size, size), ScaleObs(), FrameStack(k)])
+
+
+# ------------------------------------------------- module-to-env (actions)
+
+
+class ClipActions(ConnectorV2):
+    """Clip continuous module actions into the env's bounds — scalars or
+    per-dimension Box arrays (space.low/space.high), as in reference
+    module_to_env clip_actions. No-op for integer/discrete arrays."""
+
+    def __init__(self, low, high):
+        self.low = np.asarray(low, np.float32)
+        self.high = np.asarray(high, np.float32)
+
+    def __call__(self, actions: np.ndarray) -> np.ndarray:
+        actions = np.asarray(actions)
+        if np.issubdtype(actions.dtype, np.integer):
+            return actions
+        return np.clip(actions, self.low, self.high)
+
+
+class UnsquashActions(ConnectorV2):
+    """Map tanh-squashed module outputs in [-1, 1] onto [low, high]
+    (scalar or per-dimension array bounds; reference module_to_env
+    unsquash_actions)."""
+
+    def __init__(self, low, high):
+        self.low = np.asarray(low, np.float32)
+        self.high = np.asarray(high, np.float32)
+
+    def __call__(self, actions: np.ndarray) -> np.ndarray:
+        actions = np.asarray(actions, np.float32)
+        return self.low + (np.clip(actions, -1.0, 1.0) + 1.0) * 0.5 * (
+            self.high - self.low)
+
+
+# ------------------------------------------------------ learner connectors
+
+
+class LearnerConnector:
+    """One transform over a fragment dict of [T, N] columns (obs, actions,
+    rewards, dones, truncs, valid, ...), applied before advantage
+    estimation. Mutating a COPY keeps runner-side buffers intact."""
+
+    def __call__(self, frag: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
+        raise NotImplementedError
+
+
+class LearnerConnectorPipeline(LearnerConnector):
+    def __init__(self, connectors: Sequence[LearnerConnector]):
+        self.connectors = list(connectors)
+
+    def __call__(self, frag):
+        for c in self.connectors:
+            frag = c(frag)
+        return frag
+
+
+class ClipRewards(LearnerConnector):
+    """Clip (or sign-compress) rewards before GAE/v-trace — the Atari
+    convention (reference: learner pipeline reward clipping / the classic
+    DQN sign(r))."""
+
+    def __init__(self, bound: float = 1.0, sign: bool = False):
+        self.bound = float(bound)
+        self.sign = sign
+
+    def __call__(self, frag):
+        frag = dict(frag)
+        r = np.asarray(frag["rewards"])
+        frag["rewards"] = (np.sign(r) if self.sign
+                           else np.clip(r, -self.bound, self.bound))
+        return frag

@@ -25,6 +25,7 @@ class SingleAgentEnvRunner:
         seed: int = 0,
         worker_index: int = 0,
         connector_factory: Optional[Callable[[], Any]] = None,
+        action_connector_factory: Optional[Callable[[], Any]] = None,
         vectorize_mode: str = "sync",
         device: str = "cpu",
     ):
@@ -62,6 +63,14 @@ class SingleAgentEnvRunner:
         # record the transformed obs so the learner sees the same view.
         self._connector_factory = connector_factory
         self.connector = connector_factory() if connector_factory else None
+        # module-to-env pipeline (reference module_to_env connectors):
+        # transforms the MODULE's actions into env actions; recorded
+        # buffers keep the module's view (the learner must see what the
+        # policy actually emitted). Stateful ones reset on episode
+        # boundaries like the obs pipeline.
+        self._action_connector_factory = action_connector_factory
+        self.action_connector = (action_connector_factory()
+                                 if action_connector_factory else None)
         self._rng = jax.random.key(seed * 10_007 + worker_index)
         self._explore_fn = jax.jit(self.module.forward_exploration)
         self._value_fn = jax.jit(
@@ -86,6 +95,13 @@ class SingleAgentEnvRunner:
 
     def _connect(self, raw_obs):
         return self.connector(raw_obs) if self.connector is not None else raw_obs
+
+    def _reset_pipelines(self, env_index: int) -> None:
+        """Episode boundary: clear per-env state in BOTH pipelines."""
+        if self.connector is not None:
+            self.connector.reset(env_index)
+        if self.action_connector is not None:
+            self.action_connector.reset(env_index)
 
     def set_weights(self, weights) -> None:
         self.params = weights
@@ -138,7 +154,9 @@ class SingleAgentEnvRunner:
             if dead_fn is not None:
                 invalid |= dead_fn()
             bufs["valid"][t] = 1.0 - invalid.astype(np.float32)
-            raw_next, rewards, terms, truncs = self.batched.step(actions)
+            env_actions = (self.action_connector(actions)
+                           if self.action_connector is not None else actions)
+            raw_next, rewards, terms, truncs = self.batched.step(env_actions)
             bufs["rewards"][t] = rewards
             done = terms | truncs
             bufs["dones"][t] = done & ~invalid
@@ -159,15 +177,15 @@ class SingleAgentEnvRunner:
                 # truncation bootstrap), THEN reset; the reset state
                 # applies to the reset obs arriving next step.
                 self._obs = self._connect(raw_next)
-                if finished.any() and self.connector is not None:
+                if finished.any():
                     for i in np.nonzero(finished)[0]:
-                        self.connector.reset(int(i))
+                        self._reset_pipelines(int(i))
             else:
                 # SAME_STEP: raw_next is already the new episode's start —
                 # reset the connector before it passes through.
-                if finished.any() and self.connector is not None:
+                if finished.any():
                     for i in np.nonzero(finished)[0]:
-                        self.connector.reset(int(i))
+                        self._reset_pipelines(int(i))
                 self._obs = self._connect(raw_next)
         bootstrap = np.asarray(self._value_fn(self.params, self._obs))
         returns, self._completed_returns = self._completed_returns, []
@@ -196,7 +214,9 @@ class SingleAgentEnvRunner:
             actions = np.asarray(actions)
             logp = np.asarray(logp)
             vf = np.asarray(vf)
-            raw_next, rewards, terms, truncs, _ = self.envs.step(actions)
+            env_actions = (self.action_connector(actions)
+                           if self.action_connector is not None else actions)
+            raw_next, rewards, terms, truncs, _ = self.envs.step(env_actions)
             next_obs = self._connect(raw_next)
             vf_next: Optional[np.ndarray] = None  # lazy V(next_obs)
             for i in range(self.num_envs):
@@ -229,8 +249,7 @@ class SingleAgentEnvRunner:
                     self._needs_reset[i] = True
                     # Stateful connectors (frame stacks) restart with the
                     # new episode.
-                    if self.connector is not None:
-                        self.connector.reset(i)
+                    self._reset_pipelines(i)
                 else:
                     ep.observations.append(next_obs[i].copy())
             self._obs = next_obs
@@ -256,10 +275,12 @@ class SingleAgentEnvRunner:
 
         env = self.envs.env_fns[0]()
         jax = self._jax
-        # Evaluation gets its own connector instance: sharing the sampling
-        # pipeline's per-env state would corrupt in-flight frame stacks.
+        # Evaluation gets its own connector instances: sharing the sampling
+        # pipelines' per-env state would corrupt in-flight frame stacks.
         conn = (self._connector_factory()
                 if self._connector_factory is not None else None)
+        act_conn = (self._action_connector_factory()
+                    if self._action_connector_factory is not None else None)
 
         def trans(o):
             return conn(np.asarray(o)[None]) if conn is not None \
@@ -269,7 +290,10 @@ class SingleAgentEnvRunner:
         total = 0.0
         for _ in range(max_steps):
             action = self.module.forward_inference(self.params, trans(obs))
-            obs, r, term, trunc, _ = env.step(int(np.asarray(action)[0]))
+            act = np.asarray(action)
+            if act_conn is not None:
+                act = act_conn(act)
+            obs, r, term, trunc, _ = env.step(int(act[0]))
             total += float(r)
             if term or trunc:
                 break

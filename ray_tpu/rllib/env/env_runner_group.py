@@ -28,6 +28,7 @@ class EnvRunnerGroup:
         runner_resources: Optional[Dict[str, float]] = None,
         max_restarts: int = 3,
         connector_factory: Optional[Callable[[], Any]] = None,
+        action_connector_factory: Optional[Callable[[], Any]] = None,
         vectorize_mode: str = "sync",
     ):
         self.num_runners = num_runners
@@ -36,6 +37,7 @@ class EnvRunnerGroup:
                 env_creator, module_factory,
                 num_envs=num_envs_per_runner, seed=seed, worker_index=0,
                 connector_factory=connector_factory,
+                action_connector_factory=action_connector_factory,
                 vectorize_mode=vectorize_mode)
             self._manager = None
         else:
@@ -49,6 +51,7 @@ class EnvRunnerGroup:
                     num_envs=num_envs_per_runner, seed=seed,
                     worker_index=i + 1,
                     connector_factory=connector_factory,
+                    action_connector_factory=action_connector_factory,
                     vectorize_mode=vectorize_mode)
 
             self._manager = FaultTolerantActorManager(
