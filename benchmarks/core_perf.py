@@ -54,16 +54,23 @@ def main():
         def call(self):
             return None
 
-    # Warm the worker pool so spawn latency isn't measured.
+    # Warm the worker pool so spawn latency isn't measured, then settle
+    # past the lease backoff so the wave measures the steady-state direct
+    # path (reference microbenchmarks also measure warm-path rates).
     ray_tpu.get([nop.remote() for _ in range(8)])
+    time.sleep(1.0)
+    ray_tpu.get([nop.remote() for _ in range(32)])
 
-    # 1. task submit+get round-trips, serial batches
+    # 1. task submit+get round-trips, pipelined waves
     results.append(bench(
-        "tasks_per_s", 500,
-        lambda: ray_tpu.get([nop.remote() for _ in range(500)])))
+        "tasks_per_s", 2000,
+        lambda: ray_tpu.get([nop.remote() for _ in range(2000)])))
 
     # 2. actor method calls (2000: at direct-dispatch rates a 500-call
-    # wave finishes in ~0.1s and scheduler noise dominates the measurement)
+    # wave finishes in ~0.1s and scheduler noise dominates the measurement).
+    # Settle first: the task wave's worker leases release on idle, and that
+    # churn (reclaim pushes, state flips) pollutes the actor measurement.
+    time.sleep(2.5)
     a = Nop.remote()
     ray_tpu.get(a.call.remote())
     ray_tpu.get([a.call.remote() for _ in range(200)])  # warm the route

@@ -113,6 +113,12 @@ async def _driver_handler(conn, msg):
     kind = msg.get("kind")
     if kind == "pubsub":
         ctx.deliver_pubsub(msg["channel"], msg["data"])
+    elif kind == "lease_reclaim":
+        # The controller has queued work it cannot place while we hold
+        # task leases: release every named lease with no in-flight pushes.
+        ids = set(msg.get("lease_ids") or ())
+        threading.Thread(target=_reclaim_leases, args=(ids,),
+                         daemon=True, name="lease-reclaim").start()
     elif kind == "log":
         # A worker's stdout/stderr line, prefixed like the reference's
         # driver-side log tailing ("(pid=...) ...").
@@ -484,8 +490,11 @@ class RemoteFunction:
         _attach_runtime_env(wc, opts, spec)
         if streaming:
             _streaming_spec_opts(opts, spec)
-        _pipelined_submit(wc, {"kind": "submit_task", "spec": spec},
-                          spec["return_ids"])
+        # Lease-then-push direct path first; the controller queue is the
+        # fallback (and the only path for pg/affinity/streaming tasks).
+        if not _try_direct_task(wc, spec, opts):
+            _pipelined_submit(wc, {"kind": "submit_task", "spec": spec},
+                              spec["return_ids"])
         if streaming:
             return ObjectRefGenerator(spec["task_id"])
         refs = [ObjectRef(oid) for oid in return_ids]
@@ -760,9 +769,261 @@ def _reset_direct_state(wc=None) -> None:
     if wc is not None:
         for route in list(_routes.values()):
             _invalidate_route(wc, route)  # closes the direct sockets
+        for pool in list(_task_pools.values()):
+            pool.shutdown(wc)
     _routes.clear()
+    _task_pools.clear()
     _local_locs.clear()
     _inflight_direct.clear()
+
+
+# ---- task leases (direct stateless-task dispatch) --------------------------
+# Reference: direct_task_transport.h:75 — the owner leases a worker from the
+# raylet and pushes tasks to it peer-to-peer; the lease pins the worker's
+# resources. The pool below keeps up to _LEASE_MAX leased workers per
+# (resources, env) signature, grows while every route is saturated, and
+# releases leases that sit idle. Streaming / placement-group / affinity
+# tasks stay on the controller path.
+
+_LEASE_PIPELINE = 1         # grow the pool when every route is busy
+_LEASE_IDLE_S = 2.0         # release a lease unused this long
+_LEASE_BACKOFF_S = 0.5      # after a failed lease attempt, don't retry sooner
+
+
+def _reclaim_leases(lease_ids) -> None:
+    """Release every idle route whose lease the controller asked back."""
+    try:
+        wc = ctx.get_worker_context()
+    except Exception:
+        return
+    for pool in list(_task_pools.values()):
+        with pool.lock:
+            victims = [r for r in pool.routes
+                       if r.lease_id in lease_ids and r.inflight == 0]
+            # Out of the pool BEFORE releasing, or a concurrent pick() can
+            # hand a mid-release route to a new submit (double-booked
+            # worker + spurious WorkerCrashedError on a retry-less task).
+            pool.routes = [r for r in pool.routes if r not in victims]
+        for r in victims:
+            pool._release(wc, r)
+
+
+class _TaskRoute:
+    __slots__ = ("conn", "lease_id", "worker_id", "inflight", "last_used")
+
+    def __init__(self, conn, lease_id: str, worker_id: str) -> None:
+        self.conn = conn
+        self.lease_id = lease_id
+        self.worker_id = worker_id
+        self.inflight = 0
+        self.last_used = time.monotonic()
+
+
+class _TaskRoutePool:
+    def __init__(self) -> None:
+        self.routes: List[_TaskRoute] = []
+        self.lock = threading.Lock()
+        self.next_try = 0.0    # monotonic; backoff after failed lease
+        self.acquiring = 0     # in-flight _acquire calls (caps pool growth)
+
+    def _acquire(self, wc, resources, env_hash, runtime_env) -> Optional[_TaskRoute]:
+        from . import protocol
+
+        try:
+            got = wc.client.request({
+                "kind": "lease_worker", "resources": resources,
+                "env_hash": env_hash, "runtime_env": runtime_env})
+        except Exception:
+            got = None
+        if not got or not got.get("lease_id"):
+            with self.lock:
+                self.next_try = time.monotonic() + _LEASE_BACKOFF_S
+            return None
+        try:
+            conn = wc.client.io.call(
+                protocol.connect(got["host"], got["port"],
+                                 name=f"lease->{got['worker_id'][:8]}"),
+                timeout=5)
+        except Exception:
+            try:
+                wc.client.request({"kind": "release_lease",
+                                   "lease_id": got["lease_id"]})
+            except Exception:
+                pass
+            return None
+        route = _TaskRoute(conn, got["lease_id"], got["worker_id"])
+        with self.lock:
+            self.routes.append(route)
+        return route
+
+    def _release(self, wc, route: _TaskRoute) -> None:
+        with self.lock:
+            if route in self.routes:
+                self.routes.remove(route)
+        try:
+            wc.client.conn.request_threadsafe(
+                {"kind": "release_lease", "lease_id": route.lease_id})
+        except Exception:
+            pass
+        try:
+            wc.client.io.call_nowait(route.conn.close())
+        except Exception:
+            pass
+
+    def pick(self, wc, resources, env_hash, runtime_env) -> Optional[_TaskRoute]:
+        """Least-loaded live route; grows the pool synchronously whenever
+        every route is busy (one leased worker per concurrent task, the
+        reference's lease-per-pending-task shape — async growth would
+        serialize two parallel tasks onto one worker) and reaps idle
+        leases."""
+        now = time.monotonic()
+        with self.lock:
+            live = [r for r in self.routes if not r.conn.closed.is_set()]
+            # Reap idle leases — every one: a held lease pins a CPU the
+            # scheduler can't use for queued tasks or actor creation. Reaped
+            # routes leave the pool BEFORE selection so this submit can't
+            # ride a lease being handed back.
+            reap = [r for r in live
+                    if r.inflight == 0 and now - r.last_used > _LEASE_IDLE_S]
+            live = [r for r in live if r not in reap]
+            self.routes = live
+            for r in reap:
+                threading.Thread(target=self._release, args=(wc, r),
+                                 daemon=True).start()
+            best = min(live, key=lambda r: r.inflight, default=None)
+            lease_max = flags.get("RTPU_TASK_LEASE_MAX")
+            # acquiring counts toward the cap: N threads deciding to grow
+            # simultaneously must not overshoot lease_max between them.
+            need_grow = ((best is None
+                          or best.inflight >= _LEASE_PIPELINE)
+                         and len(live) + self.acquiring < lease_max
+                         and now >= self.next_try)
+            if need_grow:
+                self.acquiring += 1
+        if need_grow:
+            try:
+                got = self._acquire(wc, resources, env_hash, runtime_env)
+            finally:
+                with self.lock:
+                    self.acquiring -= 1
+            if got is not None:
+                best = got
+        return best
+
+    def shutdown(self, wc) -> None:
+        for r in list(self.routes):
+            self._release(wc, r)
+
+
+_task_pools: Dict[Tuple, _TaskRoutePool] = {}
+_task_pools_lock = threading.Lock()
+
+
+def _try_direct_task(wc, spec: Dict[str, Any], opts: Dict[str, Any]) -> bool:
+    """Push a plain task to a leased worker; False -> controller path."""
+    if (spec.get("pg") is not None
+            or spec.get("scheduling", {}).get("type") != "DEFAULT"
+            or spec.get("streaming")
+            or not flags.get("RTPU_TASK_LEASE_MAX")
+            or not flags.get("RTPU_DIRECT_DISPATCH")):
+        return False
+    # Deps guard: a leased worker BLOCKS in get_locations for unresolved
+    # deps while its lease pins a CPU — if the producer is still queued at
+    # the controller, that pin can starve it forever (the controller path
+    # waits for deps BEFORE dispatch, so it can't deadlock this way). Only
+    # push when every dep's location is already known locally; ship those
+    # as hints so the worker skips the controller lookup entirely.
+    deps = spec.get("deps") or ()
+    hints = {}
+    for d in deps:
+        loc = _local_locs.get(d)
+        if loc is None:
+            return False
+        hints[d] = loc
+    if hints:
+        spec["loc_hints"] = hints
+    resources = spec.get("resources") or {"CPU": 1.0}
+    env_hash = spec.get("env_hash") or ""
+    key = (wc.client.token, env_hash,
+           tuple(sorted(resources.items())))
+    with _task_pools_lock:
+        pool = _task_pools.get(key)
+        if pool is None:
+            pool = _task_pools[key] = _TaskRoutePool()
+    route = pool.pick(wc, resources, env_hash, spec.get("runtime_env"))
+    if route is None:
+        return False
+    with pool.lock:
+        route.inflight += 1
+        route.last_used = time.monotonic()
+    try:
+        fut = route.conn.request_threadsafe(
+            {"kind": "direct_task", "spec": spec})
+    except Exception:
+        with pool.lock:
+            route.inflight -= 1
+        return False
+    for oid in spec.get("return_ids", ()):
+        _inflight_direct[oid] = fut
+
+    def done(f, wc=wc, pool=pool, route=route, spec=spec):
+        with pool.lock:
+            route.inflight -= 1
+            route.last_used = time.monotonic()
+        for oid in spec.get("return_ids", ()):
+            _inflight_direct.pop(oid, None)
+        exc = f.exception()
+        if exc is None:
+            res = f.result() or {}
+            for loc in (res.get("locations") or ()):
+                _cache_loc(loc)
+            for loc in (res.get("error_locations") or ()):
+                _cache_loc(loc)
+        else:
+            # Worker/connection died mid-push. The direct attempt counts
+            # against max_retries exactly like a controller-tracked attempt
+            # (the task may have partially executed — re-running a
+            # max_retries=0 task would violate its at-most-once contract).
+            # Off the io thread: recovery issues blocking RPCs.
+            threading.Thread(
+                target=_direct_task_failure, args=(wc, pool, route, spec),
+                daemon=True, name="lease-recover").start()
+
+    fut.add_done_callback(done)
+    return True
+
+
+def _direct_task_failure(wc, pool: "_TaskRoutePool", route: "_TaskRoute",
+                         spec: Dict[str, Any]) -> None:
+    pool._release(wc, route)
+    retries = int(spec.get("max_retries", 0))
+    if retries > 0:
+        spec = dict(spec, max_retries=retries - 1)
+        try:
+            _pipelined_submit(wc, {"kind": "submit_task", "spec": spec},
+                              spec.get("return_ids", ()))
+        except Exception:
+            pass
+        return
+    import pickle as _p
+
+    from .controller import WorkerCrashedError
+    from .object_store import ObjectLocation
+
+    err = WorkerCrashedError(
+        f"worker {route.worker_id[:8]} died while running directly-pushed "
+        f"task {spec.get('label', '')} (no retries left)")
+    data = _p.dumps(err)
+    for oid in spec.get("return_ids", ()):
+        loc = ObjectLocation(object_id=oid, size=len(data), inline=data,
+                             is_error=True)
+        if oid not in _local_locs:
+            _cache_loc(loc)
+        try:
+            wc.client.request(
+                {"kind": "put_location", "loc": loc, "if_absent": True})
+        except Exception:
+            pass
 
 
 def _pipelined_submit(wc, msg: Dict[str, Any], return_ids) -> None:

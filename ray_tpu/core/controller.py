@@ -258,6 +258,11 @@ class Controller:
         self.named_pgs: Dict[str, str] = {}
         self.subs: Dict[str, List[protocol.Connection]] = {}  # pubsub channel -> conns
         self.driver_conns: Set[protocol.Connection] = set()
+        # Direct-dispatch worker leases (lease_id -> {worker_id, node_id,
+        # resources, owner conn}) and on-demand profiling collection state.
+        self._leases: Dict[str, Dict[str, Any]] = {}
+        self._profiles: Dict[str, Dict[str, str]] = {}
+        self._last_reclaim_nudge = 0.0
         # App-defined metrics (util/metrics.py): name -> {type, help,
         # boundaries, data {tags_tuple: value|histogram-state}}.
         self.app_metrics: Dict[str, dict] = {}
@@ -404,6 +409,11 @@ class Controller:
         if self._closing:
             return
         self.driver_conns.discard(conn)
+        # A departing driver's worker leases: resources return, but the
+        # workers are recycled (they may be executing orphaned pushes).
+        for lid, lease in list(self._leases.items()):
+            if lease["owner"] is conn:
+                self._release_lease(lid, to_idle=False)
         for node in self.nodes.values():
             if node.agent_conn is conn:
                 await self._on_node_death(node)
@@ -507,6 +517,12 @@ class Controller:
         node = self.nodes.get(w.node_id)
         if node:
             node.workers.discard(w.worker_id)
+        # A leased worker's death frees the lease's reserved resources; the
+        # holder notices via its broken direct connection and resubmits
+        # through the controller (tasks are retryable, unlike actor calls).
+        for lid, lease in list(self._leases.items()):
+            if lease["worker_id"] == w.worker_id:
+                self._release_lease(lid)
         # Fail — or retry — the running task (reference: task resubmission on
         # worker failure, core_worker/task_manager.h max_retries).
         if w.current_task and w.current_task in self.tasks:
@@ -714,7 +730,7 @@ class Controller:
                 if (dconn.writer.transport.get_write_buffer_size()
                         > 1 << 20):
                     continue
-                protocol.write_msg(dconn.writer, out)
+                dconn._buffered_write(dconn._frame(out))
             except Exception:
                 pass
         return None
@@ -1023,6 +1039,28 @@ class Controller:
         if spec is not None:
             self._release_task_resources(spec)
             self._record_lineage(spec, msg)
+        elif msg.get("spec") is not None:
+            # Directly-pushed (leased) task: the controller never saw the
+            # submission, so the completion report carries the spec — enough
+            # to register lineage (object reconstruction after node loss)
+            # and the task events. Resources stay pinned by the lease. The
+            # worker's start timestamp synthesizes the "running" event the
+            # timeline pairs with the terminal one.
+            if msg.get("started_ts"):
+                w_lease = self.workers.get(msg.get("worker_id", ""))
+                self.task_events.append({
+                    "task_id": msg["spec"].get("task_id"),
+                    "label": msg["spec"].get("label"),
+                    "actor_id": None,
+                    "event": "running",
+                    "ts": msg["started_ts"],
+                    "worker_id": msg.get("worker_id"),
+                    "node_id": w_lease.node_id if w_lease else None,
+                })
+            self._record_task_event(
+                msg["spec"], "failed" if msg.get("is_error") else "finished",
+                worker_id=msg.get("worker_id"))
+            self._record_lineage(msg["spec"], msg)
         self._wake_scheduler()
         return {"ok": True}
 
@@ -1179,6 +1217,76 @@ class Controller:
                                     node_id=actor.node_id)
             await w.conn.send({"kind": "execute_actor_task", "spec": spec})
 
+    # ---- worker leases for direct task dispatch -----------------------------
+    # Reference: direct_task_transport.h:75 — the owner leases a worker from
+    # the raylet, then pushes tasks to it directly; the lease pins the
+    # worker's resources until returned. Controller keeps directory/health/
+    # lineage; the per-call path is peer-to-peer.
+
+    async def _h_lease_worker(self, conn, msg):
+        """Grant an idle worker to the requesting driver for direct task
+        pushes. Returns {lease_id, worker_id, host, port} or {lease_id:
+        None} when nothing is available (caller falls back to the queued
+        controller path, which can also spawn new workers)."""
+        resources: Dict[str, float] = msg.get("resources") or {"CPU": 1.0}
+        env_hash = msg.get("env_hash") or ""
+        needs_tpu = resources.get("TPU", 0) > 0
+        for node in sorted(self.nodes.values(), key=lambda n: n.index):
+            if not node.alive or not _res_fits(node.available, resources):
+                continue
+            w = self._find_idle_worker(node, needs_tpu, env_hash)
+            if w is None or not w.direct_port:
+                continue
+            _res_sub(node.available, resources)
+            w.state = "leased"
+            lease_id = uuid.uuid4().hex[:12]
+            self._leases[lease_id] = {"worker_id": w.worker_id,
+                                      "node_id": node.node_id,
+                                      "resources": dict(resources),
+                                      "owner": conn}
+            peer = w.conn.writer.get_extra_info("peername")
+            host = peer[0] if peer else "127.0.0.1"
+            return {"lease_id": lease_id, "worker_id": w.worker_id,
+                    "host": host, "port": w.direct_port}
+        # Nothing idle: nudge a spawn so a later lease request can succeed.
+        for node in sorted(self.nodes.values(), key=lambda n: n.index):
+            if node.alive and _res_fits(node.available, resources):
+                self._maybe_spawn_worker(node, needs_tpu,
+                                         msg.get("runtime_env"))
+                break
+        return {"lease_id": None}
+
+    def _release_lease(self, lease_id: str, to_idle: bool = True) -> None:
+        """to_idle=False: the holder vanished without draining (driver
+        disconnect) — the worker may still be executing an orphaned pushed
+        task, so it is recycled rather than re-leased/scheduled (marking it
+        idle would double-book its CPU)."""
+        lease = self._leases.pop(lease_id, None)
+        if lease is None:
+            return
+        node = self.nodes.get(lease["node_id"])
+        if node is not None and node.alive:
+            _res_add(node.available, lease["resources"])
+        w = self.workers.get(lease["worker_id"])
+        if w is not None and w.state == "leased":
+            if to_idle:
+                w.state = "idle"
+            else:
+                w.state = "dying"
+                asyncio.get_running_loop().create_task(
+                    self._shutdown_worker(w))
+        self._wake_scheduler()
+
+    async def _shutdown_worker(self, w: WorkerInfo) -> None:
+        try:
+            await w.conn.send({"kind": "shutdown"})
+        except Exception:
+            pass
+
+    async def _h_release_lease(self, conn, msg):
+        self._release_lease(msg["lease_id"])
+        return {"ok": True}
+
     async def _h_resolve_actor(self, conn, msg):
         """Lease-resolution for direct dispatch: where does this actor live?
 
@@ -1189,6 +1297,12 @@ class Controller:
         actor = self.actors.get(msg["actor_id"])
         if actor is None:
             raise ValueError(f"unknown actor {msg['actor_id']}")
+        # A just-created actor is usually mid-instantiation on its worker:
+        # wait briefly for aliveness so the FIRST call can already go
+        # direct (the caller pays instantiation latency either way).
+        deadline = time.monotonic() + float(msg.get("wait", 1.0))
+        while actor.state == "pending" and time.monotonic() < deadline:
+            await asyncio.sleep(0.02)
         w = self.workers.get(actor.worker_id or "")
         direct = None
         if actor.state == "alive" and w is not None and w.direct_port:
@@ -1354,6 +1468,37 @@ class Controller:
         ns = msg.get("ns", "")
         prefix = msg.get("prefix", "")
         return [k for (n, k) in self.kv if n == ns and k.startswith(prefix)]
+
+    async def _h_profile_workers(self, conn, msg):
+        """On-demand cluster profiling (reference: dashboard-triggered
+        py-spy stack dumps, dashboard/modules/reporter): push a stack-dump
+        request to every live worker, gather replies for up to `timeout`
+        seconds, return {worker_id: all-thread stack text}. Workers that
+        are busy in native code simply miss the window — partial results
+        are returned, never an error."""
+        req_id = uuid.uuid4().hex[:12]
+        profiles = self._profiles
+        profiles[req_id] = {}
+        targets = []
+        for w in list(self.workers.values()):
+            try:
+                await w.conn.send({"kind": "stack_dump", "req_id": req_id})
+                targets.append(w.worker_id)
+            except Exception:
+                pass
+        timeout = float(msg.get("timeout", 2.0))
+        deadline = time.monotonic() + timeout
+        while (len(profiles[req_id]) < len(targets)
+               and time.monotonic() < deadline):
+            await asyncio.sleep(0.05)
+        return {"req_id": req_id, "requested": len(targets),
+                "workers": profiles.pop(req_id)}
+
+    async def _h_profile_result(self, conn, msg):
+        bucket = self._profiles.get(msg["req_id"])
+        if bucket is not None:
+            bucket[msg["worker_id"]] = msg["text"]
+        return {"ok": True}
 
     async def _h_subscribe(self, conn, msg):
         self.subs.setdefault(msg["channel"], []).append(conn)
@@ -2022,6 +2167,7 @@ class Controller:
         # One group = one placement signature: place from the head until
         # the first failure, then the rest of the group is infeasible for
         # this pass too (identical asks). See _PendingQueue docstring.
+        stuck = False
         for sig in list(self.pending_queue.groups):
             q = self.pending_queue.groups.get(sig)
             while q:
@@ -2032,11 +2178,35 @@ class Controller:
                     continue
                 placed = await self._try_place(spec)
                 if not placed:
+                    stuck = True
                     break
                 q.popleft()
                 self.pending_queue._count -= 1
             if q is not None and not q:
                 self.pending_queue.groups.pop(sig, None)
+        if stuck:
+            await self._nudge_lease_reclaim()
+
+    async def _nudge_lease_reclaim(self) -> None:
+        """Work is queued but unplaceable while drivers hold task leases:
+        ask each holder to give back idle leases (it releases any with no
+        in-flight pushes). Holder-coordinated, so no double-booking — the
+        reference's lease revocation works the same way via ReturnWorker."""
+        leases = self._leases
+        if not leases:
+            return
+        now = time.monotonic()
+        if now - self._last_reclaim_nudge < 0.2:
+            return
+        self._last_reclaim_nudge = now
+        owners: Dict[Any, List[str]] = {}
+        for lid, lease in leases.items():
+            owners.setdefault(lease["owner"], []).append(lid)
+        for conn, lids in owners.items():
+            try:
+                await conn.send({"kind": "lease_reclaim", "lease_ids": lids})
+            except Exception:
+                pass
 
     def _eligible_nodes(self, spec) -> List[NodeInfo]:
         strategy = spec.get("scheduling", {"type": "DEFAULT"})

@@ -18,6 +18,7 @@ import os
 import pickle
 import queue
 import threading
+import time
 import traceback
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Dict, List, Optional
@@ -248,22 +249,42 @@ class WorkerRuntime:
         locations with zero controller involvement."""
         import asyncio
 
-        if msg["kind"] != "direct_actor_task":
-            raise ValueError(f"direct server: unknown kind {msg['kind']!r}")
         spec = msg["spec"]
         if spec.get("streaming"):
             # Generator state lives in the controller; a direct streaming
             # call would hang the caller's future forever.
             raise ValueError("streaming calls must go through the controller")
+        # The executing thread POPS "__direct__" when it finishes — bind the
+        # future to a local BEFORE handing the spec over, or a fast task
+        # completes (and pops) before this coroutine evaluates the
+        # subscript and the await raises KeyError.
+        loop = asyncio.get_running_loop()
+        fut = loop.create_future()
+        if msg["kind"] == "direct_task":
+            # Leased stateless task (reference direct_task_transport.h:222):
+            # executes SERIALLY — the lease reserves one CPU, so pushed
+            # tasks queue here instead of fanning out over the pool.
+            spec["__direct__"] = (fut, loop)
+            spec["__leased__"] = True
+            self._lease_pool().submit(self.run_task, spec)
+            return await fut
+        if msg["kind"] != "direct_actor_task":
+            raise ValueError(f"direct server: unknown kind {msg['kind']!r}")
         mb = self.actors.get(spec["actor_id"])
         if mb is None:
             raise ActorDiedError(
                 f"actor {spec['actor_id'][:8]} is not hosted on this worker "
                 f"(died or restarted elsewhere)")
-        spec["__direct__"] = (asyncio.get_running_loop().create_future(),
-                              asyncio.get_running_loop())
+        spec["__direct__"] = (fut, loop)
         mb.submit(spec)
-        return await spec["__direct__"][0]
+        return await fut
+
+    def _lease_pool(self) -> ThreadPoolExecutor:
+        pool = getattr(self, "_lease_exec", None)
+        if pool is None:
+            pool = self._lease_exec = ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="lease")
+        return pool
 
     def _finish_direct(self, spec: Dict[str, Any], payload: Dict[str, Any]) -> bool:
         """Resolve a direct caller's future; returns True if this spec came
@@ -295,9 +316,29 @@ class WorkerRuntime:
                 mb.submit(spec)
         elif kind == "shutdown":
             self.shutdown_event.set()
+        elif kind == "stack_dump":
+            # On-demand profiling (reference: reporter agent py-spy dump):
+            # format every thread's current stack and reply off the event
+            # loop (client.request blocks).
+            text = self._format_stacks()
+            threading.Thread(
+                target=lambda: self.client.request(
+                    {"kind": "profile_result", "req_id": msg["req_id"],
+                     "worker_id": self.worker_id, "text": text}),
+                daemon=True).start()
         elif kind == "pubsub":
             ctx.deliver_pubsub(msg["channel"], msg["data"])
         return None
+
+    def _format_stacks(self) -> str:
+        import sys
+
+        names = {t.ident: t.name for t in threading.enumerate()}
+        parts = [f"pid={os.getpid()} worker={self.worker_id}"]
+        for tid, frame in sys._current_frames().items():
+            parts.append(f"--- thread {names.get(tid, '?')} ({tid}) ---")
+            parts.append("".join(traceback.format_stack(frame)))
+        return "\n".join(parts)
 
     # -------------------------------------------------------------- execution
 
@@ -339,6 +380,11 @@ class WorkerRuntime:
         mailbox: Optional["ActorMailbox"] = None,
     ) -> None:
         task_id = spec["task_id"]
+        if spec.get("__leased__"):
+            # Directly-pushed task: the controller never saw a "running"
+            # event — the completion report carries the start time so the
+            # timeline can synthesize the full span.
+            spec["__start_ts__"] = time.time()
         tls = ctx.task_local
         tls.task_id = task_id
         tls.label = spec.get("label", "")
@@ -420,6 +466,12 @@ class WorkerRuntime:
             "locations": locations,
         }
         self._finish_direct(spec, {"locations": locations})
+        if spec.pop("__leased__", False):
+            # The controller never saw this (directly-pushed) spec; ship it
+            # with the completion so lineage + task events stay complete.
+            msg["spec"] = {k: v for k, v in spec.items()
+                           if not k.startswith("__")}
+            msg["started_ts"] = spec.get("__start_ts__")
         # Fire-and-forget: nothing consumes the ack, and the worker is not
         # eligible for new work until the controller processes this message
         # anyway (state flips to idle there) — so dropping the round trip
@@ -454,8 +506,13 @@ class WorkerRuntime:
             "task_id": spec["task_id"],
             "worker_id": self.worker_id,
             "error_locations": err_locs,
+            "is_error": True,
         }
         self._finish_direct(spec, {"error_locations": err_locs})
+        if spec.pop("__leased__", False):
+            msg["spec"] = {k: v for k, v in spec.items()
+                           if not k.startswith("__")}
+            msg["started_ts"] = spec.get("__start_ts__")
         try:
             self.client.send_nowait(msg)
         except Exception:

@@ -180,6 +180,15 @@ class Dashboard:
                 data = serve_status()
             elif kind == "timeline":
                 data = state_api.timeline()
+            elif kind == "profile":
+                # Dashboard-triggered stack capture (reference: reporter
+                # py-spy endpoint); in an executor — it blocks up to
+                # `timeout` while workers reply.
+                import asyncio as _aio
+
+                t = float(request.query.get("timeout", 1.0))
+                data = await _aio.get_running_loop().run_in_executor(
+                    None, lambda: state_api.profile_workers(t))
             elif kind == "usage":
                 data = _local_usage()
             else:

@@ -15,6 +15,14 @@ class DataContext:
     # map stage (reference: ConcurrencyCapBackpressurePolicy +
     # ReservationOpResourceAllocator, resource_manager.py:29).
     max_tasks_in_flight: int = 8
+    # Memory-aware backpressure (reference ReservationOpResourceAllocator,
+    # resource_manager.py:259): when the local object-store arena is more
+    # than memory_high_water full, map stages shrink their in-flight cap to
+    # memory_pressure_cap so a fast producer drains into a slow consumer
+    # through bounded memory instead of filling the arena and leaning on
+    # spilling. 0 disables the check.
+    memory_high_water: float = 0.75
+    memory_pressure_cap: int = 2
     preserve_order: bool = True
     default_batch_format: str = "numpy"
     # Shuffle fan-out (#output partitions defaults to #input blocks).

@@ -187,17 +187,49 @@ class StreamingExecutor:
             (remote_fn.remote(ref) for ref in inputs), label, None
         )
 
+    _PRESSURE_TTL_S = 0.05
+
+    def _store_pressure(self) -> float:
+        """Local object-store arena fill fraction (0.0 when no native arena
+        is attached — e.g. inline-only stores). Sampled at most every
+        _PRESSURE_TTL_S: this sits on the per-submission hot path and the
+        reading can't move meaningfully faster than tasks complete."""
+        now = time.perf_counter()
+        cached = getattr(self, "_pressure_cache", None)
+        if cached is not None and now - cached[0] < self._PRESSURE_TTL_S:
+            return cached[1]
+        try:
+            from ray_tpu.core import native_store
+
+            arena = native_store.get_arena()
+            if arena is None:
+                p = 0.0
+            else:
+                s = arena.stats()
+                p = s["used"] / max(1, s["capacity"])
+        except Exception:
+            p = 0.0
+        self._pressure_cache = (now, p)
+        return p
+
     def _bounded_submit(self, submissions: Iterator[Any], label: str,
                         total: Optional[int]) -> Iterator[Any]:
         """Cap in-flight tasks; yield refs in submission (FIFO) order when
-        preserve_order else completion order."""
-        cap = self.ctx.max_tasks_in_flight
+        preserve_order else completion order. The cap is concurrency-based
+        normally and shrinks under object-store memory pressure (see
+        DataContext.memory_high_water) so block production stays bounded by
+        downstream consumption, not by spilling capacity."""
+        base_cap = self.ctx.max_tasks_in_flight
+        high_water = self.ctx.memory_high_water
         t0 = time.perf_counter()
         n = 0
         pending: List[Any] = []
         preserve = self.ctx.preserve_order
         for ref in submissions:
             pending.append(ref)
+            cap = base_cap
+            if high_water and self._store_pressure() >= high_water:
+                cap = min(base_cap, max(1, self.ctx.memory_pressure_cap))
             while len(pending) >= cap:
                 if preserve:
                     out, pending = pending[0], pending[1:]

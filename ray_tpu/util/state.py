@@ -42,6 +42,14 @@ def list_objects(limit: int = 1000) -> List[Dict[str, Any]]:
     return _req({"kind": "list_state", "what": "objects", "limit": limit})
 
 
+def profile_workers(timeout: float = 2.0) -> Dict[str, Any]:
+    """On-demand all-thread stack dump from every live worker (reference:
+    dashboard reporter's py-spy stack capture, `ray stack`). Returns
+    {"requested": N, "workers": {worker_id: stack text}} — workers stuck
+    in native code miss the window and are simply absent."""
+    return _req({"kind": "profile_workers", "timeout": timeout})
+
+
 def summarize_tasks() -> Dict[str, Dict[str, int]]:
     """Per-function counts of task events (reference: `ray summary tasks`)."""
     return _req({"kind": "list_state", "what": "summary"})

@@ -213,3 +213,60 @@ def test_read_streams_blocks_incrementally():
     t_first = _time.perf_counter() - t0
     assert list(first["x"]) == [0] * 8
     assert t_first < 1.0, f"first block took {t_first:.2f}s — reads not streaming"
+
+
+def test_memory_pressure_shrinks_inflight(monkeypatch):
+    """Under synthetic arena pressure, _bounded_submit caps in-flight tasks
+    at memory_pressure_cap instead of max_tasks_in_flight (reference:
+    ReservationOpResourceAllocator's memory-aware throttling)."""
+    import time as _time
+
+    import ray_tpu
+    from ray_tpu import data as rd
+    from ray_tpu.data.context import DataContext
+    from ray_tpu.data.executor import StreamingExecutor
+
+    ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
+    ctx = DataContext.get_current()
+    old = (ctx.max_tasks_in_flight, ctx.memory_high_water,
+           ctx.memory_pressure_cap, ctx.preserve_order)
+    try:
+        ctx.max_tasks_in_flight = 8
+        ctx.memory_high_water = 0.75
+        ctx.memory_pressure_cap = 2
+        # completion-order drain passes the FULL pending list to wait(), so
+        # the spy below observes the true in-flight count.
+        ctx.preserve_order = False
+
+        submitted = []
+        monkeypatch.setattr(StreamingExecutor, "_store_pressure",
+                            lambda self: 1.0)
+        orig_wait = ray_tpu.wait
+
+        peak = {"v": 0}
+
+        def counting_wait(refs, **kw):
+            # pending size just before a drain = in-flight count.
+            peak["v"] = max(peak["v"], len(refs))
+            return orig_wait(refs, **kw)
+
+        monkeypatch.setattr(
+            "ray_tpu.data.executor.rt.wait", counting_wait)
+
+        def slow(batch):
+            _time.sleep(0.01)
+            return batch
+
+        out = rd.range(32, parallelism=16).map_batches(slow).take_all()
+        assert len(out) == 32
+        assert 1 <= peak["v"] <= 2, peak["v"]
+    finally:
+        (ctx.max_tasks_in_flight, ctx.memory_high_water,
+         ctx.memory_pressure_cap, ctx.preserve_order) = old
+
+
+def test_store_pressure_bounds():
+    from ray_tpu.data.executor import StreamingExecutor
+
+    p = StreamingExecutor()._store_pressure()
+    assert 0.0 <= p <= 1.0

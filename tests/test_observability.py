@@ -148,3 +148,25 @@ def test_worker_prints_reach_driver(ray_start_regular, capfd):
             return
         time.sleep(0.2)
     raise AssertionError("worker print never reached the driver console")
+
+
+def test_profile_workers_stack_dump(ray_start_regular):
+    """On-demand profiling: a worker blocked in user code shows that code
+    in its stack dump (reference: `ray stack` / dashboard reporter py-spy
+    capture)."""
+    import ray_tpu
+    from ray_tpu.util import state as state_api
+
+    @ray_tpu.remote
+    def distinctive_sleeper_frame():
+        time.sleep(3.0)
+        return 1
+
+    ref = distinctive_sleeper_frame.remote()
+    time.sleep(0.8)  # let the task start
+    out = state_api.profile_workers(timeout=3.0)
+    assert out["requested"] >= 1
+    blob = "\n".join(out["workers"].values())
+    assert "--- thread" in blob
+    assert "distinctive_sleeper_frame" in blob
+    assert ray_tpu.get(ref) == 1
