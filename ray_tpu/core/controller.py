@@ -2406,9 +2406,42 @@ class Controller:
             import json as _json
 
             env["RTPU_RUNTIME_ENV"] = _json.dumps(runtime_env)
-        if runtime_env and runtime_env.get("pip"):
-            # venv materialization can take tens of seconds: run it off the
-            # event loop, then launch with the venv's interpreter.
+        if runtime_env and runtime_env.get("container"):
+            # Worker-in-container (reference runtime_env/container.py):
+            # wrap the launch in the configured container runtime. A
+            # missing runtime binary fails the env's tasks with a clear
+            # error instead of a silent uncontained spawn.
+            async def _spawn_container():
+                from . import runtime_env as renv
+
+                cmd = renv.container_command(
+                    runtime_env, [sys.executable, "-m",
+                                  "ray_tpu.core.worker_main"])
+                try:
+                    proc = subprocess.Popen(
+                        cmd, env=env,
+                        stdout=self._worker_log_file(spawn_token),
+                        stderr=subprocess.STDOUT)
+                except OSError as e:
+                    node.spawning = max(0, node.spawning - 1)
+                    self._release_env_spawn(node, spawn_token)
+                    self._fail_env_tasks(
+                        runtime_env.get("hash", ""),
+                        RuntimeError(
+                            f"container runtime {cmd[0]!r} unavailable: "
+                            f"{e}"))
+                    self._wake_scheduler()
+                    return
+                self._spawned_procs[spawn_token] = proc
+                asyncio.get_running_loop().create_task(
+                    self._watch_spawn(node.node_id, spawn_token, proc))
+
+            asyncio.get_running_loop().create_task(_spawn_container())
+            return
+        if runtime_env and (runtime_env.get("pip")
+                            or runtime_env.get("conda")):
+            # venv/conda materialization can take tens of seconds: run it
+            # off the event loop, then launch with that env's interpreter.
             async def _spawn_with_venv():
                 from . import runtime_env as renv
 
@@ -2416,7 +2449,8 @@ class Controller:
                     python = await asyncio.to_thread(
                         renv.spawner_python, runtime_env)
                 except Exception as e:
-                    sys.stderr.write(f"[controller] pip env failed: {e!r}\n")
+                    sys.stderr.write(
+                        f"[controller] runtime env build failed: {e!r}\n")
                     node.spawning = max(0, node.spawning - 1)
                     if spawn_token in self._tpu_spawn_tokens:
                         self._tpu_spawn_tokens.discard(spawn_token)

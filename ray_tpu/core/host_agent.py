@@ -127,14 +127,18 @@ class HostAgent:
         kind = msg["kind"]
         if kind == "spawn_worker":
             renv = msg.get("runtime_env")
-            if renv and renv.get("pip"):
-                # venv creation takes seconds: keep the agent loop live.
+            if renv and (renv.get("pip") or renv.get("conda")):
+                # venv/conda creation takes seconds: keep the agent loop
+                # live (same pip-or-conda gate as the controller's local
+                # spawn path — they must not diverge or one side silently
+                # launches env-hashed workers without the env).
                 from .runtime_env import spawner_python
 
                 try:
                     python = await asyncio.to_thread(spawner_python, renv)
                 except Exception as e:
-                    sys.stderr.write(f"[host_agent] pip env failed: {e!r}\n")
+                    sys.stderr.write(
+                        f"[host_agent] runtime env build failed: {e!r}\n")
                     await self.ctrl.send(
                         {"kind": "spawn_exited",
                          "spawn_token": msg["spawn_token"],
@@ -197,8 +201,14 @@ class HostAgent:
         from .worker_logs import worker_log_file
 
         log_f = worker_log_file(spawn_token)
+        cmd = [python or sys.executable, "-m", "ray_tpu.core.worker_main"]
+        renv_spec = msg.get("runtime_env")
+        if renv_spec and renv_spec.get("container"):
+            from .runtime_env import container_command
+
+            cmd = container_command(renv_spec, cmd)
         proc = subprocess.Popen(
-            [python or sys.executable, "-m", "ray_tpu.core.worker_main"],
+            cmd,
             env=env,
             stdout=log_f,
             stderr=subprocess.STDOUT if log_f else None,

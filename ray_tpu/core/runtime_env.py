@@ -83,6 +83,31 @@ def normalize(runtime_env: Optional[Dict[str, Any]], client) -> Optional[Dict[st
     env_vars = runtime_env.get("env_vars")
     if env_vars:
         out["env_vars"] = {str(k): str(v) for k, v in env_vars.items()}
+    conda = runtime_env.get("conda")
+    if conda:
+        if runtime_env.get("pip"):
+            raise ValueError(
+                "runtime_env cannot set both 'pip' and 'conda' (reference "
+                "semantics: pip installs into the conda env via the conda "
+                "spec's own pip section)")
+        # str = existing named env; dict = environment.yml-style spec built
+        # per content hash (reference _private/runtime_env/conda.py).
+        out["conda"] = conda if isinstance(conda, str) else dict(conda)
+    container = runtime_env.get("container")
+    if container:
+        if isinstance(container, str):  # common shorthand: just the image
+            container = {"image": container}
+        img = container.get("image")
+        if not img:
+            raise ValueError("runtime_env 'container' requires an 'image'")
+        if runtime_env.get("pip") or runtime_env.get("conda"):
+            raise ValueError(
+                "runtime_env 'container' cannot combine with 'pip'/'conda' "
+                "(reference semantics: the image brings its own "
+                "environment)")
+        out["container"] = {"image": str(img),
+                            "run_options":
+                                list(container.get("run_options") or ())}
     if not out:
         return None
     out["hash"] = env_hash(out)
@@ -92,7 +117,8 @@ def normalize(runtime_env: Optional[Dict[str, Any]], client) -> Optional[Dict[st
 def env_hash(norm: Dict[str, Any]) -> str:
     payload = json.dumps(
         {k: norm[k] for k in
-         ("working_dir_uri", "py_module_uris", "pip", "env_vars")
+         ("working_dir_uri", "py_module_uris", "pip", "env_vars",
+          "conda", "container")
          if k in norm},
         sort_keys=True,
     )
@@ -307,8 +333,104 @@ def _build_pip_env(pip: List[str], root: str, py: str, tag: str) -> str:
     return py
 
 
+_conda_env_lock = _threading.Lock()
+
+
+def ensure_conda_env(spec) -> str:
+    """Python interpreter for a conda runtime env (reference:
+    _private/runtime_env/conda.py). A str names an existing env; a dict is
+    an environment.yml-style spec materialized per content hash via
+    ``conda env create`` and cached like pip envs. Requires a ``conda``
+    binary on PATH (gated: zero-egress TPU pod images often ship without
+    one — the error says so instead of failing mid-spawn)."""
+    import shutil as _shutil
+
+    conda = _shutil.which("conda")
+    if conda is None:
+        raise RuntimeError(
+            "runtime_env requested a conda env but no 'conda' binary is on "
+            "PATH; install conda/miniconda on every node or use the 'pip' "
+            "runtime env instead")
+    if isinstance(spec, str):
+        out = subprocess.run(
+            [conda, "run", "-n", spec, "python", "-c",
+             "import sys; print(sys.executable)"],
+            capture_output=True, text=True, timeout=120)
+        if out.returncode != 0:
+            raise RuntimeError(
+                f"conda env {spec!r} not usable: {out.stderr[-300:]}")
+        return out.stdout.strip().splitlines()[-1]
+    key = hashlib.sha256(
+        json.dumps(spec, sort_keys=True).encode()).hexdigest()[:16]
+    root = os.path.join(_cache_root(), f"conda_{key}")
+    py = os.path.join(root, "bin", "python")
+    marker = os.path.join(root, ".rtpu_ready")
+    if os.path.exists(marker):
+        return py
+    with _conda_env_lock:
+        if os.path.exists(marker):
+            return py
+        import uuid as _uuid
+
+        tmp = root + f".tmp{_uuid.uuid4().hex[:8]}"
+        # JSON is valid YAML: no PyYAML dependency needed for the spec file.
+        with tempfile.NamedTemporaryFile(
+                "w", suffix=".yml", delete=False) as f:
+            json.dump(spec, f)
+            spec_file = f.name
+        try:
+            # Build into a tmp prefix, rename when complete: a failed/
+            # interrupted create must not poison the cache entry (conda
+            # refuses to create into an existing prefix), and the atomic
+            # rename also covers cross-process races the in-process lock
+            # cannot (same pattern as _build_pip_env).
+            subprocess.run(
+                [conda, "env", "create", "-p", tmp, "-f", spec_file],
+                check=True, capture_output=True, timeout=1800)
+        except subprocess.CalledProcessError as e:
+            import shutil as _shutil
+
+            _shutil.rmtree(tmp, ignore_errors=True)
+            raise RuntimeError(
+                f"conda env create failed: "
+                f"{(e.stderr or b'').decode()[-500:]}") from e
+        finally:
+            os.unlink(spec_file)
+        open(os.path.join(tmp, ".rtpu_ready"), "w").close()
+        try:
+            os.rename(tmp, root)
+        except OSError:
+            import shutil as _shutil
+
+            _shutil.rmtree(tmp, ignore_errors=True)
+        return py
+
+
+def container_command(norm: Dict[str, Any], worker_cmd: List[str],
+                      *, runtime: Optional[str] = None) -> List[str]:
+    """Wrap a worker launch command for container isolation (reference:
+    _private/runtime_env/container.py worker-in-podman). The runtime
+    binary comes from RTPU_CONTAINER_RUNTIME; host networking + the env
+    cache mount keep the control plane and runtime-env caches reachable
+    from inside."""
+    from ray_tpu import flags
+
+    runtime = runtime or flags.get("RTPU_CONTAINER_RUNTIME")
+    c = norm["container"]
+    cache = _cache_root()
+    return [
+        runtime, "run", "--rm", "--network=host",
+        "-v", f"{cache}:{cache}",
+        "-v", "/dev/shm:/dev/shm",
+        *c.get("run_options", ()),
+        c["image"], *worker_cmd,
+    ]
+
+
 def spawner_python(norm: Optional[Dict[str, Any]]) -> str:
     """Interpreter to launch a worker with for this runtime env."""
+    if norm and norm.get("conda"):
+        return ensure_conda_env(norm["conda"])
     if norm and norm.get("pip"):
         try:
             return ensure_pip_env(norm["pip"])

@@ -69,6 +69,9 @@ _define("RTPU_TPU_WORKER", bool, False,
 _define("RTPU_DIRECT_DISPATCH", bool, True,
         "Push actor calls directly to the hosting worker (lease-then-push); "
         "0 routes every call through the controller.")
+_define("RTPU_CONTAINER_RUNTIME", str, "podman",
+        "Container runtime binary used to wrap worker launches when a "
+        "runtime_env requests 'container' (reference: worker-in-podman).")
 _define("RTPU_TASK_LEASE_MAX", int, 16,
         "Max leased workers per (resources, env) signature for direct "
         "stateless-task dispatch; 0 disables task leasing entirely.")
