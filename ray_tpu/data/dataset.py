@@ -290,7 +290,15 @@ class Dataset:
         refs = list(ex.execute(self._ops))
         if refs:
             rt.wait(refs, num_returns=len(refs))
-        lines = [f"{name}: {wall:.3f}s over {cnt} blocks" for name, wall, cnt in ex.stats]
+        lines = []
+        for st in ex.stats:
+            rate = st["blocks"] / st["wall_s"] if st["wall_s"] > 0 else 0.0
+            line = (f"{st['operator']}: {st['wall_s']:.3f}s over "
+                    f"{st['blocks']} blocks ({rate:.1f} blocks/s)")
+            if st["peak_store_pressure"] >= 0.005:
+                line += (f", peak store pressure "
+                         f"{st['peak_store_pressure'] * 100:.1f}%")
+            lines.append(line)
         return "\n".join(lines) or "(no stages executed)"
 
     def __repr__(self) -> str:

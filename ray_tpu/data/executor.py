@@ -90,7 +90,10 @@ class _PoolWorker:
 class StreamingExecutor:
     def __init__(self, ctx: Optional[DataContext] = None):
         self.ctx = ctx or DataContext.get_current()
-        self.stats: List[Tuple[str, float, int]] = []  # (stage, wall_s, blocks)
+        # Per-op execution stats (reference: _StatsActor / DatasetStats):
+        # per-operator wall time, block count, and peak object-store
+        # pressure observed while the stage ran.
+        self.stats: List[Dict[str, Any]] = []
 
     # -- public ---------------------------------------------------------------
 
@@ -154,19 +157,21 @@ class StreamingExecutor:
             cap = max(1, self.ctx.max_tasks_in_flight)
             it = iter(tasks)
             pending: "collections.deque" = collections.deque()
-            for t in it:
-                pending.append(do_read.remote(t))
-                if len(pending) >= cap:
-                    break
-            while pending:
-                gen = pending.popleft()
-                for ref in gen:
-                    n += 1
-                    yield ref
-                nxt = next(it, None)
-                if nxt is not None:
-                    pending.append(do_read.remote(nxt))
-            self.stats.append(("read", time.perf_counter() - t0, n))
+            try:
+                for t in it:
+                    pending.append(do_read.remote(t))
+                    if len(pending) >= cap:
+                        break
+                while pending:
+                    gen = pending.popleft()
+                    for ref in gen:
+                        n += 1
+                        yield ref
+                    nxt = next(it, None)
+                    if nxt is not None:
+                        pending.append(do_read.remote(nxt))
+            finally:  # early-stopping consumers (Limit) must still report
+                self._record_stat("read", time.perf_counter() - t0, n)
 
         return stream()
 
@@ -188,6 +193,12 @@ class StreamingExecutor:
         )
 
     _PRESSURE_TTL_S = 0.05
+
+    def _record_stat(self, label: str, wall_s: float, blocks: int,
+                     peak_pressure: float = 0.0) -> None:
+        self.stats.append({"operator": label, "wall_s": wall_s,
+                           "blocks": blocks,
+                           "peak_store_pressure": peak_pressure})
 
     def _store_pressure(self) -> float:
         """Local object-store arena fill fraction (0.0 when no native arena
@@ -223,32 +234,46 @@ class StreamingExecutor:
         high_water = self.ctx.memory_high_water
         t0 = time.perf_counter()
         n = 0
+        peak_pressure = 0.0
         pending: List[Any] = []
         preserve = self.ctx.preserve_order
-        for ref in submissions:
-            pending.append(ref)
-            cap = base_cap
-            if high_water and self._store_pressure() >= high_water:
-                cap = min(base_cap, max(1, self.ctx.memory_pressure_cap))
-            while len(pending) >= cap:
+        try:
+            for ref in submissions:
+                pending.append(ref)
+                cap = base_cap
+                pressure = self._store_pressure() if high_water else 0.0
+                peak_pressure = max(peak_pressure, pressure)
+                if high_water and pressure >= high_water:
+                    cap = min(base_cap, max(1, self.ctx.memory_pressure_cap))
+                while len(pending) >= cap:
+                    if preserve:
+                        out, pending = pending[0], pending[1:]
+                        rt.wait([out], num_returns=1)
+                    else:
+                        ready, pending = rt.wait(pending, num_returns=1)
+                        out = ready[0]
+                    n += 1
+                    yield out
+            while pending:
                 if preserve:
                     out, pending = pending[0], pending[1:]
                     rt.wait([out], num_returns=1)
                 else:
                     ready, pending = rt.wait(pending, num_returns=1)
                     out = ready[0]
+                # Drain-phase pressure matters too: the tail blocks are
+                # still materializing into the store.
+                if high_water:
+                    peak_pressure = max(peak_pressure,
+                                        self._store_pressure())
                 n += 1
                 yield out
-        while pending:
-            if preserve:
-                out, pending = pending[0], pending[1:]
-                rt.wait([out], num_returns=1)
-            else:
-                ready, pending = rt.wait(pending, num_returns=1)
-                out = ready[0]
-            n += 1
-            yield out
-        self.stats.append((label, time.perf_counter() - t0, n))
+        finally:
+            # finally, not fallthrough: a downstream stage that stops
+            # pulling early (Limit) raises GeneratorExit here — the stage
+            # still ran and must still report.
+            self._record_stat(label, time.perf_counter() - t0, n,
+                              peak_pressure=peak_pressure)
 
     def _actor_pool_stage(self, inputs: Iterator[Any], op: L.MapBatches) -> Iterator[Any]:
         """Fixed/bounded actor pool (reference: ActorPoolMapOperator + _ActorPool
@@ -312,8 +337,8 @@ class StreamingExecutor:
                     rt.kill(a)
                 except Exception:
                     pass
-            self.stats.append((f"ActorPool[{type(op.fn).__name__}]",
-                               time.perf_counter() - t0, n))
+            self._record_stat(f"ActorPool[{type(op.fn).__name__}]",
+                              time.perf_counter() - t0, n)
 
     # -- all-to-all -----------------------------------------------------------
 

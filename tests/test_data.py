@@ -270,3 +270,13 @@ def test_store_pressure_bounds():
 
     p = StreamingExecutor()._store_pressure()
     assert 0.0 <= p <= 1.0
+
+
+def test_dataset_stats_per_op():
+    out = rd.range(32, parallelism=4).map_batches(lambda b: b).stats()
+    assert "read:" in out and "MapBatches:" in out
+    assert "blocks/s" in out
+    # Early-stopping consumers still report every stage that ran.
+    out2 = rd.range(100, parallelism=4).map_batches(lambda b: b) \
+        .limit(5).stats()
+    assert "read:" in out2 and "MapBatches:" in out2
