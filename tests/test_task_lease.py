@@ -72,3 +72,30 @@ def test_idle_lease_released(ray_start_regular, monkeypatch):
     time.sleep(0.5)            # release happens on a helper thread
     for p in pools:
         assert len(p.routes) <= 1  # all but the warm route reaped
+
+
+def test_reclaim_unblocks_actor_creation(ray_start_regular):
+    """With every CPU pinned by task leases, new queued work triggers a
+    controller lease_reclaim push and the holder gives idle leases back —
+    an actor created right after a task burst must place promptly rather
+    than waiting out the idle-reap timer."""
+
+    @ray_tpu.remote
+    def nop():
+        return None
+
+    ray_tpu.get([nop.remote() for _ in range(8)])
+    time.sleep(0.7)
+    ray_tpu.get([nop.remote() for _ in range(64)])  # grow the lease pool
+
+    @ray_tpu.remote
+    class Echo:
+        def ping(self):
+            return "pong"
+
+    t0 = time.time()
+    e = Echo.remote()
+    assert ray_tpu.get(e.ping.remote(), timeout=30) == "pong"
+    # Well under the 2s idle-reap: the reclaim push did the work.
+    assert time.time() - t0 < 8.0
+    ray_tpu.kill(e)
