@@ -852,6 +852,12 @@ class _TaskRoutePool:
                 pass
             return None
         route = _TaskRoute(conn, got["lease_id"], got["worker_id"])
+        # Born checked-out (inflight=1): a freshly acquired route must never
+        # be visible to _reclaim_leases / the idle reaper with inflight==0
+        # while its first submit is still in flight (advisor r4: that window
+        # releases the lease under the push and fabricates a
+        # WorkerCrashedError on a retry-less task).
+        route.inflight = 1
         with self.lock:
             self.routes.append(route)
         return route
@@ -898,6 +904,14 @@ class _TaskRoutePool:
                           or best.inflight >= _LEASE_PIPELINE)
                          and len(live) + self.acquiring < lease_max
                          and now >= self.next_try)
+            if best is not None:
+                # Checkout under THIS lock acquisition (advisor r4): the
+                # route leaves pick() already counted busy, so the reclaim
+                # and idle-reap inflight==0 tests can never select it
+                # between pick() returning and the submit landing. The
+                # caller decrements on submit failure.
+                best.inflight += 1
+                best.last_used = now
             if need_grow:
                 self.acquiring += 1
         if need_grow:
@@ -907,6 +921,12 @@ class _TaskRoutePool:
                 with self.lock:
                     self.acquiring -= 1
             if got is not None:
+                # The new route is born checked-out; hand back the
+                # speculative reservation on the old best.
+                if best is not None:
+                    with self.lock:
+                        best.inflight -= 1
+                        best.last_used = time.monotonic()
                 best = got
         return best
 
@@ -940,8 +960,6 @@ def _try_direct_task(wc, spec: Dict[str, Any], opts: Dict[str, Any]) -> bool:
         if loc is None:
             return False
         hints[d] = loc
-    if hints:
-        spec["loc_hints"] = hints
     resources = spec.get("resources") or {"CPU": 1.0}
     env_hash = spec.get("env_hash") or ""
     key = (wc.client.token, env_hash,
@@ -950,16 +968,22 @@ def _try_direct_task(wc, spec: Dict[str, Any], opts: Dict[str, Any]) -> bool:
         pool = _task_pools.get(key)
         if pool is None:
             pool = _task_pools[key] = _TaskRoutePool()
+    # pick() returns the route already checked out (inflight counted under
+    # the pool lock) — decrement on any failure to submit.
     route = pool.pick(wc, resources, env_hash, spec.get("runtime_env"))
     if route is None:
         return False
-    with pool.lock:
-        route.inflight += 1
-        route.last_used = time.monotonic()
+    if hints:
+        # Only the secured direct route carries cached-location hints: the
+        # controller fallback re-resolves locations itself, and a hint that
+        # went stale while queued there would turn a recoverable miss into
+        # a task read failure (advisor r4).
+        spec["loc_hints"] = hints
     try:
         fut = route.conn.request_threadsafe(
             {"kind": "direct_task", "spec": spec})
     except Exception:
+        spec.pop("loc_hints", None)  # controller fallback re-resolves
         with pool.lock:
             route.inflight -= 1
         return False
@@ -999,6 +1023,9 @@ def _direct_task_failure(wc, pool: "_TaskRoutePool", route: "_TaskRoute",
     retries = int(spec.get("max_retries", 0))
     if retries > 0:
         spec = dict(spec, max_retries=retries - 1)
+        # The hints plausibly point at objects hosted on the worker that
+        # just crashed — the controller path must re-resolve fresh.
+        spec.pop("loc_hints", None)
         try:
             _pipelined_submit(wc, {"kind": "submit_task", "spec": spec},
                               spec.get("return_ids", ()))

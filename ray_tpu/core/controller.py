@@ -1234,6 +1234,20 @@ class Controller:
         for node in sorted(self.nodes.values(), key=lambda n: n.index):
             if not node.alive or not _res_fits(node.available, resources):
                 continue
+            # Server-side lease bound (advisor r4): once a node already
+            # holds a lease, never lease away its LAST schedulable CPU.
+            # Multiple drivers can otherwise collectively pin every idle
+            # worker, leaving queued actor creations dependent solely on
+            # the holder-cooperative, 0.2s-throttled reclaim nudge. (A
+            # node's FIRST lease may still take the last CPU so tiny test
+            # hosts keep direct dispatch; CPU-less requests can't take the
+            # last CPU, so the guard doesn't apply to them.)
+            req_cpu = resources.get("CPU", 0.0)
+            has_lease = any(l["node_id"] == node.node_id
+                            for l in self._leases.values())
+            if (has_lease and req_cpu > 0
+                    and node.available.get("CPU", 0.0) - req_cpu < 1.0):
+                continue
             w = self._find_idle_worker(node, needs_tpu, env_hash)
             if w is None or not w.direct_port:
                 continue

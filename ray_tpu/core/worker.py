@@ -229,6 +229,21 @@ class WorkerRuntime:
     def _start_direct_server(self) -> int:
         from . import protocol
 
+        # Bind the interface this worker uses to reach the controller —
+        # exactly the address the controller advertises to peers (it reads
+        # our connection's peername, controller._h_lease_worker). A loopback
+        # cluster therefore stays loopback; binding 0.0.0.0 would expose an
+        # unauthenticated execute-pickled-callable endpoint on every
+        # interface of the host (advisor r4). RTPU_DIRECT_BIND overrides
+        # for multi-homed hosts where peers ride a different interface.
+        bind_host = flags.get("RTPU_DIRECT_BIND")
+        if not bind_host:
+            try:
+                bind_host = self.client.conn.writer.get_extra_info(
+                    "sockname")[0]
+            except Exception:
+                bind_host = "127.0.0.1"
+
         async def serve():
             async def on_conn(reader, writer):
                 conn = protocol.Connection(
@@ -237,7 +252,7 @@ class WorkerRuntime:
                 conn.start()
 
             return await __import__("asyncio").start_server(
-                on_conn, "0.0.0.0", 0)
+                on_conn, bind_host, 0)
 
         self._direct_server = self.client.io.call(serve(), timeout=10)
         return self._direct_server.sockets[0].getsockname()[1]

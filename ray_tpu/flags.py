@@ -75,6 +75,10 @@ _define("RTPU_CONTAINER_RUNTIME", str, "podman",
 _define("RTPU_TASK_LEASE_MAX", int, 16,
         "Max leased workers per (resources, env) signature for direct "
         "stateless-task dispatch; 0 disables task leasing entirely.")
+_define("RTPU_DIRECT_BIND", str, None,
+        "Interface the worker direct-dispatch server binds. Default: the "
+        "local address of the worker's controller connection, so loopback "
+        "clusters never expose the direct endpoint off-host.")
 
 # -- controller tunables -----------------------------------------------------
 _define("RTPU_MAX_WORKERS_PER_NODE", int, 32,
