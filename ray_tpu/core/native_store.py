@@ -200,7 +200,10 @@ _attached: Dict[str, NativeArena] = {}  # arenas attached by explicit name
 _arena_state_lock = threading.Lock()
 _ARENA_ENV = "RTPU_ARENA"
 _ARENA_SIZE_ENV = "RTPU_ARENA_SIZE"
-DEFAULT_ARENA_SIZE = 256 * 1024 * 1024
+# Must track the RTPU_ARENA_SIZE registered default (flags.py): a smaller
+# call-site fallback silently shrank every arena to 256MB, forcing large
+# put working sets through the disk-spill path (round-4 put_gbps 1.4).
+DEFAULT_ARENA_SIZE = 1 << 30
 
 
 def arena_name_for_node(node_id: str) -> str:

@@ -299,7 +299,14 @@ void* rtpu_store_create(const char* name, uint64_t size) {
     shm_unlink(name);
     return nullptr;
   }
-  void* mem = mmap(nullptr, size, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  // MAP_POPULATE: allocate every tmpfs page NOW, in the (one) creating
+  // process, instead of zero-fill-faulting them inside the first put that
+  // touches each page. Fresh-page faults cap the write path at ~1.4 GB/s
+  // on the CI host; pre-faulted pages take it to memcpy speed (>10 GB/s).
+  // Plasma parity: the reference store pre-allocates its pool the same way
+  // (create-then-seal over an owned heap).
+  void* mem = mmap(nullptr, size, PROT_READ | PROT_WRITE,
+                   MAP_SHARED | MAP_POPULATE, fd, 0);
   close(fd);
   if (mem == MAP_FAILED) {
     shm_unlink(name);
@@ -342,8 +349,10 @@ void* rtpu_store_attach(const char* name) {
     close(fd);
     return nullptr;
   }
-  void* mem =
-      mmap(nullptr, (size_t)st.st_size, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  // MAP_POPULATE here is cheap minor faults (the creator already allocated
+  // the pages) and moves even that cost out of the attacher's put path.
+  void* mem = mmap(nullptr, (size_t)st.st_size, PROT_READ | PROT_WRITE,
+                   MAP_SHARED | MAP_POPULATE, fd, 0);
   close(fd);
   if (mem == MAP_FAILED) return nullptr;
   Header* h = (Header*)mem;
