@@ -21,6 +21,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Union
 import cloudpickle
 
 from . import context as ctx
+from . import ownership
 from .client import CoreClient, EventLoopThread
 from .controller import Controller, GetTimeoutError, TaskError
 from .ids import ActorID, NodeID, ObjectID, TaskID
@@ -146,6 +147,7 @@ def shutdown() -> None:
         if not ctx.is_initialized():
             return
         wc = ctx.get_worker_context()
+        ownership.shutdown()
         _reset_direct_state(wc)
         if _owned_controller is not None and _controller_io is not None:
             try:
@@ -195,7 +197,8 @@ def put(value: Any) -> ObjectRef:
     # after it, and remote consumers block in get_locations until it lands).
     _cache_loc(loc)
     _pipelined_submit(wc, {"kind": "put_location", "loc": loc}, (oid,))
-    return ObjectRef(oid)
+    ownership.claim_ownership(oid, loc)
+    return ObjectRef(oid, ownership.self_addr())
 
 
 def _with_block_notify(fn: Callable[[], Any]) -> Any:
@@ -236,13 +239,24 @@ def get(refs: Union[ObjectRef, Sequence[ObjectRef]], *, timeout: Optional[float]
     remaining_timeout = (None if timeout is None else
                          max(0.0, timeout - (time.monotonic() - t_start)))
 
+    owners = {r.object_id: r.owner for r in ref_list
+              if r.owner and r.object_id in missing}
+
     def fetch():
         return wc.client.request(
             {"kind": "get_locations", "object_ids": missing,
-             "timeout": remaining_timeout}
+             "timeout": remaining_timeout, "owners": owners}
         )
 
     locs = _with_block_notify(fetch) if missing else {}
+    for loc in locs.values():
+        # Cache controller-fetched locations: later submits that depend on
+        # these objects stay eligible for direct dispatch (the lease path
+        # requires locally-known dep locations), and repeat gets skip the
+        # directory. get_bytes_with_refresh re-resolves stale entries.
+        # (_cache_loc also releases this process's submit holds for
+        # observed task returns — the single load-bearing hook.)
+        _cache_loc(loc)
     out = []
     for oid in ids:
         loc = locs.get(oid) or _local_locs.get(oid)
@@ -472,7 +486,7 @@ class RemoteFunction:
         if opts.get("num_tpus"):
             resources["TPU"] = float(opts["num_tpus"])
         strategy, pg = _normalize_strategy(opts.get("scheduling_strategy"))
-        args_blob, deps = pack_args(args, kwargs)
+        args_blob, deps, nested_refs = pack_args(args, kwargs)
         n_rets = 0 if streaming else max(num_returns, 0)
         return_ids = [ObjectID.generate() for _ in range(n_rets)]
         spec = {
@@ -490,6 +504,7 @@ class RemoteFunction:
         _attach_runtime_env(wc, opts, spec)
         if streaming:
             _streaming_spec_opts(opts, spec)
+        _register_dep_holds(spec, nested_refs)
         # Lease-then-push direct path first; the controller queue is the
         # fallback (and the only path for pg/affinity/streaming tasks).
         if not _try_direct_task(wc, spec, opts):
@@ -497,7 +512,7 @@ class RemoteFunction:
                               spec["return_ids"])
         if streaming:
             return ObjectRefGenerator(spec["task_id"])
-        refs = [ObjectRef(oid) for oid in return_ids]
+        refs = _claim_return_refs(return_ids)
         if num_returns == 1:
             return refs[0]
         if num_returns == 0:
@@ -642,6 +657,33 @@ def _cache_loc(loc) -> None:
     _local_locs[loc.object_id] = loc
     while len(_local_locs) > _LOCAL_LOCS_MAX:
         _local_locs.popitem(last=False)
+    # A visible location/error for a task return means the spec is no longer
+    # in flight — the submitter's dep holds can go (ownership protocol;
+    # no-op for oids this process didn't submit).
+    ownership.on_return_location(loc.object_id)
+
+
+def _register_dep_holds(spec: Dict[str, Any], nested_refs=()) -> None:
+    """Pin the spec's deps AND refs nested in its args at their owners for
+    the life of the submission (reference: reference_count.h counts every id
+    serialized into a task spec, top-level or nested)."""
+    held = list(spec.get("deps") or [])
+    for r in nested_refs:
+        if r.object_id not in held:
+            held.append(r.object_id)
+    dep_owners = ownership.register_submit_holds(
+        spec["task_id"], held, spec.get("return_ids") or [])
+    if dep_owners:
+        spec["dep_owners"] = dep_owners
+
+
+def _claim_return_refs(return_ids) -> List[ObjectRef]:
+    """Task returns are owned by the calling process (reference semantics:
+    the caller, not the executing worker, owns task results)."""
+    addr = ownership.self_addr()
+    for oid in return_ids:
+        ownership.claim_ownership(oid)
+    return [ObjectRef(oid, addr) for oid in return_ids]
 
 
 def _get_route(wc, actor_id: str) -> "_ActorRoute":
@@ -1145,7 +1187,7 @@ class ActorHandle:
     def _submit(self, method: str, args, kwargs, num_returns):
         wc = ctx.get_worker_context()
         streaming = num_returns == "streaming"
-        args_blob, deps = pack_args(args, kwargs)
+        args_blob, deps, nested_refs = pack_args(args, kwargs)
         n_rets = 0 if streaming else max(num_returns, 0)
         return_ids = [ObjectID.generate() for _ in range(n_rets)]
         spec = {
@@ -1160,6 +1202,7 @@ class ActorHandle:
         }
         if streaming:
             _streaming_spec_opts({}, spec)
+        _register_dep_holds(spec, nested_refs)
         submitted = False
         if not streaming and flags.get("RTPU_DIRECT_DISPATCH"):
             route = _get_route(wc, self._actor_id)
@@ -1173,7 +1216,7 @@ class ActorHandle:
             wc.client.request({"kind": "submit_actor_task", "spec": spec})
         if streaming:
             return ObjectRefGenerator(spec["task_id"])
-        refs = [ObjectRef(oid) for oid in return_ids]
+        refs = _claim_return_refs(return_ids)
         if num_returns == 1:
             return refs[0]
         if num_returns == 0:
@@ -1221,7 +1264,7 @@ class ActorClass:
         if opts.get("num_tpus"):
             resources["TPU"] = float(opts["num_tpus"])
         strategy, pg = _normalize_strategy(opts.get("scheduling_strategy"))
-        args_blob, deps = pack_args(args, kwargs)
+        args_blob, deps, nested_refs = pack_args(args, kwargs)
         actor_id = ActorID.generate()
         method_names = [
             n for n in dir(self._cls)
@@ -1245,6 +1288,7 @@ class ActorClass:
             "label": f"{self._cls.__name__}.__init__",
         }
         _attach_runtime_env(wc, opts, spec)
+        _register_dep_holds(spec, nested_refs)
         wc.client.request({"kind": "create_actor", "spec": spec})
         wc.client.request(
             {"kind": "kv_put", "ns": "__actor_methods__", "key": actor_id,

@@ -239,6 +239,7 @@ class Controller:
         # Last-touched times drive cold-object selection for arena spilling.
         self.object_touch: Dict[str, float] = {}
         self.spilled_count = 0
+        self.rpc_counts: Dict[str, int] = {}  # message kind -> count
         # (due_time, arena_oid) for spilled arena copies awaiting deletion.
         self._deferred_arena_deletes: List[Tuple[float, int]] = []
         self.tasks: Dict[str, Dict[str, Any]] = {}  # pending/running task specs
@@ -630,6 +631,10 @@ class Controller:
         fn = getattr(self, f"_h_{kind}", None)
         if fn is None:
             raise ValueError(f"controller: unknown message kind {kind!r}")
+        # Per-kind message counter: observability (dashboard /metrics) and
+        # the ownership-protocol tests' proof that ref passing between
+        # workers makes NO controller round-trips.
+        self.rpc_counts[kind] = self.rpc_counts.get(kind, 0) + 1
         return await fn(conn, msg)
 
     # --------------------------------------------------------------- handlers
@@ -770,16 +775,43 @@ class Controller:
     async def _h_get_locations(self, conn, msg):
         ids: List[str] = msg["object_ids"]
         timeout = msg.get("timeout")
+        owners: Dict[str, str] = msg.get("owners") or {}
         deadline = None if timeout is None else time.monotonic() + timeout
         out: Dict[str, ObjectLocation] = {}
         now = time.monotonic()
         for oid in ids:
+            if oid not in self.objects and owners.get(oid):
+                # Directory miss with a known owner: the owner is the
+                # authority for its objects (reference ownership protocol —
+                # the GCS directory is a cache, owners are truth). Covers
+                # registration races and directory loss across a controller
+                # restart.
+                await self._owner_locate(oid, owners[oid])
             try:
                 out[oid] = await self._wait_for_object(oid, deadline)
                 self.object_touch[oid] = now
             except asyncio.TimeoutError:
                 raise GetTimeoutError(f"object {oid[:8]} not ready within {timeout}s") from None
         return out
+
+    async def _owner_locate(self, oid: str, owner_addr: str) -> None:
+        hostport = owner_addr.partition("|")[0]
+        host, _, port = hostport.rpartition(":")
+        try:
+            conn = await protocol.connect(host, int(port), name="owner-locate")
+            try:
+                res = await conn.request({"kind": "ref_locate", "oid": oid},
+                                         timeout=2)
+            finally:
+                await conn.close()
+            loc = (res or {}).get("loc")
+            if loc is not None and oid not in self.objects:
+                self._store_location(loc)
+        except Exception:
+            pass  # owner gone/unreachable: fall through to the normal wait
+
+    async def _h_rpc_stats(self, conn, msg):
+        return dict(self.rpc_counts)
 
     async def _h_wait(self, conn, msg):
         """O(n) wait: one callback registration per missing object, arrivals
@@ -1613,6 +1645,14 @@ class Controller:
             spec = self.tasks.get(tid)
             if spec is not None:
                 demands.append(dict(spec.get("resources", {})))
+        # Pending placement-group bundles are demand too (reference:
+        # load_metrics pending_placement_groups) — the GCE slice loop
+        # scales up on a TPU-{type}-head bundle before any task exists.
+        for pg in self.pgs.values():
+            if pg.state == "pending":
+                for b in pg.bundles:
+                    if b.node_id is None:
+                        demands.append(dict(b.resources))
         nodes = []
         for n in self.nodes.values():
             busy = False

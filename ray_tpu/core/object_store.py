@@ -119,7 +119,17 @@ def serialize(value: Any) -> Tuple[bytes, List[pickle.PickleBuffer]]:
 def put_bytes(value: Any, object_id: str, node_id: str) -> ObjectLocation:
     """Serialize `value`; inline small results, spill large ones to the
     native arena (preferred) or a per-object shm segment (fallback)."""
-    data, oob = serialize(value)
+    from . import ownership
+    from .serialization import capture_nested_refs
+
+    # Refs nested in the payload are pinned by this process so the stored
+    # bytes never outlive the objects they reference (ownership module
+    # docstring: v1 pins for the process lifetime — safe direction).
+    nested: list = []
+    with capture_nested_refs(nested):
+        data, oob = serialize(value)
+    if nested:
+        ownership.pin_nested(object_id, list(nested))
     total = len(data) + sum(len(b.raw()) for b in oob)
     if total <= INLINE_THRESHOLD or flags.get("RTPU_FORCE_INLINE"):
         # Re-pickle in-band: cheap at this size, keeps the inline path simple.
@@ -439,16 +449,19 @@ _atexit.register(_release_zero_copy_pins)
 
 
 def get_bytes_with_refresh(loc: ObjectLocation, object_id: str, request_fn):
-    """get_bytes with a single location refresh when the copy moved (the
-    arena object was spilled between location resolution and the read).
-    The refresh carries a short timeout: if the object was freed rather
-    than spilled, the caller gets a timely error instead of waiting on an
-    id that will never reappear."""
+    """get_bytes with a single location refresh when the copy moved — the
+    arena object was spilled between resolution and the read (KeyError),
+    or the cached location's HOST died and the pull failed
+    (ConnectionError/OSError). The refresh timeout is long enough for
+    lineage reconstruction to re-run the producer (the controller blocks
+    the location request while the resubmitted task executes); if the
+    object was freed outright the caller still gets a timely error."""
     try:
         return get_bytes(loc), loc
-    except KeyError:
+    except (KeyError, ConnectionError, OSError, TimeoutError):
         locs = request_fn(
-            {"kind": "get_locations", "object_ids": [object_id], "timeout": 5}
+            {"kind": "get_locations", "object_ids": [object_id],
+             "timeout": 30}
         )
         loc = locs[object_id]
         return get_bytes(loc), loc

@@ -1,0 +1,663 @@
+"""Distributed object ownership: per-owner refcounts and borrow tracking.
+
+Role parity: the reference's per-worker ReferenceCounter
+(/root/reference/src/ray/core_worker/reference_count.h:35 — owners track
+local refs + borrower workers; borrowers report to the owner, not the GCS)
+redesigned for this runtime's asyncio control plane. Every ref-count
+mutation here is either process-local or a worker-to-worker message on the
+owner's ref channel; the controller sees exactly ONE batched ``free_objects``
+message per drained batch (the raylet-delete analog) and otherwise keeps
+only the location directory.
+
+Protocol (all fire-and-forget sends, FIFO-ordered per connection):
+
+- ``ref_borrow_add {oid, borrower}``   first live handle in a borrowing
+  process -> owner adds it to the borrower set.
+- ``ref_borrow_drop {oid, borrower}``  last handle died -> owner removes it.
+- ``ref_hold_add {oid, token}``        a submitter shipped a spec whose deps
+  include this object: the object must outlive the in-flight spec even if
+  every live handle dies (the classic submit-then-drop race).
+- ``ref_hold_release {oid, token}``    the executing worker registered its
+  own borrows (ordered BEFORE this release on the same connection), so the
+  hold can go. Releases arriving before their add leave a tombstone.
+- ``ref_locate {oid}``                 owner-side location fallback for a
+  directory miss (reference: owned objects are resolved at the owner).
+
+Premature-free safety argument: a spec's dep can only be freed when local
+handles, borrowers and holds are ALL drained. The submitter either owns the
+dep (local hold entry, no message) or borrows it (its ``hold_add`` rides the
+same connection as — and therefore lands before — its eventual
+``borrow_drop``); the executing worker's ``borrow_add`` precedes its
+``hold_release`` on ITS connection. Any interleaving of the two connections
+leaves at least one protector registered at all times.
+
+Known v1 bound (documented, safe direction): refs NESTED inside a stored
+object's payload are pinned by the serializing process for that process's
+lifetime (see ``pin_nested``) — objects can only live too long, never too
+short. The reference ties nested lifetime to the outer object's metadata;
+that refinement needs free-notification fan-out to producers.
+"""
+from __future__ import annotations
+
+import threading
+import time
+import uuid
+from typing import Any, Dict, List, Optional, Set, Tuple
+
+from ray_tpu import flags
+
+# ---------------------------------------------------------------------------
+# per-process identity
+
+_token = uuid.uuid4().hex[:16]
+_lock = threading.RLock()
+_entries: Dict[str, "_Entry"] = {}
+_pins: Dict[str, List[Any]] = {}  # outer oid -> nested ObjectRefs kept alive
+_self_addr: Optional[str] = None  # "host:port|token" once a ref server runs
+_conns: Dict[str, Any] = {}  # "host:port" -> protocol.Connection
+_pending_free: List[Tuple[float, str]] = []  # (due time, oid)
+_free_flush_scheduled = False
+_alive = True  # flipped at interpreter teardown / shutdown
+# Submit-holds this process placed, by token -> [(oid, owner_addr), ...].
+_holds_out: Dict[str, List[Tuple[str, str]]] = {}
+_return_to_token: Dict[str, str] = {}
+
+
+class _Entry:
+    __slots__ = ("local", "borrowers", "holds", "released_holds",
+                 "owner_addr", "is_owner", "registered_borrow", "freed")
+
+    def __init__(self) -> None:
+        self.local = 0
+        self.borrowers: Set[str] = set()
+        self.holds: Set[str] = set()
+        # token -> expiry. Tombstones exist only for the tiny
+        # release-before-add race (two connections); they EXPIRE because
+        # the common case (worker releases, then the submitter's
+        # grace-delayed observation releases the same token again) would
+        # otherwise pin one tombstone per task forever on hot objects.
+        self.released_holds: Dict[str, float] = {}
+        self.owner_addr = ""
+        self.is_owner = False
+        self.registered_borrow = False
+        self.freed = False
+
+    def drained(self) -> bool:
+        return (self.local <= 0 and not self.borrowers and not self.holds)
+
+    def tombstone(self, token: str) -> None:
+        now = time.monotonic()
+        if len(self.released_holds) > 32:
+            self.released_holds = {t: exp for t, exp in
+                                   self.released_holds.items() if exp > now}
+        self.released_holds[token] = now + _TOMBSTONE_TTL_S
+
+
+_TOMBSTONE_TTL_S = 120.0
+
+
+def process_token() -> str:
+    return _token
+
+
+def enabled() -> bool:
+    return bool(flags.get("RTPU_DISTRIBUTED_REFS"))
+
+
+# ---------------------------------------------------------------------------
+# ref server (the owner's channel)
+
+
+def set_self_addr(host: str, port: int) -> None:
+    """Workers: reuse the direct-dispatch server as the ref channel."""
+    global _self_addr
+    _self_addr = f"{host}:{port}|{_token}"
+
+
+def self_addr() -> str:
+    """This process's owner address, starting the ref server if needed."""
+    global _self_addr
+    if _self_addr is not None:
+        return _self_addr
+    with _lock:
+        if _self_addr is not None:
+            return _self_addr
+        if not enabled():
+            _self_addr = ""
+            return ""
+        try:
+            _self_addr = _start_ref_server()
+        except Exception:
+            _self_addr = ""  # ownership degrades to never-free, never breaks
+    return _self_addr
+
+
+def _start_ref_server() -> str:
+    """Driver-side ref server on the client's existing io loop."""
+    from . import context as ctx
+    from . import protocol
+
+    wc = ctx.get_worker_context()
+
+    async def serve():
+        import asyncio
+
+        async def on_conn(reader, writer):
+            conn = protocol.Connection(
+                reader, writer, handler=_handle_async, name="refsrv")
+            conn.start()
+
+        try:
+            bind = wc.client.conn.writer.get_extra_info("sockname")[0]
+        except Exception:
+            bind = "127.0.0.1"
+        return await asyncio.start_server(on_conn, bind, 0)
+
+    server = wc.client.io.call(serve(), timeout=10)
+    host, port = server.sockets[0].getsockname()[:2]
+    return f"{host}:{port}|{_token}"
+
+
+async def _handle_async(conn, msg):
+    return handle_ref_message(msg)
+
+
+def handle_ref_message(msg: Dict[str, Any]) -> Any:
+    """Dispatch one ref_* message (called from any server's handler)."""
+    kind = msg["kind"]
+    oid = msg["oid"]
+    with _lock:
+        e = _entries.get(oid)
+        if kind == "ref_borrow_add":
+            if e is None:
+                e = _entries.setdefault(oid, _Entry())
+            e.borrowers.add(msg["borrower"])
+            return None
+        if kind == "ref_borrow_drop":
+            if e is not None:
+                e.borrowers.discard(msg["borrower"])
+                _maybe_free_locked(oid, e)
+                _reap_zombie_locked(oid, e)
+            return None
+        if kind == "ref_hold_add":
+            if e is None:
+                e = _entries.setdefault(oid, _Entry())
+            tok = msg["token"]
+            if tok in e.released_holds:
+                e.released_holds.pop(tok, None)  # release won the race
+            else:
+                e.holds.add(tok)
+            return None
+        if kind == "ref_hold_release":
+            if e is None:
+                e = _entries.setdefault(oid, _Entry())
+            tok = msg["token"]
+            if tok in e.holds:
+                e.holds.discard(tok)
+                _maybe_free_locked(oid, e)
+                _reap_zombie_locked(oid, e)
+            else:
+                e.tombstone(tok)
+            return None
+    if kind == "ref_locate":
+        from . import api
+
+        loc = api._local_locs.get(oid)
+        return {"loc": loc}
+    if kind == "ref_locate_batch":
+        from . import api
+
+        return {"locs": {o: api._local_locs.get(o) for o in oid}}
+    return None
+
+
+# ---------------------------------------------------------------------------
+# sending to owners
+
+
+def _parse(addr: str) -> Tuple[str, str]:
+    hostport, _, tok = addr.partition("|")
+    return hostport, tok
+
+
+def _conn_to(hostport: str):
+    from . import context as ctx
+    from . import protocol
+
+    conn = _conns.get(hostport)
+    if conn is not None and not conn.closed.is_set():
+        return conn
+    wc = ctx.get_worker_context()
+    host, _, port = hostport.rpartition(":")
+    conn = wc.client.io.call(
+        protocol.connect(host, int(port), name=f"refs->{hostport}"),
+        timeout=5)
+    _conns[hostport] = conn
+    return conn
+
+
+_send_q: "Optional[Any]" = None
+_sender_started = False
+
+
+def _send_to_owner(owner_addr: str, msg: Dict[str, Any]) -> bool:
+    """Fire-and-forget, FIFO per owner (single sender thread drains one
+    queue, so per-owner order is the enqueue order). Enqueue-only from the
+    caller's perspective: ref hooks fire on arbitrary threads — including
+    the io loop, where a blocking connect would deadlock. Unreachable
+    owners are dropped (a dead owner's objects are GC'd with it)."""
+    global _send_q, _sender_started
+    hostport, tok = _parse(owner_addr)
+    if tok == _token:
+        handle_ref_message(msg)  # self-send: mutate locally
+        return True
+    with _lock:
+        if _send_q is None:
+            import queue
+
+            _send_q = queue.Queue()
+        if not _sender_started:
+            _sender_started = True
+            threading.Thread(target=_sender_loop, daemon=True,
+                             name="ref-sender").start()
+    _send_q.put((hostport, msg))
+    return True
+
+
+def _sender_loop() -> None:
+    from . import context as ctx
+
+    while _alive:
+        try:
+            hostport, msg = _send_q.get(timeout=5)
+        except Exception:
+            continue
+        try:
+            wc = ctx.get_worker_context()
+            conn = _conn_to(hostport)
+            wc.client.io.call_nowait(conn.send(msg))
+        except Exception:
+            _conns.pop(hostport, None)  # owner gone: drop its queue tail too
+
+
+# ---------------------------------------------------------------------------
+# handle-count hooks (ObjectRef.__init__ / __del__)
+
+
+def on_ref_created(oid: str, owner_addr: str) -> None:
+    if not _alive or not enabled():
+        return
+    try:
+        with _lock:
+            e = _entries.get(oid)
+            if e is None:
+                e = _entries.setdefault(oid, _Entry())
+            e.local += 1
+            if owner_addr and not e.owner_addr:
+                e.owner_addr = owner_addr
+            need_register = (
+                not e.is_owner and not e.registered_borrow and e.owner_addr
+                and _parse(e.owner_addr)[1] != _token)
+            if need_register:
+                e.registered_borrow = True
+        if need_register:
+            _send_to_owner(e.owner_addr, {
+                "kind": "ref_borrow_add", "oid": oid, "borrower": _token})
+    except Exception:
+        pass  # ref accounting must never break user code
+
+
+def on_ref_deleted(oid: str) -> None:
+    if not _alive or not enabled():
+        return
+    try:
+        with _lock:
+            e = _entries.get(oid)
+            if e is None:
+                return
+            e.local -= 1
+            if e.local > 0:
+                return
+            if e.is_owner:
+                _maybe_free_locked(oid, e)
+                return
+            registered = e.registered_borrow
+            owner = e.owner_addr
+            _entries.pop(oid, None)
+        if registered and owner:
+            _send_to_owner(owner, {
+                "kind": "ref_borrow_drop", "oid": oid, "borrower": _token})
+    except Exception:
+        pass
+
+
+def claim_ownership(oid: str, loc: Any = None) -> None:
+    """Mark this process the owner of `oid` (put() and task-return sites
+    call this BEFORE constructing the first ObjectRef)."""
+    if not enabled():
+        return
+    addr = self_addr()
+    with _lock:
+        e = _entries.get(oid)
+        if e is None:
+            e = _entries.setdefault(oid, _Entry())
+        e.is_owner = True
+        e.owner_addr = addr or ""
+
+
+def owner_addr_for(oid: str) -> str:
+    with _lock:
+        e = _entries.get(oid)
+        return e.owner_addr if e is not None else ""
+
+
+# ---------------------------------------------------------------------------
+# submit-holds (spec in flight keeps its deps alive)
+
+
+def register_submit_holds(token: str, deps: List[str],
+                          return_ids: List[str]) -> Dict[str, str]:
+    """Called by the submitter at pack time. Returns {oid: owner_addr} for
+    the spec (``dep_owners``). Owned deps get a local hold; borrowed deps
+    get a ``hold_add`` to their owner (same connection as the future
+    ``borrow_drop`` -> ordered)."""
+    if not enabled():
+        return {}
+    dep_owners: Dict[str, str] = {}
+    placed: List[Tuple[str, str]] = []
+    for oid in deps:
+        with _lock:
+            e = _entries.get(oid)
+            if e is None:
+                continue
+            owner = e.owner_addr
+            if not owner:
+                continue
+            dep_owners[oid] = owner
+            if e.is_owner:
+                if token in e.released_holds:
+                    e.released_holds.pop(token, None)
+                else:
+                    e.holds.add(token)
+                placed.append((oid, ""))
+                continue
+        if _send_to_owner(owner, {"kind": "ref_hold_add", "oid": oid,
+                                  "token": token}):
+            placed.append((oid, owner))
+    stale: List[Tuple[str, List[Tuple[str, str]]]] = []
+    if placed:
+        with _lock:
+            _holds_out[token] = placed
+            for rid in return_ids:
+                _return_to_token[rid] = token
+            # Bound the registries: tasks whose outcome this process never
+            # observes (fire-and-forget, result never fetched) would pin
+            # their deps forever. Evicting the OLDEST submissions releases
+            # holds for specs that have long since dispatched (the worker's
+            # own borrow has taken over by then).
+            while len(_holds_out) > 8192:
+                t = next(iter(_holds_out))
+                stale.append((t, _holds_out.pop(t)))
+            while len(_return_to_token) > 32768:
+                _return_to_token.pop(next(iter(_return_to_token)), None)
+    for tok, spl in stale:
+        _release_placed(tok, spl)
+    return dep_owners
+
+
+def release_submit_holds(token: str) -> None:
+    """Submitter-side release — used when the submitter OBSERVES the task's
+    outcome (direct-completion callback, or a return-oid location/error
+    arriving), covering specs that died before any worker saw them."""
+    if not enabled():
+        return
+    with _lock:
+        placed = _holds_out.pop(token, None)
+    if placed:
+        _release_placed(token, placed)
+
+
+def _release_placed(token: str, placed: List[Tuple[str, str]]) -> None:
+    for oid, owner in placed:
+        if owner == "":
+            with _lock:
+                e = _entries.get(oid)
+                if e is not None:
+                    if token in e.holds:
+                        e.holds.discard(token)
+                        _maybe_free_locked(oid, e)
+                    else:
+                        e.tombstone(token)
+        else:
+            _send_to_owner(owner, {"kind": "ref_hold_release", "oid": oid,
+                                   "token": token})
+
+
+_pending_hold_release: List[Tuple[float, str]] = []  # (due time, token)
+_hold_release_scheduled = False
+
+
+def on_return_location(oid: str) -> None:
+    """A task-return location (or error) became visible locally.
+
+    The release is DELAYED by a grace window: the executing worker's own
+    hold_release is ordered after its borrow_add on the owner connection,
+    but this locally-observed release has no such ordering — firing it
+    immediately lets `submit; get(); del ref` free the object before the
+    worker's in-flight borrow_add lands (measured race: an actor storing a
+    ref it was handed lost the object when the caller dropped its handle
+    right after the call returned). Each token carries its OWN deadline —
+    a shared sleep-once batch would give ~zero grace to tokens observed
+    near the end of the window."""
+    global _hold_release_scheduled
+    if not enabled():
+        return
+    with _lock:
+        token = _return_to_token.pop(oid, None)
+        if token is None:
+            return
+        due = time.monotonic() + float(flags.get("RTPU_HOLD_RELEASE_GRACE_S"))
+        _pending_hold_release.append((due, token))
+        if _hold_release_scheduled:
+            return
+        _hold_release_scheduled = True
+    threading.Thread(target=_hold_release_pump, daemon=True,
+                     name="ref-hold-release").start()
+
+
+def _hold_release_pump() -> None:
+    """Drain (due, token) entries as each deadline passes; exits when the
+    queue empties (a later enqueue starts a fresh pump)."""
+    global _hold_release_scheduled
+    while _alive:
+        with _lock:
+            if not _pending_hold_release:
+                _hold_release_scheduled = False
+                return
+            due, token = _pending_hold_release[0]
+            wait = due - time.monotonic()
+            if wait <= 0:
+                _pending_hold_release.pop(0)
+                token_ready = token
+            else:
+                token_ready = None
+        if token_ready is None:
+            time.sleep(min(wait, 0.5))
+        else:
+            release_submit_holds(token_ready)
+
+
+# ---------------------------------------------------------------------------
+# executing-worker side
+
+
+def acquire_spec_refs(spec: Dict[str, Any]) -> List[Any]:
+    """Register this process as borrower of every dep, THEN release the
+    submitter's holds (FIFO on the owner connection makes the borrow land
+    first). Returns the handle list; keep it alive until the completion
+    report is sent, then just drop it."""
+    if not enabled():
+        return []
+    dep_owners: Dict[str, str] = spec.get("dep_owners") or {}
+    if not dep_owners:
+        return []
+    from .serialization import ObjectRef
+
+    token = spec.get("task_id", "")
+    held = []
+    for oid, owner in dep_owners.items():
+        held.append(ObjectRef(oid, owner))  # inc -> borrow_add if first
+    for oid, owner in dep_owners.items():
+        _send_to_owner(owner, {"kind": "ref_hold_release", "oid": oid,
+                               "token": token})
+    return held
+
+
+# ---------------------------------------------------------------------------
+# nested refs
+
+
+def locate_from_owner(oid: str, owner_addr: str,
+                      timeout: float = 3.0) -> Optional[Any]:
+    """Ask the owner for the object's location (blocking; task threads
+    only). None on any failure — callers fall back to the controller."""
+    out = locate_from_owner_batch([oid], owner_addr, timeout=timeout)
+    return out.get(oid)
+
+
+def locate_from_owner_batch(oids: List[str], owner_addr: str,
+                            timeout: float = 3.0) -> Dict[str, Any]:
+    """One round-trip for ALL of an owner's deps (a per-dep loop would
+    serialize K blocking RPCs — and K timeouts when the owner is dead).
+    Empty dict on any failure: callers fall back to one batched
+    controller get_locations."""
+    if not enabled() or not oids:
+        return {}
+    try:
+        hostport, tok = _parse(owner_addr)
+        if tok == _token:
+            from . import api
+
+            return {o: api._local_locs.get(o) for o in oids}
+        conn = _conn_to(hostport)
+        res = conn.request_threadsafe(
+            {"kind": "ref_locate_batch", "oid": list(oids)}).result(timeout)
+        return {o: loc for o, loc in ((res or {}).get("locs") or {}).items()
+                if loc is not None}
+    except Exception:
+        return {}
+
+
+def pin_nested(outer_oid: str, refs: List[Any]) -> None:
+    """Keep refs discovered inside a serialized payload alive in this
+    process (v1 bound: for the process lifetime — see module docstring)."""
+    if refs and enabled():
+        with _lock:
+            _pins.setdefault(outer_oid, []).extend(refs)
+
+
+# ---------------------------------------------------------------------------
+# freeing
+
+
+def _reap_zombie_locked(oid: str, e: "_Entry") -> None:
+    """Drop drained NON-owner entries resurrected by late borrow/hold
+    messages (e.g. a borrow_add landing after the owner freed the object) —
+    they can never free anything and would otherwise accumulate."""
+    cur = _entries.get(oid)
+    if (cur is e and not e.is_owner and e.drained()
+            and not e.registered_borrow and not e.released_holds):
+        _entries.pop(oid, None)
+
+
+def _maybe_free_locked(oid: str, e: "_Entry") -> None:
+    """Caller holds _lock. Schedule the terminal free for a drained entry."""
+    global _free_flush_scheduled
+    if not e.is_owner or e.freed or not e.drained():
+        return
+    e.freed = True
+    _entries.pop(oid, None)
+    _pins.pop(oid, None)
+    due = time.monotonic() + float(flags.get("RTPU_FREE_DELAY_S"))
+    _pending_free.append((due, oid))
+    if not _free_flush_scheduled:
+        _free_flush_scheduled = True
+        threading.Thread(target=_free_pump, daemon=True,
+                         name="ref-free").start()
+
+
+def _free_pump() -> None:
+    """Per-oid grace (a shared sleep would shortchange late arrivals), but
+    everything whose deadline has passed ships in ONE batched
+    fire-and-forget free_objects — the single controller message of the
+    whole ref lifecycle (raylet-delete parity)."""
+    global _free_flush_scheduled
+    while _alive:
+        with _lock:
+            if not _pending_free:
+                _free_flush_scheduled = False
+                return
+            now = time.monotonic()
+            batch = [oid for due, oid in _pending_free if due <= now]
+            if batch:
+                _pending_free[:] = [p for p in _pending_free if p[1] not in
+                                    set(batch)]
+                wait = 0.0
+            else:
+                wait = min(due for due, _ in _pending_free) - now
+        if not batch:
+            time.sleep(min(max(wait, 0.01), 0.5))
+            continue
+        try:
+            from . import api
+            from . import context as ctx
+
+            wc = ctx.get_worker_context()
+            for oid in batch:
+                api._local_locs.pop(oid, None)
+            wc.client.io.call_nowait(wc.client.conn.send(
+                {"kind": "free_objects", "object_ids": batch}))
+        except Exception:
+            pass
+
+
+import atexit
+
+
+@atexit.register
+def _mark_dead() -> None:
+    global _alive
+    _alive = False
+
+
+def shutdown() -> None:
+    """Reset per-process state (init/shutdown cycles in one process)."""
+    global _self_addr, _free_flush_scheduled, _hold_release_scheduled
+    with _lock:
+        _entries.clear()
+        _pins.clear()
+        _holds_out.clear()
+        _return_to_token.clear()
+        _pending_free.clear()
+        _pending_hold_release.clear()
+        _free_flush_scheduled = False
+        _hold_release_scheduled = False
+        for conn in _conns.values():
+            try:
+                conn.closed.set()
+            except Exception:
+                pass
+        _conns.clear()
+        _self_addr = None
+
+
+def stats() -> Dict[str, int]:
+    with _lock:
+        return {
+            "entries": len(_entries),
+            "owned": sum(1 for e in _entries.values() if e.is_owner),
+            "borrowed": sum(1 for e in _entries.values()
+                            if e.registered_borrow),
+            "pins": len(_pins),
+            "holds_out": len(_holds_out),
+        }

@@ -9,27 +9,64 @@ nested refs travel as refs — the same semantics as the reference.
 """
 from __future__ import annotations
 
+import threading
+from contextlib import contextmanager
 from dataclasses import dataclass
 from typing import Any, Dict, List, Tuple
 
 import cloudpickle
 
+from . import ownership as _ownership
 from .ids import ObjectID
+
+# Thread-local nested-ref capture: while a payload is being pickled, every
+# ObjectRef it contains registers itself here so the serializing process can
+# pin it for the stored object's benefit (ownership.pin_nested).
+_capture = threading.local()
+
+
+@contextmanager
+def capture_nested_refs(out: List["ObjectRef"]):
+    prev = getattr(_capture, "refs", None)
+    _capture.refs = out
+    try:
+        yield out
+    finally:
+        _capture.refs = prev
 
 
 class ObjectRef:
-    """A distributed future. `ray_tpu.get(ref)` resolves it."""
+    """A distributed future. `ray_tpu.get(ref)` resolves it.
 
-    __slots__ = ("object_id",)
+    Handles participate in distributed ownership (reference:
+    reference_count.h:35): construction/destruction adjust the process-local
+    count in core.ownership, which registers this process as a borrower with
+    the owner on the first handle and reports the drop on the last. ``owner``
+    is the owning process's ref-channel address ("host:port|token", empty
+    when ownership tracking is off) and travels with the pickle."""
 
-    def __init__(self, object_id: str):
+    __slots__ = ("object_id", "owner")
+
+    def __init__(self, object_id: str, owner: str = ""):
         self.object_id = object_id
+        self.owner = owner
+        _ownership.on_ref_created(object_id, owner)
 
     def hex(self) -> str:
         return self.object_id
 
+    def __del__(self):
+        try:
+            _ownership.on_ref_deleted(self.object_id)
+        except Exception:
+            pass  # interpreter teardown: modules may be half-gone
+
     def __reduce__(self):
-        return (ObjectRef, (self.object_id,))
+        cap = getattr(_capture, "refs", None)
+        if cap is not None:
+            cap.append(self)
+        owner = self.owner or _ownership.owner_addr_for(self.object_id)
+        return (ObjectRef, (self.object_id, owner))
 
     def __hash__(self) -> int:
         return hash(self.object_id)
@@ -56,8 +93,18 @@ class ArgRef:
     object_id: str
 
 
-def pack_args(args: Tuple[Any, ...], kwargs: Dict[str, Any]) -> Tuple[bytes, List[str]]:
-    """Replace top-level ObjectRefs with ArgRef markers; return (blob, dep ids)."""
+def pack_args(
+    args: Tuple[Any, ...], kwargs: Dict[str, Any]
+) -> Tuple[bytes, List[str], List["ObjectRef"]]:
+    """Replace top-level ObjectRefs with ArgRef markers; return
+    (blob, dep ids, nested refs).
+
+    Nested refs (inside containers) are captured during pickling: they are
+    NOT scheduling dependencies (the task starts without their values — the
+    reference's semantics), but the submitter must hold them for the life of
+    the in-flight spec exactly like deps, or the only handle dying right
+    after submit frees an object the spec still carries (reference: the
+    ReferenceCounter counts ids serialized into a task spec)."""
     deps: List[str] = []
 
     def sub(i: Any, v: Any) -> Any:
@@ -68,5 +115,7 @@ def pack_args(args: Tuple[Any, ...], kwargs: Dict[str, Any]) -> Tuple[bytes, Lis
 
     new_args = tuple(sub(i, a) for i, a in enumerate(args))
     new_kwargs = {k: sub(k, v) for k, v in kwargs.items()}
-    blob = cloudpickle.dumps((new_args, new_kwargs))
-    return blob, deps
+    nested: List[ObjectRef] = []
+    with capture_nested_refs(nested):
+        blob = cloudpickle.dumps((new_args, new_kwargs))
+    return blob, deps, nested

@@ -24,6 +24,9 @@ from typing import Dict, Optional, Tuple
 from .object_store import ObjectLocation
 
 PULL_CHUNK = 4 * 1024 * 1024
+# Per-chunk pull deadline: generous for a loaded host, small enough that a
+# dead peer turns into a refresh instead of a hung get().
+PULL_CHUNK_TIMEOUT_S = 20.0
 
 
 def read_location_range(loc: ObjectLocation, offset: int, length: int) -> bytes:
@@ -105,16 +108,36 @@ def _serving_client(addr: Tuple[str, int]):
 
 
 def fetch_remote_value(loc: ObjectLocation):
-    """Pull a remote object's bytes from its producer host and decode."""
+    """Pull a remote object's bytes from its producer host and decode.
+
+    Every chunk request carries a timeout and any failure evicts the
+    cached connection: location caches mean a pull can target a host that
+    died since the location was learned, and an unbounded request there
+    hangs the whole get() instead of letting the caller's refresh path
+    re-resolve (and possibly lineage-reconstruct) the object."""
     addr = _resolve_serving_addr(loc.node_id)
     cli = _serving_client(addr)
     buf = bytearray(loc.size)
     off = 0
     while off < loc.size:
         n = min(PULL_CHUNK, loc.size - off)
-        chunk = cli.request(
-            {"kind": "pull_chunk", "loc": loc, "offset": off, "length": n}
-        )
+        try:
+            chunk = cli.request(
+                {"kind": "pull_chunk", "loc": loc, "offset": off,
+                 "length": n},
+                timeout=PULL_CHUNK_TIMEOUT_S,
+            )
+        except Exception as e:
+            with _cache_lock:
+                if _conn_cache.get(addr) is cli:
+                    _conn_cache.pop(addr, None)
+            try:
+                cli.close()
+            except Exception:
+                pass
+            raise ConnectionError(
+                f"pull of object {loc.object_id[:8]} from {addr} failed "
+                f"at offset {off}: {e!r}") from e
         if not chunk:
             raise ConnectionError(
                 f"short pull of object {loc.object_id[:8]} at offset {off}"

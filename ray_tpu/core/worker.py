@@ -255,7 +255,13 @@ class WorkerRuntime:
                 on_conn, bind_host, 0)
 
         self._direct_server = self.client.io.call(serve(), timeout=10)
-        return self._direct_server.sockets[0].getsockname()[1]
+        port = self._direct_server.sockets[0].getsockname()[1]
+        # The direct server doubles as this worker's ownership ref channel
+        # (borrow/hold messages land in _handle_direct's ref_* branch).
+        from . import ownership
+
+        ownership.set_self_addr(bind_host, port)
+        return port
 
     async def _handle_direct(self, conn, msg):
         """Peer-pushed actor task: enqueue on the mailbox, answer with the
@@ -264,6 +270,10 @@ class WorkerRuntime:
         locations with zero controller involvement."""
         import asyncio
 
+        if msg["kind"].startswith("ref_"):
+            from . import ownership
+
+            return ownership.handle_ref_message(msg)
         spec = msg["spec"]
         if spec.get("streaming"):
             # Generator state lives in the controller; a direct streaming
@@ -372,8 +382,26 @@ class WorkerRuntime:
                    if isinstance(v, ArgRef) and v.object_id not in hints]
         locs: Dict[str, ObjectLocation] = dict(hints)
         if ref_ids:
-            locs.update(self.client.request(
-                {"kind": "get_locations", "object_ids": ref_ids}))
+            # Owners before the directory (reference ownership protocol:
+            # the owner is the authority for its objects; the controller
+            # keeps a cache). ONE batched round-trip per distinct owner;
+            # anything an owner can't resolve (or a dead owner's whole
+            # group) falls through to one batched controller
+            # get_locations.
+            from . import ownership
+
+            dep_owners: Dict[str, str] = spec.get("dep_owners") or {}
+            by_owner: Dict[str, List[str]] = {}
+            for oid in ref_ids:
+                owner = dep_owners.get(oid)
+                if owner:
+                    by_owner.setdefault(owner, []).append(oid)
+            for owner, oids in by_owner.items():
+                locs.update(ownership.locate_from_owner_batch(oids, owner))
+            still = [oid for oid in ref_ids if oid not in locs]
+            if still:
+                locs.update(self.client.request(
+                    {"kind": "get_locations", "object_ids": still}))
 
         def resolve(v: Any) -> Any:
             if isinstance(v, ArgRef):
@@ -403,6 +431,14 @@ class WorkerRuntime:
         tls = ctx.task_local
         tls.task_id = task_id
         tls.label = spec.get("label", "")
+        from . import ownership
+
+        # Borrow every dep (ordered before the hold_release on the same
+        # owner connection), so the submitter's in-flight holds can retire
+        # the moment this worker protects the objects itself. The handles
+        # die with this frame — after arg VALUES are materialized the dep
+        # bytes are no longer needed here.
+        _held = ownership.acquire_spec_refs(spec)  # noqa: F841
         try:
             args, kwargs = self._resolve_args(spec)
             if spec.get("actor_id") and actor_instance is not None:
@@ -627,6 +663,9 @@ class WorkerRuntime:
         self.actors[actor_id] = mb
 
         def create():
+            from . import ownership
+
+            _held = ownership.acquire_spec_refs(spec)  # noqa: F841
             try:
                 cls = self._load_function(spec["func_id"])
                 args, kwargs = self._resolve_args(spec)

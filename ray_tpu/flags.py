@@ -75,6 +75,18 @@ _define("RTPU_CONTAINER_RUNTIME", str, "podman",
 _define("RTPU_TASK_LEASE_MAX", int, 16,
         "Max leased workers per (resources, env) signature for direct "
         "stateless-task dispatch; 0 disables task leasing entirely.")
+_define("RTPU_DISTRIBUTED_REFS", bool, True,
+        "Distributed ownership: ObjectRef handles are counted per process, "
+        "borrowers register with owners worker-to-worker, and drained "
+        "objects are freed with one batched controller message. 0 reverts "
+        "to never-free-until-pressure semantics.")
+_define("RTPU_FREE_DELAY_S", float, 1.0,
+        "Grace window between an object draining (no handles, borrowers or "
+        "holds anywhere) and the batched free, absorbing in-flight races.")
+_define("RTPU_HOLD_RELEASE_GRACE_S", float, 2.0,
+        "Grace before a submit-hold is released on locally OBSERVING a "
+        "task's outcome (vs the worker's ordered release message): bounds "
+        "how late an executing worker's borrow_add may arrive.")
 _define("RTPU_DIRECT_BIND", str, None,
         "Interface the worker direct-dispatch server binds. Default: the "
         "local address of the worker's controller connection, so loopback "

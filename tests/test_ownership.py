@@ -1,0 +1,221 @@
+"""Distributed ownership: per-owner refcounts, worker-to-worker borrowing,
+out-of-scope free (reference: reference_count.h:35 — owners track local refs
+plus borrower workers; the GCS/controller never sees per-ref mutations).
+
+Owns its cluster where node topology matters; uses env knobs to shrink the
+free grace window so drains are observable."""
+import os
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.core import ownership
+from ray_tpu.core.cluster_utils import Cluster
+from ray_tpu.core.serialization import ObjectRef
+
+
+def _rpc_stats():
+    from ray_tpu.core import context as ctx
+
+    return ctx.get_worker_context().client.request({"kind": "rpc_stats"})
+
+
+def _wait_freed(oid: str, timeout: float = 8.0) -> bool:
+    """True once a get() of the oid no longer resolves."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            ray_tpu.get(ObjectRef(oid), timeout=0.3)
+        except Exception:
+            return True
+        time.sleep(0.2)
+    return False
+
+
+def test_out_of_scope_free_single_batched_rpc():
+    os.environ["RTPU_FREE_DELAY_S"] = "0.1"
+    try:
+        ray_tpu.init(num_cpus=2)
+        refs = [ray_tpu.put(np.arange(100_000, dtype=np.int64) + i)
+                for i in range(4)]
+        oids = [r.object_id for r in refs]
+        ray_tpu.get(refs[0])
+        before = _rpc_stats().get("free_objects", 0)
+        del refs
+        assert _wait_freed(oids[0])
+        for oid in oids[1:]:
+            assert _wait_freed(oid, timeout=2)
+        after = _rpc_stats().get("free_objects", 0)
+        # All four drained handles ride ONE batched terminal free (the
+        # raylet-delete analog) — not one controller RPC per mutation.
+        assert after - before == 1, (before, after)
+    finally:
+        os.environ.pop("RTPU_FREE_DELAY_S", None)
+        ray_tpu.shutdown()
+
+
+def test_w2w_ref_passing_no_controller_ref_traffic():
+    """Ref passing driver->worker->worker makes zero controller location /
+    free RPCs while in flight (borrow + hold messages ride the owner's ref
+    channel), and the terminal free is one batched message."""
+    os.environ["RTPU_FREE_DELAY_S"] = "0.1"
+    cluster = Cluster(head_resources={"CPU": 2})
+    try:
+        cluster.add_node({"CPU": 2}, remote=True, host_id="own-host-b")
+
+        @ray_tpu.remote
+        def produce():
+            return np.arange(300_000, dtype=np.float64)  # 2.4MB: not inline
+
+        @ray_tpu.remote
+        def relay(x):  # worker-to-worker: consumes and re-ships the value
+            return float(x.sum())
+
+        @ray_tpu.remote
+        def nop(i):
+            return i
+
+        ref = produce.remote()
+        assert ray_tpu.get(ref, timeout=30).shape == (300_000,)
+        time.sleep(0.7)  # settle: lease/route establishment does use RPCs
+
+        # Differential: a wave of tasks WITH a ref argument must cost the
+        # controller no more location traffic than an identical wave
+        # without one — the dep resolution rides cached hints and the
+        # owner channel, not the directory.
+        base = _rpc_stats()
+        ray_tpu.get([nop.remote(i) for i in range(6)], timeout=30)
+        mid = _rpc_stats()
+        vals = ray_tpu.get([relay.remote(ref) for _ in range(6)], timeout=30)
+        assert all(v == vals[0] for v in vals)
+        after = _rpc_stats()
+        nop_lookups = mid.get("get_locations", 0) - base.get("get_locations", 0)
+        ref_lookups = after.get("get_locations", 0) - mid.get("get_locations", 0)
+        assert ref_lookups <= nop_lookups + 1, (nop_lookups, ref_lookups)
+        # The dep itself is still protected (frees observed above are the
+        # waves' own dropped return objects — that's the feature working).
+        assert ray_tpu.get(ref, timeout=10).shape == (300_000,)
+
+        oid = ref.object_id
+        base_free = after.get("free_objects", 0)
+        del ref
+        assert _wait_freed(oid)
+        # Terminal frees are BATCHED: ~14 objects died this test (12 wave
+        # returns + produce's return + the dep) — the controller must see
+        # far fewer free messages than freed objects (per-oid grace
+        # deadlines may split the batches, but amortization holds).
+        assert _rpc_stats().get("free_objects", 0) <= base_free + 4
+    finally:
+        os.environ.pop("RTPU_FREE_DELAY_S", None)
+        cluster.shutdown()
+
+
+def test_submit_then_drop_race_is_safe():
+    """The classic premature-free race: the only handle dies right after
+    submit, before any worker has seen the spec. The submit hold keeps the
+    dep alive until the executing worker's borrow takes over."""
+    os.environ["RTPU_FREE_DELAY_S"] = "0.05"
+    try:
+        ray_tpu.init(num_cpus=2)
+
+        @ray_tpu.remote
+        def slow_sum(x):
+            time.sleep(1.2)  # outlive several grace windows
+            return float(x.sum())
+
+        data = np.arange(200_000, dtype=np.float64)
+        ref = ray_tpu.put(data)
+        fut = slow_sum.remote(ref)
+        del ref  # only handle gone while the spec is still in flight
+        assert ray_tpu.get(fut, timeout=30) == float(data.sum())
+    finally:
+        os.environ.pop("RTPU_FREE_DELAY_S", None)
+        ray_tpu.shutdown()
+
+
+def test_nested_refs_pinned_by_outer_object():
+    os.environ["RTPU_FREE_DELAY_S"] = "0.05"
+    try:
+        ray_tpu.init(num_cpus=2)
+        inner = ray_tpu.put(np.arange(150_000, dtype=np.int64))
+        outer = ray_tpu.put({"inner": inner})
+        inner_oid = inner.object_id
+        del inner
+        time.sleep(1.0)  # several grace windows: inner must NOT free
+        got = ray_tpu.get(ray_tpu.get(outer)["inner"], timeout=10)
+        assert got.shape == (150_000,)
+        assert got[-1] == 149_999
+        assert inner_oid  # silence unused warnings
+    finally:
+        os.environ.pop("RTPU_FREE_DELAY_S", None)
+        ray_tpu.shutdown()
+
+
+def test_borrower_keeps_object_alive():
+    """An actor borrowing a driver-owned ref keeps it alive after the
+    driver's handles die; the drop of the last borrow frees it. The ref is
+    shipped NESTED (top-level refs resolve to values — reference
+    semantics), exercising the nested-capture hold path."""
+    os.environ["RTPU_FREE_DELAY_S"] = "0.1"
+    try:
+        ray_tpu.init(num_cpus=2)
+
+        @ray_tpu.remote
+        class Keeper:
+            def __init__(self):
+                self.ref = None
+
+            def hold(self, box):
+                self.ref = box["r"]
+                return True
+
+            def read(self):
+                return float(ray_tpu.get(self.ref).sum())
+
+            def drop(self):
+                self.ref = None
+                return True
+
+        k = Keeper.remote()
+        data = np.arange(250_000, dtype=np.float64)
+        ref = ray_tpu.put(data)
+        oid = ref.object_id
+        assert ray_tpu.get(k.hold.remote({"r": ref}), timeout=30)
+        del ref
+        time.sleep(1.0)  # driver handles gone; the borrow must protect it
+        assert ray_tpu.get(k.read.remote(), timeout=30) == float(data.sum())
+        assert ray_tpu.get(k.drop.remote(), timeout=30)
+        assert _wait_freed(oid, timeout=10)
+    finally:
+        os.environ.pop("RTPU_FREE_DELAY_S", None)
+        ray_tpu.shutdown()
+
+
+def test_owner_location_fallback_after_directory_miss():
+    """Controller resolves a directory miss by asking the owner (reference:
+    owned objects are resolved at the owner, the directory is a cache)."""
+    ray_tpu.init(num_cpus=2)
+    try:
+        ref = ray_tpu.put(np.arange(50_000, dtype=np.int64))
+        ray_tpu.get(ref)  # owner has the location cached locally
+        from ray_tpu.core import context as ctx
+
+        wc = ctx.get_worker_context()
+        # Simulate directory loss (controller restart without persistence).
+        wc.client.request({"kind": "free_objects", "object_ids": []})
+        ctrl_drop = {"kind": "get_locations", "object_ids": [ref.object_id],
+                     "timeout": 1}
+        # Drop the directory entry out from under the object: reach into
+        # the in-process controller.
+        from ray_tpu.core import api as api_mod
+
+        api_mod._owned_controller.objects.pop(ref.object_id, None)
+        # A get that carries the owner address must still resolve.
+        got = wc.client.request(dict(ctrl_drop, timeout=5,
+                                     owners={ref.object_id: ref.owner}))
+        assert ref.object_id in got
+        assert ownership.stats()["owned"] >= 1
+    finally:
+        ray_tpu.shutdown()
