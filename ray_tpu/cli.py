@@ -77,6 +77,16 @@ def cmd_start(args) -> int:
                     pass
             await stop.wait()
             await controller.shutdown()
+            # Only OUR files: a newer head may have overwritten them, and
+            # removing its address would strand its clients (compare
+            # content before unlink, reference `ray stop` semantics).
+            for path, mine in ((_ADDRFILE, addr),
+                               (_PIDFILE, str(os.getpid()))):
+                try:
+                    if open(path).read().strip() == mine:
+                        os.unlink(path)
+                except OSError:
+                    pass
 
         asyncio.run(run_head())
         return 0
@@ -160,8 +170,27 @@ def cmd_dashboard(args) -> int:
     from ray_tpu.dashboard import start_dashboard
 
     ray_tpu.init(address=_resolve_address(args))
+    if getattr(args, "grafana_out", None):
+        # Generate importable Grafana JSON from the live metric surface
+        # and exit (reference: grafana_dashboard_factory.py).
+        import urllib.request
+
+        from ray_tpu.util import state as state_api
+        from ray_tpu.util.grafana import write_dashboard
+
+        addr = state_api.metrics_address()
+        if not addr:
+            sys.exit("controller metrics endpoint is disabled")
+        with urllib.request.urlopen(f"http://{addr}/metrics",
+                                    timeout=5) as resp:
+            prom_text = resp.read().decode()
+        dash = write_dashboard(args.grafana_out, prom_text)
+        print(f"wrote {len(dash['panels'])} panels to {args.grafana_out}")
+        ray_tpu.shutdown()
+        return 0
     dash = start_dashboard(host=args.host, port=args.dash_port)
     print(f"dashboard at http://{args.host}:{dash.port}")
+    print(f"  task timeline: http://{args.host}:{dash.port}/timeline")
     try:
         while True:
             time.sleep(3600)
@@ -203,9 +232,70 @@ def cmd_job(args) -> int:
     return 0
 
 
+def cmd_up(args) -> int:
+    from ray_tpu.launcher import ClusterConfig, ClusterLauncher
+
+    cfg = ClusterConfig.load(args.config)
+    state = ClusterLauncher(cfg).up()
+    print(f"cluster {cfg.cluster_name!r} is up at {state['address']} "
+          f"({1 + len(state['workers'])} nodes)")
+    print(f"  attach: python -m ray_tpu.cli attach {args.config}")
+    print(f"  tear down: python -m ray_tpu.cli down {args.config}")
+    return 0
+
+
+def cmd_down(args) -> int:
+    from ray_tpu.launcher import ClusterConfig, ClusterLauncher
+
+    cfg = ClusterConfig.load(args.config)
+    ClusterLauncher(cfg).down()
+    print(f"cluster {cfg.cluster_name!r} is down")
+    return 0
+
+
+def cmd_exec(args) -> int:
+    import shlex
+
+    from ray_tpu.launcher import ClusterConfig, ClusterLauncher
+
+    cfg = ClusterConfig.load(args.config)
+    # shlex.join: the remote shell re-parses the string — plain " ".join
+    # would destroy the operator's quoting (`-c 'print("a b")'`).
+    out = ClusterLauncher(cfg).exec(shlex.join(args.command),
+                                    timeout=args.timeout)
+    sys.stdout.write(out)
+    return 0
+
+
+def cmd_attach(args) -> int:
+    from ray_tpu.launcher import ClusterConfig, ClusterLauncher
+
+    cfg = ClusterConfig.load(args.config)
+    cmd = ClusterLauncher(cfg).attach_command()
+    os.execvp(cmd[0], cmd)
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="rtpu", description=__doc__)
     sub = ap.add_subparsers(dest="cmd", required=True)
+
+    p = sub.add_parser("up", help="launch a cluster from a YAML config")
+    p.add_argument("config")
+    p.set_defaults(fn=cmd_up)
+
+    p = sub.add_parser("down", help="tear down a launched cluster")
+    p.add_argument("config")
+    p.set_defaults(fn=cmd_down)
+
+    p = sub.add_parser("exec", help="run a command on the cluster head")
+    p.add_argument("config")
+    p.add_argument("--timeout", type=float, default=600.0)
+    p.add_argument("command", nargs=argparse.REMAINDER)
+    p.set_defaults(fn=cmd_exec)
+
+    p = sub.add_parser("attach", help="open a shell bound to the cluster")
+    p.add_argument("config")
+    p.set_defaults(fn=cmd_attach)
 
     p = sub.add_parser("start", help="start a head or worker node")
     p.add_argument("--head", action="store_true")
@@ -234,6 +324,9 @@ def main(argv=None) -> int:
     p.add_argument("--address", default=None)
     p.add_argument("--host", default="127.0.0.1")
     p.add_argument("--dash-port", type=int, default=8265)
+    p.add_argument("--grafana-out", default=None, metavar="FILE",
+                   help="write importable Grafana dashboard JSON generated "
+                        "from the live metric registry, then exit")
     p.set_defaults(fn=cmd_dashboard)
 
     p = sub.add_parser("job")
@@ -257,6 +350,10 @@ def main(argv=None) -> int:
         ep = getattr(args, "entrypoint", None)
         if ep and ep[0] == "--":
             args.entrypoint = ep[1:]
+    if args.cmd == "exec":
+        cl = getattr(args, "command", None)
+        if cl and cl[0] == "--":
+            args.command = cl[1:]
     return args.fn(args)
 
 

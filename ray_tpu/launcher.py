@@ -1,0 +1,411 @@
+"""Cluster launcher: ``rtpu up cluster.yaml`` and friends.
+
+Parity: reference python/ray/scripts/scripts.py (up/down/attach/exec),
+python/ray/autoscaler/_private/command_runner.py (SSHCommandRunner) and
+_private/updater.py (NodeUpdater) — collapsed for the TPU-pod setting where
+a "worker node" is a host that joins as a host agent, and redesigned around
+one state file per cluster instead of the reference's tag-based rediscovery.
+
+Config schema (YAML)::
+
+    cluster_name: demo
+    provider:
+      type: local | ssh             # where nodes come from
+      head_ip: 10.0.0.2             # ssh: required
+      worker_ips: [10.0.0.3, ...]   # ssh: required
+    auth:                           # ssh only
+      ssh_user: ubuntu
+      ssh_private_key: ~/.ssh/id_rsa
+    head:
+      port: 6380                    # 0/absent -> pick a free port
+      num_cpus: 8                   # optional resource overrides
+    workers:
+      count: 2                      # local: processes; ssh: len(worker_ips)
+      num_cpus: 4
+    setup_commands:                 # run on every node before start
+      - pip install -e .
+    env:                            # exported to every started process
+      RTPU_ARENA_SIZE: "2147483648"
+
+``type: local`` starts every node as a local subprocess through the same
+CommandRunner/NodeUpdater machinery the ssh path uses — it is both the
+single-machine story and the e2e test harness for the launcher itself
+(reference fake_multi_node analog).
+"""
+from __future__ import annotations
+
+import json
+import os
+import shlex
+import socket
+import subprocess
+import tempfile
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+_STATE_DIR = os.path.join(tempfile.gettempdir(), "rtpu_clusters")
+
+
+# ---------------------------------------------------------------------------
+# config
+
+
+@dataclass
+class ClusterConfig:
+    cluster_name: str
+    provider_type: str
+    head_ip: str
+    worker_ips: List[str]
+    head_port: int
+    head_num_cpus: Optional[int]
+    worker_count: int
+    worker_num_cpus: Optional[int]
+    setup_commands: List[str]
+    env: Dict[str, str]
+    ssh_user: str = ""
+    ssh_key: str = ""
+    raw: Dict[str, Any] = field(default_factory=dict)
+
+    @classmethod
+    def load(cls, path: str) -> "ClusterConfig":
+        import yaml
+
+        with open(path) as f:
+            doc = yaml.safe_load(f) or {}
+        return cls.from_dict(doc)
+
+    @classmethod
+    def from_dict(cls, doc: Dict[str, Any]) -> "ClusterConfig":
+        name = doc.get("cluster_name")
+        if not name:
+            raise ValueError("cluster_name is required")
+        prov = doc.get("provider") or {}
+        ptype = prov.get("type", "local")
+        if ptype not in ("local", "ssh"):
+            raise ValueError(f"provider.type must be local|ssh, got {ptype!r}")
+        head = doc.get("head") or {}
+        workers = doc.get("workers") or {}
+        auth = doc.get("auth") or {}
+        worker_ips = list(prov.get("worker_ips") or [])
+        if ptype == "ssh":
+            if not prov.get("head_ip"):
+                raise ValueError("provider.head_ip is required for type: ssh")
+            if not auth.get("ssh_user"):
+                raise ValueError("auth.ssh_user is required for type: ssh")
+        count = int(workers.get("count", len(worker_ips)))
+        return cls(
+            cluster_name=str(name),
+            provider_type=ptype,
+            head_ip=prov.get("head_ip", "127.0.0.1"),
+            worker_ips=worker_ips,
+            head_port=int(head.get("port", 0)),
+            head_num_cpus=head.get("num_cpus"),
+            worker_count=count,
+            worker_num_cpus=workers.get("num_cpus"),
+            setup_commands=list(doc.get("setup_commands") or []),
+            env={k: str(v) for k, v in (doc.get("env") or {}).items()},
+            ssh_user=auth.get("ssh_user", ""),
+            ssh_key=os.path.expanduser(auth.get("ssh_private_key", "")),
+            raw=doc,
+        )
+
+
+# ---------------------------------------------------------------------------
+# command runners (reference: command_runner.py CommandRunnerInterface)
+
+
+class CommandRunner:
+    """Run shell commands on one node."""
+
+    def run(self, cmd: str, env: Optional[Dict[str, str]] = None,
+            timeout: float = 120.0) -> str:
+        raise NotImplementedError
+
+    def run_background(self, cmd: str,
+                       env: Optional[Dict[str, str]] = None) -> int:
+        """Start a long-lived process; return its (remote) pid.
+
+        ``exec`` makes the reported $! the actual command (a forked shell
+        in between would absorb the later kill), and ``setsid`` gives it a
+        fresh process group so teardown can sweep the node process AND
+        everything it spawned (worker subprocesses) with one group kill."""
+        wrapped = (f"setsid nohup sh -c {shlex.quote('exec ' + cmd)} "
+                   f">/tmp/rtpu_launch_$$.log 2>&1 & echo $!")
+        out = self.run(wrapped, env=env)
+        return int(out.strip().splitlines()[-1])
+
+    def kill_tree(self, pid: int) -> None:
+        """Terminate a run_background process group; escalate to KILL."""
+        self.run(f"kill -TERM -- -{pid} 2>/dev/null || "
+                 f"kill -TERM {pid} 2>/dev/null || true; sleep 1; "
+                 f"kill -KILL -- -{pid} 2>/dev/null || true", timeout=30)
+
+
+class LocalCommandRunner(CommandRunner):
+    """Execute on this machine (provider type local + launcher tests).
+
+    Started nodes must import ray_tpu regardless of the operator's cwd, so
+    the package's parent directory is prepended to PYTHONPATH (ssh nodes
+    are expected to have their own install, reference-style)."""
+
+    def run(self, cmd: str, env=None, timeout: float = 120.0) -> str:
+        from ray_tpu import flags
+
+        full_env = flags.child_env(**(env or {}))
+        pkg_parent = os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__)))
+        full_env["PYTHONPATH"] = (
+            pkg_parent + os.pathsep + full_env.get("PYTHONPATH", ""))
+        proc = subprocess.run(["sh", "-c", cmd], capture_output=True,
+                              text=True, timeout=timeout, env=full_env)
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"command failed ({proc.returncode}): {cmd}\n{proc.stderr}")
+        return proc.stdout
+
+
+class SSHCommandRunner(CommandRunner):
+    """Reference command_runner.py:SSHCommandRunner over plain `ssh`."""
+
+    def __init__(self, ip: str, user: str, key: str = "",
+                 ssh_options: Optional[List[str]] = None):
+        self.ip = ip
+        self.user = user
+        self.key = key
+        self.ssh_options = ssh_options or [
+            "-o", "StrictHostKeyChecking=no",
+            "-o", "ConnectTimeout=10",
+            "-o", "BatchMode=yes",
+        ]
+
+    def _base(self) -> List[str]:
+        cmd = ["ssh", *self.ssh_options]
+        if self.key:
+            cmd += ["-i", self.key]
+        cmd.append(f"{self.user}@{self.ip}" if self.user else self.ip)
+        return cmd
+
+    def run(self, cmd: str, env=None, timeout: float = 120.0) -> str:
+        exports = "".join(
+            f"export {k}={shlex.quote(v)}; " for k, v in (env or {}).items())
+        proc = subprocess.run(
+            self._base() + [exports + cmd],
+            capture_output=True, text=True, timeout=timeout)
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"ssh {self.ip} failed ({proc.returncode}): "
+                f"{cmd}\n{proc.stderr}")
+        return proc.stdout
+
+
+# ---------------------------------------------------------------------------
+# node updater (reference: updater.py NodeUpdater.do_update)
+
+
+class NodeUpdater:
+    """Bring one node from bare to running: setup commands, then start."""
+
+    def __init__(self, runner: CommandRunner, config: ClusterConfig):
+        self.runner = runner
+        self.config = config
+
+    def setup(self) -> None:
+        for cmd in self.config.setup_commands:
+            self.runner.run(cmd, env=self.config.env, timeout=600)
+
+    def start_head(self, port: int) -> int:
+        cpus = self.config.head_num_cpus
+        cmd = (f"{_python()} -m ray_tpu.cli start --head --port {port}"
+               + (f" --num-cpus {cpus}" if cpus else ""))
+        return self.runner.run_background(cmd, env=self.config.env)
+
+    def start_worker(self, address: str) -> int:
+        cpus = self.config.worker_num_cpus
+        cmd = (f"{_python()} -m ray_tpu.cli start --address {address}"
+               + (f" --num-cpus {cpus}" if cpus else ""))
+        return self.runner.run_background(cmd, env=self.config.env)
+
+
+def _python() -> str:
+    import sys
+
+    return shlex.quote(sys.executable)
+
+
+# ---------------------------------------------------------------------------
+# launcher
+
+
+class ClusterLauncher:
+    def __init__(self, config: ClusterConfig):
+        self.config = config
+
+    # -- runners ------------------------------------------------------------
+
+    def _runner_for(self, ip: str) -> CommandRunner:
+        if self.config.provider_type == "local":
+            return LocalCommandRunner()
+        return SSHCommandRunner(ip, self.config.ssh_user, self.config.ssh_key)
+
+    def _worker_targets(self) -> List[str]:
+        if self.config.provider_type == "local":
+            return ["127.0.0.1"] * self.config.worker_count
+        ips = self.config.worker_ips
+        if self.config.worker_count and self.config.worker_count < len(ips):
+            ips = ips[: self.config.worker_count]
+        return ips
+
+    # -- verbs --------------------------------------------------------------
+
+    def up(self) -> Dict[str, Any]:
+        cfg = self.config
+        port = cfg.head_port or _free_port()
+        address = f"{cfg.head_ip}:{port}"
+        head_runner = self._runner_for(cfg.head_ip)
+        head_up = NodeUpdater(head_runner, cfg)
+        head_up.setup()
+        # State is saved INCREMENTALLY — the moment anything starts, a
+        # failure (head wait timeout, a worker's setup raising mid-loop)
+        # must leave `down` able to find and kill what's already running,
+        # not orphan live processes behind a missing state file.
+        state = {
+            "cluster_name": cfg.cluster_name,
+            "provider_type": cfg.provider_type,
+            "address": address,
+            "head": {},
+            "workers": [],
+            "started_at": time.time(),
+        }
+        try:
+            head_pid = head_up.start_head(port)
+            state["head"] = {"ip": cfg.head_ip, "pid": head_pid}
+            _save_state(cfg.cluster_name, state)
+            _wait_for_head(address, timeout=30)
+            for ip in self._worker_targets():
+                up = NodeUpdater(self._runner_for(ip), cfg)
+                up.setup()
+                pid = up.start_worker(address)
+                state["workers"].append({"ip": ip, "pid": pid})
+                _save_state(cfg.cluster_name, state)
+            _wait_for_nodes(address, 1 + len(state["workers"]), timeout=60)
+        except BaseException:
+            self.down()  # reap whatever already started
+            raise
+        return state
+
+    def down(self) -> None:
+        state = _load_state(self.config.cluster_name)
+        if state is None:
+            return
+        for w in reversed(state.get("workers", [])):
+            try:
+                self._runner_for(w["ip"]).kill_tree(w["pid"])
+            except Exception:
+                pass
+        head = state.get("head") or {}
+        if head:
+            try:
+                self._runner_for(head["ip"]).kill_tree(head["pid"])
+            except Exception:
+                pass
+        _delete_state(self.config.cluster_name)
+
+    def exec(self, cmd: str, timeout: float = 600.0) -> str:
+        """Run a command on the head with the cluster address exported."""
+        state = _load_state(self.config.cluster_name)
+        if state is None:
+            raise RuntimeError(
+                f"cluster {self.config.cluster_name!r} is not up")
+        runner = self._runner_for(state["head"]["ip"])
+        env = dict(self.config.env)
+        env["RTPU_ADDRESS"] = state["address"]
+        return runner.run(cmd, env=env, timeout=timeout)
+
+    def attach_command(self) -> List[str]:
+        """The interactive command `rtpu attach` should exec."""
+        state = _load_state(self.config.cluster_name)
+        if state is None:
+            raise RuntimeError(
+                f"cluster {self.config.cluster_name!r} is not up")
+        if self.config.provider_type == "local":
+            return ["sh", "-c",
+                    f"RTPU_ADDRESS={state['address']} exec ${{SHELL:-sh}}"]
+        r = SSHCommandRunner(state["head"]["ip"], self.config.ssh_user,
+                             self.config.ssh_key)
+        return r._base() + ["-t",
+                            f"export RTPU_ADDRESS={state['address']}; "
+                            f"exec $SHELL -l"]
+
+
+# ---------------------------------------------------------------------------
+# helpers
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _wait_for_head(address: str, timeout: float) -> None:
+    host, _, port = address.rpartition(":")
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            with socket.create_connection((host, int(port)), timeout=2):
+                return
+        except OSError:
+            time.sleep(0.3)
+    raise TimeoutError(f"head at {address} did not come up in {timeout}s")
+
+
+def _wait_for_nodes(address: str, n: int, timeout: float) -> None:
+    """Block until the controller reports n alive nodes."""
+    from ray_tpu.core import protocol
+    from ray_tpu.core.client import EventLoopThread
+
+    host, _, port = address.rpartition(":")
+    deadline = time.monotonic() + timeout
+    io = EventLoopThread(name="launcher-wait")
+    try:
+        conn = io.call(protocol.connect(host, int(port), name="launcher"),
+                       timeout=10)
+        while time.monotonic() < deadline:
+            state = io.call(conn.request({"kind": "cluster_state"}),
+                            timeout=10)
+            alive = [x for x in state.get("nodes", []) if x.get("alive")]
+            if len(alive) >= n:
+                return
+            time.sleep(0.5)
+        raise TimeoutError(
+            f"only {len(alive)}/{n} nodes joined within {timeout}s")
+    finally:
+        io.stop()
+
+
+def _state_path(name: str) -> str:
+    os.makedirs(_STATE_DIR, exist_ok=True)
+    return os.path.join(_STATE_DIR, f"{name}.json")
+
+
+def _save_state(name: str, state: Dict[str, Any]) -> None:
+    with open(_state_path(name), "w") as f:
+        json.dump(state, f, indent=1)
+
+
+def _load_state(name: str) -> Optional[Dict[str, Any]]:
+    try:
+        with open(_state_path(name)) as f:
+            return json.load(f)
+    except OSError:
+        return None
+
+
+def _delete_state(name: str) -> None:
+    try:
+        os.unlink(_state_path(name))
+    except OSError:
+        pass

@@ -50,12 +50,15 @@ def test_cli_head_status_stop(tmp_path):
     """`start --head` + `status` + `stop` round-trip as real processes."""
     env = dict(os.environ)
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    addrfile = "/tmp/rtpu_head.addr"
+    for stale in (addrfile, "/tmp/rtpu_head.pid"):
+        if os.path.exists(stale):
+            os.unlink(stale)  # a crashed head elsewhere must not misdirect us
     head = subprocess.Popen(
         [sys.executable, "-m", "ray_tpu.cli", "start", "--head",
          "--num-cpus", "2"],
         env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
     try:
-        addrfile = "/tmp/rtpu_head.addr"
         deadline = time.monotonic() + 30
         while time.monotonic() < deadline and not os.path.exists(addrfile):
             time.sleep(0.2)
