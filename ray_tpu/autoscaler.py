@@ -47,9 +47,12 @@ class LocalNodeProvider(NodeProvider):
         self.worker_resources = dict(worker_resources or {"CPU": 1.0})
         self._procs: Dict[str, subprocess.Popen] = {}
 
-    def create_node(self, resources: Optional[Dict[str, float]] = None) -> str:
+    def create_node(self, resources: Optional[Dict[str, float]] = None,
+                    tag: Optional[str] = None) -> str:
+        """``tag`` overrides the autoscaled label — slice bootstrappers pass
+        the pod name so every slice host maps back to its provider node."""
         res = dict(resources or self.worker_resources)
-        tag = f"auto-{uuid.uuid4().hex[:8]}"
+        tag = tag or f"auto-{uuid.uuid4().hex[:8]}"
         env = flags.child_env()
         env.pop("RTPU_ARENA", None)
         env.pop("RTPU_HOST_ID", None)
