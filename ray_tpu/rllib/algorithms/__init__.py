@@ -1,5 +1,7 @@
 from .dqn import DQN, DQNConfig
+from .sac import SAC, SACConfig
 from .impala import IMPALA, IMPALAConfig
 from .ppo import PPO, PPOConfig
 
-__all__ = ["PPO", "PPOConfig", "IMPALA", "IMPALAConfig", "DQN", "DQNConfig"]
+__all__ = ["PPO", "PPOConfig", "IMPALA", "IMPALAConfig", "DQN",
+           "DQNConfig", "SAC", "SACConfig"]

@@ -33,6 +33,9 @@ class DQNConfig(AlgorithmConfig):
         self.epsilon_timesteps: int = 10_000
         self.num_td_updates_per_iter: int = 32
         self.gamma: float = 0.99
+        # Reference replay_buffer_config dicts: {"type": "uniform" |
+        # "prioritized", "alpha": 0.6, "beta": 0.4}.
+        self.replay_buffer_config: dict = {"type": "uniform"}
 
 
 class DQNModule(RLModule):
@@ -70,7 +73,10 @@ class DQNLearner(JaxLearner):
     def __init__(self, module, cfg: DQNConfig, **kw):
         self.cfg = cfg
         super().__init__(module, lr=cfg.lr, grad_clip=cfg.grad_clip, **kw)
-        self._target_params = jax.tree.map(lambda x: x, self.params)
+        # jnp.copy, not identity: the update donates params while the
+        # target rides the batch pytree (aliased donated buffers are an
+        # XLA error; the old buffer dies with the donation).
+        self._target_params = jax.tree.map(jnp.copy, self.params)
 
     def loss(self, params, batch, rng):
         cfg = self.cfg
@@ -83,17 +89,48 @@ class DQNLearner(JaxLearner):
             1.0 - batch["dones"]) * jnp.max(q_next, axis=-1)
         target = jax.lax.stop_gradient(target)
         err = q_sa - target
-        # Huber loss (reference default).
+        # Huber loss (reference default), importance-weighted when the
+        # batch came from a prioritized buffer (weights key is static per
+        # compiled variant — uniform and prioritized batches trace apart).
         huber = jnp.where(jnp.abs(err) < 1.0, 0.5 * err**2,
                           jnp.abs(err) - 0.5)
-        loss = jnp.mean(huber)
-        return loss, {"td_loss": loss, "mean_q": jnp.mean(q_sa)}
+        if "weights" in batch:
+            loss = jnp.mean(batch["weights"] * huber)
+        else:
+            loss = jnp.mean(huber)
+        # Per-row |err| rides the aux output so prioritized replay gets
+        # its priority signal from THIS update — no second forward pass.
+        return loss, {"td_loss": loss, "mean_q": jnp.mean(q_sa),
+                      "td_abs": jax.lax.stop_gradient(jnp.abs(err))}
+
+    def td_errors(self, batch: Dict[str, np.ndarray]) -> np.ndarray:
+        """|TD error| per row on CURRENT params — the prioritized buffer's
+        priority signal (reference: prioritized buffer update after each
+        train batch)."""
+        if not hasattr(self, "_jit_td_errors"):
+            def _td(params, batch):
+                cfg = self.cfg
+                q = self.module.forward(params, batch["obs"])["logits"]
+                q_sa = jnp.take_along_axis(
+                    q, batch["actions"][:, None].astype(jnp.int32),
+                    axis=1)[:, 0]
+                q_next = self.module.forward(
+                    batch["target_params"], batch["next_obs"])["logits"]
+                target = batch["rewards"] + cfg.gamma * (
+                    1.0 - batch["dones"]) * jnp.max(q_next, axis=-1)
+                return jnp.abs(q_sa - target)
+
+            self._jit_td_errors = jax.jit(_td)
+        dev = self._shard_batch(
+            {k: v for k, v in batch.items() if k != "weights"})
+        dev["target_params"] = self._target_params
+        return np.asarray(self._jit_td_errors(self.params, dev))
 
     def sync_target(self) -> None:
         """Copy current params into the target network — called only at
         target_network_update_freq, so the big pytree never rides the
         per-update RPC."""
-        self._target_params = jax.tree.map(lambda x: x, self.params)
+        self._target_params = jax.tree.map(jnp.copy, self.params)
 
     def update_td(self, batch: Dict[str, np.ndarray]) -> Dict[str, float]:
         # One full-batch jitted TD step. The target params join the batch
@@ -102,51 +139,19 @@ class DQNLearner(JaxLearner):
         dev["target_params"] = self._target_params
         self.params, self.opt_state, metrics = self._jit_update(
             self.params, self.opt_state, dev, self._consume_rng())
+        self._last_td_abs = np.asarray(metrics.pop("td_abs"))
         return {k: float(v) for k, v in metrics.items()}
 
+    def take_td_errors(self) -> np.ndarray:
+        """|TD errors| of the LAST update_td batch (prioritized replay)."""
+        return getattr(self, "_last_td_abs", np.zeros(0, np.float32))
 
-class ReplayBuffer:
-    """Uniform FIFO transition buffer (reference:
-    utils/replay_buffers/replay_buffer.py)."""
 
-    def __init__(self, capacity: int, obs_shape: Tuple[int, ...]):
-        self.capacity = capacity
-        self.size = 0
-        self.pos = 0
-        self.obs = np.zeros((capacity, *obs_shape), np.float32)
-        self.next_obs = np.zeros((capacity, *obs_shape), np.float32)
-        self.actions = np.zeros((capacity,), np.int32)
-        self.rewards = np.zeros((capacity,), np.float32)
-        self.dones = np.zeros((capacity,), np.float32)
-
-    def add_episodes(self, episodes: List[SingleAgentEpisode]) -> int:
-        n = 0
-        for ep in episodes:
-            T = len(ep.actions)
-            for t in range(T):
-                nxt = ep.observations[t + 1] if t + 1 < len(ep.observations) \
-                    else ep.observations[t]
-                done = float(ep.terminated and t == T - 1)
-                i = self.pos
-                self.obs[i] = ep.observations[t]
-                self.next_obs[i] = nxt
-                self.actions[i] = ep.actions[t]
-                self.rewards[i] = ep.rewards[t]
-                self.dones[i] = done
-                self.pos = (self.pos + 1) % self.capacity
-                self.size = min(self.size + 1, self.capacity)
-                n += 1
-        return n
-
-    def sample(self, batch_size: int, rng: np.random.Generator):
-        idx = rng.integers(0, self.size, batch_size)
-        return {
-            "obs": self.obs[idx],
-            "next_obs": self.next_obs[idx],
-            "actions": self.actions[idx],
-            "rewards": self.rewards[idx],
-            "dones": self.dones[idx],
-        }
+# The buffer implementation moved to the shared suite (uniform +
+# prioritized, discrete + continuous actions); DQN consumes it via
+# make_buffer and this re-export keeps the old import path working.
+from ..utils.replay_buffers import (  # noqa: E402
+    PrioritizedReplayBuffer, ReplayBuffer, make_buffer)
 
 
 class DQN(Algorithm):
@@ -193,7 +198,8 @@ class DQN(Algorithm):
             # The buffer stores CONNECTED observations (what the module sees).
             obs_shape = tuple(
                 cfg.env_to_module_connector().output_shape(obs_shape))
-        self._buffer = ReplayBuffer(cfg.replay_buffer_capacity, obs_shape)
+        self._buffer = make_buffer(getattr(cfg, "replay_buffer_config", None),
+                                   cfg.replay_buffer_capacity, obs_shape)
         self.learner_group.call("sync_target")
         self._steps_since_target_sync = 0
         self._np_rng = np.random.default_rng(cfg.seed)
@@ -221,9 +227,15 @@ class DQN(Algorithm):
 
         metrics: Dict[str, Any] = {}
         if self._buffer.size >= cfg.learning_starts:
+            prioritized = isinstance(self._buffer, PrioritizedReplayBuffer)
             for _ in range(cfg.num_td_updates_per_iter):
                 batch = self._buffer.sample(cfg.minibatch_size, self._np_rng)
+                idx = batch.pop("idx", None)
                 metrics = self.learner_group.call("update_td", batch)
+                if prioritized and idx is not None:
+                    td = self.learner_group.call("take_td_errors")
+                    if len(td):
+                        self._buffer.update_priorities(idx, td)
             if self._steps_since_target_sync >= cfg.target_network_update_freq:
                 self.learner_group.call("sync_target")
                 self._steps_since_target_sync = 0

@@ -1,0 +1,333 @@
+"""SAC (Soft Actor-Critic) on the jax learner stack — the continuous-
+control algorithm of the suite.
+
+Parity: reference rllib/algorithms/sac/ (sac.py training_step: rollout ->
+replay buffer -> off-policy updates; squashed-Gaussian policy from
+torch_distributions, twin Q networks, polyak-averaged targets, learnable
+entropy temperature against a target entropy of -|A|).
+
+TPU-native shape: one jitted program per update step carries all three
+losses (critic, actor, temperature) over ONE combined params pytree with a
+single optimizer; gradient isolation between the heads uses
+``stop_gradient`` on the param SUBTREES (stopping dQ/dtheta_Q in the actor
+term while the action path dQ/da stays differentiable), so there is no
+multi-optimizer bookkeeping to keep functional. The polyak target update
+is a second tiny jitted map fused onto the step.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..algorithm import Algorithm
+from ..algorithm_config import AlgorithmConfig
+from ..core.learner import JaxLearner
+from ..core.rl_module import RLModule, _dense, _dense_init
+from ..utils.replay_buffers import PrioritizedReplayBuffer, make_buffer
+
+_LOG_STD_MIN, _LOG_STD_MAX = -20.0, 2.0
+
+
+class SACConfig(AlgorithmConfig):
+    def __init__(self, algo_class=None):
+        super().__init__(algo_class=algo_class or SAC)
+        self.replay_buffer_capacity: int = 100_000
+        self.replay_buffer_config: dict = {"type": "uniform"}
+        self.learning_starts: int = 500
+        self.num_updates_per_iter: int = 32
+        self.gamma: float = 0.99
+        self.tau: float = 0.005           # polyak target coefficient
+        self.initial_alpha: float = 1.0
+        # None -> -|A| (reference heuristic).
+        self.target_entropy: Optional[float] = None
+
+
+def _mlp(rng, sizes, out_dim, out_scale=1.0):
+    n = len(sizes) - 1
+    keys = jax.random.split(rng, n + 1)
+    layers = [_dense_init(keys[i], sizes[i], sizes[i + 1]) for i in range(n)]
+    layers.append(_dense_init(keys[-1], sizes[-1], out_dim, scale=out_scale))
+    return layers
+
+
+def _apply(layers, x):
+    h = x.astype(jnp.float32)
+    for layer in layers[:-1]:
+        h = jnp.tanh(_dense(layer, h))
+    return _dense(layers[-1], h)
+
+
+class SACModule(RLModule):
+    """Squashed-Gaussian actor + twin Q critics.
+
+    Actions live in [-1, 1] module-side and are affinely mapped to the
+    env's Box bounds (the mapping is part of the module so stored
+    transitions hold MODULE actions and the critics see a consistent
+    space — reference: action squashing in SquashedGaussian)."""
+
+    def __init__(self, obs_dim: int, act_dim: int,
+                 low: np.ndarray, high: np.ndarray, hiddens=(256, 256)):
+        self.obs_dim = obs_dim
+        self.act_dim = act_dim
+        self.hiddens = tuple(hiddens)
+        self._scale = jnp.asarray((high - low) / 2.0, jnp.float32)
+        self._center = jnp.asarray((high + low) / 2.0, jnp.float32)
+
+    def init(self, rng: jax.Array):
+        k_actor, k_q1, k_q2 = jax.random.split(rng, 3)
+        sizes = (self.obs_dim,) + self.hiddens
+        q_sizes = (self.obs_dim + self.act_dim,) + self.hiddens
+        return {
+            "actor": _mlp(k_actor, sizes, 2 * self.act_dim, out_scale=0.01),
+            "q1": _mlp(k_q1, q_sizes, 1),
+            "q2": _mlp(k_q2, q_sizes, 1),
+            "log_alpha": jnp.asarray(0.0, jnp.float32),
+        }
+
+    # ------------------------------------------------------------- policy
+
+    def _dist(self, params, obs):
+        out = _apply(params["actor"], obs)
+        mu, log_std = jnp.split(out, 2, axis=-1)
+        log_std = jnp.clip(log_std, _LOG_STD_MIN, _LOG_STD_MAX)
+        return mu, log_std
+
+    def sample_action(self, params, obs, rng):
+        """Reparameterized squashed sample -> (action, log_prob)."""
+        mu, log_std = self._dist(params, obs)
+        std = jnp.exp(log_std)
+        eps = jax.random.normal(rng, mu.shape)
+        pre = mu + std * eps
+        act = jnp.tanh(pre)
+        # log N(pre) - log |d tanh/d pre|, summed over action dims
+        # (squash correction in its numerically-stable softplus form).
+        logp_gauss = -0.5 * (eps**2 + 2 * log_std
+                             + jnp.log(2 * jnp.pi)).sum(-1)
+        corr = (2 * (jnp.log(2.0) - pre
+                     - jax.nn.softplus(-2 * pre))).sum(-1)
+        return act, logp_gauss - corr
+
+    def q_values(self, params, obs, act):
+        x = jnp.concatenate([obs.astype(jnp.float32), act], axis=-1)
+        q1 = _apply(params["q1"], x)[..., 0]
+        q2 = _apply(params["q2"], x)[..., 0]
+        return q1, q2
+
+    def to_env(self, act: jax.Array) -> jax.Array:
+        return act * self._scale + self._center
+
+    # ------------------------------------- runner protocol (RLModule API)
+
+    def forward(self, params, obs):
+        mu, _ = self._dist(params, obs)
+        det = jnp.tanh(mu)
+        q1, q2 = self.q_values(params, obs, det)
+        return {"logits": mu, "vf": jnp.minimum(q1, q2)}
+
+    def forward_exploration(self, params, obs, rng):
+        act, logp = self.sample_action(params, obs, rng)
+        q1, q2 = self.q_values(params, obs, act)
+        return self.to_env(act), logp, jnp.minimum(q1, q2)
+
+
+class SACLearner(JaxLearner):
+    def __init__(self, module: SACModule, cfg: SACConfig, **kw):
+        self.cfg = cfg
+        self._target_entropy = (
+            cfg.target_entropy if cfg.target_entropy is not None
+            else -float(module.act_dim))
+        super().__init__(module, lr=cfg.lr, grad_clip=cfg.grad_clip, **kw)
+        if cfg.initial_alpha != 1.0:
+            self.params["log_alpha"] = jnp.asarray(
+                np.log(cfg.initial_alpha), jnp.float32)
+        # REAL copies, not aliases: the update donates params while the
+        # targets ride the batch pytree — an aliased buffer appearing as
+        # both donated argument and input is an XLA error (`f(donate(a),
+        # a)`), and after donation the old buffer is dead anyway.
+        self._target_q = {
+            "q1": jax.tree.map(jnp.copy, self.params["q1"]),
+            "q2": jax.tree.map(jnp.copy, self.params["q2"]),
+        }
+        tau = cfg.tau
+        self._jit_polyak = jax.jit(
+            lambda tgt, src: jax.tree.map(
+                lambda t, s: (1.0 - tau) * t + tau * s, tgt, src))
+
+    def loss(self, params, batch, rng):
+        cfg = self.cfg
+        m: SACModule = self.module
+        obs, next_obs = batch["obs"], batch["next_obs"]
+        # Stored actions are MODULE actions (pre-scaling): map env actions
+        # back (runner records to_env outputs).
+        act = (batch["actions"] - m._center) / m._scale
+        act = jnp.clip(act, -0.999, 0.999)
+        alpha = jnp.exp(params["log_alpha"])
+        r_next, r_pi = jax.random.split(rng)
+
+        # --- critic: y = r + gamma (1-d) [min tQ(s',a') - a log pi(a'|s')]
+        next_act, next_logp = m.sample_action(params, next_obs, r_next)
+        tq = {"q1": batch["target_q1"], "q2": batch["target_q2"],
+              "log_alpha": params["log_alpha"], "actor": params["actor"]}
+        tq1, tq2 = m.q_values(tq, next_obs, next_act)
+        y = batch["rewards"] + cfg.gamma * (1.0 - batch["dones"]) * (
+            jnp.minimum(tq1, tq2)
+            - jax.lax.stop_gradient(alpha) * next_logp)
+        y = jax.lax.stop_gradient(y)
+        q1, q2 = m.q_values(params, obs, act)
+        critic_err = (q1 - y) ** 2 + (q2 - y) ** 2
+        td_abs = jax.lax.stop_gradient(jnp.abs(jnp.minimum(q1, q2) - y))
+        if "weights" in batch:
+            critic_loss = 0.5 * jnp.mean(batch["weights"] * critic_err)
+        else:
+            critic_loss = 0.5 * jnp.mean(critic_err)
+
+        # --- actor: a log pi - min Q  (Q params frozen: stop_gradient on
+        # the SUBTREE keeps dQ/da while killing dQ/dtheta_Q)
+        pi_act, pi_logp = m.sample_action(params, obs, r_pi)
+        frozen = {"q1": jax.lax.stop_gradient(params["q1"]),
+                  "q2": jax.lax.stop_gradient(params["q2"]),
+                  "actor": params["actor"],
+                  "log_alpha": params["log_alpha"]}
+        fq1, fq2 = m.q_values(frozen, obs, pi_act)
+        actor_loss = jnp.mean(
+            jax.lax.stop_gradient(alpha) * pi_logp - jnp.minimum(fq1, fq2))
+
+        # --- temperature: drive E[-log pi] toward the target entropy
+        alpha_loss = -jnp.mean(
+            params["log_alpha"]
+            * jax.lax.stop_gradient(pi_logp + self._target_entropy))
+
+        loss = critic_loss + actor_loss + alpha_loss
+        return loss, {
+            "critic_loss": critic_loss,
+            "actor_loss": actor_loss,
+            "alpha_loss": alpha_loss,
+            "alpha": alpha,
+            "mean_q": jnp.mean(q1),
+            "entropy": -jnp.mean(pi_logp),
+            # Per-row priority signal for prioritized replay — rides the
+            # update's aux output so no second forward pass is needed.
+            "td_abs": td_abs,
+        }
+
+    def update_sac(self, batch: Dict[str, np.ndarray]) -> Dict[str, float]:
+        dev = self._shard_batch(batch)
+        dev["target_q1"] = self._target_q["q1"]
+        dev["target_q2"] = self._target_q["q2"]
+        self.params, self.opt_state, metrics = self._jit_update(
+            self.params, self.opt_state, dev, self._consume_rng())
+        self._target_q = self._jit_polyak(
+            self._target_q,
+            {"q1": self.params["q1"], "q2": self.params["q2"]})
+        self._last_td_abs = np.asarray(metrics.pop("td_abs"))
+        return {k: float(v) for k, v in metrics.items()}
+
+    def take_td_errors(self) -> np.ndarray:
+        """|TD errors| of the LAST update_sac batch (prioritized replay)."""
+        return getattr(self, "_last_td_abs", np.zeros(0, np.float32))
+
+    def td_errors(self, batch: Dict[str, np.ndarray]) -> np.ndarray:
+        """|min-Q TD error| for prioritized replay."""
+        if not hasattr(self, "_jit_td"):
+            def _td(params, batch, rng):
+                m = self.module
+                act = jnp.clip(
+                    (batch["actions"] - m._center) / m._scale, -0.999, 0.999)
+                next_act, next_logp = m.sample_action(
+                    params, batch["next_obs"], rng)
+                tq = {"q1": batch["target_q1"], "q2": batch["target_q2"],
+                      "log_alpha": params["log_alpha"],
+                      "actor": params["actor"]}
+                tq1, tq2 = m.q_values(tq, batch["next_obs"], next_act)
+                alpha = jnp.exp(params["log_alpha"])
+                y = batch["rewards"] + self.cfg.gamma * (
+                    1.0 - batch["dones"]) * (
+                    jnp.minimum(tq1, tq2) - alpha * next_logp)
+                q1, q2 = m.q_values(params, batch["obs"], act)
+                return jnp.abs(jnp.minimum(q1, q2) - y)
+
+            self._jit_td = jax.jit(_td)
+        dev = self._shard_batch(
+            {k: v for k, v in batch.items() if k != "weights"})
+        dev["target_q1"] = self._target_q["q1"]
+        dev["target_q2"] = self._target_q["q2"]
+        return np.asarray(self._jit_td(self.params, dev, self._consume_rng()))
+
+
+class SAC(Algorithm):
+    config_cls = SACConfig
+
+    def _spaces(self) -> Tuple[Tuple[int, ...], int, np.ndarray, np.ndarray]:
+        cfg = self._algo_config
+        env = cfg.make_env_creator()()
+        try:
+            obs_shape = env.observation_space.shape
+            space = env.action_space
+            low = np.asarray(space.low, np.float32)
+            high = np.asarray(space.high, np.float32)
+            return obs_shape, int(np.prod(space.shape)), low, high
+        finally:
+            env.close()
+
+    def _module_factory(self):
+        cfg = self._algo_config
+        obs_shape, act_dim, low, high = self._spaces()
+        obs_dim = int(np.prod(obs_shape))
+        hiddens = tuple(cfg.model.get("fcnet_hiddens", (256, 256)))
+
+        def factory():
+            return SACModule(obs_dim, act_dim, low, high, hiddens)
+
+        return factory
+
+    def _learner_factory(self):
+        cfg = self._algo_config
+        module_factory = self._module_factory()
+
+        def factory():
+            return SACLearner(module_factory(), cfg, mesh=cfg.learner_mesh,
+                              seed=cfg.seed)
+
+        return factory
+
+    def _setup_extra(self) -> None:
+        cfg = self._algo_config
+        obs_shape, act_dim, _, _ = self._spaces()
+        self._buffer = make_buffer(
+            cfg.replay_buffer_config, cfg.replay_buffer_capacity, obs_shape,
+            action_shape=(act_dim,), action_dtype=np.float32)
+        self._np_rng = np.random.default_rng(cfg.seed)
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg = self._algo_config
+        if not hasattr(self, "_buffer"):
+            self._setup_extra()
+        weights = self.learner_group.get_weights()
+        self.env_runner_group.sync_weights(weights)
+
+        episodes = self.env_runner_group.sample(cfg.train_batch_size)
+        self._record_episodes(episodes)
+        episodes = self._connect_episodes(episodes)
+        added = self._buffer.add_episodes(episodes)
+
+        metrics: Dict[str, Any] = {}
+        if self._buffer.size >= cfg.learning_starts:
+            prioritized = isinstance(self._buffer, PrioritizedReplayBuffer)
+            for _ in range(cfg.num_updates_per_iter):
+                batch = self._buffer.sample(cfg.minibatch_size, self._np_rng)
+                idx = batch.pop("idx", None)
+                metrics = self.learner_group.call("update_sac", batch)
+                if prioritized and idx is not None:
+                    td = self.learner_group.call("take_td_errors")
+                    if len(td):
+                        self._buffer.update_priorities(idx, td)
+
+        out = dict(metrics)
+        out["buffer_size"] = self._buffer.size
+        out["episode_return_mean"] = self.episode_return_mean
+        out["num_episodes"] = len(episodes)
+        out["env_steps_this_iter"] = added
+        return out

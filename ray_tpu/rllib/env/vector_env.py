@@ -127,3 +127,76 @@ class CnnRolloutBenchEnv(BatchedEnv):
         # done envs (the bank walk just continues).
         trunc = np.zeros(self.num_envs, bool)
         return obs, rew, term, trunc
+
+
+class CartPoleBatchedEnv(BatchedEnv):
+    """Vectorized CartPole-v1: the WHOLE batch integrates in ~6 numpy ops.
+
+    Same dynamics, reward and termination thresholds as gymnasium's
+    CartPole-v1 (Euler integration, tau=0.02, 500-step truncation) — but
+    no per-env Python objects, so a single core steps hundreds of
+    thousands of env-steps/s instead of ~10k. This is the envpool-style
+    answer the reference reaches for at its 1M env-steps/s scale: the env
+    batch is array state, policy inference is one batched forward, and
+    nothing in the sampling loop is O(num_envs) Python.
+
+    SAME_STEP autoreset: terminated/truncated columns return the reset
+    observation immediately (CartPole is termination-heavy; the masked
+    invalid rows of NEXT_STEP would waste ~1/200 of throughput)."""
+
+    autoreset_mode = "same_step"
+
+    GRAVITY, MASSCART, MASSPOLE = 9.8, 1.0, 0.1
+    LENGTH, FORCE_MAG, TAU = 0.5, 10.0, 0.02
+    THETA_LIMIT = 12 * 2 * np.pi / 360
+    X_LIMIT = 2.4
+    MAX_STEPS = 500
+
+    def __init__(self, num_envs: int, seed: int = 0):
+        import gymnasium as gym
+
+        self.num_envs = num_envs
+        self._rng = np.random.default_rng(seed)
+        self._state = np.zeros((num_envs, 4), np.float64)
+        self._t = np.zeros(num_envs, np.int64)
+        self.single_observation_space = gym.spaces.Box(
+            -np.inf, np.inf, (4,), np.float32)
+        self.single_action_space = gym.spaces.Discrete(2)
+
+    def _reset_rows(self, rows: np.ndarray) -> None:
+        n = int(rows.sum()) if rows.dtype == bool else len(rows)
+        if n:
+            self._state[rows] = self._rng.uniform(-0.05, 0.05, (n, 4))
+            self._t[rows] = 0
+
+    def reset(self, seed: Optional[int] = None) -> np.ndarray:
+        if seed is not None:
+            self._rng = np.random.default_rng(seed)
+        self._reset_rows(np.ones(self.num_envs, bool))
+        return self._state.astype(np.float32)
+
+    def step(self, actions):
+        x, x_dot, theta, theta_dot = self._state.T
+        force = np.where(np.asarray(actions) == 1, self.FORCE_MAG,
+                         -self.FORCE_MAG)
+        cos, sin = np.cos(theta), np.sin(theta)
+        total_mass = self.MASSCART + self.MASSPOLE
+        polemass_length = self.MASSPOLE * self.LENGTH
+        temp = (force + polemass_length * theta_dot**2 * sin) / total_mass
+        theta_acc = (self.GRAVITY * sin - cos * temp) / (
+            self.LENGTH * (4.0 / 3.0 - self.MASSPOLE * cos**2 / total_mass))
+        x_acc = temp - polemass_length * theta_acc * cos / total_mass
+        x = x + self.TAU * x_dot
+        x_dot = x_dot + self.TAU * x_acc
+        theta = theta + self.TAU * theta_dot
+        theta_dot = theta_dot + self.TAU * theta_acc
+        self._state = np.stack([x, x_dot, theta, theta_dot], axis=1)
+        self._t += 1
+
+        term = (np.abs(x) > self.X_LIMIT) | (np.abs(theta) > self.THETA_LIMIT)
+        trunc = (self._t >= self.MAX_STEPS) & ~term
+        rew = np.ones(self.num_envs, np.float32)
+        done = term | trunc
+        if done.any():
+            self._reset_rows(done)  # SAME_STEP: fresh obs ride this return
+        return self._state.astype(np.float32), rew, term, trunc

@@ -63,7 +63,10 @@ def test_bc_imitates_logged_policy(tmp_path, ray_start_regular):
         .build()
     )
     first = algo.train()["bc_nll"]
-    for _ in range(6):
+    # 9 iterations, not 6: the accuracy check below sat at ~0.895 on an
+    # unlucky shuffle order (threshold 0.9) — a little more training makes
+    # the margin comfortable without changing what is being asserted.
+    for _ in range(9):
         last = algo.train()["bc_nll"]
     assert last < first * 0.7, (first, last)
     # The cloned policy reproduces the logged rule.

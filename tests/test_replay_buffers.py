@@ -1,0 +1,147 @@
+"""Replay-buffer suite + SAC continuous control (reference:
+rllib/utils/replay_buffers/, rllib/algorithms/sac/)."""
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.rllib.utils.replay_buffers import (PrioritizedReplayBuffer,
+                                                ReplayBuffer, make_buffer)
+
+
+def test_uniform_buffer_ring_and_shapes():
+    b = ReplayBuffer(8, (3,))
+    for i in range(12):
+        b.add(np.full(3, i), np.full(3, i + 1), i % 2, float(i), 0.0)
+    assert len(b) == 8 and b.pos == 4
+    s = b.sample(16, np.random.default_rng(0))
+    assert s["obs"].shape == (16, 3)
+    assert s["actions"].dtype == np.int32
+    # Ring overwrote the oldest 4: values 0..3 are gone.
+    assert b.rewards.min() >= 4.0
+
+
+def test_continuous_action_columns():
+    b = ReplayBuffer(16, (2,), action_shape=(3,), action_dtype=np.float32)
+    b.add(np.zeros(2), np.ones(2), np.array([0.1, -0.2, 0.3]), 1.0, 0.0)
+    s = b.sample(2, np.random.default_rng(0))
+    assert s["actions"].shape == (2, 3) and s["actions"].dtype == np.float32
+
+
+def test_prioritized_sampling_follows_priorities():
+    rng = np.random.default_rng(0)
+    b = PrioritizedReplayBuffer(32, (1,), alpha=1.0, beta=1.0)
+    for i in range(32):
+        b.add([i], [i + 1], 0, float(i), 0.0)
+    # Give row 7 overwhelming priority.
+    b.update_priorities(np.arange(32), np.full(32, 1e-3))
+    b.update_priorities(np.array([7]), np.array([100.0]))
+    s = b.sample(256, rng)
+    frac7 = float(np.mean(s["idx"] == 7))
+    assert frac7 > 0.9, frac7
+    # IS weights: the over-sampled row carries the SMALLEST weight.
+    w7 = s["weights"][s["idx"] == 7]
+    assert w7.max() <= s["weights"].max()
+    assert np.isclose(s["weights"].max(), 1.0)
+
+
+def test_prioritized_new_items_seen():
+    rng = np.random.default_rng(1)
+    b = PrioritizedReplayBuffer(64, (1,))
+    for i in range(20):
+        b.add([i], [i + 1], 0, 0.0, 0.0)
+    s = b.sample(512, rng)
+    assert len(np.unique(s["idx"])) >= 15  # max-priority init: broad reach
+
+
+def test_make_buffer_config_dispatch():
+    assert isinstance(make_buffer({"type": "prioritized"}, 8, (1,)),
+                      PrioritizedReplayBuffer)
+    assert isinstance(make_buffer(None, 8, (1,)), ReplayBuffer)
+    b = make_buffer({"type": "PrioritizedEpisodeReplayBuffer",
+                     "alpha": 0.5, "beta": 0.3}, 8, (1,))
+    assert isinstance(b, PrioritizedReplayBuffer)
+    assert b.alpha == 0.5 and b.beta == 0.3
+
+
+def test_sac_trains_pendulum(ray_start_regular):
+    """SAC mechanics on Pendulum-v1: squashed-Gaussian sampling, twin-Q
+    targets with polyak averaging, temperature auto-tuning — and the
+    policy measurably beats random (full convergence to ~-200 needs more
+    steps than CI affords; the reference's CI smoke is the same shape)."""
+    from ray_tpu.rllib.algorithms.sac import SACConfig
+
+    config = (
+        SACConfig()
+        .environment("Pendulum-v1")
+        .env_runners(num_env_runners=0, num_envs_per_env_runner=8,
+                     rollout_fragment_length=64)
+        .training(train_batch_size=512, minibatch_size=128, lr=3e-4)
+    )
+    config.learning_starts = 400
+    config.num_updates_per_iter = 24
+    algo = config.build()
+    returns = []
+    r = None
+    for _ in range(14):
+        r = algo.train()
+        returns.append(r["episode_return_mean"])
+    assert r["buffer_size"] > 3000
+    for k in ("critic_loss", "actor_loss", "alpha_loss", "alpha",
+              "entropy"):
+        assert k in r and np.isfinite(r[k]), (k, r)
+    # Random policy on Pendulum averages about -1200..-1500; learning
+    # must show (the early-iteration mean includes warmup episodes).
+    early = np.mean([x for x in returns[:3] if x is not None and x == x])
+    late = np.mean([x for x in returns[-3:] if x is not None and x == x])
+    assert late > early + 50 or late > -900, (early, late, returns)
+    algo.stop()
+
+
+def test_sac_prioritized_replay(ray_start_regular):
+    """SAC composes with the prioritized buffer: priorities update from
+    |TD error| and importance weights reach the critic loss."""
+    from ray_tpu.rllib.algorithms.sac import SACConfig
+
+    config = (
+        SACConfig()
+        .environment("Pendulum-v1")
+        .env_runners(num_env_runners=0, num_envs_per_env_runner=4,
+                     rollout_fragment_length=32)
+        .training(train_batch_size=128, minibatch_size=64, lr=3e-4)
+    )
+    config.learning_starts = 100
+    config.num_updates_per_iter = 4
+    config.replay_buffer_config = {"type": "prioritized", "alpha": 0.6,
+                                   "beta": 0.4}
+    algo = config.build()
+    r = None
+    for _ in range(4):
+        r = algo.train()
+    assert isinstance(algo._buffer, PrioritizedReplayBuffer)
+    assert np.isfinite(r["critic_loss"])
+    # Priorities moved off the max-priority init for sampled rows.
+    vals = algo._buffer._tree.values[:algo._buffer.size]
+    assert (vals[vals > 0].min() < algo._buffer._max_priority ** 0.6), vals
+    algo.stop()
+
+
+def test_dqn_uses_shared_buffer_and_prioritized(ray_start_regular):
+    """DQN runs on the extracted suite, uniform and prioritized."""
+    from ray_tpu.rllib.algorithms.dqn import DQNConfig
+
+    config = (
+        DQNConfig()
+        .environment("CartPole-v1")
+        .env_runners(num_env_runners=0, num_envs_per_env_runner=4,
+                     rollout_fragment_length=32)
+        .training(train_batch_size=128, minibatch_size=64, lr=1e-3)
+    )
+    config.learning_starts = 100
+    config.num_td_updates_per_iter = 4
+    config.replay_buffer_config = {"type": "prioritized"}
+    algo = config.build()
+    for _ in range(3):
+        r = algo.train()
+    assert isinstance(algo._buffer, PrioritizedReplayBuffer)
+    assert np.isfinite(r["td_loss"])
+    algo.stop()

@@ -175,3 +175,62 @@ def test_ppo_trains_on_fragments():
         # A leaked init breaks the next module's stricter init fixture
         # (test_runtime_env's renv_cluster inits without reinit tolerance).
         ray_tpu.shutdown()
+
+
+def test_batched_cartpole_matches_gym_dynamics():
+    """The vectorized CartPole integrates the same physics as gymnasium's
+    (same constants, Euler steps): drive both with the same action
+    sequence from the same start state and compare trajectories."""
+    import gymnasium as gym
+
+    from ray_tpu.rllib.env.vector_env import CartPoleBatchedEnv
+
+    ref = gym.make("CartPole-v1")
+    ref_obs, _ = ref.reset(seed=3)
+    env = CartPoleBatchedEnv(2, seed=0)
+    env.reset()
+    env._state[0] = ref_obs  # align starting state for column 0
+    env._t[0] = 0
+    rng = np.random.default_rng(5)
+    for _ in range(30):
+        a = int(rng.integers(0, 2))
+        ref_obs, ref_r, ref_term, ref_trunc, _ = ref.step(a)
+        obs, r, term, trunc = env.step(np.array([a, 1 - a]))
+        assert r[0] == ref_r
+        assert bool(term[0]) == bool(ref_term)
+        if ref_term or ref_trunc:
+            # SAME_STEP autoreset: the batched env already returned the
+            # NEXT episode's reset obs here, gym returns the final obs —
+            # the flags matching is the assertion on this step.
+            break
+        np.testing.assert_allclose(obs[0], ref_obs, rtol=1e-5, atol=1e-6)
+
+
+def test_ppo_learns_on_batched_cartpole(ray_start_regular):
+    """PPO's fragment path over the vectorized env LEARNS (mean return
+    grows) — proves reward/termination semantics, not just throughput."""
+    from ray_tpu.rllib.algorithms.ppo import PPOConfig
+    from ray_tpu.rllib.env.vector_env import CartPoleBatchedEnv
+
+    def batched_cartpole(num_envs):
+        return CartPoleBatchedEnv(num_envs, seed=11)
+
+    batched_cartpole.makes_batched_env = True
+
+    config = (
+        PPOConfig()
+        .environment(env_creator=batched_cartpole)
+        .env_runners(num_env_runners=0, num_envs_per_env_runner=64,
+                     rollout_fragment_length=32)
+        .training(train_batch_size=2048, minibatch_size=512,
+                  num_epochs=4, lr=3e-4)
+    )
+    algo = config.build()
+    returns = []
+    for _ in range(12):
+        r = algo.train()
+        if r.get("episode_return_mean") is not None:
+            returns.append(r["episode_return_mean"])
+    assert returns, "no episodes completed"
+    assert returns[-1] > returns[0] + 15 or returns[-1] > 60, returns
+    algo.stop()
