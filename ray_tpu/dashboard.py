@@ -91,6 +91,86 @@ def _table(rows, cols) -> str:
     return f"<table><tr>{head}</tr>{body}</table>"
 
 
+_TIMELINE_PAGE = """<!doctype html>
+<html><head><title>ray_tpu task timeline</title>
+<style>
+ body { font-family: system-ui, sans-serif; margin: 1.2rem; color: #1a1a2e; }
+ h1 { font-size: 1.2rem; } .lane-label { font-size: 11px; fill: #555; }
+ .slice { stroke: #fff; stroke-width: .5; cursor: pointer; }
+ .slice:hover { opacity: .75; }
+ #tip { position: fixed; background: #1a1a2e; color: #fff; padding: 4px 8px;
+        border-radius: 4px; font-size: 12px; pointer-events: none;
+        display: none; z-index: 10; }
+ .axis { stroke: #ddd; } .axis-label { font-size: 10px; fill: #888; }
+ #empty { color: #888; }
+</style></head><body>
+<h1>Task timeline <small style="color:#888">(per node / worker swimlanes;
+ auto-refreshes)</small></h1>
+<div id="tip"></div><div id="empty"></div>
+<svg id="chart" width="100%" height="60"></svg>
+<script>
+const COLORS = ["#4e79a7","#f28e2b","#59a14f","#e15759","#b07aa1",
+                "#76b7b2","#edc948","#ff9da7","#9c755f","#bab0ac"];
+function colorFor(name) {
+  let h = 0; for (const c of name) h = (h * 31 + c.charCodeAt(0)) >>> 0;
+  return COLORS[h % COLORS.length];
+}
+async function draw() {
+  const r = await fetch("/api/timeline"); const events = await r.json();
+  const slices = events.filter(e => e.ph === "X");
+  const empty = document.getElementById("empty");
+  if (!slices.length) { empty.textContent =
+      "no completed task spans yet — run some tasks and refresh"; return; }
+  empty.textContent = "";
+  const lanes = new Map();   // "pid/tid" -> row index
+  for (const s of slices) {
+    const key = s.pid + " / " + s.tid;
+    if (!lanes.has(key)) lanes.set(key, lanes.size);
+  }
+  const t0 = Math.min(...slices.map(s => s.ts));
+  const t1 = Math.max(...slices.map(s => s.ts + s.dur));
+  const span = Math.max(t1 - t0, 1);
+  const W = document.body.clientWidth - 40, LBL = 170, ROW = 22, TOP = 24;
+  const svg = document.getElementById("chart");
+  svg.setAttribute("height", TOP + lanes.size * ROW + 10);
+  let parts = [];
+  for (let i = 0; i <= 6; i++) {
+    const x = LBL + (W - LBL) * i / 6;
+    const t = (span * i / 6) / 1e6;
+    parts.push(`<line class="axis" x1="${x}" y1="${TOP - 6}" x2="${x}"
+      y2="${TOP + lanes.size * ROW}"></line>`);
+    parts.push(`<text class="axis-label" x="${x + 2}" y="${TOP - 10}">
+      ${t.toFixed(2)}s</text>`);
+  }
+  for (const [key, row] of lanes) {
+    parts.push(`<text class="lane-label" x="0"
+      y="${TOP + row * ROW + 14}">${key}</text>`);
+  }
+  slices.forEach((s, i) => {
+    const row = lanes.get(s.pid + " / " + s.tid);
+    const x = LBL + (s.ts - t0) / span * (W - LBL);
+    const w = Math.max(1.5, s.dur / span * (W - LBL));
+    const ms = (s.dur / 1000).toFixed(2);
+    parts.push(`<rect class="slice" data-i="${i}" x="${x}"
+      y="${TOP + row * ROW + 2}" width="${w}" height="${ROW - 5}"
+      fill="${colorFor(s.name)}"
+      data-tip="${s.name} — ${ms}ms (${(s.args||{}).outcome||''})"></rect>`);
+  });
+  svg.innerHTML = parts.join("");
+  const tip = document.getElementById("tip");
+  svg.querySelectorAll(".slice").forEach(el => {
+    el.onmousemove = ev => { tip.style.display = "block";
+      tip.style.left = (ev.clientX + 12) + "px";
+      tip.style.top = (ev.clientY + 12) + "px";
+      tip.textContent = el.dataset.tip; };
+    el.onmouseout = () => tip.style.display = "none";
+  });
+}
+draw(); setInterval(draw, 5000);
+</script></body></html>
+"""
+
+
 class Dashboard:
     """aiohttp server bound to a running ray_tpu session."""
 
@@ -197,6 +277,15 @@ class Dashboard:
             return web.json_response({"error": repr(e)}, status=500)
         return web.json_response(data, dumps=lambda o: json.dumps(o, default=str))
 
+    async def _timeline_page(self, request):
+        """Per-worker swimlane view of the task-event buffer, rendered
+        in-browser from /api/timeline (reference: the dashboard's task
+        timeline; data is the same chrome-trace JSON, so perfetto remains
+        an option for big traces)."""
+        from aiohttp import web
+
+        return web.Response(text=_TIMELINE_PAGE, content_type="text/html")
+
     async def _healthz(self, request):
         from aiohttp import web
 
@@ -233,6 +322,7 @@ class Dashboard:
 
         app = web.Application()
         app.router.add_get("/", self._index)
+        app.router.add_get("/timeline", self._timeline_page)
         app.router.add_get("/api/{kind}", self._api)
         app.router.add_get("/healthz", self._healthz)
         app.router.add_get("/metrics", self._metrics)

@@ -1,0 +1,107 @@
+"""Grafana dashboard JSON generation from the live metric surface.
+
+Parity: reference dashboard/modules/metrics/grafana_dashboard_factory.py —
+which renders panel JSON per known metric — generalized here to DERIVE the
+panel list from the Prometheus text the controller actually serves (core
+``rtpu_*`` gauges + everything applications registered through
+ray_tpu.util.metrics), so custom Counters/Gauges/Histograms show up without
+touching this file.
+
+Mapping:
+- counter    -> timeseries of ``rate(name[5m])``
+- gauge      -> timeseries of the raw series
+- histogram  -> p50/p95/p99 ``histogram_quantile`` over ``name_bucket``
+
+``rtpu dashboard --grafana-out FILE`` writes an importable dashboard.
+"""
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List, Tuple
+
+
+def parse_prometheus_metadata(text: str) -> List[Tuple[str, str, str]]:
+    """Prometheus exposition text -> [(name, type, help)] in order."""
+    helps: Dict[str, str] = {}
+    out: List[Tuple[str, str, str]] = []
+    seen = set()
+    for line in text.splitlines():
+        line = line.strip()
+        if line.startswith("# HELP "):
+            _, _, rest = line.partition("# HELP ")
+            name, _, doc = rest.partition(" ")
+            helps[name] = doc
+        elif line.startswith("# TYPE "):
+            _, _, rest = line.partition("# TYPE ")
+            name, _, mtype = rest.partition(" ")
+            if name not in seen:
+                seen.add(name)
+                out.append((name, mtype.strip(), helps.get(name, "")))
+    return out
+
+
+def _panel(panel_id: int, title: str, exprs: List[Tuple[str, str]],
+           x: int, y: int, description: str = "") -> Dict[str, Any]:
+    return {
+        "id": panel_id,
+        "title": title,
+        "description": description,
+        "type": "timeseries",
+        "datasource": {"type": "prometheus", "uid": "${datasource}"},
+        "gridPos": {"h": 8, "w": 12, "x": x, "y": y},
+        "fieldConfig": {"defaults": {"custom": {"fillOpacity": 10}},
+                        "overrides": []},
+        "targets": [
+            {"expr": expr, "legendFormat": legend, "refId": chr(65 + i)}
+            for i, (expr, legend) in enumerate(exprs)
+        ],
+    }
+
+
+def generate_dashboard(prom_text: str,
+                       title: str = "ray_tpu cluster") -> Dict[str, Any]:
+    """Importable Grafana dashboard JSON from exposition text."""
+    panels: List[Dict[str, Any]] = []
+    pid = 1
+    x = y = 0
+    for name, mtype, doc in parse_prometheus_metadata(prom_text):
+        if mtype == "counter":
+            exprs = [(f"rate({name}[5m])", "{{instance}}")]
+            ptitle = f"{name} (rate/s)"
+        elif mtype == "histogram":
+            exprs = [
+                (f"histogram_quantile({q}, "
+                 f"sum(rate({name}_bucket[5m])) by (le))", f"p{int(q*100)}")
+                for q in (0.5, 0.95, 0.99)
+            ]
+            ptitle = f"{name} (quantiles)"
+        else:  # gauge / untyped
+            exprs = [(name, "{{instance}}")]
+            ptitle = name
+        panels.append(_panel(pid, ptitle, exprs, x, y, description=doc))
+        pid += 1
+        x = 12 - x  # two columns
+        if x == 0:
+            y += 8
+    return {
+        "title": title,
+        "uid": "rtpu-cluster",
+        "tags": ["ray_tpu", "generated"],
+        "timezone": "browser",
+        "schemaVersion": 39,
+        "refresh": "10s",
+        "time": {"from": "now-30m", "to": "now"},
+        "templating": {"list": [{
+            "name": "datasource",
+            "type": "datasource",
+            "query": "prometheus",
+        }]},
+        "panels": panels,
+    }
+
+
+def write_dashboard(path: str, prom_text: str) -> Dict[str, Any]:
+    dash = generate_dashboard(prom_text)
+    with open(path, "w") as f:
+        json.dump(dash, f, indent=1)
+    return dash

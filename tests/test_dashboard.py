@@ -75,3 +75,58 @@ def test_timeline_endpoint(dash):
     status, body = _get(dash, "/api/timeline")
     events = json.loads(body)
     assert status == 200 and isinstance(events, list)
+
+
+def test_timeline_page_renders(dash):
+    """The swimlane page is self-contained HTML (no external assets — the
+    cluster may have zero egress) that draws /api/timeline slices."""
+    status, body = _get(dash, "/timeline")
+    assert status == 200
+    assert "Task timeline" in body
+    assert "/api/timeline" in body  # fetches the trace endpoint
+    assert "http://" not in body.split("fetch")[1][:200]  # no CDN assets
+
+
+def test_grafana_dashboard_generation(dash, tmp_path):
+    """Grafana JSON derives panels from the live Prometheus surface
+    (reference: grafana_dashboard_factory.py)."""
+    import urllib.request
+
+    from ray_tpu.util import state as state_api
+    from ray_tpu.util.grafana import generate_dashboard
+    from ray_tpu.util.metrics import Counter, Histogram, flush_metrics
+
+    c = Counter("dash_test_requests", description="test counter")
+    c.inc(3.0)
+    h = Histogram("dash_test_latency", description="test histogram",
+                  boundaries=[0.1, 1.0])
+    h.observe(0.5)
+    flush_metrics()
+
+    addr = state_api.metrics_address()
+    with urllib.request.urlopen(f"http://{addr}/metrics", timeout=5) as r:
+        prom = r.read().decode()
+    dashboard = generate_dashboard(prom)
+    titles = [p["title"] for p in dashboard["panels"]]
+    # Core gauges and the app metrics all got panels.
+    assert any("rtpu_tasks" in t for t in titles)
+    assert any("dash_test_requests" in t for t in titles), titles
+    assert any("dash_test_latency" in t and "quantiles" in t
+               for t in titles), titles
+    # Counter panels rate(); histogram panels quantile over _bucket.
+    counter_panel = next(p for p in dashboard["panels"]
+                         if "dash_test_requests" in p["title"])
+    assert "rate(" in counter_panel["targets"][0]["expr"]
+    hist_panel = next(p for p in dashboard["panels"]
+                      if "dash_test_latency" in p["title"])
+    assert "histogram_quantile" in hist_panel["targets"][0]["expr"]
+    assert len(hist_panel["targets"]) == 3
+
+    import json as _json
+
+    from ray_tpu.util.grafana import write_dashboard
+
+    out = tmp_path / "dash.json"
+    write_dashboard(str(out), prom)
+    loaded = _json.loads(out.read_text())
+    assert loaded["panels"]
