@@ -5,6 +5,7 @@ from .dataset import Dataset
 from .grouped import GroupedData
 from .read_api import (
     from_arrow,
+    from_huggingface,
     from_blocks,
     from_items,
     from_numpy,
@@ -28,6 +29,7 @@ __all__ = [
     "Dataset",
     "GroupedData",
     "from_arrow",
+    "from_huggingface",
     "from_blocks",
     "from_items",
     "from_numpy",

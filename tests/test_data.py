@@ -280,3 +280,25 @@ def test_dataset_stats_per_op():
     out2 = rd.range(100, parallelism=4).map_batches(lambda b: b) \
         .limit(5).stats()
     assert "read:" in out2 and "MapBatches:" in out2
+
+
+def test_from_huggingface(ray_start_regular):
+    """HF arrow backing slices into blocks zero-copy (reference:
+    read_api.py:2664); DatasetDict must be split-indexed first."""
+    import datasets as hf
+    import pytest
+
+    import ray_tpu.data as rdata
+
+    src = hf.Dataset.from_dict(
+        {"text": [f"row {i}" for i in range(40)],
+         "label": list(range(40))})
+    ds = rdata.from_huggingface(src)
+    rows = ds.take_all()
+    assert len(rows) == 40
+    assert rows[7]["text"] == "row 7" and rows[7]["label"] == 7
+    assert ds.num_blocks() > 1  # actually sliced into parallel blocks
+
+    dd = hf.DatasetDict({"train": src})
+    with pytest.raises(ValueError, match="split"):
+        rdata.from_huggingface(dd)
