@@ -22,6 +22,7 @@ import cloudpickle
 
 from . import context as ctx
 from . import ownership
+from ..util import tracing
 from .client import CoreClient, EventLoopThread
 from .controller import Controller, GetTimeoutError, TaskError
 from .ids import ActorID, NodeID, ObjectID, TaskID
@@ -505,6 +506,7 @@ class RemoteFunction:
         if streaming:
             _streaming_spec_opts(opts, spec)
         _register_dep_holds(spec, nested_refs)
+        tracing.inject_submit_span(spec, spec["label"])
         # Lease-then-push direct path first; the controller queue is the
         # fallback (and the only path for pg/affinity/streaming tasks).
         if not _try_direct_task(wc, spec, opts):
@@ -1203,6 +1205,7 @@ class ActorHandle:
         if streaming:
             _streaming_spec_opts({}, spec)
         _register_dep_holds(spec, nested_refs)
+        tracing.inject_submit_span(spec, spec["label"])
         submitted = False
         if not streaming and flags.get("RTPU_DIRECT_DISPATCH"):
             route = _get_route(wc, self._actor_id)
@@ -1289,6 +1292,7 @@ class ActorClass:
         }
         _attach_runtime_env(wc, opts, spec)
         _register_dep_holds(spec, nested_refs)
+        tracing.inject_submit_span(spec, spec["label"])
         wc.client.request({"kind": "create_actor", "spec": spec})
         wc.client.request(
             {"kind": "kv_put", "ns": "__actor_methods__", "key": actor_id,

@@ -1029,6 +1029,34 @@ class Controller:
             gen.wake.set()
             gen.drain.set()
 
+    async def _h_task_spillback(self, conn, msg):
+        """A worker's admission check rejected a dispatched task
+        (reference: raylet spillback — the scheduler retries elsewhere
+        with the rejecting node excluded). Resources are returned, the
+        worker goes back to idle, and the spec re-queues."""
+        task_id = msg["task_id"]
+        spec = self.tasks.get(task_id)
+        w = self.workers.get(msg.get("worker_id", ""))
+        if w is not None and w.current_task == task_id:
+            w.current_task = None
+            if w.state == "task":
+                w.state = "idle"
+        if spec is None:
+            return {"ok": False}
+        self._release_task_resources(spec)
+        node_id = spec.pop("sched_node", None)
+        spec.pop("blocked", None)
+        if node_id:
+            spec.setdefault("spillback_excluded", []).append(node_id)
+        spec["spillback_count"] = spec.get("spillback_count", 0) + 1
+        spec["state"] = "waiting_deps"
+        self._record_task_event(spec, "spillback",
+                                worker_id=msg.get("worker_id"),
+                                node_id=node_id)
+        await self._resolve_deps_then_queue(spec)
+        self._wake_scheduler()
+        return {"ok": True}
+
     async def _h_task_done(self, conn, msg):
         task_id = msg["task_id"]
         gen = self.generators.get(task_id)
@@ -1263,8 +1291,15 @@ class Controller:
         resources: Dict[str, float] = msg.get("resources") or {"CPU": 1.0}
         env_hash = msg.get("env_hash") or ""
         needs_tpu = resources.get("TPU", 0) > 0
-        for node in sorted(self.nodes.values(), key=lambda n: n.index):
-            if not node.alive or not _res_fits(node.available, resources):
+        mem_limit = flags.get("RTPU_SPILLBACK_MEM_FRACTION")
+        for node in self._hybrid_order(
+                [n for n in self.nodes.values() if n.alive]):
+            if not _res_fits(node.available, resources):
+                continue
+            # Grant-time admission for the direct path (the spillback
+            # analog — pushed tasks never pass the worker's execute_task
+            # check, so screen the node's reported memory pressure here).
+            if mem_limit and node.mem_fraction >= mem_limit:
                 continue
             # Server-side lease bound (advisor r4): once a node already
             # holds a lease, never lease away its LAST schedulable CPU.
@@ -2266,6 +2301,17 @@ class Controller:
         strategy = spec.get("scheduling", {"type": "DEFAULT"})
         nodes = [n for n in self.nodes.values() if n.alive]
         st = strategy.get("type", "DEFAULT")
+        # Nodes that spilled this spec back are out for the retry pass
+        # (reference: spillback carries the rejecting raylet in the lease
+        # request's excluded set) — but ONLY for placement-choice
+        # strategies. Hard affinity / label constraints have no alternative
+        # node: honoring the exclusion there would strand the task forever,
+        # while re-dispatching lets the worker-side spill cap (2) force
+        # progress.
+        excluded = spec.get("spillback_excluded")
+        if excluded and st in ("DEFAULT", "SPREAD"):
+            keep = [n for n in nodes if n.node_id not in excluded]
+            nodes = keep or nodes  # every node rejected: try them again
         if st == "NODE_AFFINITY":
             hard = [n for n in nodes if n.node_id == strategy["node_id"]]
             if hard or not strategy.get("soft", False):
@@ -2281,9 +2327,43 @@ class Controller:
         if st == "NODE_LABEL":
             want: Dict[str, str] = strategy.get("labels", {})
             return [n for n in nodes if all(n.labels.get(k) == v for k, v in want.items())]
-        # DEFAULT: hybrid pack-first in node index order (hybrid_scheduling_policy.h
-        # top-k behavior degenerates to first-fit at this scale).
-        return sorted(nodes, key=lambda n: n.index)
+        # DEFAULT: the reference's hybrid policy.
+        return self._hybrid_order(nodes)
+
+    @staticmethod
+    def _cpu_util(n: NodeInfo) -> float:
+        """CPU utilization fraction — THE hybrid-policy signal. One
+        definition shared by ordering and the spawn-wait gate so they can
+        never disagree about a node's bucket."""
+        tot = n.resources.get("CPU", 1.0) or 1.0
+        return 1.0 - n.available.get("CPU", 0.0) / tot
+
+    @staticmethod
+    def _hybrid_order(nodes: List[NodeInfo]) -> List[NodeInfo]:
+        """Reference hybrid_scheduling_policy.h:29-49: PACK onto nodes
+        below the utilization threshold in index order
+        (locality/binpacking), then SPREAD across hot nodes by ascending
+        utilization. RTPU_SCHED_TOP_K > 1 randomizes among the best k to
+        avoid thundering-herd placement when many schedulers race (the
+        reference's top-k term). Shared by queue placement AND lease
+        grants so direct dispatch follows the same policy."""
+        thr = flags.get("RTPU_SCHED_HYBRID_THRESHOLD")
+
+        def hybrid_key(n: NodeInfo):
+            util = Controller._cpu_util(n)
+            if util < thr:
+                return (0, n.index, 0.0)
+            return (1, 0, util)
+
+        ordered = sorted(nodes, key=hybrid_key)
+        k = int(flags.get("RTPU_SCHED_TOP_K"))
+        if k > 1 and len(ordered) > 1:
+            import random
+
+            head = ordered[:k]
+            random.shuffle(head)
+            ordered = head + ordered[k:]
+        return ordered
 
     async def _try_place(self, spec: Dict[str, Any]) -> bool:
         resources: Dict[str, float] = spec.get("resources", {})
@@ -2326,12 +2406,32 @@ class Controller:
             return True
         needs_tpu = resources.get("TPU", 0) > 0
         env_hash = spec.get("env_hash") or ""
+        # Worker availability must not OVERRIDE the placement policy across
+        # utilization buckets: a cold (pack-bucket) node that merely needs a
+        # worker spawned beats a hot (spread-bucket) node with a warm
+        # worker — the reference commits to the policy's node and starts a
+        # worker there. WITHIN a bucket, preferring the node with a warm
+        # worker is pure win (no policy signal separates them).
+        thr = flags.get("RTPU_SCHED_HYBRID_THRESHOLD")
+
+        def bucket(n: NodeInfo) -> int:
+            return 0 if self._cpu_util(n) < thr else 1
+
+        spawning_bucket: Optional[int] = None
         for node in self._eligible_nodes(spec):
             if not _res_fits(node.available, resources):
                 continue
+            if spawning_bucket is not None and bucket(node) > spawning_bucket:
+                return False  # wait for the better-bucket node's spawn
             w = self._find_idle_worker(node, needs_tpu, env_hash)
             if w is None:
-                self._maybe_spawn_worker(node, needs_tpu, spec.get("runtime_env"))
+                spawning = self._maybe_spawn_worker(
+                    node, needs_tpu, spec.get("runtime_env"))
+                # Hold later (worse-bucket) nodes ONLY when a spawn is
+                # really coming here; a capped node with nothing in flight
+                # must not starve the task off warm workers elsewhere.
+                if spawning and spawning_bucket is None:
+                    spawning_bucket = bucket(node)
                 continue
             _res_sub(node.available, resources)
             spec["sched_node"] = node.node_id
@@ -2364,19 +2464,23 @@ class Controller:
         node: NodeInfo,
         needs_tpu: bool = False,
         runtime_env: Optional[Dict[str, Any]] = None,
-    ) -> None:
+    ) -> bool:
+        """True iff a suitable worker spawn is now (or already was) in
+        flight on this node — i.e. waiting on this node is sensible.
+        False means no spawn will happen (cap reached with no reapable
+        victim): callers must NOT hold placement for this node."""
         if node.spawning >= 4:
-            return
+            return True  # several already coming
         # One in-flight TPU-capable spawn satisfies any number of queued TPU
         # tasks' wakeups during its multi-second startup; without this guard
         # every scheduler pass reaps another idle plain worker and launches a
         # surplus TPU worker. Env spawns (venv builds can take tens of
         # seconds) get the same dedup, keyed by env hash.
         if needs_tpu and node.spawning_tpu > 0:
-            return
+            return True
         want_env = (runtime_env or {}).get("hash", "")
         if want_env and node.spawning_envs.get(want_env, 0) > 0:
-            return
+            return True
         if len(node.workers) + node.spawning >= MAX_WORKERS_PER_NODE:
             # At the cap, a task needing a worker flavor (TPU or a runtime
             # env) that no idle worker matches must not starve behind idle
@@ -2385,7 +2489,8 @@ class Controller:
             # Scarce TPU workers are victimized only as a last resort, and
             # only by a TPU-flavored request.
             if not needs_tpu and not want_env:
-                return
+                # A plain spawn can also ride any in-flight plain spawn.
+                return node.spawning > 0
             victim = None
             last_resort = None
             for wid in list(node.workers):
@@ -2402,7 +2507,7 @@ class Controller:
                 break
             victim = victim or last_resort
             if victim is None:
-                return
+                return node.spawning > 0
             node.workers.discard(victim.worker_id)
             self.workers.pop(victim.worker_id, None)
             asyncio.get_running_loop().create_task(self._shutdown_worker(victim))
@@ -2433,7 +2538,7 @@ class Controller:
                     }
                 )
             )
-            return
+            return True
         env = flags.child_env()
         env["RTPU_CONTROLLER"] = f"{self.host}:{self.port}"
         env["RTPU_NODE_ID"] = node.node_id
@@ -2491,7 +2596,7 @@ class Controller:
                     self._watch_spawn(node.node_id, spawn_token, proc))
 
             asyncio.get_running_loop().create_task(_spawn_container())
-            return
+            return True
         if runtime_env and (runtime_env.get("pip")
                             or runtime_env.get("conda")):
             # venv/conda materialization can take tens of seconds: run it
@@ -2522,7 +2627,7 @@ class Controller:
                     self._watch_spawn(node.node_id, spawn_token, proc))
 
             asyncio.get_running_loop().create_task(_spawn_with_venv())
-            return
+            return True
         proc = subprocess.Popen(
             [sys.executable, "-m", "ray_tpu.core.worker_main"],
             env=env,
@@ -2533,6 +2638,7 @@ class Controller:
         # The worker registers itself carrying the token (exact adoption in
         # _h_register); this task only reaps processes that die pre-register.
         asyncio.get_running_loop().create_task(self._watch_spawn(node.node_id, spawn_token, proc))
+        return True
 
     def _worker_log_file(self, spawn_token: str):
         from .worker_logs import worker_log_file

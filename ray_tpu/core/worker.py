@@ -328,10 +328,34 @@ class WorkerRuntime:
 
     # ----------------------------------------------------------- push handler
 
+    def _admit(self, spec: Dict[str, Any]) -> bool:
+        """Local admission (reference raylet spillback): a host at the edge
+        of memory exhaustion rejects the dispatch back to the scheduler
+        instead of starting work it will likely be OOM-killed for. Capped
+        per task so a cluster-wide pressure wave can't ping-pong a spec
+        forever — after two spills it runs wherever it lands."""
+        frac_limit = flags.get("RTPU_SPILLBACK_MEM_FRACTION")
+        if not frac_limit or spec.get("spillback_count", 0) >= 2:
+            return True
+        try:
+            import psutil
+
+            if psutil.virtual_memory().percent / 100.0 >= frac_limit:
+                return False
+        except Exception:
+            pass
+        return True
+
     async def _handle(self, conn, msg):
         kind = msg["kind"]
         if kind == "execute_task":
-            self.pool.submit(self.run_task, msg["spec"])
+            spec = msg["spec"]
+            if not self._admit(spec):
+                await conn.send({"kind": "task_spillback",
+                                 "task_id": spec["task_id"],
+                                 "worker_id": self.worker_id})
+                return None
+            self.pool.submit(self.run_task, spec)
         elif kind == "instantiate_actor":
             self._instantiate_actor(msg["spec"])
         elif kind == "execute_actor_task":
@@ -439,6 +463,16 @@ class WorkerRuntime:
         # die with this frame — after arg VALUES are materialized the dep
         # bytes are no longer needed here.
         _held = ownership.acquire_spec_refs(spec)  # noqa: F841
+        from ray_tpu.util.tracing import task_span
+
+        # Manual span scope: the consumer span must cover the ACTUAL body —
+        # for async actor methods the user code runs in the awaited
+        # coroutine, so span ownership transfers into drive() and closes
+        # there (a `with` around the sync call would record a ~0ms success
+        # for a 10s coroutine and miss its exceptions).
+        span = task_span(spec)
+        span.__enter__()
+        span_transferred = False
         try:
             args, kwargs = self._resolve_args(spec)
             if spec.get("actor_id") and actor_instance is not None:
@@ -461,18 +495,25 @@ class WorkerRuntime:
                     # next call dispatches immediately, so awaits interleave.
                     loop = mailbox.ensure_aio_loop()
                     sem = mailbox.aio_sem
+                    span_transferred = True
+                    # The mailbox thread moves on to its next call: restore
+                    # its current-span slot NOW; the span itself stays open
+                    # until the coroutine settles in drive().
+                    span.detach_context()
 
-                    async def drive(result=result, spec=spec):
+                    async def drive(result=result, spec=spec, span=span):
                         async with sem:
                             try:
                                 value = await result
                             except BaseException as e:  # noqa: BLE001
                                 tb = traceback.format_exc()
+                                span.__exit__(type(e), e, e.__traceback__)
                                 await asyncio.get_running_loop().run_in_executor(
                                     None,
                                     lambda: self._complete_error(spec, e, tb),
                                 )
                             else:
+                                span.__exit__(None, None, None)
                                 # Serialization + the controller round-trip
                                 # block; keep them off the actor loop so
                                 # other in-flight awaits keep interleaving.
@@ -502,6 +543,10 @@ class WorkerRuntime:
         except BaseException as e:  # noqa: BLE001 — every task error is captured
             self._complete_error(spec, e, traceback.format_exc())
         finally:
+            if not span_transferred:
+                import sys as _sys
+
+                span.__exit__(*_sys.exc_info())
             tls.task_id = None
 
     def _complete_ok(self, spec: Dict[str, Any], result: Any) -> None:

@@ -92,6 +92,21 @@ _define("RTPU_DIRECT_BIND", str, None,
         "local address of the worker's controller connection, so loopback "
         "clusters never expose the direct endpoint off-host.")
 
+_define("RTPU_SCHED_HYBRID_THRESHOLD", float, 0.5,
+        "Hybrid scheduling threshold: nodes below this CPU utilization are "
+        "packed in index order; above it, placement spreads by load "
+        "(reference hybrid_scheduling_policy).")
+_define("RTPU_SCHED_TOP_K", int, 1,
+        "Randomize DEFAULT placement among the best k nodes (anti-herding "
+        "at scale); 1 keeps placement deterministic.")
+_define("RTPU_TRACING", bool, False,
+        "OpenTelemetry span propagation through task submission "
+        "(util/tracing.py setup_tracing); workers inherit via env.")
+_define("RTPU_SPILLBACK_MEM_FRACTION", float, 0.97,
+        "A worker whose host memory use exceeds this fraction rejects "
+        "dispatched tasks back to the scheduler (raylet spillback shape); "
+        "0 disables admission checks.")
+
 # -- controller tunables -----------------------------------------------------
 _define("RTPU_MAX_WORKERS_PER_NODE", int, 32,
         "Upper bound on workers the controller spawns per node.")

@@ -1,0 +1,222 @@
+"""Task-span tracing with OpenTelemetry-compatible context propagation.
+
+Parity: reference python/ray/util/tracing/tracing_helper.py — the
+submitter's active trace context is injected into every task/actor-call
+spec and the executing worker opens a child span around the user function,
+so one trace follows a request across processes and nodes.
+
+The wire format is W3C ``traceparent`` (the OTel default propagator), and
+when the ``opentelemetry-sdk`` package is importable ``setup_tracing``
+registers a real TracerProvider and spans flow through the user's
+exporters. This image ships only ``opentelemetry-api`` (no-op tracers that
+cannot carry context), so a built-in tracer provides the same surface:
+thread-local current-span context, child spans, per-process finished-span
+records queryable via ``get_finished_spans()``.
+
+Everything is gated on ``RTPU_TRACING`` (set by ``setup_tracing``; worker
+processes inherit it through the spawn env): when off, submission pays one
+flag check and nothing else.
+"""
+from __future__ import annotations
+
+import secrets
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+from ray_tpu import flags
+
+_local = threading.local()
+_finished: List["Span"] = []
+_finished_lock = threading.Lock()
+_otel_sdk = None  # resolved once by setup_tracing
+
+
+def enabled() -> bool:
+    return bool(flags.get("RTPU_TRACING"))
+
+
+@dataclass
+class SpanContext:
+    trace_id: str  # 32 hex chars
+    span_id: str   # 16 hex chars
+
+    @property
+    def is_valid(self) -> bool:
+        return bool(int(self.trace_id, 16))
+
+    def to_traceparent(self) -> str:
+        return f"00-{self.trace_id}-{self.span_id}-01"
+
+    @classmethod
+    def from_traceparent(cls, tp: str) -> Optional["SpanContext"]:
+        parts = tp.split("-")
+        if len(parts) != 4 or len(parts[1]) != 32 or len(parts[2]) != 16:
+            return None
+        return cls(trace_id=parts[1], span_id=parts[2])
+
+
+@dataclass
+class Span:
+    name: str
+    context: SpanContext
+    parent_span_id: str = ""
+    kind: str = "internal"
+    attributes: Dict[str, Any] = field(default_factory=dict)
+    start_time: float = field(default_factory=time.time)
+    end_time: float = 0.0
+
+    def end(self) -> None:
+        self.end_time = time.time()
+        with _finished_lock:
+            _finished.append(Span(**{f: getattr(self, f) for f in (
+                "name", "context", "parent_span_id", "kind", "attributes",
+                "start_time", "end_time")}))
+            del _finished[:-4096]  # bounded per-process record
+
+
+def current_span_context() -> Optional[SpanContext]:
+    return getattr(_local, "ctx", None)
+
+
+def current_trace_id() -> str:
+    ctx = current_span_context()
+    return ctx.trace_id if ctx is not None else ""
+
+
+def get_finished_spans() -> List[Span]:
+    with _finished_lock:
+        return list(_finished)
+
+
+class _SpanScope:
+    """start span -> set thread-local context -> restore + record."""
+
+    def __init__(self, name: str, kind: str,
+                 attributes: Optional[Dict[str, Any]] = None,
+                 parent: Optional[SpanContext] = None):
+        self.name = name
+        self.kind = kind
+        self.attributes = dict(attributes or {})
+        self.parent = parent
+        self.span: Optional[Span] = None
+        self._prev: Optional[SpanContext] = None
+
+    def __enter__(self) -> Span:
+        parent = self.parent or current_span_context()
+        trace_id = parent.trace_id if parent else secrets.token_hex(16)
+        ctx = SpanContext(trace_id=trace_id, span_id=secrets.token_hex(8))
+        self.span = Span(name=self.name, context=ctx, kind=self.kind,
+                         parent_span_id=parent.span_id if parent else "",
+                         attributes=self.attributes)
+        self._prev = current_span_context()
+        _local.ctx = ctx
+        return self.span
+
+    def detach_context(self) -> None:
+        """Restore THIS thread's current-span slot without ending the span
+        — for ownership transfers to another thread/loop (async actor
+        methods): the origin thread must not leak the context into its
+        next task while the span stays open to record the real duration."""
+        _local.ctx = self._prev
+        self._prev = None
+
+    def __exit__(self, et, ev, tb):
+        if getattr(_local, "ctx", None) is (
+                self.span.context if self.span else None):
+            _local.ctx = self._prev
+        if self.span is not None:
+            if et is not None:
+                self.span.attributes["error"] = repr(ev)
+            self.span.end()
+        return False
+
+
+def start_span(name: str, kind: str = "internal",
+               attributes: Optional[Dict[str, Any]] = None) -> _SpanScope:
+    """Application-facing span context manager (the reference exposes the
+    raw OTel API; this is the built-in analog that also feeds it)."""
+    return _SpanScope(name, kind, attributes)
+
+
+def setup_tracing(span_processor: Optional[Any] = None) -> None:
+    """Enable tracing for this session (workers inherit via env).
+
+    With ``opentelemetry-sdk`` importable, a TracerProvider is installed
+    (if the global one is still the no-op default) and ``span_processor``
+    registered — real OTel spans flow alongside the built-in records. With
+    api-only installs the built-in tracer carries everything."""
+    global _otel_sdk
+    try:
+        from opentelemetry import trace as otel_trace
+        from opentelemetry.sdk.trace import TracerProvider
+
+        provider = otel_trace.get_tracer_provider()
+        if not isinstance(provider, TracerProvider):
+            provider = TracerProvider()
+            otel_trace.set_tracer_provider(provider)
+        if span_processor is not None:
+            provider.add_span_processor(span_processor)
+        _otel_sdk = otel_trace
+    except ImportError:
+        _otel_sdk = None  # api-only image: built-in tracer carries spans
+    flags.set_env("RTPU_TRACING", "1")
+
+
+def inject_submit_span(spec: Dict[str, Any], label: str) -> None:
+    """Submitter side: record a PRODUCER span for the submission and carry
+    its context in the spec as a W3C traceparent (reference:
+    _inject_tracing_into_function + the .remote() wrapper span)."""
+    if not enabled():
+        return
+    try:
+        with _SpanScope(f"submit {label}", "producer",
+                        {"rtpu.task_id": spec.get("task_id", ""),
+                         "rtpu.label": label}) as span:
+            spec["trace_ctx"] = {
+                "traceparent": span.context.to_traceparent()}
+    except Exception:
+        pass  # tracing must never break submission
+
+
+class task_span:
+    """Worker side: CONSUMER span around the user function, child of the
+    submitter's context extracted from the spec."""
+
+    def __init__(self, spec: Dict[str, Any]):
+        self._spec = spec
+        self._scope: Optional[_SpanScope] = None
+
+    def __enter__(self):
+        tp = (self._spec.get("trace_ctx") or {}).get("traceparent", "")
+        if not enabled() or not tp:
+            return None
+        try:
+            parent = SpanContext.from_traceparent(tp)
+            label = (self._spec.get("label")
+                     or self._spec.get("method_name", "task"))
+            self._scope = _SpanScope(
+                f"run {label}", "consumer",
+                {"rtpu.task_id": self._spec.get("task_id", ""),
+                 "rtpu.actor_id": self._spec.get("actor_id") or ""},
+                parent=parent)
+            return self._scope.__enter__()
+        except Exception:
+            self._scope = None
+            return None
+
+    def detach_context(self) -> None:
+        if self._scope is not None:
+            try:
+                self._scope.detach_context()
+            except Exception:
+                pass
+
+    def __exit__(self, et, ev, tb):
+        if self._scope is not None:
+            try:
+                self._scope.__exit__(et, ev, tb)
+            except Exception:
+                pass
+        return False

@@ -1,0 +1,85 @@
+"""Hybrid scheduling policy + worker spillback (own module: these tests
+own their clusters and must not share the module-scoped fixtures).
+Reference: src/ray/raylet/scheduling/policy/hybrid_scheduling_policy.h:29-49
+and raylet task spillback."""
+import time
+
+import ray_tpu
+from ray_tpu.util.scheduling_strategies import NodeAffinitySchedulingStrategy
+
+
+def test_hybrid_threshold_prefers_cold_nodes():
+    """DEFAULT placement is the reference hybrid policy: nodes past the
+    utilization threshold lose their pack-order priority, so new work
+    lands on cold nodes even when the hot one still fits it."""
+    import time as _t
+
+    import os as _os
+
+    from ray_tpu.core.cluster_utils import Cluster
+
+    # Queue placement is what's under test: keep the lease path out.
+    _os.environ["RTPU_TASK_LEASE_MAX"] = "0"
+    cluster = Cluster(head_resources={"CPU": 4})
+    try:
+        n2 = cluster.add_node({"CPU": 8}, remote=True,
+                              host_id="hyb-host-b")  # stays < 0.5 util under all 3 tasks
+        head = [n["node_id"] for n in ray_tpu.nodes()
+                if n["node_id"] != n2][0]
+
+        @ray_tpu.remote
+        def hold(sec):
+            _t.sleep(sec)
+            return 1
+
+        @ray_tpu.remote
+        def where():
+            from ray_tpu.core import context as c
+
+            return c.get_worker_context().node_id
+
+        # Drive the HEAD past the 0.5 threshold (3/4 CPUs busy)...
+        warm = [hold.options(
+            scheduling_strategy=__import__(
+                "ray_tpu.util.scheduling_strategies",
+                fromlist=["x"]).NodeAffinitySchedulingStrategy(
+                    node_id=head, soft=False)).remote(6) for _ in range(3)]
+        _t.sleep(1.5)  # let them start
+        # ...then DEFAULT placement must prefer the cold node despite the
+        # head having a free CPU and the lower index.
+        spots = ray_tpu.get([where.remote() for _ in range(3)], timeout=60)
+        assert all(s == n2 for s in spots), (spots, head, n2)
+        ray_tpu.get(warm, timeout=60)
+    finally:
+        _os.environ.pop("RTPU_TASK_LEASE_MAX", None)
+        cluster.shutdown()
+
+
+def test_worker_spillback_reroutes_and_caps():
+    """A worker over the memory admission threshold rejects dispatches
+    back to the scheduler (raylet spillback); the spill cap guarantees
+    progress even when EVERY node rejects."""
+    import os as _os
+
+    _os.environ["RTPU_SPILLBACK_MEM_FRACTION"] = "0.01"  # everyone rejects
+    _os.environ["RTPU_TASK_LEASE_MAX"] = "0"  # deterministic controller path
+    try:
+        ray_tpu.init(num_cpus=2)
+
+        @ray_tpu.remote
+        def two():
+            return 2
+
+        # The per-spec spill cap (2) lets the task run on the third try.
+        assert ray_tpu.get(two.remote(), timeout=60) == 2
+        from ray_tpu.core import context as c
+
+        stats = c.get_worker_context().client.request({"kind": "rpc_stats"})
+        assert stats.get("task_spillback", 0) >= 1, stats
+        events = c.get_worker_context().client.request(
+            {"kind": "task_events"})
+        assert any(e["event"] == "spillback" for e in events)
+    finally:
+        _os.environ.pop("RTPU_SPILLBACK_MEM_FRACTION", None)
+        _os.environ.pop("RTPU_TASK_LEASE_MAX", None)
+        ray_tpu.shutdown()

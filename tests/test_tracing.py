@@ -1,0 +1,87 @@
+"""Task-span tracing: context propagation through task submission
+(reference: python/ray/util/tracing/tracing_helper.py — submitter context
+injected into specs, worker opens a child span around execution). This
+image ships opentelemetry-api only, so the built-in W3C-traceparent tracer
+carries the spans; the wire format is OTel-compatible."""
+import os
+
+import ray_tpu
+from ray_tpu.util import tracing
+
+
+def test_trace_context_propagates_to_worker():
+    tracing.setup_tracing()
+    try:
+        ray_tpu.init(num_cpus=2)
+
+        @ray_tpu.remote
+        def traced_work():
+            # The worker-side CONSUMER span is active around the user
+            # function: its trace id IS the driver's trace id.
+            from ray_tpu.util import tracing as t
+
+            ctx = t.current_span_context()
+            return (ctx.trace_id if ctx else "", bool(ctx and ctx.is_valid))
+
+        with tracing.start_span("driver-root") as root:
+            driver_trace = root.context.trace_id
+            worker_trace, valid = ray_tpu.get(traced_work.remote(),
+                                              timeout=60)
+        assert valid, "no active span inside the task"
+        assert worker_trace == driver_trace
+
+        # The driver recorded the PRODUCER submit span under the same trace.
+        spans = tracing.get_finished_spans()
+        submits = [s for s in spans if s.name.startswith("submit traced")]
+        assert submits and submits[0].context.trace_id == driver_trace
+        assert submits[0].kind == "producer"
+        assert submits[0].end_time >= submits[0].start_time
+    finally:
+        os.environ.pop("RTPU_TRACING", None)
+        ray_tpu.shutdown()
+
+
+def test_actor_call_spans_share_trace():
+    tracing.setup_tracing()
+    try:
+        ray_tpu.init(num_cpus=2)
+
+        @ray_tpu.remote
+        class Probe:
+            def trace_id(self):
+                from ray_tpu.util import tracing as t
+
+                return t.current_trace_id()
+
+        p = Probe.remote()
+        with tracing.start_span("actor-root") as root:
+            inside = ray_tpu.get(p.trace_id.remote(), timeout=60)
+        assert inside == root.context.trace_id
+    finally:
+        os.environ.pop("RTPU_TRACING", None)
+        ray_tpu.shutdown()
+
+
+def test_tracing_off_adds_nothing():
+    os.environ.pop("RTPU_TRACING", None)
+    ray_tpu.init(num_cpus=1)
+    try:
+        @ray_tpu.remote
+        def f():
+            return 1
+
+        ref = f.remote()
+        assert ray_tpu.get(ref, timeout=30) == 1
+        from ray_tpu.core import context as c
+        # No trace context was attached to anything.
+        assert tracing.current_span_context() is None
+        assert c.get_worker_context() is not None
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_traceparent_roundtrip():
+    ctx = tracing.SpanContext(trace_id="ab" * 16, span_id="cd" * 8)
+    back = tracing.SpanContext.from_traceparent(ctx.to_traceparent())
+    assert back == ctx
+    assert tracing.SpanContext.from_traceparent("garbage") is None
