@@ -115,6 +115,9 @@ async def _driver_handler(conn, msg):
     kind = msg.get("kind")
     if kind == "pubsub":
         ctx.deliver_pubsub(msg["channel"], msg["data"])
+    elif kind == "pubsub_batch":
+        for item in msg["items"]:
+            ctx.deliver_pubsub(item["channel"], item["data"])
     elif kind == "lease_reclaim":
         # The controller has queued work it cannot place while we hold
         # task leases: release every named lease with no in-flight pushes.

@@ -258,6 +258,8 @@ class Controller:
         self.pgs: Dict[str, PGInfo] = {}
         self.named_pgs: Dict[str, str] = {}
         self.subs: Dict[str, List[protocol.Connection]] = {}  # pubsub channel -> conns
+        # Per-connection publish coalescing buffers: id(conn) -> [conn, items]
+        self._pubsub_pending: Dict[int, list] = {}
         self.driver_conns: Set[protocol.Connection] = set()
         # Direct-dispatch worker leases (lease_id -> {worker_id, node_id,
         # resources, owner conn}) and on-demand profiling collection state.
@@ -1586,12 +1588,33 @@ class Controller:
         return {"ok": True}
 
     async def _h_publish(self, conn, msg):
+        """Batched fan-out (reference: src/ray/pubsub/README.md — the
+        long-poll publisher coalesces queued messages per subscriber).
+        Publishes within one loop iteration append to per-connection
+        buffers; ONE flush task per connection drains them as a single
+        pubsub_batch frame, so a burst of M messages to S subscribers
+        costs S sends instead of M*S."""
+        item = {"channel": msg["channel"], "data": msg["data"]}
         for c in list(self.subs.get(msg["channel"], [])):
-            try:
-                await c.send({"kind": "pubsub", "channel": msg["channel"], "data": msg["data"]})
-            except Exception:
-                pass
+            buf = self._pubsub_pending.setdefault(id(c), [c, []])
+            buf[1].append(item)
+            if len(buf[1]) == 1:  # first item: schedule this conn's flush
+                asyncio.get_running_loop().create_task(
+                    self._flush_pubsub(id(c)))
         return {"ok": True}
+
+    async def _flush_pubsub(self, conn_key: int) -> None:
+        buf = self._pubsub_pending.pop(conn_key, None)
+        if buf is None:
+            return
+        c, items = buf
+        try:
+            if len(items) == 1:
+                await c.send({"kind": "pubsub", **items[0]})
+            else:
+                await c.send({"kind": "pubsub_batch", "items": items})
+        except Exception:
+            pass
 
     async def _h_list_state(self, conn, msg):
         """State API backend (reference: python/ray/util/state/api.py:110 —

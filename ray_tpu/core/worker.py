@@ -377,6 +377,9 @@ class WorkerRuntime:
                 daemon=True).start()
         elif kind == "pubsub":
             ctx.deliver_pubsub(msg["channel"], msg["data"])
+        elif kind == "pubsub_batch":
+            for item in msg["items"]:
+                ctx.deliver_pubsub(item["channel"], item["data"])
         return None
 
     def _format_stacks(self) -> str:
