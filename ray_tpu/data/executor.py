@@ -99,7 +99,7 @@ class StreamingExecutor:
 
     def execute(self, ops: List[L.LogicalOp]) -> Iterator[Any]:
         """Yield output block refs; pulling drives the pipeline."""
-        stages = L.fuse_plan(ops)
+        stages = L.fuse_plan(L.optimize(ops))
         stream: Iterator[Any] = iter(())
         for stage in stages:
             op = stage[0]

@@ -106,6 +106,92 @@ class Aggregate(LogicalOp):
 ROW_OPS = (MapRows, FlatMap, Filter)
 
 
+# ---------------------------------------------------------------------------
+# Rewrite-rule optimizer (reference: data/_internal/logical/optimizers.py —
+# an ordered rule list applied to fixpoint before planning; map fusion is
+# the planner-side half, fuse_plan below).
+
+
+class Rule:
+    """One rewrite: ops -> ops (pure; return the input to decline)."""
+
+    def apply(self, ops: List[LogicalOp]) -> List[LogicalOp]:
+        raise NotImplementedError
+
+
+class MergeLimits(Rule):
+    """limit(a).limit(b) == limit(min(a, b))."""
+
+    def apply(self, ops: List[LogicalOp]) -> List[LogicalOp]:
+        out: List[LogicalOp] = []
+        for op in ops:
+            if (isinstance(op, Limit) and out
+                    and isinstance(out[-1], Limit)):
+                out[-1] = Limit(n=min(out[-1].n, op.n))
+            else:
+                out.append(op)
+        return out
+
+
+class LimitPushdown(Rule):
+    """Push Limit below row-count-preserving maps so upstream stages
+    produce only what survives (reference LimitPushdownRule). MapRows is
+    one-to-one; Filter/FlatMap/MapBatches may change the row count, so the
+    limit must stay above them."""
+
+    def apply(self, ops: List[LogicalOp]) -> List[LogicalOp]:
+        out = list(ops)
+        changed = True
+        while changed:
+            changed = False
+            for i in range(1, len(out)):
+                if isinstance(out[i], Limit) and isinstance(out[i - 1],
+                                                            MapRows):
+                    out[i - 1], out[i] = out[i], out[i - 1]
+                    changed = True
+        return out
+
+
+class DropRedundantShuffles(Rule):
+    """A repartition/shuffle immediately followed by another whole-dataset
+    redistribution does dead work: sort and shuffle re-distribute anyway,
+    and of consecutive repartitions only the last layout survives."""
+
+    _REDIST = (Repartition, RandomShuffle, Sort)
+
+    def apply(self, ops: List[LogicalOp]) -> List[LogicalOp]:
+        out: List[LogicalOp] = []
+        for op in ops:
+            if (out and isinstance(out[-1], (Repartition, RandomShuffle))
+                    and isinstance(op, self._REDIST)
+                    # A shuffle feeding a plain repartition still matters
+                    # (the randomization is the point); everything else
+                    # makes the PREVIOUS redistribution dead.
+                    and not (isinstance(out[-1], RandomShuffle)
+                             and isinstance(op, Repartition))):
+                out[-1] = op
+            else:
+                out.append(op)
+        return out
+
+
+DEFAULT_RULES: List[Rule] = [MergeLimits(), LimitPushdown(),
+                             DropRedundantShuffles(), MergeLimits()]
+
+
+def optimize(ops: List[LogicalOp],
+             rules: Optional[List[Rule]] = None) -> List[LogicalOp]:
+    """Apply the rule list to fixpoint (bounded: each rule only ever
+    shrinks or reorders, but cap passes defensively)."""
+    for _ in range(8):
+        before = list(ops)
+        for rule in (rules if rules is not None else DEFAULT_RULES):
+            ops = rule.apply(ops)
+        if ops == before:
+            break
+    return ops
+
+
 def is_fusable_map(op: LogicalOp) -> bool:
     if isinstance(op, ROW_OPS):
         return True

@@ -302,3 +302,52 @@ def test_from_huggingface(ray_start_regular):
     dd = hf.DatasetDict({"train": src})
     with pytest.raises(ValueError, match="split"):
         rdata.from_huggingface(dd)
+
+
+def test_optimizer_rewrite_rules():
+    """Rule-based plan rewrites (reference: logical/optimizers.py):
+    limits merge and push below one-to-one maps; dead redistributions
+    drop before sort/shuffle."""
+    import ray_tpu.data.logical as L
+
+    def inc(r):
+        return r
+
+    # limit(10).limit(4) -> limit(4); pushed below MapRows.
+    plan = [L.InputData(refs=[]), L.MapRows(fn=inc), L.Limit(n=10),
+            L.Limit(n=4)]
+    out = L.optimize(plan)
+    kinds = [type(o).__name__ for o in out]
+    assert kinds == ["InputData", "Limit", "MapRows"], kinds
+    assert [o.n for o in out if isinstance(o, L.Limit)] == [4]
+
+    # repartition -> sort: the repartition is dead work.
+    plan = [L.InputData(refs=[]), L.Repartition(num_blocks=8),
+            L.Sort(key="x")]
+    out = L.optimize(plan)
+    assert [type(o).__name__ for o in out] == ["InputData", "Sort"]
+
+    # shuffle -> repartition keeps BOTH (the randomization matters)...
+    plan = [L.InputData(refs=[]), L.RandomShuffle(),
+            L.Repartition(num_blocks=4)]
+    out = L.optimize(plan)
+    assert [type(o).__name__ for o in out] == [
+        "InputData", "RandomShuffle", "Repartition"]
+    # ...but repartition -> repartition collapses to the last.
+    plan = [L.InputData(refs=[]), L.Repartition(num_blocks=8),
+            L.Repartition(num_blocks=2)]
+    out = L.optimize(plan)
+    assert [type(o).__name__ for o in out] == ["InputData", "Repartition"]
+    assert out[-1].num_blocks == 2
+
+
+def test_optimizer_preserves_results(ray_start_regular):
+    """The optimized plan computes the same answer."""
+    import ray_tpu.data as rdata
+
+    ds = (rdata.range(100)
+          .map(lambda r: {"id": r["id"], "v": r["id"] * 2})
+          .limit(10))
+    rows = ds.take_all()
+    assert len(rows) == 10
+    assert [r["v"] for r in rows] == [2 * i for i in range(10)]
