@@ -1,5 +1,7 @@
-from .io import JsonWriter, read_experiences, write_fragments
+from .io import (JsonWriter, read_experiences, write_fragments,
+                 write_transitions)
 from .bc import BC, BCConfig
+from .cql import CQL, CQLConfig
 
-__all__ = ["BC", "BCConfig", "JsonWriter", "read_experiences",
-           "write_fragments"]
+__all__ = ["BC", "BCConfig", "CQL", "CQLConfig", "JsonWriter",
+           "read_experiences", "write_fragments", "write_transitions"]

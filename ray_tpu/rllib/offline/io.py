@@ -72,6 +72,17 @@ def write_fragments(frags: Sequence[Dict[str, Any]], path: str) -> str:
     return JsonWriter(path).write(merged)
 
 
+def write_transitions(columns: Dict[str, np.ndarray], path: str) -> str:
+    """Append one shard of FLAT transition columns (offline continuous-RL
+    data: obs/actions/rewards/next_obs/dones — the (s, a, r, s', d) tuples
+    CQL/SAC-style learners consume, vs write_fragments' [T,N] on-policy
+    rollout layout). All columns must share the leading length."""
+    n = {k: len(v) for k, v in columns.items()}
+    if len(set(n.values())) != 1:
+        raise ValueError(f"ragged transition columns: {n}")
+    return JsonWriter(path).write(dict(columns))
+
+
 def read_experiences(path: str):
     """Offline dataset of transitions as a ray_tpu.data Dataset (the
     reference's OfflineData-on-ray.data design, rllib/offline/offline_data.py)."""
