@@ -84,6 +84,9 @@ class NodeInfo:
     # Host memory usage fraction (agent heartbeats / controller psutil for
     # local nodes); drives the memory monitor's kill decisions.
     mem_fraction: float = 0.0
+    # Per-worker-process cpu%/rss from the agent heartbeat (dashboard
+    # reporter parity); pid -> {cpu_percent, rss}.
+    proc_stats: Dict[str, Dict[str, float]] = field(default_factory=dict)
 
 
 @dataclass
@@ -1855,6 +1858,10 @@ class Controller:
                     "alive": n.alive,
                     "index": n.index,
                     "num_workers": len(n.workers),
+                    "mem_fraction": n.mem_fraction,
+                    # Per-worker-process cpu%/rss (agent heartbeats;
+                    # dashboard reporter parity). Empty for virtual nodes.
+                    "proc_stats": dict(n.proc_stats),
                 }
                 for n in self.nodes.values()
             ],
@@ -1903,6 +1910,8 @@ class Controller:
             node.arena_stats = msg.get("arena") or {}
             if msg.get("mem_fraction") is not None:
                 node.mem_fraction = float(msg["mem_fraction"])
+            if msg.get("proc_stats") is not None:
+                node.proc_stats = msg["proc_stats"]
         return None
 
     async def _h_spawn_exited(self, conn, msg):

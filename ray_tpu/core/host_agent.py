@@ -233,7 +233,35 @@ class HostAgent:
                     except Exception:
                         pass
 
+    def _proc_stats(self) -> Dict[str, Dict[str, float]]:
+        """Per-worker-process cpu%/rss (reference: the dashboard agent's
+        reporter sampling its node's worker processes). cpu_percent uses
+        the interval since the previous heartbeat's call — free."""
+        out: Dict[str, Dict[str, float]] = {}
+        try:
+            import psutil
+        except Exception:
+            return out
+        for token, proc in list(self.procs.items()):
+            if proc.poll() is not None:
+                continue
+            try:
+                p = self._psutil_cache.get(proc.pid)
+                if p is None:
+                    p = psutil.Process(proc.pid)
+                    self._psutil_cache[proc.pid] = p
+                    p.cpu_percent(None)  # prime the interval
+                with p.oneshot():
+                    out[str(proc.pid)] = {
+                        "cpu_percent": p.cpu_percent(None),
+                        "rss": float(p.memory_info().rss),
+                    }
+            except Exception:
+                self._psutil_cache.pop(proc.pid, None)
+        return out
+
     async def _heartbeat_loop(self) -> None:
+        self._psutil_cache: Dict[int, Any] = {}
         while not self._stop.is_set():
             stats = self.arena.stats() if self.arena else {}
             try:
@@ -251,6 +279,7 @@ class HostAgent:
                         "arena": stats,
                         "num_workers": len(self.procs),
                         "mem_fraction": mem_fraction,
+                        "proc_stats": self._proc_stats(),
                     }
                 )
             except Exception:

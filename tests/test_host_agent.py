@@ -141,3 +141,43 @@ def test_node_death_fails_tasks_and_marks_node(agent_cluster):
             break
         time.sleep(0.2)
     assert not alive
+
+
+def test_heartbeat_carries_proc_stats():
+    """Agent heartbeats include per-worker-process cpu/rss (reference:
+    the dashboard agent's reporter), surfaced through list_nodes."""
+    import time
+
+    import ray_tpu
+    from ray_tpu.core.cluster_utils import Cluster
+
+    cluster = Cluster(head_resources={"CPU": 1})
+    try:
+        nid = cluster.add_node({"CPU": 2}, remote=True,
+                               host_id="stats-host-b")
+        from ray_tpu.util.scheduling_strategies import (
+            NodeAffinitySchedulingStrategy)
+
+        @ray_tpu.remote(scheduling_strategy=NodeAffinitySchedulingStrategy(
+            node_id=nid, soft=False))
+        def burn():
+            t0 = time.time()
+            while time.time() - t0 < 0.5:
+                pass
+            return 1
+
+        assert ray_tpu.get(burn.remote(), timeout=60) == 1
+        deadline = time.time() + 20
+        stats = {}
+        while time.time() < deadline:
+            node = {n["node_id"]: n for n in ray_tpu.nodes()}[nid]
+            stats = node.get("proc_stats") or {}
+            if stats:
+                break
+            time.sleep(0.5)
+        assert stats, "agent never reported proc stats"
+        row = next(iter(stats.values()))
+        assert row["rss"] > 1e6  # a real python process
+        assert "cpu_percent" in row
+    finally:
+        cluster.shutdown()
