@@ -182,8 +182,15 @@ def test_streaming_handle(serve_instance):
     first = next(it)
     t_first = time.perf_counter() - t0
     assert first == {"tick": 0}
-    assert t_first < 0.9, f"first item took {t_first:.2f}s — not streaming"
-    assert list(it) == [{"tick": i} for i in range(1, 4)]
+    rest = list(it)
+    t_all = time.perf_counter() - t0
+    assert rest == [{"tick": i} for i in range(1, 4)]
+    # Streaming proof by RELATIVE timing (absolute thresholds flake on a
+    # loaded 1-core CI host): the first item must arrive well before the
+    # full 0.75s of remaining production; buffered-then-returned delivery
+    # would put t_first ~= t_all.
+    assert t_first < t_all - 0.4, (
+        f"first item at {t_first:.2f}s of {t_all:.2f}s — not streaming")
 
 
 def test_streaming_http_chunked(serve_instance):
