@@ -11,8 +11,8 @@ Parity map (reference rllib/, SURVEY.md §2.7):
 """
 from .algorithm import Algorithm
 from .algorithm_config import AlgorithmConfig
-from .algorithms import (IMPALA, IMPALAConfig, PPO, PPOConfig, SAC,
-                         SACConfig)
+from .algorithms import (APPO, APPOConfig, IMPALA, IMPALAConfig, PPO,
+                         PPOConfig, SAC, SACConfig)
 from .core import JaxLearner, LearnerGroup, MLPModule, RLModule
 from .env import EnvRunnerGroup, SingleAgentEnvRunner
 from .env.multi_agent_env import (MultiAgentBatchedEnv, MultiAgentEnv,
@@ -27,6 +27,8 @@ __all__ = [
     "make_multi_agent_creator",
     "Algorithm",
     "AlgorithmConfig",
+    "APPO",
+    "APPOConfig",
     "PPO",
     "SAC",
     "SACConfig",

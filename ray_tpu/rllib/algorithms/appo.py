@@ -1,0 +1,89 @@
+"""APPO: asynchronous PPO — IMPALA's pipeline with the clipped surrogate.
+
+Parity: reference rllib/algorithms/appo/ (appo.py: "APPO is an
+asynchronous variant of PPO based on the IMPALA architecture" — v-trace
+corrected advantages consumed by PPO's clipped-ratio objective plus a KL
+penalty against the behavior policy). Everything asynchronous (permanently
+in-flight sample futures, bounded-lag weight broadcast, runner healing) is
+inherited from IMPALA unchanged; only the loss differs, and it stays one
+jitted program.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..utils.gae import vtrace
+from .impala import IMPALA, IMPALAConfig, IMPALALearner
+
+
+class APPOConfig(IMPALAConfig):
+    def __init__(self, algo_class=None):
+        super().__init__(algo_class=algo_class or APPO)
+        self.clip_param: float = 0.2      # PPO surrogate clip
+        self.kl_coeff: float = 0.2        # behavior-KL penalty weight
+        self.use_kl_loss: bool = True
+
+
+class APPOLearner(IMPALALearner):
+    def loss(self, params, batch, rng):
+        cfg = self.cfg
+        B, T = batch["rewards"].shape
+        obs = batch["obs"].reshape((B * T,) + batch["obs"].shape[2:])
+        out = self.module.forward(params, obs)
+        logits = out["logits"].reshape(B, T, -1)
+        values = out["vf"].reshape(B, T)
+
+        dist = self.module.action_dist(logits)
+        target_logp = dist.logp(batch["actions"])
+        entropy = dist.entropy()
+
+        vs, pg_adv = vtrace(
+            batch["logp"], target_logp, batch["rewards"],
+            values, batch["dones"], batch["bootstrap_value"],
+            gamma=cfg.gamma,
+            clip_rho=cfg.clip_rho_threshold,
+            clip_c=cfg.clip_c_threshold,
+        )
+        vs = jax.lax.stop_gradient(vs)
+        pg_adv = jax.lax.stop_gradient(pg_adv)
+
+        mask = batch["mask"]
+        msum = jnp.maximum(mask.sum(), 1.0)
+        # PPO clipped surrogate on the v-trace advantages (the APPO
+        # difference vs IMPALA's plain policy gradient).
+        ratio = jnp.exp(target_logp - batch["logp"])
+        clipped = jnp.clip(ratio, 1.0 - cfg.clip_param, 1.0 + cfg.clip_param)
+        surrogate = jnp.minimum(ratio * pg_adv, clipped * pg_adv)
+        pi_loss = -(surrogate * mask).sum() / msum
+        vf_loss = (((values - vs) ** 2) * mask).sum() / msum
+        ent = (entropy * mask).sum() / msum
+        # KL(behavior || target) estimated from logp samples keeps the
+        # async policy from drifting past the clip's trust region.
+        kl = ((batch["logp"] - target_logp) * mask).sum() / msum
+        total = (pi_loss + cfg.vf_loss_coeff * vf_loss
+                 - cfg.entropy_coeff * ent)
+        if cfg.use_kl_loss:
+            total = total + cfg.kl_coeff * jnp.abs(kl)
+        return total, {
+            "policy_loss": pi_loss,
+            "vf_loss": vf_loss,
+            "entropy": ent,
+            "kl": kl,
+            "mean_ratio": (ratio * mask).sum() / msum,
+        }
+
+
+class APPO(IMPALA):
+    config_cls = APPOConfig
+
+    def _learner_factory(self):
+        cfg = self._algo_config
+        module_factory = self._module_factory()
+        mesh = cfg.learner_mesh
+
+        def factory():
+            return APPOLearner(module_factory(), cfg, mesh=mesh,
+                               seed=cfg.seed)
+
+        return factory
