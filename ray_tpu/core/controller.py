@@ -818,6 +818,46 @@ class Controller:
     async def _h_rpc_stats(self, conn, msg):
         return dict(self.rpc_counts)
 
+    async def _h_worker_logs(self, conn, msg):
+        """List / tail worker log files across hosts (dashboard log
+        viewer; reference: dashboard log endpoints). Controller-host logs
+        read locally; agent hosts answer over their control connection."""
+        import os as _os
+
+        from .worker_logs import log_dir
+
+        node_id = msg.get("node_id") or ""
+        name = msg.get("name")
+        node = self.nodes.get(node_id)
+        if node is not None and node.agent_conn is not None:
+            try:
+                if name:
+                    return await node.agent_conn.request(
+                        {"kind": "tail_log", "name": name,
+                         "bytes": msg.get("bytes", 65536)}, timeout=10)
+                return await node.agent_conn.request(
+                    {"kind": "list_logs"}, timeout=10)
+            except Exception as e:
+                return f"<agent unavailable: {e}>" if name else []
+        # Local (controller-spawned workers).
+        if not name:
+            try:
+                d = log_dir()
+                return sorted(
+                    f for f in _os.listdir(d) if f.startswith("worker-"))
+            except OSError:
+                return []
+        safe = _os.path.basename(name)
+        nbytes = min(int(msg.get("bytes", 65536)), 1 << 20)
+        try:
+            path = _os.path.join(log_dir(), safe)
+            size = _os.path.getsize(path)
+            with open(path, "rb") as f:
+                f.seek(max(0, size - nbytes))
+                return f.read().decode("utf-8", "replace")
+        except OSError as e:
+            return f"<log unavailable: {e}>"
+
     async def _h_wait(self, conn, msg):
         """O(n) wait: one callback registration per missing object, arrivals
         drained incrementally (the previous design re-registered a waiter

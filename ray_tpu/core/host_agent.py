@@ -173,6 +173,30 @@ class HostAgent:
             return {"ok": True}
         if kind == "pull_chunk":
             return read_location_range(msg["loc"], msg["offset"], msg["length"])
+        if kind == "list_logs":
+            from .worker_logs import log_dir
+
+            try:
+                d = log_dir()
+                return sorted(
+                    f for f in os.listdir(d) if f.startswith("worker-"))
+            except OSError:
+                return []
+        if kind == "tail_log":
+            # Bounded tail of one worker log (dashboard log viewer;
+            # reference: dashboard log endpoints reading session logs).
+            from .worker_logs import log_dir
+
+            name = os.path.basename(msg["name"])  # no traversal
+            nbytes = min(int(msg.get("bytes", 65536)), 1 << 20)
+            try:
+                path = os.path.join(log_dir(), name)
+                size = os.path.getsize(path)
+                with open(path, "rb") as f:
+                    f.seek(max(0, size - nbytes))
+                    return f.read().decode("utf-8", "replace")
+            except OSError as e:
+                return f"<log unavailable: {e}>"
         raise ValueError(f"host_agent: unknown message kind {kind!r}")
 
     def _spawn_worker(self, msg: Dict[str, Any],

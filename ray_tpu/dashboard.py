@@ -271,6 +271,17 @@ class Dashboard:
                     None, lambda: state_api.profile_workers(t))
             elif kind == "usage":
                 data = _local_usage()
+            elif kind == "logs":
+                # ?node=<node_id> scopes to an agent host; ?name=<file>
+                # tails that worker log (plain text in a JSON string).
+                from ray_tpu.core import context as _ctx
+
+                data = _ctx.get_worker_context().client.request({
+                    "kind": "worker_logs",
+                    "node_id": request.query.get("node", ""),
+                    "name": request.query.get("name"),
+                    "bytes": int(request.query.get("bytes", 65536)),
+                })
             else:
                 return web.Response(status=404, text=f"unknown: {kind}")
         except Exception as e:

@@ -130,3 +130,21 @@ def test_grafana_dashboard_generation(dash, tmp_path):
     write_dashboard(str(out), prom)
     loaded = _json.loads(out.read_text())
     assert loaded["panels"]
+
+
+def test_log_viewer_lists_and_tails(dash):
+    """/api/logs lists worker log files and tails one (reference:
+    dashboard log endpoints over session worker-*.out files)."""
+    @ray_tpu.remote
+    def chatty():
+        print("hello from the log viewer test", flush=True)
+        return 1
+
+    assert ray_tpu.get(chatty.remote(), timeout=60) == 1
+    status, body = _get(dash, "/api/logs")
+    names = json.loads(body)
+    assert status == 200 and isinstance(names, list)
+    if names:  # controller-spawned workers write worker-*.out locally
+        status, body = _get(dash, f"/api/logs?name={names[0]}")
+        assert status == 200
+        assert isinstance(json.loads(body), str)
