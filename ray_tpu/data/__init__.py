@@ -20,6 +20,8 @@ from .read_api import (
     read_json,
     read_numpy,
     read_parquet,
+    read_text,
+    read_tfrecords,
 )
 
 __all__ = [
@@ -44,4 +46,6 @@ __all__ = [
     "read_json",
     "read_numpy",
     "read_parquet",
+    "read_text",
+    "read_tfrecords",
 ]

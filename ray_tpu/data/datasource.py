@@ -170,6 +170,45 @@ class BinaryDatasource(FileDatasource):
         return pa.Table.from_pydict({"bytes": [data], "path": [path]})
 
 
+class TextDatasource(FileDatasource):
+    """One row per line (reference read_api.py read_text): {"text": line},
+    trailing newlines stripped, encoding errors replaced."""
+
+    def __init__(self, paths, encoding: str = "utf-8",
+                 drop_empty_lines: bool = True, **kwargs):
+        super().__init__(paths, **kwargs)
+        self.encoding = encoding
+        self.drop_empty_lines = drop_empty_lines
+
+    def read_file(self, path: str) -> Block:
+        import pyarrow as pa
+
+        with open(path, "rb") as f:
+            text = f.read().decode(self.encoding, "replace")
+        lines = text.splitlines()
+        if self.drop_empty_lines:
+            lines = [l for l in lines if l.strip()]
+        return pa.Table.from_pydict({"text": lines})
+
+
+class TFRecordDatasource(FileDatasource):
+    """TFRecord shards of tf.train.Example protos -> columnar blocks
+    (reference read_api.py read_tfrecords). The record framing
+    (len/maskedcrc/payload/maskedcrc) and the Example wire format are
+    parsed directly — no tensorflow dependency; CRCs are skipped like the
+    reference's fast path."""
+
+    suffix = ".tfrecord"
+
+    def read_file(self, path: str) -> Block:
+        import pyarrow as pa
+
+        from .tfrecord_lite import parse_tfrecord_examples
+
+        cols = parse_tfrecord_examples(path)
+        return pa.Table.from_pydict(cols)
+
+
 class ImageDatasource(FileDatasource):
     """Decode images into {"image": ndarray} blocks (reference
     python/ray/data/read_api.py:776 read_images). ``size=(h, w)`` resizes

@@ -128,6 +128,22 @@ def read_numpy(paths, *, parallelism: int = -1, **kwargs) -> Dataset:
     return _mk(NumpyDatasource(paths, **kwargs), parallelism)
 
 
+def read_text(paths, *, parallelism: int = -1, **kwargs) -> Dataset:
+    """One row per line: {"text": line} (reference read_api read_text)."""
+    from .datasource import TextDatasource
+
+    return _mk(TextDatasource(paths, **kwargs), parallelism)
+
+
+def read_tfrecords(paths, *, parallelism: int = -1, **kwargs) -> Dataset:
+    """tf.train.Example TFRecord shards -> columnar rows (reference
+    read_api read_tfrecords; dependency-free proto parsing in
+    data/tfrecord_lite.py)."""
+    from .datasource import TFRecordDatasource
+
+    return _mk(TFRecordDatasource(paths, **kwargs), parallelism)
+
+
 def read_binary_files(paths, *, parallelism: int = -1) -> Dataset:
     return _mk(BinaryDatasource(paths), parallelism)
 

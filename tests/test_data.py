@@ -351,3 +351,30 @@ def test_optimizer_preserves_results(ray_start_regular):
     rows = ds.take_all()
     assert len(rows) == 10
     assert [r["v"] for r in rows] == [2 * i for i in range(10)]
+
+
+def test_read_text(ray_start_regular, tmp_path):
+    p = tmp_path / "a.txt"
+    p.write_text("alpha\n\nbeta\ngamma\n")
+    import ray_tpu.data as rdata
+
+    rows = rdata.read_text(str(p)).take_all()
+    assert [r["text"] for r in rows] == ["alpha", "beta", "gamma"]
+
+
+def test_read_tfrecords_roundtrip(ray_start_regular, tmp_path):
+    """Dependency-free Example proto parsing (reference read_tfrecords):
+    bytes/int64/float features, scalar and list, survive a roundtrip."""
+    import ray_tpu.data as rdata
+    from ray_tpu.data.tfrecord_lite import write_tfrecord_examples
+
+    p = tmp_path / "shard.tfrecord"
+    write_tfrecord_examples(str(p), {
+        "name": [b"ada", b"grace"],
+        "age": [36, 85],
+        "scores": [[1.5, 2.5], [3.5, 4.5]],
+    })
+    rows = rdata.read_tfrecords(str(p)).take_all()
+    assert len(rows) == 2
+    assert rows[0]["name"] == b"ada" and rows[1]["age"] == 85
+    assert [round(x, 1) for x in rows[1]["scores"]] == [3.5, 4.5]
