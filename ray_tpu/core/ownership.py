@@ -31,11 +31,18 @@ same connection as — and therefore lands before — its eventual
 ``hold_release`` on ITS connection. Any interleaving of the two connections
 leaves at least one protector registered at all times.
 
-Known v1 bound (documented, safe direction): refs NESTED inside a stored
-object's payload are pinned by the serializing process for that process's
-lifetime (see ``pin_nested``) — objects can only live too long, never too
-short. The reference ties nested lifetime to the outer object's metadata;
-that refinement needs free-notification fan-out to producers.
+Known v1 bounds (documented, both in the SAFE direction — objects can only
+live too long, never too short):
+
+- Refs NESTED inside a stored object's payload are pinned by the
+  serializing process for that process's lifetime (see ``pin_nested``).
+  The reference ties nested lifetime to the outer object's metadata; that
+  refinement needs free-notification fan-out to producers.
+- A borrower that DIES without draining leaves its token in the owner's
+  borrower set, pinning the object until the owner process exits (the
+  reference detects this via WaitForRefRemoved channel failure). Arena
+  pressure still reclaims the bytes through the controller's spill/evict
+  path, so the leak is directory metadata, not memory.
 """
 from __future__ import annotations
 
