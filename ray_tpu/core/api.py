@@ -668,6 +668,17 @@ def _cache_loc(loc) -> None:
     ownership.on_return_location(loc.object_id)
 
 
+_actor_seqnos: Dict[str, int] = {}
+_actor_seqnos_lock = threading.Lock()
+
+
+def _next_actor_seqno(actor_id: str) -> int:
+    with _actor_seqnos_lock:
+        n = _actor_seqnos.get(actor_id, 0)
+        _actor_seqnos[actor_id] = n + 1
+        return n
+
+
 def _register_dep_holds(spec: Dict[str, Any], nested_refs=()) -> None:
     """Pin the spec's deps AND refs nested in its args at their owners for
     the life of the submission (reference: reference_count.h counts every id
@@ -1204,6 +1215,13 @@ class ActorHandle:
             "return_ids": return_ids,
             "resources": {},
             "label": f"actor.{method}",
+            # Per-(caller, actor) sequence numbers: calls from one caller
+            # can ride different paths (direct socket vs controller
+            # fallback) and overtake each other; the mailbox restores
+            # submission order (reference: direct_actor_task_submitter's
+            # per-caller sequence_no).
+            "caller": ownership.process_token(),
+            "seqno": _next_actor_seqno(self._actor_id),
         }
         if streaming:
             _streaming_spec_opts({}, spec)

@@ -48,6 +48,9 @@ class ActorMailbox:
         self.actor_id = actor_id
         self.instance: Any = None
         self.q: "queue.Queue[Optional[Dict[str, Any]]]" = queue.Queue()
+        # Per-caller sequence reordering state: caller -> {next, held}.
+        self._seq: Dict[str, Dict[str, Any]] = {}
+        self._seq_lock = threading.Lock()
         self.aio_loop: Any = None  # created lazily for async actors
         self.aio_sem: Any = None
         self._aio_lock = threading.Lock()
@@ -59,8 +62,62 @@ class ActorMailbox:
         for t in self.threads:
             t.start()
 
+    # How long a sequence gap may stall later calls before they flush
+    # anyway (the missing call may have failed permanently en route, or
+    # this actor restarted and joined the caller's sequence mid-stream).
+    _SEQ_GAP_TIMEOUT_S = 1.0
+
     def submit(self, spec: Dict[str, Any]) -> None:
-        self.q.put(spec)
+        """Enqueue in per-caller SUBMISSION order (reference:
+        direct_actor_task_submitter sequence_no). Calls from one caller can
+        arrive over two paths (direct socket, controller fallback) and
+        overtake; out-of-order arrivals wait in a per-caller hold-back
+        buffer until the gap fills — or until a bounded timeout flushes
+        them, so a call lost to a path failure stalls ordering, not the
+        actor."""
+        caller = spec.get("caller")
+        seq = spec.get("seqno")
+        if caller is None or seq is None:
+            self.q.put(spec)
+            return
+        with self._seq_lock:
+            state = self._seq.get(caller)
+            if state is None:
+                # Fresh caller: sequences start at 0. (A RESTARTED actor
+                # joining a caller's stream mid-sequence parks the first
+                # arrival in the hold-back buffer until the gap timer
+                # flushes it — a one-time bounded hiccup, never a stall.)
+                state = self._seq[caller] = {"next": 0, "held": {}}
+            if seq < state["next"]:
+                self.q.put(spec)  # late duplicate/retry: run, don't stall
+                return
+            if seq > state["next"]:
+                state["held"][seq] = spec
+                threading.Timer(self._SEQ_GAP_TIMEOUT_S,
+                                self._flush_seq_gap,
+                                args=(caller, seq)).start()
+                return
+            self.q.put(spec)
+            state["next"] = seq + 1
+            while state["next"] in state["held"]:
+                self.q.put(state["held"].pop(state["next"]))
+                state["next"] += 1
+
+    def _flush_seq_gap(self, caller: str, seq: int) -> None:
+        """Timeout fallback: the call before `seq` never arrived — release
+        everything held, in order, and advance the cursor past it."""
+        with self._seq_lock:
+            state = self._seq.get(caller)
+            if state is None or seq not in state["held"]:
+                return  # gap filled in time
+            for s in sorted(state["held"]):
+                if s > seq:
+                    break
+                self.q.put(state["held"].pop(s))
+            state["next"] = max(state["next"], seq + 1)
+            while state["next"] in state["held"]:
+                self.q.put(state["held"].pop(state["next"]))
+                state["next"] += 1
 
     def stop(self) -> None:
         for _ in self.threads:

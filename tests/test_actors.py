@@ -103,3 +103,55 @@ def test_async_actor_method(ray_start_regular):
 
     a = AsyncActor.remote()
     assert ray_tpu.get(a.compute.remote(21)) == 42
+
+
+def test_mailbox_restores_cross_path_submission_order():
+    """Per-caller seqnos reorder calls that overtook each other between
+    the direct and controller paths (reference:
+    direct_actor_task_submitter sequence_no); a permanently missing seqno
+    flushes the hold-back after a bounded timeout instead of stalling."""
+    import threading
+    import time as _t
+
+    from ray_tpu.core.worker import ActorMailbox
+
+    class FakeRuntime:
+        def __init__(self):
+            self.order = []
+            self.ev = threading.Event()
+
+        def run_task(self, spec, actor_instance=None, mailbox=None):
+            self.order.append(spec["seqno"])
+            if len(self.order) >= self.expect:
+                self.ev.set()
+
+    rt = FakeRuntime()
+    rt.expect = 4
+    mb = ActorMailbox(rt, "a" * 16, 1)
+    try:
+        # 1 overtakes 0 (two sockets); 2, 3 follow in order.
+        mb.submit({"caller": "c1", "seqno": 1})
+        mb.submit({"caller": "c1", "seqno": 0})
+        mb.submit({"caller": "c1", "seqno": 2})
+        mb.submit({"caller": "c1", "seqno": 3})
+        assert rt.ev.wait(5)
+        _t.sleep(0.1)
+        assert rt.order == [0, 1, 2, 3], rt.order
+
+        # A gap that never fills (seqno 4 lost) flushes 5 after the
+        # timeout rather than stalling the actor forever.
+        rt.order.clear()
+        rt.ev.clear()
+        rt.expect = 1
+        mb.submit({"caller": "c1", "seqno": 5})
+        assert not rt.ev.wait(0.3), "gap should have held seqno 5 briefly"
+        assert rt.ev.wait(3), "gap timeout never flushed"
+        assert rt.order == [5]
+
+        # Specs without seqnos (internal/legacy) bypass ordering entirely.
+        rt.order.clear()
+        rt.ev.clear()
+        mb.submit({"seqno": None, "caller": None})
+        _t.sleep(0.2)
+    finally:
+        mb.stop()
