@@ -28,6 +28,7 @@ from ray_tpu import flags
 
 import asyncio
 import collections
+import json
 import os
 import subprocess
 import sys
@@ -435,6 +436,8 @@ class Controller:
         if not node.alive:
             return
         node.alive = False
+        self._export_event("NODE", {"node_id": node.node_id,
+                                    "event": "dead", "ts": time.time()})
         node.agent_conn = None
         node.agent_addr = None
         for wid in list(node.workers):
@@ -604,6 +607,9 @@ class Controller:
             return False
         actor.restart_count += 1
         actor.state = "restarting"
+        self._export_event("ACTOR", {"actor_id": actor.actor_id,
+                                     "event": "restarting",
+                                     "ts": time.time()})
         # Fail calls already forwarded to the dead worker — but NOT calls
         # still buffered in pending_calls (never dispatched): those replay
         # after restart, and erroring them here would double-signal.
@@ -946,7 +952,7 @@ class Controller:
         return blob
 
     def _record_task_event(self, spec, event: str, **extra) -> None:
-        self.task_events.append({
+        ev = {
             "task_id": spec.get("task_id"),
             "label": spec.get("label"),
             "actor_id": spec.get("actor_id"),
@@ -954,7 +960,38 @@ class Controller:
             "ts": time.time(),
             "worker_id": extra.get("worker_id") or spec.get("_worker_id"),
             "node_id": extra.get("node_id") or spec.get("sched_node"),
-        })
+        }
+        self.task_events.append(ev)
+        self._export_event("TASK", ev)
+
+    def _export_event(self, source: str, payload: Dict[str, Any]) -> None:
+        """Structured export-event pipeline (reference: src/ray/util/event.h
+        RAY_EVENT + the export-event JSONL files external systems tail):
+        when RTPU_EVENT_EXPORT_PATH is set, every control-plane event
+        appends one {source_type, timestamp, event_data} JSON line. Opened
+        lazily, line-buffered; failures disable export rather than touch
+        the control plane."""
+        path = flags.get("RTPU_EVENT_EXPORT_PATH")
+        if not path:
+            return
+        f = getattr(self, "_export_file", None)
+        if f is None:
+            try:
+                f = self._export_file = open(path, "a", buffering=1)
+            except OSError:
+                self._export_file = False
+                return
+        if f is False:
+            return
+        try:
+            f.write(json.dumps({
+                "source_type": source,
+                "timestamp": payload.get("ts") or time.time(),
+                "event_data": {k: v for k, v in payload.items()
+                               if k != "ts"},
+            }, default=str) + "\n")
+        except Exception:
+            self._export_file = False
 
     async def _h_submit_task(self, conn, msg):
         spec = msg["spec"]
@@ -1260,6 +1297,10 @@ class Controller:
             for call in calls:
                 await self._dispatch_actor_call(actor, call)
         actor.state = "alive"
+        self._export_event("ACTOR", {"actor_id": actor.actor_id,
+                                     "event": "alive", "name": actor.name,
+                                     "node_id": actor.node_id,
+                                     "ts": time.time()})
         return {"ok": True}
 
     async def _h_actor_error(self, conn, msg):
@@ -1477,6 +1518,8 @@ class Controller:
 
     def _mark_actor_dead(self, actor: ActorInfo, err: Exception) -> None:
         actor.state = "dead"
+        self._export_event("ACTOR", {"actor_id": actor.actor_id,
+                                     "event": "dead", "ts": time.time()})
         if actor.detached:
             self._state_dirty = True
         actor.creation_error = actor.creation_error or err

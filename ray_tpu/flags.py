@@ -99,6 +99,10 @@ _define("RTPU_SCHED_HYBRID_THRESHOLD", float, 0.5,
 _define("RTPU_SCHED_TOP_K", int, 1,
         "Randomize DEFAULT placement among the best k nodes (anti-herding "
         "at scale); 1 keeps placement deterministic.")
+_define("RTPU_EVENT_EXPORT_PATH", str, None,
+        "Append structured control-plane events (task/actor/node "
+        "lifecycle) as JSONL to this file for external pipelines "
+        "(reference export-event files).")
 _define("RTPU_TRACING", bool, False,
         "OpenTelemetry span propagation through task submission "
         "(util/tracing.py setup_tracing); workers inherit via env.")

@@ -1,0 +1,46 @@
+"""Structured export-event pipeline (own module: owns its cluster).
+Reference: src/ray/util/event.h export events."""
+import ray_tpu
+
+
+def test_event_export_pipeline(tmp_path):
+    """RTPU_EVENT_EXPORT_PATH appends structured JSONL control-plane
+    events (reference: the export-event files external pipelines tail)."""
+    import json as _json
+    import os as _os
+
+    export = tmp_path / "events.jsonl"
+    _os.environ["RTPU_EVENT_EXPORT_PATH"] = str(export)
+    try:
+        ray_tpu.init(num_cpus=2)
+
+        @ray_tpu.remote
+        def traced():
+            return 1
+
+        @ray_tpu.remote
+        class A:
+            def ping(self):
+                return "ok"
+
+        assert ray_tpu.get(traced.remote(), timeout=30) == 1
+        a = A.remote()
+        assert ray_tpu.get(a.ping.remote(), timeout=30) == "ok"
+        del a
+        ray_tpu.shutdown()
+
+        lines = [_json.loads(l) for l in export.read_text().splitlines()]
+        assert lines, "no events exported"
+        sources = {l["source_type"] for l in lines}
+        assert "TASK" in sources and "ACTOR" in sources, sources
+        task_events = [l["event_data"]["event"] for l in lines
+                       if l["source_type"] == "TASK"]
+        assert "submitted" in task_events and "finished" in task_events
+        actor_events = [l["event_data"]["event"] for l in lines
+                        if l["source_type"] == "ACTOR"]
+        assert "alive" in actor_events
+        assert all("timestamp" in l for l in lines)
+    finally:
+        _os.environ.pop("RTPU_EVENT_EXPORT_PATH", None)
+        if ray_tpu.is_initialized():
+            ray_tpu.shutdown()
