@@ -28,6 +28,8 @@ def main():
     ap.add_argument("--steps", type=int, default=15)
     ap.add_argument("--warmup", type=int, default=2)
     ap.add_argument("--no-shift", action="store_true")
+    ap.add_argument("--fused-ce", action="store_true",
+                    help="chunked fused lm-head+CE (ops/fused_ce.py)")
     args = ap.parse_args()
 
     import jax
@@ -45,7 +47,8 @@ def main():
 
     remat = args.policy != "none"
     cfg = bench_350m(remat=remat,
-                     remat_policy=args.policy if remat else "dots")
+                     remat_policy=args.policy if remat else "dots",
+                     fused_ce=args.fused_ce)
     dev = jax.devices()[0]
     mesh = make_mesh(MeshSpec(), devices=[dev])
     ts = transformer_train_step(cfg, mesh, rules=RULES_DP,
@@ -69,6 +72,7 @@ def main():
     mfu = tok_s * cfg.flops_per_token(args.seq) / peak_flops_per_chip()
     print(json.dumps({
         "batch": args.batch, "seq": args.seq, "policy": args.policy,
+        "fused_ce": args.fused_ce,
         "block": args.block or None, "shift": not args.no_shift,
         "tok_s": round(tok_s, 1), "mfu": round(mfu, 4),
         "step_ms": round(dt / args.steps * 1e3, 2), "loss": round(final, 4),

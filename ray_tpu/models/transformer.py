@@ -73,6 +73,10 @@ class TransformerConfig:
     moe_experts_per_token: int = 2
     moe_capacity_factor: float = 1.25
     moe_aux_coef: float = 0.01
+    # Chunked fused lm-head+CE (ops/fused_ce.py): never materializes the
+    # [B*S, V] logits/dlogits tensors (~1GB each way at bench shapes) —
+    # vocab chunks stream through online logsumexp fwd / recompute bwd.
+    fused_ce: bool = False
 
     @property
     def kv_heads(self) -> int:
@@ -383,6 +387,15 @@ def forward_with_aux(
     params: Params, tokens: jax.Array, cfg: TransformerConfig
 ) -> Tuple[jax.Array, jax.Array]:
     """forward + summed MoE load-balancing aux loss (0 for dense stacks)."""
+    x, aux = backbone_with_aux(params, tokens, cfg)
+    return lm_head(params, x, cfg), aux
+
+
+def backbone_with_aux(
+    params: Params, tokens: jax.Array, cfg: TransformerConfig
+) -> Tuple[jax.Array, jax.Array]:
+    """Everything before the lm head: tokens -> hidden [B,S,d] + MoE aux
+    (the fused-CE loss path consumes the hidden states directly)."""
     B, S = tokens.shape
     x = embed_tokens(params, tokens, cfg)
     positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32)[None], (B, S))
@@ -406,7 +419,7 @@ def forward_with_aux(
         x, auxs = jax.lax.scan(
             layer_scan_body(cfg, positions), x, params["layers"])
         aux = auxs.sum()
-    return lm_head(params, x, cfg), aux
+    return x, aux
 
 
 def lm_head(params: Params, x: jax.Array, cfg: TransformerConfig) -> jax.Array:
@@ -444,9 +457,8 @@ def shift_targets_valid(tokens: jax.Array, mask: Optional[jax.Array] = None):
     return targets, valid
 
 
-def next_token_loss(logits: jax.Array, batch: Dict[str, jax.Array]) -> jax.Array:
-    """Next-token CE over logits [B,S,V]; loss over tokens[1:] (the final
-    position is masked out — in-place convention, see loss_fn)."""
+def inplace_targets_valid(batch: Dict[str, jax.Array]):
+    """targets/valid for the in-place convention (final position masked)."""
     tokens = batch["tokens"]
     B, S = tokens.shape
     targets = jnp.concatenate(
@@ -459,6 +471,13 @@ def next_token_loss(logits: jax.Array, batch: Dict[str, jax.Array]) -> jax.Array
         shifted = jnp.concatenate(
             [mask[:, 1:], jnp.zeros((B, 1), mask.dtype)], axis=1)
         valid = valid * shifted.astype(jnp.float32)
+    return targets, valid
+
+
+def next_token_loss(logits: jax.Array, batch: Dict[str, jax.Array]) -> jax.Array:
+    """Next-token CE over logits [B,S,V]; loss over tokens[1:] (the final
+    position is masked out — in-place convention, see loss_fn)."""
+    targets, valid = inplace_targets_valid(batch)
     return token_cross_entropy(logits, targets, valid)
 
 
@@ -482,7 +501,23 @@ def loss_fn(params: Params, batch: Dict[str, jax.Array], cfg: TransformerConfig,
       parallelism composes too.
     """
     tokens = batch["tokens"]
-    if shift_inputs:
+    if cfg.fused_ce:
+        from ..ops.fused_ce import fused_next_token_loss
+
+        tokens_in = tokens[:, :-1] if shift_inputs else tokens
+        x, aux = backbone_with_aux(params, tokens_in, cfg)
+        x = _norm(x, params["final_norm"], params.get("final_norm_b"),
+                  cfg.norm)
+        head = params.get("lm_head", None)
+        if head is None:
+            head = params["embed"].T
+        if shift_inputs:
+            targets, valid = shift_targets_valid(tokens, batch.get("mask"))
+        else:
+            targets, valid = inplace_targets_valid(batch)
+        loss = fused_next_token_loss(
+            x.astype(cfg.dtype), head.astype(cfg.dtype), targets, valid)
+    elif shift_inputs:
         logits, aux = forward_with_aux(params, tokens[:, :-1], cfg)
         targets, valid = shift_targets_valid(tokens, batch.get("mask"))
         loss = token_cross_entropy(logits, targets, valid)
