@@ -422,13 +422,23 @@ def backbone_with_aux(
     return x, aux
 
 
-def lm_head(params: Params, x: jax.Array, cfg: TransformerConfig) -> jax.Array:
-    """Final norm + (tied) output projection: hidden [B,S,d] -> logits f32."""
+def final_hidden_and_head(
+    params: Params, x: jax.Array, cfg: TransformerConfig
+) -> Tuple[jax.Array, jax.Array]:
+    """THE head-weight convention (final norm + tied-or-separate head),
+    shared by the unfused lm_head and the fused-CE loss path so the two
+    can never drift."""
     x = _norm(x, params["final_norm"], params.get("final_norm_b"), cfg.norm)
     head = params.get("lm_head", None)
     if head is None:
         head = params["embed"].T
-    return (x @ head.astype(cfg.dtype)).astype(jnp.float32)
+    return x, head.astype(cfg.dtype)
+
+
+def lm_head(params: Params, x: jax.Array, cfg: TransformerConfig) -> jax.Array:
+    """Final norm + (tied) output projection: hidden [B,S,d] -> logits f32."""
+    x, head = final_hidden_and_head(params, x, cfg)
+    return (x @ head).astype(jnp.float32)
 
 
 def token_cross_entropy(logits: jax.Array, targets: jax.Array,
@@ -506,17 +516,13 @@ def loss_fn(params: Params, batch: Dict[str, jax.Array], cfg: TransformerConfig,
 
         tokens_in = tokens[:, :-1] if shift_inputs else tokens
         x, aux = backbone_with_aux(params, tokens_in, cfg)
-        x = _norm(x, params["final_norm"], params.get("final_norm_b"),
-                  cfg.norm)
-        head = params.get("lm_head", None)
-        if head is None:
-            head = params["embed"].T
+        x, head = final_hidden_and_head(params, x, cfg)
         if shift_inputs:
             targets, valid = shift_targets_valid(tokens, batch.get("mask"))
         else:
             targets, valid = inplace_targets_valid(batch)
         loss = fused_next_token_loss(
-            x.astype(cfg.dtype), head.astype(cfg.dtype), targets, valid)
+            x.astype(cfg.dtype), head, targets, valid)
     elif shift_inputs:
         logits, aux = forward_with_aux(params, tokens[:, :-1], cfg)
         targets, valid = shift_targets_valid(tokens, batch.get("mask"))

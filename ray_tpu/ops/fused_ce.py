@@ -26,17 +26,19 @@ import jax.numpy as jnp
 
 
 def _pick_chunk(V: int, target: int = 4096) -> int:
-    """Largest divisor of V that is <= target, preferring multiples of 128
-    (MXU lane width). Falls back to V itself (single chunk) if V is prime
-    relative to everything reasonable."""
-    best = V
-    for c in range(target, 0, -1):
+    """Chunk width for a vocab of V: the largest 128-multiple divisor
+    <= target (MXU lane width) if one exists, else the largest divisor
+    <= target, else V itself (a single chunk — V with no usable divisor,
+    e.g. a prime vocab, must NOT degrade to a V-step scan of [M,1]
+    matmuls)."""
+    best_any = 0
+    for c in range(min(target, V), 1, -1):
         if V % c == 0:
             if c % 128 == 0:
-                return c
-            if best == V:
-                best = c
-    return best
+                return c  # descending: first 128-multiple is the largest
+            if best_any == 0:
+                best_any = c
+    return best_any or V
 
 
 @partial(jax.custom_vjp, nondiff_argnums=(4,))

@@ -110,6 +110,14 @@ def transformer_train_step(
     from ray_tpu.models import transformer as tfm
 
     if "pipe" in mesh.axis_names and mesh.shape["pipe"] > 1:
+        if getattr(cfg, "fused_ce", False):
+            # The pipelined loss computes logits inside the last stage
+            # (parallel/pipeline.py) and would silently skip the fused
+            # epilogue; fail loudly rather than drop the memory win the
+            # flag promises.
+            raise NotImplementedError(
+                "fused_ce is not supported under pipeline parallelism "
+                "yet — unset cfg.fused_ce for pipe>1 meshes")
         from ray_tpu.parallel.pipeline import pipeline_loss_fn
 
         M = pipeline_microbatches or 2 * mesh.shape["pipe"]

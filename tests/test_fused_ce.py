@@ -49,10 +49,11 @@ def test_bf16_inputs_accumulate_f32():
 
 
 def test_pick_chunk():
-    assert _pick_chunk(32000) == 3200          # 25*128, divides V
+    assert _pick_chunk(32000) == 3200   # largest 128-multiple divisor
     assert _pick_chunk(4096) == 4096
-    assert 0 < _pick_chunk(977) <= 977         # prime vocab still works
-    assert 977 % _pick_chunk(977) == 0
+    assert _pick_chunk(977) == 977      # prime: ONE chunk, never [M,1] scans
+    assert _pick_chunk(32003) == 32003  # prime-ish vocab, same
+    assert _pick_chunk(4000) == 4000    # largest divisor when no 128-mult
 
 
 def test_model_loss_path_matches_unfused():
