@@ -1,5 +1,7 @@
 """ray_tpu.train — the Train-equivalent layer (SURVEY.md §2.4, §7 step 5)."""
 from .backend import Backend, HostCollectiveBackend, JaxBackend
+from .callbacks import (CallbackList, JsonLineLogger, ProgressPrinter,
+                        TrainCallback, TransformersCallbackAdapter)
 from .backend_executor import BackendExecutor, TrainingFailedError, TrainingIterator
 from .checkpoint import Checkpoint
 from .config import CheckpointConfig, FailureConfig, RunConfig, ScalingConfig

@@ -75,6 +75,19 @@ class DataParallelTrainer:
             experiment_name=name,
         )
         ckpt_manager = CheckpointManager(storage, self.run_config.checkpoint_config)
+        from .callbacks import CallbackList
+
+        cbs = CallbackList(self.run_config.callbacks)
+        tune_hook = getattr(self, "_tune_report_hook", None)
+        report_count = [0]
+
+        def on_report(item: Dict[str, Any]) -> None:
+            report_count[0] += 1
+            cbs.on_report(report_count[0], dict(item.get("metrics") or {}),
+                          item.get("checkpoint"))
+            if tune_hook is not None:
+                tune_hook(item)
+
         iterator = TrainingIterator(
             scaling_config=self.scaling_config,
             backend=self.backend,
@@ -85,14 +98,16 @@ class DataParallelTrainer:
             max_failures=self.run_config.failure_config.max_failures,
             resume_checkpoint=self.resume_from_checkpoint,
             dataset_shard_fn=self._dataset_shard_fn(),
-            on_report=getattr(self, "_tune_report_hook", None),
+            on_report=on_report,
         )
         error: Optional[BaseException] = None
         metrics: Dict[str, Any] = {}
+        cbs.on_start(self.train_loop_config)
         try:
             metrics = iterator.run()
         except TrainingFailedError as e:
             error = e
+        cbs.on_end(metrics, error)
         best = ckpt_manager.best
         result = Result(
             metrics=metrics,

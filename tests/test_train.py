@@ -196,3 +196,70 @@ def test_session_profile_capture(ray_start_regular, tmp_path):
 
     assert glob.glob(os.path.join(logdir, "**", "*"), recursive=True), \
         "no xprof trace files written"
+
+
+def test_train_callbacks_and_hf_adapter(ray_start_regular, tmp_path):
+    """RunConfig(callbacks=...) observes every rank-0 report: the JSONL
+    logger captures them and a transformers.TrainerCallback receives
+    on_log through the adapter (reference: AIR framework callbacks)."""
+    from ray_tpu.train import DataParallelTrainer, RunConfig, ScalingConfig
+    from ray_tpu.train.callbacks import (JsonLineLogger,
+                                         TransformersCallbackAdapter,
+                                         TrainCallback)
+
+    logged = []
+
+    class Probe(TrainCallback):
+        def __init__(self):
+            self.started = False
+            self.ended = False
+
+        def on_start(self, config):
+            self.started = True
+
+        def on_report(self, iteration, metrics, checkpoint=None):
+            logged.append((iteration, metrics.get("loss")))
+
+        def on_end(self, metrics, error):
+            self.ended = True
+            assert error is None
+
+    class HFProbe:  # transformers.TrainerCallback duck type
+        def __init__(self):
+            self.logs = []
+
+        def on_log(self, args, state, control, logs=None, **kw):
+            self.logs.append((state.global_step, dict(logs or {})))
+
+        def on_train_end(self, args, state, control, **kw):
+            self.train_ended = True
+
+    def loop(config):
+        from ray_tpu import train as tr
+
+        for i in range(3):
+            tr.report({"loss": 1.0 / (i + 1)})
+
+    probe = Probe()
+    hf = HFProbe()
+    jl = tmp_path / "log.jsonl"
+    trainer = DataParallelTrainer(
+        loop,
+        train_loop_config={},
+        scaling_config=ScalingConfig(num_workers=2),
+        run_config=RunConfig(
+            name="cbtest", storage_path=str(tmp_path),
+            callbacks=[Probe() if False else probe,
+                       JsonLineLogger(str(jl)),
+                       TransformersCallbackAdapter(hf)]),
+    )
+    trainer.fit()
+    assert probe.started and probe.ended
+    assert [i for i, _ in logged] == [1, 2, 3]
+    assert abs(logged[-1][1] - 1 / 3) < 1e-6
+    import json as _json
+
+    lines = [_json.loads(l) for l in jl.read_text().splitlines()]
+    assert len(lines) == 3 and lines[0]["loss"] == 1.0
+    assert hf.logs and hf.logs[-1][0] == 3
+    assert getattr(hf, "train_ended", False)
