@@ -103,6 +103,7 @@ class WorkerInfo:
     # Runtime-env identity: a worker only runs tasks with the same env hash
     # (reference: worker_pool.h runtime_env_hash pool keying).
     env_hash: str = ""
+    pid: int = 0  # worker OS pid (joins agent heartbeat proc_stats)
     # TPU-capable workers carry the accelerator runtime (axon/PJRT plugin)
     # and cost seconds to start; plain workers skip it and start in ~0.3s.
     tpu_capable: bool = False
@@ -661,10 +662,12 @@ class Controller:
         if w is not None:
             w.conn = conn  # reconnect
             w.direct_port = int(msg.get("direct_port") or 0)
+            w.pid = int(msg.get("pid") or 0)
         else:
             w = WorkerInfo(worker_id=worker_id, node_id=node_id, conn=conn,
                            tpu_capable=bool(msg.get("tpu_capable")),
                            env_hash=msg.get("env_hash") or "",
+                           pid=int(msg.get("pid") or 0),
                            direct_port=int(msg.get("direct_port") or 0))
             self.workers[worker_id] = w
         # Exact proc adoption via startup token (reference: worker startup
@@ -1749,6 +1752,8 @@ class Controller:
                     "state": w.state,
                     "current_task": w.current_task,
                     "tpu_capable": w.tpu_capable,
+                    # Joins the agent heartbeat proc_stats (cpu/rss by pid).
+                    "pid": w.pid,
                 }
                 for w in list(self.workers.values())[:limit]
             ]

@@ -208,6 +208,7 @@ class WorkerRuntime:
                 "tpu_capable": flags.get("RTPU_TPU_WORKER"),
                 "env_hash": env_hash,
                 "direct_port": self.direct_port,
+                "pid": os.getpid(),
             }
         )
 
