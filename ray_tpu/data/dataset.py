@@ -225,6 +225,38 @@ class Dataset:
             sharding, prefetch,
         )
 
+    def iter_torch_batches(self, *, batch_size: int = 256,
+                           dtypes=None, device: str = "cpu",
+                           drop_last: bool = False) -> "Iterator[Any]":
+        """Numpy batches -> dicts of torch tensors (reference
+        dataset.iter_torch_batches; torch-cpu is the supported target on a
+        TPU host — device batches for the chip go through
+        iter_device_batches/jax instead)."""
+        import numpy as _np
+        import torch
+
+        def gen():
+            for batch in self.iter_batches(batch_size=batch_size,
+                                           batch_format="numpy",
+                                           drop_last=drop_last):
+                out = {}
+                for k, v in batch.items():
+                    if v.dtype == _np.object_:
+                        out[k] = list(v)  # ragged/object columns pass through
+                        continue
+                    t = torch.from_numpy(_np.ascontiguousarray(v))
+                    if dtypes is not None:
+                        want = dtypes.get(k) if isinstance(dtypes, dict) \
+                            else dtypes
+                        if want is not None:
+                            t = t.to(want)
+                    if device != "cpu":
+                        t = t.to(device)
+                    out[k] = t
+                yield out
+
+        return gen()
+
     def to_pandas(self):
         import pandas as pd
 
@@ -274,6 +306,11 @@ class Dataset:
 
     def write_json(self, path: str, **kwargs) -> None:
         self._write(path, "json", **kwargs)
+
+    def write_tfrecords(self, path: str, **kwargs) -> None:
+        """tf.train.Example shards (dependency-free writer,
+        data/tfrecord_lite.py; reference dataset.write_tfrecords)."""
+        self._write(path, "tfrecord", **kwargs)
 
     def _write(self, path: str, fmt: str, **kwargs) -> None:
         @rt.remote

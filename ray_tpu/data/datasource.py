@@ -280,6 +280,11 @@ def write_block(block: Block, path: str, file_format: str, index: int, **kwargs)
         pacsv.write_csv(table, fp, **kwargs)
     elif file_format == "json":
         BlockAccessor(block).to_pandas().to_json(fp, orient="records", lines=True)
+    elif file_format == "tfrecord":
+        from .tfrecord_lite import write_tfrecord_examples
+
+        cols = BlockAccessor(block).to_batch("numpy")
+        write_tfrecord_examples(fp, {k: list(v) for k, v in cols.items()})
     else:
         raise ValueError(f"unknown format {file_format}")
     return fp

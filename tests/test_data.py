@@ -378,3 +378,30 @@ def test_read_tfrecords_roundtrip(ray_start_regular, tmp_path):
     assert len(rows) == 2
     assert rows[0]["name"] == b"ada" and rows[1]["age"] == 85
     assert [round(x, 1) for x in rows[1]["scores"]] == [3.5, 4.5]
+
+
+def test_iter_torch_batches(ray_start_regular):
+    import numpy as np
+    import torch
+
+    import ray_tpu.data as rdata
+
+    ds = rdata.range(10).map(lambda r: {"id": r["id"],
+                                        "x": float(r["id"]) * 0.5})
+    batches = list(ds.iter_torch_batches(batch_size=4,
+                                         dtypes={"x": torch.float32}))
+    assert all(isinstance(b["x"], torch.Tensor) for b in batches)
+    assert batches[0]["x"].dtype == torch.float32
+    total = torch.cat([b["id"] for b in batches]).tolist()
+    assert sorted(total) == list(range(10))
+
+
+def test_write_read_tfrecords_roundtrip(ray_start_regular, tmp_path):
+    import ray_tpu.data as rdata
+
+    out = tmp_path / "shards"
+    rdata.from_items([{"a": i, "b": float(i) / 2} for i in range(8)]) \
+        .write_tfrecords(str(out))
+    rows = rdata.read_tfrecords(str(out)).take_all()
+    assert len(rows) == 8
+    assert sorted(int(r["a"]) for r in rows) == list(range(8))
