@@ -30,6 +30,7 @@ from .core.api import (
     get_runtime_context,
     init,
     is_initialized,
+    cancel,
     kill,
     nodes,
     put,
@@ -44,6 +45,7 @@ from .core.controller import (
     OutOfMemoryError,
     GetTimeoutError,
     RayTpuError,
+    TaskCancelledError,
     TaskError,
     WorkerCrashedError,
 )
@@ -61,6 +63,7 @@ __all__ = [
     "put",
     "wait",
     "free",
+    "cancel",
     "kill",
     "get_actor",
     "get_runtime_context",
@@ -77,6 +80,7 @@ __all__ = [
     "ActorClass",
     "RemoteFunction",
     "RayTpuError",
+    "TaskCancelledError",
     "TaskError",
     "GetTimeoutError",
     "WorkerCrashedError",

@@ -752,6 +752,9 @@ def _resolve_route(wc, route: "_ActorRoute", actor_id: str) -> bool:
 # In-flight direct calls by return id: get() awaits these instead of asking
 # the controller for locations the reply will carry any moment.
 _inflight_direct: Dict[str, Any] = {}
+# return oid -> (task_id, route conn): lets ray_tpu.cancel reach tasks the
+# controller never saw (direct lease pushes).
+_direct_task_meta: Dict[str, Any] = {}
 
 
 def _direct_submit(wc, route: "_ActorRoute", spec: Dict[str, Any]) -> bool:
@@ -770,6 +773,7 @@ def _direct_submit(wc, route: "_ActorRoute", spec: Dict[str, Any]) -> bool:
     def done(f, wc=wc, route=route, spec=spec):
         for oid in spec.get("return_ids", ()):
             _inflight_direct.pop(oid, None)
+            _direct_task_meta.pop(oid, None)
         exc = f.exception()
         if exc is None:
             res = f.result() or {}
@@ -833,6 +837,7 @@ def _reset_direct_state(wc=None) -> None:
     _task_pools.clear()
     _local_locs.clear()
     _inflight_direct.clear()
+    _direct_task_meta.clear()
 
 
 # ---- task leases (direct stateless-task dispatch) --------------------------
@@ -1047,6 +1052,7 @@ def _try_direct_task(wc, spec: Dict[str, Any], opts: Dict[str, Any]) -> bool:
         return False
     for oid in spec.get("return_ids", ()):
         _inflight_direct[oid] = fut
+        _direct_task_meta[oid] = (spec["task_id"], route.conn)
 
     def done(f, wc=wc, pool=pool, route=route, spec=spec):
         with pool.lock:
@@ -1054,6 +1060,7 @@ def _try_direct_task(wc, spec: Dict[str, Any], opts: Dict[str, Any]) -> bool:
             route.last_used = time.monotonic()
         for oid in spec.get("return_ids", ()):
             _inflight_direct.pop(oid, None)
+            _direct_task_meta.pop(oid, None)
         exc = f.exception()
         if exc is None:
             res = f.result() or {}
@@ -1342,6 +1349,27 @@ def remote(*args, **kwargs):
     if args:
         raise TypeError("use @remote or @remote(**options)")
     return wrap
+
+
+def cancel(ref: ObjectRef, *, force: bool = False) -> None:
+    """Cancel the task producing ``ref`` (reference: ray.cancel). Queued
+    tasks fail immediately with TaskCancelledError; running tasks get the
+    exception raised in their executing thread (force=True kills the
+    hosting worker instead, for code that swallows exceptions)."""
+    wc = ctx.get_worker_context()
+    meta = _direct_task_meta.get(ref.object_id)
+    if meta is not None and not force:
+        # Directly-pushed task: the controller never saw the spec — the
+        # cancel rides the same lease connection the push did.
+        task_id, conn = meta
+        try:
+            wc.client.io.call_nowait(conn.send(
+                {"kind": "cancel_task", "task_id": task_id}))
+            return
+        except Exception:
+            pass  # route died: the crash path fails the task anyway
+    wc.client.request({"kind": "cancel_task", "object_id": ref.object_id,
+                       "force": force})
 
 
 def kill(actor: ActorHandle, *, no_restart: bool = True) -> None:

@@ -1114,6 +1114,45 @@ class Controller:
             gen.wake.set()
             gen.drain.set()
 
+    async def _h_cancel_task(self, conn, msg):
+        """ray.cancel (reference: python/ray/_private/worker.py cancel +
+        CancelTask RPC): a QUEUED task is failed in place with
+        TaskCancelledError; a RUNNING one gets an async-raise in its
+        executing thread (force=True kills the worker process instead —
+        for code that swallows exceptions)."""
+        oid = msg["object_id"]
+        force = bool(msg.get("force"))
+        spec = None
+        for t in self.tasks.values():
+            if oid in (t.get("return_ids") or ()):
+                spec = t
+                break
+        if spec is None:
+            return {"ok": False, "reason": "unknown or already finished"}
+        task_id = spec["task_id"]
+        w = next((x for x in self.workers.values()
+                  if x.current_task == task_id), None)
+        if w is None:
+            # Still queued: remove + fail the returns.
+            self.pending_queue.remove(task_id)
+            self._release_task_resources(spec)
+            self._fail_task(spec, TaskCancelledError(
+                f"task {task_id[:8]} was cancelled before it started"))
+            self._record_task_event(spec, "cancelled")
+            return {"ok": True, "state": "queued"}
+        if force:
+            spec["max_retries"] = 0  # a force-cancel must not resurrect it
+            spec["__cancelled__"] = True
+            await self._shutdown_worker(w)
+            return {"ok": True, "state": "force_killed"}
+        try:
+            await w.conn.send({"kind": "cancel_task", "task_id": task_id})
+        except Exception:
+            pass
+        self._record_task_event(spec, "cancel_requested",
+                                worker_id=w.worker_id)
+        return {"ok": True, "state": "running"}
+
     async def _h_task_spillback(self, conn, msg):
         """A worker's admission check rejected a dispatched task
         (reference: raylet spillback — the scheduler retries elsewhere
@@ -2848,6 +2887,11 @@ class OutOfMemoryError(RayTpuError):
     """A worker was killed by the memory monitor to relieve host memory
     pressure (reference: ray.exceptions.OutOfMemoryError +
     src/ray/common/memory_monitor.h)."""
+
+
+class TaskCancelledError(RayTpuError):
+    """The task was cancelled via ray_tpu.cancel (reference:
+    ray.exceptions.TaskCancelledError)."""
 
 
 class ObjectLostError(RayTpuError):

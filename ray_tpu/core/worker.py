@@ -174,6 +174,8 @@ class WorkerRuntime:
         self.pool = ThreadPoolExecutor(max_workers=32, thread_name_prefix="task")
         self.functions: Dict[str, Any] = {}
         self.actors: Dict[str, ActorMailbox] = {}
+        self.running_threads: Dict[str, int] = {}  # task_id -> thread ident
+        self.cancelled_tasks: set = set()  # ray.cancel'd before/while running
         self.shutdown_event = threading.Event()
         # Direct-dispatch server: peers push actor tasks here without a
         # controller hop (reference: direct task transport,
@@ -332,6 +334,9 @@ class WorkerRuntime:
             from . import ownership
 
             return ownership.handle_ref_message(msg)
+        if msg["kind"] == "cancel_task":
+            self._cancel_task(msg["task_id"])
+            return None
         spec = msg["spec"]
         if spec.get("streaming"):
             # Generator state lives in the controller; a direct streaming
@@ -386,6 +391,22 @@ class WorkerRuntime:
 
     # ----------------------------------------------------------- push handler
 
+    def _cancel_task(self, task_id: str) -> None:
+        """Non-force ray.cancel (reference: TaskCancelledError raised in
+        the executing thread via the CPython async-exception hook). A task
+        still QUEUED here (lease executor / actor mailbox) is marked and
+        refused at run_task start; a RUNNING one sees the exception at its
+        next bytecode boundary."""
+        self.cancelled_tasks.add(task_id)
+        ident = self.running_threads.get(task_id)
+        if ident is not None:
+            import ctypes as _ct
+
+            from .controller import TaskCancelledError
+
+            _ct.pythonapi.PyThreadState_SetAsyncExc(
+                _ct.c_ulong(ident), _ct.py_object(TaskCancelledError))
+
     def _admit(self, spec: Dict[str, Any]) -> bool:
         """Local admission (reference raylet spillback): a host at the edge
         of memory exhaustion rejects the dispatch back to the scheduler
@@ -421,6 +442,8 @@ class WorkerRuntime:
             mb = self.actors.get(spec["actor_id"])
             if mb is not None:
                 mb.submit(spec)
+        elif kind == "cancel_task":
+            self._cancel_task(msg["task_id"])
         elif kind == "shutdown":
             self.shutdown_event.set()
         elif kind == "stack_dump":
@@ -516,6 +539,15 @@ class WorkerRuntime:
         tls = ctx.task_local
         tls.task_id = task_id
         tls.label = spec.get("label", "")
+        if task_id in self.cancelled_tasks:
+            from .controller import TaskCancelledError
+
+            self.cancelled_tasks.discard(task_id)
+            self._complete_error(spec, TaskCancelledError(
+                f"task {task_id[:8]} was cancelled before it started"), "")
+            tls.task_id = None
+            return
+        self.running_threads[task_id] = threading.get_ident()
         from . import ownership
 
         # Borrow every dep (ordered before the hold_release on the same
@@ -608,6 +640,7 @@ class WorkerRuntime:
                 import sys as _sys
 
                 span.__exit__(*_sys.exc_info())
+            self.running_threads.pop(task_id, None)
             tls.task_id = None
 
     def _complete_ok(self, spec: Dict[str, Any], result: Any) -> None:

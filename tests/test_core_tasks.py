@@ -1,4 +1,5 @@
 """Core task/object tests (reference test strategy: python/ray/tests/test_basic*.py)."""
+import os
 import time
 
 import numpy as np
@@ -114,3 +115,76 @@ def test_remote_function_not_callable(ray_start_regular):
 def test_cluster_resources(ray_start_regular):
     res = ray_tpu.cluster_resources()
     assert res.get("CPU", 0) >= 4
+
+
+def test_cancel_queued_task(ray_start_regular):
+    """ray_tpu.cancel on a QUEUED task fails it with TaskCancelledError
+    without it ever running (reference: ray.cancel semantics)."""
+    import time
+
+    marker = []
+
+    @ray_tpu.remote
+    def hold(sec):
+        time.sleep(sec)
+        return 1
+
+    @ray_tpu.remote
+    def never(path):
+        import pathlib
+
+        pathlib.Path(path).touch()
+        return 2
+
+    import tempfile
+    import uuid as _uuid
+
+    sentinel = os.path.join(tempfile.gettempdir(),
+                            f"cancel_{_uuid.uuid4().hex}")
+    # Force the CONTROLLER queue (the path under test): earlier module
+    # tests can leave long sleepers on leased workers, and a victim queued
+    # behind one would time the test out for reasons unrelated to cancel.
+    os.environ["RTPU_TASK_LEASE_MAX"] = "0"
+    try:
+        # Saturate the 4 CPUs so `never` stays queued at the controller.
+        holders = [hold.remote(30) for _ in range(4)]
+        time.sleep(0.5)
+        victim = never.remote(sentinel)
+        ray_tpu.cancel(victim)
+        with pytest.raises(Exception) as ei:
+            out = ray_tpu.get(victim, timeout=10)
+            raise AssertionError(f"task ran: {out}")
+        assert "timeout" not in type(ei.value).__name__.lower(), ei.value
+        for h in holders:
+            ray_tpu.cancel(h)
+    finally:
+        os.environ.pop("RTPU_TASK_LEASE_MAX", None)
+    assert "cancel" in str(ei.value).lower() or \
+        type(ei.value).__name__ == "TaskCancelledError"
+    # The cancelled holders surface TaskCancelledError too (running-task
+    # cancel is exercised in depth by the next test).
+    for h in holders:
+        with pytest.raises(Exception):
+            ray_tpu.get(h, timeout=30)
+    assert not os.path.exists(sentinel), "cancelled task still ran"
+    assert marker == []
+
+
+def test_cancel_running_task(ray_start_regular):
+    """Non-force cancel interrupts the executing thread."""
+    import time
+
+    @ray_tpu.remote
+    def spin():
+        t0 = time.time()
+        while time.time() - t0 < 30:
+            time.sleep(0.01)
+        return "finished"
+
+    ref = spin.remote()
+    time.sleep(1.0)  # make sure it's running
+    ray_tpu.cancel(ref)
+    t0 = time.time()
+    with pytest.raises(Exception):
+        ray_tpu.get(ref, timeout=25)
+    assert time.time() - t0 < 20, "cancel did not interrupt the spin"
