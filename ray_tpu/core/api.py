@@ -504,6 +504,10 @@ class RemoteFunction:
             "pg": pg,
             "label": getattr(self._fn, "__name__", "task"),
             "max_retries": int(opts.get("max_retries", 0)),
+            # True retries APPLICATION errors too (reference
+            # retry_exceptions; bool form — per-exception-class lists are
+            # not supported).
+            "retry_exceptions": bool(opts.get("retry_exceptions", False)),
         }
         _attach_runtime_env(wc, opts, spec)
         if streaming:
@@ -1006,6 +1010,9 @@ def _try_direct_task(wc, spec: Dict[str, Any], opts: Dict[str, Any]) -> bool:
     """Push a plain task to a leased worker; False -> controller path."""
     if (spec.get("pg") is not None
             or spec.get("scheduling", {}).get("type") != "DEFAULT"
+            or spec.get("retry_exceptions")  # app-error retry is a
+            # controller-queue feature: the direct path reports errors
+            # straight back to the caller
             or spec.get("streaming")
             or not flags.get("RTPU_TASK_LEASE_MAX")
             or not flags.get("RTPU_DIRECT_DISPATCH")):

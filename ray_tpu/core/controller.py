@@ -1202,6 +1202,31 @@ class Controller:
             if gen.closed:
                 self.generators.pop(task_id, None)
         spec = self.tasks.pop(task_id, None)
+        # retry_exceptions (reference: @ray.remote(retry_exceptions=True),
+        # task_manager.cc RetryTask on application error): a failed task
+        # with retry budget re-queues instead of surfacing the error —
+        # cancelled tasks excepted (a cancel must stick).
+        if (spec is not None and msg.get("is_error")
+                and spec.get("retry_exceptions")
+                and int(spec.get("max_retries", 0)) > 0
+                and not spec.get("__cancelled__")
+                and not gen):
+            spec["max_retries"] = int(spec["max_retries"]) - 1
+            if w := self.workers.get(msg["worker_id"]):
+                if w.current_task == task_id:
+                    w.current_task = None
+                    if w.state == "task":
+                        w.state = "idle"
+            self._release_task_resources(spec)
+            spec.pop("sched_node", None)
+            spec.pop("blocked", None)
+            spec["state"] = "waiting_deps"
+            self.tasks[task_id] = spec
+            self._record_task_event(spec, "retry",
+                                    worker_id=msg.get("worker_id"))
+            await self._resolve_deps_then_queue(spec)
+            self._wake_scheduler()
+            return {"ok": True}
         if spec is not None:
             self._record_task_event(
                 spec, "failed" if msg.get("is_error") else "finished",

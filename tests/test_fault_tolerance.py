@@ -109,3 +109,35 @@ def test_actor_without_restarts_stays_dead(ray_start_regular):
     time.sleep(0.5)
     with pytest.raises(Exception):
         ray_tpu.get(f.ping.remote(), timeout=30)
+
+
+def test_retry_exceptions_retries_application_errors(ray_start_regular):
+    """@remote(retry_exceptions=True, max_retries=N) re-queues a task
+    whose APPLICATION code raised (reference retry_exceptions); without
+    the flag the error surfaces on the first attempt."""
+    import os
+    import tempfile
+    import uuid as _uuid
+
+    marker = os.path.join(tempfile.gettempdir(),
+                          f"rexc_{_uuid.uuid4().hex}")
+
+    @ray_tpu.remote(max_retries=3, retry_exceptions=True)
+    def flaky_app(marker):
+        # Fails twice (app-level), succeeds on the third attempt.
+        n = 0
+        if os.path.exists(marker):
+            n = int(open(marker).read() or 0)
+        open(marker, "w").write(str(n + 1))
+        if n < 2:
+            raise ValueError(f"app failure #{n}")
+        return n
+
+    assert ray_tpu.get(flaky_app.remote(marker), timeout=60) == 2
+
+    @ray_tpu.remote(max_retries=3)  # no retry_exceptions: surfaces at once
+    def always_raises():
+        raise ValueError("boom")
+
+    with pytest.raises(Exception, match="boom"):
+        ray_tpu.get(always_raises.remote(), timeout=30)
