@@ -31,7 +31,9 @@ from .core.api import (
     init,
     is_initialized,
     cancel,
+    exit_actor,
     kill,
+    method,
     nodes,
     put,
     remote,
@@ -64,7 +66,9 @@ __all__ = [
     "wait",
     "free",
     "cancel",
+    "exit_actor",
     "kill",
+    "method",
     "get_actor",
     "get_runtime_context",
     "cluster_resources",

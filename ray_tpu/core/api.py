@@ -1181,6 +1181,25 @@ def _await_inflight(ids, timeout: Optional[float]) -> None:
             _cache_loc(loc)
 
 
+def exit_actor() -> None:
+    """Reference: ray.actor.exit_actor — terminate the hosting actor after
+    the current call (implemented in core.worker; re-exported here for the
+    package root)."""
+    from .worker import exit_actor as _exit_actor
+
+    _exit_actor()
+
+
+def method(*, num_returns: int = 1):
+    """Per-method defaults (reference: @ray.method(num_returns=N)) —
+    consumed when the actor class registers, carried on every handle."""
+    def deco(fn):
+        fn.__rtpu_method_opts__ = {"num_returns": num_returns}
+        return fn
+
+    return deco
+
+
 class ActorMethod:
     def __init__(self, handle: "ActorHandle", name: str, num_returns=1):
         self._handle = handle
@@ -1203,16 +1222,20 @@ class ActorMethod:
 class ActorHandle:
     """Client-side handle to an actor (reference: actor.py ActorHandle)."""
 
-    def __init__(self, actor_id: str, method_names: Sequence[str]):
+    def __init__(self, actor_id: str, method_names: Sequence[str],
+                 method_defaults: Optional[Dict[str, Dict[str, Any]]] = None):
         self._actor_id = actor_id
         self._method_names = list(method_names)
+        self._method_defaults = dict(method_defaults or {})
 
     def __getattr__(self, name: str) -> ActorMethod:
         if name.startswith("_"):
             raise AttributeError(name)
         if self._method_names and name not in self._method_names:
             raise AttributeError(f"actor has no method {name!r}")
-        return ActorMethod(self, name)
+        return ActorMethod(self, name,
+                           self._method_defaults.get(name, {}).get(
+                               "num_returns", 1))
 
     def _submit(self, method: str, args, kwargs, num_returns):
         wc = ctx.get_worker_context()
@@ -1262,7 +1285,8 @@ class ActorHandle:
         return refs
 
     def __reduce__(self):
-        return (ActorHandle, (self._actor_id, self._method_names))
+        return (ActorHandle, (self._actor_id, self._method_names,
+                              self._method_defaults))
 
     def __repr__(self) -> str:
         return f"ActorHandle({self._actor_id[:16]})"
@@ -1329,11 +1353,16 @@ class ActorClass:
         _register_dep_holds(spec, nested_refs)
         tracing.inject_submit_span(spec, spec["label"])
         wc.client.request({"kind": "create_actor", "spec": spec})
+        method_defaults = {
+            n: getattr(getattr(self._cls, n), "__rtpu_method_opts__")
+            for n in method_names
+            if hasattr(getattr(self._cls, n, None), "__rtpu_method_opts__")
+        }
         wc.client.request(
             {"kind": "kv_put", "ns": "__actor_methods__", "key": actor_id,
-             "value": cloudpickle.dumps(method_names)}
+             "value": cloudpickle.dumps((method_names, method_defaults))}
         )
-        return ActorHandle(actor_id, method_names)
+        return ActorHandle(actor_id, method_names, method_defaults)
 
     def bind(self, *args, **kwargs):
         """Lazy actor construction node (reference python/ray/dag/class_node.py)."""
@@ -1392,8 +1421,12 @@ def get_actor(name: str, namespace: Optional[str] = None) -> ActorHandle:
     methods_blob = wc.client.request(
         {"kind": "kv_get", "ns": "__actor_methods__", "key": info["actor_id"]}
     )
-    methods = cloudpickle.loads(methods_blob) if methods_blob else []
-    return ActorHandle(info["actor_id"], methods)
+    blob = cloudpickle.loads(methods_blob) if methods_blob else []
+    if isinstance(blob, tuple):
+        methods, defaults = blob
+    else:  # pre-@method registrations stored a bare name list
+        methods, defaults = blob, {}
+    return ActorHandle(info["actor_id"], methods, defaults)
 
 
 # --------------------------------------------------------------- cluster info

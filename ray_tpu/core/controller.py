@@ -1370,6 +1370,26 @@ class Controller:
                                      "ts": time.time()})
         return {"ok": True}
 
+    async def _h_actor_exit(self, conn, msg):
+        """Intentional actor termination via exit_actor: dead WITHOUT
+        restart regardless of max_restarts (reference semantics)."""
+        actor = self.actors.get(msg["actor_id"])
+        if actor is None:
+            return {"ok": False}
+        actor.max_restarts = 0  # an intentional exit must stick
+        self._mark_actor_dead(actor, ActorDiedError(
+            f"actor {actor.actor_id[:8]} exited via exit_actor()"))
+        w = self.workers.get(actor.worker_id or "")
+        if w is not None:
+            w.actor_ids.discard(actor.actor_id)
+            if not w.actor_ids:
+                w.state = "idle"
+        self._export_event("ACTOR", {"actor_id": actor.actor_id,
+                                     "event": "exited",
+                                     "ts": time.time()})
+        self._wake_scheduler()
+        return {"ok": True}
+
     async def _h_actor_error(self, conn, msg):
         actor = self.actors.get(msg["actor_id"])
         if actor is None:

@@ -34,6 +34,22 @@ from .object_store import (ObjectLocation, get_bytes, get_bytes_with_refresh,
 from .serialization import ArgRef, ObjectRef
 
 
+class ActorExitSignal(BaseException):
+    """Raised by ray_tpu.exit_actor inside an actor method: the call
+    completes with None and the actor shuts down intentionally (no
+    restart, queued calls fail with ActorDiedError)."""
+
+
+def exit_actor() -> None:
+    """Reference: ray.actor.exit_actor — terminate the hosting actor after
+    the current call. Only valid inside an actor method."""
+    from . import context as _ctx
+
+    if _ctx.current_actor_id() is None:
+        raise RuntimeError("exit_actor() called outside an actor method")
+    raise ActorExitSignal()
+
+
 class ActorMailbox:
     """Ordered (or bounded-concurrency) execution context for one actor.
 
@@ -633,6 +649,21 @@ class WorkerRuntime:
                 self._run_streaming(spec, result)
                 return
             self._complete_ok(spec, result)
+        except ActorExitSignal:
+            # Intentional exit: the triggering call succeeds (None), the
+            # controller retires the actor without restart, the mailbox
+            # drains (queued specs fail actor-died on redelivery).
+            self._complete_ok(spec, None)
+            aid = spec.get("actor_id")
+            if aid:
+                try:
+                    self.client.request({"kind": "actor_exit",
+                                         "actor_id": aid})
+                except Exception:
+                    pass
+                mb = self.actors.pop(aid, None)
+                if mb is not None:
+                    mb.stop()
         except BaseException as e:  # noqa: BLE001 — every task error is captured
             self._complete_error(spec, e, traceback.format_exc())
         finally:

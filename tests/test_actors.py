@@ -155,3 +155,54 @@ def test_mailbox_restores_cross_path_submission_order():
         _t.sleep(0.2)
     finally:
         mb.stop()
+
+
+def test_method_decorator_num_returns(ray_start_regular):
+    """@ray_tpu.method(num_returns=2) applies per-method defaults
+    (reference @ray.method) on direct handles AND named lookups."""
+    @ray_tpu.remote
+    class Splitter:
+        @ray_tpu.method(num_returns=2)
+        def pair(self, x):
+            return x, x + 1
+
+        def single(self, x):
+            return x
+
+    s = Splitter.options(name="splitter-m").remote()
+    a, b = s.pair.remote(5)
+    assert ray_tpu.get([a, b], timeout=30) == [5, 6]
+    assert ray_tpu.get(s.single.remote(7), timeout=30) == 7
+    g = ray_tpu.get_actor("splitter-m")
+    c, d = g.pair.remote(10)
+    assert ray_tpu.get([c, d], timeout=30) == [10, 11]
+
+
+def test_exit_actor(ray_start_regular):
+    """exit_actor terminates the actor intentionally: the triggering call
+    returns, later calls fail actor-died, and max_restarts does NOT
+    resurrect it (reference ray.actor.exit_actor)."""
+    import time as _t
+
+    @ray_tpu.remote(max_restarts=3)
+    class Quitter:
+        def ping(self):
+            return "ok"
+
+        def quit(self):
+            ray_tpu.exit_actor()
+            return "unreachable"
+
+    q = Quitter.remote()
+    assert ray_tpu.get(q.ping.remote(), timeout=30) == "ok"
+    assert ray_tpu.get(q.quit.remote(), timeout=30) is None
+    deadline = _t.monotonic() + 20
+    died = False
+    while _t.monotonic() < deadline:
+        try:
+            ray_tpu.get(q.ping.remote(), timeout=5)
+        except Exception:
+            died = True
+            break
+        _t.sleep(0.3)
+    assert died, "actor survived exit_actor (or was restarted)"
