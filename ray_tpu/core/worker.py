@@ -64,6 +64,7 @@ class ActorMailbox:
         self.actor_id = actor_id
         self.instance: Any = None
         self.q: "queue.Queue[Optional[Dict[str, Any]]]" = queue.Queue()
+        self.exited = False  # exit_actor ran: refuse everything queued
         # Per-caller sequence reordering state: caller -> {next, held}.
         self._seq: Dict[str, Dict[str, Any]] = {}
         self._seq_lock = threading.Lock()
@@ -167,6 +168,9 @@ class ActorMailbox:
     def _run_aio(self) -> None:
         import asyncio
 
+        # The loop thread belongs to exactly one actor: current_actor_id()
+        # (and therefore exit_actor) must work from coroutine methods too.
+        ctx.task_local.actor_id = self.actor_id
         asyncio.set_event_loop(self.aio_loop)
         self.aio_loop.run_forever()
 
@@ -177,6 +181,11 @@ class ActorMailbox:
                 return
             if "__create__" in spec:
                 spec["__create__"]()
+                continue
+            if self.exited:
+                # exit_actor already ran: a queued call must FAIL, not
+                # execute on (or double-complete against) a retired actor.
+                self.runtime._refuse_exited(spec)
                 continue
             self.runtime.run_task(spec, actor_instance=self.instance, mailbox=self)
 
@@ -407,6 +416,49 @@ class WorkerRuntime:
 
     # ----------------------------------------------------------- push handler
 
+    def _refuse_exited(self, spec: Dict[str, Any]) -> None:
+        """A call queued behind exit_actor: direct pushes get their reply
+        failed; controller-path specs are dropped (the controller already
+        stored ActorDiedError for them when it retired the actor —
+        completing them here would double-write the return objects)."""
+        if "__direct__" in spec:
+            self._complete_error(spec, ActorDiedError(
+                "actor exited via exit_actor() before this call ran"), "")
+
+    def _handle_actor_exit(self, spec: Dict[str, Any]) -> None:
+        """Intentional exit (exit_actor): the triggering call succeeds
+        with None (shaped to its num_returns), the controller retires the
+        actor WITHOUT restart, the mailbox refuses everything queued."""
+        aid = spec.get("actor_id")
+        mb = self.actors.get(aid) if aid else None
+        if mb is not None:
+            mb.exited = True  # BEFORE completing: no queued call may run
+        n = len(spec.get("return_ids") or ())
+        self._complete_ok(spec, None if n <= 1 else [None] * n)
+        if not aid:
+            return
+        ok = False
+        for _ in range(3):
+            try:
+                self.client.request({"kind": "actor_exit", "actor_id": aid})
+                ok = True
+                break
+            except Exception:
+                time.sleep(0.5)
+        if not ok:
+            # The control connection is almost certainly gone — fate-share
+            # (the watch task would kill us anyway); dying via the normal
+            # worker-death path at least fails the actor visibly instead
+            # of leaving the controller believing it is alive.
+            import sys as _sys
+
+            print("[worker] actor_exit unreachable; fate-sharing",
+                  file=_sys.stderr, flush=True)
+            self.shutdown_event.set()
+        self.actors.pop(aid, None)
+        if mb is not None:
+            mb.stop()
+
     def _cancel_task(self, task_id: str) -> None:
         """Non-force ray.cancel (reference: TaskCancelledError raised in
         the executing thread via the CPython async-exception hook). A task
@@ -555,6 +607,8 @@ class WorkerRuntime:
         tls = ctx.task_local
         tls.task_id = task_id
         tls.label = spec.get("label", "")
+        if spec.get("actor_id") and actor_instance is not None:
+            tls.actor_id = spec["actor_id"]
         if task_id in self.cancelled_tasks:
             from .controller import TaskCancelledError
 
@@ -614,6 +668,12 @@ class WorkerRuntime:
                         async with sem:
                             try:
                                 value = await result
+                            except ActorExitSignal:
+                                span.__exit__(None, None, None)
+                                await asyncio.get_running_loop().run_in_executor(
+                                    None,
+                                    lambda: self._handle_actor_exit(spec))
+                                return
                             except BaseException as e:  # noqa: BLE001
                                 tb = traceback.format_exc()
                                 span.__exit__(type(e), e, e.__traceback__)
@@ -650,20 +710,7 @@ class WorkerRuntime:
                 return
             self._complete_ok(spec, result)
         except ActorExitSignal:
-            # Intentional exit: the triggering call succeeds (None), the
-            # controller retires the actor without restart, the mailbox
-            # drains (queued specs fail actor-died on redelivery).
-            self._complete_ok(spec, None)
-            aid = spec.get("actor_id")
-            if aid:
-                try:
-                    self.client.request({"kind": "actor_exit",
-                                         "actor_id": aid})
-                except Exception:
-                    pass
-                mb = self.actors.pop(aid, None)
-                if mb is not None:
-                    mb.stop()
+            self._handle_actor_exit(spec)
         except BaseException as e:  # noqa: BLE001 — every task error is captured
             self._complete_error(spec, e, traceback.format_exc())
         finally:

@@ -206,3 +206,61 @@ def test_exit_actor(ray_start_regular):
             break
         _t.sleep(0.3)
     assert died, "actor survived exit_actor (or was restarted)"
+
+
+def test_exit_actor_async_and_queued_and_multireturn(ray_start_regular):
+    """exit_actor from an ASYNC method works; calls queued behind the
+    exit fail instead of running; a num_returns=2 exit call completes
+    with (None, None)."""
+    import time as _t
+
+    @ray_tpu.remote(max_restarts=2)
+    class AsyncQuitter:
+        async def quit(self):
+            ray_tpu.exit_actor()
+
+        async def ping(self):
+            return "ok"
+
+    a = AsyncQuitter.remote()
+    assert ray_tpu.get(a.ping.remote(), timeout=30) == "ok"
+    assert ray_tpu.get(a.quit.remote(), timeout=30) is None
+    deadline = _t.monotonic() + 20
+    died = False
+    while _t.monotonic() < deadline:
+        try:
+            ray_tpu.get(a.ping.remote(), timeout=5)
+            _t.sleep(0.3)
+        except Exception:
+            died = True
+            break
+    assert died, "async exit_actor did not retire the actor"
+
+    # SYNC mailbox: a call queued BEHIND the exiting call must fail, not
+    # run (async actors interleave, so this guarantee is sync-only).
+    @ray_tpu.remote
+    class SyncQuitter:
+        def quit(self):
+            _t.sleep(0.8)  # let the chaser join the queue
+            ray_tpu.exit_actor()
+
+        def ping(self):
+            return "ok"
+
+    s = SyncQuitter.remote()
+    assert ray_tpu.get(s.ping.remote(), timeout=30) == "ok"
+    q = s.quit.remote()
+    chased = s.ping.remote()  # queued behind the exit
+    assert ray_tpu.get(q, timeout=30) is None
+    with pytest.raises(Exception):
+        ray_tpu.get(chased, timeout=20)
+
+    @ray_tpu.remote
+    class PairQuitter:
+        @ray_tpu.method(num_returns=2)
+        def quit2(self):
+            ray_tpu.exit_actor()
+
+    p = PairQuitter.remote()
+    x, y = p.quit2.remote()
+    assert ray_tpu.get([x, y], timeout=30) == [None, None]
