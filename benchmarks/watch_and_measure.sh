@@ -1,0 +1,70 @@
+#!/bin/bash
+# Round-5 tunnel watcher: probes the axon compile tunnel and, whenever it is
+# up, drains a queue of on-chip measurements (each in its own process, each
+# resumable). The tunnel flapped all round (TUNNEL_HEALTH_r05.jsonl): it was
+# up for ~3 minutes at 01:03 UTC and down again by 01:20, so measurements
+# must start the moment a probe succeeds, ordered by importance.
+#
+# State: benchmarks/.watch_state/<name>.done marks a completed measurement.
+# Log:   benchmarks/watch_r05.log
+# Rows:  benchmarks/SWEEP_r05.jsonl (mfu rows); VIT_INFER/RL_PERF write their
+#        own JSON files.
+cd /root/repo
+mkdir -p benchmarks/.watch_state
+LOG=benchmarks/watch_r05.log
+STATE=benchmarks/.watch_state
+
+log() { echo "$(date -u +%H:%M:%S) $*" >> "$LOG"; }
+
+probe() {
+  timeout 90 python - <<'EOF' > /dev/null 2>&1
+import jax, jax.numpy as jnp
+jax.devices()
+x = jnp.ones((256, 256), jnp.bfloat16)
+jax.jit(lambda a: a @ a)(x).block_until_ready()
+EOF
+}
+
+# name | timeout | append-to-sweep(1/0) | command...
+run_one() {
+  local name="$1" tmo="$2" sweep="$3"; shift 3
+  [ -f "$STATE/$name.done" ] && return 0
+  log "start $name"
+  local out="$STATE/$name.out"
+  if timeout "$tmo" "$@" > "$out" 2> "$STATE/$name.err"; then
+    log "done $name: $(tail -1 "$out")"
+    if [ "$sweep" = 1 ]; then tail -1 "$out" >> benchmarks/SWEEP_r05.jsonl; fi
+    touch "$STATE/$name.done"
+    return 0
+  else
+    log "FAIL $name rc=$? tail: $(tail -c 200 "$out") $(tail -c 200 "$STATE/$name.err" | tr '\n' ' ')"
+    return 1
+  fi
+}
+
+all_done() {
+  for n in mfu_dots mfu_fused envelope vit rl; do
+    [ -f "$STATE/$n.done" ] || return 1
+  done
+  return 0
+}
+
+log "watcher started (pid $$)"
+while ! all_done; do
+  if probe; then
+    log "tunnel UP"
+    run_one mfu_dots 700 1 python benchmarks/mfu_one.py --batch 8 --seq 1024 --policy dots || { sleep 60; continue; }
+    probe || continue
+    run_one mfu_fused 700 1 python benchmarks/mfu_one.py --batch 8 --seq 1024 --policy dots --fused-ce || { sleep 60; continue; }
+    probe || continue
+    run_one envelope 600 1 python benchmarks/probe_model_envelope.py || { sleep 60; continue; }
+    probe || continue
+    run_one vit 700 0 python benchmarks/vit_infer.py || { sleep 60; continue; }
+    probe || continue
+    run_one rl 900 0 python benchmarks/rl_perf.py || { sleep 60; continue; }
+  else
+    log "tunnel down"
+  fi
+  sleep 120
+done
+log "all measurements complete"

@@ -225,6 +225,15 @@ class WorkerRuntime:
             norm = _json.loads(renv_json)
             renv.apply_in_worker(norm, self.client)
             env_hash = norm.get("hash", "")
+        # Tee stdout/stderr to the driver console via the controller
+        # (reference: _private/log_monitor.py tailing worker logs; here the
+        # worker pushes its own lines — no per-node tail daemon needed).
+        # Installed BEFORE registering: the controller may push a task the
+        # instant registration lands, and a print() from that first task
+        # must not race the tee install (it would go only to the log file,
+        # never to the driver).
+        if flags.get("RTPU_LOG_TO_DRIVER"):
+            self._install_log_forwarder()
         self.client.request(
             {
                 "kind": "register",
@@ -247,12 +256,6 @@ class WorkerRuntime:
             self.shutdown_event.set()
 
         self.client.io.call_nowait(_watch_conn())
-
-        # Tee stdout/stderr to the driver console via the controller
-        # (reference: _private/log_monitor.py tailing worker logs; here the
-        # worker pushes its own lines — no per-node tail daemon needed).
-        if flags.get("RTPU_LOG_TO_DRIVER"):
-            self._install_log_forwarder()
 
     def _install_log_forwarder(self) -> None:
         import sys

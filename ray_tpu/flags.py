@@ -174,6 +174,11 @@ _define("RTPU_JAX_PLATFORM", str, None,
 _define("RTPU_WORKFLOW_STORAGE", str, None,
         "Workflow durability root (default ~/.ray_tpu/workflows).")
 
+_define("RTPU_ATTN_IMPL", str, "auto",
+        "Attention implementation: auto (flash on TPU, else XLA) | flash | "
+        "xla. 'xla' keeps the whole program Pallas-free, for environments "
+        "where the Mosaic compile path is unavailable (remote-compile "
+        "tunnels that hang on tpu_custom_call).")
 _define("RTPU_SP_MODE", str, "ring",
         "Context-parallel attention scheme over the seq mesh axis: "
         "ring | ulysses | auto (ulysses when head counts divide the axis).")

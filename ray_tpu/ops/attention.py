@@ -121,17 +121,39 @@ def attention(
     use_flash: Optional[bool] = None,
 ) -> jax.Array:
     """Dispatching attention entry point used by all models."""
+    from ray_tpu import flags
     from ray_tpu.parallel.sharding import current_sharding_ctx
 
+    impl = flags.get("RTPU_ATTN_IMPL")
+    if impl not in ("auto", "flash", "xla"):
+        global _warned_bad_impl
+        if not _warned_bad_impl:
+            import warnings
+
+            warnings.warn(
+                f"RTPU_ATTN_IMPL={impl!r} is not one of auto|flash|xla; "
+                "treating as 'auto'", stacklevel=2)
+            _warned_bad_impl = True
+        impl = "auto"
     ctx = current_sharding_ctx()
-    if ctx is not None:
+    # impl=xla promises a Pallas-free program; the seq-parallel schemes
+    # (ring/ulysses) run Mosaic flash kernels per-shard, so they are
+    # bypassed too — dense reference attention under pjit computes the
+    # same global result (XLA shards it by the operand shardings), just
+    # without the comm/compute overlap.
+    if ctx is not None and impl != "xla":
         mesh, rules = ctx
         if "seq" in mesh.axis_names and mesh.shape["seq"] > 1:
             out = _seq_parallel_attention(q, k, v, mesh, rules, causal, scale)
             if out is not None:
                 return out
     if use_flash is None:
-        use_flash = _on_tpu()
+        if impl == "flash":
+            use_flash = True
+        elif impl == "xla":
+            use_flash = False
+        else:
+            use_flash = _on_tpu()
     if use_flash:
         try:
             from .flash_attention import flash_attention
@@ -153,3 +175,4 @@ def attention(
 
 
 _warned_no_flash = False
+_warned_bad_impl = False

@@ -140,14 +140,24 @@ def test_worker_prints_reach_driver(ray_start_regular, capfd):
         return 1
 
     assert ray_tpu.get(shout.remote()) == 1
+    # Let the forwarded line land BEFORE the first readouterr(): pytest's
+    # fd snap reads-then-truncates the capture file, so a write from the
+    # driver's IO thread that arrives between the read and the truncate is
+    # silently discarded. The line is written ~ms after get() returns —
+    # polling immediately synchronizes the write with the lossy snap and
+    # flaked ~50% under load. One generous sleep, then poll for slow hosts.
+    time.sleep(1.5)
+    seen = ""
     deadline = time.time() + 10
     while time.time() < deadline:
         out, err = capfd.readouterr()
-        if "hello-from-worker-xyz" in out:
-            assert "(worker pid=" in out
+        seen += out
+        if "hello-from-worker-xyz" in seen:
+            assert "(worker pid=" in seen
             return
-        time.sleep(0.2)
-    raise AssertionError("worker print never reached the driver console")
+        time.sleep(1.0)
+    raise AssertionError(
+        f"worker print never reached the driver console; saw={seen!r}")
 
 
 def test_profile_workers_stack_dump(ray_start_regular):
