@@ -55,9 +55,9 @@ while ! all_done; do
     log "tunnel UP"
     run_one mfu_dots 700 1 python benchmarks/mfu_one.py --batch 8 --seq 1024 --policy dots || { sleep 60; continue; }
     probe || continue
-    run_one mfu_fused 700 1 python benchmarks/mfu_one.py --batch 8 --seq 1024 --policy dots --fused-ce || { sleep 60; continue; }
+    run_one mfu_fused 1100 1 python benchmarks/mfu_one.py --batch 8 --seq 1024 --policy dots --fused-ce || { sleep 60; continue; }
     probe || continue
-    run_one envelope 600 1 python benchmarks/probe_model_envelope.py || { sleep 60; continue; }
+    run_one envelope 900 1 python benchmarks/probe_model_envelope.py || { sleep 60; continue; }
     probe || continue
     run_one vit 700 0 python benchmarks/vit_infer.py || { sleep 60; continue; }
     probe || continue

@@ -20,8 +20,10 @@ from .read_api import (
     read_json,
     read_numpy,
     read_parquet,
+    read_sql,
     read_text,
     read_tfrecords,
+    read_webdataset,
 )
 
 __all__ = [
@@ -46,6 +48,8 @@ __all__ = [
     "read_json",
     "read_numpy",
     "read_parquet",
+    "read_sql",
     "read_text",
     "read_tfrecords",
+    "read_webdataset",
 ]

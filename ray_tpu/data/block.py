@@ -160,11 +160,18 @@ def rows_to_block(rows: List[Dict[str, Any]]) -> Block:
     """Build a block from a list of row dicts (used by from_items/map)."""
     if not rows:
         return {}
-    keys = rows[0].keys()
+    # Union of keys over ALL rows, first-seen order: ragged row sets (e.g.
+    # WebDataset samples with differing members) must neither KeyError nor
+    # silently drop fields absent from row 0.
+    keys: Dict[str, None] = {}
+    for r in rows:
+        for k in r:
+            keys[k] = None
+    ragged = any(len(r) != len(keys) for r in rows)
     cols: Dict[str, Any] = {}
-    numpyable = True
+    numpyable = not ragged
     for k in keys:
-        vals = [r[k] for r in rows]
+        vals = [r.get(k) for r in rows]
         first = np.asarray(vals[0])
         if first.dtype == object:
             numpyable = False
@@ -177,6 +184,18 @@ def rows_to_block(rows: List[Dict[str, Any]]) -> Block:
                 cols[k] = vals
     if numpyable:
         return cols
-    if pa is None:
-        raise RuntimeError("pyarrow required for object-typed rows")
-    return pa.Table.from_pylist(rows)
+    if pa is not None:
+        try:
+            return pa.Table.from_pylist(rows)
+        except (pa.lib.ArrowInvalid, pa.lib.ArrowTypeError):
+            pass  # multi-dim ndarrays / mixed-type columns: no arrow layout
+    # Object-dtype numpy columns carry anything (per-row ndarrays, dicts);
+    # same representation ImageDatasource uses for ragged images.
+    out: Dict[str, Any] = {}
+    for k in keys:
+        vals = [r.get(k) for r in rows]
+        col = np.empty(len(vals), dtype=object)
+        for i, v in enumerate(vals):
+            col[i] = v
+        out[k] = col
+    return out

@@ -312,6 +312,37 @@ class Dataset:
         data/tfrecord_lite.py; reference dataset.write_tfrecords)."""
         self._write(path, "tfrecord", **kwargs)
 
+    def write_webdataset(self, path: str, **kwargs) -> None:
+        """WebDataset tar shards, one per block (reference
+        dataset.write_webdataset); rows keyed by "__key__" when present."""
+        self._write(path, "tar", **kwargs)
+
+    def write_sql(self, sql: str, connection_factory) -> None:
+        """INSERT every row via a DBAPI connection per block (reference
+        dataset.write_sql): `sql` is a parameterized statement, e.g.
+        ``INSERT INTO t VALUES(?, ?)``; the picklable zero-arg
+        `connection_factory` opens the connection inside each write task."""
+        @rt.remote
+        def w(block, stmt, factory):
+            from .block import BlockAccessor
+
+            def native(v):
+                # DBAPI drivers store numpy scalars as blobs; unwrap them.
+                return v.item() if hasattr(v, "item") else v
+
+            conn = factory()
+            try:
+                cur = conn.cursor()
+                cur.executemany(stmt, [tuple(native(v) for v in r.values())
+                                       for r in BlockAccessor(block).iter_rows()])
+                conn.commit()
+            finally:
+                conn.close()
+            return True
+
+        rt.get([w.remote(r, sql, connection_factory)
+                for r in self._execute()])
+
     def _write(self, path: str, fmt: str, **kwargs) -> None:
         @rt.remote
         def w(block, i):

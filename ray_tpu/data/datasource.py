@@ -261,6 +261,132 @@ class ImageDatasource(FileDatasource):
         return {"image": col, "path": np.array([path])}
 
 
+class SQLDatasource(Datasource):
+    """Rows from a DBAPI query (reference python/ray/data/read_api.py
+    read_sql: runs `sql` through a zero-arg `connection_factory`).
+
+    The factory — not a connection — is what ships to the read task, so it
+    must be picklable (e.g. ``functools.partial(sqlite3.connect, path)``).
+    One read task by default, like the reference; `shard_predicates`
+    extends it: each predicate string becomes one task reading
+    ``SELECT * FROM (sql) WHERE <predicate>`` — dialect-agnostic sharding
+    the caller controls (the reference's shard_keys/MOD sharding is
+    MySQL-specific)."""
+
+    def __init__(self, sql: str, connection_factory: Callable[[], Any],
+                 shard_predicates: Optional[List[str]] = None):
+        self.sql = sql
+        self.factory = connection_factory
+        self.shard_predicates = shard_predicates
+
+    def get_read_tasks(self, parallelism: int) -> List[ReadTask]:
+        queries = [self.sql]
+        if self.shard_predicates:
+            queries = [
+                f"SELECT * FROM ({self.sql}) WHERE {pred}"  # noqa: S608
+                for pred in self.shard_predicates
+            ]
+        factory = self.factory
+
+        def make(q: str) -> ReadTask:
+            def read() -> Block:
+                conn = factory()
+                try:
+                    cur = conn.cursor()
+                    cur.execute(q)
+                    names = [d[0] for d in cur.description]
+                    rows = cur.fetchall()
+                finally:
+                    conn.close()
+                cols: Dict[str, Any] = {}
+                for i, n in enumerate(names):
+                    vals = [r[i] for r in rows]
+                    if any(isinstance(v, bytes) for v in vals):
+                        # np.asarray's fixed-width "S" dtype strips
+                        # trailing NULs from blobs; object dtype is exact.
+                        col = np.empty(len(vals), dtype=object)
+                        for j, v in enumerate(vals):
+                            col[j] = v
+                        cols[n] = col
+                    else:
+                        cols[n] = np.asarray(vals)
+                return cols
+
+            return ReadTask(read)
+
+        return [make(q) for q in queries]
+
+
+class WebDatasetDatasource(FileDatasource):
+    """Tar shards in WebDataset layout: members sharing a basename-up-to-
+    the-first-dot form one sample; the remainder is the field name
+    (reference python/ray/data/datasource/webdataset_datasource.py).
+
+    Rows come out as {"__key__": key, "<ext>": value} with stdlib-only
+    decoding by extension: txt/text -> str, json -> parsed, cls/index ->
+    int, npy -> ndarray, everything else (incl. images) -> raw bytes.
+    ``decode_images=True`` additionally decodes jpg/png/... members to
+    [H, W, C] uint8 arrays via PIL."""
+
+    suffix = ".tar"
+    _IMG_EXTS = ("jpg", "jpeg", "png", "bmp", "webp", "ppm")
+
+    def __init__(self, paths, decode_images: bool = False, **kwargs):
+        super().__init__(paths, **kwargs)
+        self.decode_images = decode_images
+
+    def _decode(self, ext: str, data: bytes) -> Any:
+        e = ext.lower()
+        if e in ("txt", "text"):
+            return data.decode("utf-8", "replace")
+        if e == "json":
+            import json
+
+            return json.loads(data)
+        if e in ("cls", "index"):
+            return int(data.decode("ascii").strip())
+        if e == "npy":
+            import io
+
+            return np.load(io.BytesIO(data), allow_pickle=False)
+        if self.decode_images and e in self._IMG_EXTS:
+            import io
+
+            from PIL import Image
+
+            with Image.open(io.BytesIO(data)) as im:
+                return np.asarray(im.convert("RGB"))
+        return data
+
+    def read_file(self, path: str) -> Block:
+        import tarfile
+
+        samples: Dict[str, Dict[str, Any]] = {}
+        order: List[str] = []
+        with tarfile.open(path) as tf:
+            for m in tf.getmembers():
+                if not m.isfile():
+                    continue
+                base = os.path.basename(m.name)
+                if "." not in base:
+                    key, ext = m.name, "bin"
+                else:
+                    stem, ext = base.split(".", 1)
+                    key = os.path.join(os.path.dirname(m.name), stem)
+                data = tf.extractfile(m).read()
+                if key not in samples:
+                    samples[key] = {"__key__": key}
+                    order.append(key)
+                # Compound extensions carry a codec suffix the writer added
+                # ("meta.json", "x.npy"): decode by the last component and
+                # strip it from the field name so write->read round-trips.
+                field, last = ext, ext.rsplit(".", 1)[-1]
+                if "." in ext and last in ("json", "npy"):
+                    field = ext[: -(len(last) + 1)]
+                samples[key][field] = self._decode(last, data)
+        return rows_to_block([samples[k] for k in order])
+
+
 # ------------------------------------------------------------------- writers
 
 
@@ -269,15 +395,14 @@ def write_block(block: Block, path: str, file_format: str, index: int, **kwargs)
 
     os.makedirs(path, exist_ok=True)
     fp = os.path.join(path, f"part-{index:05d}.{file_format}")
-    table = BlockAccessor(block).to_arrow()
     if file_format == "parquet":
         import pyarrow.parquet as pq
 
-        pq.write_table(table, fp, **kwargs)
+        pq.write_table(BlockAccessor(block).to_arrow(), fp, **kwargs)
     elif file_format == "csv":
         import pyarrow.csv as pacsv
 
-        pacsv.write_csv(table, fp, **kwargs)
+        pacsv.write_csv(BlockAccessor(block).to_arrow(), fp, **kwargs)
     elif file_format == "json":
         BlockAccessor(block).to_pandas().to_json(fp, orient="records", lines=True)
     elif file_format == "tfrecord":
@@ -285,6 +410,50 @@ def write_block(block: Block, path: str, file_format: str, index: int, **kwargs)
 
         cols = BlockAccessor(block).to_batch("numpy")
         write_tfrecord_examples(fp, {k: list(v) for k, v in cols.items()})
+    elif file_format == "tar":  # WebDataset shard
+        _write_wds_shard(block, fp)
     else:
         raise ValueError(f"unknown format {file_format}")
     return fp
+
+
+def _write_wds_shard(block: Block, fp: str) -> None:
+    """One tar shard in WebDataset layout (reference dataset
+    write_webdataset): each row becomes members ``<key>.<field>``; bytes
+    pass through, str -> utf-8, int -> ascii (cls convention), dict/list ->
+    json, ndarray -> .npy bytes."""
+    import io
+    import json as jsonlib
+    import tarfile
+
+    from .block import BlockAccessor
+
+    def encode(field: str, v: Any) -> tuple:
+        if isinstance(v, np.generic):  # numpy scalars: json can't take them
+            v = v.item()
+        if isinstance(v, bytes):
+            return field, v
+        if isinstance(v, str):
+            return field, v.encode("utf-8")
+        if isinstance(v, (bool, int)):
+            return field, str(int(v)).encode("ascii")
+        if isinstance(v, np.ndarray):
+            buf = io.BytesIO()
+            np.save(buf, v, allow_pickle=False)
+            name = field if field == "npy" or field.endswith(".npy") \
+                else field + ".npy"
+            return name, buf.getvalue()
+        name = field if field == "json" or field.endswith(".json") \
+            else field + ".json"
+        return name, jsonlib.dumps(v).encode("utf-8")
+
+    with tarfile.open(fp, "w") as tf:
+        for i, row in enumerate(BlockAccessor(block).iter_rows()):
+            key = row.get("__key__") or f"{i:08d}"
+            for field, v in row.items():
+                if field == "__key__" or v is None:
+                    continue
+                name, data = encode(field, v)
+                info = tarfile.TarInfo(f"{key}.{name}")
+                info.size = len(data)
+                tf.addfile(info, io.BytesIO(data))

@@ -148,6 +148,28 @@ def read_binary_files(paths, *, parallelism: int = -1) -> Dataset:
     return _mk(BinaryDatasource(paths), parallelism)
 
 
+def read_sql(sql: str, connection_factory, *, shard_predicates=None,
+             parallelism: int = -1) -> Dataset:
+    """Rows from a DBAPI query (reference read_api.py read_sql). The
+    zero-arg `connection_factory` must be picklable — it runs inside the
+    read task. `shard_predicates=["id % 2 = 0", "id % 2 = 1"]` splits the
+    read into one task per predicate."""
+    from .datasource import SQLDatasource
+
+    return _mk(SQLDatasource(sql, connection_factory,
+                             shard_predicates=shard_predicates), parallelism)
+
+
+def read_webdataset(paths, *, decode_images: bool = False,
+                    parallelism: int = -1, **kwargs) -> Dataset:
+    """WebDataset tar shards -> {"__key__", "<field>": value} rows
+    (reference datasource/webdataset_datasource.py; stdlib tarfile)."""
+    from .datasource import WebDatasetDatasource
+
+    return _mk(WebDatasetDatasource(paths, decode_images=decode_images,
+                                    **kwargs), parallelism)
+
+
 def read_images(paths, *, size=None, mode: str = "RGB",
                 parallelism: int = -1) -> Dataset:
     """Decode image files into {"image": [H,W,C] uint8, "path"} rows

@@ -42,8 +42,16 @@ def test_scale_up_then_down(head_only_cluster):
                 break
             time.sleep(0.5)
         assert not provider.non_terminated_nodes(), "idle nodes not reaped"
-        nodes_alive = [n for n in ray_tpu.nodes()
-                       if n["alive"] and n["labels"].get("autoscaled")]
+        # The controller marks the terminated node dead on heartbeat
+        # timeout, which lags the provider's termination under load — poll.
+        deadline = time.monotonic() + 30
+        nodes_alive = True
+        while time.monotonic() < deadline:
+            nodes_alive = [n for n in ray_tpu.nodes()
+                           if n["alive"] and n["labels"].get("autoscaled")]
+            if not nodes_alive:
+                break
+            time.sleep(0.5)
         assert not nodes_alive
     finally:
         scaler.stop()
