@@ -53,12 +53,15 @@ def cmd_start(args) -> int:
         async def run_head():
             controller = Controller(port=args.port)
             host, port = await controller.start()
-            from ray_tpu.util.accelerators import detect_tpu_chips
+            from ray_tpu.util.accelerators import (
+                detect_node_accelerator_resources,
+            )
 
             res = {"CPU": float(args.num_cpus or os.cpu_count() or 1)}
-            tpus = detect_tpu_chips()
-            if tpus:
-                res["TPU"] = float(tpus)
+            # Same vendor-agnostic autodetection as api.init(): accelerator
+            # counts plus pod-scoped custom resources — a CLI-started head
+            # must schedule identically to an init()-started one.
+            res.update(detect_node_accelerator_resources())
             controller.add_node(res, labels={"head": "1"})
             addr = f"{host}:{port}"
             with open(_ADDRFILE, "w") as f:

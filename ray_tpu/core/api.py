@@ -66,17 +66,43 @@ def init(
             address = flags.get("RTPU_ADDRESS") or None
 
         if address is None:
-            from ray_tpu.util.accelerators import detect_tpu_chips
-
             io = EventLoopThread(name="rtpu-controller")
             controller = Controller()
             host, port = io.call(controller.start(), timeout=10)
             node_res: Dict[str, float] = {
                 "CPU": float(num_cpus if num_cpus is not None else os.cpu_count() or 1),
             }
-            tpus = num_tpus if num_tpus is not None else detect_tpu_chips()
-            if tpus:
-                node_res["TPU"] = float(tpus)
+            # Vendor-agnostic autodetection over the registered accelerator
+            # managers (util/accelerators.py plugin layer); on a TPU host
+            # this adds {"TPU": chips} plus the pod-scoped custom resources
+            # when GCE metadata env is present. An explicit num_tpus
+            # overrides the detected chip count but must NOT silence the
+            # pod resources — the pod-leader scheduling scheme has to work
+            # whether or not the user pinned the count.
+            from ray_tpu.util.accelerators import (
+                detect_node_accelerator_resources,
+            )
+
+            node_res.update(detect_node_accelerator_resources())
+            if num_tpus is not None:
+                node_res.pop("TPU", None)
+                if num_tpus:
+                    node_res["TPU"] = float(num_tpus)
+                    # Detection may have found 0 chips (container without
+                    # /dev/accel*) and thus skipped the TPU manager's
+                    # additional resources — an explicit chip count says
+                    # this IS a TPU host, so advertise them.
+                    from ray_tpu.util.accelerators import (
+                        TPUAcceleratorManager,
+                    )
+
+                    try:
+                        for k, v in \
+                                TPUAcceleratorManager.additional_resources() \
+                                .items():
+                            node_res.setdefault(k, v)
+                    except Exception:
+                        pass
             if resources:
                 node_res.update(resources)
             node_id = controller.add_node(node_res, labels={"head": "1"})
@@ -318,6 +344,29 @@ def free(refs: Sequence[ObjectRef]) -> None:
 # ------------------------------------------------------------------- tasks
 
 
+def _validate_accel_quantity(resource: str, quantity: Any) -> float:
+    """Validate an accelerator request against its registered manager
+    (reference: option validation via accelerator.validate_resource_request_
+    quantity in _private/ray_option_utils.py)."""
+    from ray_tpu.util.accelerators import manager_for_resource
+
+    mgr = manager_for_resource(resource)
+    if mgr is not None:
+        ok, err = mgr.validate_request(float(quantity))
+        if not ok:
+            raise ValueError(err)
+    return float(quantity)
+
+
+def _validate_accel_resources(resources: Dict[str, float]) -> Dict[str, float]:
+    """Validate every accelerator-managed entry of a resources dict — the
+    resources={"TPU": n} spelling must hit the same validation as
+    num_tpus=n."""
+    for name, q in resources.items():
+        _validate_accel_quantity(name, q)
+    return resources
+
+
 def _normalize_strategy(scheduling_strategy: Any) -> Tuple[Dict[str, Any], Optional[Tuple[str, int]]]:
     """Returns (strategy dict, pg tuple)."""
     from ray_tpu.util.scheduling_strategies import (
@@ -489,6 +538,7 @@ class RemoteFunction:
         resources["CPU"] = float(opts.get("num_cpus", 1 if "num_tpus" not in opts else 0))
         if opts.get("num_tpus"):
             resources["TPU"] = float(opts["num_tpus"])
+        _validate_accel_resources(resources)
         strategy, pg = _normalize_strategy(opts.get("scheduling_strategy"))
         args_blob, deps, nested_refs = pack_args(args, kwargs)
         n_rets = 0 if streaming else max(num_returns, 0)
@@ -1325,6 +1375,7 @@ class ActorClass:
         resources["CPU"] = float(opts.get("num_cpus", 0))
         if opts.get("num_tpus"):
             resources["TPU"] = float(opts["num_tpus"])
+        _validate_accel_resources(resources)
         strategy, pg = _normalize_strategy(opts.get("scheduling_strategy"))
         args_blob, deps, nested_refs = pack_args(args, kwargs)
         actor_id = ActorID.generate()
@@ -1466,6 +1517,19 @@ class RuntimeContext:
 
     def get_node_id(self) -> str:
         return self.node_id
+
+    def get_accelerator_ids(self) -> Dict[str, List[str]]:
+        """Accelerator ids assigned to this worker process, per resource
+        name (reference: worker.py:932 get_accelerator_ids_for_accelerator_
+        resource over CUDA_VISIBLE_DEVICES/TPU_VISIBLE_CHIPS). Workers
+        spawned for a TPU request see the chip ids the spawner granted;
+        an empty list means no assignment (unrestricted visibility)."""
+        from ray_tpu.util.accelerators import accelerator_managers
+
+        out: Dict[str, List[str]] = {}
+        for mgr in accelerator_managers():
+            out[mgr.resource_name] = mgr.get_visible_ids() or []
+        return out
 
 
 def get_runtime_context() -> RuntimeContext:

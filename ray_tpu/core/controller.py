@@ -85,6 +85,11 @@ class NodeInfo:
     # Host memory usage fraction (agent heartbeats / controller psutil for
     # local nodes); drives the memory monitor's kill decisions.
     mem_fraction: float = 0.0
+    # Unallocated TPU chip ids on locally-spawned (agent-less) nodes: the
+    # unit-instance side of the "TPU" float resource (reference: per-instance
+    # GPU accounting, resource_instance_set.h). Agent-managed nodes track
+    # this on the agent, which owns the worker processes.
+    tpu_free: List[int] = field(default_factory=list)
     # Per-worker-process cpu%/rss from the agent heartbeat (dashboard
     # reporter parity); pid -> {cpu_percent, rss}.
     proc_stats: Dict[str, Dict[str, float]] = field(default_factory=dict)
@@ -107,6 +112,9 @@ class WorkerInfo:
     # TPU-capable workers carry the accelerator runtime (axon/PJRT plugin)
     # and cost seconds to start; plain workers skip it and start in ~0.3s.
     tpu_capable: bool = False
+    # Chip ids assigned at spawn (TPU_VISIBLE_CHIPS); returned to the
+    # node's tpu_free pool when the worker dies. Local-spawn nodes only.
+    chip_ids: List[int] = field(default_factory=list)
     # Port of the worker's direct-dispatch server (0 = none); peers push
     # actor tasks there without a controller hop.
     direct_port: int = 0
@@ -276,6 +284,7 @@ class Controller:
         self.app_metrics: Dict[str, dict] = {}
         self._node_counter = 0
         self._spawned_procs: Dict[str, subprocess.Popen] = {}  # spawn_token -> proc
+        self._chip_alloc: Dict[str, List[int]] = {}  # spawn_token -> TPU chip ids
         self._tpu_spawn_tokens: Set[str] = set()  # tokens of TPU-capable spawns
         self._agent_spawns: Dict[str, str] = {}  # outstanding agent spawn token -> node_id
         self._spawn_env_hash: Dict[str, str] = {}  # spawn token -> env hash
@@ -348,6 +357,7 @@ class Controller:
             available=dict(resources),
             index=self._node_counter,
             labels=labels or {},
+            tpu_free=list(range(int(resources.get("TPU", 0)))),
         )
         self._wake_scheduler()
         return nid
@@ -527,6 +537,11 @@ class Controller:
         node = self.nodes.get(w.node_id)
         if node:
             node.workers.discard(w.worker_id)
+            if w.chip_ids and node.agent_conn is None:
+                # Local-spawn pool only: agent-spawned workers' chips are
+                # owned and recycled by their agent's reap loop.
+                node.tpu_free.extend(w.chip_ids)
+                w.chip_ids = []
         # A leased worker's death frees the lease's reserved resources; the
         # holder notices via its broken direct connection and resubmits
         # through the controller (tasks are retryable, unlike actor calls).
@@ -684,6 +699,13 @@ class Controller:
                 self._agent_spawns.pop(token, None)  # no longer outstanding
             was_tpu_spawn = token in self._tpu_spawn_tokens
             self._tpu_spawn_tokens.discard(token)
+            # Local spawns: adopt the controller-side allocation (also
+            # removes it from the never-registered-exit path). Agent
+            # spawns: the agent allocated; trust the worker's report.
+            # Non-TPU workers never hold chips regardless of env noise.
+            w.chip_ids = (self._chip_alloc.pop(token, None)
+                          or list(msg.get("chip_ids") or [])) \
+                if w.tpu_capable else []
         node = self.nodes.get(node_id)
         if node:
             node.workers.add(worker_id)
@@ -1488,7 +1510,8 @@ class Controller:
             if (has_lease and req_cpu > 0
                     and node.available.get("CPU", 0.0) - req_cpu < 1.0):
                 continue
-            w = self._find_idle_worker(node, needs_tpu, env_hash)
+            w = self._find_idle_worker(node, needs_tpu, env_hash,
+                                       tpu_chips=int(resources.get("TPU", 0)))
             if w is None or not w.direct_port:
                 continue
             _res_sub(node.available, resources)
@@ -1506,7 +1529,8 @@ class Controller:
         for node in sorted(self.nodes.values(), key=lambda n: n.index):
             if node.alive and _res_fits(node.available, resources):
                 self._maybe_spawn_worker(node, needs_tpu,
-                                         msg.get("runtime_env"))
+                                         msg.get("runtime_env"),
+                                         tpu_chips=int(resources.get("TPU", 0)))
                 break
         return {"lease_id": None}
 
@@ -2599,9 +2623,11 @@ class Controller:
                 return False
             needs_tpu = resources.get("TPU", 0) > 0
             env_hash = spec.get("env_hash") or ""
-            w = self._find_idle_worker(node, needs_tpu, env_hash)
+            w = self._find_idle_worker(node, needs_tpu, env_hash,
+                                       tpu_chips=int(resources.get("TPU", 0)))
             if w is None:
-                self._maybe_spawn_worker(node, needs_tpu, spec.get("runtime_env"))
+                self._maybe_spawn_worker(node, needs_tpu, spec.get("runtime_env"),
+                                         tpu_chips=int(resources.get("TPU", 0)))
                 return False
             _res_sub(bundle.available, resources)
             spec["pg"] = (pg_ref[0], idx)  # bind so release credits this bundle
@@ -2627,10 +2653,12 @@ class Controller:
                 continue
             if spawning_bucket is not None and bucket(node) > spawning_bucket:
                 return False  # wait for the better-bucket node's spawn
-            w = self._find_idle_worker(node, needs_tpu, env_hash)
+            w = self._find_idle_worker(node, needs_tpu, env_hash,
+                                       tpu_chips=int(resources.get("TPU", 0)))
             if w is None:
                 spawning = self._maybe_spawn_worker(
-                    node, needs_tpu, spec.get("runtime_env"))
+                    node, needs_tpu, spec.get("runtime_env"),
+                    tpu_chips=int(resources.get("TPU", 0)))
                 # Hold later (worse-bucket) nodes ONLY when a spawn is
                 # really coming here; a capped node with nothing in flight
                 # must not starve the task off warm workers elsewhere.
@@ -2644,30 +2672,47 @@ class Controller:
         return False
 
     def _find_idle_worker(
-        self, node: NodeInfo, needs_tpu: bool = False, env_hash: str = ""
+        self, node: NodeInfo, needs_tpu: bool = False, env_hash: str = "",
+        tpu_chips: int = 0,
     ) -> Optional[WorkerInfo]:
         # Plain work prefers plain workers so the scarce, seconds-to-start
         # TPU-capable workers stay free for TPU tasks. Runtime envs match
         # strictly: an env worker's cwd/sys.path/venv are already mutated.
+        # A chip-restricted worker (spawn-time TPU_VISIBLE_CHIPS) only takes
+        # tasks its slice can serve: a num_tpus=4 task must not land on a
+        # worker that sees one chip (reference: per-lease accelerator-id
+        # grants; here the grant is per-worker, so matching does the work).
         fallback: Optional[WorkerInfo] = None
+        best: Optional[WorkerInfo] = None
         for wid in node.workers:
             w = self.workers.get(wid)
             if w is None or w.state != "idle" or w.env_hash != env_hash:
                 continue
             if needs_tpu:
-                if w.tpu_capable:
-                    return w
+                if w.tpu_capable and (
+                        not w.chip_ids
+                        or len(w.chip_ids) >= max(1, tpu_chips)):
+                    # Prefer the smallest sufficient restricted worker over
+                    # unrestricted ones: an unrestricted process touches
+                    # every chip JAX can see, so handing it a small request
+                    # while an exact-fit slice idles invites physical
+                    # contention with concurrently-running slices.
+                    if best is None or (
+                            (len(w.chip_ids) or 1 << 30)
+                            < (len(best.chip_ids) or 1 << 30)):
+                        best = w
             elif w.tpu_capable:
                 fallback = fallback or w
             else:
                 return w
-        return fallback
+        return best if needs_tpu else fallback
 
     def _maybe_spawn_worker(
         self,
         node: NodeInfo,
         needs_tpu: bool = False,
         runtime_env: Optional[Dict[str, Any]] = None,
+        tpu_chips: int = 0,
     ) -> bool:
         """True iff a suitable worker spawn is now (or already was) in
         flight on this node — i.e. waiting on this node is sensible.
@@ -2737,6 +2782,7 @@ class Controller:
                         "kind": "spawn_worker",
                         "spawn_token": spawn_token,
                         "tpu": needs_tpu,
+                        "tpu_chips": max(1, tpu_chips) if needs_tpu else 0,
                         "sys_path": sys_path,
                         "runtime_env": runtime_env,
                     }
@@ -2750,12 +2796,32 @@ class Controller:
         if needs_tpu:
             env["RTPU_TPU_WORKER"] = "1"
             self._tpu_spawn_tokens.add(spawn_token)
+            # Unit-instance chip assignment (reference: per-instance GPU
+            # accounting + CUDA_VISIBLE_DEVICES; tpu.py TPU_VISIBLE_CHIPS):
+            # the worker sees only its chips. Freed when the worker dies.
+            # If the pool is exhausted (more TPU workers than chips), spawn
+            # unrestricted rather than refusing — visibility is an
+            # isolation nicety, the hard limit is the float resource.
+            k = max(1, tpu_chips)
+            if len(node.tpu_free) >= k:
+                ids, node.tpu_free = node.tpu_free[:k], node.tpu_free[k:]
+                env["TPU_VISIBLE_CHIPS"] = ",".join(map(str, ids))
+                self._chip_alloc[spawn_token] = ids
+            else:
+                # Fewer than k free (idle workers still pin theirs): a
+                # partial slice would run a k-chip workload on <k chips —
+                # spawn unrestricted instead, per the fallback contract.
+                env.pop("TPU_VISIBLE_CHIPS", None)
         else:
             # Plain workers skip the accelerator runtime entirely: the axon
             # PJRT plugin registration in sitecustomize imports jax (~3s of
             # interpreter startup). Control-plane workers must spawn in
             # ~0.3s (reference: prestarted raylet workers, worker_pool.h).
             env.pop("PALLAS_AXON_POOL_IPS", None)
+            # An inherited TPU_VISIBLE_CHIPS (chip-restricted driver env)
+            # would be reported at registration and freed into tpu_free on
+            # death — chips this node never allocated. Strip it.
+            env.pop("TPU_VISIBLE_CHIPS", None)
         pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
         env["PYTHONPATH"] = pkg_root + os.pathsep + env.get("PYTHONPATH", "")
         # Propagate the driver's import path so functions defined in driver-
@@ -2861,6 +2927,9 @@ class Controller:
                     node.spawning = max(0, node.spawning - 1)
                     if spawn_token in self._tpu_spawn_tokens:
                         node.spawning_tpu = max(0, node.spawning_tpu - 1)
+                    # Died before registering: its chips were never adopted.
+                    node.tpu_free.extend(
+                        self._chip_alloc.pop(spawn_token, []))
                 self._release_env_spawn(node, spawn_token)
                 self._tpu_spawn_tokens.discard(spawn_token)
                 self._wake_scheduler()

@@ -56,6 +56,11 @@ class HostAgent:
         self.controller_addr = controller_addr
         self.node_id = NodeID.generate()
         self.resources = dict(resources or {"CPU": float(os.cpu_count() or 1)})
+        # Unit-instance chip pool for TPU_VISIBLE_CHIPS assignment (the agent
+        # owns its worker processes, so it owns the per-worker chip ids —
+        # reference: raylet-side GPU instance accounting).
+        self.tpu_free: list = list(range(int(self.resources.get("TPU", 0))))
+        self.tpu_alloc: Dict[str, list] = {}  # spawn_token -> chip ids
         self.labels = dict(labels or {})
         self.serve_host = serve_host
         self.serve_port = serve_port
@@ -152,7 +157,12 @@ class HostAgent:
             tok = msg.get("spawn_token") or self.worker_tokens.get(
                 msg.get("worker_id", "")
             )
-            proc = self.procs.pop(tok, None) if tok else None
+            # Terminate but leave the proc in self.procs: chips must return
+            # to the pool only when the process has ACTUALLY exited (the
+            # reap loop frees them) — a SIGTERM'd worker can hold the
+            # devices open for seconds, and granting its chips to a new
+            # spawn meanwhile hits libtpu "device in use".
+            proc = self.procs.get(tok) if tok else None
             if proc is not None and proc.poll() is None:
                 try:
                     proc.terminate()
@@ -213,8 +223,19 @@ class HostAgent:
             env["RTPU_ARENA"] = self.arena.name
         if msg.get("tpu"):
             env["RTPU_TPU_WORKER"] = "1"
+            # Per-worker chip visibility (reference tpu.py TPU_VISIBLE_CHIPS;
+            # controller's local-spawn path does the same). Pool exhausted ->
+            # unrestricted visibility; the float resource is the hard limit.
+            k = max(1, int(msg.get("tpu_chips") or 1))
+            if len(self.tpu_free) >= k:
+                ids, self.tpu_free = self.tpu_free[:k], self.tpu_free[k:]
+                env["TPU_VISIBLE_CHIPS"] = ",".join(map(str, ids))
+                self.tpu_alloc[spawn_token] = ids
+            else:  # partial slice would under-provision: spawn unrestricted
+                env.pop("TPU_VISIBLE_CHIPS", None)
         else:
             env.pop("PALLAS_AXON_POOL_IPS", None)
+            env.pop("TPU_VISIBLE_CHIPS", None)  # never inherit chip grants
         pkg_root = os.path.dirname(
             os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
         )
@@ -248,6 +269,7 @@ class HostAgent:
             for tok, proc in list(self.procs.items()):
                 if proc.poll() is not None:
                     self.procs.pop(tok, None)
+                    self.tpu_free.extend(self.tpu_alloc.pop(tok, []))
                     try:
                         await self.ctrl.send(
                             {"kind": "spawn_exited", "spawn_token": tok,

@@ -242,6 +242,12 @@ class WorkerRuntime:
                 "node_id": node_id,
                 "spawn_token": flags.get("RTPU_SPAWN_TOKEN"),
                 "tpu_capable": flags.get("RTPU_TPU_WORKER"),
+                # Spawner-assigned chip visibility (agent- or controller-
+                # side): reported so the scheduler can match workers to
+                # tasks by chip count, not just TPU-capability.
+                "chip_ids": [int(x) for x in
+                             (flags.get("TPU_VISIBLE_CHIPS") or "").split(",")
+                             if x != ""],
                 "env_hash": env_hash,
                 "direct_port": self.direct_port,
                 "pid": os.getpid(),

@@ -207,6 +207,17 @@ _define("XLA_FLAGS", str, None,
 _define("TPU_ACCELERATOR_TYPE", str, None,
         "GCE metadata accelerator type (e.g. v5litepod-16); used for "
         "generation detection.", external=True)
+_define("TPU_NAME", str, None,
+        "TPU pod/slice name from GCE/GKE metadata; when set, the node "
+        "advertises the per-pod custom resource {TPU_NAME: 1} "
+        "(reference tpu.py:335-398 scheme).", external=True)
+_define("TPU_WORKER_ID", str, None,
+        "Worker index within a TPU pod; worker 0 additionally advertises "
+        "TPU-<type>-head: 1.", external=True)
+_define("TPU_VISIBLE_CHIPS", str, None,
+        "Comma-separated chip ids visible to this process (the TPU analog "
+        "of CUDA_VISIBLE_DEVICES; reference tpu.py TPU_VISIBLE_CHIPS).",
+        external=True)
 
 
 def get(name: str, default: Any = None) -> Any:

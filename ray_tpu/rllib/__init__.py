@@ -17,7 +17,7 @@ from .core import JaxLearner, LearnerGroup, MLPModule, RLModule
 from .env import EnvRunnerGroup, SingleAgentEnvRunner
 from .env.multi_agent_env import (MultiAgentBatchedEnv, MultiAgentEnv,
                                   make_multi_agent_creator)
-from .offline import BC, BCConfig
+from .offline import BC, BCConfig, MARWIL, MARWILConfig
 from .utils import (FaultTolerantActorManager, SingleAgentEpisode,
                     compute_gae, episodes_to_batch, vtrace)
 
@@ -35,6 +35,8 @@ __all__ = [
     "PPOConfig",
     "BC",
     "BCConfig",
+    "MARWIL",
+    "MARWILConfig",
     "IMPALA",
     "IMPALAConfig",
     "RLModule",

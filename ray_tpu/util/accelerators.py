@@ -11,7 +11,6 @@ from __future__ import annotations
 from ray_tpu import flags
 
 import glob
-import os
 from typing import Dict, Optional
 
 # Peak dense bf16 TFLOP/s per chip, used for MFU accounting (public specs).
@@ -67,3 +66,147 @@ def peak_flops_per_chip(generation: Optional[str] = None, dtype: str = "bf16") -
     if dtype in ("f32", "float32"):
         tf = tf / 2
     return tf * 1e12
+
+
+# --------------------------------------------------------------- plugin layer
+#
+# Pluggable accelerator managers (reference: _private/accelerators/
+# accelerator.py:5 AcceleratorManager ABC + per-vendor implementations).
+# ray_tpu is TPU-first — the TPU manager simply wraps the detection helpers
+# above — but the registry keeps the node-resource construction in
+# api.init() vendor-agnostic, so a GPU/NPU manager is one subclass away
+# rather than a core change.
+
+
+class AcceleratorManager:
+    """One accelerator family: detection, request validation, visibility.
+
+    Mirrors the reference ABC's surface (resource name, visibility env var,
+    node count/type autodetect, request validation, additional resources)
+    with classmethods instead of an abc module dependency."""
+
+    resource_name: str = ""
+    visible_ids_env_var: str = ""
+
+    @classmethod
+    def num_accelerators(cls) -> int:
+        """Autodetected accelerator count on this node."""
+        raise NotImplementedError
+
+    @classmethod
+    def accelerator_type(cls) -> Optional[str]:
+        return None
+
+    @classmethod
+    def additional_resources(cls) -> Dict[str, float]:
+        """Extra custom resources this node should advertise (the TPU
+        per-pod {pod_name: 1} / {TPU-<type>-head: 1} scheme)."""
+        return {}
+
+    @classmethod
+    def validate_request(cls, quantity: float):
+        """(ok, error_message) for a task/actor resource request."""
+        return True, None
+
+    @classmethod
+    def get_visible_ids(cls) -> Optional[list]:
+        raw = flags.get(cls.visible_ids_env_var, default=None) \
+            if cls.visible_ids_env_var else None
+        if raw is None:
+            return None
+        return [] if raw == "" else str(raw).split(",")
+
+    @classmethod
+    def set_visible_ids(cls, ids) -> None:
+        if cls.visible_ids_env_var:
+            flags.set_env(cls.visible_ids_env_var, ",".join(map(str, ids)))
+
+
+class TPUAcceleratorManager(AcceleratorManager):
+    """Reference parity: _private/accelerators/tpu.py:75 (resource "TPU",
+    TPU_VISIBLE_CHIPS isolation, valid per-host chip requests {1, 2, 4},
+    pod-scoped custom resources)."""
+
+    resource_name = "TPU"
+    visible_ids_env_var = "TPU_VISIBLE_CHIPS"
+    # Reference tpu.py TPU_VALID_CHIP_OPTIONS is (1, 2, 4) for 4-chip
+    # hosts; 8 is additionally valid here for v5e/v6e 8-chip hosts
+    # (v5litepod-8: one host owns all 8 chips).
+    VALID_CHIP_REQUESTS = (1, 2, 4, 8)
+
+    @classmethod
+    def num_accelerators(cls) -> int:
+        return detect_tpu_chips()
+
+    @classmethod
+    def accelerator_type(cls) -> Optional[str]:
+        return detect_tpu_generation()
+
+    @classmethod
+    def additional_resources(cls) -> Dict[str, float]:
+        pod_name = flags.get("TPU_NAME", default="")
+        if not pod_name:
+            return {}
+        pod_type = flags.get("TPU_ACCELERATOR_TYPE", default="") or "pod"
+        worker_id = flags.get("TPU_WORKER_ID", default="0")
+        return tpu_pod_resources(pod_name, pod_type,
+                                 is_head=str(worker_id) == "0")
+
+    @classmethod
+    def validate_request(cls, quantity: float):
+        if quantity != int(quantity) or int(quantity) not in \
+                cls.VALID_CHIP_REQUESTS:
+            return False, (
+                f"num_tpus={quantity} is not a supported per-host chip "
+                f"request; supported: {cls.VALID_CHIP_REQUESTS} "
+                f"(reference tpu.py TPU_VALID_CHIP_OPTIONS)")
+        return True, None
+
+
+_MANAGERS: list = [TPUAcceleratorManager]
+
+
+def register_accelerator_manager(mgr: type) -> None:
+    """Add a vendor manager (newest wins on resource-name conflicts). The
+    manager's visibility env var is registered as an external flag so the
+    flags-registry-is-sole-environ-reader invariant holds for plugins too."""
+    if mgr.visible_ids_env_var and mgr.visible_ids_env_var not in \
+            flags.REGISTRY:
+        flags._define(
+            mgr.visible_ids_env_var, str, None,
+            f"Visible accelerator ids for the {mgr.resource_name} plugin "
+            f"(accelerator manager {mgr.__name__}).", external=True)
+    _MANAGERS[:] = [m for m in _MANAGERS
+                    if m.resource_name != mgr.resource_name]
+    _MANAGERS.append(mgr)
+
+
+def accelerator_managers() -> list:
+    return list(_MANAGERS)
+
+
+def manager_for_resource(name: str) -> Optional[type]:
+    for m in _MANAGERS:
+        if m.resource_name == name:
+            return m
+    return None
+
+
+def detect_node_accelerator_resources() -> Dict[str, float]:
+    """Autodetected accelerator resources for this node: every registered
+    family with a nonzero count, plus its additional custom resources
+    (api.init's vendor-agnostic entry; reference: resource autodetection in
+    _private/accelerators via get_current_node_num_accelerators)."""
+    res: Dict[str, float] = {}
+    for m in _MANAGERS:
+        try:
+            n = m.num_accelerators()
+        except Exception:
+            n = 0
+        if n:
+            res[m.resource_name] = float(n)
+            try:
+                res.update(m.additional_resources())
+            except Exception:
+                pass  # a faulty plugin must not take down init()
+    return res

@@ -1,0 +1,93 @@
+"""Accelerator plugin framework (reference: _private/accelerators/
+accelerator.py ABC + python/ray/tests/accelerators/test_tpu.py, which mocks
+/dev/accel* and GCE metadata env the same way)."""
+import numpy as np
+import pytest
+
+from ray_tpu.util import accelerators as acc
+
+
+def test_tpu_detection_env_override(monkeypatch):
+    monkeypatch.setenv("RTPU_NUM_TPUS", "4")
+    assert acc.TPUAcceleratorManager.num_accelerators() == 4
+    res = acc.detect_node_accelerator_resources()
+    assert res["TPU"] == 4.0
+
+
+def test_tpu_detection_dev_glob(monkeypatch):
+    monkeypatch.delenv("RTPU_NUM_TPUS", raising=False)
+    monkeypatch.setattr(
+        "ray_tpu.util.accelerators.glob.glob",
+        lambda pat: ["/dev/accel0", "/dev/accel1"] if "accel" in pat else [])
+    assert acc.TPUAcceleratorManager.num_accelerators() == 2
+
+
+def test_tpu_generation_from_accelerator_type(monkeypatch):
+    monkeypatch.setenv("TPU_ACCELERATOR_TYPE", "v5litepod-16")
+    assert acc.TPUAcceleratorManager.accelerator_type() == "v5e"
+    monkeypatch.setenv("TPU_ACCELERATOR_TYPE", "v5p-64")
+    assert acc.TPUAcceleratorManager.accelerator_type() == "v5p"
+
+
+def test_tpu_request_validation():
+    for good in (1, 2, 4, 8):
+        ok, err = acc.TPUAcceleratorManager.validate_request(good)
+        assert ok and err is None
+    for bad in (0.5, 3, 5, 16):
+        ok, err = acc.TPUAcceleratorManager.validate_request(bad)
+        assert not ok and "supported" in err
+
+
+def test_tpu_pod_additional_resources(monkeypatch):
+    monkeypatch.setenv("TPU_NAME", "my-pod")
+    monkeypatch.setenv("TPU_ACCELERATOR_TYPE", "v5litepod-16")
+    monkeypatch.setenv("TPU_WORKER_ID", "0")
+    res = acc.TPUAcceleratorManager.additional_resources()
+    assert res == {"my-pod": 1.0, "TPU-v5litepod-16-head": 1.0}
+    monkeypatch.setenv("TPU_WORKER_ID", "2")
+    res = acc.TPUAcceleratorManager.additional_resources()
+    assert res == {"my-pod": 1.0}
+
+
+def test_visible_ids_roundtrip(monkeypatch):
+    monkeypatch.delenv("TPU_VISIBLE_CHIPS", raising=False)
+    assert acc.TPUAcceleratorManager.get_visible_ids() is None
+    acc.TPUAcceleratorManager.set_visible_ids([0, 2])
+    assert acc.TPUAcceleratorManager.get_visible_ids() == ["0", "2"]
+    monkeypatch.setenv("TPU_VISIBLE_CHIPS", "")
+    assert acc.TPUAcceleratorManager.get_visible_ids() == []
+
+
+def test_registry_replacement_and_detection(monkeypatch):
+    class FakeNPU(acc.AcceleratorManager):
+        resource_name = "NPU"
+        visible_ids_env_var = "NPU_VISIBLE"
+
+        @classmethod
+        def num_accelerators(cls):
+            return 3
+
+        @classmethod
+        def additional_resources(cls):
+            return {"npu-island": 1.0}
+
+    before = acc.accelerator_managers()
+    try:
+        acc.register_accelerator_manager(FakeNPU)
+        assert acc.manager_for_resource("NPU") is FakeNPU
+        monkeypatch.setenv("RTPU_NUM_TPUS", "0")
+        res = acc.detect_node_accelerator_resources()
+        assert res == {"NPU": 3.0, "npu-island": 1.0}
+    finally:
+        acc._MANAGERS[:] = before
+
+
+def test_remote_option_validation(ray_start_regular):
+    import ray_tpu
+
+    @ray_tpu.remote
+    def f():
+        return 1
+
+    with pytest.raises(ValueError, match="supported"):
+        f.options(num_tpus=3).remote()

@@ -1,0 +1,63 @@
+"""Worker-level TPU chip assignment (own module: needs a fresh
+cluster with RTPU_NUM_TPUS set before init, which the module-scoped
+ray_start_regular fixture would prevent)."""
+def test_worker_chip_isolation(monkeypatch):
+    """Unit-instance accounting end-to-end: concurrently-alive TPU actors
+    get disjoint TPU_VISIBLE_CHIPS slices of the node's pool, and chips
+    return to the pool when workers die (reference: per-instance GPU
+    accounting + tpu.py TPU_VISIBLE_CHIPS isolation)."""
+    import os
+
+    import ray_tpu
+
+    monkeypatch.setenv("RTPU_NUM_TPUS", "4")
+    ray_tpu.init(num_cpus=2)
+    try:
+        @ray_tpu.remote(num_tpus=2)
+        class Holder:
+            def chips(self):
+                ids = ray_tpu.get_runtime_context() \
+                    .get_accelerator_ids()["TPU"]
+                return os.getpid(), ids
+
+        a, b = Holder.remote(), Holder.remote()
+        (pid_a, chips_a), (pid_b, chips_b) = ray_tpu.get(
+            [a.chips.remote(), b.chips.remote()], timeout=60)
+        assert pid_a != pid_b
+        assert len(chips_a) == 2 and len(chips_b) == 2
+        assert not (set(chips_a) & set(chips_b)), (chips_a, chips_b)
+        assert set(chips_a) | set(chips_b) == {"0", "1", "2", "3"}
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_chip_count_aware_worker_reuse(monkeypatch):
+    """A num_tpus=4 task must not reuse an idle worker that sees one chip
+    (review scenario: spawn-time visibility vs per-task reservation)."""
+    import os
+
+    import ray_tpu
+
+    monkeypatch.setenv("RTPU_NUM_TPUS", "4")
+    ray_tpu.init(num_cpus=2)
+    try:
+        @ray_tpu.remote(num_tpus=1)
+        def one_chip():
+            return (os.getpid(),
+                    ray_tpu.get_runtime_context().get_accelerator_ids()["TPU"])
+
+        @ray_tpu.remote(num_tpus=4)
+        def four_chip():
+            return (os.getpid(),
+                    ray_tpu.get_runtime_context().get_accelerator_ids()["TPU"])
+
+        pid1, chips1 = ray_tpu.get(one_chip.remote(), timeout=60)
+        assert len(chips1) == 1
+        # The 1-chip worker is now idle; the 4-chip task needs a different
+        # worker. With 3 chips left free the spawner can't grant 4, so the
+        # new worker runs unrestricted — never a partial slice.
+        pid4, chips4 = ray_tpu.get(four_chip.remote(), timeout=60)
+        assert pid4 != pid1
+        assert chips4 == [] or len(chips4) == 4, chips4
+    finally:
+        ray_tpu.shutdown()
