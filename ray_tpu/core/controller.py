@@ -2759,7 +2759,45 @@ class Controller:
                 return node.spawning > 0
             node.workers.discard(victim.worker_id)
             self.workers.pop(victim.worker_id, None)
+            if victim.chip_ids and node.agent_conn is None:
+                # This path pops the worker before shutdown, so the death
+                # handler can't return its chips — do it here.
+                node.tpu_free.extend(victim.chip_ids)
+                victim.chip_ids = []
             asyncio.get_running_loop().create_task(self._shutdown_worker(victim))
+        if needs_tpu:
+            # Chip-pressure check: spawning a TPU worker whose visibility
+            # would overlap chips pinned by LIVE workers trades isolation
+            # for "device in use" crashes (libtpu holds devices for process
+            # lifetime). If disjoint chips can't be granted, reap an idle
+            # chip-holder to replenish the pool and let the scheduler retry
+            # after its death; with only busy holders, wait.
+            total = int(node.resources.get("TPU", 0))
+            k = max(1, tpu_chips)
+            if total:
+                held = 0
+                for wid in node.workers:
+                    lw = self.workers.get(wid)
+                    if lw is None or not lw.tpu_capable:
+                        continue
+                    # An unrestricted TPU worker's JAX runtime grabbed every
+                    # visible chip — it holds `total`, not zero.
+                    held += len(lw.chip_ids) or total
+                if total - held < k:
+                    dying = None
+                    for wid in node.workers:
+                        w = self.workers.get(wid)
+                        if (w is not None and w.state == "idle"
+                                and w.chip_ids):
+                            if dying is None or \
+                                    len(w.chip_ids) < len(dying.chip_ids):
+                                dying = w
+                    if dying is not None:
+                        dying.state = "dying"  # matcher must skip it now
+                        asyncio.get_running_loop().create_task(
+                            self._shutdown_worker(dying))
+                        return True  # chips free on its death; retry then
+                    return node.spawning > 0
         node.spawning += 1
         if needs_tpu:
             node.spawning_tpu += 1
@@ -2854,6 +2892,7 @@ class Controller:
                 except OSError as e:
                     node.spawning = max(0, node.spawning - 1)
                     self._release_env_spawn(node, spawn_token)
+                    self._free_spawn_chips(node, spawn_token)
                     self._fail_env_tasks(
                         runtime_env.get("hash", ""),
                         RuntimeError(
@@ -2885,6 +2924,7 @@ class Controller:
                         self._tpu_spawn_tokens.discard(spawn_token)
                         node.spawning_tpu = max(0, node.spawning_tpu - 1)
                     self._release_env_spawn(node, spawn_token)
+                    self._free_spawn_chips(node, spawn_token)
                     self._fail_env_tasks(runtime_env.get("hash", ""), e)
                     self._wake_scheduler()
                     return
@@ -2898,17 +2938,36 @@ class Controller:
 
             asyncio.get_running_loop().create_task(_spawn_with_venv())
             return True
-        proc = subprocess.Popen(
-            [sys.executable, "-m", "ray_tpu.core.worker_main"],
-            env=env,
-            stdout=self._worker_log_file(spawn_token),
-            stderr=subprocess.STDOUT,
-        )
+        try:
+            proc = subprocess.Popen(
+                [sys.executable, "-m", "ray_tpu.core.worker_main"],
+                env=env,
+                stdout=self._worker_log_file(spawn_token),
+                stderr=subprocess.STDOUT,
+            )
+        except OSError:
+            # Unwind: a failed launch must not leak the carved-out chips
+            # or the spawning counters.
+            node.spawning = max(0, node.spawning - 1)
+            if spawn_token in self._tpu_spawn_tokens:
+                self._tpu_spawn_tokens.discard(spawn_token)
+                node.spawning_tpu = max(0, node.spawning_tpu - 1)
+            self._release_env_spawn(node, spawn_token)
+            self._free_spawn_chips(node, spawn_token)
+            return False
         self._spawned_procs[spawn_token] = proc
         # The worker registers itself carrying the token (exact adoption in
         # _h_register); this task only reaps processes that die pre-register.
         asyncio.get_running_loop().create_task(self._watch_spawn(node.node_id, spawn_token, proc))
         return True
+
+    def _free_spawn_chips(self, node: Optional[NodeInfo],
+                          spawn_token: str) -> None:
+        """Return a never-started/never-registered local spawn's chip grant
+        to the node pool."""
+        ids = self._chip_alloc.pop(spawn_token, [])
+        if ids and node is not None:
+            node.tpu_free.extend(ids)
 
     def _worker_log_file(self, spawn_token: str):
         from .worker_logs import worker_log_file
@@ -2916,7 +2975,10 @@ class Controller:
         return worker_log_file(spawn_token)
 
     async def _watch_spawn(self, node_id: str, spawn_token: str, proc: subprocess.Popen) -> None:
-        for _ in range(600):
+        # ~2 min of polling: generous for a loaded CI host (TPU workers
+        # import jax, ~3-10s; venv workers build first), but bounded so the
+        # kill-on-exhaustion below can't hit a healthy slow starter.
+        for _ in range(1200):
             await asyncio.sleep(0.1)
             if spawn_token not in self._spawned_procs:
                 return  # adopted by a registered worker
@@ -2927,13 +2989,33 @@ class Controller:
                     node.spawning = max(0, node.spawning - 1)
                     if spawn_token in self._tpu_spawn_tokens:
                         node.spawning_tpu = max(0, node.spawning_tpu - 1)
-                    # Died before registering: its chips were never adopted.
-                    node.tpu_free.extend(
-                        self._chip_alloc.pop(spawn_token, []))
+                # Died before registering: its chips were never adopted.
+                self._free_spawn_chips(node, spawn_token)
                 self._release_env_spawn(node, spawn_token)
                 self._tpu_spawn_tokens.discard(spawn_token)
                 self._wake_scheduler()
                 return
+        # Watch window exhausted with the process still alive and
+        # unregistered: a 60s silent startup is pathological (reference:
+        # worker_pool startup timeouts kill slow starters). Kill it and
+        # unwind — freeing the chip grant while the process lived on could
+        # double-allocate its chips if it registered late.
+        if spawn_token in self._spawned_procs:
+            try:
+                proc.terminate()
+            except Exception:
+                pass
+            await asyncio.sleep(1.0)
+            self._spawned_procs.pop(spawn_token, None)
+            node = self.nodes.get(node_id)
+            if node:
+                node.spawning = max(0, node.spawning - 1)
+                if spawn_token in self._tpu_spawn_tokens:
+                    node.spawning_tpu = max(0, node.spawning_tpu - 1)
+            self._free_spawn_chips(node, spawn_token)
+            self._release_env_spawn(node, spawn_token)
+            self._tpu_spawn_tokens.discard(spawn_token)
+            self._wake_scheduler()
 
     async def _dispatch(self, spec: Dict[str, Any], node: NodeInfo, w: WorkerInfo) -> None:
         self._record_task_event(spec, "running", worker_id=w.worker_id,

@@ -252,12 +252,24 @@ class HostAgent:
             from .runtime_env import container_command
 
             cmd = container_command(renv_spec, cmd)
-        proc = subprocess.Popen(
-            cmd,
-            env=env,
-            stdout=log_f,
-            stderr=subprocess.STDOUT if log_f else None,
-        )
+        try:
+            proc = subprocess.Popen(
+                cmd,
+                env=env,
+                stdout=log_f,
+                stderr=subprocess.STDOUT if log_f else None,
+            )
+        except OSError as e:
+            # Unwind the chip grant: a launch that never produced a process
+            # has no reap event to return the chips through. The synthetic
+            # spawn_exited unwinds the controller's spawning counters the
+            # same way a pre-register death would.
+            self.tpu_free.extend(self.tpu_alloc.pop(spawn_token, []))
+            sys.stderr.write(f"[host_agent] worker launch failed: {e!r}\n")
+            asyncio.get_running_loop().create_task(self.ctrl.send(
+                {"kind": "spawn_exited", "spawn_token": spawn_token,
+                 "node_id": self.node_id, "returncode": -1}))
+            return {"ok": False, "error": str(e)}
         self.procs[spawn_token] = proc
         return {"ok": True, "pid": proc.pid}
 

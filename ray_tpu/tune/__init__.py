@@ -4,7 +4,8 @@ Hosts trainers and RL algorithms as trials: Tuner → TuneController → trial
 actors, with searchers (grid/random + pluggable Searcher) and schedulers
 (FIFO/ASHA/MedianStopping/PBT). reference: python/ray/tune.
 """
-from .callbacks import Callback, CSVLoggerCallback, JsonLoggerCallback
+from .callbacks import (Callback, CSVLoggerCallback, JsonLoggerCallback,
+                        TensorBoardLoggerCallback)
 from .experiment import Trial
 from .schedulers import (
     AsyncHyperBandScheduler,
@@ -37,6 +38,7 @@ __all__ = [
     "FIFOScheduler",
     "FunctionTrainable",
     "JsonLoggerCallback",
+    "TensorBoardLoggerCallback",
     "MedianStoppingRule",
     "PopulationBasedTraining",
     "ResultGrid",

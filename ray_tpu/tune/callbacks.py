@@ -132,3 +132,34 @@ class CSVLoggerCallback(Callback):
             if st["file"] is not None:
                 st["file"].close()
         self._state.clear()
+
+
+class TensorBoardLoggerCallback(Callback):
+    """Per-trial TensorBoard event files under <trial_dir>/ (reference:
+    tune/logger/tensorboardx.py TBXLoggerCallback; writer is the
+    dependency-free util/tensorboard.py — the image ships no tensorboardX).
+    Steps use the result's training_iteration when present."""
+
+    def __init__(self):
+        self._writers: Dict[str, Any] = {}
+        self._steps: Dict[str, int] = {}
+
+    def on_trial_result(self, trial, result: Dict[str, Any]) -> None:
+        if not trial.local_dir:
+            return
+        w = self._writers.get(trial.trial_id)
+        if w is None:
+            from ray_tpu.util.tensorboard import EventFileWriter
+
+            w = self._writers[trial.trial_id] = EventFileWriter(
+                trial.local_dir)
+        step = result.get("training_iteration")
+        if not isinstance(step, int):
+            step = self._steps.get(trial.trial_id, 0) + 1
+        self._steps[trial.trial_id] = step
+        w.add_scalars(_flatten(result), step=step)
+
+    def on_experiment_end(self, controller) -> None:
+        for w in self._writers.values():
+            w.close()
+        self._writers.clear()

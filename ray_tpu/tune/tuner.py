@@ -14,7 +14,8 @@ from typing import Any, Callable, Dict, List, Optional, Union
 from ray_tpu.train.checkpoint import Checkpoint
 from ray_tpu.train.config import RunConfig
 
-from .callbacks import Callback, CSVLoggerCallback, JsonLoggerCallback
+from .callbacks import (Callback, CSVLoggerCallback, JsonLoggerCallback,
+                        TensorBoardLoggerCallback)
 from .experiment import ERROR, TERMINATED, Trial, load_experiment_state
 from .schedulers import FIFOScheduler, TrialScheduler
 from .search.basic_variant import BasicVariantGenerator
@@ -182,7 +183,8 @@ class Tuner:
             )
         scheduler = tc.scheduler or FIFOScheduler(metric=tc.metric, mode=tc.mode)
 
-        callbacks: List[Callback] = [JsonLoggerCallback(), CSVLoggerCallback()]
+        callbacks: List[Callback] = [JsonLoggerCallback(), CSVLoggerCallback(),
+                             TensorBoardLoggerCallback()]
         if self.run_config.callbacks:
             callbacks.extend(self.run_config.callbacks)
 
