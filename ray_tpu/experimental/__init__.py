@@ -1,0 +1,10 @@
+"""ray.experimental parity surface (reference: python/ray/experimental/).
+
+internal_kv and object-location introspection; the rest of the reference's
+experimental module (tqdm_ray, shuffle) is either superseded by first-class
+features here or out of scope for a TPU-first stack.
+"""
+from . import internal_kv
+from .locations import get_object_locations
+
+__all__ = ["internal_kv", "get_object_locations"]

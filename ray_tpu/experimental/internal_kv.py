@@ -1,0 +1,66 @@
+"""Cluster-wide internal KV (reference: python/ray/experimental/internal_kv.py
+— the GCS KV table libraries use for small control-plane metadata; here it is
+the controller's persistent KV, the same table runtime_env packages and the
+function registry live in)."""
+from __future__ import annotations
+
+from typing import List, Optional
+
+from ray_tpu.core import context as ctx
+
+_NS = "__internal_kv__"
+
+
+def _client():
+    return ctx.get_worker_context().client
+
+
+def _internal_kv_initialized() -> bool:
+    try:
+        return _client() is not None
+    except Exception:
+        return False
+
+
+def _internal_kv_put(key: bytes, value: bytes, overwrite: bool = True,
+                     namespace: Optional[bytes] = None) -> bool:
+    """Returns True iff the key already existed (reference semantics)."""
+    ns = _NS + (namespace or b"").decode("latin-1")
+    out = _client().request({"kind": "kv_put", "ns": ns,
+                             "key": _k(key), "value": bytes(value),
+                             "overwrite": overwrite})
+    return not out.get("added", False)
+
+
+def _internal_kv_get(key: bytes,
+                     namespace: Optional[bytes] = None) -> Optional[bytes]:
+    ns = _NS + (namespace or b"").decode("latin-1")
+    v = _client().request({"kind": "kv_get", "ns": ns, "key": _k(key)})
+    return None if v is None else bytes(v)
+
+
+def _internal_kv_exists(key: bytes,
+                        namespace: Optional[bytes] = None) -> bool:
+    return _internal_kv_get(key, namespace) is not None
+
+
+def _internal_kv_del(key: bytes,
+                     namespace: Optional[bytes] = None) -> int:
+    ns = _NS + (namespace or b"").decode("latin-1")
+    out = _client().request({"kind": "kv_del", "ns": ns, "key": _k(key)})
+    return 1 if out.get("deleted") else 0
+
+
+def _internal_kv_list(prefix: bytes,
+                      namespace: Optional[bytes] = None) -> List[bytes]:
+    ns = _NS + (namespace or b"").decode("latin-1")
+    keys = _client().request({"kind": "kv_keys", "ns": ns,
+                              "prefix": _k(prefix)})
+    return [k.encode("latin-1") for k in keys]
+
+
+def _k(key: bytes) -> str:
+    # latin-1 is a bijection between bytes 0-255 and code points 0-255, so
+    # arbitrary binary keys (hashes, pickled ids — common internal_kv
+    # usage) never collide the way a lossy utf-8 'replace' decode would.
+    return key.decode("latin-1") if isinstance(key, bytes) else str(key)

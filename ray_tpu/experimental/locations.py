@@ -1,0 +1,51 @@
+"""Object location introspection (reference:
+python/ray/experimental/locations.py get_object_locations — where an
+object's bytes physically live and how big they are)."""
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+from ray_tpu.core import context as ctx
+
+
+def get_object_locations(obj_refs: List[Any],
+                         timeout_ms: int = -1) -> Dict[Any, Dict[str, Any]]:
+    """{ref: {"node_ids": [...], "object_size": int, "did_spill": bool}}.
+
+    Reference semantics: timeout_ms=-1 waits indefinitely for resolution;
+    timeout_ms=0 is a non-blocking snapshot; unknown/unresolvable refs map
+    to empty node lists rather than raising — one bad ref must not destroy
+    the batch."""
+    client = ctx.get_worker_context().client
+    ids = [r.object_id for r in obj_refs]
+    # Owners ride along so directory misses can be recovered from the
+    # owning worker (same pattern as the fetch path, core/api.py).
+    owners = {r.object_id: r.owner for r in obj_refs
+              if getattr(r, "owner", None)}
+    timeout = 2 ** 31 if timeout_ms < 0 else timeout_ms / 1000.0
+    try:
+        locs = client.request({"kind": "get_locations", "object_ids": ids,
+                               "owners": owners, "timeout": timeout})
+    except Exception:
+        # At least one ref couldn't resolve within the timeout: snapshot
+        # each ref independently so resolvable ones still report.
+        locs = {}
+        for oid in ids:
+            try:
+                locs.update(client.request(
+                    {"kind": "get_locations", "object_ids": [oid],
+                     "owners": owners, "timeout": 0}))
+            except Exception:
+                pass
+    out: Dict[Any, Dict[str, Any]] = {}
+    for ref, oid in zip(obj_refs, ids):
+        loc = locs.get(oid)
+        if loc is None:
+            out[ref] = {"node_ids": [], "object_size": 0, "did_spill": False}
+        else:
+            out[ref] = {
+                "node_ids": [loc.node_id] if loc.node_id else [],
+                "object_size": loc.size,
+                "did_spill": loc.spill_path is not None,
+            }
+    return out

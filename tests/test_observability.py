@@ -209,3 +209,57 @@ def test_pubsub_batches_bursts(ray_start_regular):
             {"kind": "publish", "channel": "burst_chan", "data": i})
     assert done.wait(timeout=15), f"only {len(got)}/40 delivered"
     assert got == list(range(40)), got[:10]
+
+
+def test_internal_kv_and_locations(ray_start_regular):
+    """ray.experimental parity: internal_kv round-trip + object locations
+    (reference: experimental/internal_kv.py, experimental/locations.py)."""
+    import numpy as np
+
+    import ray_tpu
+    from ray_tpu.experimental import get_object_locations, internal_kv
+
+    assert internal_kv._internal_kv_initialized()
+    existed = internal_kv._internal_kv_put(b"k1", b"v1")
+    assert existed is False
+    assert internal_kv._internal_kv_get(b"k1") == b"v1"
+    assert internal_kv._internal_kv_put(b"k1", b"v2") is True
+    assert internal_kv._internal_kv_exists(b"k1")
+    assert not internal_kv._internal_kv_exists(b"nope")
+    internal_kv._internal_kv_put(b"k2", b"x", namespace=b"ns")
+    assert internal_kv._internal_kv_get(b"k2") is None  # ns isolation
+    assert internal_kv._internal_kv_get(b"k2", namespace=b"ns") == b"x"
+    assert internal_kv._internal_kv_list(b"k") == [b"k1"]
+    assert internal_kv._internal_kv_del(b"k1") == 1
+    assert internal_kv._internal_kv_get(b"k1") is None
+
+    big = ray_tpu.put(np.zeros(1_000_000))
+    small = ray_tpu.put(1)
+    locs = get_object_locations([big, small])
+    assert locs[big]["object_size"] > 7_000_000
+    assert locs[big]["did_spill"] is False
+    assert isinstance(locs[big]["node_ids"], list)
+
+
+def test_internal_kv_binary_keys_and_unknown_locations(ray_start_regular):
+    """Binary keys must not collide (lossless latin-1 mapping) and an
+    unknown ref yields an empty-location entry without poisoning the
+    batch (reference get_object_locations semantics)."""
+    import ray_tpu
+    from ray_tpu.core.serialization import ObjectRef
+    from ray_tpu.experimental import get_object_locations, internal_kv
+
+    internal_kv._internal_kv_put(b"\x80", b"v1")
+    internal_kv._internal_kv_put(b"\x81", b"v2")
+    assert internal_kv._internal_kv_get(b"\x80") == b"v1"
+    assert internal_kv._internal_kv_get(b"\x81") == b"v2"
+    assert set(internal_kv._internal_kv_list(b"\x80")) == {b"\x80"}
+    internal_kv._internal_kv_del(b"\x80")
+    internal_kv._internal_kv_del(b"\x81")
+
+    good = ray_tpu.put("here")
+    bogus = ObjectRef("ffffffffffffffffffffffffffffffff")
+    locs = get_object_locations([good, bogus], timeout_ms=500)
+    assert locs[good]["object_size"] > 0
+    assert locs[bogus] == {"node_ids": [], "object_size": 0,
+                           "did_spill": False}
