@@ -154,6 +154,33 @@ def cmd_summary(args) -> int:
     return 0
 
 
+def cmd_memory(args) -> int:
+    """Object-reference/memory table (reference: `ray memory` — the
+    reference-table dump from _private/state.py)."""
+    rt = _connect(args)
+    from ray_tpu.core import context as ctx
+
+    s = ctx.get_worker_context().client.request(
+        {"kind": "memory_summary", "limit": args.limit})
+    print(f"objects: {s['num_objects']}  "
+          f"total: {s['total_bytes'] / 1e6:.1f} MB")
+    for nid, st in sorted(s.get("arenas", {}).items()):
+        used, cap = st.get("used", 0), st.get("capacity", 0)
+        print(f"arena {nid[:8]}: {used / 1e6:.1f}/{cap / 1e6:.1f} MB "
+              f"({st.get('objects', 0)} objects)")
+    for wid, st in sorted(s.get("workers", {}).items()):
+        print(f"worker {wid[:8]}: owned={st.get('owned', 0)} "
+              f"borrowed={st.get('borrowed', 0)} pins={st.get('pins', 0)}")
+    rows = sorted(s["objects"], key=lambda o: -o["size"])[:args.limit]
+    if rows:
+        print(f"{'OBJECT':34} {'SIZE':>12} {'STORAGE':8} NODE")
+        for o in rows:
+            print(f"{o['object_id'][:32]:34} {o['size']:>12} "
+                  f"{o['storage']:8} {(o['node_id'] or '')[:8]}")
+    rt.shutdown()
+    return 0
+
+
 def cmd_timeline(args) -> int:
     rt = _connect(args)
     from ray_tpu.util import state
@@ -322,6 +349,11 @@ def main(argv=None) -> int:
     p.add_argument("--address", default=None)
     p.add_argument("--out", default="timeline.json")
     p.set_defaults(fn=cmd_timeline)
+
+    p = sub.add_parser("memory", help="object reference/memory table")
+    p.add_argument("--address", default=None)
+    p.add_argument("--limit", type=int, default=50)
+    p.set_defaults(fn=cmd_memory)
 
     p = sub.add_parser("dashboard", help="serve the web dashboard")
     p.add_argument("--address", default=None)

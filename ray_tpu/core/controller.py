@@ -277,7 +277,7 @@ class Controller:
         # Direct-dispatch worker leases (lease_id -> {worker_id, node_id,
         # resources, owner conn}) and on-demand profiling collection state.
         self._leases: Dict[str, Dict[str, Any]] = {}
-        self._profiles: Dict[str, Dict[str, str]] = {}
+        self._profiles: Dict[str, Dict[str, Any]] = {}
         self._last_reclaim_nudge = 0.0
         # App-defined metrics (util/metrics.py): name -> {type, help,
         # boundaries, data {tags_tuple: value|histogram-state}}.
@@ -1756,29 +1756,62 @@ class Controller:
         seconds, return {worker_id: all-thread stack text}. Workers that
         are busy in native code simply miss the window — partial results
         are returned, never an error."""
+        req_id, requested, workers = await self._gather_from_workers(
+            "stack_dump", float(msg.get("timeout", 2.0)))
+        return {"req_id": req_id, "requested": requested, "workers": workers}
+
+    async def _gather_from_workers(self, kind: str, timeout: float):
+        """Fan a request to every live worker and gather replies (arriving
+        as profile_result messages) until all respond or the deadline
+        passes — partial results, never an error."""
         req_id = uuid.uuid4().hex[:12]
-        profiles = self._profiles
-        profiles[req_id] = {}
+        self._profiles[req_id] = {}
         targets = []
         for w in list(self.workers.values()):
             try:
-                await w.conn.send({"kind": "stack_dump", "req_id": req_id})
+                await w.conn.send({"kind": kind, "req_id": req_id})
                 targets.append(w.worker_id)
             except Exception:
                 pass
-        timeout = float(msg.get("timeout", 2.0))
         deadline = time.monotonic() + timeout
-        while (len(profiles[req_id]) < len(targets)
+        while (len(self._profiles[req_id]) < len(targets)
                and time.monotonic() < deadline):
             await asyncio.sleep(0.05)
-        return {"req_id": req_id, "requested": len(targets),
-                "workers": profiles.pop(req_id)}
+        return req_id, len(targets), self._profiles.pop(req_id)
 
     async def _h_profile_result(self, conn, msg):
         bucket = self._profiles.get(msg["req_id"])
         if bucket is not None:
             bucket[msg["worker_id"]] = msg["text"]
         return {"ok": True}
+
+    async def _h_memory_summary(self, conn, msg):
+        """`rtpu memory` backend (reference: `ray memory` reference-table
+        dump, _private/state.py memory summary): the object directory
+        (id/size/storage/node) joined with each worker's local ownership
+        stats, gathered with the same fan-out/partial-result contract as
+        profiling — a worker busy in native code misses the window."""
+        _, _, owners = await self._gather_from_workers(
+            "ref_dump", float(msg.get("timeout", 2.0)))
+        limit = int(msg.get("limit", 1000))
+        # Largest first BEFORE truncating: the memory-debugging view must
+        # never drop the biggest objects to insertion order.
+        ranked = sorted(self.objects.items(),
+                        key=lambda kv: -kv[1].size)[:limit]
+        objs = []
+        for oid, loc in ranked:
+            storage = ("error" if loc.is_error else
+                       "inline" if loc.inline is not None else
+                       "spilled" if loc.spill_path else
+                       "arena" if loc.arena else
+                       "shm" if loc.shm_name else "?")
+            objs.append({"object_id": oid, "size": loc.size,
+                         "storage": storage, "node_id": loc.node_id})
+        arenas = {nid: n.arena_stats for nid, n in self.nodes.items()
+                  if n.arena_stats}
+        return {"objects": objs, "num_objects": len(self.objects),
+                "total_bytes": sum(l.size for l in self.objects.values()),
+                "workers": owners, "arenas": arenas}
 
     async def _h_subscribe(self, conn, msg):
         self.subs.setdefault(msg["channel"], []).append(conn)

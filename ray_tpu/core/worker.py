@@ -523,6 +523,18 @@ class WorkerRuntime:
             self._cancel_task(msg["task_id"])
         elif kind == "shutdown":
             self.shutdown_event.set()
+        elif kind == "ref_dump":
+            # Ownership introspection for `rtpu memory` (reference: the
+            # reference-table rows `ray memory` collects per worker); same
+            # off-loop reply pattern as stack_dump.
+            from . import ownership
+
+            st = ownership.stats()
+            threading.Thread(
+                target=lambda: self.client.request(
+                    {"kind": "profile_result", "req_id": msg["req_id"],
+                     "worker_id": self.worker_id, "text": st}),
+                daemon=True).start()
         elif kind == "stack_dump":
             # On-demand profiling (reference: reporter agent py-spy dump):
             # format every thread's current stack and reply off the event

@@ -263,3 +263,29 @@ def test_internal_kv_binary_keys_and_unknown_locations(ray_start_regular):
     assert locs[good]["object_size"] > 0
     assert locs[bogus] == {"node_ids": [], "object_size": 0,
                            "did_spill": False}
+
+
+def test_memory_summary(ray_start_regular):
+    """`rtpu memory` backend: object table + arena stats + per-worker
+    ownership stats (reference: `ray memory` reference-table dump)."""
+    import numpy as np
+
+    import ray_tpu
+    from ray_tpu.core import context as ctx
+
+    big = ray_tpu.put(np.zeros(2_000_000))
+
+    @ray_tpu.remote
+    def hold(x):
+        return x.nbytes
+
+    assert ray_tpu.get(hold.remote(big)) == 16_000_000
+    s = ctx.get_worker_context().client.request(
+        {"kind": "memory_summary", "limit": 100})
+    assert s["num_objects"] >= 1
+    mine = [o for o in s["objects"] if o["size"] > 15_000_000]
+    assert mine and mine[0]["storage"] in ("arena", "shm")
+    assert s["total_bytes"] >= mine[0]["size"]
+    assert isinstance(s["workers"], dict) and s["workers"], s["workers"]
+    st = next(iter(s["workers"].values()))
+    assert "owned" in st and "borrowed" in st
