@@ -30,6 +30,10 @@ def main():
     ap.add_argument("--no-shift", action="store_true")
     ap.add_argument("--fused-ce", action="store_true",
                     help="chunked fused lm-head+CE (ops/fused_ce.py)")
+    ap.add_argument("--opt-bf16", action="store_true",
+                    help="adamw first moment (mu) in bf16 — optax exposes "
+                         "no nu_dtype — so optimizer state drops 8 -> 6 "
+                         "bytes/param (~25% less optimizer HBM traffic)")
     args = ap.parse_args()
 
     import jax
@@ -51,7 +55,13 @@ def main():
                      fused_ce=args.fused_ce)
     dev = jax.devices()[0]
     mesh = make_mesh(MeshSpec(), devices=[dev])
-    ts = transformer_train_step(cfg, mesh, rules=RULES_DP,
+    opt = None
+    if args.opt_bf16:
+        import jax.numpy as jnp
+        import optax
+
+        opt = optax.adamw(3e-4, weight_decay=0.0, mu_dtype=jnp.bfloat16)
+    ts = transformer_train_step(cfg, mesh, rules=RULES_DP, optimizer=opt,
                                 shift_inputs=not args.no_shift)
     params, opt_state = ts.init(jax.random.key(0))
     tokens = np.random.default_rng(0).integers(
@@ -72,7 +82,7 @@ def main():
     mfu = tok_s * cfg.flops_per_token(args.seq) / peak_flops_per_chip()
     print(json.dumps({
         "batch": args.batch, "seq": args.seq, "policy": args.policy,
-        "fused_ce": args.fused_ce,
+        "fused_ce": args.fused_ce, "opt_bf16": args.opt_bf16,
         "block": args.block or None, "shift": not args.no_shift,
         "tok_s": round(tok_s, 1), "mfu": round(mfu, 4),
         "step_ms": round(dt / args.steps * 1e3, 2), "loss": round(final, 4),

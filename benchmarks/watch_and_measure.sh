@@ -43,7 +43,7 @@ run_one() {
 }
 
 all_done() {
-  for n in mfu_dots mfu_fused envelope vit rl; do
+  for n in mfu_dots mfu_fused mfu_fused_optbf16 envelope vit rl; do
     [ -f "$STATE/$n.done" ] || return 1
   done
   return 0
@@ -56,6 +56,8 @@ while ! all_done; do
     run_one mfu_dots 700 1 python benchmarks/mfu_one.py --batch 8 --seq 1024 --policy dots || { sleep 60; continue; }
     probe || continue
     run_one mfu_fused 1100 1 python benchmarks/mfu_one.py --batch 8 --seq 1024 --policy dots --fused-ce || { sleep 60; continue; }
+    probe || continue
+    run_one mfu_fused_optbf16 1100 1 python benchmarks/mfu_one.py --batch 8 --seq 1024 --policy dots --fused-ce --opt-bf16 || { sleep 60; continue; }
     probe || continue
     run_one envelope 900 1 python benchmarks/probe_model_envelope.py || { sleep 60; continue; }
     probe || continue

@@ -171,7 +171,7 @@ def cmd_memory(args) -> int:
     for wid, st in sorted(s.get("workers", {}).items()):
         print(f"worker {wid[:8]}: owned={st.get('owned', 0)} "
               f"borrowed={st.get('borrowed', 0)} pins={st.get('pins', 0)}")
-    rows = sorted(s["objects"], key=lambda o: -o["size"])[:args.limit]
+    rows = s["objects"]  # server-ranked largest-first, already truncated
     if rows:
         print(f"{'OBJECT':34} {'SIZE':>12} {'STORAGE':8} NODE")
         for o in rows:

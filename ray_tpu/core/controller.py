@@ -1796,17 +1796,13 @@ class Controller:
         limit = int(msg.get("limit", 1000))
         # Largest first BEFORE truncating: the memory-debugging view must
         # never drop the biggest objects to insertion order.
+        from .object_store import storage_kind
+
         ranked = sorted(self.objects.items(),
                         key=lambda kv: -kv[1].size)[:limit]
-        objs = []
-        for oid, loc in ranked:
-            storage = ("error" if loc.is_error else
-                       "inline" if loc.inline is not None else
-                       "spilled" if loc.spill_path else
-                       "arena" if loc.arena else
-                       "shm" if loc.shm_name else "?")
-            objs.append({"object_id": oid, "size": loc.size,
-                         "storage": storage, "node_id": loc.node_id})
+        objs = [{"object_id": oid, "size": loc.size,
+                 "storage": storage_kind(loc), "node_id": loc.node_id}
+                for oid, loc in ranked]
         arenas = {nid: n.arena_stats for nid, n in self.nodes.items()
                   if n.arena_stats}
         return {"objects": objs, "num_objects": len(self.objects),
@@ -1899,13 +1895,13 @@ class Controller:
                 for w in list(self.workers.values())[:limit]
             ]
         if what == "objects":
+            from .object_store import storage_kind
+
             return [
                 {
                     "object_id": oid,
                     "size": loc.size,
-                    "backend": ("inline" if loc.inline is not None
-                                else "spill" if loc.spill_path
-                                else "arena" if loc.arena else "shm"),
+                    "backend": storage_kind(loc),
                     "node_id": loc.node_id,
                     "is_error": loc.is_error,
                 }

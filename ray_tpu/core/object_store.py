@@ -486,6 +486,23 @@ def get_bytes_with_refresh(loc: ObjectLocation, object_id: str, request_fn):
         return get_bytes(loc), loc
 
 
+def storage_kind(loc: ObjectLocation) -> str:
+    """Canonical storage-backend label for observability surfaces (`rtpu
+    memory`, the state API): exactly one place decides the name of each
+    backend so the two views can never drift."""
+    if loc.is_error:
+        return "error"
+    if loc.inline is not None:
+        return "inline"
+    if loc.spill_path:
+        return "spilled"
+    if loc.arena:
+        return "arena"
+    if loc.shm_name:
+        return "shm"
+    return "?"
+
+
 def free_location(loc: ObjectLocation) -> None:
     """Free an object's storage, whichever backend holds it."""
     if loc.spill_path is not None:
