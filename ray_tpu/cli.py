@@ -181,6 +181,38 @@ def cmd_memory(args) -> int:
     return 0
 
 
+def cmd_serve(args) -> int:
+    """`rtpu serve run|status|shutdown` (reference: the `serve` CLI,
+    python/ray/serve/scripts.py — run imports `module:app`, deploys it
+    with the HTTP proxy, and blocks)."""
+    rt = _connect(args)
+    from ray_tpu import serve
+
+    try:
+        if args.serve_cmd == "run":
+            import importlib
+
+            mod_name, _, attr = args.target.partition(":")
+            sys.path.insert(0, os.getcwd())
+            app = getattr(importlib.import_module(mod_name), attr or "app")
+            print(f"serving {args.target} on :{args.port} (ctrl-c to stop)")
+            serve.run(app, _http=True, http_port=args.port, blocking=True)
+            return 0
+        if args.serve_cmd == "status":
+            st = serve.status()
+            print(json.dumps(st, indent=1, default=str) if st
+                  else "serve is not running")
+            return 0
+        if args.serve_cmd == "shutdown":
+            serve.shutdown()
+            print("serve shut down")
+            return 0
+        raise SystemExit(f"unknown serve subcommand {args.serve_cmd!r}")
+    finally:
+        if args.serve_cmd != "run":
+            rt.shutdown()
+
+
 def cmd_timeline(args) -> int:
     rt = _connect(args)
     from ray_tpu.util import state
@@ -354,6 +386,18 @@ def main(argv=None) -> int:
     p.add_argument("--address", default=None)
     p.add_argument("--limit", type=int, default=50)
     p.set_defaults(fn=cmd_memory)
+
+    p = sub.add_parser("serve", help="deploy/inspect Serve applications")
+    ssub = p.add_subparsers(dest="serve_cmd", required=True)
+    sr = ssub.add_parser("run", help="import module:app and serve it")
+    sr.add_argument("target")
+    sr.add_argument("--address", default=None)
+    sr.add_argument("--port", type=int, default=8000)
+    sr.set_defaults(fn=cmd_serve)
+    for name in ("status", "shutdown"):
+        sp = ssub.add_parser(name)
+        sp.add_argument("--address", default=None)
+        sp.set_defaults(fn=cmd_serve)
 
     p = sub.add_parser("dashboard", help="serve the web dashboard")
     p.add_argument("--address", default=None)

@@ -138,7 +138,15 @@ def delete(name: str) -> None:
 
 
 def status() -> Dict[str, Any]:
-    ctrl = _get_or_create_controller()
+    """Read-only: inspecting a cluster where serve was never started must
+    not create a controller actor as a side effect (reference `serve
+    status` reports not-running the same way)."""
+    if not ray_tpu.is_initialized():
+        ray_tpu.init(ignore_reinit_error=True)
+    try:
+        ctrl = ray_tpu.get_actor(CONTROLLER_NAME)
+    except Exception:
+        return {}
     names = ray_tpu.get(ctrl.get_deployment_names.remote())
     out = {}
     for n in names:
