@@ -200,8 +200,12 @@ def cmd_serve(args) -> int:
             return 0
         if args.serve_cmd == "status":
             st = serve.status()
-            print(json.dumps(st, indent=1, default=str) if st
-                  else "serve is not running")
+            if st is None:
+                print("serve is not running")
+            elif not st:
+                print("serve is running with no deployments")
+            else:
+                print(json.dumps(st, indent=1, default=str))
             return 0
         if args.serve_cmd == "shutdown":
             serve.shutdown()

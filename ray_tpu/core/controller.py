@@ -1907,6 +1907,21 @@ class Controller:
                 }
                 for oid, loc in list(self.objects.items())[:limit]
             ]
+        if what == "placement_groups":
+            return [
+                {
+                    "placement_group_id": pg.pg_id,
+                    "name": pg.name,
+                    "state": pg.state.upper(),
+                    "strategy": pg.strategy,
+                    "bundles": [
+                        {"bundle_index": i, "resources": dict(b.resources),
+                         "node_id": b.node_id}
+                        for i, b in enumerate(pg.bundles)
+                    ],
+                }
+                for pg in list(self.pgs.values())[:limit]
+            ]
         if what == "summary":
             counts: Dict[str, Dict[str, int]] = {}
             for ev in self._latest_task_events().values():

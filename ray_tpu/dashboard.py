@@ -257,7 +257,7 @@ class Dashboard:
             elif kind == "serve":
                 from ray_tpu.serve.api import status as serve_status
 
-                data = serve_status()
+                data = serve_status() or {}  # None = serve not running
             elif kind == "timeline":
                 data = state_api.timeline()
             elif kind == "profile":

@@ -28,17 +28,28 @@ _proxy: Optional[HTTPProxy] = None
 _grpc_proxy = None
 
 
-def _get_or_create_controller():
+def _get_controller_if_exists():
+    """The running controller actor, or None — never creates one and never
+    boots a cluster (read-only probes must stay side-effect free)."""
     if not ray_tpu.is_initialized():
-        ray_tpu.init(ignore_reinit_error=True)
+        return None
     try:
         return ray_tpu.get_actor(CONTROLLER_NAME)
     except Exception:
-        ctrl = ray_tpu.remote(ServeController).options(
-            name=CONTROLLER_NAME, num_cpus=0.1, max_concurrency=8).remote()
-        ray_tpu.get(ctrl.ping.remote())
-        atexit.register(shutdown)
+        return None
+
+
+def _get_or_create_controller():
+    if not ray_tpu.is_initialized():
+        ray_tpu.init(ignore_reinit_error=True)
+    ctrl = _get_controller_if_exists()
+    if ctrl is not None:
         return ctrl
+    ctrl = ray_tpu.remote(ServeController).options(
+        name=CONTROLLER_NAME, num_cpus=0.1, max_concurrency=8).remote()
+    ray_tpu.get(ctrl.ping.remote())
+    atexit.register(shutdown)
+    return ctrl
 
 
 def start(*, http_host: str = "127.0.0.1", http_port: int = 8000,
@@ -137,18 +148,16 @@ def delete(name: str) -> None:
     ray_tpu.get(ctrl.delete_deployment.remote(name))
 
 
-def status() -> Dict[str, Any]:
-    """Read-only: inspecting a cluster where serve was never started must
-    not create a controller actor as a side effect (reference `serve
-    status` reports not-running the same way)."""
-    if not ray_tpu.is_initialized():
-        ray_tpu.init(ignore_reinit_error=True)
-    try:
-        ctrl = ray_tpu.get_actor(CONTROLLER_NAME)
-    except Exception:
-        return {}
+def status() -> Optional[Dict[str, Any]]:
+    """Read-only: never creates a controller or boots a cluster. Returns
+    None when serve is not running (or no cluster is attached), {} when
+    serve runs with zero deployments — callers can tell the two apart
+    (reference `serve status` draws the same distinction)."""
+    ctrl = _get_controller_if_exists()
+    if ctrl is None:
+        return None
     names = ray_tpu.get(ctrl.get_deployment_names.remote())
-    out = {}
+    out: Dict[str, Any] = {}
     for n in names:
         version, reps = ray_tpu.get(ctrl.get_replicas.remote(n))
         out[n] = {"version": version, "num_replicas": len(reps)}
@@ -169,10 +178,10 @@ def shutdown() -> None:
         except Exception:
             pass
         _grpc_proxy = None
-    if not ray_tpu.is_initialized():
+    ctrl = _get_controller_if_exists()
+    if ctrl is None:
         return
     try:
-        ctrl = ray_tpu.get_actor(CONTROLLER_NAME)
         ray_tpu.get(ctrl.shutdown.remote(), timeout=15)
         ray_tpu.kill(ctrl)
     except Exception:

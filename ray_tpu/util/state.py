@@ -1,7 +1,7 @@
 """State observability API.
 
 Parity surface with the reference's state API + timeline export:
-- list_tasks/list_actors/list_nodes/list_workers/list_objects + summarize
+- list_tasks/actors/nodes/workers/objects/placement_groups + summarize
   (ray: python/ray/util/state/api.py:110, state_manager queries),
 - timeline() chrome-trace export (ray: GlobalState.chrome_tracing_dump,
   python/ray/_private/state.py:434) — open the file in chrome://tracing or
@@ -40,6 +40,13 @@ def list_workers(limit: int = 1000) -> List[Dict[str, Any]]:
 
 def list_objects(limit: int = 1000) -> List[Dict[str, Any]]:
     return _req({"kind": "list_state", "what": "objects", "limit": limit})
+
+
+def list_placement_groups(limit: int = 1000) -> List[Dict[str, Any]]:
+    """Reference: `ray list placement-groups` (util/state/api.py) — id,
+    name, state, strategy, and per-bundle resources/placement."""
+    return _req({"kind": "list_state", "what": "placement_groups",
+                 "limit": limit})
 
 
 def profile_workers(timeout: float = 2.0) -> Dict[str, Any]:

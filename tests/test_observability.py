@@ -289,3 +289,23 @@ def test_memory_summary(ray_start_regular):
     assert isinstance(s["workers"], dict) and s["workers"], s["workers"]
     st = next(iter(s["workers"].values()))
     assert "owned" in st and "borrowed" in st
+
+
+def test_list_placement_groups(ray_start_regular):
+    """State API lists placement groups with per-bundle placement
+    (reference: `ray list placement-groups`)."""
+    import ray_tpu
+    from ray_tpu.util import state
+
+    pg = ray_tpu.placement_group([{"CPU": 1}, {"CPU": 1}], strategy="PACK",
+                                 name="obs-pg")
+    assert pg.ready(timeout=60)
+    rows = state.list_placement_groups()
+    mine = [r for r in rows if r["name"] == "obs-pg"]
+    assert mine, rows
+    r = mine[0]
+    assert r["state"] == "READY" and r["strategy"] == "PACK"
+    assert len(r["bundles"]) == 2
+    assert all(b["resources"] == {"CPU": 1} for b in r["bundles"])
+    assert all(b["node_id"] for b in r["bundles"])
+    ray_tpu.remove_placement_group(pg)
