@@ -1,0 +1,5 @@
+from . import configs, transformer, vit
+from .generate import KVCache, decode_step, generate, prefill
+
+__all__ = ["configs", "transformer", "vit",
+           "KVCache", "decode_step", "generate", "prefill"]

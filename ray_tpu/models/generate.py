@@ -1,0 +1,173 @@
+"""Autoregressive decoding with a static-shape KV cache.
+
+The reference framework ships no model layer (SURVEY.md §5.7) — this is the
+TPU-first inference path its Serve/Data users would otherwise build by hand:
+
+- **Static shapes end to end**: the cache is allocated at `max_len` up
+  front; the decode loop is ONE `lax.scan` over step indices, so the whole
+  generation compiles once (no per-length recompiles, no dynamic shapes —
+  XLA's requirement, not a style choice).
+- **Prefill/decode split**: the prompt runs through the normal batched
+  forward (MXU-friendly [B, S] matmuls) capturing per-layer K/V; each
+  decode step is a [B, 1] pass attending over the cache (a dot against
+  cached keys — flash tiling buys nothing for a single query row).
+- **GQA-aware**: cached K/V keep `kv_heads`; query heads fold into groups
+  at the attention einsum exactly like ops/attention.py's training path.
+
+Layout: cache K/V are [L, B, max_len, KVH, hd] — layer-major so the decode
+scan over layers consumes them as `xs` alongside the stacked layer params.
+"""
+from __future__ import annotations
+
+from typing import Dict, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .transformer import (
+    TransformerConfig,
+    _layer_body_kv,
+    _mlp_block,
+    _norm,
+    _qkv_proj,
+    embed_tokens,
+    final_hidden_and_head,
+)
+
+Params = Dict[str, jax.Array]
+
+
+class KVCache(NamedTuple):
+    k: jax.Array  # [L, B, max_len, KVH, hd] (cfg.dtype)
+    v: jax.Array  # [L, B, max_len, KVH, hd]
+    pos: jax.Array  # [] int32 — tokens filled so far
+
+
+def prefill(params: Params, tokens: jax.Array, cfg: TransformerConfig,
+            max_len: int) -> Tuple[jax.Array, KVCache]:
+    """Run the prompt [B, S] through the batched forward, returning logits
+    for the LAST position [B, V] and the primed cache."""
+    B, S = tokens.shape
+    if S > max_len:
+        raise ValueError(f"prompt length {S} exceeds cache max_len {max_len}")
+    x = embed_tokens(params, tokens, cfg)
+    positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32)[None], (B, S))
+
+    def body(carry, layer):
+        x, k, v = _layer_body_kv(cfg, carry, layer, positions)
+        return x, (k, v)
+
+    x, (ks, vs) = jax.lax.scan(body, x, params["layers"])
+    # Pad [L, B, S, KVH, hd] out to the static max_len.
+    pad = [(0, 0), (0, 0), (0, max_len - S), (0, 0), (0, 0)]
+    cache = KVCache(
+        k=jnp.pad(ks, pad), v=jnp.pad(vs, pad),
+        pos=jnp.asarray(S, jnp.int32))
+    x, head = final_hidden_and_head(params, x[:, -1:], cfg)
+    logits = (x @ head).astype(jnp.float32)[:, 0]
+    return logits, cache
+
+
+def decode_step(params: Params, cache: KVCache, token: jax.Array,
+                cfg: TransformerConfig) -> Tuple[jax.Array, KVCache]:
+    """One token [B] int32 -> logits [B, V] + the cache advanced by one."""
+    if cfg.positional == "learned":
+        raise NotImplementedError(
+            "decode_step: learned positional embeddings index by absolute "
+            "position, which embed_tokens applies only for full sequences; "
+            "use rope (the flagship configs) for incremental decoding")
+    B = token.shape[0]
+    H, KVH, hd = cfg.n_heads, cfg.kv_heads, cfg.head_dim
+    max_len = cache.k.shape[2]
+    pos = cache.pos
+    # Overflow guard (eager callers only — the manual prefill/decode_step
+    # loop): under jit `pos` is traced and dynamic_update_slice would CLAMP
+    # the write to the last slot, silently overwriting it. generate() can't
+    # overflow (its scan length is sized against max_len); hand-rolled
+    # loops get the same contract as prefill's length check where possible.
+    try:
+        if int(pos) >= max_len:
+            raise ValueError(
+                f"decode_step: cache full (pos {int(pos)} >= max_len "
+                f"{max_len}); size prefill's max_len for the tokens you "
+                f"intend to generate")
+    except (jax.errors.TracerIntegerConversionError,
+            jax.errors.ConcretizationTypeError):
+        pass
+    x = embed_tokens(params, token[:, None], cfg)  # [B, 1, d]
+    positions = jnp.full((B, 1), pos, jnp.int32)
+    valid = (jnp.arange(max_len) <= pos)[None, None, None, :]  # [1,1,1,S]
+
+    def body(x, xs):
+        layer, ck, cv = xs  # ck/cv: [B, max_len, KVH, hd]
+        h = _norm(x, layer["attn_norm"], layer.get("attn_norm_b"), cfg.norm)
+        q, k, v = _qkv_proj(cfg, h, layer, positions)
+        ck = jax.lax.dynamic_update_slice(ck, k.astype(ck.dtype),
+                                          (0, pos, 0, 0))
+        cv = jax.lax.dynamic_update_slice(cv, v.astype(cv.dtype),
+                                          (0, pos, 0, 0))
+        # GQA: fold query heads into KVH groups of size G.
+        G = H // KVH
+        qg = q.reshape(B, 1, KVH, G, hd)
+        scores = jnp.einsum("bqkgh,bskh->bkgqs", qg.astype(jnp.float32),
+                            ck.astype(jnp.float32)) / (hd ** 0.5)
+        scores = jnp.where(valid[:, :, :, None, :], scores, -1e30)
+        probs = jax.nn.softmax(scores, axis=-1)
+        o = jnp.einsum("bkgqs,bskh->bqkgh", probs,
+                       cv.astype(jnp.float32)).astype(cfg.dtype)
+        o = o.reshape(B, 1, H * hd)
+        x = x + o @ layer["wo"].astype(cfg.dtype)
+
+        h = _norm(x, layer["mlp_norm"], layer.get("mlp_norm_b"), cfg.norm)
+        delta, _aux = _mlp_block(cfg, h, layer)
+        x = x + delta
+        return x, (ck, cv)
+
+    x, (nk, nv) = jax.lax.scan(body, x, (params["layers"], cache.k, cache.v))
+    x, head = final_hidden_and_head(params, x, cfg)
+    logits = (x @ head).astype(jnp.float32)[:, 0]
+    return logits, KVCache(k=nk, v=nv, pos=pos + 1)
+
+
+def generate(params: Params, tokens: jax.Array, cfg: TransformerConfig,
+             max_new_tokens: int, *, temperature: float = 0.0,
+             top_k: int = 0, rng: Optional[jax.Array] = None,
+             eos_id: Optional[int] = None) -> jax.Array:
+    """Greedy (temperature=0) or sampled continuation of `tokens` [B, S] ->
+    [B, S + max_new_tokens]. Once a row emits `eos_id` it keeps repeating
+    it (the static output shape never changes — consumers mask on eos).
+    jit-able as a whole; the step loop is a lax.scan."""
+    B, S = tokens.shape
+    max_len = S + max_new_tokens
+    logits, cache = prefill(params, tokens, cfg, max_len)
+    if rng is None:
+        rng = jax.random.key(0)
+
+    def pick(logits, step_rng):
+        if temperature <= 0.0:
+            return jnp.argmax(logits, -1).astype(jnp.int32)
+        scaled = logits / jnp.maximum(temperature, 1e-6)
+        if top_k:
+            kth = jax.lax.top_k(scaled, top_k)[0][:, -1:]  # O(V log k)
+            scaled = jnp.where(scaled < kth, -1e30, scaled)
+        return jax.random.categorical(step_rng, scaled).astype(jnp.int32)
+
+    rng, r0 = jax.random.split(rng)
+    first = pick(logits, r0)
+    # The first generated token may itself be eos — done0 reflects it.
+    done0 = jnp.zeros((B,), bool) if eos_id is None else first == eos_id
+
+    def step(carry, step_rng):
+        cache, tok, done = carry
+        logits, cache = decode_step(params, cache, tok, cfg)
+        nxt = pick(logits, step_rng)
+        if eos_id is not None:
+            nxt = jnp.where(done, eos_id, nxt)
+            done = done | (nxt == eos_id)
+        return (cache, nxt, done), nxt
+
+    keys = jax.random.split(rng, max(max_new_tokens - 1, 0))
+    (_, _, _), rest = jax.lax.scan(step, (cache, first, done0), keys)
+    out = jnp.concatenate(
+        [tokens, first[:, None], rest.T.astype(tokens.dtype)], axis=1)
+    return out[:, :max_len]

@@ -273,11 +273,10 @@ def _rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
     return out.astype(x.dtype)
 
 
-def _layer_body(cfg: TransformerConfig, x: jax.Array, layer: Params, positions: jax.Array):
-    B, S, d = x.shape
-    H, KVH, hd = cfg.n_heads, cfg.kv_heads, cfg.head_dim
-
-    h = _norm(x, layer["attn_norm"], layer.get("attn_norm_b"), cfg.norm)
+def _qkv_proj(cfg: TransformerConfig, h: jax.Array, layer: Params,
+              positions: jax.Array):
+    """Projection + rope shared by training forward and KV-cache decode
+    (models/generate.py) — ONE home for the layer's q/k/v convention."""
     if "wqkv" in layer:
         qkv = jnp.einsum("bsd,dcnh->bscnh", h, layer["wqkv"].astype(cfg.dtype))
         qkv = checkpoint_name(qkv, "qkv_proj")
@@ -290,34 +289,58 @@ def _layer_body(cfg: TransformerConfig, x: jax.Array, layer: Params, positions: 
     if cfg.positional == "rope":
         q = _rope(q, positions, cfg.rope_theta)
         k = _rope(k, positions, cfg.rope_theta)
+    return q, k, v
+
+
+def _mlp_block(cfg: TransformerConfig, h: jax.Array, layer: Params):
+    """Post-attention FFN (moe / swiglu / gelu), shared with the decode
+    path; returns (delta, moe_aux)."""
+    if cfg.moe_num_experts:
+        from ray_tpu.ops.moe import moe_ffn
+
+        return moe_ffn(
+            h, layer["router"], layer["moe_w_gate_up"], layer["moe_w_down"],
+            experts_per_token=cfg.moe_experts_per_token,
+            capacity_factor=cfg.moe_capacity_factor,
+            dtype=cfg.dtype)
+    aux = jnp.zeros((), jnp.float32)
+    if cfg.activation == "swiglu":
+        gu = jnp.einsum("bsd,dcf->bscf", h, layer["w_gate_up"].astype(cfg.dtype))
+        gu = checkpoint_name(gu, "gate_up")
+        act = jax.nn.silu(gu[:, :, 0]) * gu[:, :, 1]
+        return act @ layer["w_down"].astype(cfg.dtype), aux
+    act = checkpoint_name(h @ layer["w_up"].astype(cfg.dtype), "gate_up")
+    act = jax.nn.gelu(act)
+    return act @ layer["w_down"].astype(cfg.dtype), aux
+
+
+def _layer_body(cfg: TransformerConfig, x: jax.Array, layer: Params,
+                positions: jax.Array, return_kv: bool = False):
+    B, S, d = x.shape
+    H, KVH, hd = cfg.n_heads, cfg.kv_heads, cfg.head_dim
+
+    h = _norm(x, layer["attn_norm"], layer.get("attn_norm_b"), cfg.norm)
+    q, k, v = _qkv_proj(cfg, h, layer, positions)
     q = maybe_constrain(q, ("batch", "seq_act", "heads", None))
     o = checkpoint_name(attention(q, k, v, causal=True), "attn_out")
     x = x + o.reshape(B, S, H * hd) @ layer["wo"].astype(cfg.dtype)
     x = maybe_constrain(x, ("batch", "seq_act", "embed"))
 
     h = _norm(x, layer["mlp_norm"], layer.get("mlp_norm_b"), cfg.norm)
-    aux = jnp.zeros((), jnp.float32)
-    if cfg.moe_num_experts:
-        from ray_tpu.ops.moe import moe_ffn
-
-        moe_out, aux = moe_ffn(
-            h, layer["router"], layer["moe_w_gate_up"], layer["moe_w_down"],
-            experts_per_token=cfg.moe_experts_per_token,
-            capacity_factor=cfg.moe_capacity_factor,
-            dtype=cfg.dtype)
-        x = x + moe_out
-    elif cfg.activation == "swiglu":
-        gu = jnp.einsum("bsd,dcf->bscf", h, layer["w_gate_up"].astype(cfg.dtype))
-        gu = checkpoint_name(gu, "gate_up")
-        act = jax.nn.silu(gu[:, :, 0]) * gu[:, :, 1]
-        x = x + act @ layer["w_down"].astype(cfg.dtype)
-    else:
-        act = checkpoint_name(
-            h @ layer["w_up"].astype(cfg.dtype), "gate_up")
-        act = jax.nn.gelu(act)
-        x = x + act @ layer["w_down"].astype(cfg.dtype)
+    delta, aux = _mlp_block(cfg, h, layer)
+    x = x + delta
     x = maybe_constrain(x, ("batch", "seq_act", "embed"))
+    if return_kv:
+        return x, aux, k, v
     return x, aux
+
+
+def _layer_body_kv(cfg: TransformerConfig, x: jax.Array, layer: Params,
+                   positions: jax.Array):
+    """Layer forward that also surfaces this layer's (roped) K/V — the
+    prefill path of models/generate.py primes its cache from these."""
+    x, _aux, k, v = _layer_body(cfg, x, layer, positions, return_kv=True)
+    return x, k, v
 
 
 def embed_tokens(params: Params, tokens: jax.Array, cfg: TransformerConfig) -> jax.Array:
