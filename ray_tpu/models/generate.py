@@ -136,21 +136,33 @@ def generate(params: Params, tokens: jax.Array, cfg: TransformerConfig,
     """Greedy (temperature=0) or sampled continuation of `tokens` [B, S] ->
     [B, S + max_new_tokens]. Once a row emits `eos_id` it keeps repeating
     it (the static output shape never changes — consumers mask on eos).
-    jit-able as a whole; the step loop is a lax.scan."""
+    jit-able as a whole; the step loop is a lax.scan. `temperature` may be
+    a traced jax scalar (serving passes client values without recompiles);
+    a Python float stays static and compiles only its branch."""
     B, S = tokens.shape
     max_len = S + max_new_tokens
     logits, cache = prefill(params, tokens, cfg, max_len)
     if rng is None:
         rng = jax.random.key(0)
 
+    static_temp = isinstance(temperature, (int, float))
+
     def pick(logits, step_rng):
-        if temperature <= 0.0:
-            return jnp.argmax(logits, -1).astype(jnp.int32)
+        # `temperature` may be a TRACED scalar (a serving path must not
+        # recompile per client-supplied float): then both branches compute
+        # and a where() selects. A static Python float keeps the one-branch
+        # program.
+        greedy = jnp.argmax(logits, -1).astype(jnp.int32)
+        if static_temp and temperature <= 0.0:
+            return greedy
         scaled = logits / jnp.maximum(temperature, 1e-6)
         if top_k:
             kth = jax.lax.top_k(scaled, top_k)[0][:, -1:]  # O(V log k)
             scaled = jnp.where(scaled < kth, -1e30, scaled)
-        return jax.random.categorical(step_rng, scaled).astype(jnp.int32)
+        sampled = jax.random.categorical(step_rng, scaled).astype(jnp.int32)
+        if static_temp:
+            return sampled
+        return jnp.where(temperature <= 0.0, greedy, sampled)
 
     rng, r0 = jax.random.split(rng)
     first = pick(logits, r0)

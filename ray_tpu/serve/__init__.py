@@ -8,12 +8,14 @@ Parity map (reference python/ray/serve/, SURVEY.md §2.6):
 - HTTP proxy (ASGI)                     -> proxy.py
 - @serve.batch                          -> batching.py
 - serve.run/start/delete/status         -> api.py
+- LLM deployment over models.generate    -> llm.py
 """
 from .api import (delete, get_app_handle, get_deployment_handle, run,
                   shutdown, start, status)
 from .batching import batch
 from .multiplex import get_multiplexed_model_id, multiplexed
 from .deployment import Application, AutoscalingConfig, Deployment, deployment
+from .llm import build_llm_deployment
 from .handle import (DeploymentHandle, DeploymentResponse,
                      DeploymentStreamingResponse)
 
@@ -35,4 +37,5 @@ __all__ = [
     "get_app_handle",
     "get_deployment_handle",
     "batch",
+    "build_llm_deployment",
 ]

@@ -1,0 +1,124 @@
+"""LLM serving deployment: models/generate.py behind Serve batching.
+
+The reference serves LLMs by hosting external engines; here the framework's
+own model layer IS the engine, so the deployment is thin and TPU-shaped:
+
+- requests batch via @serve.batch, group by exact prompt length (see
+  build_llm_deployment's docstring for why padding prompts is wrong),
+  pad only the batch dimension, and run ONE jitted generate() per
+  length — static shapes, so each length compiles once and is reused;
+- the replica reserves chips with num_tpus like any other TPU actor, so
+  the Data/Train/Serve stacks share one accelerator accounting scheme.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from . import batching
+from .deployment import deployment
+
+
+def build_llm_deployment(cfg, params_factory, *, name: str = "llm",
+                         max_batch_size: int = 4,
+                         batch_wait_timeout_s: float = 0.05,
+                         max_prompt_len: int = 256,
+                         max_new_tokens: int = 64,
+                         pad_id: int = 0,
+                         num_replicas: int = 1,
+                         num_tpus: Optional[int] = None):
+    """A Serve deployment class generating continuations for
+    {"tokens": [...], optional "max_new_tokens", "temperature"} requests.
+
+    `params_factory` is a zero-arg picklable callable returning the model
+    params ON THE REPLICA (load from a checkpoint path, don't ship arrays
+    through the deployment config).
+
+    Batching correctness: prompts are grouped by EXACT length inside each
+    batch — padding a prompt would shift rope positions and let pad
+    tokens leak into attention (the flash path has no key-padding mask).
+    Rows are independent, so each length group pads its BATCH dim to
+    max_batch_size (junk rows dropped after), meaning the jitted scan
+    compiles once per distinct prompt length, not per batch composition.
+    Returns the deployment (call .bind() to serve)."""
+    import functools
+
+    @deployment(name=name, num_replicas=num_replicas,
+                ray_actor_options=(
+                    {"num_tpus": num_tpus} if num_tpus else None))
+    class LLM:
+        def __init__(self):
+            import os
+
+            import jax
+
+            from ray_tpu.models.generate import generate
+
+            self._params = params_factory()
+            # Distinct stream per replica: key(0) everywhere would make
+            # replicas sample bit-identical continuations.
+            self._rng = jax.random.key(
+                int.from_bytes(os.urandom(4), "little"))
+
+            # temperature rides as a TRACED scalar — client-supplied floats
+            # must not trigger a recompile per value (generate() selects
+            # greedy-vs-sampled with a where when temperature is traced).
+            @jax.jit
+            def _gen(params, tokens, rng, temperature):
+                return generate(
+                    params, tokens, cfg, max_new_tokens=max_new_tokens,
+                    temperature=temperature, rng=rng)
+
+            self._gen = _gen
+
+        @batching.batch(max_batch_size=max_batch_size,
+                        batch_wait_timeout_s=batch_wait_timeout_s)
+        def _generate_batch(self, requests: List[Dict[str, Any]]):
+            import jax
+
+            # Per-request validation: one malformed request must answer
+            # with its own error, never poison the coalesced batch.
+            # Groups key on (length, temperature) — same-length requests
+            # with different sampling must not inherit the leader's.
+            groups: Dict[tuple, List[int]] = {}
+            prompts: List[Optional[np.ndarray]] = []
+            results: List[Optional[Dict[str, Any]]] = [None] * len(requests)
+            for i, req in enumerate(requests):
+                try:
+                    ids = np.asarray(req["tokens"], np.int32)
+                    if ids.ndim != 1 or ids.size == 0:
+                        raise ValueError("tokens must be a non-empty 1-D "
+                                         "integer list")
+                    temp = float(req.get("temperature", 0.0))
+                except Exception as e:
+                    prompts.append(None)
+                    results[i] = {"error": f"bad request: {e}"}
+                    continue
+                ids = ids[-max_prompt_len:]
+                prompts.append(ids)
+                groups.setdefault((len(ids), temp), []).append(i)
+            for (L, temp), idxs in groups.items():
+                toks = np.full((max_batch_size, L), pad_id, np.int32)
+                for row, i in enumerate(idxs):
+                    toks[row] = prompts[i]
+                self._rng, sub = jax.random.split(self._rng)
+                out = np.asarray(self._gen(
+                    self._params, toks, sub, np.float32(temp)))
+                for row, i in enumerate(idxs):
+                    want = int(requests[i].get("max_new_tokens",
+                                               max_new_tokens))
+                    n = min(want, max_new_tokens)
+                    res = {"tokens": [int(t) for t in out[row, L:L + n]]}
+                    if want > max_new_tokens:
+                        # Signal the cap instead of silently truncating.
+                        res["max_new_tokens_capped"] = max_new_tokens
+                    results[i] = res
+            return results
+
+        def __call__(self, request: Dict[str, Any]) -> Dict[str, Any]:
+            if not isinstance(request, dict) or "tokens" not in request:
+                return {"error": "expected {'tokens': [...]} request body"}
+            return self._generate_batch(request)
+
+    return LLM

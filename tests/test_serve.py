@@ -283,3 +283,72 @@ def test_grpc_ingress(serve_instance):
                                        timeout=30)]
     assert items == [{"i": 0}, {"i": 1}, {"i": 2}]
     ch.close()
+
+
+def test_llm_deployment_serves_generation(ray_start_regular):
+    """build_llm_deployment: batched KV-cache generation behind Serve;
+    greedy results must match direct generate() for each prompt length."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    import ray_tpu
+    from ray_tpu import serve
+    from ray_tpu.models import generate as gen_fn
+    from ray_tpu.models import transformer as tfm
+    from ray_tpu.models.configs import llama_tiny
+    from ray_tpu.serve.llm import build_llm_deployment
+
+    cfg = llama_tiny(remat=False)
+
+    def factory(seed=0):
+        return tfm.init_params(jax.random.key(seed), cfg)
+
+    LLM = build_llm_deployment(
+        cfg, factory, name="tiny-llm", max_batch_size=3,
+        max_prompt_len=16, max_new_tokens=4)
+    handle = serve.run(LLM.bind())
+    try:
+        prompts = [[5, 9, 2], [7, 1, 3], [4, 4, 8, 8, 1]]  # two lengths
+        refs = [handle.remote({"tokens": p}) for p in prompts]
+        outs = [r.result(timeout=120) for r in refs]
+        params = factory()
+        for p, out in zip(prompts, outs):
+            toks = jnp.asarray([p], jnp.int32)
+            exp = gen_fn(params, toks, cfg, max_new_tokens=4)
+            assert out["tokens"] == [int(t) for t in
+                                     np.asarray(exp)[0, len(p):]], (p, out)
+    finally:
+        serve.shutdown()
+
+
+def test_llm_deployment_error_isolation_and_cap(ray_start_regular):
+    """A malformed request answers with its own error without poisoning
+    the batch; oversized max_new_tokens is capped with a signal."""
+    import jax
+
+    from ray_tpu import serve
+    from ray_tpu.models import transformer as tfm
+    from ray_tpu.models.configs import llama_tiny
+    from ray_tpu.serve.llm import build_llm_deployment
+
+    cfg = llama_tiny(remat=False)
+
+    def factory():
+        return tfm.init_params(jax.random.key(0), cfg)
+
+    LLM = build_llm_deployment(cfg, factory, name="tiny-llm2",
+                               max_batch_size=3, max_prompt_len=8,
+                               max_new_tokens=3, batch_wait_timeout_s=0.2)
+    handle = serve.run(LLM.bind())
+    try:
+        refs = [handle.remote({"tokens": [1, 2, 3]}),
+                handle.remote({"tokens": []}),
+                handle.remote({"tokens": [4, 5], "max_new_tokens": 99})]
+        good, bad, capped = [r.result(timeout=120) for r in refs]
+        assert len(good["tokens"]) == 3 and "error" not in good
+        assert "error" in bad
+        assert capped["max_new_tokens_capped"] == 3
+        assert len(capped["tokens"]) == 3
+    finally:
+        serve.shutdown()
