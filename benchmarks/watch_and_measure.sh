@@ -43,7 +43,7 @@ run_one() {
 }
 
 all_done() {
-  for n in mfu_dots mfu_fused mfu_fused_optbf16 envelope vit rl; do
+  for n in mfu_dots mfu_fused mfu_fused_optbf16 envelope vit rl decode; do
     [ -f "$STATE/$n.done" ] || return 1
   done
   return 0
@@ -64,6 +64,8 @@ while ! all_done; do
     run_one vit 700 0 python benchmarks/vit_infer.py || { sleep 60; continue; }
     probe || continue
     run_one rl 900 0 python benchmarks/rl_perf.py || { sleep 60; continue; }
+    probe || continue
+    run_one decode 900 1 python benchmarks/decode_bench.py || { sleep 60; continue; }
   else
     log "tunnel down"
   fi

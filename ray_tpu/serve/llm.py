@@ -42,8 +42,6 @@ def build_llm_deployment(cfg, params_factory, *, name: str = "llm",
     max_batch_size (junk rows dropped after), meaning the jitted scan
     compiles once per distinct prompt length, not per batch composition.
     Returns the deployment (call .bind() to serve)."""
-    import functools
-
     @deployment(name=name, num_replicas=num_replicas,
                 ray_actor_options=(
                     {"num_tpus": num_tpus} if num_tpus else None))
@@ -84,6 +82,8 @@ def build_llm_deployment(cfg, params_factory, *, name: str = "llm",
             groups: Dict[tuple, List[int]] = {}
             prompts: List[Optional[np.ndarray]] = []
             results: List[Optional[Dict[str, Any]]] = [None] * len(requests)
+            wants: List[int] = [0] * len(requests)
+            truncated: List[bool] = [False] * len(requests)
             for i, req in enumerate(requests):
                 try:
                     ids = np.asarray(req["tokens"], np.int32)
@@ -91,10 +91,15 @@ def build_llm_deployment(cfg, params_factory, *, name: str = "llm",
                         raise ValueError("tokens must be a non-empty 1-D "
                                          "integer list")
                     temp = float(req.get("temperature", 0.0))
+                    want = int(req.get("max_new_tokens", max_new_tokens))
+                    if want <= 0:
+                        raise ValueError("max_new_tokens must be positive")
                 except Exception as e:
                     prompts.append(None)
                     results[i] = {"error": f"bad request: {e}"}
                     continue
+                wants[i] = want
+                truncated[i] = len(ids) > max_prompt_len
                 ids = ids[-max_prompt_len:]
                 prompts.append(ids)
                 groups.setdefault((len(ids), temp), []).append(i)
@@ -106,13 +111,14 @@ def build_llm_deployment(cfg, params_factory, *, name: str = "llm",
                 out = np.asarray(self._gen(
                     self._params, toks, sub, np.float32(temp)))
                 for row, i in enumerate(idxs):
-                    want = int(requests[i].get("max_new_tokens",
-                                               max_new_tokens))
-                    n = min(want, max_new_tokens)
+                    n = min(wants[i], max_new_tokens)
                     res = {"tokens": [int(t) for t in out[row, L:L + n]]}
-                    if want > max_new_tokens:
-                        # Signal the cap instead of silently truncating.
+                    if wants[i] > max_new_tokens:
+                        # Signal caps/truncation instead of silently
+                        # degrading the answer.
                         res["max_new_tokens_capped"] = max_new_tokens
+                    if truncated[i]:
+                        res["prompt_truncated_to"] = max_prompt_len
                     results[i] = res
             return results
 

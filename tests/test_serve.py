@@ -352,3 +352,29 @@ def test_llm_deployment_error_isolation_and_cap(ray_start_regular):
         assert len(capped["tokens"]) == 3
     finally:
         serve.shutdown()
+
+
+def test_llm_bad_max_new_tokens_and_prompt_truncation(ray_start_regular):
+    import jax
+
+    from ray_tpu import serve
+    from ray_tpu.models import transformer as tfm
+    from ray_tpu.models.configs import llama_tiny
+    from ray_tpu.serve.llm import build_llm_deployment
+
+    cfg = llama_tiny(remat=False)
+    LLM = build_llm_deployment(
+        cfg, lambda: tfm.init_params(jax.random.key(0), cfg),
+        name="tiny-llm3", max_batch_size=3, max_prompt_len=4,
+        max_new_tokens=2, batch_wait_timeout_s=0.2)
+    handle = serve.run(LLM.bind())
+    try:
+        refs = [handle.remote({"tokens": [1, 2], "max_new_tokens": "lots"}),
+                handle.remote({"tokens": [3, 4]}),
+                handle.remote({"tokens": [9, 9, 9, 9, 9, 9]})]  # > 4
+        bad, good, trunc = [r.result(timeout=120) for r in refs]
+        assert "error" in bad  # its own error, batch not poisoned:
+        assert good["tokens"] and "error" not in good
+        assert trunc["prompt_truncated_to"] == 4
+    finally:
+        serve.shutdown()
