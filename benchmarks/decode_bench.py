@@ -26,6 +26,10 @@ def main():
     ap.add_argument("--prompt", type=int, default=128)
     ap.add_argument("--new", type=int, default=128)
     ap.add_argument("--reps", type=int, default=3)
+    ap.add_argument("--int8", action="store_true",
+                    help="weight-only int8 layer weights "
+                         "(models/quantize.py): ~halves the bytes each "
+                         "decode step streams from HBM")
     args = ap.parse_args()
 
     import jax
@@ -38,7 +42,12 @@ def main():
 
     cfg = bench_350m(remat=False)
     dev = jax.devices()[0]
-    params = jax.device_put(tfm.init_params(jax.random.key(0), cfg))
+    params = tfm.init_params(jax.random.key(0), cfg)
+    if args.int8:
+        from ray_tpu.models.quantize import quantize_params_int8
+
+        params = quantize_params_int8(params)
+    params = jax.device_put(params)
     tokens = jnp.asarray(np.random.default_rng(0).integers(
         0, cfg.vocab_size, (args.batch, args.prompt), np.int32))
 
@@ -69,6 +78,7 @@ def main():
         "per_token_ms": round(decode_s / args.new * 1e3, 3),
         "prefill_ms": round(prefill_s * 1e3, 1),
         "wall_s": round(best, 3),
+        "int8": args.int8,
         "platform": dev.platform,
     }), flush=True)
 

@@ -43,7 +43,7 @@ run_one() {
 }
 
 all_done() {
-  for n in mfu_dots mfu_fused mfu_fused_optbf16 envelope vit rl decode; do
+  for n in mfu_dots mfu_fused mfu_fused_optbf16 envelope vit rl decode decode_int8; do
     [ -f "$STATE/$n.done" ] || return 1
   done
   return 0
@@ -66,6 +66,8 @@ while ! all_done; do
     run_one rl 900 0 python benchmarks/rl_perf.py || { sleep 60; continue; }
     probe || continue
     run_one decode 900 1 python benchmarks/decode_bench.py || { sleep 60; continue; }
+    probe || continue
+    run_one decode_int8 900 1 python benchmarks/decode_bench.py --int8 || { sleep 60; continue; }
   else
     log "tunnel down"
   fi

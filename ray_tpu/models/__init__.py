@@ -1,5 +1,7 @@
 from . import configs, transformer, vit
 from .generate import KVCache, decode_step, generate, prefill
+from .quantize import quantize_params_int8
 
 __all__ = ["configs", "transformer", "vit",
-           "KVCache", "decode_step", "generate", "prefill"]
+           "KVCache", "decode_step", "generate", "prefill",
+           "quantize_params_int8"]

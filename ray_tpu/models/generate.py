@@ -30,6 +30,7 @@ from .transformer import (
     _mlp_block,
     _norm,
     _qkv_proj,
+    _w,
     embed_tokens,
     final_hidden_and_head,
 )
@@ -116,7 +117,7 @@ def decode_step(params: Params, cache: KVCache, token: jax.Array,
         o = jnp.einsum("bkgqs,bskh->bqkgh", probs,
                        cv.astype(jnp.float32)).astype(cfg.dtype)
         o = o.reshape(B, 1, H * hd)
-        x = x + o @ layer["wo"].astype(cfg.dtype)
+        x = x + o @ _w(layer, "wo", cfg)
 
         h = _norm(x, layer["mlp_norm"], layer.get("mlp_norm_b"), cfg.norm)
         delta, _aux = _mlp_block(cfg, h, layer)

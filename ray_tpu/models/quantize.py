@@ -1,0 +1,82 @@
+"""Weight-only int8 quantization for inference.
+
+Decode is HBM-bandwidth-bound (every step reads all parameters once:
+models/generate.py docstring), so storing layer weights as int8 with
+per-output-channel bf16 scales nearly halves the bytes each decode step
+streams — XLA fuses the `q * scale` dequant into the matmul's operand
+read, so there is no materialized bf16 copy.
+
+Scheme: symmetric absmax per OUTPUT channel — for a weight of shape
+[d_in, ...out], the scale has shape [...out] (reduction over d_in), so the
+worst-case relative error per channel is 1/127. Activations stay bf16
+(weight-only), which preserves the training forward untouched: the layer
+helpers (transformer._qkv_proj/_mlp_block) dequantize transparently when a
+`<name>_q8_scale` sibling is present.
+
+The embedding/lm-head stay unquantized in v1: the (tied) table feeds BOTH
+the token gather and the head matmul, and gather output quality is far
+more scale-sensitive than the FFN mats.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Iterable
+
+import jax
+import jax.numpy as jnp
+
+Params = Dict[str, Any]
+
+# Per-layer weights worth quantizing (the stacked [L, ...] leaves).
+DEFAULT_NAMES = ("wqkv", "wq", "wkv", "wo", "w_gate_up", "w_up", "w_down")
+
+SCALE_SUFFIX = "_q8_scale"
+
+
+def _quantize_leaf(w: jax.Array) -> tuple:
+    """[d_in, ...out] -> (int8 [same shape], scale [1, ...out] f32).
+
+    The scale KEEPS the reduced d_in axis as size 1, so `q * scale`
+    broadcasts identically whether the caller holds the stacked
+    [L, d_in, ...out] tree leaf (scale [L, 1, ...out]) or one layer's
+    slice inside a lax.scan (scale [1, ...out])."""
+    absmax = jnp.max(jnp.abs(w.astype(jnp.float32)), axis=0, keepdims=True)
+    scale = jnp.where(absmax > 0, absmax / 127.0, 1.0)
+    q = jnp.clip(jnp.round(w.astype(jnp.float32) / scale), -127, 127)
+    return q.astype(jnp.int8), scale.astype(jnp.float32)
+
+
+def quantize_params_int8(params: Params,
+                         names: Iterable[str] = DEFAULT_NAMES) -> Params:
+    """Same tree with each named layer weight replaced by int8 plus a
+    `<name>_q8_scale` sibling. Layer weights are stacked [L, ...]; the
+    scale keeps the leading L so each layer dequantizes with its own
+    channels."""
+    out = dict(params)
+    layers = dict(params["layers"])
+    for name in names:
+        w = layers.get(name)
+        if w is None:
+            continue
+        if w.dtype == jnp.int8 or name + SCALE_SUFFIX in layers:
+            # Already quantized: re-quantizing would compute absmax over
+            # the int8 CODES (~127), overwrite the real scale with ~1.0,
+            # and silently corrupt every channel. Idempotent skip.
+            continue
+        q, scale = jax.vmap(_quantize_leaf)(w)  # map over the L axis
+        layers[name] = q
+        layers[name + SCALE_SUFFIX] = scale
+    out["layers"] = layers
+    return out
+
+
+def maybe_dequant(layer: Params, name: str, dtype) -> jax.Array:
+    """The layer weight in compute dtype, dequantizing if quantized —
+    THE access path transformer's layer helpers use for every weight."""
+    w = layer[name]
+    scale = layer.get(name + SCALE_SUFFIX)
+    if scale is None:
+        return w.astype(dtype)
+    # The scale carries a size-1 d_in axis (see _quantize_leaf), so this
+    # broadcast is layout-agnostic; XLA fuses it into the consuming
+    # matmul's operand read (no bf16 copy in HBM).
+    return (w.astype(jnp.float32) * scale).astype(dtype)

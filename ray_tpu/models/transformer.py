@@ -273,17 +273,26 @@ def _rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
     return out.astype(x.dtype)
 
 
+def _w(layer: Params, name: str, cfg: TransformerConfig) -> jax.Array:
+    """Weight access for the layer helpers: compute-dtype view,
+    transparently dequantizing int8 weight-only params
+    (models/quantize.py) when a scale sibling is present."""
+    from .quantize import maybe_dequant
+
+    return maybe_dequant(layer, name, cfg.dtype)
+
+
 def _qkv_proj(cfg: TransformerConfig, h: jax.Array, layer: Params,
               positions: jax.Array):
     """Projection + rope shared by training forward and KV-cache decode
     (models/generate.py) — ONE home for the layer's q/k/v convention."""
     if "wqkv" in layer:
-        qkv = jnp.einsum("bsd,dcnh->bscnh", h, layer["wqkv"].astype(cfg.dtype))
+        qkv = jnp.einsum("bsd,dcnh->bscnh", h, _w(layer, "wqkv", cfg))
         qkv = checkpoint_name(qkv, "qkv_proj")
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
     else:
-        q = jnp.einsum("bsd,dnh->bsnh", h, layer["wq"].astype(cfg.dtype))
-        kv = jnp.einsum("bsd,dcnh->bscnh", h, layer["wkv"].astype(cfg.dtype))
+        q = jnp.einsum("bsd,dnh->bsnh", h, _w(layer, "wq", cfg))
+        kv = jnp.einsum("bsd,dcnh->bscnh", h, _w(layer, "wkv", cfg))
         kv = checkpoint_name(kv, "qkv_proj")
         k, v = kv[:, :, 0], kv[:, :, 1]
     if cfg.positional == "rope":
@@ -305,13 +314,13 @@ def _mlp_block(cfg: TransformerConfig, h: jax.Array, layer: Params):
             dtype=cfg.dtype)
     aux = jnp.zeros((), jnp.float32)
     if cfg.activation == "swiglu":
-        gu = jnp.einsum("bsd,dcf->bscf", h, layer["w_gate_up"].astype(cfg.dtype))
+        gu = jnp.einsum("bsd,dcf->bscf", h, _w(layer, "w_gate_up", cfg))
         gu = checkpoint_name(gu, "gate_up")
         act = jax.nn.silu(gu[:, :, 0]) * gu[:, :, 1]
-        return act @ layer["w_down"].astype(cfg.dtype), aux
-    act = checkpoint_name(h @ layer["w_up"].astype(cfg.dtype), "gate_up")
+        return act @ _w(layer, "w_down", cfg), aux
+    act = checkpoint_name(h @ _w(layer, "w_up", cfg), "gate_up")
     act = jax.nn.gelu(act)
-    return act @ layer["w_down"].astype(cfg.dtype), aux
+    return act @ _w(layer, "w_down", cfg), aux
 
 
 def _layer_body(cfg: TransformerConfig, x: jax.Array, layer: Params,
@@ -323,7 +332,7 @@ def _layer_body(cfg: TransformerConfig, x: jax.Array, layer: Params,
     q, k, v = _qkv_proj(cfg, h, layer, positions)
     q = maybe_constrain(q, ("batch", "seq_act", "heads", None))
     o = checkpoint_name(attention(q, k, v, causal=True), "attn_out")
-    x = x + o.reshape(B, S, H * hd) @ layer["wo"].astype(cfg.dtype)
+    x = x + o.reshape(B, S, H * hd) @ _w(layer, "wo", cfg)
     x = maybe_constrain(x, ("batch", "seq_act", "embed"))
 
     h = _norm(x, layer["mlp_norm"], layer.get("mlp_norm_b"), cfg.norm)

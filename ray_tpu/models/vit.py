@@ -158,15 +158,20 @@ def forward(params: Params, images: jax.Array, cfg: ViTConfig) -> jax.Array:
     x = maybe_constrain(x, ("batch", None, "embed"))
 
     def block(h, layer):
+        # Weight access via maybe_dequant: int8 weight-only quantized
+        # params (models/quantize.py) work for ViT batch inference the
+        # same way they do for transformer decoding.
+        from .quantize import maybe_dequant as _mq
+
         S = h.shape[1]
         y = _ln(h, layer["ln1"], layer["ln1_b"])
-        qkv = jnp.einsum("bsd,dcnh->bscnh", y, layer["wqkv"].astype(cfg.dtype))
+        qkv = jnp.einsum("bsd,dcnh->bscnh", y, _mq(layer, "wqkv", cfg.dtype))
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
         o = attention(q, k, v, causal=False)
-        h = h + o.reshape(B, S, H * hd) @ layer["wo"].astype(cfg.dtype)
+        h = h + o.reshape(B, S, H * hd) @ _mq(layer, "wo", cfg.dtype)
         y = _ln(h, layer["ln2"], layer["ln2_b"])
-        y = jax.nn.gelu(y @ layer["w_up"].astype(cfg.dtype))
-        h = h + y @ layer["w_down"].astype(cfg.dtype)
+        y = jax.nn.gelu(y @ _mq(layer, "w_up", cfg.dtype))
+        h = h + y @ _mq(layer, "w_down", cfg.dtype)
         return h, None
 
     x, _ = jax.lax.scan(block, x, params["layers"])

@@ -27,7 +27,8 @@ def build_llm_deployment(cfg, params_factory, *, name: str = "llm",
                          max_new_tokens: int = 64,
                          pad_id: int = 0,
                          num_replicas: int = 1,
-                         num_tpus: Optional[int] = None):
+                         num_tpus: Optional[int] = None,
+                         quantize_int8: bool = False):
     """A Serve deployment class generating continuations for
     {"tokens": [...], optional "max_new_tokens", "temperature"} requests.
 
@@ -54,6 +55,13 @@ def build_llm_deployment(cfg, params_factory, *, name: str = "llm",
             from ray_tpu.models.generate import generate
 
             self._params = params_factory()
+            if quantize_int8:
+                # Weight-only int8 (models/quantize.py): decode is
+                # HBM-bound, so halving the layer-weight bytes each step
+                # streams is a direct throughput lever.
+                from ray_tpu.models.quantize import quantize_params_int8
+
+                self._params = quantize_params_int8(self._params)
             # Distinct stream per replica: key(0) everywhere would make
             # replicas sample bit-identical continuations.
             self._rng = jax.random.key(
