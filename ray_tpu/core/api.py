@@ -926,12 +926,15 @@ def _reclaim_leases(lease_ids) -> None:
 
 
 class _TaskRoute:
-    __slots__ = ("conn", "lease_id", "worker_id", "inflight", "last_used")
+    __slots__ = ("conn", "lease_id", "worker_id", "node_id", "inflight",
+                 "last_used")
 
-    def __init__(self, conn, lease_id: str, worker_id: str) -> None:
+    def __init__(self, conn, lease_id: str, worker_id: str,
+                 node_id: str = "") -> None:
         self.conn = conn
         self.lease_id = lease_id
         self.worker_id = worker_id
+        self.node_id = node_id
         self.inflight = 0
         self.last_used = time.monotonic()
 
@@ -943,13 +946,15 @@ class _TaskRoutePool:
         self.next_try = 0.0    # monotonic; backoff after failed lease
         self.acquiring = 0     # in-flight _acquire calls (caps pool growth)
 
-    def _acquire(self, wc, resources, env_hash, runtime_env) -> Optional[_TaskRoute]:
+    def _acquire(self, wc, resources, env_hash, runtime_env,
+                 arg_bytes=None) -> Optional[_TaskRoute]:
         from . import protocol
 
         try:
             got = wc.client.request({
                 "kind": "lease_worker", "resources": resources,
-                "env_hash": env_hash, "runtime_env": runtime_env})
+                "env_hash": env_hash, "runtime_env": runtime_env,
+                "arg_bytes": arg_bytes or {}})
         except Exception:
             got = None
         if not got or not got.get("lease_id"):
@@ -968,7 +973,8 @@ class _TaskRoutePool:
             except Exception:
                 pass
             return None
-        route = _TaskRoute(conn, got["lease_id"], got["worker_id"])
+        route = _TaskRoute(conn, got["lease_id"], got["worker_id"],
+                           got.get("node_id") or "")
         # Born checked-out (inflight=1): a freshly acquired route must never
         # be visible to _reclaim_leases / the idle reaper with inflight==0
         # while its first submit is still in flight (advisor r4: that window
@@ -993,12 +999,15 @@ class _TaskRoutePool:
         except Exception:
             pass
 
-    def pick(self, wc, resources, env_hash, runtime_env) -> Optional[_TaskRoute]:
+    def pick(self, wc, resources, env_hash, runtime_env,
+             arg_bytes=None) -> Optional[_TaskRoute]:
         """Least-loaded live route; grows the pool synchronously whenever
         every route is busy (one leased worker per concurrent task, the
         reference's lease-per-pending-task shape — async growth would
         serialize two parallel tasks onto one worker) and reaps idle
-        leases."""
+        leases. `arg_bytes` ({node_id: bytes of this task's args there})
+        prefers an unsaturated route on the data node and rides to the
+        controller on pool growth so new leases land there too."""
         now = time.monotonic()
         with self.lock:
             live = [r for r in self.routes if not r.conn.closed.is_set()]
@@ -1014,11 +1023,28 @@ class _TaskRoutePool:
                 threading.Thread(target=self._release, args=(wc, r),
                                  daemon=True).start()
             best = min(live, key=lambda r: r.inflight, default=None)
+            want_local = False
+            if arg_bytes and live:
+                # Locality preference: an unsaturated route on the node
+                # holding the most argument bytes beats the globally
+                # least-loaded route (the bytes don't move; the task can).
+                data_node = max(arg_bytes, key=arg_bytes.get)
+                local = [r for r in live if r.node_id == data_node
+                         and r.inflight < _LEASE_PIPELINE]
+                if local:
+                    best = min(local, key=lambda r: r.inflight)
+                else:
+                    # No route on the data node: grow toward it (the new
+                    # lease request carries arg_bytes, so the controller
+                    # grants there) instead of shipping the bytes over the
+                    # network forever through an idle wrong-node route.
+                    want_local = True
             lease_max = flags.get("RTPU_TASK_LEASE_MAX")
             # acquiring counts toward the cap: N threads deciding to grow
             # simultaneously must not overshoot lease_max between them.
             need_grow = ((best is None
-                          or best.inflight >= _LEASE_PIPELINE)
+                          or best.inflight >= _LEASE_PIPELINE
+                          or want_local)
                          and len(live) + self.acquiring < lease_max
                          and now >= self.next_try)
             if best is not None:
@@ -1033,7 +1059,8 @@ class _TaskRoutePool:
                 self.acquiring += 1
         if need_grow:
             try:
-                got = self._acquire(wc, resources, env_hash, runtime_env)
+                got = self._acquire(wc, resources, env_hash, runtime_env,
+                                    arg_bytes=arg_bytes)
             finally:
                 with self.lock:
                     self.acquiring -= 1
@@ -1090,7 +1117,12 @@ def _try_direct_task(wc, spec: Dict[str, Any], opts: Dict[str, Any]) -> bool:
             pool = _task_pools[key] = _TaskRoutePool()
     # pick() returns the route already checked out (inflight counted under
     # the pool lock) — decrement on any failure to submit.
-    route = pool.pick(wc, resources, env_hash, spec.get("runtime_env"))
+    arg_bytes: Dict[str, int] = {}
+    for loc in hints.values():
+        if loc.node_id and loc.inline is None:
+            arg_bytes[loc.node_id] = arg_bytes.get(loc.node_id, 0) + loc.size
+    route = pool.pick(wc, resources, env_hash, spec.get("runtime_env"),
+                      arg_bytes=arg_bytes)
     if route is None:
         return False
     if hints:

@@ -1487,8 +1487,12 @@ class Controller:
         env_hash = msg.get("env_hash") or ""
         needs_tpu = resources.get("TPU", 0) > 0
         mem_limit = flags.get("RTPU_SPILLBACK_MEM_FRACTION")
+        # Locality term for the DIRECT path: the driver ships the byte
+        # placement of the task's (cached-location) args so lease grants
+        # rank nodes the same way queue placement does.
+        arg_bytes: Dict[str, int] = msg.get("arg_bytes") or {}
         for node in self._hybrid_order(
-                [n for n in self.nodes.values() if n.alive]):
+                [n for n in self.nodes.values() if n.alive], arg_bytes):
             if not _res_fits(node.available, resources):
                 continue
             # Grant-time admission for the direct path (the spillback
@@ -1524,7 +1528,8 @@ class Controller:
             peer = w.conn.writer.get_extra_info("peername")
             host = peer[0] if peer else "127.0.0.1"
             return {"lease_id": lease_id, "worker_id": w.worker_id,
-                    "host": host, "port": w.direct_port}
+                    "host": host, "port": w.direct_port,
+                    "node_id": node.node_id}
         # Nothing idle: nudge a spawn so a later lease request can succeed.
         for node in sorted(self.nodes.values(), key=lambda n: n.index):
             if node.alive and _res_fits(node.available, resources):
@@ -2569,7 +2574,9 @@ class Controller:
             except Exception:
                 pass
 
-    def _eligible_nodes(self, spec) -> List[NodeInfo]:
+    def _eligible_nodes(self, spec,
+                        arg_bytes: Optional[Dict[str, int]] = None
+                        ) -> List[NodeInfo]:
         strategy = spec.get("scheduling", {"type": "DEFAULT"})
         nodes = [n for n in self.nodes.values() if n.alive]
         st = strategy.get("type", "DEFAULT")
@@ -2599,8 +2606,24 @@ class Controller:
         if st == "NODE_LABEL":
             want: Dict[str, str] = strategy.get("labels", {})
             return [n for n in nodes if all(n.labels.get(k) == v for k, v in want.items())]
-        # DEFAULT: the reference's hybrid policy.
-        return self._hybrid_order(nodes)
+        # DEFAULT: the reference's hybrid policy, with the lease-policy
+        # locality term — among equally-cold nodes, prefer the one already
+        # holding the most argument bytes (reference: the locality-aware
+        # LeasePolicy picks the raylet with the largest located share of
+        # the task's args; here the directory is controller-local, so the
+        # ranking is one dict walk, no RPCs).
+        if arg_bytes is None:
+            arg_bytes = self._arg_bytes_by_node(spec)
+        return self._hybrid_order(nodes, arg_bytes)
+
+    def _arg_bytes_by_node(self, spec) -> Dict[str, int]:
+        """node_id -> bytes of this task's dependencies resident there."""
+        by_node: Dict[str, int] = {}
+        for oid in spec.get("deps", []) or []:
+            loc = self.objects.get(oid)
+            if loc is not None and loc.node_id and loc.inline is None:
+                by_node[loc.node_id] = by_node.get(loc.node_id, 0) + loc.size
+        return by_node
 
     @staticmethod
     def _cpu_util(n: NodeInfo) -> float:
@@ -2611,21 +2634,25 @@ class Controller:
         return 1.0 - n.available.get("CPU", 0.0) / tot
 
     @staticmethod
-    def _hybrid_order(nodes: List[NodeInfo]) -> List[NodeInfo]:
+    def _hybrid_order(nodes: List[NodeInfo],
+                      arg_bytes: Optional[Dict[str, int]] = None
+                      ) -> List[NodeInfo]:
         """Reference hybrid_scheduling_policy.h:29-49: PACK onto nodes
-        below the utilization threshold in index order
-        (locality/binpacking), then SPREAD across hot nodes by ascending
-        utilization. RTPU_SCHED_TOP_K > 1 randomizes among the best k to
-        avoid thundering-herd placement when many schedulers race (the
-        reference's top-k term). Shared by queue placement AND lease
-        grants so direct dispatch follows the same policy."""
+        below the utilization threshold (locality/binpacking) — ordered by
+        descending local argument bytes, then index — then SPREAD across
+        hot nodes by ascending utilization. RTPU_SCHED_TOP_K > 1
+        randomizes among the best k to avoid thundering-herd placement
+        when many schedulers race (the reference's top-k term). Shared by
+        queue placement AND lease grants so direct dispatch follows the
+        same policy."""
         thr = flags.get("RTPU_SCHED_HYBRID_THRESHOLD")
+        arg_bytes = arg_bytes or {}
 
         def hybrid_key(n: NodeInfo):
             util = Controller._cpu_util(n)
             if util < thr:
-                return (0, n.index, 0.0)
-            return (1, 0, util)
+                return (0, -arg_bytes.get(n.node_id, 0), n.index, 0.0)
+            return (1, 0, 0, util)
 
         ordered = sorted(nodes, key=hybrid_key)
         k = int(flags.get("RTPU_SCHED_TOP_K"))
@@ -2685,29 +2712,43 @@ class Controller:
         # worker spawned beats a hot (spread-bucket) node with a warm
         # worker — the reference commits to the policy's node and starts a
         # worker there. WITHIN a bucket, preferring the node with a warm
-        # worker is pure win (no policy signal separates them).
+        # worker is pure win UNLESS the locality term separates them: a
+        # node holding strictly more of this task's argument bytes keeps
+        # precedence even while its worker spawns (otherwise the data node
+        # loses exactly when it's busy and the bytes cross the network).
         thr = flags.get("RTPU_SCHED_HYBRID_THRESHOLD")
+        arg_bytes = self._arg_bytes_by_node(spec)
+        # The locality hold only applies where locality ordered the nodes:
+        # the DEFAULT hybrid policy. SPREAD deliberately ignores data
+        # placement; label/affinity orders have no locality meaning.
+        locality_st = spec.get("scheduling",
+                               {"type": "DEFAULT"}).get("type") == "DEFAULT"
 
         def bucket(n: NodeInfo) -> int:
             return 0 if self._cpu_util(n) < thr else 1
 
-        spawning_bucket: Optional[int] = None
-        for node in self._eligible_nodes(spec):
+        spawning_at: Optional[Tuple[int, int]] = None  # (bucket, arg bytes)
+        for node in self._eligible_nodes(spec, arg_bytes):
             if not _res_fits(node.available, resources):
                 continue
-            if spawning_bucket is not None and bucket(node) > spawning_bucket:
-                return False  # wait for the better-bucket node's spawn
+            if spawning_at is not None:
+                sb, sbytes = spawning_at
+                if bucket(node) > sb or (
+                        locality_st and bucket(node) == sb
+                        and arg_bytes.get(node.node_id, 0) < sbytes):
+                    return False  # wait for the better node's spawn
             w = self._find_idle_worker(node, needs_tpu, env_hash,
                                        tpu_chips=int(resources.get("TPU", 0)))
             if w is None:
                 spawning = self._maybe_spawn_worker(
                     node, needs_tpu, spec.get("runtime_env"),
                     tpu_chips=int(resources.get("TPU", 0)))
-                # Hold later (worse-bucket) nodes ONLY when a spawn is
-                # really coming here; a capped node with nothing in flight
-                # must not starve the task off warm workers elsewhere.
-                if spawning and spawning_bucket is None:
-                    spawning_bucket = bucket(node)
+                # Hold later (worse) nodes ONLY when a spawn is really
+                # coming here; a capped node with nothing in flight must
+                # not starve the task off warm workers elsewhere.
+                if spawning and spawning_at is None:
+                    spawning_at = (bucket(node),
+                                   arg_bytes.get(node.node_id, 0))
                 continue
             _res_sub(node.available, resources)
             spec["sched_node"] = node.node_id

@@ -113,3 +113,40 @@ def test_pg_bundle_index_any_spreads(ray_start_cluster):
     wall = time.monotonic() - t0
     assert wall < 1.9, f"tasks serialized ({wall:.1f}s): -1 pinned to bundle 0"
     ray_tpu.remove_placement_group(pg)
+
+
+def test_arg_locality_prefers_data_node(three_nodes):
+    """DEFAULT placement's locality term: among cold nodes, a task
+    follows its (non-inline) argument bytes (reference: locality-aware
+    LeasePolicy picks the raylet holding the largest argument share)."""
+    import numpy as np
+
+    _, _, n3 = three_nodes
+
+    @ray_tpu.remote
+    def produce():
+        return np.zeros(1_000_000)  # 8MB: well past the inline threshold
+
+    @ray_tpu.remote
+    def consume(x):
+        return ray_tpu.get_runtime_context().get_node_id(), x.nbytes
+
+    big = produce.options(
+        scheduling_strategy=NodeAffinitySchedulingStrategy(node_id=n3)
+    ).remote()
+    # get() caches the location driver-side, so `consume` takes the
+    # DIRECT-dispatch path — the lease request must carry arg_bytes and
+    # land on the data node (wait() would exercise the controller-queue
+    # path instead; both must follow the bytes).
+    ray_tpu.get(big, timeout=60)
+    node, nbytes = ray_tpu.get(consume.remote(big), timeout=60)
+    assert nbytes == 8_000_000
+    assert node == n3
+    # Controller-queue path: a fresh producer awaited via wait() (which
+    # does NOT cache locations) forces the queued path for its consumer.
+    big2 = produce.options(
+        scheduling_strategy=NodeAffinitySchedulingStrategy(node_id=n3)
+    ).remote()
+    ray_tpu.wait([big2], timeout=60)
+    node2, _ = ray_tpu.get(consume.remote(big2), timeout=60)
+    assert node2 == n3
