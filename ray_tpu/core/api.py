@@ -1064,6 +1064,15 @@ class _TaskRoutePool:
             finally:
                 with self.lock:
                     self.acquiring -= 1
+            if want_local and got is not None and arg_bytes and \
+                    got.node_id != max(arg_bytes, key=arg_bytes.get):
+                # Grew FOR locality but the grant landed off the data node
+                # (no capacity there): back off further locality growth so
+                # a stream of submits doesn't inflate the pool with
+                # off-node leases, one lease RPC per task. The off-node
+                # route still serves this task.
+                with self.lock:
+                    self.next_try = time.monotonic() + _LEASE_BACKOFF_S
             if got is not None:
                 # The new route is born checked-out; hand back the
                 # speculative reservation on the old best.

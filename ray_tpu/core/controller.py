@@ -1530,9 +1530,12 @@ class Controller:
             return {"lease_id": lease_id, "worker_id": w.worker_id,
                     "host": host, "port": w.direct_port,
                     "node_id": node.node_id}
-        # Nothing idle: nudge a spawn so a later lease request can succeed.
-        for node in sorted(self.nodes.values(), key=lambda n: n.index):
-            if node.alive and _res_fits(node.available, resources):
+        # Nothing idle: nudge a spawn so a later lease request can succeed —
+        # in the SAME locality order as grants, so "grow toward the data
+        # node" creates the worker where the bytes are.
+        for node in self._hybrid_order(
+                [n for n in self.nodes.values() if n.alive], arg_bytes):
+            if _res_fits(node.available, resources):
                 self._maybe_spawn_worker(node, needs_tpu,
                                          msg.get("runtime_env"),
                                          tpu_chips=int(resources.get("TPU", 0)))

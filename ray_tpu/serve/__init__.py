@@ -15,7 +15,7 @@ from .api import (delete, get_app_handle, get_deployment_handle, run,
 from .batching import batch
 from .multiplex import get_multiplexed_model_id, multiplexed
 from .deployment import Application, AutoscalingConfig, Deployment, deployment
-from .llm import build_llm_deployment
+from .llm import build_llm_deployment, build_streaming_llm_deployment
 from .handle import (DeploymentHandle, DeploymentResponse,
                      DeploymentStreamingResponse)
 
@@ -38,4 +38,5 @@ __all__ = [
     "get_deployment_handle",
     "batch",
     "build_llm_deployment",
+    "build_streaming_llm_deployment",
 ]
