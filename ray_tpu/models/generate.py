@@ -41,13 +41,23 @@ Params = Dict[str, jax.Array]
 class KVCache(NamedTuple):
     k: jax.Array  # [L, B, max_len, KVH, hd] (cfg.dtype)
     v: jax.Array  # [L, B, max_len, KVH, hd]
-    pos: jax.Array  # [] int32 — tokens filled so far
+    # Tokens filled so far: [] int32 (uniform batch) or [B] int32 (ragged
+    # batch — per-row prompt lengths; decode masks and writes per row).
+    pos: jax.Array
 
 
 def prefill(params: Params, tokens: jax.Array, cfg: TransformerConfig,
-            max_len: int) -> Tuple[jax.Array, KVCache]:
+            max_len: int,
+            lengths: Optional[jax.Array] = None) -> Tuple[jax.Array, KVCache]:
     """Run the prompt [B, S] through the batched forward, returning logits
-    for the LAST position [B, V] and the primed cache."""
+    for the last REAL position [B, V] and the primed cache.
+
+    `lengths` [B] enables RAGGED prompts: rows are right-padded to S, each
+    row's logits come from index lengths[i]-1, and cache.pos = lengths.
+    Right-padding is safe without a key mask: causal attention means real
+    tokens never attend pad positions (pads sit after them), pad rows'
+    outputs go unused, and pad K/V slots are overwritten by decode writes
+    before any step's valid mask (slot < pos[i]) can expose them."""
     B, S = tokens.shape
     if S > max_len:
         raise ValueError(f"prompt length {S} exceeds cache max_len {max_len}")
@@ -61,11 +71,16 @@ def prefill(params: Params, tokens: jax.Array, cfg: TransformerConfig,
     x, (ks, vs) = jax.lax.scan(body, x, params["layers"])
     # Pad [L, B, S, KVH, hd] out to the static max_len.
     pad = [(0, 0), (0, 0), (0, max_len - S), (0, 0), (0, 0)]
-    cache = KVCache(
-        k=jnp.pad(ks, pad), v=jnp.pad(vs, pad),
-        pos=jnp.asarray(S, jnp.int32))
-    x, head = final_hidden_and_head(params, x[:, -1:], cfg)
-    logits = (x @ head).astype(jnp.float32)[:, 0]
+    if lengths is None:
+        last = x[:, -1:]
+        pos = jnp.asarray(S, jnp.int32)
+    else:
+        last = jnp.take_along_axis(
+            x, (lengths - 1)[:, None, None].astype(jnp.int32), axis=1)
+        pos = lengths.astype(jnp.int32)
+    cache = KVCache(k=jnp.pad(ks, pad), v=jnp.pad(vs, pad), pos=pos)
+    h, head = final_hidden_and_head(params, last, cfg)
+    logits = (h @ head).astype(jnp.float32)[:, 0]
     return logits, cache
 
 
@@ -87,26 +102,41 @@ def decode_step(params: Params, cache: KVCache, token: jax.Array,
     # overflow (its scan length is sized against max_len); hand-rolled
     # loops get the same contract as prefill's length check where possible.
     try:
-        if int(pos) >= max_len:
+        hi = int(pos) if getattr(pos, "ndim", 0) == 0 else int(pos.max())
+        if hi >= max_len:
             raise ValueError(
-                f"decode_step: cache full (pos {int(pos)} >= max_len "
+                f"decode_step: cache full (pos {hi} >= max_len "
                 f"{max_len}); size prefill's max_len for the tokens you "
                 f"intend to generate")
     except (jax.errors.TracerIntegerConversionError,
             jax.errors.ConcretizationTypeError):
         pass
+    ragged = getattr(pos, "ndim", 0) == 1  # per-row positions [B]
     x = embed_tokens(params, token[:, None], cfg)  # [B, 1, d]
-    positions = jnp.full((B, 1), pos, jnp.int32)
-    valid = (jnp.arange(max_len) <= pos)[None, None, None, :]  # [1,1,1,S]
+    if ragged:
+        positions = pos[:, None].astype(jnp.int32)
+        # [B,1,1,S]: row i may attend cache slots < pos[i] plus its own
+        # just-written slot.
+        valid = (jnp.arange(max_len)[None] <= pos[:, None])[:, None, None]
+    else:
+        positions = jnp.full((B, 1), pos, jnp.int32)
+        valid = (jnp.arange(max_len) <= pos)[None, None, None, :]
+
+    def _write(ck, k):
+        """Append this step's K (or V) at each row's position."""
+        if ragged:
+            return jax.vmap(
+                lambda c, kk, p: jax.lax.dynamic_update_slice(
+                    c, kk, (p, 0, 0)))(ck, k.astype(ck.dtype), pos)
+        return jax.lax.dynamic_update_slice(ck, k.astype(ck.dtype),
+                                            (0, pos, 0, 0))
 
     def body(x, xs):
         layer, ck, cv = xs  # ck/cv: [B, max_len, KVH, hd]
         h = _norm(x, layer["attn_norm"], layer.get("attn_norm_b"), cfg.norm)
         q, k, v = _qkv_proj(cfg, h, layer, positions)
-        ck = jax.lax.dynamic_update_slice(ck, k.astype(ck.dtype),
-                                          (0, pos, 0, 0))
-        cv = jax.lax.dynamic_update_slice(cv, v.astype(cv.dtype),
-                                          (0, pos, 0, 0))
+        ck = _write(ck, k)
+        cv = _write(cv, v)
         # GQA: fold query heads into KVH groups of size G.
         G = H // KVH
         qg = q.reshape(B, 1, KVH, G, hd)
@@ -184,3 +214,49 @@ def generate(params: Params, tokens: jax.Array, cfg: TransformerConfig,
     out = jnp.concatenate(
         [tokens, first[:, None], rest.T.astype(tokens.dtype)], axis=1)
     return out[:, :max_len]
+
+
+def generate_ragged(params: Params, tokens: jax.Array, lengths: jax.Array,
+                    cfg: TransformerConfig, max_new_tokens: int, *,
+                    temperature=0.0, rng: Optional[jax.Array] = None,
+                    eos_id: Optional[int] = None) -> jax.Array:
+    """Mixed-length batched generation: prompts right-padded to [B, S] with
+    true `lengths` [B] -> GENERATED tokens [B, max_new_tokens].
+
+    One compiled program serves every batch composition: per-row cache
+    positions remove the uniform-prompt-length restriction, and
+    `temperature` may be a [B] vector (per-request sampling — rows with
+    temperature<=0 decode greedily) or a scalar/float. Serving uses this
+    to batch heterogeneous requests without per-length recompiles."""
+    B, S = tokens.shape
+    max_len = S + max_new_tokens
+    logits, cache = prefill(params, tokens, cfg, max_len, lengths=lengths)
+    if rng is None:
+        rng = jax.random.key(0)
+    temp = jnp.asarray(temperature, jnp.float32)
+    if temp.ndim == 0:
+        temp = jnp.broadcast_to(temp, (B,))
+    tcol = temp[:, None]
+
+    def pick(logits, step_rng):
+        greedy = jnp.argmax(logits, -1).astype(jnp.int32)
+        scaled = logits / jnp.maximum(tcol, 1e-6)
+        sampled = jax.random.categorical(step_rng, scaled).astype(jnp.int32)
+        return jnp.where(temp <= 0.0, greedy, sampled)
+
+    rng, r0 = jax.random.split(rng)
+    first = pick(logits, r0)
+    done0 = jnp.zeros((B,), bool) if eos_id is None else first == eos_id
+
+    def step(carry, step_rng):
+        cache, tok, done = carry
+        logits, cache = decode_step(params, cache, tok, cfg)
+        nxt = pick(logits, step_rng)
+        if eos_id is not None:
+            nxt = jnp.where(done, eos_id, nxt)
+            done = done | (nxt == eos_id)
+        return (cache, nxt, done), nxt
+
+    keys = jax.random.split(rng, max(max_new_tokens - 1, 0))
+    (_, _, _), rest = jax.lax.scan(step, (cache, first, done0), keys)
+    return jnp.concatenate([first[:, None], rest.T], axis=1).astype(jnp.int32)

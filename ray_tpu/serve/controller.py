@@ -164,9 +164,9 @@ class ServeController:
             pass
 
     def _reconcile(self) -> None:
-        # Snapshot under _lock, health-check OUTSIDE it (a hung replica
-        # costs a 10s RPC timeout; holding the lock through that would stall
-        # every deploy/delete), then re-acquire and commit only if the
+        # Snapshot under _lock, health-check OUTSIDE it (hung replicas cost
+        # up to the 30s health window; holding the lock through that would
+        # stall every deploy/delete), then re-acquire and commit only if the
         # deployment wasn't concurrently redeployed — otherwise a stale pass
         # could resurrect just-killed old-version replicas.
         with self._lock:
@@ -175,9 +175,23 @@ class ServeController:
         for info, replicas in snapshot:
             alive = []
             dead = []
+            # Fire every probe first, then gather against ONE shared 30s
+            # deadline (the reference serve default,
+            # health_check_timeout_s=30 — a replica blocking its loop on a
+            # long model compile/load must not read as dead). Serial waits
+            # would stall a pass 30s PER hung replica.
+            probes = []
             for r in replicas:
                 try:
-                    ray_tpu.get(r.check_health.remote(), timeout=10.0)
+                    probes.append((r, r.check_health.remote()))
+                except Exception as e:
+                    info.last_error = repr(e)
+                    dead.append(r)
+            deadline = time.monotonic() + 30.0
+            for r, ref in probes:
+                try:
+                    ray_tpu.get(ref, timeout=max(
+                        0.5, deadline - time.monotonic()))
                     alive.append(r)
                 except Exception as e:
                     logger.warning("replica of %s failed health check",

@@ -3,10 +3,10 @@
 The reference serves LLMs by hosting external engines; here the framework's
 own model layer IS the engine, so the deployment is thin and TPU-shaped:
 
-- requests batch via @serve.batch, group by exact prompt length (see
-  build_llm_deployment's docstring for why padding prompts is wrong),
-  pad only the batch dimension, and run ONE jitted generate() per
-  length — static shapes, so each length compiles once and is reused;
+- requests batch via @serve.batch into ONE ragged generate per batch
+  (models/generate.py generate_ragged): right-padded prompts with
+  per-row cache positions and per-row temperatures, padded to power-of-2
+  length buckets so at most log2(max_prompt_len) programs ever compile;
 - the replica reserves chips with num_tpus like any other TPU actor, so
   the Data/Train/Serve stacks share one accelerator accounting scheme.
 """
@@ -36,13 +36,14 @@ def build_llm_deployment(cfg, params_factory, *, name: str = "llm",
     params ON THE REPLICA (load from a checkpoint path, don't ship arrays
     through the deployment config).
 
-    Batching correctness: prompts are grouped by EXACT length inside each
-    batch — padding a prompt would shift rope positions and let pad
-    tokens leak into attention (the flash path has no key-padding mask).
-    Rows are independent, so each length group pads its BATCH dim to
-    max_batch_size (junk rows dropped after), meaning the jitted scan
-    compiles once per distinct prompt length, not per batch composition.
-    Returns the deployment (call .bind() to serve)."""
+    Batching: every coalesced batch runs as ONE ragged generate
+    (models/generate.py generate_ragged) — prompts right-pad with per-row
+    cache positions (pads can never leak into attention) and temperature
+    rides as a per-row vector, so batch composition never recompiles.
+    The padded length is the batch's longest prompt rounded up to a
+    power of two (capped at max_prompt_len): short-prompt traffic doesn't
+    pay max_prompt_len prefill FLOPs, and at most ~log2(max_prompt_len)
+    programs ever compile. Returns the deployment (call .bind())."""
     @deployment(name=name, num_replicas=num_replicas,
                 ray_actor_options=(
                     {"num_tpus": num_tpus} if num_tpus else None))
@@ -51,8 +52,6 @@ def build_llm_deployment(cfg, params_factory, *, name: str = "llm",
             import os
 
             import jax
-
-            from ray_tpu.models.generate import generate
 
             self._params = params_factory()
             if quantize_int8:
@@ -67,14 +66,16 @@ def build_llm_deployment(cfg, params_factory, *, name: str = "llm",
             self._rng = jax.random.key(
                 int.from_bytes(os.urandom(4), "little"))
 
-            # temperature rides as a TRACED scalar — client-supplied floats
-            # must not trigger a recompile per value (generate() selects
-            # greedy-vs-sampled with a where when temperature is traced).
+            from ray_tpu.models.generate import generate_ragged
+
+            # One program for every batch composition: fixed [B, S] padded
+            # shape, per-row lengths and temperatures all traced.
             @jax.jit
-            def _gen(params, tokens, rng, temperature):
-                return generate(
-                    params, tokens, cfg, max_new_tokens=max_new_tokens,
-                    temperature=temperature, rng=rng)
+            def _gen(params, tokens, lengths, rng, temps):
+                return generate_ragged(
+                    params, tokens, lengths, cfg,
+                    max_new_tokens=max_new_tokens, temperature=temps,
+                    rng=rng)
 
             self._gen = _gen
 
@@ -85,13 +86,8 @@ def build_llm_deployment(cfg, params_factory, *, name: str = "llm",
 
             # Per-request validation: one malformed request must answer
             # with its own error, never poison the coalesced batch.
-            # Groups key on (length, temperature) — same-length requests
-            # with different sampling must not inherit the leader's.
-            groups: Dict[tuple, List[int]] = {}
-            prompts: List[Optional[np.ndarray]] = []
             results: List[Optional[Dict[str, Any]]] = [None] * len(requests)
-            wants: List[int] = [0] * len(requests)
-            truncated: List[bool] = [False] * len(requests)
+            rows: List[tuple] = []  # (request idx, ids, temp, want, trunc)
             for i, req in enumerate(requests):
                 try:
                     ids = np.asarray(req["tokens"], np.int32)
@@ -103,29 +99,33 @@ def build_llm_deployment(cfg, params_factory, *, name: str = "llm",
                     if want <= 0:
                         raise ValueError("max_new_tokens must be positive")
                 except Exception as e:
-                    prompts.append(None)
                     results[i] = {"error": f"bad request: {e}"}
                     continue
-                wants[i] = want
-                truncated[i] = len(ids) > max_prompt_len
-                ids = ids[-max_prompt_len:]
-                prompts.append(ids)
-                groups.setdefault((len(ids), temp), []).append(i)
-            for (L, temp), idxs in groups.items():
-                toks = np.full((max_batch_size, L), pad_id, np.int32)
-                for row, i in enumerate(idxs):
-                    toks[row] = prompts[i]
+                trunc = len(ids) > max_prompt_len
+                rows.append((i, ids[-max_prompt_len:], temp, want, trunc))
+            if rows:
+                longest = max(len(ids) for _, ids, _, _, _ in rows)
+                S = 1
+                while S < longest:
+                    S <<= 1
+                S = min(max(S, 8), max_prompt_len)
+                toks = np.full((max_batch_size, S), pad_id, np.int32)
+                lengths = np.ones(max_batch_size, np.int32)
+                temps = np.zeros(max_batch_size, np.float32)
+                for row, (_, ids, temp, _, _) in enumerate(rows):
+                    toks[row, :len(ids)] = ids
+                    lengths[row] = len(ids)
+                    temps[row] = temp
                 self._rng, sub = jax.random.split(self._rng)
                 out = np.asarray(self._gen(
-                    self._params, toks, sub, np.float32(temp)))
-                for row, i in enumerate(idxs):
-                    n = min(wants[i], max_new_tokens)
-                    res = {"tokens": [int(t) for t in out[row, L:L + n]]}
-                    if wants[i] > max_new_tokens:
-                        # Signal caps/truncation instead of silently
-                        # degrading the answer.
+                    self._params, toks, lengths, sub, temps))
+                for row, (i, ids, _, want, trunc) in enumerate(rows):
+                    n = min(want, max_new_tokens)
+                    res = {"tokens": [int(t) for t in out[row, :n]]}
+                    if want > max_new_tokens:
+                        # Signal the cap instead of silently truncating.
                         res["max_new_tokens_capped"] = max_new_tokens
-                    if truncated[i]:
+                    if trunc:
                         res["prompt_truncated_to"] = max_prompt_len
                     results[i] = res
             return results
