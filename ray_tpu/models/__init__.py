@@ -1,7 +1,9 @@
 from . import configs, transformer, vit
-from .generate import KVCache, decode_step, generate, prefill
+from .generate import (KVCache, decode_step, generate,
+                       generate_ragged, prefill)
 from .quantize import quantize_params_int8
 
 __all__ = ["configs", "transformer", "vit",
-           "KVCache", "decode_step", "generate", "prefill",
+           "KVCache", "decode_step", "generate", "generate_ragged",
+           "prefill",
            "quantize_params_int8"]

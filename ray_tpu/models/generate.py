@@ -56,8 +56,10 @@ def prefill(params: Params, tokens: jax.Array, cfg: TransformerConfig,
     row's logits come from index lengths[i]-1, and cache.pos = lengths.
     Right-padding is safe without a key mask: causal attention means real
     tokens never attend pad positions (pads sit after them), pad rows'
-    outputs go unused, and pad K/V slots are overwritten by decode writes
-    before any step's valid mask (slot < pos[i]) can expose them."""
+    outputs go unused, and decode overwrites the pad K/V slot at pos[i]
+    BEFORE the attention einsum runs (the valid mask is slot <= pos[i],
+    which includes the just-written slot — ordering of _write before
+    attend in decode_step's body is load-bearing)."""
     B, S = tokens.shape
     if S > max_len:
         raise ValueError(f"prompt length {S} exceeds cache max_len {max_len}")
@@ -160,6 +162,32 @@ def decode_step(params: Params, cache: KVCache, token: jax.Array,
     return logits, KVCache(k=nk, v=nv, pos=pos + 1)
 
 
+def _decode_loop(params, cfg, cache, logits, pick, rng, max_new_tokens,
+                 eos_id):
+    """Shared first-token + eos-freeze + lax.scan machinery for
+    generate()/generate_ragged() — ONE home so sampling/eos semantics can
+    never drift between the uniform and ragged paths. Returns
+    (first [B], rest [max_new_tokens-1, B])."""
+    B = logits.shape[0]
+    rng, r0 = jax.random.split(rng)
+    first = pick(logits, r0)
+    # The first generated token may itself be eos — done0 reflects it.
+    done0 = jnp.zeros((B,), bool) if eos_id is None else first == eos_id
+
+    def step(carry, step_rng):
+        cache, tok, done = carry
+        logits, cache = decode_step(params, cache, tok, cfg)
+        nxt = pick(logits, step_rng)
+        if eos_id is not None:
+            nxt = jnp.where(done, eos_id, nxt)
+            done = done | (nxt == eos_id)
+        return (cache, nxt, done), nxt
+
+    keys = jax.random.split(rng, max(max_new_tokens - 1, 0))
+    (_, _, _), rest = jax.lax.scan(step, (cache, first, done0), keys)
+    return first, rest
+
+
 def generate(params: Params, tokens: jax.Array, cfg: TransformerConfig,
              max_new_tokens: int, *, temperature: float = 0.0,
              top_k: int = 0, rng: Optional[jax.Array] = None,
@@ -195,22 +223,8 @@ def generate(params: Params, tokens: jax.Array, cfg: TransformerConfig,
             return sampled
         return jnp.where(temperature <= 0.0, greedy, sampled)
 
-    rng, r0 = jax.random.split(rng)
-    first = pick(logits, r0)
-    # The first generated token may itself be eos — done0 reflects it.
-    done0 = jnp.zeros((B,), bool) if eos_id is None else first == eos_id
-
-    def step(carry, step_rng):
-        cache, tok, done = carry
-        logits, cache = decode_step(params, cache, tok, cfg)
-        nxt = pick(logits, step_rng)
-        if eos_id is not None:
-            nxt = jnp.where(done, eos_id, nxt)
-            done = done | (nxt == eos_id)
-        return (cache, nxt, done), nxt
-
-    keys = jax.random.split(rng, max(max_new_tokens - 1, 0))
-    (_, _, _), rest = jax.lax.scan(step, (cache, first, done0), keys)
+    first, rest = _decode_loop(params, cfg, cache, logits, pick, rng,
+                               max_new_tokens, eos_id)
     out = jnp.concatenate(
         [tokens, first[:, None], rest.T.astype(tokens.dtype)], axis=1)
     return out[:, :max_len]
@@ -244,19 +258,6 @@ def generate_ragged(params: Params, tokens: jax.Array, lengths: jax.Array,
         sampled = jax.random.categorical(step_rng, scaled).astype(jnp.int32)
         return jnp.where(temp <= 0.0, greedy, sampled)
 
-    rng, r0 = jax.random.split(rng)
-    first = pick(logits, r0)
-    done0 = jnp.zeros((B,), bool) if eos_id is None else first == eos_id
-
-    def step(carry, step_rng):
-        cache, tok, done = carry
-        logits, cache = decode_step(params, cache, tok, cfg)
-        nxt = pick(logits, step_rng)
-        if eos_id is not None:
-            nxt = jnp.where(done, eos_id, nxt)
-            done = done | (nxt == eos_id)
-        return (cache, nxt, done), nxt
-
-    keys = jax.random.split(rng, max(max_new_tokens - 1, 0))
-    (_, _, _), rest = jax.lax.scan(step, (cache, first, done0), keys)
+    first, rest = _decode_loop(params, cfg, cache, logits, pick, rng,
+                               max_new_tokens, eos_id)
     return jnp.concatenate([first[:, None], rest.T], axis=1).astype(jnp.int32)

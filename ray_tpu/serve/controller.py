@@ -172,12 +172,16 @@ class ServeController:
         with self._lock:
             snapshot = [(info, list(info.replicas)) for info in
                         self._deployments.values()]
+        # ONE deadline for the whole pass (probes are fired concurrently
+        # per deployment): hung replicas across many deployments must not
+        # stack 30s each before replacements start.
+        deadline = time.monotonic() + 30.0
         for info, replicas in snapshot:
             alive = []
             dead = []
-            # Fire every probe first, then gather against ONE shared 30s
-            # deadline (the reference serve default,
-            # health_check_timeout_s=30 — a replica blocking its loop on a
+            # Fire every probe first, then gather against the shared pass
+            # deadline (30s — the reference serve default,
+            # health_check_timeout_s=30: a replica blocking its loop on a
             # long model compile/load must not read as dead). Serial waits
             # would stall a pass 30s PER hung replica.
             probes = []
@@ -187,7 +191,6 @@ class ServeController:
                 except Exception as e:
                     info.last_error = repr(e)
                     dead.append(r)
-            deadline = time.monotonic() + 30.0
             for r, ref in probes:
                 try:
                     ray_tpu.get(ref, timeout=max(
