@@ -104,11 +104,10 @@ def build_llm_deployment(cfg, params_factory, *, name: str = "llm",
                 trunc = len(ids) > max_prompt_len
                 rows.append((i, ids[-max_prompt_len:], temp, want, trunc))
             if rows:
-                longest = max(len(ids) for _, ids, _, _, _ in rows)
-                S = 1
-                while S < longest:
-                    S <<= 1
-                S = min(max(S, 8), max_prompt_len)
+                from ray_tpu.serve.llm_engine import bucket_len
+
+                S = bucket_len(max(len(ids) for _, ids, _, _, _ in rows),
+                               max_prompt_len)
                 toks = np.full((max_batch_size, S), pad_id, np.int32)
                 lengths = np.ones(max_batch_size, np.int32)
                 temps = np.zeros(max_batch_size, np.float32)
@@ -143,17 +142,22 @@ def build_streaming_llm_deployment(cfg, params_factory, *, name: str = "llm-stre
                                    max_new_tokens: int = 64,
                                    num_replicas: int = 1,
                                    num_tpus: Optional[int] = None,
-                                   quantize_int8: bool = False):
+                                   quantize_int8: bool = False,
+                                   continuous_batching: bool = False,
+                                   num_slots: int = 4):
     """Token-by-token streaming generation (reference: serve streaming
     responses; LLM engines' SSE token streams).
 
     Unlike build_llm_deployment's one-compiled-scan batch path, each
     request runs prefill once and then jitted decode_step per token,
     yielding {"token": id} chunks as they land — first-token latency is
-    prefill + one step instead of the whole generation. The two jitted
-    programs (prefill at each prompt length, one decode step) are reused
-    across requests; no cross-request batching in v1 (continuous batching
-    composes on top of decode_step, not inside it)."""
+    prefill + one step instead of the whole generation.
+
+    ``continuous_batching=True`` backs the replica with a
+    ContinuousBatchingEngine (serve/llm_engine.py): `num_slots` concurrent
+    streams share ONE decode tick over a slot-pooled ragged cache —
+    requests join the running batch mid-flight and retire independently,
+    so a replica's decode throughput is shared instead of serialized."""
     @deployment(name=name, num_replicas=num_replicas, stream=True,
                 ray_actor_options=(
                     {"num_tpus": num_tpus} if num_tpus else None))
@@ -178,6 +182,25 @@ def build_streaming_llm_deployment(cfg, params_factory, *, name: str = "llm-stre
             self._base_rng = jax.random.key(
                 int.from_bytes(os.urandom(4), "little"))
             self._draws = itertools.count()
+            self._engine = None
+            if continuous_batching:
+                import threading
+
+                from ray_tpu.serve.llm_engine import (
+                    ContinuousBatchingEngine,
+                )
+
+                self._engine = ContinuousBatchingEngine(
+                    cfg, self._params, num_slots=num_slots,
+                    max_prompt_len=max_prompt_len,
+                    max_new_tokens=max_new_tokens,
+                    seed=int.from_bytes(os.urandom(4), "little"))
+                self._stop = threading.Event()
+                self._ticker = threading.Thread(
+                    target=self._engine.run_forever, args=(self._stop,),
+                    daemon=True)
+                self._ticker.start()
+                return
             self._prefill = jax.jit(
                 lambda p, t: prefill(p, t, cfg,
                                      max_len=max_prompt_len + max_new_tokens))
@@ -204,6 +227,45 @@ def build_streaming_llm_deployment(cfg, params_factory, *, name: str = "llm-stre
                 yield {"error": f"bad request: {e}"}
                 return
             ids = ids[-max_prompt_len:]
+            if self._engine is not None:
+                # Continuous batching: attach to the shared tick loop and
+                # stream tokens as the slot emits them.
+                import time as _t
+
+                try:
+                    req = self._engine.submit(
+                        ids, max_new_tokens=n, temperature=temp,
+                        eos_id=eos, timeout=300)
+                except TimeoutError as e:
+                    # Backpressure uses the same error-chunk contract as
+                    # malformed requests — not a raw stream exception.
+                    yield {"error": f"overloaded: {e}"}
+                    return
+                sent = 0
+                try:
+                    while True:
+                        toks = self._engine.peek(req)
+                        while sent < len(toks):
+                            yield {"token": toks[sent]}
+                            sent += 1
+                        if self._engine.check_failed() is not None \
+                                and not self._engine.is_done(req):
+                            yield {"error": "generation engine failed"}
+                            return
+                        if self._engine.is_done(req):
+                            try:
+                                tail = self._engine.pop_result(req)[sent:]
+                            except RuntimeError as e:
+                                yield {"error": str(e)}
+                                return
+                            for tok in tail:
+                                yield {"token": tok}
+                            return
+                        _t.sleep(0.005)
+                finally:
+                    # Client disconnect closes this generator mid-loop:
+                    # release the request's engine state either way.
+                    self._engine.discard(req)
             logits, cache = self._prefill(self._params, ids[None])
             for i in range(n):
                 if temp > 0:

@@ -1,0 +1,322 @@
+"""Continuous-batching generation engine (slot-based, vLLM-style shape).
+
+The batch LLM deployment coalesces requests that ARRIVE together; this
+engine lets requests join and leave a RUNNING batch: a fixed pool of B
+slots shares one ragged KV cache (models/generate.py per-row positions),
+every tick runs ONE decode_step over all slots, and a request attaches by
+splicing its prefilled K/V into a free slot mid-flight. Short requests
+retire without stalling long ones; new arrivals don't wait for the batch
+to drain.
+
+Compiled units (all static shapes, reused forever):
+- per-length-bucket prefill of a single prompt,
+- the slot splice (dynamic_update_slice on the batch axis),
+- one decode tick (the [B] ragged decode_step + sampling).
+
+The engine is deliberately serve-independent and synchronous-core: attach/
+tick/poll are plain methods driven by one background thread, so it can be
+tested exhaustively without actors and wired into any serving surface.
+Inactive slots still compute through the tick (their rows are masked at
+the sampling layer) — wasted FLOPs bounded by B, the price of a single
+compiled program.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+
+def bucket_len(n: int, max_len: int, floor: int = 8) -> int:
+    """Power-of-2 length bucket (>= floor, <= max_len): THE compile-count
+    bound shared by the batch deployment and the engine — one definition
+    so the two paths can't drift apart in how many programs they compile."""
+    S = floor
+    while S < n:
+        S <<= 1
+    return min(S, max_len)
+
+
+class ContinuousBatchingEngine:
+    """B-slot continuous batching over a shared ragged KV cache."""
+
+    def __init__(self, cfg, params, *, num_slots: int = 4,
+                 max_prompt_len: int = 128, max_new_tokens: int = 64,
+                 seed: int = 0):
+        import jax
+        import jax.numpy as jnp
+
+        from ray_tpu.models.generate import KVCache, decode_step, prefill
+
+        self.cfg = cfg
+        self.params = params
+        self.B = num_slots
+        self.max_prompt_len = max_prompt_len
+        self.max_new = max_new_tokens
+        self.max_len = max_prompt_len + max_new_tokens
+        self._jax, self._jnp = jax, jnp
+
+        L = cfg.n_layers
+        KVH, hd = cfg.kv_heads, cfg.head_dim
+        kv_shape = (L, self.B, self.max_len, KVH, hd)
+        self.cache = KVCache(
+            k=jnp.zeros(kv_shape, cfg.dtype),
+            v=jnp.zeros(kv_shape, cfg.dtype),
+            pos=jnp.zeros((self.B,), jnp.int32))
+        self.cur_tok = jnp.zeros((self.B,), jnp.int32)
+
+        # Host-side slot bookkeeping (engine lock; the arrays above are
+        # replaced wholesale under it).
+        self.lock = threading.Lock()
+        self.active = [False] * self.B
+        self.budget = [0] * self.B      # tokens left to emit per slot
+        self.eos = [None] * self.B      # per-request eos id
+        self.temp = np.zeros(self.B, np.float32)
+        self.out: List[List[int]] = [[] for _ in range(self.B)]
+        # Slots recycle; REQUESTS are the stable identity. submit() returns
+        # a request id, finished outputs move to _results keyed by it, and
+        # readers can never observe a successor request's tokens.
+        self.slot_req: List[Optional[int]] = [None] * self.B
+        self._req_seq = 0
+        self._req_slot: Dict[int, int] = {}
+        self._results: Dict[int, List[int]] = {}
+        self._done_ev: Dict[int, threading.Event] = {}
+        self._discarded: set = set()
+        self.failed: Optional[BaseException] = None
+        self._free = list(range(self.B))
+        self._free_cv = threading.Condition(self.lock)
+        self._rng = jax.random.key(seed)
+        self._draws = 0
+
+        # ---- compiled units ----
+        def _prefill_one(params, tokens, length):
+            # [1, S] -> (logits [1, V], k/v [L, 1, S, KVH, hd], pos [1])
+            logits, cache = prefill(params, tokens, self.cfg, tokens.shape[1],
+                                    lengths=length)
+            return logits[0], cache.k[:, 0], cache.v[:, 0]
+
+        self._prefill_one = jax.jit(_prefill_one)
+
+        def _splice(ck, cv, pos, cur, slot_k, slot_v, slot_pos,
+                    slot_tok, slot):
+            # Insert one request's prefilled K/V + state into slot `slot`.
+            ck = jax.lax.dynamic_update_slice(
+                ck, slot_k[:, None], (0, slot, 0, 0, 0))
+            cv = jax.lax.dynamic_update_slice(
+                cv, slot_v[:, None], (0, slot, 0, 0, 0))
+            pos = pos.at[slot].set(slot_pos)
+            cur = cur.at[slot].set(slot_tok)
+            return ck, cv, pos, cur
+
+        self._splice = jax.jit(_splice)
+
+        def _tick(params, cache, cur, rng, temps):
+            logits, cache = decode_step(params, cache, cur, self.cfg)
+            greedy = jnp.argmax(logits, -1).astype(jnp.int32)
+            scaled = logits / jnp.maximum(temps[:, None], 1e-6)
+            sampled = jax.random.categorical(rng, scaled).astype(jnp.int32)
+            nxt = jnp.where(temps <= 0.0, greedy, sampled)
+            return nxt, logits, cache
+
+        self._tick = jax.jit(_tick)
+
+    # ------------------------------------------------------------ requests
+
+    def submit(self, tokens, *, max_new_tokens: Optional[int] = None,
+               temperature: float = 0.0, eos_id: Optional[int] = None,
+               timeout: Optional[float] = None) -> int:
+        """Attach a request to a free slot (blocking while all slots busy).
+        Returns a stable REQUEST id; poll with peek(), collect with
+        result() — valid even after the slot is recycled."""
+        jnp = self._jnp
+        ids = np.asarray(tokens, np.int32)
+        if ids.ndim != 1 or ids.size == 0:
+            raise ValueError("tokens must be a non-empty 1-D integer list")
+        ids = ids[-self.max_prompt_len:]
+        S = bucket_len(len(ids), self.max_prompt_len)
+        padded = np.zeros((1, S), np.int32)
+        padded[0, :len(ids)] = ids
+        # Prefill OUTSIDE the engine lock (seconds on first compile).
+        logits1, k1, v1 = self._prefill_one(
+            self.params, jnp.asarray(padded),
+            jnp.asarray([len(ids)], jnp.int32))
+        # Pad the slot K/V out to the engine max_len on the host once.
+        pad = self.max_len - S
+        if pad:
+            k1 = jnp.pad(k1, ((0, 0), (0, pad), (0, 0), (0, 0)))
+            v1 = jnp.pad(v1, ((0, 0), (0, pad), (0, 0), (0, 0)))
+        with self._free_cv:
+            while not self._free:
+                if not self._free_cv.wait(timeout=timeout):
+                    raise TimeoutError("no free generation slot")
+            slot = self._free.pop()
+            self._req_seq += 1
+            req = self._req_seq
+            self.slot_req[slot] = req
+            self._req_slot[req] = slot
+            self._done_ev[req] = threading.Event()
+            # First token comes from the prefill logits, decided under the
+            # lock with the slot's sampling config.
+            first = self._pick_host(np.asarray(logits1), temperature)
+            n = min(max_new_tokens or self.max_new, self.max_new)
+            self.active[slot] = True
+            self.budget[slot] = n - 1
+            self.eos[slot] = eos_id
+            self.temp[slot] = temperature
+            self.out[slot] = [int(first)]
+            ck, cv, pos, cur = self._splice(
+                self.cache.k, self.cache.v, self.cache.pos, self.cur_tok,
+                k1, v1, jnp.asarray(len(ids), jnp.int32),
+                jnp.asarray(int(first), jnp.int32), slot)
+            from ray_tpu.models.generate import KVCache
+
+            self.cache = KVCache(k=ck, v=cv, pos=pos)
+            self.cur_tok = cur
+            if self.budget[slot] <= 0 or (eos_id is not None
+                                          and int(first) == eos_id):
+                self._retire_locked(slot)
+            return req
+
+    def _pick_host(self, logits: np.ndarray, temperature: float) -> int:
+        if temperature <= 0:
+            return int(np.argmax(logits))
+        jax = self._jax
+        self._draws += 1
+        key = jax.random.fold_in(self._rng, self._draws)
+        return int(jax.random.categorical(
+            key, self._jnp.asarray(logits) / max(temperature, 1e-6)))
+
+    def _retire_locked(self, slot: int) -> None:
+        self.active[slot] = False
+        req = self.slot_req[slot]
+        if req is not None:
+            if req in self._discarded:
+                # Consumer went away mid-stream: drop the output instead
+                # of storing it for a reader that will never come.
+                self._discarded.discard(req)
+                self._done_ev.pop(req, None)
+            else:
+                self._results[req] = list(self.out[slot])
+                self._done_ev[req].set()
+            self._req_slot.pop(req, None)
+            self.slot_req[slot] = None
+        self._free.append(slot)
+        self._free_cv.notify_all()
+
+    def discard(self, req: int) -> None:
+        """Consumer abandoned the request (client disconnect): release its
+        stored output now, or mark it to be dropped at retirement — either
+        way no per-request state outlives the reader."""
+        with self.lock:
+            if req in self._results or (req in self._done_ev
+                                        and req not in self._req_slot):
+                self._results.pop(req, None)
+                self._done_ev.pop(req, None)
+                return
+            slot = self._req_slot.get(req)
+            if slot is not None:
+                self._discarded.add(req)
+                self.budget[slot] = 0  # retire at the next tick
+
+    # ---------------------------------------------------------------- tick
+
+    def tick(self) -> int:
+        """One decode step for every active slot; returns #active after.
+
+        The whole tick holds the engine lock: a snapshot-compute-swap
+        design would let a submit() splice land between snapshot and swap
+        and be ERASED by the swap. submit's slow part (prefill compile/run)
+        is outside the lock, so attaches wait at most one tick for the
+        fast splice. Inactive slots compute garbage rows (their pos keeps
+        advancing; writes clamp harmlessly) — the price of one compiled
+        program; a splice fully re-initializes a slot on attach."""
+        jax, jnp = self._jax, self._jnp
+        with self.lock:
+            if not any(self.active):
+                return 0
+            self._draws += 1
+            key = jax.random.fold_in(self._rng, self._draws)
+            temps = jnp.asarray(self.temp)
+            nxt, logits, cache = self._tick(
+                self.params, self.cache, self.cur_tok, key, temps)
+            nxt_host = np.asarray(nxt)
+            self.cache = cache
+            self.cur_tok = nxt
+            for s in range(self.B):
+                if not self.active[s]:
+                    continue
+                tok = int(nxt_host[s])
+                self.out[s].append(tok)
+                self.budget[s] -= 1
+                if self.budget[s] <= 0 or (self.eos[s] is not None
+                                           and tok == self.eos[s]):
+                    self._retire_locked(s)
+            return sum(self.active)
+
+    # ------------------------------------------------------------- results
+
+    def result(self, req: int, timeout: Optional[float] = None) -> List[int]:
+        """Block until the request finished; returns its tokens. The
+        result stays retrievable (and peek-able) after slot recycling;
+        pop_result() releases it."""
+        ev = self._done_ev.get(req)
+        if ev is None:
+            raise KeyError(f"unknown request {req}")
+        if not ev.wait(timeout=timeout):
+            raise TimeoutError(f"request {req} still generating")
+        with self.lock:
+            if self.failed is not None and req not in self._results:
+                raise RuntimeError(
+                    f"generation engine failed: {self.failed!r}")
+            return list(self._results[req])
+
+    def pop_result(self, req: int) -> List[int]:
+        """result() + release the stored output (bounds memory for
+        long-running engines)."""
+        out = self.result(req)
+        with self.lock:
+            self._results.pop(req, None)
+            self._done_ev.pop(req, None)
+        return out
+
+    def is_done(self, req: int) -> bool:
+        ev = self._done_ev.get(req)
+        return ev is not None and ev.is_set()
+
+    def check_failed(self) -> Optional[BaseException]:
+        return self.failed
+
+    def peek(self, req: int) -> List[int]:
+        """Tokens emitted so far (streaming consumers poll this)."""
+        with self.lock:
+            done = self._results.get(req)
+            if done is not None:
+                return list(done)
+            slot = self._req_slot.get(req)
+            if slot is None:
+                raise KeyError(f"unknown request {req}")
+            return list(self.out[slot])
+
+    # ------------------------------------------------------- driver thread
+
+    def run_forever(self, stop: threading.Event, idle_sleep: float = 0.005):
+        """Tick loop for a background thread: ticks while any slot is
+        active, sleeps briefly when idle."""
+        import time
+
+        while not stop.is_set():
+            try:
+                n = self.tick()
+            except BaseException as e:  # device/runtime failure
+                # A dead ticker must not strand pollers: record the
+                # failure, wake every waiter, and stop. is_done()/result()
+                # surface the error instead of hanging forever.
+                with self.lock:
+                    self.failed = e
+                    for ev in self._done_ev.values():
+                        ev.set()
+                    self._free_cv.notify_all()
+                return
+            if n == 0:
+                time.sleep(idle_sleep)

@@ -428,3 +428,40 @@ def test_streaming_llm_tokens_arrive_incrementally(ray_start_regular):
         assert [c["token"] for c in out2] == exp[:2]
     finally:
         serve.shutdown()
+
+
+def test_streaming_llm_continuous_batching(ray_start_regular):
+    """continuous_batching=True: concurrent streams share one decode tick
+    and each still matches isolated greedy generation exactly."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ray_tpu import serve
+    from ray_tpu.models import generate as gen_fn
+    from ray_tpu.models import transformer as tfm
+    from ray_tpu.models.configs import llama_tiny
+    from ray_tpu.serve.llm import build_streaming_llm_deployment
+
+    cfg = llama_tiny(remat=False)
+
+    def factory():
+        return tfm.init_params(jax.random.key(0), cfg)
+
+    LLM = build_streaming_llm_deployment(
+        cfg, factory, name="cb-llm", max_prompt_len=16, max_new_tokens=4,
+        continuous_batching=True, num_slots=2)
+    handle = serve.run(LLM.bind())
+    try:
+        params = factory()
+        prompts = [[3, 1, 4, 1], [5, 9], [2, 6, 5, 3, 5]]
+        streams = [handle.options(stream=True).remote({"tokens": p})
+                   for p in prompts]
+        for p, st in zip(prompts, streams):
+            toks = [c["token"] for c in st]
+            exp = np.asarray(gen_fn(
+                params, jnp.asarray([p], jnp.int32), cfg,
+                max_new_tokens=4))[0, len(p):].tolist()
+            assert toks == exp, (p, toks, exp)
+    finally:
+        serve.shutdown()
