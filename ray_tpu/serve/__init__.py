@@ -16,6 +16,7 @@ from .batching import batch
 from .multiplex import get_multiplexed_model_id, multiplexed
 from .deployment import Application, AutoscalingConfig, Deployment, deployment
 from .llm import build_llm_deployment, build_streaming_llm_deployment
+from .llm_engine import ContinuousBatchingEngine
 from .handle import (DeploymentHandle, DeploymentResponse,
                      DeploymentStreamingResponse)
 
@@ -39,4 +40,5 @@ __all__ = [
     "batch",
     "build_llm_deployment",
     "build_streaming_llm_deployment",
+    "ContinuousBatchingEngine",
 ]
