@@ -209,6 +209,9 @@ def test_workflow_parallel_branches(ray_start_regular, tmp_path):
         return x
 
     dag = add.bind(slow.bind(1), slow.bind(2))
+    # Warm two workers BEFORE the timed window: fresh-cluster spawns cost
+    # ~0.9s and belong to neither regime being separated.
+    ray_tpu.get([slow.remote(0), slow.remote(0)])
     t0 = time.perf_counter()
     assert workflow.run(dag, storage=str(tmp_path)) == 3
     wall = time.perf_counter() - t0
