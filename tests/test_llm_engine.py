@@ -131,3 +131,18 @@ def test_discard_releases_state_and_ticker_failure_surfaces(engine_setup):
     with pytest.raises(RuntimeError, match="engine failed"):
         eng.result(r3, timeout=5)
     eng._tick = orig
+
+
+def test_sampled_slots_vary_and_respect_budget(engine_setup):
+    cfg, params = engine_setup
+    outs = []
+    for seed in (1, 2):
+        eng = ContinuousBatchingEngine(cfg, params, num_slots=2,
+                                       max_prompt_len=16, max_new_tokens=5,
+                                       seed=seed)
+        r = eng.submit([5, 9, 2], temperature=1.1)
+        while eng.tick():
+            pass
+        outs.append(eng.result(r, timeout=60))
+    assert all(len(o) == 5 for o in outs)
+    assert outs[0] != outs[1], "different seeds sampled identical streams"
