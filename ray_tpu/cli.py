@@ -62,7 +62,12 @@ def cmd_start(args) -> int:
             # counts plus pod-scoped custom resources — a CLI-started head
             # must schedule identically to an init()-started one.
             res.update(detect_node_accelerator_resources())
-            controller.add_node(res, labels={"head": "1"})
+            if args.resources:
+                res.update(json.loads(args.resources))
+            # ensure_head_node: a restart with --state-path reuses the
+            # persisted head-node identity so surviving workers of the
+            # previous controller can reconnect under their node id.
+            controller.ensure_head_node(res, labels={"head": "1"})
             addr = f"{host}:{port}"
             with open(_ADDRFILE, "w") as f:
                 f.write(addr)
@@ -368,9 +373,11 @@ def main(argv=None) -> int:
     p.add_argument("--address", default=None, help="join an existing head")
     p.add_argument("--port", type=int, default=0)
     p.add_argument("--num-cpus", type=int, default=None)
+    p.add_argument("--resources", default=None,
+                   help='extra head-node resources, JSON (e.g. {"TPU": 4})')
     p.add_argument("--state-path", default=None,
-                   help="persist controller state (KV, detached actors) "
-                        "across head restarts")
+                   help="persist controller state (KV, detached actors, "
+                        "node table) across head restarts")
     p.set_defaults(fn=cmd_start)
 
     p = sub.add_parser("stop", help="stop the head started on this machine")

@@ -65,7 +65,9 @@ def init(
             # address via env (reference: RAY_ADDRESS).
             address = flags.get("RTPU_ADDRESS") or None
 
+        owned = False
         if address is None:
+            owned = True
             io = EventLoopThread(name="rtpu-controller")
             controller = Controller()
             host, port = io.call(controller.start(), timeout=10)
@@ -105,7 +107,10 @@ def init(
                         pass
             if resources:
                 node_res.update(resources)
-            node_id = controller.add_node(node_res, labels={"head": "1"})
+            # ensure_head_node: a state-path restore brings back the prior
+            # head node — reuse its identity instead of adding a duplicate.
+            node_id = controller.ensure_head_node(node_res,
+                                                  labels={"head": "1"})
             _owned_controller = controller
             _controller_io = io
             address = f"{host}:{port}"
@@ -113,7 +118,13 @@ def init(
             node_id = ""
 
         host, port_s = address.rsplit(":", 1)
-        client = CoreClient(host, int(port_s), handler=_driver_handler)
+        # Drivers of a REMOTE controller survive a controller bounce: the
+        # client reconnects with capped backoff, re-registers, and resubmits
+        # in-flight plain tasks (an embedded controller dies with this
+        # process, so reconnect would only mask real shutdown races).
+        client = CoreClient(host, int(port_s), handler=_driver_handler,
+                            reconnect=not owned,
+                            on_reconnect=_driver_on_reconnect)
         reg = client.request({"kind": "register", "role": "driver"})
         # A driver on a host with no pull server (neither the controller's
         # host nor an agent's) cannot serve its shm objects to workers: its
@@ -162,6 +173,85 @@ async def _driver_handler(conn, msg):
         except Exception:
             pass
     return None
+
+
+def _driver_on_reconnect(client: CoreClient) -> None:
+    """Runs on the fresh connection after a controller bounce, before any
+    retried request goes out: re-register as a driver, drop task-lease
+    pools the restarted controller knows nothing about, and resubmit
+    in-flight plain tasks so blocked get()s complete without a driver
+    restart (at-least-once for retryable work; actor routes stay — live
+    actor workers keep serving direct calls through the bounce)."""
+    client.io.call(
+        client.conn.request({"kind": "register", "role": "driver"}),
+        timeout=30)
+    # Rotate the client token: per-session caches keyed on it (function
+    # registrations, actor routes) re-validate against the restarted
+    # controller instead of trusting state it may not have. (Functions of
+    # ALREADY in-flight specs come from the --state-path function table.)
+    import secrets
+
+    client.token = secrets.token_hex(8)
+    # The restarted controller has no lease ledger: forget leased routes so
+    # fresh leases are negotiated (the workers themselves re-register as
+    # idle). Conn closes are fire-and-forget on the io loop.
+    for pool in list(_task_pools.values()):
+        with pool.lock:
+            routes, pool.routes = pool.routes, []
+        for r in routes:
+            try:
+                client.io.call_nowait(r.conn.close())
+            except Exception:
+                pass
+    _task_pools.clear()
+    with _inflight_lock:
+        specs = [dict(s) for s in _inflight_specs.values()]
+    for spec in specs:
+        # Stale placement/dispatch residue must not ride the resubmit.
+        for k in ("loc_hints", "sched_node", "blocked", "state"):
+            spec.pop(k, None)
+        try:
+            client.io.call(
+                client.conn.request({"kind": "submit_task", "spec": spec}),
+                timeout=30)
+        except Exception:
+            pass
+
+
+# In-flight plain-task specs for controller-bounce resubmission: task_id ->
+# spec, retired when any return location is observed (get()/direct reply),
+# bounded so fire-and-forget callers can't grow it without limit.
+from collections import OrderedDict as _OrderedDict
+
+_inflight_lock = threading.Lock()
+_INFLIGHT_MAX = 4096
+_inflight_specs: "_OrderedDict[str, Dict[str, Any]]" = _OrderedDict()
+_inflight_oid2task: Dict[str, str] = {}
+
+
+def _track_inflight(spec: Dict[str, Any]) -> None:
+    if spec.get("actor_id") or spec.get("is_actor_creation") \
+            or spec.get("streaming") or not spec.get("return_ids"):
+        return
+    with _inflight_lock:
+        _inflight_specs[spec["task_id"]] = spec
+        for oid in spec["return_ids"]:
+            _inflight_oid2task[oid] = spec["task_id"]
+        while len(_inflight_specs) > _INFLIGHT_MAX:
+            _, old = _inflight_specs.popitem(last=False)
+            for oid in old.get("return_ids") or ():
+                _inflight_oid2task.pop(oid, None)
+
+
+def _untrack_inflight(object_id: str) -> None:
+    if object_id not in _inflight_oid2task:
+        return
+    with _inflight_lock:
+        tid = _inflight_oid2task.pop(object_id, None)
+        spec = _inflight_specs.pop(tid, None) if tid else None
+        if spec:
+            for oid in spec.get("return_ids") or ():
+                _inflight_oid2task.pop(oid, None)
 
 
 def _atexit_shutdown() -> None:
@@ -564,6 +654,7 @@ class RemoteFunction:
             _streaming_spec_opts(opts, spec)
         _register_dep_holds(spec, nested_refs)
         tracing.inject_submit_span(spec, spec["label"])
+        _track_inflight(spec)
         # Lease-then-push direct path first; the controller queue is the
         # fallback (and the only path for pg/affinity/streaming tasks).
         if not _try_direct_task(wc, spec, opts):
@@ -718,8 +809,10 @@ def _cache_loc(loc) -> None:
         _local_locs.popitem(last=False)
     # A visible location/error for a task return means the spec is no longer
     # in flight — the submitter's dep holds can go (ownership protocol;
-    # no-op for oids this process didn't submit).
+    # no-op for oids this process didn't submit), and the spec leaves the
+    # controller-bounce resubmission buffer.
     ownership.on_return_location(loc.object_id)
+    _untrack_inflight(loc.object_id)
 
 
 _actor_seqnos: Dict[str, int] = {}
@@ -892,6 +985,9 @@ def _reset_direct_state(wc=None) -> None:
     _local_locs.clear()
     _inflight_direct.clear()
     _direct_task_meta.clear()
+    with _inflight_lock:
+        _inflight_specs.clear()
+        _inflight_oid2task.clear()
 
 
 # ---- task leases (direct stateless-task dispatch) --------------------------
@@ -1219,14 +1315,13 @@ def _direct_task_failure(wc, pool: "_TaskRoutePool", route: "_TaskRoute",
 def _pipelined_submit(wc, msg: Dict[str, Any], return_ids) -> None:
     """Submit without waiting for the controller's ack (the reply is
     pipelined on the connection, so ordering with later requests holds).
-    A submission failure surfaces as error locations on the return ids —
-    the same channel task-execution errors use."""
+    A connection drop retries through the client's reconnect path (the
+    controller may just be bouncing — puts/submits in flight survive);
+    a real submission failure surfaces as error locations on the return
+    ids — the same channel task-execution errors use."""
     fut = wc.client.conn.request_threadsafe(msg)
 
-    def done(f, wc=wc, return_ids=tuple(return_ids)):
-        exc = f.exception()
-        if exc is None:
-            return
+    def fail(exc, return_ids):
         import pickle as _p
         import sys as _sys
 
@@ -1245,6 +1340,26 @@ def _pipelined_submit(wc, msg: Dict[str, Any], return_ids) -> None:
                 wc.client.send_nowait({"kind": "put_location", "loc": loc})
             except Exception:
                 pass
+
+    def done(f, wc=wc, msg=msg, return_ids=tuple(return_ids)):
+        exc = f.exception()
+        if exc is None:
+            return
+        if (isinstance(exc, ConnectionError)
+                and wc.client.reconnect_enabled
+                and not wc.client._closed):
+            # Controller bounce mid-flight: re-issue through the blocking
+            # client (it reconnects with backoff) off the io thread.
+            def _retry():
+                try:
+                    wc.client.request(msg)
+                except Exception as e2:  # noqa: BLE001
+                    fail(e2, return_ids)
+
+            threading.Thread(target=_retry, daemon=True,
+                             name="submit-retry").start()
+            return
+        fail(exc, return_ids)
 
     fut.add_done_callback(done)
 

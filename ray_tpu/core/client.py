@@ -4,14 +4,28 @@ Both the driver and every worker process embed one of these — the analog of
 the reference's CoreWorker library (ray: src/ray/core_worker/core_worker.h:292)
 being linked into driver and worker processes alike. A dedicated thread runs
 the asyncio loop; public methods are thread-safe and synchronous.
+
+Fault tolerance: with ``reconnect=True`` the client survives a controller
+bounce (reference: the GCS client's reconnection on NotifyGCSRestart,
+gcs_rpc_client reconnect window). A request that fails on a dropped
+connection re-dials with capped exponential backoff until
+``RTPU_RECONNECT_MAX_S`` passes, then raises ConnectionError cleanly. On a
+successful reconnect the owner's ``on_reconnect`` hook runs first (it
+re-registers identity / re-reports state on the NEW connection) and the
+client replays its pubsub subscriptions.
 """
 from __future__ import annotations
 
 import asyncio
 import threading
+import time
 from typing import Any, Awaitable, Callable, Dict, Optional
 
+from ray_tpu import flags
+
 from . import protocol
+
+_BACKOFF_CAP_S = 2.0
 
 
 class EventLoopThread:
@@ -53,21 +67,125 @@ class CoreClient:
         port: int,
         handler: Optional[Callable[[protocol.Connection, Dict[str, Any]], Awaitable[Any]]] = None,
         loop_thread: Optional[EventLoopThread] = None,
+        reconnect: bool = False,
+        on_reconnect: Optional[Callable[["CoreClient"], None]] = None,
     ):
         self.io = loop_thread or EventLoopThread()
         self.host = host
         self.port = port
+        self.handler = handler
+        self.reconnect_enabled = reconnect
+        # Called (on the reconnecting thread) after a NEW connection is up,
+        # before any retried request goes out: re-register identity,
+        # re-report held state. Exceptions here fail the reconnect attempt.
+        self.on_reconnect = on_reconnect
+        self._closed = False
+        # RLock: on_reconnect re-enters request()/ensure_connected() while
+        # re-registering on the fresh connection.
+        self._reconnect_lock = threading.RLock()
+        self._subscriptions: set = set()
         # Stable identity for caches keyed per-connection (id() of a freed
         # client can be reused by a new one after shutdown/re-init).
         import secrets
 
         self.token = secrets.token_hex(8)
-        self.conn: protocol.Connection = self.io.call(
-            protocol.connect(host, port, handler, name=f"client->{host}:{port}"), timeout=10
+        self.conn: protocol.Connection = self._connect_once()
+
+    def _connect_once(self) -> protocol.Connection:
+        return self.io.call(
+            protocol.connect(self.host, self.port, self.handler,
+                             name=f"client->{self.host}:{self.port}"),
+            timeout=10,
         )
 
+    # ------------------------------------------------------------- reconnect
+
+    def ensure_connected(self) -> None:
+        """Re-dial a dropped connection with capped exponential backoff.
+
+        No-op while the current connection is live. Raises ConnectionError
+        once ``RTPU_RECONNECT_MAX_S`` passes without a successful dial —
+        a permanently dead controller fails callers cleanly instead of
+        hanging them forever.
+        """
+        if self._closed:
+            raise ConnectionError("client is closed")
+        if not self.conn.closed.is_set():
+            return
+        with self._reconnect_lock:
+            if self._closed:
+                raise ConnectionError("client is closed")
+            if not self.conn.closed.is_set():
+                return  # another thread already reconnected
+            if not self.reconnect_enabled:
+                raise ConnectionError(
+                    f"connection to {self.host}:{self.port} is closed")
+            max_s = flags.get("RTPU_RECONNECT_MAX_S")
+            deadline = time.monotonic() + max_s
+            backoff = flags.get("RTPU_RECONNECT_BACKOFF_S")
+
+            def _pause(e: BaseException) -> None:
+                now = time.monotonic()
+                if now >= deadline or self._closed:
+                    raise ConnectionError(
+                        f"controller at {self.host}:{self.port} "
+                        f"unreachable after {max_s:.0f}s "
+                        f"({type(e).__name__}: {e})") from None
+                time.sleep(min(backoff, max(0.0, deadline - now)))
+
+            while True:
+                try:
+                    conn = self._connect_once()
+                except Exception as e:
+                    _pause(e)
+                    backoff = min(backoff * 2, _BACKOFF_CAP_S)
+                    continue
+                self.conn = conn
+                try:
+                    # Identity first (register/re-report), then
+                    # subscriptions: the hook is what makes the restarted
+                    # controller recognize us.
+                    if self.on_reconnect is not None:
+                        self.on_reconnect(self)
+                    for channel in sorted(self._subscriptions):
+                        try:
+                            self.io.call(self.conn.request(
+                                {"kind": "subscribe", "channel": channel}),
+                                timeout=10)
+                        except Exception:
+                            pass
+                    return
+                except ConnectionError as e:
+                    # The FRESH connection died mid-handshake — the
+                    # controller bounced again under us. Not fatal: keep
+                    # dialing until the deadline.
+                    try:
+                        self.io.call_nowait(conn.close())
+                    except Exception:
+                        pass
+                    _pause(e)
+                    backoff = min(backoff * 2, _BACKOFF_CAP_S)
+
     def request(self, msg: Dict[str, Any], timeout: Optional[float] = None) -> Any:
-        return self.io.call(self.conn.request(msg, timeout), timeout=None)
+        if msg.get("kind") == "subscribe" and msg.get("channel"):
+            self._subscriptions.add(msg["channel"])
+        retry_deadline: Optional[float] = None
+        while True:
+            try:
+                return self.io.call(self.conn.request(msg, timeout), timeout=None)
+            except ConnectionError:
+                if self._closed or not self.reconnect_enabled:
+                    raise
+                # One retry window across flapping reconnects: each
+                # ensure_connected has its own backoff deadline, but a
+                # connection that dies between reconnect and retry must not
+                # extend the overall budget forever.
+                if retry_deadline is None:
+                    retry_deadline = (time.monotonic()
+                                      + flags.get("RTPU_RECONNECT_MAX_S"))
+                elif time.monotonic() >= retry_deadline:
+                    raise
+                self.ensure_connected()
 
     def request_async(self, msg: Dict[str, Any]) -> "asyncio.Future":
         return self.io.call_nowait(self.conn.request(msg))
@@ -81,6 +199,7 @@ class CoreClient:
         self.io.call_nowait(self.conn.send(msg))
 
     def close(self) -> None:
+        self._closed = True
         try:
             self.io.call(self.conn.close(), timeout=2)
         except Exception:

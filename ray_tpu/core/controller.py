@@ -324,6 +324,16 @@ class Controller:
         loop = asyncio.get_running_loop()
         self._sched_task = loop.create_task(self._scheduler_loop())
         self._health_task = loop.create_task(self._health_check_loop())
+        if getattr(self, "_restored_detached", None):
+            # Restored detached actors re-create right after the adoption
+            # grace window, independent of the health loop's cadence.
+            async def _resume_after_grace():
+                await asyncio.sleep(
+                    max(0.0, self._adopt_grace_until - time.monotonic())
+                    + 0.05)
+                self._resume_detached_actors()
+
+            loop.create_task(_resume_after_grace())
         if flags.get("RTPU_MEMORY_MONITOR"):
             self._memory_task = loop.create_task(self._memory_monitor_loop())
         # Prometheus scrape endpoint (GET /metrics) on an ephemeral port,
@@ -359,8 +369,43 @@ class Controller:
             labels=labels or {},
             tpu_free=list(range(int(resources.get("TPU", 0)))),
         )
+        self._state_dirty = True  # node table persists across restarts
         self._wake_scheduler()
         return nid
+
+    def ensure_head_node(
+        self,
+        resources: Dict[str, float],
+        labels: Optional[Dict[str, str]] = None,
+    ) -> str:
+        """add_node, unless the state snapshot restored a head node — then
+        reuse its identity so workers of the pre-restart controller can
+        reconnect under the node id they were spawned with. Capacity is
+        refreshed to the caller's view; consumption by adopted workers and
+        actors is re-applied as they re-register."""
+        for n in self.nodes.values():
+            if n.labels.get("head") == "1" and n.agent_conn is None:
+                n.resources = dict(resources)
+                n.available = dict(resources)
+                n.labels.update(labels or {})
+                n.alive = True
+                # Workers/actors that re-registered before this call keep
+                # their grants: re-apply their chip and resource claims to
+                # the refreshed capacity instead of clobbering them.
+                held = {
+                    c for wid in n.workers
+                    for c in (self.workers[wid].chip_ids
+                              if wid in self.workers else ())
+                }
+                n.tpu_free = [c for c in
+                              range(int(resources.get("TPU", 0)))
+                              if c not in held]
+                for a in self.actors.values():
+                    if a.reserved and a.node_id == n.node_id:
+                        _res_sub(n.available, a.resources)
+                self._wake_scheduler()
+                return n.node_id
+        return self.add_node(resources, labels)
 
     async def shutdown(self) -> None:
         self._closing = True
@@ -673,7 +718,16 @@ class Controller:
             return {"ok": True, "controller_host_id": self.host_id}
         worker_id = msg["worker_id"]
         node_id = msg["node_id"]
+        reconnect = bool(msg.get("reconnect"))
+        node = self.nodes.get(node_id)
         w = self.workers.get(worker_id)
+        if reconnect and w is None and node is None:
+            # The worker outlived a controller restart but its node hasn't
+            # (re-)registered yet — its host agent may still be dialing.
+            # Ask the worker to retry instead of adopting it onto a node
+            # the scheduler doesn't know (reconcile, don't trust blindly).
+            return {"ok": False, "retry": True}
+        adopted = reconnect and w is None
         if w is not None:
             w.conn = conn  # reconnect
             w.direct_port = int(msg.get("direct_port") or 0)
@@ -706,16 +760,95 @@ class Controller:
             w.chip_ids = (self._chip_alloc.pop(token, None)
                           or list(msg.get("chip_ids") or [])) \
                 if w.tpu_capable else []
-        node = self.nodes.get(node_id)
         if node:
             node.workers.add(worker_id)
-            node.spawning = max(0, node.spawning - 1)
-            if was_tpu_spawn:
-                node.spawning_tpu = max(0, node.spawning_tpu - 1)
-            if token:
-                self._release_env_spawn(node, token)
+            if not reconnect:
+                node.spawning = max(0, node.spawning - 1)
+                if was_tpu_spawn:
+                    node.spawning_tpu = max(0, node.spawning_tpu - 1)
+                if token:
+                    self._release_env_spawn(node, token)
+            elif adopted and w.chip_ids and node.agent_conn is None:
+                # Chip reconciliation on re-register after a controller
+                # restart: the restored node's free pool starts full, and
+                # this worker's grant must leave it — free-pool and granted
+                # sets stay disjoint (no chip double-allocation).
+                taken = set(w.chip_ids)
+                node.tpu_free = [c for c in node.tpu_free if c not in taken]
+        drop = await self._adopt_worker_actors(w, node, msg)
         self._wake_scheduler()
-        return {"ok": True}
+        return {"ok": True, "drop_actors": drop}
+
+    async def _adopt_worker_actors(
+        self, w: WorkerInfo, node: Optional[NodeInfo], msg: Dict[str, Any]
+    ) -> List[str]:
+        """Reconcile actors a re-registering worker claims to host
+        (reference: gcs_actor_manager rebuilding the actor directory from
+        worker re-reports on GCS failover). The live instance wins over a
+        queued re-creation; a re-creation already dispatched (or finished)
+        elsewhere wins over the stale claimant, which is told to drop it."""
+        drop: List[str] = []
+        adopted: List[ActorInfo] = []
+        for aspec in msg.get("actors") or ():
+            aid = aspec["actor_id"]
+            actor = self.actors.get(aid)
+            if actor is None:
+                # Non-detached actor (not persisted): rebuild the directory
+                # entry from the worker's report. No creation spec — a later
+                # crash of this worker kills the actor for good.
+                actor = ActorInfo(
+                    actor_id=aid,
+                    name=aspec.get("name"),
+                    resources=dict(aspec.get("resources") or {}),
+                    detached=bool(aspec.get("detached")),
+                    max_restarts=int(aspec.get("max_restarts", 0)),
+                )
+                self.actors[aid] = actor
+                if aspec.get("name"):
+                    key = (aspec.get("namespace", "default"), aspec["name"])
+                    cur = self.named_actors.get(key)
+                    if cur is None or self.actors[cur].state == "dead":
+                        self.named_actors[key] = aid
+            if actor.state == "dead":
+                drop.append(aid)
+                continue
+            if actor.state == "alive" and actor.worker_id not in (
+                    None, w.worker_id):
+                drop.append(aid)  # already re-created elsewhere
+                continue
+            ctid = actor.creation_task_id
+            cspec = self.tasks.get(ctid) if ctid else None
+            if cspec is not None:
+                if cspec.get("sched_node"):
+                    # Re-creation already dispatched: that instance wins.
+                    drop.append(aid)
+                    continue
+                # Still queued: cancel it — the live instance keeps serving
+                # with its state intact (the whole point of adoption).
+                self.tasks.pop(ctid, None)
+                self.pending_queue.remove(ctid)
+            actor.worker_id = w.worker_id
+            actor.node_id = w.node_id
+            w.actor_ids.add(aid)
+            w.state = "actor"
+            if node is not None and not actor.reserved and actor.pg is None:
+                _res_sub(node.available, actor.resources)
+                actor.reserved = True
+            adopted.append(actor)
+        for actor in adopted:
+            # Same drain-before-alive ordering as _h_actor_ready: queued
+            # calls dispatch before the direct address is handed out.
+            while actor.pending_calls:
+                calls, actor.pending_calls = actor.pending_calls, []
+                for call in calls:
+                    await self._dispatch_actor_call(actor, call)
+            actor.state = "alive"
+            self._export_event("ACTOR", {"actor_id": actor.actor_id,
+                                         "event": "adopted",
+                                         "name": actor.name,
+                                         "node_id": actor.node_id,
+                                         "ts": time.time()})
+        return drop
 
     def _release_env_spawn(self, node: Optional[NodeInfo], token: str) -> None:
         eh = self._spawn_env_hash.pop(token, None)
@@ -1897,6 +2030,7 @@ class Controller:
                     "state": w.state,
                     "current_task": w.current_task,
                     "tpu_capable": w.tpu_capable,
+                    "chip_ids": list(w.chip_ids),
                     # Joins the agent heartbeat proc_stats (cpu/rss by pid).
                     "pid": w.pid,
                 }
@@ -2107,6 +2241,9 @@ class Controller:
                     "index": n.index,
                     "num_workers": len(n.workers),
                     "mem_fraction": n.mem_fraction,
+                    # Unallocated chip ids (local-spawn nodes): chaos tests
+                    # assert free-pool/granted disjointness across restarts.
+                    "tpu_free": list(n.tpu_free),
                     # Per-worker-process cpu%/rss (agent heartbeats;
                     # dashboard reporter parity). Empty for virtual nodes.
                     "proc_stats": dict(n.proc_stats),
@@ -2133,21 +2270,46 @@ class Controller:
     # host agents -------------------------------------------------------------
 
     async def _h_register_node(self, conn, msg):
-        """A host agent joins the cluster (reference: raylet node
-        registration with the GCS, gcs_node_manager.h)."""
+        """A host agent joins — or, after a controller/agent bounce,
+        re-joins — the cluster (reference: raylet node registration with
+        the GCS, gcs_node_manager.h; re-registration on NotifyGCSRestart,
+        node_manager.proto:373)."""
         nid = msg["node_id"]
-        self._node_counter += 1
-        self.nodes[nid] = NodeInfo(
-            node_id=nid,
-            resources=dict(msg["resources"]),
-            available=dict(msg["resources"]),
-            index=self._node_counter,
-            labels=msg.get("labels") or {},
-            agent_conn=conn,
-            agent_addr=tuple(msg["agent_addr"]),
-            host_id=msg.get("host_id"),
-            last_heartbeat=time.monotonic(),
-        )
+        node = self.nodes.get(nid)
+        if node is not None:
+            # Re-registration under the same identity: refresh the control
+            # connection and capacity in place. The agent's surviving
+            # workers re-register themselves right after and re-claim their
+            # node slots; spawn counters reset (in-flight spawn bookkeeping
+            # did not survive the bounce — the agent's reap loop reports
+            # any orphaned spawn exits).
+            node.agent_conn = conn
+            node.agent_addr = tuple(msg["agent_addr"])
+            node.host_id = msg.get("host_id") or node.host_id
+            node.resources = dict(msg["resources"])
+            node.available = dict(msg["resources"])
+            node.labels = msg.get("labels") or node.labels
+            node.alive = True
+            node.last_heartbeat = time.monotonic()
+            node.spawning = 0
+            node.spawning_tpu = 0
+            node.spawning_envs.clear()
+            for a in self.actors.values():
+                if a.reserved and a.node_id == nid and a.pg is None:
+                    _res_sub(node.available, a.resources)
+        else:
+            self._node_counter += 1
+            self.nodes[nid] = NodeInfo(
+                node_id=nid,
+                resources=dict(msg["resources"]),
+                available=dict(msg["resources"]),
+                index=self._node_counter,
+                labels=msg.get("labels") or {},
+                agent_conn=conn,
+                agent_addr=tuple(msg["agent_addr"]),
+                host_id=msg.get("host_id"),
+                last_heartbeat=time.monotonic(),
+            )
         self._wake_scheduler()
         return {"ok": True, "controller_host_id": self.host_id}
 
@@ -2203,6 +2365,8 @@ class Controller:
         return read_location_range(msg["loc"], msg["offset"], msg["length"])
 
     def _restore_state(self) -> None:
+        self._restored_detached: List[Dict[str, Any]] = []
+        self._adopt_grace_until = 0.0
         if not self.persist_path or not os.path.exists(self.persist_path):
             return
         import pickle as _p
@@ -2215,6 +2379,23 @@ class Controller:
             return
         self.kv.update(snap.get("kv", {}))
         self.functions.update(snap.get("functions", {}))
+        # Node table (non-agent nodes only — agents re-register themselves):
+        # restored so that surviving workers of the previous controller can
+        # reconnect under their original node ids and so the head node keeps
+        # its identity across a bounce (reference: the GCS node table in
+        # gcs_storage surviving failover).
+        for nd in snap.get("nodes", []):
+            if nd["node_id"] in self.nodes:
+                continue
+            self._node_counter += 1
+            self.nodes[nd["node_id"]] = NodeInfo(
+                node_id=nd["node_id"],
+                resources=dict(nd["resources"]),
+                available=dict(nd["resources"]),
+                index=self._node_counter,
+                labels=dict(nd.get("labels") or {}),
+                tpu_free=list(range(int(nd["resources"].get("TPU", 0)))),
+            )
         # Only resume detached actors that can actually be rebuilt: creation
         # deps died with the old process's object plane, and placement
         # groups are not persisted — resuming those would leave actors
@@ -2236,24 +2417,22 @@ class Controller:
             k: v for k, v in snap.get("named_actors", {}).items()
             if v in resumed_ids
         })
-        self._restored_detached = resumable
         # Register the ActorInfos NOW so get_actor() between start and the
-        # first scheduler pass sees a pending actor, not a missing name.
-        self._resume_detached_actors()
-
-    def _resume_detached_actors(self) -> None:
-        """Re-create detached actors from their persisted creation specs
-        (reference: GCS restart reconstructing actors from storage,
-        gcs_actor_manager RestartActor on GCS failover)."""
-        specs = getattr(self, "_restored_detached", None) or []
-        self._restored_detached = []
-        for spec in specs:
+        # first scheduler pass sees a restarting actor, not a missing name
+        # (calls submitted meanwhile buffer in pending_calls). Re-CREATION
+        # is deferred for an adoption grace window: the previous
+        # controller's workers may still be alive and hosting these very
+        # instances — they re-claim them on reconnect, preserving actor
+        # state (reference: GCS failover waits for raylet/worker
+        # re-registration before reconstructing actors).
+        for spec in resumable:
             actor_id = spec["actor_id"]
             if actor_id in self.actors:
                 continue
-            actor = ActorInfo(
+            self.actors[actor_id] = ActorInfo(
                 actor_id=actor_id,
                 name=spec.get("name"),
+                state="restarting",
                 resources=spec.get("resources", {}),
                 pg=spec.get("pg"),
                 detached=True,
@@ -2261,12 +2440,34 @@ class Controller:
                 max_restarts=int(spec.get("max_restarts", 0)),
                 creation_spec=spec,
             )
-            self.actors[actor_id] = actor
+        self._restored_detached = resumable
+        if resumable:
+            self._adopt_grace_until = (
+                time.monotonic() + flags.get("RTPU_RECONNECT_GRACE_S"))
+
+    def _resume_detached_actors(self) -> None:
+        """Queue creation tasks for restored detached actors that no
+        surviving worker re-claimed within the adoption grace window
+        (reference: GCS restart reconstructing actors from storage,
+        gcs_actor_manager RestartActor on GCS failover)."""
+        specs = getattr(self, "_restored_detached", None) or []
+        if not specs:
+            return
+        if time.monotonic() < self._adopt_grace_until:
+            return  # reconnecting workers get first claim
+        self._restored_detached = []
+        queued = False
+        for spec in specs:
+            actor_id = spec["actor_id"]
+            actor = self.actors.get(actor_id)
+            if actor is None or actor.state in ("alive", "dead"):
+                continue  # adopted by a reconnected worker (or retired)
             spec["state"] = "pending"
             spec.pop("sched_node", None)
             self.tasks[spec["task_id"]] = spec
             self.pending_queue.append(spec)
-        if specs:
+            queued = True
+        if queued:
             self._wake_scheduler()
 
     def _snapshot_state(self, force: bool = False) -> None:
@@ -2290,6 +2491,14 @@ class Controller:
                 k: v for k, v in self.named_actors.items() if v in live_ids
             },
             "detached_actors": detached,
+            # Non-agent nodes (head + virtual): identity + capacity only.
+            # Agent nodes re-register themselves after a restart.
+            "nodes": [
+                {"node_id": n.node_id, "resources": dict(n.resources),
+                 "labels": dict(n.labels)}
+                for n in self.nodes.values()
+                if n.agent_conn is None and n.agent_addr is None and n.alive
+            ],
         }
         tmp = self.persist_path + f".tmp{os.getpid()}"
         try:
@@ -3093,14 +3302,37 @@ class Controller:
                 proc.terminate()
             except Exception:
                 pass
-            await asyncio.sleep(1.0)
+            for _ in range(20):  # up to 2s for a graceful exit
+                await asyncio.sleep(0.1)
+                if proc.poll() is not None:
+                    break
+            else:
+                try:
+                    proc.kill()
+                except Exception:
+                    pass
+                for _ in range(50):  # SIGKILL is definitive, reap it
+                    await asyncio.sleep(0.1)
+                    if proc.poll() is not None:
+                        break
             self._spawned_procs.pop(spawn_token, None)
             node = self.nodes.get(node_id)
             if node:
                 node.spawning = max(0, node.spawning - 1)
                 if spawn_token in self._tpu_spawn_tokens:
                     node.spawning_tpu = max(0, node.spawning_tpu - 1)
-            self._free_spawn_chips(node, spawn_token)
+            if proc.poll() is not None:
+                proc.wait()  # reap the zombie
+                # Chips return ONLY once the process is truly gone: a
+                # still-alive process may hold the devices open, and
+                # re-granting its chips double-allocates them.
+                self._free_spawn_chips(node, spawn_token)
+            else:
+                self._chip_alloc.pop(spawn_token, None)
+                sys.stderr.write(
+                    f"[controller] spawned worker {spawn_token[:8]} "
+                    f"survived SIGKILL; leaking its chip grant rather than "
+                    f"double-allocating\n")
             self._release_env_spawn(node, spawn_token)
             self._tpu_spawn_tokens.discard(spawn_token)
             self._wake_scheduler()

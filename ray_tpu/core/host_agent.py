@@ -88,27 +88,71 @@ class HostAgent:
         self.ctrl = await protocol.connect(
             host, int(port), self._on_controller_msg, name="agent->controller"
         )
-        await self.ctrl.request(
-            {
-                "kind": "register_node",
-                "node_id": self.node_id,
-                "resources": self.resources,
-                "labels": self.labels,
-                "agent_addr": [self.serve_host, self.serve_port],
-                "host_id": self.host_id,
-                "arena": self.arena.name if self.arena else None,
-            }
-        )
+        await self.ctrl.request(self._register_msg())
         loop = asyncio.get_running_loop()
         loop.create_task(self._heartbeat_loop())
         loop.create_task(self._watch_controller())
         loop.create_task(self._reap_loop())
 
+    def _register_msg(self) -> Dict[str, Any]:
+        return {
+            "kind": "register_node",
+            "node_id": self.node_id,
+            "resources": self.resources,
+            "labels": self.labels,
+            "agent_addr": [self.serve_host, self.serve_port],
+            "host_id": self.host_id,
+            "arena": self.arena.name if self.arena else None,
+            # Live state, re-reported on reconnect so a restarted
+            # controller can reconcile (harmless on first contact): chips
+            # currently granted to worker processes, and the live workers.
+            "tpu_in_use": sorted(
+                c for ids in self.tpu_alloc.values() for c in ids),
+            "workers": {tok: proc.pid for tok, proc in self.procs.items()
+                        if proc.poll() is None},
+        }
+
     async def _watch_controller(self) -> None:
-        await self.ctrl.closed.wait()
-        # Fate-share: controller gone -> this node is orphaned.
-        self._terminate_workers()
-        self._stop.set()
+        """Reconnect with capped exponential backoff when the controller
+        connection drops (reference: raylet re-registration on
+        NotifyGCSRestart, node_manager.proto:373). Only after the reconnect
+        deadline passes does the agent fate-share: kill workers and exit."""
+        while not self._stop.is_set():
+            ctrl = self.ctrl
+            await ctrl.closed.wait()
+            if self._stop.is_set():
+                return
+            if not await self._reconnect():
+                self._terminate_workers()
+                self._stop.set()
+                return
+
+    async def _reconnect(self) -> bool:
+        host, port = self.controller_addr.rsplit(":", 1)
+        max_s = flags.get("RTPU_RECONNECT_MAX_S")
+        deadline = time.monotonic() + max_s
+        backoff = flags.get("RTPU_RECONNECT_BACKOFF_S")
+        while not self._stop.is_set():
+            try:
+                ctrl = await protocol.connect(
+                    host, int(port), self._on_controller_msg,
+                    name="agent->controller")
+                await ctrl.request(self._register_msg(), timeout=10)
+                self.ctrl = ctrl
+                sys.stderr.write(
+                    f"[host_agent] reconnected to controller at "
+                    f"{self.controller_addr}\n")
+                return True
+            except Exception as e:
+                now = time.monotonic()
+                if now >= deadline:
+                    sys.stderr.write(
+                        f"[host_agent] controller unreachable after "
+                        f"{max_s:.0f}s ({e!r}); shutting down\n")
+                    return False
+                await asyncio.sleep(min(backoff, deadline - now))
+                backoff = min(backoff * 2, 2.0)
+        return False
 
     async def run_forever(self) -> None:
         await self._stop.wait()

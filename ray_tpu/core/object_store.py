@@ -489,13 +489,15 @@ def get_bytes_with_refresh(loc: ObjectLocation, object_id: str, request_fn):
 def storage_kind(loc: ObjectLocation) -> str:
     """Canonical storage-backend label for observability surfaces (`rtpu
     memory`, the state API): exactly one place decides the name of each
-    backend so the two views can never drift."""
+    backend so the two views can never drift. The labels are EXTERNAL API
+    (scripted `rtpu memory` / `list_objects()` consumers key on them) —
+    'spill' is the original, published name; do not rename."""
     if loc.is_error:
         return "error"
     if loc.inline is not None:
         return "inline"
     if loc.spill_path:
-        return "spilled"
+        return "spill"
     if loc.arena:
         return "arena"
     if loc.shm_name:

@@ -22,6 +22,35 @@ from typing import Any, Awaitable, Callable, Dict, Optional
 
 _LEN = struct.Struct("!Q")
 
+# --------------------------------------------------------- fault injection
+# RTPU_TESTING_RPC_DELAY_MS (reference: RAY_testing_asio_delay_us) delays
+# the server-side handler of matching message kinds — deterministic
+# reconnect/race testing without sleeps sprinkled through product code.
+# Format: "kind=ms,kind2=ms" or "*=ms" (every kind). Parsed lazily and
+# cached per raw value so the hot path costs one env read + dict lookup.
+_delay_cache: tuple = (None, {})
+
+
+def testing_delay_s(kind: Optional[str]) -> float:
+    """Injected handler delay in seconds for one message kind (0 = none)."""
+    from ray_tpu import flags
+
+    raw = flags.raw("RTPU_TESTING_RPC_DELAY_MS")
+    if not raw:
+        return 0.0
+    global _delay_cache
+    cached_raw, table = _delay_cache
+    if raw != cached_raw:
+        table = {}
+        for part in raw.split(","):
+            name, _, ms = part.partition("=")
+            try:
+                table[name.strip()] = float(ms) / 1000.0
+            except ValueError:
+                continue
+        _delay_cache = (raw, table)
+    return table.get(kind or "", table.get("*", 0.0))
+
 # Messages are small control-plane payloads; large values go via the object
 # store.  A high cap catches protocol bugs (accidentally inlined tensors).
 MAX_MSG_BYTES = 1 << 31
@@ -140,6 +169,12 @@ class Connection:
                     asyncio.get_running_loop().create_task(self._serve(msg))
         except (asyncio.IncompleteReadError, ConnectionResetError, OSError, EOFError):
             pass
+        except Exception as e:  # noqa: BLE001 — diagnose, then close as usual
+            import sys as _sys
+            import traceback as _tb
+
+            _sys.stderr.write(f"[protocol] read loop {self.name!r} died "
+                              f"unexpectedly: {e!r}\n{_tb.format_exc()}\n")
         finally:
             for fut in self._pending.values():
                 if not fut.done():
@@ -159,6 +194,9 @@ class Connection:
     async def _serve(self, msg: Dict[str, Any]) -> None:
         rid = msg.get("rid")
         try:
+            delay = testing_delay_s(msg.get("kind"))
+            if delay:
+                await asyncio.sleep(delay)
             result = await self.handler(self, msg)
             if rid is not None:
                 # Buffered write on the connection's loop: frames cannot
@@ -192,6 +230,14 @@ class Connection:
         msg = dict(msg, rid=rid)
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         self._pending[rid] = fut
+        # A request on an ALREADY-closed connection must fail fast: the
+        # read loop's cleanup (which fails pending futures) already ran, so
+        # a future registered now would hang forever. Checked after
+        # registration — no await in between, so the close path either sees
+        # the future or this check sees the close.
+        if self.closed.is_set():
+            self._pending.pop(rid, None)
+            raise ConnectionError(f"connection {self.name!r} closed")
         await self.send(msg)
         if timeout is None:
             return await fut

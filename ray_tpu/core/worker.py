@@ -63,6 +63,7 @@ class ActorMailbox:
         self.runtime = runtime
         self.actor_id = actor_id
         self.instance: Any = None
+        self.spec: Optional[Dict[str, Any]] = None  # creation spec (re-claim)
         self.q: "queue.Queue[Optional[Dict[str, Any]]]" = queue.Queue()
         self.exited = False  # exit_actor ran: refuse everything queued
         # Per-caller sequence reordering state: caller -> {next, held}.
@@ -195,7 +196,9 @@ class WorkerRuntime:
         host, port = controller_addr.rsplit(":", 1)
         self.worker_id = WorkerID.generate()
         self.node_id = node_id
-        self.client = CoreClient(host, int(port), handler=self._handle)
+        self.client = CoreClient(host, int(port), handler=self._handle,
+                                 reconnect=True,
+                                 on_reconnect=self._on_reconnect)
         self.pool = ThreadPoolExecutor(max_workers=32, thread_name_prefix="task")
         self.functions: Dict[str, Any] = {}
         self.actors: Dict[str, ActorMailbox] = {}
@@ -234,34 +237,111 @@ class WorkerRuntime:
         # never to the driver).
         if flags.get("RTPU_LOG_TO_DRIVER"):
             self._install_log_forwarder()
-        self.client.request(
-            {
-                "kind": "register",
-                "role": "worker",
-                "worker_id": self.worker_id,
-                "node_id": node_id,
-                "spawn_token": flags.get("RTPU_SPAWN_TOKEN"),
-                "tpu_capable": flags.get("RTPU_TPU_WORKER"),
-                # Spawner-assigned chip visibility (agent- or controller-
-                # side): reported so the scheduler can match workers to
-                # tasks by chip count, not just TPU-capability.
-                "chip_ids": [int(x) for x in
-                             (flags.get("TPU_VISIBLE_CHIPS") or "").split(",")
-                             if x != ""],
-                "env_hash": env_hash,
-                "direct_port": self.direct_port,
-                "pid": os.getpid(),
-            }
-        )
+        self._env_hash = env_hash
+        self.client.request(self._register_msg())
 
-        # Fate-share with the controller: if the control connection drops the
-        # worker must die (reference: workers fate-share with their raylet;
-        # an orphaned worker would leak forever).
+        # Controller-connection watch: a dropped connection first enters
+        # the client's capped-backoff reconnect loop (the controller may
+        # just be bouncing — reference: NotifyGCSRestart re-registration,
+        # core_worker.proto:392). Only when the reconnect deadline passes
+        # does the worker fate-share and die (an orphaned worker would
+        # leak forever).
         async def _watch_conn() -> None:
-            await self.client.conn.closed.wait()
-            self.shutdown_event.set()
+            import asyncio
+
+            while not self.shutdown_event.is_set():
+                conn = self.client.conn
+                await conn.closed.wait()
+                if self.shutdown_event.is_set():
+                    return
+                ok = await asyncio.get_running_loop().run_in_executor(
+                    None, self._try_reconnect)
+                if not ok:
+                    self.shutdown_event.set()
+                    return
 
         self.client.io.call_nowait(_watch_conn())
+
+    # ------------------------------------------------- controller reconnect
+
+    def _register_msg(self, reconnect: bool = False) -> Dict[str, Any]:
+        msg = {
+            "kind": "register",
+            "role": "worker",
+            "worker_id": self.worker_id,
+            "node_id": self.node_id,
+            "spawn_token": flags.get("RTPU_SPAWN_TOKEN"),
+            "tpu_capable": flags.get("RTPU_TPU_WORKER"),
+            # Spawner-assigned chip visibility (agent- or controller-
+            # side): reported so the scheduler can match workers to
+            # tasks by chip count, not just TPU-capability.
+            "chip_ids": [int(x) for x in
+                         (flags.get("TPU_VISIBLE_CHIPS") or "").split(",")
+                         if x != ""],
+            "env_hash": self._env_hash,
+            "direct_port": self.direct_port,
+            "pid": os.getpid(),
+        }
+        if reconnect:
+            msg["reconnect"] = True
+            # Re-claim hosted actors: a restarted controller rebuilds its
+            # actor directory from these reports, keeping live instances
+            # (and their state) over queued re-creations.
+            msg["actors"] = [
+                self._actor_claim(aid, mb)
+                for aid, mb in list(self.actors.items())
+                if not mb.exited and mb.instance is not None
+            ]
+        return msg
+
+    @staticmethod
+    def _actor_claim(actor_id: str, mb: "ActorMailbox") -> Dict[str, Any]:
+        spec = getattr(mb, "spec", None) or {}
+        return {
+            "actor_id": actor_id,
+            "name": spec.get("name"),
+            "namespace": spec.get("namespace", "default"),
+            "detached": bool(spec.get("detached")),
+            "max_restarts": int(spec.get("max_restarts", 0)),
+            "resources": dict(spec.get("resources") or {}),
+        }
+
+    def _try_reconnect(self) -> bool:
+        try:
+            self.client.ensure_connected()
+            return True
+        except Exception as e:
+            import sys as _sys
+
+            print(f"[worker] controller reconnect failed: {e!r}; "
+                  f"fate-sharing\n{traceback.format_exc()}",
+                  file=_sys.stderr, flush=True)
+            return False
+
+    def _on_reconnect(self, client: CoreClient) -> None:
+        """Runs on the fresh connection before any retried request:
+        re-register under the existing worker id, re-report chips and
+        hosted actors, drop actors the controller says were re-created
+        elsewhere while we were away."""
+        deadline = time.monotonic() + flags.get("RTPU_RECONNECT_MAX_S")
+        while True:
+            reply = client.io.call(
+                client.conn.request(self._register_msg(reconnect=True)),
+                timeout=30)
+            if reply and reply.get("ok"):
+                break
+            if not (reply and reply.get("retry")) \
+                    or time.monotonic() >= deadline:
+                raise ConnectionError(
+                    "controller refused worker re-registration")
+            # Our node (host agent) has not re-registered yet: give it a
+            # beat and try again.
+            time.sleep(0.3)
+        for aid in reply.get("drop_actors") or ():
+            mb = self.actors.pop(aid, None)
+            if mb is not None:
+                mb.exited = True
+                mb.stop()
 
     def _install_log_forwarder(self) -> None:
         import sys
@@ -898,6 +978,7 @@ class WorkerRuntime:
     def _instantiate_actor(self, spec: Dict[str, Any]) -> None:
         actor_id = spec["actor_id"]
         mb = ActorMailbox(self, actor_id, spec.get("max_concurrency", 1))
+        mb.spec = spec  # kept for re-claiming the actor after a controller bounce
         self.actors[actor_id] = mb
 
         def create():

@@ -134,6 +134,26 @@ _define("RTPU_MEMORY_USAGE_THRESHOLD", float, 0.95,
 _define("RTPU_MEMORY_MONITOR_S", float, 2.0,
         "Memory monitor sampling period.")
 
+# -- controller fault tolerance ----------------------------------------------
+_define("RTPU_RECONNECT_MAX_S", float, 20.0,
+        "Total time a disconnected client/worker/host-agent keeps retrying "
+        "the controller before giving up (reference: GCS client reconnect "
+        "window, gcs_rpc_server reconnection timeout). Workers and agents "
+        "fate-share once the deadline passes; drivers raise ConnectionError.")
+_define("RTPU_RECONNECT_BACKOFF_S", float, 0.1,
+        "Initial reconnect backoff; doubles per attempt, capped at 2s.")
+_define("RTPU_RECONNECT_GRACE_S", float, 2.0,
+        "After a controller restart with persisted state, how long restored "
+        "detached actors wait for their (possibly still-alive) hosting "
+        "workers to reconnect and re-claim them before being re-created "
+        "from scratch (reference: GCS waits for raylet re-registration on "
+        "NotifyGCSRestart before reconstructing actors).")
+_define("RTPU_TESTING_RPC_DELAY_MS", str, None,
+        "Fault-injection: per-message-kind handler delays, e.g. "
+        "'register=200,heartbeat=50' or '*=20' (reference: "
+        "RAY_testing_asio_delay_us). Applied server-side in the protocol "
+        "layer before the handler runs; testing only.")
+
 # -- object store / spilling -------------------------------------------------
 _define("RTPU_NATIVE_STORE", bool, True,
         "Use the C++ shm arena when available (0 forces pickle fallback).")

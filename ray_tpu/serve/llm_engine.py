@@ -23,6 +23,7 @@ compiled program.
 from __future__ import annotations
 
 import threading
+import time
 from typing import Any, Dict, List, Optional
 
 import numpy as np
@@ -147,9 +148,23 @@ class ContinuousBatchingEngine:
             k1 = jnp.pad(k1, ((0, 0), (0, pad), (0, 0), (0, 0)))
             v1 = jnp.pad(v1, ((0, 0), (0, pad), (0, 0), (0, 0)))
         with self._free_cv:
+            # One monotonic deadline for the whole wait: contended submits
+            # that wake repeatedly must not restart the clock each time.
+            deadline = (None if timeout is None
+                        else time.monotonic() + timeout)
             while not self._free:
-                if not self._free_cv.wait(timeout=timeout):
+                # A dead ticker thread recorded the failure and notified
+                # this condition; blocking the full timeout (or forever)
+                # on an engine that will never free a slot helps nobody.
+                if self.failed is not None:
+                    raise RuntimeError(f"engine failed: {self.failed!r}")
+                remaining = (None if deadline is None
+                             else deadline - time.monotonic())
+                if remaining is not None and remaining <= 0:
                     raise TimeoutError("no free generation slot")
+                self._free_cv.wait(timeout=remaining)
+            if self.failed is not None:
+                raise RuntimeError(f"engine failed: {self.failed!r}")
             slot = self._free.pop()
             self._req_seq += 1
             req = self._req_seq

@@ -1,0 +1,14 @@
+"""Testing utilities: cluster fault injection and chaos harnesses.
+
+Reference: ray's ``_private/test_utils.py`` ResourceKiller hierarchy and the
+``RAY_testing_asio_delay_us`` handler-delay flag (here:
+``RTPU_TESTING_RPC_DELAY_MS``, applied in ``core/protocol.py``).
+"""
+from .fault_injection import (  # noqa: F401
+    ControllerKiller,
+    HostAgentKiller,
+    ProcessSuspender,
+    ResourceKillerBase,
+    WorkerKiller,
+    rpc_delays,
+)

@@ -1,0 +1,260 @@
+"""Cluster fault-injection harness (chaos testing).
+
+Mirrors the reference's ``ResourceKiller`` hierarchy
+(ray: python/ray/_private/test_utils.py:1430 — ``NodeKillerBase`` /
+``RayletKiller`` / ``WorkerKillerActor``): a background thread that, on a
+schedule, picks a target component — controller, host agent, or worker —
+and kills (or suspends) it, recording every kill so tests can assert the
+cluster absorbed the faults. Combine with ``RTPU_TESTING_RPC_DELAY_MS``
+(reference: ``RAY_testing_asio_delay_us``; see :func:`rpc_delays`) to make
+reconnect races deterministic.
+
+All killers are process-level and signal-based: SIGKILL models a crash
+(nothing runs, nothing cleans up), SIGSTOP/SIGCONT models a stall (GC
+pause, preempted VM) without death.
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+import random
+import signal
+import subprocess
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Union
+
+ProcTarget = Union[int, subprocess.Popen]
+
+
+def _pid_of(target: ProcTarget) -> Optional[int]:
+    if isinstance(target, subprocess.Popen):
+        return target.pid if target.poll() is None else None
+    return int(target)
+
+
+def _signal_pid(pid: int, sig: int) -> bool:
+    try:
+        os.kill(pid, sig)
+        return True
+    except (ProcessLookupError, PermissionError, OSError):
+        return False
+
+
+class ResourceKillerBase:
+    """Kill one target per interval on a background thread.
+
+    Subclasses implement :meth:`_find_target` (what to kill next) and
+    :meth:`_kill` (how). ``kills`` records ``(timestamp, description)`` for
+    every successful kill; ``stop()`` joins the thread.
+    """
+
+    def __init__(
+        self,
+        kill_interval_s: float = 1.0,
+        warmup_s: float = 0.0,
+        max_kills: Optional[int] = None,
+        seed: Optional[int] = None,
+    ):
+        self.kill_interval_s = kill_interval_s
+        self.warmup_s = warmup_s
+        self.max_kills = max_kills
+        self.rng = random.Random(seed)
+        self.kills: List[tuple] = []
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- subclass interface --------------------------------------------------
+
+    def _find_target(self) -> Optional[Any]:
+        raise NotImplementedError
+
+    def _kill(self, target: Any) -> Optional[str]:
+        """Kill `target`; return a description on success, None on miss."""
+        raise NotImplementedError
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> "ResourceKillerBase":
+        self._thread = threading.Thread(
+            target=self._run, name=type(self).__name__, daemon=True)
+        self._thread.start()
+        return self
+
+    def kill_once(self) -> Optional[str]:
+        """Synchronous single kill (no thread): find + kill one target."""
+        target = self._find_target()
+        if target is None:
+            return None
+        desc = self._kill(target)
+        if desc:
+            self.kills.append((time.monotonic(), desc))
+        return desc
+
+    def _run(self) -> None:
+        if self.warmup_s and self._stop.wait(self.warmup_s):
+            return
+        while not self._stop.is_set():
+            if self.max_kills is not None and len(self.kills) >= self.max_kills:
+                return
+            try:
+                self.kill_once()
+            except Exception:
+                pass  # chaos must not crash the chaos harness
+            if self._stop.wait(self.kill_interval_s):
+                return
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+
+class ControllerKiller(ResourceKillerBase):
+    """SIGKILL the controller process (reference: the GCS-server kill in
+    chaos tests proving raylet/worker re-registration on restart).
+
+    ``proc_supplier`` returns the CURRENT controller process (tests restart
+    it between kills); with ``restart_fn`` set, the killer bounces the
+    controller itself: kill, wait ``downtime_s``, call ``restart_fn()``.
+    """
+
+    def __init__(self, proc_supplier: Callable[[], Optional[ProcTarget]],
+                 restart_fn: Optional[Callable[[], Any]] = None,
+                 downtime_s: float = 0.5, **kw):
+        super().__init__(**kw)
+        self.proc_supplier = proc_supplier
+        self.restart_fn = restart_fn
+        self.downtime_s = downtime_s
+
+    def _find_target(self) -> Optional[ProcTarget]:
+        return self.proc_supplier()
+
+    def _kill(self, target: ProcTarget) -> Optional[str]:
+        pid = _pid_of(target)
+        if pid is None or not _signal_pid(pid, signal.SIGKILL):
+            return None
+        if isinstance(target, subprocess.Popen):
+            try:
+                target.wait(timeout=5)
+            except Exception:
+                pass
+        if self.restart_fn is not None:
+            time.sleep(self.downtime_s)
+            self.restart_fn()
+        return f"controller pid={pid}"
+
+
+class HostAgentKiller(ResourceKillerBase):
+    """SIGKILL one host-agent process (node failure; reference:
+    RayletKiller). Targets come from a ``cluster_utils.Cluster`` (its
+    ``_agent_procs``) or any explicit list of processes/pids."""
+
+    def __init__(self, cluster=None,
+                 procs: Optional[List[ProcTarget]] = None, **kw):
+        super().__init__(**kw)
+        self.cluster = cluster
+        self.procs = procs
+
+    def _candidates(self) -> List[ProcTarget]:
+        if self.procs is not None:
+            return list(self.procs)
+        return list(getattr(self.cluster, "_agent_procs", []) or [])
+
+    def _find_target(self) -> Optional[ProcTarget]:
+        live = [p for p in self._candidates() if _pid_of(p) is not None]
+        return self.rng.choice(live) if live else None
+
+    def _kill(self, target: ProcTarget) -> Optional[str]:
+        pid = _pid_of(target)
+        if pid is None or not _signal_pid(pid, signal.SIGKILL):
+            return None
+        return f"host_agent pid={pid}"
+
+
+class WorkerKiller(ResourceKillerBase):
+    """SIGKILL one worker process by id/pid (reference: WorkerKillerActor
+    killing task executors mid-flight). Worker pids come from the live
+    controller via the state API, so the killer follows respawns; pass
+    ``worker_filter`` to narrow (e.g. only TPU workers)."""
+
+    def __init__(self, client=None,
+                 worker_filter: Optional[Callable[[Dict], bool]] = None,
+                 **kw):
+        super().__init__(**kw)
+        self._client = client
+        self.worker_filter = worker_filter
+
+    def _request(self, msg: Dict) -> Any:
+        client = self._client
+        if client is None:
+            from ray_tpu.core import context as ctx
+
+            client = ctx.get_worker_context().client
+        return client.request(msg)
+
+    def _find_target(self) -> Optional[Dict]:
+        try:
+            workers = self._request(
+                {"kind": "list_state", "what": "workers", "limit": 1000})
+        except Exception:
+            return None
+        live = [w for w in workers if w.get("pid")]
+        if self.worker_filter is not None:
+            live = [w for w in live if self.worker_filter(w)]
+        return self.rng.choice(live) if live else None
+
+    def _kill(self, target: Dict) -> Optional[str]:
+        pid = int(target["pid"])
+        if pid == os.getpid() or not _signal_pid(pid, signal.SIGKILL):
+            return None
+        return f"worker {target.get('worker_id', '?')[:8]} pid={pid}"
+
+
+class ProcessSuspender(ResourceKillerBase):
+    """SIGSTOP a process for ``suspend_s`` then SIGCONT it — a stall, not a
+    crash (models GC pauses / preempted VMs; heartbeat and reconnect logic
+    must ride it out without declaring death prematurely)."""
+
+    def __init__(self, proc_supplier: Callable[[], Optional[ProcTarget]],
+                 suspend_s: float = 1.0, **kw):
+        super().__init__(**kw)
+        self.proc_supplier = proc_supplier
+        self.suspend_s = suspend_s
+
+    def _find_target(self) -> Optional[ProcTarget]:
+        return self.proc_supplier()
+
+    def _kill(self, target: ProcTarget) -> Optional[str]:
+        pid = _pid_of(target)
+        if pid is None or not _signal_pid(pid, signal.SIGSTOP):
+            return None
+        try:
+            time.sleep(self.suspend_s)
+        finally:
+            _signal_pid(pid, signal.SIGCONT)
+        return f"suspended pid={pid} for {self.suspend_s}s"
+
+
+@contextlib.contextmanager
+def rpc_delays(spec: str):
+    """Scoped ``RTPU_TESTING_RPC_DELAY_MS`` (reference:
+    ``RAY_testing_asio_delay_us``): delay server-side handling of matching
+    message kinds in THIS process and every child spawned inside the scope.
+
+        with rpc_delays("register=200,heartbeat=50"):
+            ...   # re-register handling now lags 200ms
+
+    Format: ``kind=ms[,kind=ms...]``; ``*`` matches every kind.
+    """
+    from ray_tpu import flags
+
+    prev = flags.raw("RTPU_TESTING_RPC_DELAY_MS")
+    flags.set_env("RTPU_TESTING_RPC_DELAY_MS", spec)
+    try:
+        yield
+    finally:
+        if prev is None:
+            flags.unset_env("RTPU_TESTING_RPC_DELAY_MS")
+        else:
+            flags.set_env("RTPU_TESTING_RPC_DELAY_MS", prev)

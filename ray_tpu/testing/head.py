@@ -1,0 +1,59 @@
+"""Chaos-test head entrypoint: a controller in its OWN process.
+
+``python -m ray_tpu.testing.head --port P --state-path S --resources JSON``
+
+Unlike ``rtpu start --head`` this writes no pid/addr files (tests must not
+clobber an operator's real head bookkeeping), takes its node resources
+verbatim (no host autodetection — chaos tests pin exact CPU/TPU counts),
+and prints one ``RTPU_HEAD_READY host:port`` line when serving so the
+harness can wait for readiness, SIGKILL the process, and start a
+replacement on the same port + state path.
+"""
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import signal
+import sys
+
+from ray_tpu import flags
+
+
+async def _amain(args) -> int:
+    if args.state_path:
+        flags.set_env("RTPU_STATE_PATH", args.state_path)
+    from ray_tpu.core.controller import Controller
+
+    controller = Controller(port=args.port)
+    host, port = await controller.start()
+    res = {"CPU": float(args.num_cpus)}
+    if args.resources:
+        res.update(json.loads(args.resources))
+    controller.ensure_head_node(res, labels={"head": "1"})
+    print(f"RTPU_HEAD_READY {host}:{port}", flush=True)
+    stop = asyncio.Event()
+    loop = asyncio.get_running_loop()
+    for s in (signal.SIGTERM, signal.SIGINT):
+        try:
+            loop.add_signal_handler(s, stop.set)
+        except NotImplementedError:
+            pass
+    await stop.wait()
+    await controller.shutdown()
+    return 0
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--port", type=int, required=True)
+    ap.add_argument("--state-path", default=None)
+    ap.add_argument("--num-cpus", type=float, default=2.0)
+    ap.add_argument("--resources", default=None,
+                    help='extra node resources, JSON (e.g. {"TPU": 4})')
+    args = ap.parse_args()
+    return asyncio.run(_amain(args))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

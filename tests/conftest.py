@@ -16,6 +16,13 @@ cpu_mesh_env(8)
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: long-running stress/chaos variants excluded from tier-1 "
+        "(run with -m slow)")
+
+
 @pytest.fixture(scope="module")
 def ray_start_regular():
     import ray_tpu

@@ -43,7 +43,7 @@ def test_working_set_twice_arena_completes(tiny_arena_cluster):
 
     backends = {o["object_id"]: o["backend"] for o in state.list_objects()}
     used = {backends[r.object_id] for r in refs}
-    assert "spilled" in used, f"nothing spilled: {used}"
+    assert "spill" in used, f"nothing spilled: {used}"
     for i, r in enumerate(refs):
         out = ray_tpu.get(r)
         np.testing.assert_array_equal(out, arrays[i])
