@@ -154,7 +154,22 @@ def cmd_summary(args) -> int:
     rt = _connect(args)
     from ray_tpu.util import state
 
-    print(json.dumps(state.summarize_tasks(), indent=1))
+    if getattr(args, "breakdown", False):
+        rows = state.summarize_tasks(breakdown=True)
+        if not rows:
+            print("no phase events recorded yet "
+                  "(is RTPU_TASK_EVENTS enabled?)")
+        else:
+            print(f"{'LABEL':28} {'PHASE':20} {'COUNT':>7} "
+                  f"{'MEAN_MS':>9} {'P50_MS':>9} {'P99_MS':>9}")
+            for label in sorted(rows):
+                for phase, st in rows[label].items():
+                    print(f"{label[:28]:28} {phase:20} {st['count']:>7} "
+                          f"{st['mean'] * 1e3:>9.2f} "
+                          f"{st['p50'] * 1e3:>9.2f} "
+                          f"{st['p99'] * 1e3:>9.2f}")
+    else:
+        print(json.dumps(state.summarize_tasks(), indent=1))
     rt.shutdown()
     return 0
 
@@ -383,10 +398,18 @@ def main(argv=None) -> int:
     p = sub.add_parser("stop", help="stop the head started on this machine")
     p.set_defaults(fn=cmd_stop)
 
-    for name, fn in (("status", cmd_status), ("summary", cmd_summary)):
-        p = sub.add_parser(name)
-        p.add_argument("--address", default=None)
-        p.set_defaults(fn=fn)
+    p = sub.add_parser("status")
+    p.add_argument("--address", default=None)
+    p.set_defaults(fn=cmd_status)
+
+    p = sub.add_parser("summary", help="per-function task-event counts")
+    p.add_argument("--address", default=None)
+    p.add_argument("--breakdown", action="store_true",
+                   help="per-label per-phase latency breakdown "
+                        "(p50/p99/mean over the flight-recorder histograms: "
+                        "scheduling delay, queue wait, arg fetch, execute, "
+                        "result store)")
+    p.set_defaults(fn=cmd_summary)
 
     p = sub.add_parser("timeline")
     p.add_argument("--address", default=None)

@@ -654,6 +654,10 @@ class RemoteFunction:
             _streaming_spec_opts(opts, spec)
         _register_dep_holds(spec, nested_refs)
         tracing.inject_submit_span(spec, spec["label"])
+        if flags.get("RTPU_TASK_EVENTS"):
+            # Flight-recorder anchor: the executing worker derives
+            # scheduling delay (submit -> dispatch arrival) from this.
+            spec["submit_ts"] = time.time()
         _track_inflight(spec)
         # Lease-then-push direct path first; the controller queue is the
         # fallback (and the only path for pg/affinity/streaming tasks).
@@ -1470,6 +1474,8 @@ class ActorHandle:
             _streaming_spec_opts({}, spec)
         _register_dep_holds(spec, nested_refs)
         tracing.inject_submit_span(spec, spec["label"])
+        if flags.get("RTPU_TASK_EVENTS"):
+            spec["submit_ts"] = time.time()
         submitted = False
         if not streaming and flags.get("RTPU_DIRECT_DISPATCH"):
             route = _get_route(wc, self._actor_id)

@@ -45,6 +45,44 @@ from .object_store import ObjectLocation, free_location
 # is a backstop against runaway spawning on the 1-CPU CI host).
 MAX_WORKERS_PER_NODE = flags.get("RTPU_MAX_WORKERS_PER_NODE")
 
+# Flight-recorder phase -> derived Prometheus histogram (reference: the
+# GcsTaskManager-fed task latency breakdowns behind `ray summary`). Served
+# from app_metrics so the exposition/grafana paths pick them up unchanged.
+PHASE_METRIC_NAMES = {
+    "scheduling_delay_s": "rtpu_task_scheduling_delay_s",
+    "queue_wait_s": "rtpu_task_queue_wait_s",
+    "arg_fetch_s": "rtpu_task_arg_fetch_s",
+    "exec_s": "rtpu_task_exec_s",
+    "result_store_s": "rtpu_task_result_store_s",
+}
+PHASE_METRIC_HELP = {
+    "rtpu_task_scheduling_delay_s": "Task submit -> dispatch arrival at a worker",
+    "rtpu_task_queue_wait_s": "Worker-local queue wait before execution",
+    "rtpu_task_arg_fetch_s": "Argument location lookup + fetch + deserialize",
+    "rtpu_task_exec_s": "User-code execution",
+    "rtpu_task_result_store_s": "Result serialize + object-store put",
+}
+PHASE_BOUNDARIES = [0.0001, 0.0005, 0.001, 0.005, 0.01, 0.05,
+                    0.1, 0.5, 1.0, 5.0, 10.0, 30.0, 60.0]
+
+
+def _hist_quantile(bounds: List[float], h: Dict[str, Any], q: float) -> float:
+    """Percentile estimate from cumulative bucket counts (the
+    histogram_quantile linear interpolation, server-side)."""
+    total = h.get("count", 0)
+    if not total:
+        return 0.0
+    target = q * total
+    cum = 0.0
+    lo = 0.0
+    for i, b in enumerate(bounds):
+        c = h["buckets"][i]
+        if c and cum + c >= target:
+            return lo + (b - lo) * ((target - cum) / c)
+        cum += c
+        lo = b
+    return bounds[-1] if bounds else 0.0  # +Inf bucket clamps to last edge
+
 
 def _res_fits(avail: Dict[str, float], need: Dict[str, float]) -> bool:
     return all(avail.get(k, 0.0) + 1e-9 >= v for k, v in need.items())
@@ -300,6 +338,10 @@ class Controller:
 
         self.task_events: "collections.deque" = collections.deque(
             maxlen=flags.get("RTPU_TASK_EVENTS_MAX"))
+        # Cluster-wide finished tracing spans shipped by worker flight
+        # recorders (util/tracing.py get_cluster_spans backend).
+        self.cluster_spans: "collections.deque" = collections.deque(
+            maxlen=flags.get("RTPU_SPANS_MAX"))
         # Node-wide native object arena (plasma-equivalent, src/store).
         # Created here so worker spawns inherit RTPU_ARENA via env; falls
         # back to per-object segments when the native lib is unavailable.
@@ -878,7 +920,18 @@ class Controller:
                     h = st["data"].setdefault(
                         tags, {"buckets": [0] * (len(st["boundaries"]) + 1),
                                "sum": 0.0, "count": 0})
-                    for obs in value:
+                    if isinstance(value, dict):
+                        # Pre-aggregated bucket counts (util/metrics.py
+                        # aggregates at record time): merge elementwise,
+                        # overflow into the +Inf bucket on length mismatch.
+                        for i, c in enumerate(value.get("buckets", ())):
+                            if c:
+                                h["buckets"][min(i, len(h["buckets"]) - 1)] \
+                                    += c
+                        h["sum"] += value.get("sum", 0.0)
+                        h["count"] += value.get("count", 0)
+                        continue
+                    for obs in value:  # legacy raw observation list
                         i = 0
                         for i, b in enumerate(st["boundaries"]):
                             if obs <= b:
@@ -2070,13 +2123,20 @@ class Controller:
                 row = counts.setdefault(ev.get("label") or "?", {})
                 row[ev["event"]] = row.get(ev["event"], 0) + 1
             return counts
+        if what == "summary_breakdown":
+            # Per-label per-phase latency percentiles (reference: the
+            # `ray summary tasks` timing columns the GcsTaskManager feeds).
+            return self._phase_breakdown()
         raise ValueError(f"unknown state listing {what!r}")
 
     def _latest_task_events(self) -> Dict[str, Dict[str, Any]]:
-        """task_id -> its most recent event (events append in order)."""
+        """task_id -> its most recent LIFECYCLE event (events append in
+        order). Flight-recorder "phases" entries are annotations riding the
+        same ring — they must not shadow a task's state."""
         latest: Dict[str, Dict[str, Any]] = {}
         for ev in self.task_events:
-            latest[ev["task_id"]] = ev
+            if ev["event"] != "phases":
+                latest[ev["task_id"]] = ev
         return latest
 
     async def _h_autoscaler_state(self, conn, msg):
@@ -2132,6 +2192,88 @@ class Controller:
         (reference: GlobalState.chrome_tracing_dump, _private/state.py:434)."""
         return list(self.task_events)
 
+    async def _h_task_phase_events(self, conn, msg):
+        """Flight-recorder batch from a worker (reference: TaskEventBuffer
+        batches landing in GcsTaskManager): merge phase events into the
+        task-event ring (keyed by task_id, consumed by timeline()), fold
+        each phase duration into its derived Prometheus histogram, and
+        collect shipped tracing spans for get_cluster_spans()."""
+        for ev in msg.get("events", ()):
+            entry = {
+                "task_id": ev.get("task_id"),
+                "label": ev.get("label"),
+                "actor_id": ev.get("actor_id"),
+                "event": "phases",
+                "ts": ev.get("end_ts"),
+                "worker_id": ev.get("worker_id"),
+                "node_id": ev.get("node_id"),
+                "start_ts": ev.get("start_ts"),
+                "outcome": ev.get("outcome"),
+                "phases": dict(ev.get("phases") or {}),
+            }
+            self.task_events.append(entry)
+            self._export_event("TASK_PHASES", entry)
+            label = entry["label"] or "?"
+            for key, mname in PHASE_METRIC_NAMES.items():
+                v = entry["phases"].get(key)
+                if v is not None:
+                    self._observe_phase(mname, label, float(v))
+        for d in msg.get("spans", ()):
+            self.cluster_spans.append(d)
+        return {"ok": True}
+
+    def _observe_phase(self, name: str, label: str, value: float) -> None:
+        """One observation into a derived phase histogram; stored in
+        app_metrics so the /metrics exposition and grafana generation pick
+        it up like any user Histogram."""
+        import bisect
+
+        st = self.app_metrics.setdefault(
+            name, {"type": "histogram",
+                   "help": PHASE_METRIC_HELP.get(name, ""),
+                   "boundaries": list(PHASE_BOUNDARIES), "data": {}})
+        tags = (("label", label),)
+        h = st["data"].setdefault(
+            tags, {"buckets": [0] * (len(st["boundaries"]) + 1),
+                   "sum": 0.0, "count": 0})
+        i = min(bisect.bisect_left(st["boundaries"], value),
+                len(st["boundaries"]))
+        h["buckets"][i] += 1
+        h["sum"] += value
+        h["count"] += 1
+
+    def _phase_breakdown(self) -> Dict[str, Dict[str, Dict[str, float]]]:
+        """label -> phase -> {count, mean, p50, p99} from the derived
+        histograms (state.summarize_tasks(breakdown=True) backend)."""
+        out: Dict[str, Dict[str, Dict[str, float]]] = {}
+        for key, mname in PHASE_METRIC_NAMES.items():
+            st = self.app_metrics.get(mname)
+            if not st:
+                continue
+            bounds = st["boundaries"]
+            for tags, h in st["data"].items():
+                label = dict(tags).get("label", "?")
+                if not h.get("count"):
+                    continue
+                out.setdefault(label, {})[key] = {
+                    "count": h["count"],
+                    "mean": h["sum"] / h["count"],
+                    "p50": _hist_quantile(bounds, h, 0.5),
+                    "p99": _hist_quantile(bounds, h, 0.99),
+                }
+        return out
+
+    async def _h_get_spans(self, conn, msg):
+        """Cluster-wide finished tracing spans (util/tracing.py
+        get_cluster_spans): spans shipped by worker flight recorders,
+        optionally filtered by trace_id."""
+        trace_id = msg.get("trace_id")
+        spans = list(self.cluster_spans)
+        if trace_id:
+            spans = [s for s in spans if s.get("trace_id") == trace_id]
+        limit = int(msg.get("limit", 10000))
+        return spans[-limit:]
+
     def _metrics_text(self) -> str:
         """Prometheus text exposition (reference: _private/metrics_agent.py
         + ray_metrics_export — collapsed to a controller-local scrape)."""
@@ -2175,6 +2317,19 @@ class Controller:
                 lines.append(
                     f'rtpu_node_arena_used_bytes{{node="{n.node_id[:12]}"}} '
                     f"{n.arena_stats.get('used', 0)}")
+        # Control-plane RPC accounting (protocol.py handler stats): count +
+        # cumulative handler seconds per message kind.
+        rpc = protocol.handler_stats()
+        if rpc:
+            lines.append("# TYPE rtpu_rpc_handled_total counter")
+            for kind, (n_served, _) in sorted(rpc.items()):
+                lines.append(
+                    f'rtpu_rpc_handled_total{{kind="{kind}"}} {n_served}')
+            lines.append("# TYPE rtpu_rpc_handler_seconds_total counter")
+            for kind, (_, secs) in sorted(rpc.items()):
+                lines.append(
+                    f'rtpu_rpc_handler_seconds_total{{kind="{kind}"}} '
+                    f"{secs:.6f}")
         # App-defined metrics (util/metrics.py).
         def esc(v) -> str:
             # Prometheus label-value escaping: one bad value must not

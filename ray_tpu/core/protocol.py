@@ -18,9 +18,36 @@ import asyncio
 import itertools
 import pickle
 import struct
-from typing import Any, Awaitable, Callable, Dict, Optional
+import threading
+import time
+from typing import Any, Awaitable, Callable, Dict, Optional, Tuple
 
 _LEN = struct.Struct("!Q")
+
+# ------------------------------------------------------- handler accounting
+# Per-kind served-message count + cumulative handler seconds for this
+# process (reference: the per-RPC event stats gRPC servers surface). The
+# controller exports these on /metrics (rtpu_rpc_handled_total /
+# rtpu_rpc_handler_seconds_total) so the control-plane leg of task latency
+# is visible next to the worker-side phase histograms.
+_handler_stats_lock = threading.Lock()
+_handler_stats: Dict[str, list] = {}  # kind -> [count, total_seconds]
+
+
+def _record_handler_stat(kind: Optional[str], dt: float) -> None:
+    with _handler_stats_lock:
+        st = _handler_stats.get(kind or "?")
+        if st is None:
+            st = _handler_stats[kind or "?"] = [0, 0.0]
+        st[0] += 1
+        st[1] += dt
+
+
+def handler_stats() -> Dict[str, Tuple[int, float]]:
+    """Snapshot of this process's served-message stats: kind -> (count,
+    total handler seconds — awaits inside the handler included)."""
+    with _handler_stats_lock:
+        return {k: (v[0], v[1]) for k, v in _handler_stats.items()}
 
 # --------------------------------------------------------- fault injection
 # RTPU_TESTING_RPC_DELAY_MS (reference: RAY_testing_asio_delay_us) delays
@@ -197,7 +224,10 @@ class Connection:
             delay = testing_delay_s(msg.get("kind"))
             if delay:
                 await asyncio.sleep(delay)
+            t0 = time.perf_counter()
             result = await self.handler(self, msg)
+            _record_handler_stat(msg.get("kind"),
+                                 time.perf_counter() - t0)
             if rid is not None:
                 # Buffered write on the connection's loop: frames cannot
                 # interleave and responses produced in the same iteration

@@ -1,0 +1,134 @@
+"""Worker-side task flight recorder: phase events + span shipping.
+
+Role-equivalent to the reference's ``TaskEventBuffer``
+(ray: src/ray/core_worker/task_event_buffer.h:206): every worker buffers
+fine-grained per-task events locally — here, the phase breakdown of each
+execution (scheduling delay, queue wait, arg fetch+deserialize, user-code
+execute, result serialize+store) plus the tracing spans that finished in
+this process — and a daemon flusher ships batches to the controller (the
+GcsTaskManager analog) over the existing control connection.
+
+Shipping uses the worker's reconnecting ``CoreClient``: a batch that fails
+to deliver (controller bouncing) re-buffers and retries on the next tick,
+so events recorded across a controller restart land on the NEW controller
+once the worker re-registers. The buffer is a bounded deque — a controller
+unreachable longer than the buffer covers drops oldest-first rather than
+growing worker memory.
+
+Everything is gated on ``RTPU_TASK_EVENTS``: when off, the execution hot
+path pays one flag check and nothing is buffered, flushed, or shipped.
+"""
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from ray_tpu import flags
+
+# Phase keys a worker may report, in execution order. The controller maps
+# each to its derived Prometheus histogram (rtpu_task_<phase>).
+PHASE_KEYS = (
+    "scheduling_delay_s",  # driver submit -> spec arrival at the worker
+    "queue_wait_s",        # spec arrival -> execution start (pool/mailbox)
+    "arg_fetch_s",         # dependency location lookup + fetch + deserialize
+    "exec_s",              # user code (incl. awaited coroutine time)
+    "result_store_s",      # result serialize + object-store put
+)
+
+
+def enabled() -> bool:
+    return bool(flags.get("RTPU_TASK_EVENTS"))
+
+
+class _Recorder:
+    """Bounded per-process buffer of phase events, flushed to the controller
+    (same daemon-flusher shape as util/metrics.py's _Aggregator)."""
+
+    def __init__(self) -> None:
+        self.lock = threading.Lock()
+        self.events: Optional[collections.deque] = None  # created lazily
+        self._pending_spans: List[Dict[str, Any]] = []
+        self._thread: Optional[threading.Thread] = None
+
+    def record(self, event: Dict[str, Any]) -> None:
+        with self.lock:
+            if self.events is None:
+                self.events = collections.deque(
+                    maxlen=max(16, flags.get("RTPU_TASK_EVENTS_BUF")))
+            self.events.append(event)
+        self._ensure_flusher()
+
+    def _ensure_flusher(self) -> None:
+        if self._thread is not None and self._thread.is_alive():
+            return
+        self._thread = threading.Thread(
+            target=self._run, name="rtpu-task-events-flush", daemon=True)
+        self._thread.start()
+
+    def _run(self) -> None:
+        while True:
+            time.sleep(flags.get("RTPU_TASK_EVENTS_FLUSH_S"))
+            try:
+                self.flush()
+            except Exception:
+                pass  # the recorder must never take a worker down
+
+    def flush(self, timeout: float = 30.0) -> bool:
+        """Ship everything buffered; False (and re-buffer) on failure.
+
+        The request rides the worker's reconnecting client, so a batch in
+        flight when the controller dies blocks in the reconnect loop and
+        delivers to the restarted controller — events survive the bounce.
+        """
+        from ray_tpu.util import tracing
+
+        from . import context as ctx
+
+        with self.lock:
+            events = list(self.events) if self.events else []
+            if self.events is not None:
+                self.events.clear()
+            spans, self._pending_spans = self._pending_spans, []
+        spans = spans + [tracing.span_to_dict(s)
+                         for s in tracing.drain_finished_spans()]
+        if not events and not spans:
+            return True
+        if not ctx.is_initialized():
+            self._requeue(events, spans)
+            return False
+        try:
+            wc = ctx.get_worker_context()
+            wc.client.request({"kind": "task_phase_events",
+                               "events": events, "spans": spans},
+                              timeout=timeout)
+            return True
+        except Exception:
+            self._requeue(events, spans)
+            return False
+
+    def _requeue(self, events: List[Dict[str, Any]],
+                 spans: List[Dict[str, Any]]) -> None:
+        with self.lock:
+            if events:
+                if self.events is None:
+                    self.events = collections.deque(
+                        maxlen=max(16, flags.get("RTPU_TASK_EVENTS_BUF")))
+                # Preserve order; the deque bound drops oldest on overflow.
+                self.events.extendleft(reversed(events))
+            self._pending_spans.extend(spans)
+            del self._pending_spans[:-4096]  # spans are bounded too
+
+
+_recorder = _Recorder()
+
+
+def record(event: Dict[str, Any]) -> None:
+    """Buffer one finished-task phase event (worker execution path)."""
+    _recorder.record(event)
+
+
+def flush_task_events(timeout: float = 30.0) -> bool:
+    """Force a flush (tests / shutdown hooks)."""
+    return _recorder.flush(timeout=timeout)

@@ -26,6 +26,7 @@ from typing import Any, Dict, List, Optional
 import cloudpickle
 
 from . import context as ctx
+from . import task_events
 from .client import CoreClient
 from .controller import ActorDiedError, TaskError
 from .ids import WorkerID
@@ -93,6 +94,10 @@ class ActorMailbox:
         buffer until the gap fills — or until a bounded timeout flushes
         them, so a call lost to a path failure stalls ordering, not the
         actor."""
+        if "__recv_ts__" not in spec and task_events.enabled():
+            # Arrival stamp for the queue-wait phase: covers time spent in
+            # the hold-back buffer AND the mailbox queue.
+            spec["__recv_ts__"] = time.time()
         caller = spec.get("caller")
         seq = spec.get("seqno")
         if caller is None or seq is None:
@@ -452,6 +457,8 @@ class WorkerRuntime:
             self._cancel_task(msg["task_id"])
             return None
         spec = msg["spec"]
+        if task_events.enabled():
+            spec["__recv_ts__"] = time.time()
         if spec.get("streaming"):
             # Generator state lives in the controller; a direct streaming
             # call would hang the caller's future forever.
@@ -586,6 +593,8 @@ class WorkerRuntime:
         kind = msg["kind"]
         if kind == "execute_task":
             spec = msg["spec"]
+            if task_events.enabled():
+                spec["__recv_ts__"] = time.time()
             if not self._admit(spec):
                 await conn.send({"kind": "task_spillback",
                                  "task_id": spec["task_id"],
@@ -705,6 +714,19 @@ class WorkerRuntime:
             # event — the completion report carries the start time so the
             # timeline can synthesize the full span.
             spec["__start_ts__"] = time.time()
+        if task_events.enabled():
+            # Flight recorder (TaskEventBuffer analog): phase timestamps
+            # accumulate in __ph__ and are finalized by the completion
+            # paths — which cover sync tasks, actor calls, async actor
+            # coroutines (drive()), streaming, and every error path alike.
+            now = time.time()
+            ph = spec["__ph__"] = {"start_ts": now}
+            recv = spec.pop("__recv_ts__", None)
+            if recv is not None:
+                ph["queue_wait_s"] = max(0.0, now - recv)
+                sub = spec.get("submit_ts")
+                if sub is not None:
+                    ph["scheduling_delay_s"] = max(0.0, recv - sub)
         tls = ctx.task_local
         tls.task_id = task_id
         tls.label = spec.get("label", "")
@@ -739,6 +761,11 @@ class WorkerRuntime:
         span_transferred = False
         try:
             args, kwargs = self._resolve_args(spec)
+            ph = spec.get("__ph__")
+            if ph is not None:
+                t = time.time()
+                ph["arg_fetch_s"] = max(0.0, t - ph["start_ts"])
+                ph["exec_start"] = t
             if spec.get("actor_id") and actor_instance is not None:
                 method = getattr(actor_instance, spec["method_name"])
                 result = method(*args, **kwargs)
@@ -822,12 +849,45 @@ class WorkerRuntime:
             self.running_threads.pop(task_id, None)
             tls.task_id = None
 
+    def _record_phases(self, spec: Dict[str, Any], outcome: str) -> None:
+        """Finalize + buffer this task's phase event (flight recorder).
+        Pops ``__ph__`` so a completion that re-routes (store failure →
+        _complete_error) records exactly once."""
+        ph = spec.pop("__ph__", None)
+        if ph is None:
+            return
+        end = time.time()
+        if "exec_start" in ph and "exec_s" not in ph:
+            ph["exec_s"] = max(0.0, end - ph.pop("exec_start"))
+        ph.pop("exec_start", None)
+        task_events.record({
+            "task_id": spec.get("task_id"),
+            "label": spec.get("label"),
+            "actor_id": spec.get("actor_id"),
+            "worker_id": self.worker_id,
+            "node_id": self.node_id,
+            "start_ts": ph.pop("start_ts"),
+            "end_ts": end,
+            "outcome": outcome,
+            "phases": {k: v for k, v in ph.items()
+                       if k in task_events.PHASE_KEYS},
+        })
+
     def _complete_ok(self, spec: Dict[str, Any], result: Any) -> None:
+        ph = spec.get("__ph__")
+        t_store = 0.0
+        if ph is not None:
+            t_store = time.time()
+            if "exec_start" in ph:
+                ph["exec_s"] = max(0.0, t_store - ph.pop("exec_start"))
         try:
             locations = self._store_returns(spec, result)
         except BaseException as e:  # noqa: BLE001
             self._complete_error(spec, e, traceback.format_exc())
             return
+        if ph is not None:
+            ph["result_store_s"] = max(0.0, time.time() - t_store)
+        self._record_phases(spec, "finished")
         msg = {
             "kind": "task_done",
             "task_id": spec["task_id"],
@@ -848,6 +908,7 @@ class WorkerRuntime:
         self.client.send_nowait(msg)
 
     def _complete_error(self, spec: Dict[str, Any], e: BaseException, tb: str) -> None:
+        self._record_phases(spec, "failed")
         label = spec.get("label", spec["task_id"][:8])
         err = TaskError(label, e, tb)
         try:
@@ -913,6 +974,7 @@ class WorkerRuntime:
                 # Consumer dropped the generator: stop producing.
                 result.close()
                 break
+        self._record_phases(spec, "finished")
         self.client.request(
             {
                 "kind": "task_done",
@@ -951,6 +1013,7 @@ class WorkerRuntime:
             except BaseException as e:  # noqa: BLE001
                 self._complete_error(spec, e, traceback.format_exc())
                 return
+            self._record_phases(spec, "finished")
             await asyncio.get_running_loop().run_in_executor(
                 None,
                 lambda: self.client.request(

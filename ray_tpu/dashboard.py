@@ -16,7 +16,8 @@ Endpoints:
     GET /api/cluster         resources + node table
     GET /api/nodes           state API list_nodes
     GET /api/actors          state API list_actors
-    GET /api/tasks           state API list_tasks (+ ?summary=1)
+    GET /api/tasks           state API list_tasks (+ ?summary=1,
+                             ?breakdown=1 for per-phase latency p50/p99)
     GET /api/workers         state API list_workers
     GET /api/objects         state API list_objects
     GET /api/jobs            job list (ray_tpu.jobs)
@@ -108,6 +109,9 @@ _TIMELINE_PAGE = """<!doctype html>
  auto-refreshes)</small></h1>
 <div id="tip"></div><div id="empty"></div>
 <svg id="chart" width="100%" height="60"></svg>
+<h1 style="margin-top:1.5rem">Latency breakdown <small style="color:#888">
+(per label, flight-recorder phases)</small></h1>
+<div id="breakdown" style="color:#888">no phase events yet</div>
 <script>
 const COLORS = ["#4e79a7","#f28e2b","#59a14f","#e15759","#b07aa1",
                 "#76b7b2","#edc948","#ff9da7","#9c755f","#bab0ac"];
@@ -166,7 +170,37 @@ async function draw() {
     el.onmouseout = () => tip.style.display = "none";
   });
 }
-draw(); setInterval(draw, 5000);
+async function drawBreakdown() {
+  const r = await fetch("/api/tasks?breakdown=1");
+  const rows = await r.json();
+  const labels = Object.keys(rows || {}).sort();
+  if (!labels.length) return;
+  const ms = v => (v * 1000).toFixed(2);
+  // Labels are user task names: escape or a crafted name is stored XSS.
+  const esc = s => String(s).replace(/[&<>"']/g,
+      c => "&#" + c.charCodeAt(0) + ";");
+  let html = `<table style="border-collapse:collapse;font-size:12px">
+    <tr><th style="text-align:left;padding:2px 10px">label</th>
+    <th style="text-align:left;padding:2px 10px">phase</th>
+    <th style="padding:2px 10px">count</th>
+    <th style="padding:2px 10px">mean ms</th>
+    <th style="padding:2px 10px">p50 ms</th>
+    <th style="padding:2px 10px">p99 ms</th></tr>`;
+  for (const label of labels) {
+    for (const [phase, st] of Object.entries(rows[label])) {
+      html += `<tr><td style="padding:2px 10px">${esc(label)}</td>
+        <td style="padding:2px 10px">${esc(phase)}</td>
+        <td style="padding:2px 10px;text-align:right">${st.count}</td>
+        <td style="padding:2px 10px;text-align:right">${ms(st.mean)}</td>
+        <td style="padding:2px 10px;text-align:right">${ms(st.p50)}</td>
+        <td style="padding:2px 10px;text-align:right">${ms(st.p99)}</td>
+        </tr>`;
+    }
+  }
+  document.getElementById("breakdown").innerHTML = html + "</table>";
+}
+draw(); drawBreakdown();
+setInterval(() => { draw(); drawBreakdown(); }, 5000);
 </script></body></html>
 """
 
@@ -245,9 +279,12 @@ class Dashboard:
             elif kind == "actors":
                 data = state_api.list_actors()
             elif kind == "tasks":
-                data = (state_api.summarize_tasks()
-                        if request.query.get("summary")
-                        else state_api.list_tasks())
+                if request.query.get("breakdown"):
+                    data = state_api.summarize_tasks(breakdown=True)
+                elif request.query.get("summary"):
+                    data = state_api.summarize_tasks()
+                else:
+                    data = state_api.list_tasks()
             elif kind == "workers":
                 data = state_api.list_workers()
             elif kind == "objects":

@@ -206,6 +206,23 @@ _define("RTPU_SP_MODE", str, "ring",
 # -- observability -----------------------------------------------------------
 _define("RTPU_METRICS_FLUSH_S", float, 1.0,
         "Flush period for app metrics (util/metrics.py) to the controller.")
+_define("RTPU_TASK_EVENTS", bool, True,
+        "Worker-side task flight recorder: per-task phase timestamps "
+        "(scheduling delay, queue wait, arg fetch, execute, result store) "
+        "buffered and shipped to the controller in batches together with "
+        "finished tracing spans (reference: TaskEventBuffer -> "
+        "GcsTaskManager, task_event_buffer.h:206). 0 disables recording "
+        "entirely; the hot path then pays one flag check.")
+_define("RTPU_TASK_EVENTS_FLUSH_S", float, 0.5,
+        "Flight-recorder flush period: how often a worker ships its "
+        "buffered phase events + spans to the controller.")
+_define("RTPU_TASK_EVENTS_BUF", int, 4096,
+        "Per-worker flight-recorder buffer (bounded deque): oldest phase "
+        "events drop first when the controller is unreachable longer than "
+        "the buffer covers.")
+_define("RTPU_SPANS_MAX", int, 20000,
+        "Controller-side ring of finished tracing spans shipped by worker "
+        "flight recorders (serves get_cluster_spans()).")
 _define("RTPU_LOG_TO_DRIVER", bool, True,
         "Tee worker stdout/stderr to connected drivers' consoles.")
 _define("RTPU_WORKER_LOG_MAX", int, 16 * 1024 * 1024,

@@ -69,11 +69,22 @@ def generate_dashboard(prom_text: str,
             exprs = [(f"rate({name}[5m])", "{{instance}}")]
             ptitle = f"{name} (rate/s)"
         elif mtype == "histogram":
-            exprs = [
-                (f"histogram_quantile({q}, "
-                 f"sum(rate({name}_bucket[5m])) by (le))", f"p{int(q*100)}")
-                for q in (0.5, 0.95, 0.99)
-            ]
+            # Flight-recorder phase histograms are tagged per task label —
+            # quantile per label so one panel breaks latency down by task.
+            if name.startswith("rtpu_task_"):
+                exprs = [
+                    (f"histogram_quantile({q}, "
+                     f"sum(rate({name}_bucket[5m])) by (le, label))",
+                     f"{{{{label}}}} p{int(q * 100)}")
+                    for q in (0.5, 0.99)
+                ]
+            else:
+                exprs = [
+                    (f"histogram_quantile({q}, "
+                     f"sum(rate({name}_bucket[5m])) by (le))",
+                     f"p{int(q * 100)}")
+                    for q in (0.5, 0.95, 0.99)
+                ]
             ptitle = f"{name} (quantiles)"
         else:  # gauge / untyped
             exprs = [(name, "{{instance}}")]

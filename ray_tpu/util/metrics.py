@@ -5,7 +5,10 @@ defines metrics and records values; they surface on the cluster's
 Prometheus endpoint. Here the controller IS the aggregation point (it
 already serves /metrics), so workers buffer updates locally and a daemon
 flusher ships deltas over the existing control connection fire-and-forget
-— no per-node metrics agent daemon, no OpenCensus dependency.
+— no per-node metrics agent daemon, no OpenCensus dependency. Histograms
+are pre-aggregated into bucket counts at record time, so both the pending
+buffer and the wire message stay O(buckets) regardless of observation
+rate.
 
 Usage (same surface as the reference)::
 
@@ -20,6 +23,7 @@ Usage (same surface as the reference)::
 """
 from __future__ import annotations
 
+import bisect
 import threading
 import time
 from typing import Dict, Optional, Sequence, Tuple
@@ -31,6 +35,17 @@ _TagTuple = Tuple[Tuple[str, str], ...]
 
 def _tags_tuple(tags: Optional[Dict[str, str]]) -> _TagTuple:
     return tuple(sorted((tags or {}).items()))
+
+
+def _hist_state(boundaries: Sequence[float]) -> dict:
+    return {"buckets": [0] * (len(boundaries) + 1), "sum": 0.0, "count": 0}
+
+
+def _hist_merge(dst: dict, src: dict) -> None:
+    for i, c in enumerate(src["buckets"]):
+        dst["buckets"][min(i, len(dst["buckets"]) - 1)] += c
+    dst["sum"] += src["sum"]
+    dst["count"] += src["count"]
 
 
 class _Aggregator:
@@ -53,8 +68,20 @@ class _Aggregator:
                 m["data"][tags] = value
             elif mtype == "counter":
                 m["data"][tags] = m["data"].get(tags, 0.0) + value
-            else:  # histogram: store raw observations, shipped as a list
-                m["data"].setdefault(tags, []).append(value)
+            else:
+                # Histogram: pre-aggregate into bucket counts (+Inf bucket,
+                # sum, count) at record time — a hot path observing at high
+                # rate keeps pending memory AND the wire message O(buckets),
+                # where raw observation lists grew without bound across
+                # failed flushes.
+                h = m["data"].get(tags)
+                if h is None:
+                    h = m["data"][tags] = _hist_state(m["boundaries"])
+                i = min(bisect.bisect_left(m["boundaries"], value),
+                        len(m["boundaries"]))
+                h["buckets"][i] += 1
+                h["sum"] += value
+                h["count"] += 1
         self._ensure_flusher()
 
     def _ensure_flusher(self) -> None:
@@ -92,7 +119,11 @@ class _Aggregator:
                         if m["type"] == "counter":
                             cur["data"][tags] = cur["data"].get(tags, 0.0) + v
                         elif m["type"] == "histogram":
-                            cur["data"].setdefault(tags, []).extend(v)
+                            ch = cur["data"].get(tags)
+                            if ch is None:
+                                cur["data"][tags] = v
+                            else:
+                                _hist_merge(ch, v)
                         else:  # gauge: the newer pending value wins
                             cur["data"].setdefault(tags, v)
             return

@@ -57,8 +57,17 @@ def profile_workers(timeout: float = 2.0) -> Dict[str, Any]:
     return _req({"kind": "profile_workers", "timeout": timeout})
 
 
-def summarize_tasks() -> Dict[str, Dict[str, int]]:
-    """Per-function counts of task events (reference: `ray summary tasks`)."""
+def summarize_tasks(breakdown: bool = False) -> Dict[str, Dict[str, Any]]:
+    """Per-function counts of task events (reference: `ray summary tasks`).
+
+    With ``breakdown=True``, returns per-label per-phase latency stats
+    instead — ``{label: {phase: {count, mean, p50, p99}}}`` over the
+    flight-recorder histograms (scheduling_delay_s, queue_wait_s,
+    arg_fetch_s, exec_s, result_store_s), the `ray summary` timing-column
+    analog.
+    """
+    if breakdown:
+        return _req({"kind": "list_state", "what": "summary_breakdown"})
     return _req({"kind": "list_state", "what": "summary"})
 
 
@@ -72,34 +81,125 @@ def metrics_address() -> Optional[str]:
     return f"{host}:{port}"
 
 
+def _phase_subslices(pev: Dict[str, Any], pid: str, tid: str,
+                     task_id: str) -> List[Dict[str, Any]]:
+    """Flight-recorder phases -> nested sub-slices on the task's row:
+    queue_wait before the worker-side start, then arg_fetch / exec /
+    result_store laid end to end from it."""
+    out: List[Dict[str, Any]] = []
+    phases = pev.get("phases") or {}
+    start = pev.get("start_ts")
+    if start is None:
+        return out
+
+    def sub(name: str, ts: float, dur_s: float) -> None:
+        out.append({
+            "name": name, "cat": "phase", "ph": "X",
+            "ts": ts * 1e6, "dur": max(0.5, dur_s * 1e6),
+            "pid": pid, "tid": tid,
+            "args": {"task_id": task_id, f"{name}_s": dur_s},
+        })
+
+    qw = phases.get("queue_wait_s")
+    if qw:
+        sub("queue_wait", start - qw, qw)
+    cursor = start
+    for key, name in (("arg_fetch_s", "arg_fetch"), ("exec_s", "exec"),
+                      ("result_store_s", "result_store")):
+        d = phases.get(key)
+        if d is None:
+            continue
+        sub(name, cursor, d)
+        cursor += d
+    return out
+
+
 def timeline(filename: Optional[str] = None) -> Any:
     """Export task events as a chrome-trace JSON (trace-event format).
 
     Pairs each task's "running" event with its terminal event into one
-    complete ("ph": "X") slice; rows are (node, worker). Load the file in
-    chrome://tracing or https://ui.perfetto.dev.
+    complete ("ph": "X") slice; rows are (node, worker). With the flight
+    recorder on (RTPU_TASK_EVENTS), each task slice additionally carries
+    nested phase sub-slices (queue_wait / arg_fetch / exec / result_store)
+    and a flow arrow ("ph": "s"/"f") linking the driver's submit event to
+    the worker's run slice across pid rows; tasks that failed before ever
+    running show as instant events ("ph": "i") on their owning node's row.
+    Load the file in chrome://tracing or https://ui.perfetto.dev.
     """
     events = _req({"kind": "task_events"})
     starts: Dict[str, Dict[str, Any]] = {}
+    submitted: Dict[str, Dict[str, Any]] = {}
+    phase_evs: Dict[str, Dict[str, Any]] = {}
+    done: List[tuple] = []  # (start_ev, terminal_ev)
+    ran: set = set()
     trace: List[Dict[str, Any]] = []
     for ev in events:
         tid = ev["task_id"]
-        if ev["event"] == "running":
+        if ev["event"] == "submitted":
+            submitted[tid] = ev
+        elif ev["event"] == "running":
             starts[tid] = ev
-        elif ev["event"] in ("finished", "failed") and tid in starts:
-            s = starts.pop(tid)
-            trace.append(
-                {
-                    "name": s.get("label") or tid[:8],
-                    "cat": "actor_task" if s.get("actor_id") else "task",
-                    "ph": "X",
-                    "ts": s["ts"] * 1e6,
-                    "dur": max(1.0, (ev["ts"] - s["ts"]) * 1e6),
-                    "pid": (s.get("node_id") or "node")[:12],
-                    "tid": (s.get("worker_id") or "worker")[:12],
-                    "args": {"task_id": tid, "outcome": ev["event"]},
-                }
-            )
+            ran.add(tid)
+        elif ev["event"] == "phases":
+            phase_evs[tid] = ev
+        elif ev["event"] in ("finished", "failed"):
+            if tid in starts:
+                done.append((starts.pop(tid), ev))
+            elif ev["event"] == "failed" and tid not in ran:
+                # Failed before ever running (scheduling/spawn/dependency
+                # failure): an instant event on the owning node row, so the
+                # failure is visible in the trace at all.
+                trace.append({
+                    "name": f"{ev.get('label') or tid[:8]} failed",
+                    "cat": "task", "ph": "i", "s": "p",
+                    "ts": ev["ts"] * 1e6,
+                    "pid": (ev.get("node_id") or "driver")[:12],
+                    "tid": "failures",
+                    "args": {"task_id": tid},
+                })
+    flow_id = 0
+    for s, ev in done:
+        tid = s["task_id"]
+        pid = (s.get("node_id") or "node")[:12]
+        row = (s.get("worker_id") or "worker")[:12]
+        pev = phase_evs.get(tid)
+        args: Dict[str, Any] = {"task_id": tid, "outcome": ev["event"]}
+        if pev is not None:
+            args.update(pev.get("phases") or {})
+        trace.append(
+            {
+                "name": s.get("label") or tid[:8],
+                "cat": "actor_task" if s.get("actor_id") else "task",
+                "ph": "X",
+                "ts": s["ts"] * 1e6,
+                "dur": max(1.0, (ev["ts"] - s["ts"]) * 1e6),
+                "pid": pid,
+                "tid": row,
+                "args": args,
+            }
+        )
+        if pev is not None:
+            trace.extend(_phase_subslices(pev, pid, row, tid))
+        sub = submitted.get(tid)
+        if sub is not None:
+            # The driver's submit slice (its duration IS the scheduling
+            # delay) + a flow arrow landing on the worker's run slice.
+            flow_id += 1
+            sub_ts = sub["ts"] * 1e6
+            run_ts = s["ts"] * 1e6
+            label = s.get("label") or tid[:8]
+            trace.append({
+                "name": f"submit {label}", "cat": "task_submit", "ph": "X",
+                "ts": sub_ts, "dur": max(1.0, run_ts - sub_ts),
+                "pid": "driver", "tid": "submit",
+                "args": {"task_id": tid},
+            })
+            trace.append({"name": "task", "cat": "flow", "ph": "s",
+                          "id": flow_id, "ts": sub_ts,
+                          "pid": "driver", "tid": "submit"})
+            trace.append({"name": "task", "cat": "flow", "ph": "f",
+                          "bp": "e", "id": flow_id,
+                          "ts": run_ts, "pid": pid, "tid": row})
     # Still-running tasks appear as begin events so they show in the view.
     for tid, s in starts.items():
         trace.append(

@@ -11,7 +11,10 @@ registers a real TracerProvider and spans flow through the user's
 exporters. This image ships only ``opentelemetry-api`` (no-op tracers that
 cannot carry context), so a built-in tracer provides the same surface:
 thread-local current-span context, child spans, per-process finished-span
-records queryable via ``get_finished_spans()``.
+records queryable via ``get_finished_spans()`` — and, with the flight
+recorder on (``RTPU_TASK_EVENTS``), cluster-wide via
+``get_cluster_spans()``: workers ship their finished spans to the
+controller alongside task phase events.
 
 Everything is gated on ``RTPU_TRACING`` (set by ``setup_tracing``; worker
 processes inherit it through the spawn env): when off, submission pays one
@@ -88,6 +91,61 @@ def current_trace_id() -> str:
 def get_finished_spans() -> List[Span]:
     with _finished_lock:
         return list(_finished)
+
+
+def drain_finished_spans() -> List[Span]:
+    """Pop (and clear) this process's finished-span records. Used by the
+    worker flight recorder (core/task_events.py) to ship spans to the
+    controller's cluster-wide collection — after a drain,
+    ``get_finished_spans()`` in THIS process no longer returns them."""
+    with _finished_lock:
+        spans, _finished[:] = list(_finished), []
+    return spans
+
+
+def span_to_dict(s: Span) -> Dict[str, Any]:
+    """Wire/JSON form of a span (what get_cluster_spans returns)."""
+    return {
+        "name": s.name,
+        "trace_id": s.context.trace_id,
+        "span_id": s.context.span_id,
+        "parent_span_id": s.parent_span_id,
+        "kind": s.kind,
+        "attributes": dict(s.attributes),
+        "start_time": s.start_time,
+        "end_time": s.end_time,
+    }
+
+
+def get_cluster_spans(trace_id: Optional[str] = None,
+                      timeout: float = 10.0) -> List[Dict[str, Any]]:
+    """Cluster-wide finished spans, as dicts sorted by start time.
+
+    Merges this process's records (e.g. the driver's PRODUCER submit
+    spans, which are never shipped) with the controller's collection of
+    spans shipped by every worker's flight recorder (CONSUMER run spans) —
+    so one trace_id yields the submitter AND executor sides of a task even
+    though they finished in different processes. Filter with ``trace_id``;
+    without a live session only local spans are returned.
+    """
+    from ray_tpu.core import context as ctx
+
+    by_id: Dict[str, Dict[str, Any]] = {
+        d["span_id"]: d for d in (span_to_dict(s)
+                                  for s in get_finished_spans())}
+    if ctx.is_initialized():
+        try:
+            for d in ctx.get_worker_context().client.request(
+                    {"kind": "get_spans", "trace_id": trace_id},
+                    timeout=timeout):
+                by_id.setdefault(d["span_id"], d)
+        except Exception:
+            pass  # controller unreachable: local records still answer
+    spans = list(by_id.values())
+    if trace_id:
+        spans = [d for d in spans if d["trace_id"] == trace_id]
+    spans.sort(key=lambda d: d["start_time"])
+    return spans
 
 
 class _SpanScope:

@@ -51,6 +51,25 @@ def test_actor_call_throughput_floor(ray_start_regular):
     assert 300 / dt > 100, f"actor call throughput {300/dt:.0f}/s below floor"
 
 
+def test_task_events_disabled_path_overhead(ray_start_regular, monkeypatch):
+    """Flight-recorder guard: with RTPU_TASK_EVENTS=0 the recorder must
+    cost the task round-trip nothing beyond one flag check — the disabled
+    path holds the same throughput floor as the always-on benchmark above,
+    so the recorder can never silently tax the hot path."""
+    monkeypatch.setenv("RTPU_TASK_EVENTS", "0")
+
+    @ray_tpu.remote
+    def nop():
+        return None
+
+    ray_tpu.get([nop.remote() for _ in range(8)])  # warm the pool
+    t0 = time.perf_counter()
+    ray_tpu.get([nop.remote() for _ in range(200)])
+    dt = time.perf_counter() - t0
+    assert 200 / dt > 30, \
+        f"disabled-recorder task throughput {200/dt:.0f}/s below floor"
+
+
 def test_large_object_bandwidth_floor(ray_start_regular):
     arr = np.ones(4 * 1024 * 1024, dtype=np.float64)  # 32MB
     t0 = time.perf_counter()
