@@ -10,6 +10,7 @@ Usage (no console-script install needed):
     python -m ray_tpu.cli start --address HOST:PORT [--num-cpus N]
     python -m ray_tpu.cli status  [--address HOST:PORT]
     python -m ray_tpu.cli summary [--address HOST:PORT]
+    python -m ray_tpu.cli logs [NAME] [--task-id ID] [--follow|--tail N]
     python -m ray_tpu.cli timeline --out trace.json
     python -m ray_tpu.cli job submit -- python my_script.py
     python -m ray_tpu.cli job logs <job_id>
@@ -199,6 +200,42 @@ def cmd_memory(args) -> int:
                   f"{o['storage']:8} {(o['node_id'] or '')[:8]}")
     rt.shutdown()
     return 0
+
+
+def cmd_logs(args) -> int:
+    """`rtpu logs` (reference: the `ray logs` CLI + dashboard log API):
+    list worker log files cluster-wide, fetch one file (or one task's /
+    actor's attributed output) from whichever node holds it, or --follow
+    a live stream of new lines."""
+    rt = _connect(args)
+    from ray_tpu.util import state
+
+    try:
+        sel = {"name": args.name, "node_id": args.node,
+               "task_id": args.task_id, "actor_id": args.actor_id,
+               "worker_id": args.worker_id}
+        if not any(sel.values()):
+            listing = state.list_logs()
+            for nid in sorted(listing):
+                print(f"node {nid}")
+                for f in listing[nid]:
+                    print(f"  {f['name']:<32} {f['size']:>12} bytes")
+            return 0
+        if args.follow:
+            try:
+                for chunk in state.follow_log(**sel):
+                    sys.stdout.write(chunk)
+                    sys.stdout.flush()
+            except KeyboardInterrupt:
+                pass
+            return 0
+        text = state.get_log_text(**sel, tail_lines=args.tail)
+        sys.stdout.write(text)
+        if text and not text.endswith("\n"):
+            sys.stdout.write("\n")
+        return 0
+    finally:
+        rt.shutdown()
 
 
 def cmd_serve(args) -> int:
@@ -415,6 +452,24 @@ def main(argv=None) -> int:
     p.add_argument("--address", default=None)
     p.add_argument("--out", default="timeline.json")
     p.set_defaults(fn=cmd_timeline)
+
+    p = sub.add_parser("logs", help="list / fetch / follow cluster worker "
+                                    "logs")
+    p.add_argument("name", nargs="?", default=None,
+                   help="log file name (from the no-argument listing)")
+    p.add_argument("--address", default=None)
+    p.add_argument("--node", default=None, help="node id owning the file")
+    p.add_argument("--task-id", default=None,
+                   help="fetch only this task's attributed output")
+    p.add_argument("--actor-id", default=None,
+                   help="fetch only this actor's attributed output")
+    p.add_argument("--worker-id", default=None,
+                   help="resolve the file by worker id")
+    p.add_argument("--follow", "-f", action="store_true",
+                   help="stream new lines live (ctrl-c to stop)")
+    p.add_argument("--tail", type=int, default=0,
+                   help="only the last N lines")
+    p.set_defaults(fn=cmd_logs)
 
     p = sub.add_parser("memory", help="object reference/memory table")
     p.add_argument("--address", default=None)

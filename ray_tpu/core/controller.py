@@ -131,6 +131,9 @@ class NodeInfo:
     # Per-worker-process cpu%/rss from the agent heartbeat (dashboard
     # reporter parity); pid -> {cpu_percent, rss}.
     proc_stats: Dict[str, Dict[str, float]] = field(default_factory=dict)
+    # Total bytes of worker log files on the host (agent heartbeats;
+    # exported as the rtpu_worker_log_bytes gauge).
+    log_bytes: int = 0
 
 
 @dataclass
@@ -342,6 +345,11 @@ class Controller:
         # recorders (util/tracing.py get_cluster_spans backend).
         self.cluster_spans: "collections.deque" = collections.deque(
             maxlen=flags.get("RTPU_SPANS_MAX"))
+        # Cluster log index: worker_id -> {node_id, name} of its log file,
+        # kept after the worker dies so `rtpu logs --task-id/--worker-id`
+        # can route post-mortem fetches to the owning host (bounded).
+        self.worker_log_names: "collections.OrderedDict[str, Dict[str, str]]" = (
+            collections.OrderedDict())
         # Node-wide native object arena (plasma-equivalent, src/store).
         # Created here so worker spawns inherit RTPU_ARENA via env; falls
         # back to per-object segments when the native lib is unavailable.
@@ -621,6 +629,12 @@ class Controller:
 
     async def _on_worker_death(self, w: WorkerInfo) -> None:
         self.workers.pop(w.worker_id, None)
+        # Crash post-mortem (reference: worker-death exit_detail quoting
+        # the crashed process's stderr in RayTaskError / ActorDiedError):
+        # fetched only when the death actually fails user work.
+        detail = ""
+        if (w.current_task and w.current_task in self.tasks) or w.actor_ids:
+            detail = await self._worker_exit_detail(w)
         node = self.nodes.get(w.node_id)
         if node:
             node.workers.discard(w.worker_id)
@@ -644,11 +658,11 @@ class Controller:
                 err: Exception = OutOfMemoryError(
                     f"worker {w.worker_id[:8]} was killed by the memory "
                     f"monitor while running task {spec.get('label', '')} "
-                    f"(host memory pressure)")
+                    f"(host memory pressure){detail}")
             else:
                 err = WorkerCrashedError(
                     f"worker {w.worker_id[:8]} died while running task "
-                    f"{spec.get('label', '')}")
+                    f"{spec.get('label', '')}{detail}")
             if not self._maybe_retry_task(spec):
                 self._finalize_generator(spec["task_id"], err)
                 for oid in spec["return_ids"]:
@@ -657,10 +671,40 @@ class Controller:
         for aid in list(w.actor_ids):
             actor = self.actors.get(aid)
             if actor and actor.state != "dead":
-                err = WorkerCrashedError(f"actor {aid[:8]} process died")
+                err = WorkerCrashedError(
+                    f"actor {aid[:8]} process died{detail}")
                 if not self._maybe_restart_actor(actor, err):
                     self._mark_actor_dead(actor, err)
         self._wake_scheduler()
+
+    async def _worker_exit_detail(self, w: WorkerInfo) -> str:
+        """Bounded tail of a dead worker's log file, fetched from its host
+        (the controller reads head-host files itself, agent hosts answer
+        over their control connection) — so OOM-killed and segfaulted
+        workers are attributable from the driver without SSH. Never fatal,
+        never unbounded."""
+        limit = int(flags.get("RTPU_EXIT_DETAIL_BYTES"))
+        if not limit or not w.spawn_token:
+            return ""
+        from . import worker_logs as wl
+
+        name = wl.log_file_name(w.spawn_token)
+        node = self.nodes.get(w.node_id)
+        try:
+            if node is not None and node.agent_conn is not None:
+                text = await node.agent_conn.request(
+                    {"kind": "tail_log", "name": name, "bytes": limit},
+                    timeout=3)
+            else:
+                text = await asyncio.to_thread(
+                    wl.read_tail, os.path.join(wl.log_dir(), name), limit)
+        except Exception:
+            return ""
+        text = (text or "").strip()
+        if not text or text.startswith("<log unavailable"):
+            return ""
+        return (f"\n--- last log lines of the dead worker ({name}) ---\n"
+                f"{text}")
 
     def _fail_env_tasks(self, env_hash: str, err: Exception) -> None:
         """A runtime env cannot materialize: every task queued for it would
@@ -791,8 +835,17 @@ class Controller:
             if proc is not None:
                 w.proc = proc
             else:
-                w.spawn_token = token  # agent-spawned: proc lives on the agent
                 self._agent_spawns.pop(token, None)  # no longer outstanding
+            # Kept for BOTH spawn flavors: names the worker's log file for
+            # the cluster log index (kill routing still checks proc first).
+            w.spawn_token = token
+            from .worker_logs import log_file_name
+
+            self.worker_log_names[worker_id] = {
+                "node_id": node_id, "name": log_file_name(token)}
+            self.worker_log_names.move_to_end(worker_id)
+            while len(self.worker_log_names) > 8192:
+                self.worker_log_names.popitem(last=False)
             was_tpu_spawn = token in self._tpu_spawn_tokens
             self._tpu_spawn_tokens.discard(token)
             # Local spawns: adopt the controller-side allocation (also
@@ -1036,12 +1089,12 @@ class Controller:
         return dict(self.rpc_counts)
 
     async def _h_worker_logs(self, conn, msg):
-        """List / tail worker log files across hosts (dashboard log
-        viewer; reference: dashboard log endpoints). Controller-host logs
-        read locally; agent hosts answer over their control connection."""
+        """Legacy list/tail of worker log files on one host (the original
+        dashboard viewer contract: a list of names, or one tail string).
+        The cluster-wide surface is list_logs / resolve_log / get_log."""
         import os as _os
 
-        from .worker_logs import log_dir
+        from .worker_logs import log_dir, list_log_files, read_tail
 
         node_id = msg.get("node_id") or ""
         name = msg.get("name")
@@ -1052,28 +1105,105 @@ class Controller:
                     return await node.agent_conn.request(
                         {"kind": "tail_log", "name": name,
                          "bytes": msg.get("bytes", 65536)}, timeout=10)
-                return await node.agent_conn.request(
+                res = await node.agent_conn.request(
                     {"kind": "list_logs"}, timeout=10)
+                return [f["name"] if isinstance(f, dict) else f
+                        for f in res]
             except Exception as e:
                 return f"<agent unavailable: {e}>" if name else []
         # Local (controller-spawned workers).
         if not name:
-            try:
-                d = log_dir()
-                return sorted(
-                    f for f in _os.listdir(d) if f.startswith("worker-"))
-            except OSError:
-                return []
+            return [f["name"] for f in list_log_files()]
         safe = _os.path.basename(name)
         nbytes = min(int(msg.get("bytes", 65536)), 1 << 20)
         try:
-            path = _os.path.join(log_dir(), safe)
-            size = _os.path.getsize(path)
-            with open(path, "rb") as f:
-                f.seek(max(0, size - nbytes))
-                return f.read().decode("utf-8", "replace")
+            return read_tail(_os.path.join(log_dir(), safe), nbytes)
         except OSError as e:
             return f"<log unavailable: {e}>"
+
+    # -------------------------------------------------- cluster log subsystem
+    # Reference: the `ray logs` CLI + dashboard log API — any log file on
+    # any node is listable and fetchable through the head, with task/actor
+    # attribution resolving an id to the owning host's file.
+
+    async def _h_list_logs(self, conn, msg):
+        """Cluster log index: node_id -> [{name, size, mtime}] for every
+        alive node (agent hosts answer over their control connection; the
+        controller lists the head host itself)."""
+        out: Dict[str, Any] = {}
+        local: Optional[List[Dict[str, Any]]] = None
+        for node in list(self.nodes.values()):
+            if not node.alive:
+                continue
+            if node.agent_conn is not None:
+                try:
+                    out[node.node_id] = await node.agent_conn.request(
+                        {"kind": "list_logs"}, timeout=5)
+                except Exception:
+                    out[node.node_id] = []
+            else:
+                if local is None:
+                    from .worker_logs import list_log_files
+
+                    local = list_log_files()
+                out[node.node_id] = local
+        return out
+
+    def _resolve_log_target(self, msg) -> Optional[Dict[str, str]]:
+        """task/actor/worker id -> {node_id, name} of the log file the
+        owning worker writes (the attribution the cluster log index keeps
+        beyond worker death)."""
+        wid = msg.get("worker_id")
+        if not wid and msg.get("actor_id"):
+            a = self.actors.get(msg["actor_id"])
+            wid = a.worker_id if a is not None else None
+        if not wid and msg.get("task_id"):
+            tid = msg["task_id"]
+            for ev in reversed(self.task_events):
+                if ev.get("task_id") == tid and ev.get("worker_id"):
+                    wid = ev["worker_id"]
+                    break
+        if not wid:
+            return None
+        return self.worker_log_names.get(wid)
+
+    async def _h_resolve_log(self, conn, msg):
+        t = self._resolve_log_target(msg)
+        if t is None:
+            return {"found": False}
+        return {"found": True, **t}
+
+    async def _h_get_log(self, conn, msg):
+        """Fetch a chunk of one worker log from whichever host owns it
+        (offset/max_bytes ranged; task_id/actor_id filters to attributed
+        output via the sidecar index; wait_s long-polls for follow mode).
+        Ids resolve on every call, so a follow stream re-resolves cleanly
+        after a controller bounce rebuilt the index from re-registers."""
+        m = {k: msg.get(k) for k in
+             ("name", "node_id", "offset", "max_bytes", "task_id",
+              "actor_id", "worker_id", "wait_s", "strip_markers")
+             if msg.get(k) is not None}
+        if not m.get("name"):
+            t = self._resolve_log_target(m)
+            if t is None:
+                return {"error": "no log file known for that id",
+                        "data": "", "offset": int(m.get("offset") or 0),
+                        "size": 0, "eof": True}
+            m["name"] = t["name"]
+            m["node_id"] = t["node_id"]
+        node = self.nodes.get(m.get("node_id") or "")
+        if node is not None and node.agent_conn is not None:
+            try:
+                return await node.agent_conn.request(
+                    {"kind": "get_log", **m},
+                    timeout=float(m.get("wait_s") or 0) + 10)
+            except Exception as e:
+                return {"error": f"agent unavailable: {e!r}", "data": "",
+                        "offset": int(m.get("offset") or 0), "size": 0,
+                        "eof": True}
+        from .worker_logs import serve_get_log_wait
+
+        return await serve_get_log_wait(m)
 
     async def _h_wait(self, conn, msg):
         """O(n) wait: one callback registration per missing object, arrivals
@@ -2317,6 +2447,31 @@ class Controller:
                 lines.append(
                     f'rtpu_node_arena_used_bytes{{node="{n.node_id[:12]}"}} '
                     f"{n.arena_stats.get('used', 0)}")
+        # Per-node worker-log volume (agent heartbeats; the controller
+        # samples its own host at scrape time for agent-less nodes).
+        log_lines = []
+        local_log_bytes: Optional[int] = None
+        for n in self.nodes.values():
+            if not n.alive:
+                continue
+            if n.agent_conn is not None:
+                v = n.log_bytes
+            else:
+                if local_log_bytes is None:
+                    from .worker_logs import log_volume_bytes
+
+                    try:
+                        local_log_bytes = log_volume_bytes()
+                    except Exception:
+                        local_log_bytes = 0
+                v = local_log_bytes
+            log_lines.append(
+                f'rtpu_worker_log_bytes{{node="{n.node_id[:12]}"}} {v}')
+        if log_lines:
+            lines.append("# HELP rtpu_worker_log_bytes Bytes of worker "
+                         "log files per node")
+            lines.append("# TYPE rtpu_worker_log_bytes gauge")
+            lines.extend(log_lines)
         # Control-plane RPC accounting (protocol.py handler stats): count +
         # cumulative handler seconds per message kind.
         rpc = protocol.handler_stats()
@@ -2477,6 +2632,8 @@ class Controller:
                 node.mem_fraction = float(msg["mem_fraction"])
             if msg.get("proc_stats") is not None:
                 node.proc_stats = msg["proc_stats"]
+            if msg.get("log_bytes") is not None:
+                node.log_bytes = int(msg["log_bytes"])
         return None
 
     async def _h_spawn_exited(self, conn, msg):

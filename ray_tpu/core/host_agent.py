@@ -228,29 +228,33 @@ class HostAgent:
         if kind == "pull_chunk":
             return read_location_range(msg["loc"], msg["offset"], msg["length"])
         if kind == "list_logs":
-            from .worker_logs import log_dir
+            # This host's worker log files with sizes (cluster log index
+            # building block; reference: the dashboard log API's per-node
+            # file listing).
+            from .worker_logs import list_log_files
 
-            try:
-                d = log_dir()
-                return sorted(
-                    f for f in os.listdir(d) if f.startswith("worker-"))
-            except OSError:
-                return []
+            return list_log_files()
         if kind == "tail_log":
-            # Bounded tail of one worker log (dashboard log viewer;
-            # reference: dashboard log endpoints reading session logs).
-            from .worker_logs import log_dir
+            # Bounded tail of one worker log (dashboard log viewer + crash
+            # post-mortems; attribution markers are stripped so the tail
+            # reads like the process's console did).
+            from .worker_logs import log_dir, read_tail
 
             name = os.path.basename(msg["name"])  # no traversal
             nbytes = min(int(msg.get("bytes", 65536)), 1 << 20)
             try:
-                path = os.path.join(log_dir(), name)
-                size = os.path.getsize(path)
-                with open(path, "rb") as f:
-                    f.seek(max(0, size - nbytes))
-                    return f.read().decode("utf-8", "replace")
+                return read_tail(os.path.join(log_dir(), name), nbytes)
             except OSError as e:
                 return f"<log unavailable: {e}>"
+        if kind == "get_log":
+            # Ranged / task-filtered / long-poll log read (the `rtpu logs`
+            # fetch + follow backend; reference: the `ray logs` CLI and
+            # dashboard log endpoints streaming any file on any node).
+            from .worker_logs import serve_get_log_wait
+
+            m = dict(msg)
+            m["name"] = os.path.basename(m.get("name") or "")
+            return await serve_get_log_wait(m)
         raise ValueError(f"host_agent: unknown message kind {kind!r}")
 
     def _spawn_worker(self, msg: Dict[str, Any],
@@ -372,6 +376,8 @@ class HostAgent:
                 mem_fraction = psutil.virtual_memory().percent / 100.0
             except Exception:
                 mem_fraction = None
+            from .worker_logs import log_volume_bytes
+
             try:
                 await self.ctrl.send(
                     {
@@ -382,6 +388,8 @@ class HostAgent:
                         "num_workers": len(self.procs),
                         "mem_fraction": mem_fraction,
                         "proc_stats": self._proc_stats(),
+                        # Per-node log volume (rtpu_worker_log_bytes gauge).
+                        "log_bytes": log_volume_bytes(),
                     }
                 )
             except Exception:

@@ -239,8 +239,17 @@ class WorkerRuntime:
         # Installed BEFORE registering: the controller may push a task the
         # instant registration lands, and a print() from that first task
         # must not race the tee install (it would go only to the log file,
-        # never to the driver).
-        if flags.get("RTPU_LOG_TO_DRIVER"):
+        # never to the driver). The same tee stamps task/actor attribution
+        # markers + byte-range index entries into the spawn's log file
+        # (worker_logs.LogAttributor) so one task's output is remotely
+        # retrievable without scanning.
+        from . import worker_logs
+
+        self._log_attributor = (
+            worker_logs.LogAttributor.create(self.worker_id, node_id)
+            if flags.get("RTPU_LOG_ATTRIBUTION") else None)
+        if flags.get("RTPU_LOG_TO_DRIVER") \
+                or self._log_attributor is not None:
             self._install_log_forwarder()
         self._env_hash = env_hash
         self.client.request(self._register_msg())
@@ -368,6 +377,8 @@ class WorkerRuntime:
             def _emit(self, line: str) -> None:
                 if not line.strip():
                     return
+                if not flags.get("RTPU_LOG_TO_DRIVER"):
+                    return
                 try:
                     runtime.client.send_nowait({
                         "kind": "worker_log", "line": line,
@@ -379,7 +390,17 @@ class WorkerRuntime:
                     pass
 
             def write(self, text: str) -> int:
-                n = self._inner.write(text)
+                attr = runtime._log_attributor
+                if attr is not None and flags.get("RTPU_LOG_ATTRIBUTION"):
+                    # Attribution path: marker stamping + byte-range index
+                    # entries keyed by the WRITING thread's execution
+                    # context (the task pool / mailbox threads set it).
+                    n = attr.write(self._inner, text, self._stream,
+                                   ctx.current_task_id(),
+                                   ctx.current_actor_id(),
+                                   getattr(ctx.task_local, "label", None))
+                else:
+                    n = self._inner.write(text)
                 # The 32-thread task pool writes concurrently; _buf updates
                 # must be atomic or lines interleave/vanish.
                 with self._lock:
@@ -848,6 +869,10 @@ class WorkerRuntime:
                 span.__exit__(*_sys.exc_info())
             self.running_threads.pop(task_id, None)
             tls.task_id = None
+            if self._log_attributor is not None:
+                # Close out the task's pending byte range so its indexed
+                # output is complete once the result is observable.
+                self._log_attributor.flush()
 
     def _record_phases(self, spec: Dict[str, Any], outcome: str) -> None:
         """Finalize + buffer this task's phase event (flight recorder).
@@ -1069,6 +1094,11 @@ class WorkerRuntime:
 
     def serve_forever(self) -> None:
         self.shutdown_event.wait()
+        if self._log_attributor is not None:
+            try:
+                self._log_attributor.flush()
+            except Exception:
+                pass
         try:
             self.client.close()
         except Exception:

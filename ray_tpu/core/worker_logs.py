@@ -1,41 +1,382 @@
-"""Per-worker log files (reference: session_latest/logs/worker-*.out).
+"""Per-worker log files: attribution, rotation, and fetch helpers.
 
-Spawners (controller, host agent) redirect worker stdout/stderr here; the
-worker's own tee (worker.py) forwards lines to drivers, so inheriting the
-console would print everything twice on single-host setups. The file is
-the durable copy, the driver console gets the prefixed stream.
+Reference surfaces collapsed into one module (ray:
+session_latest/logs/worker-*.out + the log_monitor magic-line protocol +
+the dashboard/CLI log endpoints reading any file on any node):
+
+- Spawners (controller, host agent) redirect worker stdout/stderr here via
+  :func:`worker_log_file`; the worker's own tee (worker.py) forwards lines
+  to drivers, so inheriting the console would print everything twice on
+  single-host setups. The file is the durable copy.
+- A file past ``RTPU_WORKER_LOG_MAX`` rotates to a single ``.1`` backup on
+  (re)open — history survives rotation instead of being truncated away.
+- :class:`LogAttributor` stamps structured attribution markers (task id,
+  actor id, worker, node, label) into the stream whenever the execution
+  context changes, and maintains a JSONL sidecar index
+  (``worker-*.out.idx``) of task/actor -> byte-range so one task's output
+  is retrievable without scanning the file (the reference's magic-line
+  attribution, made O(ranges) on the read path).
+- :func:`serve_get_log` / :func:`serve_get_log_wait` implement the
+  ``get_log`` RPC body shared by the host agent and the controller's
+  local-node path: ranged reads, task/actor-filtered reads over the index,
+  and long-poll follow mode.
+
+Everything attribution-side is gated on ``RTPU_LOG_ATTRIBUTION``: when
+off, a worker's write path pays one flag check per write and no marker or
+index I/O happens.
 """
 from __future__ import annotations
 
+import json
 import os
 import tempfile
-from typing import IO, Optional
+import threading
+import time
+from typing import IO, Any, Dict, List, Optional, Tuple
 
 from ray_tpu import flags
+
+# A marker line opens each attribution segment in the log file itself, so
+# the file remains self-describing even if the sidecar index is lost.
+MARKER_PREFIX = "::rtpu-log::"
+
+# Pending in-memory index ranges flush at this size so a crashing worker
+# loses at most one bounded range (idx appends are line-buffered).
+_PENDING_FLUSH_BYTES = 64 * 1024
 
 
 def log_dir() -> str:
     return os.path.join(tempfile.gettempdir(), "rtpu_logs")
 
 
+def log_file_name(spawn_token: str) -> str:
+    return f"worker-{spawn_token[:12]}.out"
+
+
+def rotate_log(path: str) -> None:
+    """path -> path.1 (replacing any previous backup); the index sidecar
+    moves with it so byte ranges always refer to the file they index."""
+    os.replace(path, path + ".1")
+    try:
+        if os.path.exists(path + ".idx"):
+            os.replace(path + ".idx", path + ".1.idx")
+    except OSError:
+        pass
+
+
 def worker_log_file(spawn_token: str) -> Optional[IO[bytes]]:
     """Open the spawn's log file for redirect; None -> inherit the console.
 
     Restart-churned tokens reuse files; a file past RTPU_WORKER_LOG_MAX is
-    truncated on (re)open — the crude rotation that keeps a long-lived
-    autoscaling host from filling /tmp.
+    rotated to a ``.1`` backup on (re)open, keeping a long-lived
+    autoscaling host from filling /tmp without dropping the prior history
+    on the floor.
     """
     try:
         d = log_dir()
         os.makedirs(d, exist_ok=True)
-        path = os.path.join(d, f"worker-{spawn_token[:12]}.out")
+        path = os.path.join(d, log_file_name(spawn_token))
         cap = flags.get("RTPU_WORKER_LOG_MAX")
-        mode = "ab"
         try:
             if os.path.getsize(path) > cap:
-                mode = "wb"
+                rotate_log(path)
         except OSError:
             pass
-        return open(path, mode)
+        return open(path, "ab")
     except OSError:
         return None
+
+
+# ---------------------------------------------------------------- writer side
+
+
+class LogAttributor:
+    """Task/actor attribution for one worker process's log file.
+
+    One instance is shared by the stdout and stderr tees (both fds are
+    dup'ed onto the same O_APPEND file description, so a flush-then-tell on
+    either stream reads the true shared end-of-file offset). Under one
+    lock it stamps a marker line whenever the execution context changes,
+    writes the payload, and records (context, byte-range) entries into the
+    line-buffered JSONL sidecar index.
+    """
+
+    def __init__(self, spawn_token: str, worker_id: str, node_id: str):
+        self.path = os.path.join(log_dir(), log_file_name(spawn_token))
+        self.worker_id = worker_id
+        self.node_id = node_id
+        self.lock = threading.Lock()
+        self._last_key: Optional[Tuple] = None
+        # [task_id, actor_id, stream, start, end] awaiting an index write.
+        self._pending: Optional[list] = None
+        self._at_bol = True  # markers must start at a line boundary
+        self._idx = open(self.path + ".idx", "a", buffering=1)
+
+    @classmethod
+    def create(cls, worker_id: str, node_id: str) -> Optional["LogAttributor"]:
+        """None unless this process's stdout actually IS the spawn's log
+        file (the spawner's redirect): markers and byte offsets are only
+        meaningful there — a worker inheriting a real console must never
+        be stamped."""
+        import sys
+
+        token = flags.get("RTPU_SPAWN_TOKEN")
+        if not token:
+            return None
+        path = os.path.join(log_dir(), log_file_name(token))
+        try:
+            if os.fstat(sys.stdout.fileno()).st_ino != os.stat(path).st_ino:
+                return None
+            return cls(token, worker_id, node_id)
+        except (OSError, ValueError, AttributeError):
+            return None
+
+    def write(self, inner, text: str, stream: str, task_id: Optional[str],
+              actor_id: Optional[str], label: Optional[str]) -> int:
+        key = (task_id, actor_id)
+        with self.lock:
+            try:
+                if key != self._last_key:
+                    self._stamp(inner, key, stream, label)
+                attributed = task_id is not None or actor_id is not None
+                start = self._tell(inner) if attributed else None
+                n = inner.write(text)
+                if text:
+                    self._at_bol = text.endswith("\n")
+                if start is not None:
+                    end = self._tell(inner)
+                    if end is not None and end > start:
+                        self._record(task_id, actor_id, stream, start, end)
+                return n
+            except Exception:
+                # Attribution must never take the write path down; fall
+                # back to the plain write if bookkeeping failed mid-way.
+                try:
+                    return inner.write(text)
+                except Exception:
+                    return 0
+
+    def _stamp(self, inner, key: Tuple, stream: str,
+               label: Optional[str]) -> None:
+        self._flush_pending()
+        marker = MARKER_PREFIX + json.dumps(
+            {"task_id": key[0], "actor_id": key[1],
+             "worker_id": self.worker_id, "node_id": self.node_id,
+             "label": label, "stream": stream,
+             "ts": round(time.time(), 3)},
+            separators=(",", ":")) + "\n"
+        if not self._at_bol:
+            marker = "\n" + marker
+        inner.write(marker)
+        self._at_bol = True
+        self._last_key = key
+
+    @staticmethod
+    def _tell(inner) -> Optional[int]:
+        """True byte offset of the shared log fd: flush Python's buffer,
+        then ask the binary layer (self-correcting against any out-of-band
+        fd writes by C extensions)."""
+        try:
+            inner.flush()
+            return inner.buffer.tell()
+        except (OSError, ValueError, AttributeError):
+            return None
+
+    def _record(self, task_id, actor_id, stream, start: int,
+                end: int) -> None:
+        p = self._pending
+        if (p is not None and (p[0], p[1], p[2]) == (task_id, actor_id,
+                                                     stream)
+                and p[4] == start):
+            p[4] = end  # contiguous same-context write: extend in place
+        else:
+            self._flush_pending()
+            self._pending = [task_id, actor_id, stream, start, end]
+        if self._pending[4] - self._pending[3] >= _PENDING_FLUSH_BYTES:
+            self._flush_pending()
+
+    def _flush_pending(self) -> None:
+        p, self._pending = self._pending, None
+        if p is None:
+            return
+        try:
+            self._idx.write(json.dumps(
+                {"t": p[0], "a": p[1], "st": p[2], "s": p[3], "e": p[4]},
+                separators=(",", ":")) + "\n")
+        except Exception:
+            pass
+
+    def flush(self) -> None:
+        """Flush the pending index range (task-completion hook: a task's
+        last lines must be indexed by the time its result is observable
+        modulo one scheduling beat)."""
+        with self.lock:
+            self._flush_pending()
+
+
+# ---------------------------------------------------------------- reader side
+
+
+def strip_marker_lines(text: str) -> str:
+    if MARKER_PREFIX not in text:
+        return text
+    return "\n".join(line for line in text.split("\n")
+                     if not line.startswith(MARKER_PREFIX))
+
+
+def read_tail(path: str, nbytes: int = 65536) -> str:
+    """Last ``nbytes`` of a log file, attribution markers stripped."""
+    size = os.path.getsize(path)
+    with open(path, "rb") as f:
+        f.seek(max(0, size - nbytes))
+        text = f.read(nbytes).decode("utf-8", "replace")
+    return strip_marker_lines(text)
+
+
+def list_log_files() -> List[Dict[str, Any]]:
+    """[{name, size, mtime}] for every worker log (backups included,
+    sidecar indexes excluded) in this host's log dir."""
+    out: List[Dict[str, Any]] = []
+    d = log_dir()
+    try:
+        names = sorted(os.listdir(d))
+    except OSError:
+        return out
+    for name in names:
+        if not name.startswith("worker-") or name.endswith(".idx"):
+            continue
+        try:
+            st = os.stat(os.path.join(d, name))
+        except OSError:
+            continue
+        out.append({"name": name, "size": st.st_size, "mtime": st.st_mtime})
+    return out
+
+
+def log_volume_bytes() -> int:
+    """Total bytes under the log dir (files + sidecars): the per-node
+    log-volume gauge shipped in agent heartbeats."""
+    total = 0
+    try:
+        with os.scandir(log_dir()) as it:
+            for e in it:
+                try:
+                    if e.is_file():
+                        total += e.stat().st_size
+                except OSError:
+                    pass
+    except OSError:
+        return 0
+    return total
+
+
+def task_ranges(path: str, task_id: Optional[str] = None,
+                actor_id: Optional[str] = None) -> List[List[int]]:
+    """Merged [start, end) byte ranges of one task's (or actor's) output,
+    from the sidecar index — no log-file scan."""
+    ranges: List[List[int]] = []
+    try:
+        with open(path + ".idx", "r", encoding="utf-8") as f:
+            for line in f:
+                try:
+                    r = json.loads(line)
+                except ValueError:
+                    continue
+                if task_id is not None and r.get("t") != task_id:
+                    continue
+                if actor_id is not None and r.get("a") != actor_id:
+                    continue
+                s, e = int(r["s"]), int(r["e"])
+                if ranges and s <= ranges[-1][1]:
+                    ranges[-1][1] = max(ranges[-1][1], e)
+                else:
+                    ranges.append([s, e])
+    except OSError:
+        pass
+    return ranges
+
+
+def read_task_output(path: str, task_id: Optional[str] = None,
+                     actor_id: Optional[str] = None, offset: int = 0,
+                     max_bytes: int = 65536) -> Tuple[str, int, int]:
+    """(data, new_offset, total_bytes) of one task's attributed output.
+
+    ``offset`` indexes into the task's concatenated output (not the file),
+    so followers can stream a single task's lines incrementally; negative
+    offsets count back from the current end.
+    """
+    ranges = task_ranges(path, task_id, actor_id)
+    total = sum(e - s for s, e in ranges)
+    if offset < 0:
+        offset = max(0, total + offset)
+    out: List[bytes] = []
+    skip, budget = offset, max_bytes
+    try:
+        with open(path, "rb") as f:
+            for s, e in ranges:
+                if budget <= 0:
+                    break
+                n = e - s
+                if skip >= n:
+                    skip -= n
+                    continue
+                s += skip
+                skip = 0
+                take = min(e - s, budget)
+                f.seek(s)
+                out.append(f.read(take))
+                budget -= take
+    except OSError:
+        return "", offset, total
+    raw = b"".join(out)
+    return raw.decode("utf-8", "replace"), offset + len(raw), total
+
+
+def serve_get_log(msg: Dict[str, Any]) -> Dict[str, Any]:
+    """``get_log`` RPC body (host agent + controller local path): a ranged
+    read of one log file, or an index-backed read of one task's/actor's
+    output when ``task_id``/``actor_id`` is set. Returns {data, offset,
+    size, eof} — ``offset`` is the resume cursor for follow mode."""
+    name = os.path.basename(msg.get("name") or "")
+    path = os.path.join(log_dir(), name)
+    offset = int(msg.get("offset") or 0)
+    max_bytes = min(int(msg.get("max_bytes") or 65536), 1 << 20)
+    task_id, actor_id = msg.get("task_id"), msg.get("actor_id")
+    try:
+        if task_id or actor_id:
+            data, new_off, total = read_task_output(
+                path, task_id, actor_id, offset, max_bytes)
+            return {"data": data, "offset": new_off, "size": total,
+                    "eof": new_off >= total}
+        size = os.path.getsize(path)
+        if offset < 0:
+            offset = max(0, size + offset)
+        offset = min(offset, size)
+        with open(path, "rb") as f:
+            f.seek(offset)
+            raw = f.read(max_bytes)
+        text = raw.decode("utf-8", "replace")
+        if msg.get("strip_markers", True):
+            text = strip_marker_lines(text)
+        return {"data": text, "offset": offset + len(raw), "size": size,
+                "eof": offset + len(raw) >= size}
+    except OSError as e:
+        return {"error": str(e), "data": "", "offset": offset, "size": 0,
+                "eof": True}
+
+
+async def serve_get_log_wait(msg: Dict[str, Any]) -> Dict[str, Any]:
+    """Long-poll wrapper: with ``wait_s`` set, hold the reply until new
+    bytes appear past ``offset`` (or the window closes). Follow mode is a
+    chain of these — each one an independent request on the caller's
+    reconnecting client, so streams pause across a controller bounce and
+    resume on re-register instead of dying."""
+    import asyncio
+
+    deadline = time.monotonic() + min(float(msg.get("wait_s") or 0), 10.0)
+    while True:
+        out = serve_get_log(msg)
+        if out.get("data") or out.get("error") \
+                or time.monotonic() >= deadline:
+            return out
+        await asyncio.sleep(0.15)

@@ -24,6 +24,11 @@ Endpoints:
     GET /api/serve           serve application status (if running)
     GET /api/timeline        chrome-trace events (open in chrome://tracing)
     GET /api/usage           local host cpu/mem (reporter_agent.py role)
+    GET /api/logs            cluster log index (?all=1), one host's
+                             list/tail (?node, ?name), or ranged /
+                             task-attributed chunks (?task_id, ?actor_id,
+                             ?worker_id, ?offset)
+    GET /logs                log viewer page (live tail via /api/logs)
     GET /healthz             200 ok (dashboard/modules/healthz)
     GET /metrics             proxied controller Prometheus text
 """
@@ -67,29 +72,43 @@ _PAGE = """<!doctype html>
 </style></head><body>
 <h1>ray_tpu dashboard</h1>
 <p>{cluster}</p>
+<p><a href="/logs">log viewer</a> · <a href="/timeline">timeline</a></p>
 <h2>Nodes</h2>{nodes}
 <h2>Actors</h2>{actors}
 <h2>Task summary</h2>{tasks}
+<h2>Recent tasks</h2>{recent}
 <h2>Jobs</h2>{jobs}
 <p style="margin-top:2rem;color:#888">JSON under <code>/api/*</code>;
 Prometheus at <code>/metrics</code>; timeline at
-<code>/api/timeline</code>.</p>
+<code>/api/timeline</code>; logs at <code>/api/logs</code>.</p>
 </body></html>"""
 
 
-def _table(rows, cols) -> str:
+def _table(rows, cols, raw=()) -> str:
     if not rows:
         return "<p><i>none</i></p>"
     # Every cell is user-controlled data (actor names, job entrypoints,
     # labels) — escape or a crafted name is stored XSS in the viewer.
+    # Columns in `raw` carry server-rendered HTML (log-viewer links built
+    # from escaped values) and are trusted as-is.
     head = "".join(f"<th>{html.escape(str(c))}</th>" for c in cols)
     body = "".join(
         "<tr>"
-        + "".join(f"<td>{html.escape(str(r.get(c, '')))}</td>" for c in cols)
+        + "".join(
+            f"<td>{r.get(c, '') if c in raw else html.escape(str(r.get(c, '')))}</td>"
+            for c in cols)
         + "</tr>"
         for r in rows[:200]
     )
     return f"<table><tr>{head}</tr>{body}</table>"
+
+
+def _log_link(param: str, value) -> str:
+    from urllib.parse import quote
+
+    if not value:
+        return ""
+    return (f'<a href="/logs?{param}={quote(str(value))}">logs</a>')
 
 
 _TIMELINE_PAGE = """<!doctype html>
@@ -205,6 +224,66 @@ setInterval(() => { draw(); drawBreakdown(); }, 5000);
 """
 
 
+_LOGS_PAGE = """<!doctype html>
+<html><head><title>ray_tpu logs</title>
+<style>
+ body { font-family: system-ui, sans-serif; margin: 1.2rem; color: #1a1a2e; }
+ h1 { font-size: 1.2rem; } h3 { font-size: 1rem; margin-bottom: .2rem; }
+ pre { background: #f7f7fa; padding: .8rem; font-size: 12px;
+       white-space: pre-wrap; word-break: break-all; }
+ a { color: #2a6fbb; } #meta { color: #888; font-size: .85rem; }
+</style></head><body>
+<h1>Logs <small style="color:#888">(<a href="/">overview</a>)</small></h1>
+<div id="meta"></div><div id="list"></div><pre id="out"></pre>
+<script>
+const q = new URLSearchParams(location.search);
+const out = document.getElementById("out");
+const esc = s => String(s).replace(/[&<>"']/g,
+    c => "&#" + c.charCodeAt(0) + ";");
+async function list() {
+  const r = await fetch("/api/logs?all=1"); const data = await r.json();
+  let h = "";
+  for (const [nid, files] of Object.entries(data || {})) {
+    h += `<h3>node ${esc(nid)}</h3><ul>`;
+    for (const f of files) {
+      const href = `/logs?node=${encodeURIComponent(nid)}` +
+                   `&name=${encodeURIComponent(f.name)}`;
+      h += `<li><a href="${href}">${esc(f.name)}</a>` +
+           ` (${f.size} bytes)</li>`;
+    }
+    h += "</ul>";
+  }
+  document.getElementById("list").innerHTML = h || "<i>no log files</i>";
+}
+let offset = null;
+async function poll() {
+  const p = new URLSearchParams();
+  for (const k of ["name", "task_id", "actor_id", "worker_id"])
+    if (q.get(k)) p.set(k, q.get(k));
+  if (q.get("node")) p.set("node", q.get("node"));
+  p.set("offset", offset === null
+      ? (q.get("task_id") || q.get("actor_id") ? 0 : -65536) : offset);
+  try {
+    const r = await fetch("/api/logs?" + p); const d = await r.json();
+    if (d && typeof d === "object") {
+      if (d.error) document.getElementById("meta").textContent = d.error;
+      if (d.data) out.textContent += d.data;
+      if (d.offset !== undefined) offset = d.offset;
+    }
+  } catch (e) {}
+  setTimeout(poll, 1500);  // live tail: new bytes append on each poll
+}
+if (q.get("name") || q.get("task_id") || q.get("actor_id")
+    || q.get("worker_id")) {
+  document.getElementById("meta").textContent =
+      "following " + (q.get("name") || q.get("task_id")
+                      || q.get("actor_id") || q.get("worker_id"));
+  poll();
+} else list();
+</script></body></html>
+"""
+
+
 class Dashboard:
     """aiohttp server bound to a running ray_tpu session."""
 
@@ -234,18 +313,28 @@ class Dashboard:
             cluster = f"cluster unavailable: {html.escape(repr(e))}"
         nodes = _table(self._safe(state_api.list_nodes),
                        ["node_id", "alive", "resources", "labels"])
-        actors = _table(self._safe(state_api.list_actors),
-                        ["actor_id", "class_name", "state", "node_id", "name"])
+        actor_rows = self._safe(state_api.list_actors) or []
+        for r in actor_rows:
+            r["logs"] = _log_link("actor_id", r.get("actor_id"))
+        actors = _table(actor_rows,
+                        ["actor_id", "class_name", "state", "node_id",
+                         "name", "logs"], raw={"logs"})
         summary = self._safe(state_api.summarize_tasks) or {}
         tasks = _table(
             [{"func": k, **v} for k, v in summary.items()],
             ["func", "running", "finished", "failed", "pending"],
         )
+        recent_rows = (self._safe(state_api.list_tasks) or [])[-20:]
+        for r in recent_rows:
+            r["logs"] = _log_link("task_id", r.get("task_id"))
+        recent = _table(recent_rows,
+                        ["task_id", "name", "state", "node_id", "logs"],
+                        raw={"logs"})
         jobs = _table(self._safe(self._jobs),
                       ["job_id", "status", "entrypoint"])
         return web.Response(
             text=_PAGE.format(cluster=cluster, nodes=nodes, actors=actors,
-                              tasks=tasks, jobs=jobs),
+                              tasks=tasks, recent=recent, jobs=jobs),
             content_type="text/html")
 
     @staticmethod
@@ -309,21 +398,45 @@ class Dashboard:
             elif kind == "usage":
                 data = _local_usage()
             elif kind == "logs":
-                # ?node=<node_id> scopes to an agent host; ?name=<file>
-                # tails that worker log (plain text in a JSON string).
-                from ray_tpu.core import context as _ctx
+                # ?all=1 -> cluster log index; ?task_id/?actor_id/
+                # ?worker_id or ?offset -> ranged/attributed chunk
+                # ({data, offset, size, eof} — the viewer's poll cursor);
+                # legacy: ?node + optional ?name lists/tails one host.
+                q = request.query
+                if q.get("all"):
+                    data = state_api.list_logs()
+                elif (q.get("task_id") or q.get("actor_id")
+                        or q.get("worker_id") or q.get("offset")):
+                    data = state_api.get_log(
+                        name=q.get("name"), node_id=q.get("node", ""),
+                        task_id=q.get("task_id"),
+                        actor_id=q.get("actor_id"),
+                        worker_id=q.get("worker_id"),
+                        offset=int(q.get("offset", 0)),
+                        max_bytes=int(q.get("bytes", 65536)))
+                else:
+                    from ray_tpu.core import context as _ctx
 
-                data = _ctx.get_worker_context().client.request({
-                    "kind": "worker_logs",
-                    "node_id": request.query.get("node", ""),
-                    "name": request.query.get("name"),
-                    "bytes": int(request.query.get("bytes", 65536)),
-                })
+                    data = _ctx.get_worker_context().client.request({
+                        "kind": "worker_logs",
+                        "node_id": q.get("node", ""),
+                        "name": q.get("name"),
+                        "bytes": int(q.get("bytes", 65536)),
+                    })
             else:
                 return web.Response(status=404, text=f"unknown: {kind}")
         except Exception as e:
             return web.json_response({"error": repr(e)}, status=500)
         return web.json_response(data, dumps=lambda o: json.dumps(o, default=str))
+
+    async def _logs_page(self, request):
+        """Log viewer (reference: the dashboard log viewer): lists the
+        cluster log index, or — given ?node&name / ?task_id / ?actor_id /
+        ?worker_id — live-tails that file / attributed output by polling
+        /api/logs with an offset cursor."""
+        from aiohttp import web
+
+        return web.Response(text=_LOGS_PAGE, content_type="text/html")
 
     async def _timeline_page(self, request):
         """Per-worker swimlane view of the task-event buffer, rendered
@@ -370,6 +483,7 @@ class Dashboard:
 
         app = web.Application()
         app.router.add_get("/", self._index)
+        app.router.add_get("/logs", self._logs_page)
         app.router.add_get("/timeline", self._timeline_page)
         app.router.add_get("/api/{kind}", self._api)
         app.router.add_get("/healthz", self._healthz)

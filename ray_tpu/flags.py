@@ -226,7 +226,19 @@ _define("RTPU_SPANS_MAX", int, 20000,
 _define("RTPU_LOG_TO_DRIVER", bool, True,
         "Tee worker stdout/stderr to connected drivers' consoles.")
 _define("RTPU_WORKER_LOG_MAX", int, 16 * 1024 * 1024,
-        "Truncate a worker's log file when it exceeds this on (re)open.")
+        "Rotate a worker's log file to a .1 backup when it exceeds this "
+        "on (re)open (the sidecar attribution index rotates with it).")
+_define("RTPU_LOG_ATTRIBUTION", bool, True,
+        "Stamp worker log files with task/actor attribution markers and "
+        "maintain a per-file task->byte-range index so `rtpu logs "
+        "--task-id` fetches one task's output without scanning "
+        "(reference: the log_monitor magic-line protocol). 0 disables; "
+        "the write path then pays one flag check per write.")
+_define("RTPU_EXIT_DETAIL_BYTES", int, 2048,
+        "On worker death, quote up to this many bytes of the crashed "
+        "process's log tail in the task/actor error surfaced to the "
+        "driver (reference: RayTaskError exit_detail); 0 disables the "
+        "post-mortem fetch.")
 
 # -- bench -------------------------------------------------------------------
 _define("RTPU_BENCH_TPU_TIMEOUT", int, 1500,

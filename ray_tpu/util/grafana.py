@@ -87,7 +87,12 @@ def generate_dashboard(prom_text: str,
                 ]
             ptitle = f"{name} (quantiles)"
         else:  # gauge / untyped
-            exprs = [(name, "{{instance}}")]
+            # Per-node gauges (log volume, arena usage) legend by node so
+            # one panel fans out across the cluster.
+            legend = "{{node}}" if name in (
+                "rtpu_worker_log_bytes", "rtpu_node_arena_used_bytes",
+            ) else "{{instance}}"
+            exprs = [(name, legend)]
             ptitle = name
         panels.append(_panel(pid, ptitle, exprs, x, y, description=doc))
         pid += 1

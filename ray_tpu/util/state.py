@@ -81,6 +81,93 @@ def metrics_address() -> Optional[str]:
     return f"{host}:{port}"
 
 
+# ------------------------------------------------------- cluster log fetching
+# Reference: `ray logs` (python/ray/scripts) + the dashboard log API — any
+# worker log on any node is listable, fetchable, and followable through
+# the head, with task/actor attribution selecting one task's output.
+
+_LOG_CHUNK = 65536
+
+
+def list_logs() -> Dict[str, List[Dict[str, Any]]]:
+    """Cluster log index: node_id -> [{name, size, mtime}]."""
+    return _req({"kind": "list_logs"})
+
+
+def resolve_log(task_id: Optional[str] = None, actor_id: Optional[str] = None,
+                worker_id: Optional[str] = None) -> Dict[str, Any]:
+    """Which node/file holds this id's output: {found, node_id, name}."""
+    return _req({"kind": "resolve_log", "task_id": task_id,
+                 "actor_id": actor_id, "worker_id": worker_id})
+
+
+def get_log(name: Optional[str] = None, node_id: Optional[str] = None,
+            task_id: Optional[str] = None, actor_id: Optional[str] = None,
+            worker_id: Optional[str] = None, offset: int = 0,
+            max_bytes: int = _LOG_CHUNK,
+            wait_s: float = 0.0) -> Dict[str, Any]:
+    """One chunk of a worker log: {data, offset, size, eof} (offset is the
+    resume cursor). With task_id/actor_id, only that id's attributed
+    output is returned (index-backed — no file scan); negative offsets
+    count back from the end."""
+    return _req({"kind": "get_log", "name": name, "node_id": node_id or "",
+                 "task_id": task_id, "actor_id": actor_id,
+                 "worker_id": worker_id, "offset": offset,
+                 "max_bytes": max_bytes, "wait_s": wait_s})
+
+
+def get_log_text(name: Optional[str] = None, node_id: Optional[str] = None,
+                 task_id: Optional[str] = None,
+                 actor_id: Optional[str] = None,
+                 worker_id: Optional[str] = None, tail_lines: int = 0,
+                 max_bytes: int = 1 << 20) -> str:
+    """Convenience fetch (the `rtpu logs` one-shot body): the id's full
+    attributed output, or the file's last ``max_bytes`` — optionally cut
+    to the final ``tail_lines`` lines."""
+    filtered = bool(task_id or actor_id)
+    r = get_log(name=name, node_id=node_id, task_id=task_id,
+                actor_id=actor_id, worker_id=worker_id,
+                offset=0 if filtered else -max_bytes, max_bytes=max_bytes)
+    if r.get("error"):
+        raise RuntimeError(f"log fetch failed: {r['error']}")
+    text = r.get("data", "")
+    if tail_lines and tail_lines > 0:
+        text = "\n".join(text.splitlines()[-tail_lines:])
+        if text:
+            text += "\n"
+    return text
+
+
+def follow_log(name: Optional[str] = None, node_id: Optional[str] = None,
+               task_id: Optional[str] = None, actor_id: Optional[str] = None,
+               worker_id: Optional[str] = None, wait_s: float = 2.0,
+               from_start: Optional[bool] = None):
+    """Generator of new log chunks (the `rtpu logs --follow` backend).
+
+    Each poll is an independent long-poll request on the session's
+    reconnecting client, and ids re-resolve server-side per call — so a
+    controller bounce pauses the stream and it resumes once the client
+    re-registers and workers re-report their log files.
+    """
+    import time as _time
+
+    filtered = bool(task_id or actor_id)
+    if from_start is None:
+        from_start = filtered
+    offset = 0 if from_start else -2048
+    while True:
+        r = get_log(name=name, node_id=node_id, task_id=task_id,
+                    actor_id=actor_id, worker_id=worker_id, offset=offset,
+                    max_bytes=_LOG_CHUNK, wait_s=wait_s)
+        if r.get("error"):
+            # File not written yet / agent flapping: keep polling.
+            _time.sleep(min(wait_s, 2.0) or 0.5)
+            continue
+        offset = r.get("offset", offset)
+        if r.get("data"):
+            yield r["data"]
+
+
 def _phase_subslices(pev: Dict[str, Any], pid: str, tid: str,
                      task_id: str) -> List[Dict[str, Any]]:
     """Flight-recorder phases -> nested sub-slices on the task's row:

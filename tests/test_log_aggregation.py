@@ -1,0 +1,354 @@
+"""Cluster-wide log aggregation: attribution, fetch/tail, post-mortems.
+
+Reference surfaces matched: per-worker log files with task/actor
+attribution via magic line markers (the log_monitor protocol), the
+`ray logs` CLI + dashboard log API fetching/following any file on any
+node through the head, and worker-death errors quoting the crashed
+process's stderr tail (RayTaskError exit_detail / ActorDiedError
+death-cause detail).
+"""
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.core import worker_logs
+from ray_tpu.util import state
+from ray_tpu.util.scheduling_strategies import NodeAffinitySchedulingStrategy
+
+PKG_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ------------------------------------------------------------ rotation (unit)
+
+
+def test_rotation_keeps_backup(monkeypatch, tmp_path):
+    """A file past RTPU_WORKER_LOG_MAX rotates to a .1 backup on reopen —
+    the prior history survives instead of being truncated away — and the
+    attribution index sidecar moves with it."""
+    monkeypatch.setattr(worker_logs, "log_dir", lambda: str(tmp_path))
+    monkeypatch.setenv("RTPU_WORKER_LOG_MAX", "128")
+    token = "rotatetesttok99"
+    path = os.path.join(str(tmp_path), worker_logs.log_file_name(token))
+    with open(path, "wb") as f:
+        f.write(b"x" * 200)
+    with open(path + ".idx", "w") as f:
+        f.write('{"t":"tid","a":null,"st":"stdout","s":0,"e":10}\n')
+
+    f = worker_logs.worker_log_file(token)
+    assert f is not None
+    f.write(b"fresh")
+    f.close()
+    with open(path + ".1", "rb") as bk:
+        assert bk.read() == b"x" * 200
+    assert os.path.exists(path + ".1.idx")
+    with open(path, "rb") as cur:
+        assert cur.read() == b"fresh"
+
+    # Under the cap: plain append, no rotation (the backup is untouched).
+    f = worker_logs.worker_log_file(token)
+    f.write(b"+more")
+    f.close()
+    with open(path, "rb") as cur:
+        assert cur.read() == b"fresh+more"
+    with open(path + ".1", "rb") as bk:
+        assert bk.read() == b"x" * 200
+
+
+# ------------------------------------------------------- attribution (unit)
+
+
+def test_attributor_records_ranges_and_markers(monkeypatch, tmp_path):
+    """LogAttributor stamps a marker on context switches and indexes each
+    context's byte ranges so read_task_output returns exactly one task's
+    bytes without scanning the file."""
+    monkeypatch.setattr(worker_logs, "log_dir", lambda: str(tmp_path))
+    token = "attrunittok77"
+    path = os.path.join(str(tmp_path), worker_logs.log_file_name(token))
+    inner = open(path, "a", encoding="utf-8")
+    attr = worker_logs.LogAttributor(token, "w1", "n1")
+    try:
+        attr.write(inner, "a1\n", "stdout", "tA", None, "f")
+        attr.write(inner, "b1\n", "stdout", "tB", None, "g")
+        attr.write(inner, "a2\n", "stderr", "tA", None, "f")
+        attr.write(inner, "framework noise\n", "stderr", None, None, None)
+        attr.flush()
+        inner.flush()
+    finally:
+        inner.close()
+
+    data, off, total = worker_logs.read_task_output(path, task_id="tA")
+    assert data == "a1\na2\n"
+    assert total == 6 and off == 6
+    data, _, _ = worker_logs.read_task_output(path, task_id="tB")
+    assert data == "b1\n"
+    # Incremental (follow-mode) reads resume from the returned offset.
+    d1, o1, _ = worker_logs.read_task_output(path, task_id="tA",
+                                             offset=0, max_bytes=3)
+    d2, o2, _ = worker_logs.read_task_output(path, task_id="tA", offset=o1)
+    assert d1 + d2 == "a1\na2\n" and o2 == 6
+
+    raw = open(path, encoding="utf-8").read()
+    assert worker_logs.MARKER_PREFIX in raw
+    # Marker lines never leak into tails shown to humans.
+    assert worker_logs.MARKER_PREFIX not in worker_logs.read_tail(path)
+    # The noise line is attributed to nobody.
+    assert "noise" not in data
+
+
+# ------------------------------------------------- remote-node fetch (accept)
+
+
+@pytest.fixture()
+def agent_cluster():
+    from ray_tpu.core.cluster_utils import Cluster
+
+    cluster = Cluster(head_resources={"CPU": 1})
+    nid = cluster.add_node({"CPU": 2}, remote=True, host_id="log-host-b")
+    yield cluster, nid
+    cluster.shutdown()
+
+
+def _on_node(nid):
+    return NodeAffinitySchedulingStrategy(node_id=nid, soft=False)
+
+
+def test_task_log_fetch_from_remote_node(agent_cluster):
+    """THE acceptance path: a task runs on a worker of another node; `rtpu
+    logs --task-id` (state.get_log backend) returns exactly that task's
+    stdout/stderr lines, fetched through the controller from the owning
+    host agent — another task's output on the same host is excluded."""
+    cluster, nid = agent_cluster
+
+    @ray_tpu.remote(scheduling_strategy=_on_node(nid))
+    def chatty(tag):
+        print(f"out-{tag}-1")
+        print(f"out-{tag}-2")
+        sys.stderr.write(f"err-{tag}\n")
+        sys.stderr.flush()
+        return ray_tpu.get_runtime_context().task_id
+
+    tid_a = ray_tpu.get(chatty.remote("aaa"), timeout=60)
+    tid_b = ray_tpu.get(chatty.remote("bbb"), timeout=60)
+    assert tid_a and tid_b
+
+    deadline = time.monotonic() + 30
+    text = ""
+    while time.monotonic() < deadline:
+        r = state.get_log(task_id=tid_a)
+        text = r.get("data", "")
+        if "err-aaa" in text:
+            break
+        time.sleep(0.3)
+    lines = [ln for ln in text.splitlines() if ln]
+    assert lines == ["out-aaa-1", "out-aaa-2", "err-aaa"], text
+    assert "bbb" not in text
+
+    # The cluster log index attributes the file to the remote node.
+    res = state.resolve_log(task_id=tid_a)
+    assert res["found"] and res["node_id"] == nid
+    listing = state.list_logs()
+    assert res["name"] in {f["name"] for f in listing[nid]}
+
+    # And the actual `rtpu logs --task-id` CLI, as a fresh driver process.
+    from ray_tpu.core import context as ctx
+
+    addr = ctx.get_worker_context().extra.get("address")
+    env = dict(os.environ)
+    env["PYTHONPATH"] = PKG_ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    out = subprocess.run(
+        [sys.executable, "-m", "ray_tpu.cli", "logs",
+         "--task-id", tid_a, "--address", addr],
+        capture_output=True, text=True, timeout=120, env=env)
+    assert out.returncode == 0, out.stderr[-2000:]
+    cli_lines = [ln for ln in out.stdout.splitlines() if ln]
+    assert cli_lines == ["out-aaa-1", "out-aaa-2", "err-aaa"], out.stdout
+
+
+def test_follow_streams_live(agent_cluster):
+    """--follow semantics: a follower started against a live actor's
+    attributed output sees lines produced AFTER it started, streamed from
+    the remote host through long-poll get_log chunks."""
+    cluster, nid = agent_cluster
+
+    @ray_tpu.remote(scheduling_strategy=_on_node(nid))
+    class Talker:
+        def say(self, i):
+            print(f"follow-line-{i}", flush=True)
+            from ray_tpu.core import context as c
+
+            return c.current_actor_id()
+
+    t = Talker.remote()
+    aid = ray_tpu.get(t.say.remote(0), timeout=60)
+    got = []
+
+    def run():
+        try:
+            for chunk in state.follow_log(actor_id=aid, wait_s=1.0):
+                got.append(chunk)
+        except Exception:
+            pass  # session shutdown tears the stream down
+
+    th = threading.Thread(target=run, daemon=True)
+    th.start()
+    for i in range(1, 4):
+        ray_tpu.get(t.say.remote(i), timeout=60)
+        time.sleep(0.2)
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        if all(f"follow-line-{i}" in "".join(got) for i in range(4)):
+            break
+        time.sleep(0.3)
+    text = "".join(got)
+    assert all(f"follow-line-{i}" in text for i in range(4)), text
+
+
+# --------------------------------------------------------- crash post-mortems
+
+
+def test_task_crash_quotes_stderr_tail(monkeypatch):
+    """A SIGKILLed worker's task error quotes the process's stderr tail
+    (exit_detail): OOM-killed / segfaulted workers are attributable from
+    the driver without SSH."""
+    monkeypatch.setenv("RTPU_TASK_LEASE_MAX", "0")  # queue path
+    ray_tpu.init(num_cpus=2)
+    try:
+        @ray_tpu.remote
+        def die():
+            sys.stderr.write("FATAL: crash-detail-sentinel-123\n")
+            sys.stderr.flush()
+            os.kill(os.getpid(), signal.SIGKILL)
+
+        with pytest.raises(Exception) as ei:
+            ray_tpu.get(die.remote(), timeout=60)
+        assert "crash-detail-sentinel-123" in str(ei.value), ei.value
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_actor_crash_quotes_stderr_tail(monkeypatch):
+    """An actor whose process dies mid-call surfaces the death with the
+    crashed worker's last stderr lines in the error message."""
+    monkeypatch.setenv("RTPU_DIRECT_DISPATCH", "0")  # controller path
+    ray_tpu.init(num_cpus=2)
+    try:
+        @ray_tpu.remote
+        class Crasher:
+            def boom(self):
+                sys.stderr.write("ACTOR-DEATH-DETAIL-sentinel\n")
+                sys.stderr.flush()
+                os._exit(7)
+
+        a = Crasher.remote()
+        with pytest.raises(Exception) as ei:
+            ray_tpu.get(a.boom.remote(), timeout=60)
+        assert "ACTOR-DEATH-DETAIL-sentinel" in str(ei.value), ei.value
+    finally:
+        ray_tpu.shutdown()
+
+
+# ----------------------------------------------- controller-bounce resilience
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_log_fetch_and_follow_survive_controller_bounce(tmp_path):
+    """ControllerKiller-harness proof: a --follow stream started before a
+    controller SIGKILL+restart keeps delivering lines produced afterwards
+    (each poll rides the driver's reconnecting client, and workers
+    re-report their log files on re-register), and `rtpu logs --task-id`
+    resolves a post-bounce task against the rebuilt log index."""
+    import test_controller_reconnect as tcr
+
+    port = _free_port()
+    state_path = str(tmp_path / "state.pkl")
+    os.environ["RTPU_TASK_LEASE_MAX"] = "0"
+    head = tcr._start_head(port, state_path,
+                           log_path=str(tmp_path / "head1.log"))
+    killed = []
+    client = None
+    try:
+        ray_tpu.init(address=f"127.0.0.1:{port}")
+        from ray_tpu.core import context as ctx
+
+        client = ctx.get_worker_context().client
+
+        @ray_tpu.remote
+        class Chat:
+            def say(self, i):
+                print(f"bounce-line-{i}", flush=True)
+                from ray_tpu.core import context as c
+
+                return c.current_actor_id()
+
+        a = Chat.remote()
+        aid = ray_tpu.get(a.say.remote(0), timeout=60)
+        tcr._wait_snapshot(state_path, lambda s: s.get("nodes"))
+
+        got = []
+
+        def run():
+            try:
+                for chunk in state.follow_log(actor_id=aid, wait_s=1.0):
+                    got.append(chunk)
+            except Exception:
+                pass
+
+        th = threading.Thread(target=run, daemon=True)
+        th.start()
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline and "bounce-line-0" not in "".join(got):
+            time.sleep(0.3)
+        assert "bounce-line-0" in "".join(got), "follow never started"
+
+        killed.extend(tcr._worker_pids(client))
+        tcr._kill9(head)
+        time.sleep(0.5)
+        head = tcr._start_head(port, state_path,
+                               log_path=str(tmp_path / "head2.log"))
+
+        # Post-restart actor call produces a new line; the follower's next
+        # polls ride the reconnected client and must deliver it.
+        assert ray_tpu.get(a.say.remote(1), timeout=90) == aid
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline and "bounce-line-1" not in "".join(got):
+            time.sleep(0.3)
+        assert "bounce-line-1" in "".join(got), \
+            f"follow did not resume after the bounce: {''.join(got)!r}"
+
+        # A post-bounce task resolves by task id against the rebuilt index.
+        @ray_tpu.remote
+        def post():
+            print("post-bounce-task-line", flush=True)
+            return ray_tpu.get_runtime_context().task_id
+
+        tid = ray_tpu.get(post.remote(), timeout=90)
+        deadline = time.monotonic() + 30
+        text = ""
+        while time.monotonic() < deadline:
+            r = client.request({"kind": "get_log", "task_id": tid})
+            text = r.get("data", "")
+            if "post-bounce-task-line" in text:
+                break
+            time.sleep(0.3)
+        assert "post-bounce-task-line" in text, text
+    finally:
+        os.environ.pop("RTPU_TASK_LEASE_MAX", None)
+        if client is not None:
+            killed.extend(tcr._worker_pids(client))
+        tcr._cleanup(head, killed)

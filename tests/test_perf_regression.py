@@ -70,6 +70,27 @@ def test_task_events_disabled_path_overhead(ray_start_regular, monkeypatch):
         f"disabled-recorder task throughput {200/dt:.0f}/s below floor"
 
 
+def test_log_attribution_disabled_path_overhead(ray_start_regular,
+                                                monkeypatch):
+    """Log-aggregation guard (mirrors the RTPU_TASK_EVENTS guard): with
+    RTPU_LOG_ATTRIBUTION=0 a printing task's write path pays one flag
+    check per write — no marker stamping, no index I/O — so the printing
+    round-trip holds the same throughput floor as the plain benchmark."""
+    monkeypatch.setenv("RTPU_LOG_ATTRIBUTION", "0")
+
+    @ray_tpu.remote
+    def chatty(i):
+        print("chatty", i)
+        return None
+
+    ray_tpu.get([chatty.remote(i) for i in range(8)])  # warm the pool
+    t0 = time.perf_counter()
+    ray_tpu.get([chatty.remote(i) for i in range(200)])
+    dt = time.perf_counter() - t0
+    assert 200 / dt > 30, \
+        f"attribution-disabled throughput {200/dt:.0f}/s below floor"
+
+
 def test_large_object_bandwidth_floor(ray_start_regular):
     arr = np.ones(4 * 1024 * 1024, dtype=np.float64)  # 32MB
     t0 = time.perf_counter()
