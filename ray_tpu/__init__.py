@@ -43,6 +43,7 @@ from .core.api import (
 from .core.controller import (
     ActorDiedError,
     DependencyError,
+    NodePreemptedError,
     ObjectLostError,
     OutOfMemoryError,
     GetTimeoutError,
@@ -89,6 +90,7 @@ __all__ = [
     "GetTimeoutError",
     "WorkerCrashedError",
     "ActorDiedError",
+    "NodePreemptedError",
     "DependencyError",
     "__version__",
 ]

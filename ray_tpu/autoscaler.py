@@ -69,9 +69,38 @@ class LocalNodeProvider(NodeProvider):
         return tag
 
     def terminate_node(self, tag: str) -> None:
+        """Terminate -> kill escalation + reap (mirrors the controller's
+        _watch_spawn teardown): a host agent that ignores SIGTERM — or is
+        stuck mid-drain on a dead controller — must not outlive the
+        scale-down as a leaked subprocess or linger as a zombie."""
         proc = self._procs.pop(tag, None)
-        if proc is not None and proc.poll() is None:
+        if proc is None:
+            return
+        if proc.poll() is not None:
+            proc.wait()  # reap the zombie
+            return
+        try:
             proc.terminate()
+        except Exception:
+            pass
+
+        def _escalate(proc=proc):
+            try:
+                proc.wait(timeout=5)
+                return
+            except subprocess.TimeoutExpired:
+                pass
+            try:
+                proc.kill()
+            except Exception:
+                pass
+            try:
+                proc.wait(timeout=10)  # SIGKILL is definitive: reap it
+            except Exception:
+                pass
+
+        threading.Thread(target=_escalate, daemon=True,
+                         name="rtpu-node-reap").start()
 
     def non_terminated_nodes(self) -> List[str]:
         return [t for t, p in self._procs.items() if p.poll() is None]
@@ -90,6 +119,11 @@ class AutoscalerConfig:
     # Per-launched-node resources (what one provider node satisfies).
     worker_resources: Dict[str, float] = field(
         default_factory=lambda: {"CPU": 1.0})
+    # Grace window an idle-scale-down drain gives work that raced onto the
+    # node (None -> RTPU_DRAIN_DEADLINE_S); terminate_node is forced once
+    # drain_timeout_s passes without the node leaving on its own.
+    drain_deadline_s: Optional[float] = None
+    drain_timeout_s: float = 60.0
 
 
 class Autoscaler:
@@ -99,6 +133,9 @@ class Autoscaler:
         self.provider = provider
         self.config = config or AutoscalerConfig()
         self._idle_since: Dict[str, float] = {}  # label tag -> idle start
+        # tag -> drain start time: nodes we asked the controller to drain;
+        # terminate_node runs only once they leave (drain-before-terminate).
+        self._draining: Dict[str, float] = {}
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -146,8 +183,11 @@ class Autoscaler:
         if demands:
             # Demand not placeable on current availability, bin-packed
             # against what one new node offers.
+            # Draining nodes take no placements: their capacity must not
+            # mask a deficit (or the drained node's work never re-lands).
             free: List[Dict[str, float]] = [
-                dict(n["available"]) for n in state["nodes"] if n["alive"]]
+                dict(n["available"]) for n in state["nodes"]
+                if n["alive"] and n.get("state", "alive") == "alive"]
             unsat = []
             for d in demands:
                 placed = False
@@ -186,9 +226,16 @@ class Autoscaler:
             self.provider.create_node(dict(cfg.worker_resources))
 
         # Scale down: managed nodes idle past the timeout (respect min).
+        # Drain-before-terminate (reference: the autoscaler's DrainNode
+        # call ahead of node termination, autoscaler.proto:334): the
+        # controller stops scheduling there, migrates actors, and lets a
+        # task that raced onto the idle-marked node finish or re-queue —
+        # only once the node has actually left does the provider reap it.
         now = time.monotonic()
         removable = []
         for tag in managed:
+            if tag in self._draining:
+                continue
             node = live_tags.get(tag)
             if node is None:
                 continue  # still registering
@@ -198,15 +245,28 @@ class Autoscaler:
             since = self._idle_since.setdefault(tag, now)
             if now - since >= cfg.idle_timeout_s:
                 removable.append((tag, node["node_id"]))
-        can_remove = max(0, len(managed) - self.config.min_workers)
+        already = len(self._draining)
+        can_remove = max(0, len(managed) - already - cfg.min_workers)
         for tag, node_id in removable[:can_remove]:
             try:
                 ctx.get_worker_context().client.request(
-                    {"kind": "drop_node", "node_id": node_id})
+                    {"kind": "drain_node", "node_id": node_id,
+                     "reason": "idle_scale_down",
+                     "deadline_s": cfg.drain_deadline_s})
             except Exception:
-                pass
-            self.provider.terminate_node(tag)
+                continue  # retry the drain next pass
+            self._draining[tag] = now
             self._idle_since.pop(tag, None)
+        # Reap drained nodes: the controller's drain completion shuts the
+        # agent down, so the provider call is normally just a zombie reap;
+        # a drain stuck past drain_timeout_s is forced out.
+        for tag, t0 in list(self._draining.items()):
+            node = live_tags.get(tag)
+            departed = node is None or node.get("state") in ("drained",
+                                                            "dead")
+            if departed or now - t0 >= cfg.drain_timeout_s:
+                self.provider.terminate_node(tag)
+                self._draining.pop(tag, None)
 
 
 def request_resources(num_cpus: Optional[int] = None,

@@ -175,6 +175,41 @@ def cmd_summary(args) -> int:
     return 0
 
 
+def cmd_drain(args) -> int:
+    """`rtpu drain NODE` (reference: `ray drain-node`): graceful node
+    departure — stop scheduling, migrate actors with state, give running
+    tasks the deadline, re-replicate sole-copy objects, then release the
+    node. NODE may be a unique node-id prefix from `rtpu status`."""
+    rt = _connect(args)
+    from ray_tpu.util import state
+
+    try:
+        res = state.drain_node(args.node, reason=args.reason,
+                               deadline_s=args.deadline)
+        if not res.get("ok"):
+            print(f"drain failed: {res.get('error', 'unknown error')}")
+            return 1
+        print(f"node {res['node_id']} -> {res['state']} "
+              f"(reason={args.reason})")
+        if args.wait:
+            deadline = time.monotonic() + args.wait
+            from ray_tpu.core import context as ctx
+
+            while time.monotonic() < deadline:
+                nodes = ctx.get_worker_context().client.request(
+                    {"kind": "cluster_state"})["nodes"]
+                row = next((n for n in nodes
+                            if n["node_id"] == res["node_id"]), None)
+                if row is None or row.get("state") in ("drained", "dead"):
+                    print(f"node {res['node_id']} drained")
+                    return 0
+                time.sleep(0.3)
+            print("drain still in progress (deadline not reached)")
+        return 0
+    finally:
+        rt.shutdown()
+
+
 def cmd_memory(args) -> int:
     """Object-reference/memory table (reference: `ray memory` — the
     reference-table dump from _private/state.py)."""
@@ -470,6 +505,21 @@ def main(argv=None) -> int:
     p.add_argument("--tail", type=int, default=0,
                    help="only the last N lines")
     p.set_defaults(fn=cmd_logs)
+
+    p = sub.add_parser("drain", help="gracefully drain a node "
+                                     "(migrate actors, re-queue tasks, "
+                                     "then remove it)")
+    p.add_argument("node", help="node id (or unique prefix) to drain")
+    p.add_argument("--address", default=None)
+    p.add_argument("--reason", default="manual",
+                   choices=["manual", "preemption", "idle_scale_down"],
+                   help="drain reason (rtpu_node_drains_total label)")
+    p.add_argument("--deadline", type=float, default=None,
+                   help="grace seconds for running tasks "
+                        "(default RTPU_DRAIN_DEADLINE_S)")
+    p.add_argument("--wait", type=float, default=0.0, metavar="S",
+                   help="block up to S seconds until the node is drained")
+    p.set_defaults(fn=cmd_drain)
 
     p = sub.add_parser("memory", help="object reference/memory table")
     p.add_argument("--address", default=None)

@@ -912,6 +912,12 @@ def _direct_submit(wc, route: "_ActorRoute", spec: Dict[str, Any]) -> bool:
     conn = route.conn
     if conn is None:
         return False
+    if conn.closed.is_set():
+        # Stale route: the actor's old worker died (e.g. its node drained
+        # and the actor migrated). Nothing was sent — drop the route and
+        # let the caller take the controller path / re-resolve.
+        _invalidate_route(wc, route)
+        return False
     try:
         fut = conn.request_threadsafe(
             {"kind": "direct_actor_task", "spec": spec})
@@ -945,12 +951,24 @@ def _direct_submit(wc, route: "_ActorRoute", spec: Dict[str, Any]) -> bool:
 
 def _direct_failure(wc, route: "_ActorRoute", spec: Dict[str, Any],
                     exc: BaseException) -> None:
-    """The direct connection failed mid-call. Workers fate-share with the
-    controller, so this nearly always means the actor's worker died.
-    In-flight calls fail with ActorDiedError — the reference's default
-    actor-task semantics. NO automatic resubmission: the worker may have
-    already executed the call before the connection dropped, and silently
-    re-running a non-idempotent method would corrupt actor state.
+    """The direct call failed. Resubmit through the controller ONLY when
+    the call provably never executed:
+
+    - NeverSentError: the route's connection was already closed at submit —
+      the bytes never left this process.
+    - ActorNotHostedError: the worker REFUSED the call before any user code
+      ran (the actor migrated off a draining node, or died there).
+    - A dead connection where the controller says the actor has MOVED off
+      the route's worker (drain migration): migration snapshots the
+      instance after every queued call completes AND publishes those
+      results before the old worker exits, so a call with no published
+      results never ran. Results already published mean the call DID
+      complete — cache them instead of resubmitting.
+
+    Anything else fails with ActorDiedError — the reference's default
+    actor-task semantics: the worker may have executed the call before the
+    connection dropped, and silently re-running a non-idempotent method
+    would corrupt actor state.
 
     The error publication is if_absent: the worker's own fire-and-forget
     task_done may have carried real result locations before it died — a
@@ -958,10 +976,41 @@ def _direct_failure(wc, route: "_ActorRoute", spec: Dict[str, Any],
     """
     import pickle as _p
 
-    from .controller import ActorDiedError
+    from . import protocol
+    from .controller import ActorDiedError, ActorNotHostedError
     from .object_store import ObjectLocation
 
+    old_worker = route.worker_id
     _invalidate_route(wc, route)
+    resubmit = isinstance(exc, (protocol.NeverSentError, ActorNotHostedError))
+    if not resubmit and isinstance(exc, (ConnectionError, OSError, EOFError)):
+        try:
+            info = wc.client.request(
+                {"kind": "resolve_actor", "actor_id": spec["actor_id"]})
+        except Exception:
+            info = None
+        d = (info or {}).get("direct") or {}
+        moved = info is not None and (
+            info.get("state") in ("pending", "restarting")
+            or (info.get("state") == "alive"
+                and d.get("worker_id") not in (None, old_worker)))
+        if moved:
+            try:
+                locs = wc.client.request(
+                    {"kind": "get_locations",
+                     "object_ids": list(spec.get("return_ids", ())),
+                     "timeout": 0})
+                for loc in locs.values():
+                    _cache_loc(loc)
+                return  # the call completed before the worker left
+            except Exception:
+                resubmit = True  # no published results: it never ran
+    if resubmit:
+        try:
+            wc.client.request({"kind": "submit_actor_task", "spec": spec})
+            return
+        except Exception:
+            pass  # controller unreachable too: fail the call below
     err = ActorDiedError(
         f"actor {spec['actor_id'][:8]} died during a direct call "
         f"({type(exc).__name__}: {exc})")

@@ -134,6 +134,14 @@ class NodeInfo:
     # Total bytes of worker log files on the host (agent heartbeats;
     # exported as the rtpu_worker_log_bytes gauge).
     log_bytes: int = 0
+    # Drain state machine (reference: autoscaler.proto:334 DrainNode +
+    # node_manager.proto:391 DrainRaylet): alive -> draining -> drained.
+    # A draining node takes no new placements; at the deadline its running
+    # work re-queues with the preempted flag and the node leaves.
+    draining: bool = False
+    drained: bool = False
+    drain_reason: str = ""
+    drain_deadline: float = 0.0  # wall clock (survives a controller bounce)
 
 
 @dataclass
@@ -324,6 +332,13 @@ class Controller:
         # boundaries, data {tags_tuple: value|histogram-state}}.
         self.app_metrics: Dict[str, dict] = {}
         self._node_counter = 0
+        # Drain bookkeeping: per-reason completed-drain counters (the
+        # rtpu_node_drains_total{reason} metric) and the in-progress drain
+        # table (node_id -> {reason, deadline}) persisted across controller
+        # bounces so a drain survives a head restart.
+        self.drain_counts: Dict[str, int] = {}
+        self.pending_drains: Dict[str, Dict[str, Any]] = {}
+        self._drain_tasks: Dict[str, asyncio.Task] = {}
         self._spawned_procs: Dict[str, subprocess.Popen] = {}  # spawn_token -> proc
         self._chip_alloc: Dict[str, List[int]] = {}  # spawn_token -> TPU chip ids
         self._tpu_spawn_tokens: Set[str] = set()  # tokens of TPU-capable spawns
@@ -386,6 +401,29 @@ class Controller:
             loop.create_task(_resume_after_grace())
         if flags.get("RTPU_MEMORY_MONITOR"):
             self._memory_task = loop.create_task(self._memory_monitor_loop())
+        # Resume drains interrupted by a controller bounce: restored
+        # (non-agent) nodes become unschedulable immediately, but the
+        # drain task itself waits out the reconnect grace — the node's
+        # surviving workers haven't re-registered yet, and an instant
+        # quiesce check would see an empty node and cut the grace window
+        # short mid-task. Agent nodes re-arm on re-register.
+        resume: List[str] = []
+        for nid in list(self.pending_drains):
+            node = self.nodes.get(nid)
+            if node is not None:
+                st = self.pending_drains[nid]
+                node.draining = True
+                node.drain_reason = st.get("reason", "manual")
+                node.drain_deadline = float(st.get("deadline", 0.0))
+                resume.append(nid)
+        if resume:
+            async def _resume_drains():
+                await asyncio.sleep(flags.get("RTPU_RECONNECT_GRACE_S"))
+                for nid in resume:
+                    if nid in self.pending_drains and nid in self.nodes:
+                        self._arm_drain(self.nodes[nid])
+
+            loop.create_task(_resume_drains())
         # Prometheus scrape endpoint (GET /metrics) on an ephemeral port,
         # advertised via cluster_state.metrics_port.
         try:
@@ -542,6 +580,19 @@ class Controller:
         if not node.alive:
             return
         node.alive = False
+        if node.draining:
+            # The node left while (or because) it was draining — a
+            # preemption that fired before the grace window closed, or the
+            # drain's own shutdown. Either way the departure was planned:
+            # record it as drained so worker cleanup below re-queues work
+            # through the budget-free preempted paths.
+            node.draining = False
+            node.drained = True
+            self.pending_drains.pop(node.node_id, None)
+            task = self._drain_tasks.pop(node.node_id, None)
+            if task is not None and not task.done():
+                task.cancel()
+            self._state_dirty = True
         self._export_event("NODE", {"node_id": node.node_id,
                                     "event": "dead", "ts": time.time()})
         node.agent_conn = None
@@ -649,13 +700,24 @@ class Controller:
         for lid, lease in list(self._leases.items()):
             if lease["worker_id"] == w.worker_id:
                 self._release_lease(lid)
+        # Planned departure? A worker dying on a draining/drained node was
+        # preempted, not crashed: its work re-queues without consuming
+        # retry/restart budgets (reference: DrainNode graceful-departure
+        # semantics vs node failure).
+        preempted = node is not None and (node.draining or node.drained)
         # Fail — or retry — the running task (reference: task resubmission on
         # worker failure, core_worker/task_manager.h max_retries).
         if w.current_task and w.current_task in self.tasks:
             spec = self.tasks.pop(w.current_task)
             self._release_task_resources(spec)
-            if w.oom_killed:
-                err: Exception = OutOfMemoryError(
+            if preempted:
+                err: Exception = NodePreemptedError(
+                    f"worker {w.worker_id[:8]} left with draining node "
+                    f"{w.node_id[:8]} "
+                    f"({node.drain_reason or 'drain'}) while running task "
+                    f"{spec.get('label', '')}")
+            elif w.oom_killed:
+                err = OutOfMemoryError(
                     f"worker {w.worker_id[:8]} was killed by the memory "
                     f"monitor while running task {spec.get('label', '')} "
                     f"(host memory pressure){detail}")
@@ -663,7 +725,7 @@ class Controller:
                 err = WorkerCrashedError(
                     f"worker {w.worker_id[:8]} died while running task "
                     f"{spec.get('label', '')}{detail}")
-            if not self._maybe_retry_task(spec):
+            if not self._maybe_retry_task(spec, preempted=preempted):
                 self._finalize_generator(spec["task_id"], err)
                 for oid in spec["return_ids"]:
                     self._store_error(oid, err)
@@ -671,9 +733,15 @@ class Controller:
         for aid in list(w.actor_ids):
             actor = self.actors.get(aid)
             if actor and actor.state != "dead":
-                err = WorkerCrashedError(
-                    f"actor {aid[:8]} process died{detail}")
-                if not self._maybe_restart_actor(actor, err):
+                if preempted:
+                    err = NodePreemptedError(
+                        f"actor {aid[:8]} left with draining node "
+                        f"{w.node_id[:8]} ({node.drain_reason or 'drain'})")
+                else:
+                    err = WorkerCrashedError(
+                        f"actor {aid[:8]} process died{detail}")
+                if not self._maybe_restart_actor(actor, err,
+                                                 preempted=preempted):
                     self._mark_actor_dead(actor, err)
         self._wake_scheduler()
 
@@ -718,15 +786,19 @@ class Controller:
                     RuntimeEnvSetupError(f"runtime env setup failed: {err}"),
                 )
 
-    def _maybe_retry_task(self, spec: Dict[str, Any]) -> bool:
+    def _maybe_retry_task(self, spec: Dict[str, Any],
+                          preempted: bool = False) -> bool:
         """Resubmit a task killed by a system failure (worker/node death),
         up to max_retries times. Application errors never retry here — they
-        reach _h_task_done as error locations, not a dead connection."""
+        reach _h_task_done as error locations, not a dead connection.
+        ``preempted`` (planned node departure): the task ALWAYS re-queues
+        and the retry budget is untouched — the result was never observed,
+        so replaying it is safe and free."""
         if spec.get("is_actor_creation") or spec.get("actor_id"):
             return False
         retries = int(spec.get("max_retries", 0))
         used = int(spec.get("_retry_count", 0))
-        if used >= retries:
+        if not preempted and used >= retries:
             return False
         if spec.get("streaming") and spec["task_id"] in self.generators:
             gen = self.generators[spec["task_id"]]
@@ -734,7 +806,8 @@ class Controller:
                 # Items already observed by the consumer can't be replayed
                 # consistently; only an unstarted stream retries.
                 return False
-        spec["_retry_count"] = used + 1
+        if not preempted:
+            spec["_retry_count"] = used + 1
         spec["state"] = "pending"
         spec.pop("sched_node", None)
         spec.pop("blocked", None)
@@ -744,15 +817,30 @@ class Controller:
         self._wake_scheduler()
         return True
 
-    def _maybe_restart_actor(self, actor: ActorInfo, err: Exception) -> bool:
+    def _maybe_restart_actor(self, actor: ActorInfo, err: Exception,
+                             preempted: bool = False) -> bool:
         """Re-instantiate a crashed actor from its creation spec (reference:
         gcs_actor_manager RestartActor, max_restarts semantics). In-flight
         calls fail (at-most-once actor tasks); calls submitted while
-        restarting buffer and replay on actor_ready."""
+        restarting buffer and replay on actor_ready. ``preempted``
+        (planned node departure): detached/restartable actors re-create
+        without consuming restart budget."""
         spec = actor.creation_spec
-        if spec is None or actor.restart_count >= actor.max_restarts:
+        if spec is None:
             return False
-        actor.restart_count += 1
+        if preempted:
+            if not (actor.detached
+                    or actor.restart_count < actor.max_restarts):
+                return False
+        elif actor.restart_count >= actor.max_restarts:
+            return False
+        else:
+            # A crash restart re-runs the constructor: a state snapshot
+            # left by an earlier drain migration must not resurrect stale
+            # state past a real failure.
+            spec.pop("state_blob", None)
+        if not preempted:
+            actor.restart_count += 1
         actor.state = "restarting"
         self._export_event("ACTOR", {"actor_id": actor.actor_id,
                                      "event": "restarting",
@@ -870,6 +958,26 @@ class Controller:
                 # sets stay disjoint (no chip double-allocation).
                 taken = set(w.chip_ids)
                 node.tpu_free = [c for c in node.tpu_free if c not in taken]
+        if reconnect:
+            # Re-claim plain tasks still executing on the re-registering
+            # worker (reference: the GCS rebuilding lease state from raylet
+            # re-reports on failover). The driver resubmits in-flight specs
+            # on ITS reconnect — without this claim the controller would
+            # both schedule the duplicate AND consider the worker idle
+            # (breaking drain's quiesce wait); with it, the running
+            # instance finishes and its task_done retires the spec.
+            for tid in msg.get("running") or ():
+                spec = self.tasks.get(tid)
+                if spec is not None and spec.get("actor_id"):
+                    continue  # actor calls are claimed via msg["actors"]
+                if spec is not None and not spec.get("sched_node"):
+                    self.pending_queue.remove(tid)
+                    spec["state"] = "running"
+                    spec["sched_node"] = None  # resources never reserved
+                w.current_task = tid
+                if w.state == "idle":
+                    w.state = "task"
+                break
         drop = await self._adopt_worker_actors(w, node, msg)
         self._wake_scheduler()
         return {"ok": True, "drop_actors": drop}
@@ -1702,6 +1810,11 @@ class Controller:
             for call in calls:
                 await self._dispatch_actor_call(actor, call)
         actor.state = "alive"
+        # A drain-migration state snapshot is single-use: the instance
+        # mutates from here on, so a later (crash) re-creation must run the
+        # constructor, not resurrect this stale blob.
+        if actor.creation_spec is not None:
+            actor.creation_spec.pop("state_blob", None)
         self._export_event("ACTOR", {"actor_id": actor.actor_id,
                                      "event": "alive", "name": actor.name,
                                      "node_id": actor.node_id,
@@ -1807,8 +1920,9 @@ class Controller:
         # placement of the task's (cached-location) args so lease grants
         # rank nodes the same way queue placement does.
         arg_bytes: Dict[str, int] = msg.get("arg_bytes") or {}
-        for node in self._hybrid_order(
-                [n for n in self.nodes.values() if n.alive], arg_bytes):
+        candidates = [n for n in self.nodes.values()
+                      if n.alive and not n.draining]
+        for node in self._hybrid_order(candidates, arg_bytes):
             if not _res_fits(node.available, resources):
                 continue
             # Grant-time admission for the direct path (the spillback
@@ -1849,8 +1963,7 @@ class Controller:
         # Nothing idle: nudge a spawn so a later lease request can succeed —
         # in the SAME locality order as grants, so "grow toward the data
         # node" creates the worker where the bytes are.
-        for node in self._hybrid_order(
-                [n for n in self.nodes.values() if n.alive], arg_bytes):
+        for node in self._hybrid_order(candidates, arg_bytes):
             if _res_fits(node.available, resources):
                 self._maybe_spawn_worker(node, needs_tpu,
                                          msg.get("runtime_env"),
@@ -1878,12 +1991,6 @@ class Controller:
                 asyncio.get_running_loop().create_task(
                     self._shutdown_worker(w))
         self._wake_scheduler()
-
-    async def _shutdown_worker(self, w: WorkerInfo) -> None:
-        try:
-            await w.conn.send({"kind": "shutdown"})
-        except Exception:
-            pass
 
     async def _h_release_lease(self, conn, msg):
         self._release_lease(msg["lease_id"])
@@ -2014,7 +2121,8 @@ class Controller:
         gcs_placement_group_scheduler.h:274; atomic here since state is local)."""
         if pg.state != "pending":
             return
-        nodes = [n for n in self.nodes.values() if n.alive]
+        nodes = [n for n in self.nodes.values()
+                 if n.alive and not n.draining]
         nodes.sort(key=lambda n: n.index)
         trial = {n.node_id: dict(n.available) for n in nodes}
         assignment: List[str] = []
@@ -2297,6 +2405,7 @@ class Controller:
             nodes.append({
                 "node_id": n.node_id,
                 "alive": n.alive,
+                "state": self._node_state(n),
                 "is_agent": n.agent_conn is not None,
                 "busy": busy,
                 "resources": dict(n.resources),
@@ -2305,17 +2414,270 @@ class Controller:
             })
         return {"demands": demands, "nodes": nodes}
 
+    # ------------------------------------------------------------- node drain
+    # Reference: the DrainNode protocol (autoscaler.proto:334 DrainNode,
+    # node_manager.proto:391 DrainRaylet): a node leaves gracefully —
+    # scheduling stops, hosted restartable actors migrate (with their state),
+    # running tasks get a grace window then re-queue with the preempted
+    # flag, sole-copy objects are re-replicated, and only then do the
+    # chips/capacity leave the cluster.
+
+    @staticmethod
+    def _node_state(node: NodeInfo) -> str:
+        if node.drained:
+            return "drained"
+        if not node.alive:
+            return "dead"
+        if node.draining:
+            return "draining"
+        return "alive"
+
+    async def _h_drain_node(self, conn, msg):
+        """Start (or report) a node drain. Idempotent: re-draining a
+        draining node returns its current state; deadlines only shrink."""
+        nid = msg.get("node_id") or ""
+        node = self.nodes.get(nid)
+        if node is None:
+            # Prefix match so operators can pass the short id `rtpu status`
+            # prints.
+            matches = [n for n in self.nodes.values()
+                       if n.node_id.startswith(nid)] if nid else []
+            if len(matches) != 1:
+                return {"ok": False, "error": f"unknown node {nid!r}"}
+            node = matches[0]
+        if node.drained or not node.alive:
+            return {"ok": True, "node_id": node.node_id,
+                    "state": self._node_state(node)}
+        if node.labels.get("head") == "1":
+            return {"ok": False, "error": "refusing to drain the head node"}
+        reason = msg.get("reason") or "manual"
+        deadline_s = msg.get("deadline_s")
+        if deadline_s is None:
+            deadline_s = flags.get("RTPU_DRAIN_DEADLINE_S")
+        deadline = time.time() + max(0.0, float(deadline_s))
+        if node.draining:
+            node.drain_deadline = min(node.drain_deadline, deadline)
+            st = self.pending_drains.get(node.node_id)
+            if st is not None and node.drain_deadline < st["deadline"]:
+                st["deadline"] = node.drain_deadline
+                self._state_dirty = True
+            return {"ok": True, "node_id": node.node_id, "state": "draining"}
+        node.draining = True
+        node.drain_reason = reason
+        node.drain_deadline = deadline
+        self.drain_counts[reason] = self.drain_counts.get(reason, 0) + 1
+        self.pending_drains[node.node_id] = {"reason": reason,
+                                             "deadline": deadline}
+        self._state_dirty = True
+        self._export_event("NODE", {"node_id": node.node_id,
+                                    "event": "draining", "reason": reason,
+                                    "ts": time.time()})
+        self._arm_drain(node)
+        return {"ok": True, "node_id": node.node_id, "state": "draining"}
+
+    def _arm_drain(self, node: NodeInfo) -> None:
+        st = self.pending_drains.get(node.node_id)
+        if st is None:
+            return
+        node.draining = True
+        node.drain_reason = st.get("reason", "manual")
+        node.drain_deadline = float(st.get("deadline", 0.0))
+        task = self._drain_tasks.get(node.node_id)
+        if task is not None and not task.done():
+            return
+        self._drain_tasks[node.node_id] = (
+            asyncio.get_running_loop().create_task(self._drain_node(node)))
+
+    async def _drain_node(self, node: NodeInfo) -> None:
+        try:
+            # 1. Proactively migrate restartable/detached actors: their
+            # state is snapshotted on the still-healthy worker and restored
+            # on the new placement — a planned departure is a move, not a
+            # crash-recovery (restart_count untouched).
+            for actor in list(self.actors.values()):
+                if (actor.node_id == node.node_id
+                        and actor.state == "alive"
+                        and actor.creation_spec is not None
+                        and (actor.detached
+                             or actor.restart_count < actor.max_restarts)):
+                    await self._migrate_actor(actor, node)
+            # 2. Grace window: let running tasks (and direct leases) finish.
+            while time.time() < node.drain_deadline:
+                if node.node_id not in self.pending_drains:
+                    return  # node died mid-drain; death path took over
+                if self._node_quiesced(node):
+                    break
+                await asyncio.sleep(0.1)
+            # 3. Re-replicate objects whose only copy lives on the draining
+            # host BEFORE the node (and its chips) leave the free pool.
+            await self._evacuate_objects(node)
+        except Exception as e:  # pragma: no cover — drain must terminate
+            sys.stderr.write(f"[controller] drain error on "
+                             f"{node.node_id[:8]}: {e!r}\n")
+        await self._finish_drain(node)
+
+    def _node_quiesced(self, node: NodeInfo) -> bool:
+        for wid in node.workers:
+            w = self.workers.get(wid)
+            if w is not None and (w.current_task or w.state == "leased"):
+                return False
+        for lease in self._leases.values():
+            if lease["node_id"] == node.node_id:
+                return False
+        for actor in self.actors.values():
+            if actor.node_id == node.node_id and actor.state in (
+                    "alive", "pending"):
+                return False
+        return True
+
+    async def _migrate_actor(self, actor: ActorInfo, node: NodeInfo) -> None:
+        """Move one actor off a draining node: snapshot its instance state
+        on the hosting worker (best-effort; falls back to a fresh
+        constructor run), retire the old instance, and re-queue the
+        creation spec — the scheduler places it on a non-draining node.
+        Unlike _maybe_restart_actor this consumes NO restart budget and
+        fails no buffered calls (in-flight calls complete on the old
+        instance before the snapshot closure reaches the mailbox)."""
+        spec = actor.creation_spec
+        if spec is None:
+            return
+        actor.state = "restarting"  # new controller-path calls buffer now
+        self._export_event("ACTOR", {"actor_id": actor.actor_id,
+                                     "event": "migrating",
+                                     "node_id": node.node_id,
+                                     "ts": time.time()})
+        w = self.workers.get(actor.worker_id or "")
+        blob = None
+        if w is not None:
+            try:
+                res = await w.conn.request(
+                    {"kind": "snapshot_actor", "actor_id": actor.actor_id},
+                    timeout=10)
+                if isinstance(res, dict):
+                    blob = res.get("blob")
+            except Exception:
+                blob = None
+            # Retire the old instance so post-snapshot mutations can't be
+            # silently lost; a direct call racing this window fails with
+            # ActorDiedError (at-most-once actor-call semantics).
+            try:
+                await w.conn.send({"kind": "drop_actor",
+                                   "actor_id": actor.actor_id})
+            except Exception:
+                pass
+            w.actor_ids.discard(actor.actor_id)
+            if not w.actor_ids and w.state == "actor":
+                w.state = "idle"
+        if actor.reserved:
+            actor.reserved = False
+            self._release_reservation(actor.resources, node, actor.pg)
+        actor.worker_id = None
+        actor.node_id = None
+        if blob is not None:
+            spec["state_blob"] = blob
+        else:
+            spec.pop("state_blob", None)
+        spec["state"] = "pending"
+        spec.pop("sched_node", None)
+        self.tasks[spec["task_id"]] = spec
+        self.pending_queue.append(spec)
+        self._record_task_event(spec, "actor_migrate")
+        if actor.detached:
+            self._state_dirty = True
+        self._wake_scheduler()
+
+    async def _evacuate_objects(self, node: NodeInfo) -> None:
+        """Pull the raw bytes of every object whose only copy lives on the
+        draining host and re-home them in the head's spill directory (the
+        same byte layout spilling uses, so every read path already
+        understands the rewritten location). Objects that cannot be pulled
+        fall back to lineage reconstruction in the node-death path."""
+        if node.agent_conn is None or not node.host_id \
+                or node.host_id == self.host_id:
+            return  # bytes live on the head host and survive worker death
+        head = next((n for n in self.nodes.values()
+                     if n.agent_conn is None and n.alive), None)
+        from .object_store import spill_dir
+
+        CHUNK = 4 * 1024 * 1024
+        for oid, loc in list(self.objects.items()):
+            if (loc.inline is not None or loc.is_error
+                    or loc.host_id != node.host_id):
+                continue
+            path = os.path.join(spill_dir(), f"{oid[:32]}.bin")
+            try:
+                with open(path, "wb") as f:
+                    off = 0
+                    while off < loc.size:
+                        n = min(CHUNK, loc.size - off)
+                        raw = await node.agent_conn.request(
+                            {"kind": "pull_chunk", "loc": loc,
+                             "offset": off, "length": n}, timeout=30)
+                        if not raw:
+                            raise ConnectionError("short pull")
+                        f.write(raw)
+                        off += len(raw)
+            except Exception:
+                try:
+                    os.unlink(path)
+                except OSError:
+                    pass
+                continue  # node-death reconstruction is the fallback
+            if self.objects.get(oid) is not loc:
+                try:
+                    os.unlink(path)
+                except OSError:
+                    pass
+                continue  # freed/replaced while pulling
+            import dataclasses as _dc
+
+            self.objects[oid] = _dc.replace(
+                loc, arena=None, arena_oid=0, shm_name=None,
+                spill_path=path, host_id=self.host_id,
+                node_id=head.node_id if head else None)
+
+    async def _finish_drain(self, node: NodeInfo) -> None:
+        """Terminal step: the grace window closed (or the node quiesced) —
+        kill remaining workers, release the node, run the death path. The
+        drained flag routes every resulting task/actor failure through the
+        preempted (budget-free) retry paths."""
+        self._drain_tasks.pop(node.node_id, None)
+        if node.node_id not in self.pending_drains:
+            return  # death path already cleaned up mid-drain
+        node.drained = True
+        self.pending_drains.pop(node.node_id, None)
+        self._state_dirty = True
+        self._export_event("NODE", {"node_id": node.node_id,
+                                    "event": "drained",
+                                    "reason": node.drain_reason,
+                                    "ts": time.time()})
+        for wid in list(node.workers):
+            w = self.workers.get(wid)
+            if w is not None:
+                # Graceful stop + proc terminate (local spawns); agent
+                # spawns are reaped by their agent's shutdown below.
+                await self._shutdown_worker(w)
+        if node.agent_conn is not None:
+            # The agent kills its workers and exits; its connection drop
+            # runs _on_node_death, which sees node.drained.
+            try:
+                await node.agent_conn.send({"kind": "shutdown"})
+            except Exception:
+                pass
+        else:
+            await self._on_node_death(node)
+        self._wake_scheduler()
+
     async def _h_drop_node(self, conn, msg):
-        """Autoscaler-initiated scale-down of an agent node: tell its agent
-        to exit; the normal death path cleans up."""
+        """Legacy immediate scale-down of an agent node — now a
+        zero-deadline drain, so even the abrupt path migrates actors and
+        re-queues work with the preempted flag instead of crashing it."""
         node = self.nodes.get(msg["node_id"])
         if node is None or node.agent_conn is None:
             return {"ok": False}
-        try:
-            await node.agent_conn.send({"kind": "shutdown"})
-        except Exception:
-            pass
-        return {"ok": True}
+        return await self._h_drain_node(conn, {
+            "node_id": node.node_id, "reason": msg.get("reason") or "manual",
+            "deadline_s": 0.0})
 
     async def _h_task_events(self, conn, msg):
         """Raw event stream for the chrome-trace timeline export
@@ -2429,6 +2791,24 @@ class Controller:
             f"rtpu_nodes_alive {sum(1 for n in self.nodes.values() if n.alive)}",
             "# TYPE rtpu_objects gauge",
             f"rtpu_objects {len(self.objects)}",
+            "# HELP rtpu_nodes Nodes by drain-lifecycle state "
+            "(alive/draining/drained/dead)",
+            "# TYPE rtpu_nodes gauge",
+        ]
+        node_states: Dict[str, int] = {}
+        for n in self.nodes.values():
+            st = self._node_state(n)
+            node_states[st] = node_states.get(st, 0) + 1
+        for st, cnt in sorted(node_states.items()):
+            lines.append(f'rtpu_nodes{{state="{st}"}} {cnt}')
+        if self.drain_counts:
+            lines.append("# HELP rtpu_node_drains_total Node drains "
+                         "initiated, by reason")
+            lines.append("# TYPE rtpu_node_drains_total counter")
+            for reason, cnt in sorted(self.drain_counts.items()):
+                lines.append(
+                    f'rtpu_node_drains_total{{reason="{reason}"}} {cnt}')
+        lines += [
             "# TYPE rtpu_uptime_seconds counter",
             f"rtpu_uptime_seconds {time.time() - self.start_time:.1f}",
             "# TYPE rtpu_objects_spilled_total counter",
@@ -2548,6 +2928,10 @@ class Controller:
                     "available": dict(n.available),
                     "labels": dict(n.labels),
                     "alive": n.alive,
+                    # Drain lifecycle: alive | draining | drained | dead
+                    # (rtpu status / dashboard node table / serve routing).
+                    "state": self._node_state(n),
+                    "drain_reason": n.drain_reason,
                     "index": n.index,
                     "num_workers": len(n.workers),
                     "mem_fraction": n.mem_fraction,
@@ -2607,6 +2991,10 @@ class Controller:
             for a in self.actors.values():
                 if a.reserved and a.node_id == nid and a.pg is None:
                     _res_sub(node.available, a.resources)
+            if nid in self.pending_drains:
+                # The drain outlived a controller bounce: the re-registered
+                # node resumes draining with its original deadline.
+                self._arm_drain(node)
         else:
             self._node_counter += 1
             self.nodes[nid] = NodeInfo(
@@ -2691,6 +3079,11 @@ class Controller:
             return
         self.kv.update(snap.get("kv", {}))
         self.functions.update(snap.get("functions", {}))
+        # In-progress drains resume after the bounce (wall-clock deadlines,
+        # so the grace window keeps shrinking through the downtime).
+        drains = snap.get("drains") or {}
+        self.drain_counts.update(drains.get("counts") or {})
+        self.pending_drains.update(drains.get("pending") or {})
         # Node table (non-agent nodes only — agents re-register themselves):
         # restored so that surviving workers of the previous controller can
         # reconnect under their original node ids and so the head node keeps
@@ -2811,6 +3204,8 @@ class Controller:
                 for n in self.nodes.values()
                 if n.agent_conn is None and n.agent_addr is None and n.alive
             ],
+            "drains": {"counts": dict(self.drain_counts),
+                       "pending": dict(self.pending_drains)},
         }
         tmp = self.persist_path + f".tmp{os.getpid()}"
         try:
@@ -3102,7 +3497,10 @@ class Controller:
                         arg_bytes: Optional[Dict[str, int]] = None
                         ) -> List[NodeInfo]:
         strategy = spec.get("scheduling", {"type": "DEFAULT"})
-        nodes = [n for n in self.nodes.values() if n.alive]
+        # Draining nodes take no new placements (reference: DrainNode makes
+        # the raylet unschedulable while its deadline runs down).
+        nodes = [n for n in self.nodes.values()
+                 if n.alive and not n.draining]
         st = strategy.get("type", "DEFAULT")
         # Nodes that spilled this spec back are out for the retry pass
         # (reference: spillback carries the rejecting raylet in the lease
@@ -3707,8 +4105,27 @@ class WorkerCrashedError(RayTpuError):
     pass
 
 
+class NodePreemptedError(WorkerCrashedError):
+    """The hosting node left the cluster on a PLANNED departure — a spot
+    preemption notice, a manual `rtpu drain`, or autoscaler idle
+    scale-down. Carries ``preempted = True`` so planned departures never
+    consume task ``max_retries`` / actor ``max_restarts`` budgets
+    (reference: the DrainNode protocol's graceful-departure semantics vs
+    unexpected node failure)."""
+
+    preempted = True
+
+
 class ActorDiedError(RayTpuError):
     pass
+
+
+class ActorNotHostedError(ActorDiedError):
+    """A worker REFUSED an actor call because it no longer hosts the actor
+    (it migrated off a draining node, or was killed). The refusal happens
+    before any user code runs, so the call PROVABLY never executed —
+    callers may safely resubmit it through the controller, which routes to
+    the actor's new host (or buffers while it re-creates)."""
 
 
 class OutOfMemoryError(RayTpuError):

@@ -69,6 +69,7 @@ class HostAgent:
         self.procs: Dict[str, subprocess.Popen] = {}  # spawn_token -> proc
         self.worker_tokens: Dict[str, str] = {}  # worker_id -> spawn_token
         self._stop = asyncio.Event()
+        self._draining = False  # a self-drain request is in flight
         if host_id:
             flags.set_env("RTPU_HOST_ID", host_id)
         from .object_store import current_host_id
@@ -93,6 +94,8 @@ class HostAgent:
         loop.create_task(self._heartbeat_loop())
         loop.create_task(self._watch_controller())
         loop.create_task(self._reap_loop())
+        if flags.get("RTPU_PREEMPTION_WATCHER"):
+            loop.create_task(self._preemption_watch_loop())
 
     def _register_msg(self) -> Dict[str, Any]:
         return {
@@ -153,6 +156,80 @@ class HostAgent:
                 await asyncio.sleep(min(backoff, deadline - now))
                 backoff = min(backoff * 2, 2.0)
         return False
+
+    # ------------------------------------------------- drain / preemption
+
+    async def _preemption_watch_loop(self) -> None:
+        """Poll the cloud metadata preemption endpoint (GCE: the
+        instance/preempted key flips to TRUE ~30s before the VM dies;
+        RTPU_PREEMPTION_URL makes it pluggable so tests serve a fake) and
+        self-drain on the first notice — the cluster migrates this host's
+        actors/tasks/objects during the notice window instead of taking a
+        crash."""
+        url = flags.get("RTPU_PREEMPTION_URL")
+        poll = flags.get("RTPU_PREEMPTION_POLL_S")
+        while not self._stop.is_set():
+            try:
+                await asyncio.wait_for(self._stop.wait(), poll)
+                return
+            except asyncio.TimeoutError:
+                pass
+            try:
+                notice = await asyncio.to_thread(self._poll_preemption, url)
+            except Exception:
+                continue  # metadata server flake: keep watching
+            if notice:
+                sys.stderr.write(
+                    f"[host_agent] preemption notice at {url}; draining "
+                    f"node {self.node_id[:8]}\n")
+                self.initiate_drain("preemption")
+                return
+
+    @staticmethod
+    def _poll_preemption(url: str) -> bool:
+        import urllib.request
+
+        req = urllib.request.Request(
+            url, headers={"Metadata-Flavor": "Google"})
+        with urllib.request.urlopen(req, timeout=2) as resp:
+            body = resp.read(256).decode("utf-8", "replace").strip()
+        return body.upper() not in ("", "FALSE", "NONE", "0")
+
+    def initiate_drain(self, reason: str) -> None:
+        """Ask the controller to drain this node (idempotent). Called from
+        the preemption watcher and the SIGTERM handler — both run on the
+        event loop. A second call (second SIGTERM, or drain already
+        pending) forces immediate shutdown instead."""
+        if self._draining:
+            self._stop.set()
+            return
+        self._draining = True
+        deadline_s = flags.get("RTPU_DRAIN_DEADLINE_S")
+
+        async def _drain():
+            try:
+                await self.ctrl.request(
+                    {"kind": "drain_node", "node_id": self.node_id,
+                     "reason": reason, "deadline_s": deadline_s},
+                    timeout=10)
+            except Exception as e:
+                sys.stderr.write(
+                    f"[host_agent] drain request failed ({e!r}); "
+                    f"shutting down hard\n")
+                self._stop.set()
+                return
+            # The controller finishes the drain by sending us "shutdown".
+            # Backstop: if that never arrives (controller died mid-drain),
+            # exit once the grace window (plus slack) has passed rather
+            # than serving a cluster that thinks we're gone.
+            try:
+                await asyncio.wait_for(self._stop.wait(), deadline_s + 15)
+            except asyncio.TimeoutError:
+                sys.stderr.write(
+                    "[host_agent] drain never completed; exiting\n")
+                self._stop.set()
+
+        asyncio.get_running_loop().create_task(_drain())
 
     async def run_forever(self) -> None:
         await self._stop.wait()
@@ -425,15 +502,25 @@ async def _amain(args) -> int:
         serve_port=args.port,
     )
 
-    def _sig(*_a):
+    def _sigterm(*_a):
+        # Graceful departure: SIGTERM triggers a drain — workers keep
+        # running while the controller migrates actors and re-queues tasks
+        # — instead of an immediate worker kill. A second SIGTERM (or
+        # SIGINT) forces the old immediate shutdown.
+        agent.initiate_drain("manual")
+
+    def _sigint(*_a):
         agent._stop.set()
 
     loop = asyncio.get_running_loop()
-    for s in (signal.SIGTERM, signal.SIGINT):
-        try:
-            loop.add_signal_handler(s, _sig)
-        except NotImplementedError:
-            pass
+    try:
+        loop.add_signal_handler(signal.SIGTERM, _sigterm)
+    except NotImplementedError:
+        pass
+    try:
+        loop.add_signal_handler(signal.SIGINT, _sigint)
+    except NotImplementedError:
+        pass
     try:
         await agent.start()
     except (ConnectionError, OSError) as e:

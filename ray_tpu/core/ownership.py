@@ -58,6 +58,12 @@ from ray_tpu import flags
 
 _token = uuid.uuid4().hex[:16]
 _lock = threading.RLock()
+# Ref-server init only. NEVER the ref-table _lock: self_addr blocks on the
+# io loop while the server starts, and the io loop's ref hooks
+# (on_return_location et al.) take _lock — holding _lock across that wait
+# deadlocks the loop until the io.call timeout fires and silently disables
+# ownership for the whole process.
+_addr_lock = threading.Lock()
 _entries: Dict[str, "_Entry"] = {}
 _pins: Dict[str, List[Any]] = {}  # outer oid -> nested ObjectRefs kept alive
 _self_addr: Optional[str] = None  # "host:port|token" once a ref server runs
@@ -126,7 +132,7 @@ def self_addr() -> str:
     global _self_addr
     if _self_addr is not None:
         return _self_addr
-    with _lock:
+    with _addr_lock:
         if _self_addr is not None:
             return _self_addr
         if not enabled():

@@ -24,6 +24,14 @@ from typing import Any, Awaitable, Callable, Dict, Optional, Tuple
 
 _LEN = struct.Struct("!Q")
 
+
+class NeverSentError(ConnectionError):
+    """The connection was already closed when the request was submitted:
+    the bytes PROVABLY never left this process. Callers with at-most-once
+    semantics (direct actor calls) may safely resubmit on another path —
+    unlike a generic ConnectionError, where the peer may have executed the
+    request before the connection dropped."""
+
 # ------------------------------------------------------- handler accounting
 # Per-kind served-message count + cumulative handler seconds for this
 # process (reference: the per-RPC event stats gRPC servers surface). The
@@ -267,7 +275,7 @@ class Connection:
         # the future or this check sees the close.
         if self.closed.is_set():
             self._pending.pop(rid, None)
-            raise ConnectionError(f"connection {self.name!r} closed")
+            raise NeverSentError(f"connection {self.name!r} closed")
         await self.send(msg)
         if timeout is None:
             return await fut
@@ -291,7 +299,7 @@ class Connection:
         def _send() -> None:
             if self.closed.is_set():
                 cfut.set_exception(
-                    ConnectionError(f"connection {self.name!r} closed"))
+                    NeverSentError(f"connection {self.name!r} closed"))
                 return
             fut = self._loop.create_future()
             self._pending[rid] = fut

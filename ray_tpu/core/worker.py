@@ -28,7 +28,7 @@ import cloudpickle
 from . import context as ctx
 from . import task_events
 from .client import CoreClient
-from .controller import ActorDiedError, TaskError
+from .controller import ActorDiedError, ActorNotHostedError, TaskError
 from .ids import WorkerID
 from .object_store import (ObjectLocation, get_bytes, get_bytes_with_refresh,
                            put_bytes)
@@ -298,6 +298,11 @@ class WorkerRuntime:
         }
         if reconnect:
             msg["reconnect"] = True
+            # Tasks currently executing on this worker: a restarted
+            # controller re-claims them so (a) a resubmitted duplicate
+            # isn't also scheduled and (b) a node drain's quiesce check
+            # keeps waiting for work it would otherwise not see.
+            msg["running"] = list(self.running_threads.keys())
             # Re-claim hosted actors: a restarted controller rebuilds its
             # actor directory from these reports, keeping live instances
             # (and their state) over queued re-creations.
@@ -502,7 +507,10 @@ class WorkerRuntime:
             raise ValueError(f"direct server: unknown kind {msg['kind']!r}")
         mb = self.actors.get(spec["actor_id"])
         if mb is None:
-            raise ActorDiedError(
+            # Typed refusal BEFORE any user code runs: the caller knows the
+            # call never executed and resubmits through the controller
+            # (which has the actor's post-migration address).
+            raise ActorNotHostedError(
                 f"actor {spec['actor_id'][:8]} is not hosted on this worker "
                 f"(died or restarted elsewhere)")
         spec["__direct__"] = (fut, loop)
@@ -629,6 +637,47 @@ class WorkerRuntime:
             mb = self.actors.get(spec["actor_id"])
             if mb is not None:
                 mb.submit(spec)
+            else:
+                # The actor left this worker (killed, or migrated off a
+                # draining node) while the dispatch was in flight. The call
+                # never ran, so bounce it back to the controller — which
+                # routes to the actor's new host, buffers while it
+                # re-creates, or stores ActorDiedError if it is truly dead.
+                # Bounded so a stale directory can't ping-pong forever; a
+                # silent drop would hang the caller.
+                spec = dict(spec, __rehost__=spec.get("__rehost__", 0) + 1)
+
+                def _bounce(spec=spec):
+                    try:
+                        self.client.request(
+                            {"kind": "submit_actor_task", "spec": spec})
+                    except Exception:
+                        self._complete_error(spec, ActorNotHostedError(
+                            f"actor {spec['actor_id'][:8]} is no longer "
+                            f"hosted on this worker"), "")
+
+                if spec["__rehost__"] <= 3:
+                    self.pool.submit(_bounce)
+                else:
+                    self.pool.submit(
+                        self._complete_error, spec,
+                        ActorDiedError(
+                            f"actor {spec['actor_id'][:8]} is no longer "
+                            f"hosted on this worker"), "")
+        elif kind == "snapshot_actor":
+            # Drain migration: serialize the actor instance ON ITS MAILBOX
+            # THREAD (state is thread-affine), after every already-queued
+            # call — so the snapshot reflects all calls the caller saw
+            # complete. Best-effort: unpicklable/slow actors fall back to a
+            # fresh constructor run on the new node.
+            return await self._snapshot_actor(msg["actor_id"])
+        elif kind == "drop_actor":
+            # The controller moved this actor elsewhere: retire the local
+            # instance so post-snapshot mutations cannot be silently lost.
+            mb = self.actors.pop(msg["actor_id"], None)
+            if mb is not None:
+                mb.exited = True
+                mb.stop()
         elif kind == "cancel_task":
             self._cancel_task(msg["task_id"])
         elif kind == "shutdown":
@@ -661,6 +710,32 @@ class WorkerRuntime:
             for item in msg["items"]:
                 ctx.deliver_pubsub(item["channel"], item["data"])
         return None
+
+    async def _snapshot_actor(self, actor_id: str) -> Dict[str, Any]:
+        import asyncio
+
+        mb = self.actors.get(actor_id)
+        if mb is None or mb.exited or mb.instance is None:
+            return {"error": "actor not hosted here"}
+        loop = asyncio.get_running_loop()
+        fut: "asyncio.Future" = loop.create_future()
+
+        def snap():
+            try:
+                blob = cloudpickle.dumps(mb.instance)
+                payload: Dict[str, Any] = {"blob": blob}
+            except Exception as e:  # unpicklable state: ctor fallback
+                payload = {"error": repr(e)}
+            loop.call_soon_threadsafe(
+                lambda: fut.done() or fut.set_result(payload))
+
+        # Rides the mailbox's closure lane (same as __init__), so it runs
+        # strictly after every call queued before the migration began.
+        mb.q.put({"__create__": snap})
+        try:
+            return await asyncio.wait_for(fut, timeout=8.0)
+        except asyncio.TimeoutError:
+            return {"error": "snapshot timed out behind queued calls"}
 
     def _format_stacks(self) -> str:
         import sys
@@ -1074,9 +1149,16 @@ class WorkerRuntime:
 
             _held = ownership.acquire_spec_refs(spec)  # noqa: F841
             try:
-                cls = self._load_function(spec["func_id"])
-                args, kwargs = self._resolve_args(spec)
-                mb.instance = cls(*args, **kwargs)
+                blob = spec.get("state_blob")
+                if blob is not None:
+                    # Drain migration: restore the serialized instance from
+                    # the old node instead of re-running the constructor —
+                    # the actor arrives with its state intact.
+                    mb.instance = cloudpickle.loads(blob)
+                else:
+                    cls = self._load_function(spec["func_id"])
+                    args, kwargs = self._resolve_args(spec)
+                    mb.instance = cls(*args, **kwargs)
                 ctx.task_local.actor_id = actor_id
                 self.client.request({"kind": "actor_ready", "actor_id": actor_id})
             except BaseException as e:  # noqa: BLE001

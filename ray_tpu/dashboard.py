@@ -311,8 +311,16 @@ class Dashboard:
             )
         except Exception as e:
             cluster = f"cluster unavailable: {html.escape(repr(e))}"
-        nodes = _table(self._safe(state_api.list_nodes),
-                       ["node_id", "alive", "resources", "labels"])
+        node_rows = self._safe(state_api.list_nodes) or []
+        for r in node_rows:
+            # Draining badge: the lifecycle state plus its reason, so an
+            # operator sees "draining (preemption)" at a glance.
+            st = r.get("state", "alive" if r.get("alive") else "dead")
+            if st in ("draining", "drained") and r.get("drain_reason"):
+                st = f"{st} ({r['drain_reason']})"
+            r["state"] = st
+        nodes = _table(node_rows,
+                       ["node_id", "state", "resources", "labels"])
         actor_rows = self._safe(state_api.list_actors) or []
         for r in actor_rows:
             r["logs"] = _log_link("actor_id", r.get("actor_id"))

@@ -154,6 +154,28 @@ _define("RTPU_TESTING_RPC_DELAY_MS", str, None,
         "RAY_testing_asio_delay_us). Applied server-side in the protocol "
         "layer before the handler runs; testing only.")
 
+# -- node drain / preemption -------------------------------------------------
+_define("RTPU_DRAIN_DEADLINE_S", float, 30.0,
+        "Default grace window a draining node gives its running tasks "
+        "before they are killed and re-queued (the DrainNode deadline; "
+        "reference autoscaler.proto DrainNode deadline_timestamp_ms). "
+        "Callers of drain_node may override per drain.")
+_define("RTPU_PREEMPTION_WATCHER", bool, False,
+        "Host agent polls the cloud metadata preemption endpoint and "
+        "self-drains (reason='preemption') when an imminent-preemption "
+        "notice appears, so a spot/preemptible TPU VM migrates its work "
+        "instead of crashing. Off by default: only meaningful on "
+        "preemptible capacity.")
+_define("RTPU_PREEMPTION_URL", str,
+        "http://metadata.google.internal/computeMetadata/v1/instance/"
+        "preempted",
+        "Metadata endpoint the preemption watcher polls. A body of "
+        "TRUE/FALSE (the GCE contract) — any other non-empty truthy body "
+        "also counts as a notice. Tests point this at a "
+        "testing.PreemptionInjector fake.")
+_define("RTPU_PREEMPTION_POLL_S", float, 1.0,
+        "Preemption watcher polling period.")
+
 # -- object store / spilling -------------------------------------------------
 _define("RTPU_NATIVE_STORE", bool, True,
         "Use the C++ shm arena when available (0 forces pickle fallback).")

@@ -31,6 +31,11 @@ class FaultTolerantActorManager:
         }
         self._healthy: Dict[int, bool] = {i: True for i in self._actors}
         self._restarts: Dict[int, int] = {i: 0 for i in self._actors}
+        # Actors whose last failure carried the preempted flag (planned
+        # node departure): restoring them must not consume restart budget —
+        # on elastic spot capacity every preemption wave would otherwise
+        # permanently shrink the pool.
+        self._preempted: set = set()
 
     # ------------------------------------------------------------------ info
 
@@ -71,27 +76,63 @@ class FaultTolerantActorManager:
         for i, ref in refs.items():
             try:
                 out.append((i, ray_tpu.get(ref, timeout=timeout)))
-            except Exception:
+            except Exception as e:
                 logger.exception("actor %d call %s failed", i, fn_name)
                 self._healthy[i] = False
+                if self._is_preempted_error(e):
+                    self._preempted.add(i)
         return out
 
+    @staticmethod
+    def _is_preempted_error(e: BaseException) -> bool:
+        """True when the failure stems from a planned node departure
+        (NodePreemptedError carries preempted=True, possibly wrapped in a
+        TaskError's cause chain)."""
+        seen = 0
+        cur: Optional[BaseException] = e
+        while cur is not None and seen < 8:
+            if getattr(cur, "preempted", False):
+                return True
+            cur = getattr(cur, "cause", None) or cur.__cause__
+            seen += 1
+        return False
+
+    @staticmethod
+    def _actor_state(actor) -> str:
+        from ray_tpu.core import context as ctx
+
+        try:
+            info = ctx.get_worker_context().client.request(
+                {"kind": "resolve_actor", "actor_id": actor._actor_id,
+                 "wait": 0})
+            return info.get("state", "?")
+        except Exception:
+            return "?"
+
     def restore_unhealthy(self) -> int:
-        """Recreate dead actors from the factory (bounded by max_restarts).
-        Returns the number restored."""
+        """Recreate dead actors from the factory (bounded by max_restarts;
+        preemption-flagged deaths don't count against it). Returns the
+        number restored."""
         restored = 0
         for i, ok in list(self._healthy.items()):
             if ok:
                 continue
-            if self._restarts[i] >= self._max_restarts:
+            preempted = i in self._preempted
+            if not preempted and self._restarts[i] >= self._max_restarts:
                 continue
-            try:
-                ray_tpu.kill(self._actors[i])
-            except Exception:
-                pass
+            # Skip the kill when the actor is already dead — killing a
+            # corpse wastes an RPC and can tear down the worker that
+            # meanwhile hosts the actor's restarted incarnation.
+            if self._actor_state(self._actors[i]) != "dead":
+                try:
+                    ray_tpu.kill(self._actors[i])
+                except Exception:
+                    pass
             self._actors[i] = self._factory(i)
             self._healthy[i] = True
-            self._restarts[i] += 1
+            if not preempted:
+                self._restarts[i] += 1
+            self._preempted.discard(i)
             restored += 1
         return restored
 

@@ -144,6 +144,10 @@ class Router:
         self._version = -1
         self._replicas: List[Any] = []
         self._inflight: Dict[str, int] = {}
+        # Replicas hosted on draining/drained nodes: excluded from picks so
+        # requests stop landing on a node that is about to vanish
+        # (refreshed with the replica list).
+        self._avoid: set = set()
         self._controller = None
         self._last_refresh = 0.0
         _routers.add(self)
@@ -170,17 +174,45 @@ class Router:
                     self._replicas = []
                 raise DeploymentNotFoundError(self.name) from e
             raise
+        avoid = self._replicas_on_draining_nodes(replicas)
         with self._lock:
             self._version = version
             self._replicas = replicas
+            self._avoid = avoid
             self._inflight = {r._actor_id: self._inflight.get(r._actor_id, 0)
                               for r in replicas}
             self._last_refresh = now
 
+    @staticmethod
+    def _replicas_on_draining_nodes(replicas) -> set:
+        """Actor ids of replicas hosted on draining/drained nodes — the
+        scheduler already re-creates them elsewhere; routing there just
+        buys a request an ActorDiedError when the node goes."""
+        if not replicas:
+            return set()
+        from ray_tpu.core import context as ctx
+
+        try:
+            client = ctx.get_worker_context().client
+            nodes = client.request({"kind": "cluster_state"})["nodes"]
+            bad = {n["node_id"] for n in nodes
+                   if n.get("state", "alive") != "alive"}
+            if not bad:
+                return set()
+            actors = client.request(
+                {"kind": "list_state", "what": "actors", "limit": 10000})
+            want = {r._actor_id for r in replicas}
+            return {a["actor_id"] for a in actors
+                    if a["actor_id"] in want and a.get("node_id") in bad}
+        except Exception:
+            return set()
+
     def _pick(self):
-        """Power-of-two-choices over local in-flight counts."""
+        """Power-of-two-choices over local in-flight counts; replicas on
+        draining nodes are out of the draw while any alternative exists."""
         with self._lock:
-            reps = self._replicas
+            reps = [r for r in self._replicas
+                    if r._actor_id not in self._avoid] or self._replicas
             if not reps:
                 raise RuntimeError(f"no replicas for {self.name}")
             if len(reps) == 1:
@@ -202,12 +234,16 @@ class Router:
         """Model-affine pick: rendezvous hash over replicas, so one model's
         requests land where it is already loaded (reference model-multiplex
         routing). `exclude` holds replicas that already failed this call —
-        the deterministic hash would otherwise retry the same dead one."""
+        the deterministic hash would otherwise retry the same dead one.
+        Draining-node replicas leave the hash ring the same way (unless
+        nothing else remains)."""
         import hashlib
 
         with self._lock:
             reps = [r for r in self._replicas
                     if not exclude or r._actor_id not in exclude]
+            live = [r for r in reps if r._actor_id not in self._avoid]
+            reps = live or reps
             if not reps:
                 raise RuntimeError(f"no replicas for {self.name}")
             r = max(

@@ -7,6 +7,7 @@ Reference: ray's ``_private/test_utils.py`` ResourceKiller hierarchy and the
 from .fault_injection import (  # noqa: F401
     ControllerKiller,
     HostAgentKiller,
+    PreemptionInjector,
     ProcessSuspender,
     ResourceKillerBase,
     WorkerKiller,

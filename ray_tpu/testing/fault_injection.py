@@ -236,6 +236,84 @@ class ProcessSuspender(ResourceKillerBase):
         return f"suspended pid={pid} for {self.suspend_s}s"
 
 
+class PreemptionInjector:
+    """Fake spot-VM preemption: a metadata endpoint + a deadline kill.
+
+    Serves the GCE ``instance/preempted`` contract over HTTP ("FALSE"
+    until armed, "TRUE" after) so a host agent's preemption watcher
+    (``RTPU_PREEMPTION_WATCHER=1`` with ``RTPU_PREEMPTION_URL=inj.url``)
+    sees a real notice — then SIGKILLs the target node process when the
+    notice deadline passes, exactly like the cloud reclaiming the VM.
+    Covers both spot paths: notice HONORED (the agent self-drains and
+    exits before the kill lands — the kill records a miss) and notice
+    IGNORED (watcher off: the SIGKILL is the first the cluster hears of
+    it, i.e. a plain crash).
+
+        inj = PreemptionInjector()
+        # agent env: RTPU_PREEMPTION_WATCHER=1, RTPU_PREEMPTION_URL=inj.url
+        inj.arm(agent_proc, notice_s=5.0)
+        ...
+        inj.stop()
+    """
+
+    def __init__(self, host: str = "127.0.0.1"):
+        import http.server
+
+        injector = self
+
+        class _Handler(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler API
+                body = (b"TRUE" if injector.preempting else b"FALSE")
+                self.send_response(200)
+                self.send_header("Content-Type", "text/plain")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):  # keep test output clean
+                pass
+
+        self.preempting = False
+        self.kills: List[tuple] = []
+        self._server = http.server.ThreadingHTTPServer((host, 0), _Handler)
+        self.url = f"http://{host}:{self._server.server_address[1]}/"
+        self._serve_thread = threading.Thread(
+            target=self._server.serve_forever, name="PreemptionInjector",
+            daemon=True)
+        self._serve_thread.start()
+        self._kill_thread: Optional[threading.Thread] = None
+
+    def arm(self, target: ProcTarget, notice_s: float = 5.0) -> None:
+        """Flip the metadata notice on and schedule the VM kill for
+        ``notice_s`` seconds out."""
+        self.preempting = True
+
+        def _reap():
+            time.sleep(notice_s)
+            pid = _pid_of(target)
+            if pid is not None and _signal_pid(pid, signal.SIGKILL):
+                self.kills.append(
+                    (time.monotonic(), f"preempted node pid={pid}"))
+
+        self._kill_thread = threading.Thread(
+            target=_reap, name="PreemptionInjector-kill", daemon=True)
+        self._kill_thread.start()
+
+    def honored(self) -> bool:
+        """True when the node left on its own before the deadline kill —
+        the preemption notice was honored."""
+        return not self.kills
+
+    def stop(self) -> None:
+        try:
+            self._server.shutdown()
+            self._server.server_close()
+        except Exception:
+            pass
+        if self._kill_thread is not None:
+            self._kill_thread.join(timeout=10)
+
+
 @contextlib.contextmanager
 def rpc_delays(spec: str):
     """Scoped ``RTPU_TESTING_RPC_DELAY_MS`` (reference:

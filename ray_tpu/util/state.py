@@ -71,6 +71,20 @@ def summarize_tasks(breakdown: bool = False) -> Dict[str, Dict[str, Any]]:
     return _req({"kind": "list_state", "what": "summary"})
 
 
+def drain_node(node_id: str, reason: str = "manual",
+               deadline_s: Optional[float] = None) -> Dict[str, Any]:
+    """Gracefully drain a node out of the cluster (reference: the DrainNode
+    protocol / `ray drain-node`): scheduling stops there immediately,
+    hosted restartable actors migrate with their state, running tasks get
+    ``deadline_s`` (default RTPU_DRAIN_DEADLINE_S) to finish before they
+    re-queue with the preempted flag, and sole-copy objects re-replicate
+    before the node's chips leave the pool. ``reason`` is one of
+    manual / preemption / idle_scale_down (exported as
+    rtpu_node_drains_total{reason}). Returns {ok, node_id, state}."""
+    return _req({"kind": "drain_node", "node_id": node_id,
+                 "reason": reason, "deadline_s": deadline_s})
+
+
 def metrics_address() -> Optional[str]:
     """host:port of the controller's Prometheus /metrics endpoint."""
     state = _req({"kind": "cluster_state"})

@@ -21,6 +21,11 @@ def pytest_configure(config):
         "markers",
         "slow: long-running stress/chaos variants excluded from tier-1 "
         "(run with -m slow)")
+    config.addinivalue_line(
+        "markers",
+        "chaos: fault-injection tests (drain/preemption/kill harnesses). "
+        "Fast chaos tests stay inside the tier-1 'not slow' set; stress "
+        "variants are additionally marked slow.")
 
 
 @pytest.fixture(scope="module")

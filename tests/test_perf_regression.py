@@ -91,6 +91,27 @@ def test_log_attribution_disabled_path_overhead(ray_start_regular,
         f"attribution-disabled throughput {200/dt:.0f}/s below floor"
 
 
+def test_drain_watcher_disabled_path_overhead(ray_start_regular,
+                                              monkeypatch):
+    """Drain-subsystem guard: with the preemption watcher off (the
+    default, RTPU_PREEMPTION_WATCHER=0) the drain machinery costs the
+    task round-trip nothing beyond the scheduler's per-node draining-flag
+    check — the same throughput floor as the plain benchmark holds, so
+    drain support can never silently tax a cluster that isn't draining."""
+    monkeypatch.setenv("RTPU_PREEMPTION_WATCHER", "0")
+
+    @ray_tpu.remote
+    def nop():
+        return None
+
+    ray_tpu.get([nop.remote() for _ in range(8)])  # warm the pool
+    t0 = time.perf_counter()
+    ray_tpu.get([nop.remote() for _ in range(200)])
+    dt = time.perf_counter() - t0
+    assert 200 / dt > 30, \
+        f"watcher-disabled task throughput {200/dt:.0f}/s below floor"
+
+
 def test_large_object_bandwidth_floor(ray_start_regular):
     arr = np.ones(4 * 1024 * 1024, dtype=np.float64)  # 32MB
     t0 = time.perf_counter()
