@@ -224,6 +224,16 @@ class Autoscaler:
         )
         for _ in range(target_new):
             self.provider.create_node(dict(cfg.worker_resources))
+        if target_new:
+            from ray_tpu.core import events
+
+            events.emit(
+                "INFO", "AUTOSCALER_SCALE_UP",
+                f"autoscaler launching {target_new} node(s) for "
+                f"{deficit_nodes} unsatisfied demand node(s)",
+                source="autoscaler",
+                data={"new_nodes": target_new,
+                      "resources": dict(cfg.worker_resources)})
 
         # Scale down: managed nodes idle past the timeout (respect min).
         # Drain-before-terminate (reference: the autoscaler's DrainNode
@@ -255,6 +265,14 @@ class Autoscaler:
                      "deadline_s": cfg.drain_deadline_s})
             except Exception:
                 continue  # retry the drain next pass
+            from ray_tpu.core import events
+
+            events.emit(
+                "INFO", "AUTOSCALER_SCALE_DOWN",
+                f"autoscaler draining idle node {node_id[:8]} "
+                f"(idle > {cfg.idle_timeout_s:.0f}s)",
+                source="autoscaler", node_id=node_id,
+                data={"idle_timeout_s": cfg.idle_timeout_s})
             self._draining[tag] = now
             self._idle_since.pop(tag, None)
         # Reap drained nodes: the controller's drain completion shuts the

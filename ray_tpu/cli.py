@@ -146,9 +146,122 @@ def cmd_status(args) -> int:
     from ray_tpu.core import context as ctx
 
     state = ctx.get_worker_context().client.request({"kind": "cluster_state"})
+    # Per-node utilization table (reference: the `ray status` node
+    # report): the controller already holds host CPU%/mem% from agent
+    # heartbeats — surface them instead of burying them in the JSON.
+    # Human output goes to stderr: stdout stays pure JSON so
+    # `rtpu status | jq` keeps working.
+    nodes = state.get("nodes") or []
+    if nodes:
+        print(f"{'NODE':14} {'STATE':10} {'CPU%':>6} {'MEM%':>6} "
+              f"{'WORKERS':>8}  RESOURCES", file=sys.stderr)
+        for n in sorted(nodes, key=lambda n: n.get("index", 0)):
+            st = n.get("state", "alive" if n.get("alive") else "dead")
+            if st in ("draining", "drained") and n.get("drain_reason"):
+                st = f"{st[:4]}:{n['drain_reason'][:5]}"
+            print(f"{n['node_id'][:12]:14} {st:10} "
+                  f"{n.get('cpu_percent') or 0.0:>6.1f} "
+                  f"{(n.get('mem_fraction') or 0.0) * 100:>6.1f} "
+                  f"{n.get('num_workers', 0):>8}  "
+                  f"{json.dumps(n.get('resources', {}))}", file=sys.stderr)
+        print(file=sys.stderr)
     print(json.dumps(state, indent=1, default=str))
+    # Quote recent hang/straggler findings: the watchdog's whole point is
+    # that a silently hung step shows up where operators already look.
+    try:
+        from ray_tpu.util import state as state_api
+
+        hangs = state_api.list_events(
+            kind=["TASK_HUNG", "TASK_STRAGGLER"], limit=5)
+        if hangs:
+            print("\nrecent hang/straggler events "
+                  "(`rtpu events --kind TASK_HUNG` for stacks):",
+                  file=sys.stderr)
+            for ev in hangs:
+                print(f"  {_fmt_event(ev)}", file=sys.stderr)
+    except Exception:
+        pass
     rt.shutdown()
     return 0
+
+
+def _fmt_event(ev, stacks: bool = False) -> str:
+    """One human line per cluster event (the `rtpu events` row shape)."""
+    t = time.strftime("%H:%M:%S", time.localtime(ev.get("ts", 0)))
+    ids = " ".join(
+        f"{k.split('_')[0]}={ev[k][:12]}"
+        for k in ("task_id", "actor_id", "worker_id", "node_id")
+        if ev.get(k))
+    line = (f"[{t}] {ev.get('severity', 'INFO'):7} "
+            f"{ev.get('kind', '?'):22} {ev.get('message', '')}"
+            + (f"  ({ids})" if ids else ""))
+    stack = (ev.get("data") or {}).get("stack")
+    if stacks and stack:
+        indented = "\n".join("    " + ln for ln in stack.splitlines())
+        line += f"\n{indented}"
+    return line
+
+
+def cmd_events(args) -> int:
+    """`rtpu events` (reference: `ray list cluster-events`): the cluster
+    event feed — node/actor/task lifecycle, autoscaler decisions, and the
+    hang watchdog's TASK_HUNG/TASK_STRAGGLER findings (--stacks prints
+    their captured all-thread stacks). --follow streams new events."""
+    rt = _connect(args)
+    from ray_tpu.util import state
+
+    sel = dict(severity=args.severity, kind=args.kind or None,
+               task_id=args.task_id, actor_id=args.actor_id,
+               node_id=args.node, worker_id=args.worker_id)
+    # With an id filter the stacks are usually what you came for.
+    stacks = args.stacks or bool(args.task_id or args.actor_id)
+    try:
+        if args.follow:
+            try:
+                for ev in state.follow_events(**sel):
+                    print(_fmt_event(ev, stacks=stacks), flush=True)
+            except KeyboardInterrupt:
+                pass
+            return 0
+        since = time.time() - args.since if args.since else None
+        events = state.list_events(**sel, since=since, limit=args.limit)
+        for ev in events:
+            print(_fmt_event(ev, stacks=stacks))
+        if not events:
+            print("no matching events")
+        return 0
+    finally:
+        rt.shutdown()
+
+
+def cmd_stack(args) -> int:
+    """`rtpu stack` (reference: `ray stack`): on-demand all-thread stack
+    dump from live workers, over the same profile_workers fan-out the
+    dashboard and the hang watchdog use. Filter with --worker-id / --node
+    (id prefixes)."""
+    rt = _connect(args)
+    from ray_tpu.util import state
+
+    try:
+        res = state.profile_workers(timeout=args.timeout)
+        workers = res.get("workers", {})
+        if args.node:
+            rows = state.list_workers()
+            on_node = {w["worker_id"] for w in rows
+                       if (w.get("node_id") or "").startswith(args.node)}
+            workers = {w: t for w, t in workers.items() if w in on_node}
+        if args.worker_id:
+            workers = {w: t for w, t in workers.items()
+                       if w.startswith(args.worker_id)}
+        for wid, text in sorted(workers.items()):
+            print(f"=== worker {wid} ===")
+            print(text)
+        print(f"{len(workers)} worker(s) answered "
+              f"({res.get('requested', 0)} asked; busy-in-native-code "
+              f"workers miss the window)")
+        return 0
+    finally:
+        rt.shutdown()
 
 
 def cmd_summary(args) -> int:
@@ -505,6 +618,44 @@ def main(argv=None) -> int:
     p.add_argument("--tail", type=int, default=0,
                    help="only the last N lines")
     p.set_defaults(fn=cmd_logs)
+
+    p = sub.add_parser("events", help="cluster event feed (lifecycle + "
+                                      "hang-watchdog findings)")
+    p.add_argument("--address", default=None)
+    p.add_argument("--severity", default=None,
+                   choices=["DEBUG", "INFO", "WARNING", "ERROR"],
+                   help="minimum severity to show")
+    p.add_argument("--kind", action="append", default=None,
+                   help="event kind filter (repeatable), e.g. TASK_HUNG, "
+                        "NODE_DIED, ACTOR_RESTARTING")
+    p.add_argument("--task-id", default=None,
+                   help="events for this task id (prefix ok)")
+    p.add_argument("--actor-id", default=None,
+                   help="events for this actor id (prefix ok)")
+    p.add_argument("--node", default=None,
+                   help="events for this node id (prefix ok)")
+    p.add_argument("--worker-id", default=None,
+                   help="events for this worker id (prefix ok)")
+    p.add_argument("--since", type=float, default=0.0, metavar="S",
+                   help="only events from the last S seconds")
+    p.add_argument("--limit", type=int, default=100)
+    p.add_argument("--follow", "-f", action="store_true",
+                   help="stream new events live (ctrl-c to stop)")
+    p.add_argument("--stacks", action="store_true",
+                   help="print captured stacks attached to hang events "
+                        "(implied by --task-id/--actor-id)")
+    p.set_defaults(fn=cmd_events)
+
+    p = sub.add_parser("stack", help="all-thread stack dump from live "
+                                     "workers (`ray stack` analog)")
+    p.add_argument("--address", default=None)
+    p.add_argument("--worker-id", default=None,
+                   help="only this worker (id prefix)")
+    p.add_argument("--node", default=None,
+                   help="only workers on this node (id prefix)")
+    p.add_argument("--timeout", type=float, default=2.0,
+                   help="seconds to wait for worker replies")
+    p.set_defaults(fn=cmd_stack)
 
     p = sub.add_parser("drain", help="gracefully drain a node "
                                      "(migrate actors, re-queue tasks, "

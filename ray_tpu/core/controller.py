@@ -123,6 +123,9 @@ class NodeInfo:
     # Host memory usage fraction (agent heartbeats / controller psutil for
     # local nodes); drives the memory monitor's kill decisions.
     mem_fraction: float = 0.0
+    # Host CPU utilization percent (agent heartbeats; local nodes sample
+    # at cluster_state time) — the `rtpu status` CPU% column.
+    cpu_percent: float = 0.0
     # Unallocated TPU chip ids on locally-spawned (agent-less) nodes: the
     # unit-instance side of the "TPU" float resource (reference: per-instance
     # GPU accounting, resource_instance_set.h). Agent-managed nodes track
@@ -380,6 +383,19 @@ class Controller:
         self.persist_path = flags.get("RTPU_STATE_PATH")
         self._state_dirty = False
         self._restore_state()
+        # Cluster event log (reference: `ray list cluster-events` + the
+        # dashboard event feed): bounded ring + JSONL persistence next to
+        # the state snapshot, so the feed survives a controller bounce.
+        from .events import EventLog
+
+        self.events = EventLog(
+            maxlen=flags.get("RTPU_EVENTS_MAX"),
+            persist_path=(self.persist_path + ".events.jsonl")
+            if self.persist_path else None)
+        # Hang-watchdog de-dup: task ids already reported this incarnation
+        # (a hung task yields ONE event, not one per sweep).
+        self._hang_reported: Set[str] = set()
+        self._watchdog_task: Optional[asyncio.Task] = None
 
     # ------------------------------------------------------------------ setup
 
@@ -401,6 +417,10 @@ class Controller:
             loop.create_task(_resume_after_grace())
         if flags.get("RTPU_MEMORY_MONITOR"):
             self._memory_task = loop.create_task(self._memory_monitor_loop())
+        if flags.get("RTPU_HANG_WATCHDOG") and flags.get("RTPU_EVENTS"):
+            # Off => no task, no per-sweep work: the disabled-path perf
+            # floor is literally zero controller cycles.
+            self._watchdog_task = loop.create_task(self._hang_watchdog_loop())
         # Resume drains interrupted by a controller bounce: restored
         # (non-agent) nodes become unschedulable immediately, but the
         # drain task itself waits out the reconnect grace — the node's
@@ -458,6 +478,11 @@ class Controller:
             tpu_free=list(range(int(resources.get("TPU", 0)))),
         )
         self._state_dirty = True  # node table persists across restarts
+        if getattr(self, "events", None) is not None:
+            self._emit_event(
+                "INFO", "NODE_ADDED",
+                f"node {nid[:8]} joined with {resources}",
+                node_id=nid, data={"resources": dict(resources)})
         self._wake_scheduler()
         return nid
 
@@ -530,6 +555,8 @@ class Controller:
             self._health_task.cancel()
         if getattr(self, "_memory_task", None) is not None:
             self._memory_task.cancel()
+        if self._watchdog_task is not None:
+            self._watchdog_task.cancel()
         if getattr(self, "_metrics_server", None) is not None:
             self._metrics_server.close()
         if self.server is not None:
@@ -595,6 +622,21 @@ class Controller:
             self._state_dirty = True
         self._export_event("NODE", {"node_id": node.node_id,
                                     "event": "dead", "ts": time.time()})
+        if node.drained:
+            self._emit_event(
+                "INFO", "NODE_REMOVED",
+                f"node {node.node_id[:8]} left after draining "
+                f"({node.drain_reason or 'drain'})",
+                node_id=node.node_id,
+                data={"reason": node.drain_reason})
+        else:
+            self._emit_event(
+                "ERROR", "NODE_DIED",
+                f"node {node.node_id[:8]} died "
+                f"({len(node.workers)} worker(s) lost)",
+                node_id=node.node_id,
+                data={"workers": len(node.workers),
+                      "host_id": node.host_id})
         node.agent_conn = None
         node.agent_addr = None
         for wid in list(node.workers):
@@ -684,8 +726,31 @@ class Controller:
         # the crashed process's stderr in RayTaskError / ActorDiedError):
         # fetched only when the death actually fails user work.
         detail = ""
+        _node = self.nodes.get(w.node_id)
+        _planned = _node is not None and (_node.draining or _node.drained)
         if (w.current_task and w.current_task in self.tasks) or w.actor_ids:
             detail = await self._worker_exit_detail(w)
+            if w.oom_killed:
+                # Worker-OOM post-mortem (PR 3's log-tail fetch) as a
+                # first-class cluster event: the kill decision, victim,
+                # and the crashed process's last log lines in one record.
+                self._emit_event(
+                    "ERROR", "WORKER_OOM",
+                    f"worker {w.worker_id[:8]} on node {w.node_id[:8]} "
+                    f"was killed by the memory monitor while running "
+                    f"{(self.tasks.get(w.current_task or '') or {}).get('label') or 'actor work'}",
+                    worker_id=w.worker_id, node_id=w.node_id,
+                    task_id=w.current_task,
+                    data={"log_tail": detail.strip()})
+            elif not _planned:
+                self._emit_event(
+                    "ERROR", "WORKER_DIED",
+                    f"worker {w.worker_id[:8]} on node {w.node_id[:8]} "
+                    f"died with work in flight",
+                    worker_id=w.worker_id, node_id=w.node_id,
+                    task_id=w.current_task,
+                    data={"actors": len(w.actor_ids),
+                          "log_tail": detail.strip()})
         node = self.nodes.get(w.node_id)
         if node:
             node.workers.discard(w.worker_id)
@@ -811,9 +876,28 @@ class Controller:
         spec["state"] = "pending"
         spec.pop("sched_node", None)
         spec.pop("blocked", None)
+        spec.pop("__dispatch_ts", None)
         self.tasks[spec["task_id"]] = spec
         self.pending_queue.append(spec)
         self._record_task_event(spec, "retry")
+        if preempted:
+            self._emit_event(
+                "WARNING", "TASK_PREEMPTED",
+                f"task {spec.get('label') or spec['task_id'][:8]} "
+                f"re-queued after planned node departure "
+                f"(no retry budget consumed)",
+                task_id=spec["task_id"],
+                data={"label": spec.get("label")})
+        else:
+            self._emit_event(
+                "WARNING", "TASK_RETRY",
+                f"task {spec.get('label') or spec['task_id'][:8]} "
+                f"re-queued after worker/node failure "
+                f"(retry {spec.get('_retry_count', 0)}/"
+                f"{spec.get('max_retries', 0)})",
+                task_id=spec["task_id"],
+                data={"label": spec.get("label"),
+                      "retry": spec.get("_retry_count", 0)})
         self._wake_scheduler()
         return True
 
@@ -845,6 +929,16 @@ class Controller:
         self._export_event("ACTOR", {"actor_id": actor.actor_id,
                                      "event": "restarting",
                                      "ts": time.time()})
+        self._emit_event(
+            "WARNING", "ACTOR_RESTARTING",
+            f"actor {actor.name or actor.actor_id[:8]} restarting after "
+            f"{'preemption' if preempted else 'crash'}: {err} "
+            f"(restart {actor.restart_count}/{actor.max_restarts})",
+            actor_id=actor.actor_id, node_id=actor.node_id,
+            worker_id=actor.worker_id,
+            data={"cause": f"{type(err).__name__}: {err}",
+                  "preempted": preempted,
+                  "restarts": actor.restart_count})
         # Fail calls already forwarded to the dead worker — but NOT calls
         # still buffered in pending_calls (never dispatched): those replay
         # after restart, and erroring them here would double-signal.
@@ -1051,6 +1145,12 @@ class Controller:
                                          "name": actor.name,
                                          "node_id": actor.node_id,
                                          "ts": time.time()})
+            self._emit_event(
+                "INFO", "ACTOR_ADOPTED",
+                f"actor {actor.name or actor.actor_id[:8]} re-claimed by "
+                f"its surviving worker after a controller bounce",
+                actor_id=actor.actor_id, node_id=actor.node_id,
+                worker_id=actor.worker_id, data={"name": actor.name})
         return drop
 
     def _release_env_spawn(self, node: Optional[NodeInfo], token: str) -> None:
@@ -1787,6 +1887,12 @@ class Controller:
         self.actors[actor_id] = actor
         if actor.detached:
             self._state_dirty = True
+        self._emit_event(
+            "INFO", "ACTOR_CREATED",
+            f"actor {name or actor_id[:8]} creation submitted"
+            + (" (detached)" if actor.detached else ""),
+            actor_id=actor_id,
+            data={"name": name, "detached": actor.detached})
         spec["is_actor_creation"] = True
         self.tasks[spec["task_id"]] = spec
         await self._resolve_deps_then_queue(spec)
@@ -1819,6 +1925,12 @@ class Controller:
                                      "event": "alive", "name": actor.name,
                                      "node_id": actor.node_id,
                                      "ts": time.time()})
+        self._emit_event(
+            "INFO", "ACTOR_ALIVE",
+            f"actor {actor.name or actor.actor_id[:8]} alive on node "
+            f"{(actor.node_id or '?')[:8]}",
+            actor_id=actor.actor_id, node_id=actor.node_id,
+            worker_id=actor.worker_id, data={"name": actor.name})
         return {"ok": True}
 
     async def _h_actor_exit(self, conn, msg):
@@ -1897,6 +2009,7 @@ class Controller:
                 self._fail_task(spec, err)
                 return
             spec["sched_node"] = actor.node_id
+            spec["__dispatch_ts"] = time.time()  # hang-watchdog age base
             self._record_task_event(spec, "running", worker_id=w.worker_id,
                                     node_id=actor.node_id)
             await w.conn.send({"kind": "execute_actor_task", "spec": spec})
@@ -2062,6 +2175,13 @@ class Controller:
         actor.state = "dead"
         self._export_event("ACTOR", {"actor_id": actor.actor_id,
                                      "event": "dead", "ts": time.time()})
+        self._emit_event(
+            "ERROR", "ACTOR_DIED",
+            f"actor {actor.name or actor.actor_id[:8]} died: {err}",
+            actor_id=actor.actor_id, node_id=actor.node_id,
+            worker_id=actor.worker_id,
+            data={"name": actor.name, "cause": f"{type(err).__name__}: "
+                  f"{err}", "restarts": actor.restart_count})
         if actor.detached:
             self._state_dirty = True
         actor.creation_error = actor.creation_error or err
@@ -2086,6 +2206,12 @@ class Controller:
         self.pgs[pg_id] = pg
         if pg.name:
             self.named_pgs[pg.name] = pg_id
+        self._emit_event(
+            "INFO", "PG_CREATED",
+            f"placement group {pg.name or pg_id[:8]} requested "
+            f"({len(pg.bundles)} bundles, {pg.strategy})",
+            data={"placement_group_id": pg_id, "strategy": pg.strategy,
+                  "bundles": len(pg.bundles)})
         self._try_reserve_pg(pg)
         self._wake_scheduler()
         return {"ok": True}
@@ -2113,6 +2239,10 @@ class Controller:
         pg.state = "removed"
         if pg.name:
             self.named_pgs.pop(pg.name, None)
+        self._emit_event(
+            "INFO", "PG_REMOVED",
+            f"placement group {pg.name or pg.pg_id[:8]} removed",
+            data={"placement_group_id": pg.pg_id})
         self._wake_scheduler()
         return {"ok": True}
 
@@ -2155,6 +2285,12 @@ class Controller:
             _res_sub(self.nodes[nid].available, b.resources)
         pg.state = "ready"
         pg.ready_event.set()
+        self._emit_event(
+            "INFO", "PG_READY",
+            f"placement group {pg.name or pg.pg_id[:8]} reserved on "
+            f"{len(set(assignment))} node(s)",
+            data={"placement_group_id": pg.pg_id,
+                  "bundle_nodes": assignment})
 
     # kv / pubsub / introspection ---------------------------------------------
 
@@ -2472,6 +2608,12 @@ class Controller:
         self._export_event("NODE", {"node_id": node.node_id,
                                     "event": "draining", "reason": reason,
                                     "ts": time.time()})
+        self._emit_event(
+            "WARNING", "NODE_DRAINING",
+            f"node {node.node_id[:8]} draining (reason={reason}, "
+            f"deadline in {max(0.0, deadline - time.time()):.1f}s)",
+            node_id=node.node_id,
+            data={"reason": reason, "deadline": deadline})
         self._arm_drain(node)
         return {"ok": True, "node_id": node.node_id, "state": "draining"}
 
@@ -2546,6 +2688,13 @@ class Controller:
                                      "event": "migrating",
                                      "node_id": node.node_id,
                                      "ts": time.time()})
+        self._emit_event(
+            "INFO", "ACTOR_MIGRATING",
+            f"actor {actor.name or actor.actor_id[:8]} migrating off "
+            f"draining node {node.node_id[:8]}",
+            actor_id=actor.actor_id, node_id=node.node_id,
+            data={"name": actor.name,
+                  "reason": node.drain_reason})
         w = self.workers.get(actor.worker_id or "")
         blob = None
         if w is not None:
@@ -2651,6 +2800,11 @@ class Controller:
                                     "event": "drained",
                                     "reason": node.drain_reason,
                                     "ts": time.time()})
+        self._emit_event(
+            "INFO", "NODE_DRAINED",
+            f"node {node.node_id[:8]} drained "
+            f"(reason={node.drain_reason or 'manual'})",
+            node_id=node.node_id, data={"reason": node.drain_reason})
         for wid in list(node.workers):
             w = self.workers.get(wid)
             if w is not None:
@@ -2766,6 +2920,172 @@ class Controller:
         limit = int(msg.get("limit", 10000))
         return spans[-limit:]
 
+    # --------------------------------------------------- cluster event log
+    # Reference: the cluster-event framework (`ray list cluster-events`,
+    # gcs_ray_event_converter.h, the dashboard event feed) — lifecycle
+    # transitions as structured, filterable, followable records.
+
+    def _emit_event(self, severity: str, kind: str, message: str,
+                    **entities) -> None:
+        """One controller-side cluster event (no-op when RTPU_EVENTS=0)."""
+        if not flags.get("RTPU_EVENTS"):
+            return
+        try:
+            self.events.emit(severity, kind, message, **entities)
+        except Exception:
+            pass  # the event feed must never hurt the control plane
+
+    async def _h_get_events(self, conn, msg):
+        """Filtered (and optionally long-polled) read of the cluster event
+        ring: severity is a minimum level, kinds match exactly, entity ids
+        match on prefix, `after_seq` is the follow cursor. Returns
+        {events, seq} where seq is the cursor for the next follow poll."""
+        kinds = msg.get("kinds")
+        if isinstance(kinds, str):
+            kinds = [kinds]
+        sel = dict(
+            severity=msg.get("severity"), kinds=kinds,
+            task_id=msg.get("task_id"), actor_id=msg.get("actor_id"),
+            node_id=msg.get("node_id"), worker_id=msg.get("worker_id"),
+            since=msg.get("since"), after_seq=msg.get("after_seq"),
+            limit=int(msg.get("limit", 1000)))
+        evs = self.events.query(**sel)
+        wait_s = float(msg.get("wait_s") or 0)
+        if not evs and wait_s > 0:
+            await self.events.wait_for_new(wait_s)
+            evs = self.events.query(**sel)
+        return {"events": evs, "seq": self.events.seq}
+
+    async def _h_cluster_events(self, conn, msg):
+        """Batched events shipped by workers/drivers (events._Shipper) and
+        host agents (heartbeat-path flush) — merged into the same ring the
+        controller's own emit sites feed."""
+        if flags.get("RTPU_EVENTS"):
+            for ev in msg.get("events", ()):
+                if isinstance(ev, dict) and ev.get("kind"):
+                    self.events.append(dict(ev))
+        return {"ok": True}
+
+    # ------------------------------------------------- hang/straggler watchdog
+    # Reference failure mode (LlamaRL): at scale the dominant outage is a
+    # SILENTLY hung step — one straggler blocking a collective. The
+    # controller already derives per-label exec-latency histograms from the
+    # flight recorder (PR 2); this loop closes the loop by using them to
+    # DETECT anomalies: any running task older than
+    # max(RTPU_HANG_MIN_S, RTPU_HANG_P99_FACTOR x label-p99) is flagged,
+    # and the existing stack_dump worker RPC fires automatically so the
+    # event carries every thread's stack — a hung collective shows all
+    # members blocked at the same frame without anyone ssh'ing anywhere.
+
+    async def _hang_watchdog_loop(self) -> None:
+        while True:
+            await asyncio.sleep(flags.get("RTPU_HANG_POLL_S"))
+            try:
+                await self._hang_sweep()
+            except Exception as e:  # pragma: no cover — keep watching
+                sys.stderr.write(f"[controller] hang watchdog error: "
+                                 f"{e!r}\n")
+
+    def _label_exec_p99(self, label: str) -> Tuple[float, int]:
+        """(p99 seconds, observation count) of the label's exec-latency
+        histogram — the PR 2 flight-recorder rtpu_task_exec_s series."""
+        st = self.app_metrics.get(PHASE_METRIC_NAMES["exec_s"])
+        if not st:
+            return 0.0, 0
+        h = st["data"].get((("label", label),))
+        if not h or not h.get("count"):
+            return 0.0, 0
+        return _hist_quantile(st["boundaries"], h, 0.99), int(h["count"])
+
+    def _hang_threshold(self, label: str) -> Tuple[float, bool]:
+        """(threshold seconds, has_history): the cutoff a running task of
+        this label may age to before it is flagged. With label history the
+        task is a STRAGGLER (slow relative to its peers); without any
+        completions to compare against it is simply HUNG."""
+        floor = float(flags.get("RTPU_HANG_MIN_S"))
+        p99, count = self._label_exec_p99(label)
+        if count >= 5 and p99 > 0:
+            return max(floor, float(flags.get("RTPU_HANG_P99_FACTOR"))
+                       * p99), True
+        return floor, False
+
+    async def _hang_sweep(self) -> None:
+        now = time.time()
+        # __dispatch_ts exists exactly while a spec is out on a worker:
+        # stamped at dispatch, popped on every re-queue path.
+        running = [
+            spec for spec in list(self.tasks.values())
+            if spec.get("__dispatch_ts")
+        ]
+        live = {s["task_id"] for s in running}
+        # De-dup set self-cleans: ids of finished/retired tasks drop out,
+        # so a task that re-queues (retry) can be flagged again.
+        self._hang_reported &= live
+        for spec in running:
+            tid = spec["task_id"]
+            if tid in self._hang_reported:
+                continue
+            age = now - float(spec["__dispatch_ts"])
+            label = spec.get("label") or "?"
+            threshold, has_history = self._hang_threshold(label)
+            if age < threshold:
+                continue
+            self._hang_reported.add(tid)
+            w = self._executing_worker(spec)
+            stack = ""
+            if w is not None:
+                stack = await self._stack_dump_worker(w)
+            kind = "TASK_STRAGGLER" if has_history else "TASK_HUNG"
+            what = ("actor call (mailbox stalled)"
+                    if spec.get("actor_id") else "task")
+            self._emit_event(
+                "WARNING" if has_history else "ERROR", kind,
+                f"{what} {label!r} ({tid[:8]}) has been running "
+                f"{age:.1f}s on worker "
+                f"{(w.worker_id[:8] if w else '?')} / node "
+                f"{(w.node_id[:8] if w else '?')} "
+                f"(threshold {threshold:.1f}s"
+                + (f", label p99-based" if has_history else "")
+                + "); all-thread stacks attached",
+                task_id=tid, actor_id=spec.get("actor_id"),
+                worker_id=w.worker_id if w else None,
+                node_id=w.node_id if w else spec.get("sched_node"),
+                data={"age_s": age, "threshold_s": threshold,
+                      "label": label, "stack": stack})
+
+    def _executing_worker(self, spec: Dict[str, Any]) -> Optional[WorkerInfo]:
+        aid = spec.get("actor_id")
+        if aid and not spec.get("is_actor_creation"):
+            actor = self.actors.get(aid)
+            if actor is not None:
+                return self.workers.get(actor.worker_id or "")
+            return None
+        tid = spec["task_id"]
+        for w in self.workers.values():
+            if w.current_task == tid or (spec.get("is_actor_creation")
+                                         and aid in w.actor_ids):
+                return w
+        return None
+
+    async def _stack_dump_worker(self, w: WorkerInfo,
+                                 timeout: float = 3.0) -> str:
+        """Targeted stack_dump on ONE worker (the fan-out variant is
+        _h_profile_workers); same partial-result contract — a worker stuck
+        in native code misses the window and the event ships without the
+        stack rather than never."""
+        req_id = uuid.uuid4().hex[:12]
+        self._profiles[req_id] = {}
+        try:
+            await w.conn.send({"kind": "stack_dump", "req_id": req_id})
+        except Exception:
+            self._profiles.pop(req_id, None)
+            return ""
+        deadline = time.monotonic() + timeout
+        while (not self._profiles.get(req_id)
+               and time.monotonic() < deadline):
+            await asyncio.sleep(0.05)
+        return (self._profiles.pop(req_id, None) or {}).get(w.worker_id, "")
+
     def _metrics_text(self) -> str:
         """Prometheus text exposition (reference: _private/metrics_agent.py
         + ray_metrics_export — collapsed to a controller-local scrape)."""
@@ -2852,6 +3172,38 @@ class Controller:
                          "log files per node")
             lines.append("# TYPE rtpu_worker_log_bytes gauge")
             lines.extend(log_lines)
+        # Cluster-event accounting (core/events.py EventLog counters).
+        if getattr(self, "events", None) is not None and self.events.counts:
+            lines.append("# HELP rtpu_events_total Cluster events "
+                         "recorded, by source and severity")
+            lines.append("# TYPE rtpu_events_total counter")
+            for (source, severity), n in sorted(self.events.counts.items()):
+                lines.append(
+                    f'rtpu_events_total{{source="{source}",'
+                    f'severity="{severity}"}} {n}')
+        # Per-worker-process cpu/rss from host-agent heartbeats (dashboard
+        # reporter parity, now scrapeable + grafana-panelled).
+        cpu_lines, rss_lines = [], []
+        for n in self.nodes.values():
+            if not n.alive:
+                continue
+            for pid, st in sorted(n.proc_stats.items()):
+                node_l = n.node_id[:12]
+                cpu_lines.append(
+                    f'rtpu_worker_cpu_percent{{node="{node_l}",'
+                    f'pid="{pid}"}} {st.get("cpu_percent", 0.0)}')
+                rss_lines.append(
+                    f'rtpu_worker_rss_bytes{{node="{node_l}",'
+                    f'pid="{pid}"}} {st.get("rss", 0.0)}')
+        if cpu_lines:
+            lines.append("# HELP rtpu_worker_cpu_percent Worker process "
+                         "CPU percent (host-agent heartbeats)")
+            lines.append("# TYPE rtpu_worker_cpu_percent gauge")
+            lines.extend(cpu_lines)
+            lines.append("# HELP rtpu_worker_rss_bytes Worker process "
+                         "resident set size (host-agent heartbeats)")
+            lines.append("# TYPE rtpu_worker_rss_bytes gauge")
+            lines.extend(rss_lines)
         # Control-plane RPC accounting (protocol.py handler stats): count +
         # cumulative handler seconds per message kind.
         rpc = protocol.handler_stats()
@@ -2920,6 +3272,17 @@ class Controller:
                 pass
 
     async def _h_cluster_state(self, conn, msg):
+        # Local (agent-less) nodes share the controller's host: sample its
+        # cpu/mem ONCE per call so `rtpu status` surfaces node-level
+        # numbers for them too (agent nodes report via heartbeat).
+        local_cpu = local_mem = None
+        try:
+            import psutil
+
+            local_cpu = psutil.cpu_percent(None)
+            local_mem = psutil.virtual_memory().percent / 100.0
+        except Exception:
+            pass
         return {
             "nodes": [
                 {
@@ -2934,7 +3297,16 @@ class Controller:
                     "drain_reason": n.drain_reason,
                     "index": n.index,
                     "num_workers": len(n.workers),
-                    "mem_fraction": n.mem_fraction,
+                    "mem_fraction": (
+                        n.mem_fraction if n.agent_conn is not None
+                        else (local_mem if local_mem is not None
+                              else n.mem_fraction)),
+                    # Host CPU% (heartbeats for agent nodes, sampled here
+                    # for local ones) — the `rtpu status` CPU column.
+                    "cpu_percent": (
+                        n.cpu_percent if n.agent_conn is not None
+                        else (local_cpu if local_cpu is not None
+                              else n.cpu_percent)),
                     # Unallocated chip ids (local-spawn nodes): chaos tests
                     # assert free-pool/granted disjointness across restarts.
                     "tpu_free": list(n.tpu_free),
@@ -2991,6 +3363,10 @@ class Controller:
             for a in self.actors.values():
                 if a.reserved and a.node_id == nid and a.pg is None:
                     _res_sub(node.available, a.resources)
+            self._emit_event(
+                "INFO", "NODE_RECONNECTED",
+                f"node {nid[:8]} re-registered after a bounce",
+                node_id=nid, data={"host_id": node.host_id})
             if nid in self.pending_drains:
                 # The drain outlived a controller bounce: the re-registered
                 # node resumes draining with its original deadline.
@@ -3008,6 +3384,13 @@ class Controller:
                 host_id=msg.get("host_id"),
                 last_heartbeat=time.monotonic(),
             )
+            self._emit_event(
+                "INFO", "NODE_ADDED",
+                f"node {nid[:8]} joined with {msg['resources']} "
+                f"(host agent)",
+                node_id=nid,
+                data={"resources": dict(msg["resources"]),
+                      "host_id": msg.get("host_id")})
         self._wake_scheduler()
         return {"ok": True, "controller_host_id": self.host_id}
 
@@ -3018,6 +3401,8 @@ class Controller:
             node.arena_stats = msg.get("arena") or {}
             if msg.get("mem_fraction") is not None:
                 node.mem_fraction = float(msg["mem_fraction"])
+            if msg.get("cpu_percent") is not None:
+                node.cpu_percent = float(msg["cpu_percent"])
             if msg.get("proc_stats") is not None:
                 node.proc_stats = msg["proc_stats"]
             if msg.get("log_bytes") is not None:
@@ -3042,6 +3427,14 @@ class Controller:
         if msg.get("env_failed"):
             # The agent could not materialize the runtime env: fail the
             # queued tasks rather than retrying the broken install forever.
+            self._emit_event(
+                "ERROR", "RUNTIME_ENV_FAILED",
+                f"runtime env build failed on node "
+                f"{(node_id or '?')[:8]}: "
+                f"{msg.get('env_error') or 'setup failed'}",
+                node_id=node_id,
+                data={"env_hash": msg["env_failed"],
+                      "error": msg.get("env_error")})
             self._fail_env_tasks(
                 msg["env_failed"],
                 RuntimeError(msg.get("env_error") or "runtime env setup failed"),
@@ -4048,6 +4441,9 @@ class Controller:
             self._wake_scheduler()
 
     async def _dispatch(self, spec: Dict[str, Any], node: NodeInfo, w: WorkerInfo) -> None:
+        # Wall-clock dispatch stamp: the hang watchdog ages running work
+        # against it (wall clock so it stays meaningful across a bounce).
+        spec["__dispatch_ts"] = time.time()
         self._record_task_event(spec, "running", worker_id=w.worker_id,
                                 node_id=node.node_id)
         if spec.get("is_actor_creation"):

@@ -1,0 +1,309 @@
+"""Cluster event subsystem: structured control-plane events.
+
+Role-equivalent to the reference's cluster-event framework (ray:
+src/ray/gcs/gcs_server/gcs_ray_event_converter.h + the
+``ray list cluster-events`` state API and the dashboard event feed): node,
+actor, task, placement-group, and autoscaler lifecycle transitions become
+structured records — (ts, severity, source, kind, entity ids, message,
+data) — instead of lines scattered through the controller's stderr.
+
+Three pieces live here:
+
+- :func:`make_event` — the one record shape every producer emits.
+- :class:`EventLog` — the controller-side store: a bounded ring served by
+  the ``get_events`` RPC (severity/kind/entity/since filters plus
+  long-poll follow), JSONL persistence alongside ``--state-path`` so the
+  feed survives a controller bounce, and per-(source, severity) counters
+  feeding the ``rtpu_events_total`` metric.
+- a worker/driver-side shipper — :func:`emit` buffers events in a bounded
+  deque and a daemon flusher ships batches over the process's
+  reconnecting control connection (the same reconnect-safe pattern as
+  ``task_events.py``: a batch in flight when the controller dies delivers
+  to the restarted controller). Host agents ship their events themselves
+  on the heartbeat path (they hold a raw protocol connection, not a
+  CoreClient).
+
+Everything is gated on ``RTPU_EVENTS``: when off, emit sites pay one flag
+check and nothing is stored, persisted, or shipped.
+"""
+from __future__ import annotations
+
+import collections
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from ray_tpu import flags
+
+# Severity ladder (reference: event.proto severity levels). Filters treat
+# a requested severity as the MINIMUM level to return.
+SEVERITIES = ("DEBUG", "INFO", "WARNING", "ERROR")
+_SEV_RANK = {s: i for i, s in enumerate(SEVERITIES)}
+
+
+def severity_rank(severity: Optional[str]) -> int:
+    """Rank for min-severity filtering; unknown severities rank as INFO."""
+    return _SEV_RANK.get((severity or "INFO").upper(), 1)
+
+
+def enabled() -> bool:
+    return bool(flags.get("RTPU_EVENTS"))
+
+
+def make_event(severity: str, source: str, kind: str, message: str, *,
+               node_id: Optional[str] = None,
+               worker_id: Optional[str] = None,
+               actor_id: Optional[str] = None,
+               task_id: Optional[str] = None,
+               data: Optional[Dict[str, Any]] = None,
+               ts: Optional[float] = None) -> Dict[str, Any]:
+    """One structured cluster event. ``kind`` is a stable SCREAMING_SNAKE
+    identifier (NODE_DIED, TASK_HUNG, ...); ``message`` is the human line;
+    ``data`` carries kind-specific payload (e.g. the captured stack)."""
+    return {
+        "ts": ts if ts is not None else time.time(),
+        "severity": (severity or "INFO").upper(),
+        "source": source,
+        "kind": kind,
+        "message": message,
+        "node_id": node_id,
+        "worker_id": worker_id,
+        "actor_id": actor_id,
+        "task_id": task_id,
+        "data": data or {},
+    }
+
+
+class EventLog:
+    """Controller-side event store: bounded ring + JSONL persistence.
+
+    Events get a monotonically increasing ``seq`` — the follow cursor for
+    ``get_events(after_seq=...)`` long-polls. With a persist path, every
+    event appends one JSON line and a restart reloads the ring tail (seq
+    continues from the persisted maximum, so follower cursors stay valid
+    across a controller bounce).
+    """
+
+    def __init__(self, maxlen: int = 10000,
+                 persist_path: Optional[str] = None):
+        self.ring: "collections.deque[Dict[str, Any]]" = collections.deque(
+            maxlen=max(16, int(maxlen)))
+        self.persist_path = persist_path
+        self.seq = 0
+        # (source, severity) -> count since start/restore: the
+        # rtpu_events_total{source,severity} counter.
+        self.counts: Dict[tuple, int] = {}
+        self._file: Any = None  # lazily opened; False = disabled on error
+        # Follow waiters: asyncio.Events set (once each) on every append.
+        self._waiters: List[Any] = []
+        self._restore()
+
+    # ------------------------------------------------------------ persistence
+
+    def _restore(self) -> None:
+        if not self.persist_path or not os.path.exists(self.persist_path):
+            return
+        tail: "collections.deque[str]" = collections.deque(
+            maxlen=self.ring.maxlen)
+        try:
+            with open(self.persist_path, "r", encoding="utf-8",
+                      errors="replace") as f:
+                for line in f:
+                    if line.strip():
+                        tail.append(line)
+        except OSError:
+            return
+        for line in tail:
+            try:
+                ev = json.loads(line)
+            except ValueError:
+                continue  # torn write at the kill point: skip the line
+            if not isinstance(ev, dict) or "kind" not in ev:
+                continue
+            self.seq = max(self.seq, int(ev.get("seq", 0)))
+            self.ring.append(ev)
+            key = (ev.get("source", "?"), ev.get("severity", "INFO"))
+            self.counts[key] = self.counts.get(key, 0) + 1
+
+    def _persist(self, ev: Dict[str, Any]) -> None:
+        if not self.persist_path:
+            return
+        if self._file is None:
+            try:
+                self._file = open(self.persist_path, "a", buffering=1,
+                                  encoding="utf-8")
+            except OSError:
+                self._file = False
+        if self._file is False:
+            return
+        try:
+            self._file.write(json.dumps(ev, default=str) + "\n")
+        except Exception:
+            self._file = False  # never let the event feed hurt the plane
+
+    # ----------------------------------------------------------------- append
+
+    def append(self, ev: Dict[str, Any]) -> Dict[str, Any]:
+        self.seq += 1
+        ev["seq"] = self.seq
+        self.ring.append(ev)
+        key = (ev.get("source", "?"), ev.get("severity", "INFO"))
+        self.counts[key] = self.counts.get(key, 0) + 1
+        self._persist(ev)
+        waiters, self._waiters = self._waiters, []
+        for w in waiters:
+            try:
+                w.set()
+            except Exception:
+                pass
+        return ev
+
+    def emit(self, severity: str, kind: str, message: str,
+             source: str = "controller", **entities: Any) -> None:
+        if not enabled():
+            return
+        self.append(make_event(severity, source, kind, message, **entities))
+
+    # ------------------------------------------------------------------ query
+
+    def query(self, severity: Optional[str] = None,
+              kinds: Optional[List[str]] = None,
+              task_id: Optional[str] = None,
+              actor_id: Optional[str] = None,
+              node_id: Optional[str] = None,
+              worker_id: Optional[str] = None,
+              since: Optional[float] = None,
+              after_seq: Optional[int] = None,
+              limit: int = 1000) -> List[Dict[str, Any]]:
+        """Filtered view of the ring, oldest first. ``severity`` is a
+        minimum level; ``kinds`` matches exactly (case-insensitive);
+        entity ids match on PREFIX so the short ids `rtpu status` prints
+        work; ``since`` is a wall-clock lower bound; ``after_seq`` the
+        follow cursor."""
+        min_rank = severity_rank(severity) if severity else 0
+        want_kinds = {k.upper() for k in kinds} if kinds else None
+        out: List[Dict[str, Any]] = []
+        for ev in self.ring:
+            if after_seq is not None and ev.get("seq", 0) <= after_seq:
+                continue
+            if since is not None and ev.get("ts", 0.0) < since:
+                continue
+            if min_rank and severity_rank(ev.get("severity")) < min_rank:
+                continue
+            if want_kinds and (ev.get("kind") or "").upper() not in want_kinds:
+                continue
+            if task_id and not (ev.get("task_id") or "").startswith(task_id):
+                continue
+            if actor_id and not (ev.get("actor_id") or "").startswith(
+                    actor_id):
+                continue
+            if node_id and not (ev.get("node_id") or "").startswith(node_id):
+                continue
+            if worker_id and not (ev.get("worker_id") or "").startswith(
+                    worker_id):
+                continue
+            out.append(ev)
+        return out[-max(1, int(limit)):]
+
+    async def wait_for_new(self, timeout: float) -> None:
+        """Block (on the controller's event loop) until any event appends
+        or the timeout passes — the get_events long-poll primitive."""
+        import asyncio
+
+        ev = asyncio.Event()
+        self._waiters.append(ev)
+        try:
+            await asyncio.wait_for(ev.wait(), max(0.0, timeout) or 1e-6)
+        except asyncio.TimeoutError:
+            pass
+        finally:
+            try:
+                self._waiters.remove(ev)
+            except ValueError:
+                pass
+
+
+# --------------------------------------------------- worker/driver shipping
+
+
+class _Shipper:
+    """Bounded per-process event buffer flushed to the controller over the
+    reconnecting control connection (same daemon-flusher shape as
+    task_events._Recorder — a batch that fails to deliver re-buffers and
+    lands on the restarted controller after re-register)."""
+
+    def __init__(self) -> None:
+        self.lock = threading.Lock()
+        self.events: Optional[collections.deque] = None
+        self._thread: Optional[threading.Thread] = None
+
+    def emit(self, ev: Dict[str, Any]) -> None:
+        with self.lock:
+            if self.events is None:
+                self.events = collections.deque(
+                    maxlen=max(16, flags.get("RTPU_EVENTS_BUF")))
+            self.events.append(ev)
+        self._ensure_flusher()
+
+    def _ensure_flusher(self) -> None:
+        if self._thread is not None and self._thread.is_alive():
+            return
+        self._thread = threading.Thread(
+            target=self._run, name="rtpu-events-flush", daemon=True)
+        self._thread.start()
+
+    def _run(self) -> None:
+        while True:
+            time.sleep(flags.get("RTPU_EVENTS_FLUSH_S"))
+            try:
+                self.flush()
+            except Exception:
+                pass  # the event feed must never take a process down
+
+    def flush(self, timeout: float = 30.0) -> bool:
+        from . import context as ctx
+
+        with self.lock:
+            events = list(self.events) if self.events else []
+            if self.events is not None:
+                self.events.clear()
+        if not events:
+            return True
+        if not ctx.is_initialized():
+            self._requeue(events)
+            return False
+        try:
+            wc = ctx.get_worker_context()
+            wc.client.request({"kind": "cluster_events", "events": events},
+                              timeout=timeout)
+            return True
+        except Exception:
+            self._requeue(events)
+            return False
+
+    def _requeue(self, events: List[Dict[str, Any]]) -> None:
+        with self.lock:
+            if self.events is None:
+                self.events = collections.deque(
+                    maxlen=max(16, flags.get("RTPU_EVENTS_BUF")))
+            self.events.extendleft(reversed(events))
+
+
+_shipper = _Shipper()
+
+
+def emit(severity: str, kind: str, message: str, source: str = "worker",
+         **entities: Any) -> None:
+    """Buffer one cluster event for shipping to the controller (worker /
+    driver processes; the controller emits into its EventLog directly,
+    host agents ship theirs on the heartbeat path)."""
+    if not enabled():
+        return
+    _shipper.emit(make_event(severity, source, kind, message, **entities))
+
+
+def flush_events(timeout: float = 30.0) -> bool:
+    """Force a flush (tests / shutdown hooks)."""
+    return _shipper.flush(timeout=timeout)

@@ -70,6 +70,11 @@ class HostAgent:
         self.worker_tokens: Dict[str, str] = {}  # worker_id -> spawn_token
         self._stop = asyncio.Event()
         self._draining = False  # a self-drain request is in flight
+        # Unshipped cluster events (core/events.py records): flushed on the
+        # heartbeat path, so delivery is reconnect-safe for free — a batch
+        # pending across a controller bounce rides the first heartbeat on
+        # the re-established connection.
+        self._pending_events: list = []
         if host_id:
             flags.set_env("RTPU_HOST_ID", host_id)
         from .object_store import current_host_id
@@ -159,6 +164,29 @@ class HostAgent:
 
     # ------------------------------------------------- drain / preemption
 
+    def _emit_event(self, severity: str, kind: str, message: str,
+                    **entities) -> None:
+        """Queue one cluster event for the next heartbeat flush."""
+        from . import events
+
+        if not events.enabled():
+            return
+        self._pending_events.append(events.make_event(
+            severity, "agent", kind, message,
+            node_id=entities.pop("node_id", self.node_id), **entities))
+        del self._pending_events[:-256]  # bounded, oldest drop first
+
+    async def _flush_events(self) -> None:
+        if not self._pending_events:
+            return
+        batch, self._pending_events = self._pending_events, []
+        try:
+            await self.ctrl.send({"kind": "cluster_events", "events": batch})
+        except Exception:
+            # Controller unreachable: re-buffer for the next heartbeat.
+            self._pending_events = batch + self._pending_events
+            del self._pending_events[:-256]
+
     async def _preemption_watch_loop(self) -> None:
         """Poll the cloud metadata preemption endpoint (GCE: the
         instance/preempted key flips to TRUE ~30s before the VM dies;
@@ -182,6 +210,11 @@ class HostAgent:
                 sys.stderr.write(
                     f"[host_agent] preemption notice at {url}; draining "
                     f"node {self.node_id[:8]}\n")
+                self._emit_event(
+                    "WARNING", "NODE_PREEMPTION_NOTICE",
+                    f"preemption notice received on node "
+                    f"{self.node_id[:8]}; self-draining",
+                    data={"url": url})
                 self.initiate_drain("preemption")
                 return
 
@@ -391,6 +424,10 @@ class HostAgent:
             # same way a pre-register death would.
             self.tpu_free.extend(self.tpu_alloc.pop(spawn_token, []))
             sys.stderr.write(f"[host_agent] worker launch failed: {e!r}\n")
+            self._emit_event(
+                "ERROR", "WORKER_LAUNCH_FAILED",
+                f"worker launch failed on node {self.node_id[:8]}: {e!r}",
+                data={"error": str(e)})
             asyncio.get_running_loop().create_task(self.ctrl.send(
                 {"kind": "spawn_exited", "spawn_token": spawn_token,
                  "node_id": self.node_id, "returncode": -1}))
@@ -453,6 +490,12 @@ class HostAgent:
                 mem_fraction = psutil.virtual_memory().percent / 100.0
             except Exception:
                 mem_fraction = None
+            try:
+                import psutil as _ps
+
+                cpu_percent = _ps.cpu_percent(None)
+            except Exception:
+                cpu_percent = None
             from .worker_logs import log_volume_bytes
 
             try:
@@ -464,6 +507,8 @@ class HostAgent:
                         "arena": stats,
                         "num_workers": len(self.procs),
                         "mem_fraction": mem_fraction,
+                        # Host CPU% (the `rtpu status` per-node column).
+                        "cpu_percent": cpu_percent,
                         "proc_stats": self._proc_stats(),
                         # Per-node log volume (rtpu_worker_log_bytes gauge).
                         "log_bytes": log_volume_bytes(),
@@ -471,6 +516,7 @@ class HostAgent:
                 )
             except Exception:
                 pass
+            await self._flush_events()
             try:
                 await asyncio.wait_for(self._stop.wait(), HEARTBEAT_S)
             except asyncio.TimeoutError:

@@ -558,6 +558,14 @@ class WorkerRuntime:
         mb = self.actors.get(aid) if aid else None
         if mb is not None:
             mb.exited = True  # BEFORE completing: no queued call may run
+        if aid:
+            from . import events
+
+            events.emit(
+                "INFO", "ACTOR_EXIT",
+                f"actor {aid[:8]} exited intentionally via exit_actor()",
+                actor_id=aid, worker_id=self.worker_id,
+                node_id=self.node_id)
         n = len(spec.get("return_ids") or ())
         self._complete_ok(spec, None if n <= 1 else [None] * n)
         if not aid:
@@ -625,6 +633,15 @@ class WorkerRuntime:
             if task_events.enabled():
                 spec["__recv_ts__"] = time.time()
             if not self._admit(spec):
+                from . import events
+
+                events.emit(
+                    "WARNING", "TASK_SPILLBACK",
+                    f"worker {self.worker_id[:8]} rejected task "
+                    f"{spec.get('label') or spec['task_id'][:8]} under "
+                    f"host memory pressure",
+                    task_id=spec["task_id"], worker_id=self.worker_id,
+                    node_id=self.node_id)
                 await conn.send({"kind": "task_spillback",
                                  "task_id": spec["task_id"],
                                  "worker_id": self.worker_id})

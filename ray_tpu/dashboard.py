@@ -28,7 +28,11 @@ Endpoints:
                              list/tail (?node, ?name), or ranged /
                              task-attributed chunks (?task_id, ?actor_id,
                              ?worker_id, ?offset)
+    GET /api/events          cluster event feed (?severity, ?kind,
+                             ?task_id, ?actor_id, ?node, ?worker_id)
     GET /logs                log viewer page (live tail via /api/logs)
+    GET /events              event feed page (hang events expose their
+                             captured stacks)
     GET /healthz             200 ok (dashboard/modules/healthz)
     GET /metrics             proxied controller Prometheus text
 """
@@ -72,8 +76,10 @@ _PAGE = """<!doctype html>
 </style></head><body>
 <h1>ray_tpu dashboard</h1>
 <p>{cluster}</p>
-<p><a href="/logs">log viewer</a> · <a href="/timeline">timeline</a></p>
+<p><a href="/logs">log viewer</a> · <a href="/timeline">timeline</a> ·
+<a href="/events">events</a></p>
 <h2>Nodes</h2>{nodes}
+<h2>Recent events</h2>{events}
 <h2>Actors</h2>{actors}
 <h2>Task summary</h2>{tasks}
 <h2>Recent tasks</h2>{recent}
@@ -101,6 +107,15 @@ def _table(rows, cols, raw=()) -> str:
         for r in rows[:200]
     )
     return f"<table><tr>{head}</tr>{body}</table>"
+
+
+def _fmt_ts(ts) -> str:
+    import time as _time
+
+    try:
+        return _time.strftime("%H:%M:%S", _time.localtime(float(ts or 0)))
+    except Exception:
+        return "?"
 
 
 def _log_link(param: str, value) -> str:
@@ -340,9 +355,19 @@ class Dashboard:
                         raw={"logs"})
         jobs = _table(self._safe(self._jobs),
                       ["job_id", "status", "entrypoint"])
+        # Recent-events feed (reference: the dashboard event feed): the
+        # newest cluster events, newest first, with the full log one click
+        # away on /events.
+        ev_rows = list(reversed(
+            self._safe(lambda: state_api.list_events(limit=12)) or []))
+        for r in ev_rows:
+            r["time"] = _fmt_ts(r.get("ts"))
+        events = _table(ev_rows,
+                        ["time", "severity", "kind", "message"])
         return web.Response(
             text=_PAGE.format(cluster=cluster, nodes=nodes, actors=actors,
-                              tasks=tasks, recent=recent, jobs=jobs),
+                              tasks=tasks, recent=recent, jobs=jobs,
+                              events=events),
             content_type="text/html")
 
     @staticmethod
@@ -405,6 +430,14 @@ class Dashboard:
                     None, lambda: state_api.profile_workers(t))
             elif kind == "usage":
                 data = _local_usage()
+            elif kind == "events":
+                q = request.query
+                data = state_api.list_events(
+                    severity=q.get("severity"),
+                    kind=q.getall("kind") if q.get("kind") else None,
+                    task_id=q.get("task_id"), actor_id=q.get("actor_id"),
+                    node_id=q.get("node"), worker_id=q.get("worker_id"),
+                    limit=int(q.get("limit", 200)))
             elif kind == "logs":
                 # ?all=1 -> cluster log index; ?task_id/?actor_id/
                 # ?worker_id or ?offset -> ranged/attributed chunk
@@ -436,6 +469,63 @@ class Dashboard:
         except Exception as e:
             return web.json_response({"error": repr(e)}, status=500)
         return web.json_response(data, dumps=lambda o: json.dumps(o, default=str))
+
+    async def _events_page(self, request):
+        """Cluster event feed (reference: the dashboard event page):
+        severity/kind/entity filters via query params; hang-watchdog
+        events expose their captured stacks in a collapsible block."""
+        from aiohttp import web
+
+        q = request.query
+        try:
+            evs = state_api.list_events(
+                severity=q.get("severity"),
+                kind=q.getall("kind") if q.get("kind") else None,
+                task_id=q.get("task_id"), actor_id=q.get("actor_id"),
+                node_id=q.get("node"), worker_id=q.get("worker_id"),
+                limit=int(q.get("limit", 200)))
+        except Exception as e:
+            evs = []
+            err = html.escape(repr(e))
+        else:
+            err = ""
+        rows = []
+        for ev in reversed(evs):  # newest first
+            stack = (ev.get("data") or {}).get("stack")
+            msg = html.escape(str(ev.get("message", "")))
+            if stack:
+                msg += (f"<details><summary>captured stacks</summary>"
+                        f"<pre>{html.escape(stack)}</pre></details>")
+            ids = " ".join(
+                f"{k.split('_')[0]}={html.escape(ev[k][:12])}"
+                for k in ("task_id", "actor_id", "worker_id", "node_id")
+                if ev.get(k))
+            rows.append({
+                "time": _fmt_ts(ev.get("ts")),
+                "severity": ev.get("severity", ""),
+                "kind": ev.get("kind", ""),
+                "entities": ids,
+                "message": msg,
+            })
+        table = _table(rows, ["time", "severity", "kind", "entities",
+                              "message"], raw={"message"})
+        body = (
+            "<!doctype html><html><head><title>ray_tpu events</title>"
+            '<meta http-equiv="refresh" content="5"><style>'
+            "body { font-family: system-ui, sans-serif; margin: 1.2rem; "
+            "color: #1a1a2e; } h1 { font-size: 1.2rem; } "
+            "table { border-collapse: collapse; width: 100%; "
+            "font-size: .85rem; } th, td { text-align: left; "
+            "padding: .3rem .6rem; border-bottom: 1px solid #ddd; } "
+            "th { background: #f4f4f8; } pre { background: #f7f7fa; "
+            "padding: .6rem; font-size: 11px; white-space: pre-wrap; }"
+            "</style></head><body>"
+            '<h1>Cluster events <small style="color:#888">'
+            '(<a href="/">overview</a>; filters: ?severity=, ?kind=, '
+            "?task_id=, ?actor_id=, ?node=)</small></h1>"
+            + (f"<p>{err}</p>" if err else "")
+            + table + "</body></html>")
+        return web.Response(text=body, content_type="text/html")
 
     async def _logs_page(self, request):
         """Log viewer (reference: the dashboard log viewer): lists the
@@ -492,6 +582,7 @@ class Dashboard:
         app = web.Application()
         app.router.add_get("/", self._index)
         app.router.add_get("/logs", self._logs_page)
+        app.router.add_get("/events", self._events_page)
         app.router.add_get("/timeline", self._timeline_page)
         app.router.add_get("/api/{kind}", self._api)
         app.router.add_get("/healthz", self._healthz)

@@ -256,6 +256,39 @@ _define("RTPU_LOG_ATTRIBUTION", bool, True,
         "--task-id` fetches one task's output without scanning "
         "(reference: the log_monitor magic-line protocol). 0 disables; "
         "the write path then pays one flag check per write.")
+_define("RTPU_EVENTS", bool, True,
+        "Cluster event subsystem (core/events.py): structured node/actor/"
+        "task/placement-group/autoscaler lifecycle events in a bounded "
+        "controller ring, persisted as JSONL alongside --state-path and "
+        "served by `rtpu events` / state.list_events (reference: `ray "
+        "list cluster-events` + the dashboard event feed). 0 disables; "
+        "emit sites then pay one flag check.")
+_define("RTPU_EVENTS_MAX", int, 10000,
+        "Controller-side cluster-event ring size (and the number of "
+        "persisted JSONL lines reloaded after a controller bounce).")
+_define("RTPU_EVENTS_FLUSH_S", float, 0.5,
+        "Flush period for worker/driver-side cluster events shipped to "
+        "the controller in batches.")
+_define("RTPU_EVENTS_BUF", int, 2048,
+        "Per-process bounded buffer of unshipped cluster events: oldest "
+        "drop first when the controller is unreachable longer than the "
+        "buffer covers.")
+_define("RTPU_HANG_WATCHDOG", bool, True,
+        "Controller watchdog sweeping running tasks/actor calls for hangs "
+        "and stragglers: a task older than max(RTPU_HANG_MIN_S, "
+        "RTPU_HANG_P99_FACTOR x its label's exec-latency p99) emits a "
+        "TASK_HUNG/TASK_STRAGGLER cluster event with an automatic "
+        "all-thread stack capture from the executing worker (reference: "
+        "the LlamaRL silent-hang failure mode; `ray stack` made "
+        "automatic). 0 disables the sweep entirely.")
+_define("RTPU_HANG_MIN_S", float, 300.0,
+        "Hard floor before the hang watchdog flags any task — no label "
+        "history can lower the threshold below this.")
+_define("RTPU_HANG_P99_FACTOR", float, 10.0,
+        "Straggler threshold multiplier over the label's observed "
+        "exec-latency p99 (from the rtpu_task_exec_s histogram).")
+_define("RTPU_HANG_POLL_S", float, 2.0,
+        "Hang-watchdog sweep period.")
 _define("RTPU_EXIT_DETAIL_BYTES", int, 2048,
         "On worker death, quote up to this many bytes of the crashed "
         "process's log tail in the task/actor error surfaced to the "

@@ -66,7 +66,13 @@ def generate_dashboard(prom_text: str,
     x = y = 0
     for name, mtype, doc in parse_prometheus_metadata(prom_text):
         if mtype == "counter":
-            exprs = [(f"rate({name}[5m])", "{{instance}}")]
+            # Cluster-event rate fans out by severity: one panel shows the
+            # WARNING/ERROR mix shifting (the hang watchdog's signal).
+            if name == "rtpu_events_total":
+                exprs = [(f"sum(rate({name}[5m])) by (severity)",
+                          "{{severity}}")]
+            else:
+                exprs = [(f"rate({name}[5m])", "{{instance}}")]
             ptitle = f"{name} (rate/s)"
         elif mtype == "histogram":
             # Flight-recorder phase histograms are tagged per task label —
@@ -88,10 +94,15 @@ def generate_dashboard(prom_text: str,
             ptitle = f"{name} (quantiles)"
         else:  # gauge / untyped
             # Per-node gauges (log volume, arena usage) legend by node so
-            # one panel fans out across the cluster.
-            legend = "{{node}}" if name in (
-                "rtpu_worker_log_bytes", "rtpu_node_arena_used_bytes",
-            ) else "{{instance}}"
+            # one panel fans out across the cluster; per-worker-process
+            # gauges (heartbeat cpu/rss) additionally split by pid.
+            if name in ("rtpu_worker_cpu_percent", "rtpu_worker_rss_bytes"):
+                legend = "{{node}}/{{pid}}"
+            elif name in ("rtpu_worker_log_bytes",
+                          "rtpu_node_arena_used_bytes"):
+                legend = "{{node}}"
+            else:
+                legend = "{{instance}}"
             exprs = [(name, legend)]
             ptitle = name
         panels.append(_panel(pid, ptitle, exprs, x, y, description=doc))

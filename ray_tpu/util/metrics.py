@@ -97,7 +97,7 @@ class _Aggregator:
             time.sleep(period)
             self.flush()
 
-    def flush(self) -> None:
+    def flush(self, final: bool = False) -> None:
         from ray_tpu.core import context as ctx
 
         with self.lock:
@@ -134,7 +134,16 @@ class _Aggregator:
             for name, m in batch.items()
         ]
         try:
-            wc.client.send_nowait({"kind": "metric_update", "metrics": wire})
+            if final:
+                # Interpreter teardown: fire-and-forget would enqueue the
+                # frame on the io loop and exit before it hits the socket —
+                # a short blocking request guarantees delivery (or gives up
+                # fast when the controller is already gone).
+                wc.client.request(
+                    {"kind": "metric_update", "metrics": wire}, timeout=2)
+            else:
+                wc.client.send_nowait(
+                    {"kind": "metric_update", "metrics": wire})
         except Exception:
             pass
 
@@ -145,6 +154,21 @@ _aggregator = _Aggregator()
 def flush_metrics() -> None:
     """Force a flush (tests / shutdown hooks)."""
     _aggregator.flush()
+
+
+def _atexit_flush() -> None:
+    try:
+        _aggregator.flush(final=True)
+    except Exception:
+        pass
+
+
+# The flusher is a daemon thread: without this hook a short-lived driver
+# that records and exits inside one RTPU_METRICS_FLUSH_S interval silently
+# drops its final pending batch.
+import atexit  # noqa: E402
+
+atexit.register(_atexit_flush)
 
 
 class _Metric:

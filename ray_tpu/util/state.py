@@ -85,6 +85,61 @@ def drain_node(node_id: str, reason: str = "manual",
                  "reason": reason, "deadline_s": deadline_s})
 
 
+def list_events(severity: Optional[str] = None,
+                kind: Optional[Any] = None,
+                task_id: Optional[str] = None,
+                actor_id: Optional[str] = None,
+                node_id: Optional[str] = None,
+                worker_id: Optional[str] = None,
+                since: Optional[float] = None,
+                limit: int = 1000) -> List[Dict[str, Any]]:
+    """Cluster events (reference: `ray list cluster-events`): structured
+    node/actor/task/placement-group/autoscaler lifecycle records — plus
+    the hang watchdog's TASK_HUNG / TASK_STRAGGLER findings with their
+    captured stacks in ``data["stack"]``. ``severity`` is a minimum level
+    (DEBUG/INFO/WARNING/ERROR), ``kind`` one kind or a list, entity ids
+    match on prefix, ``since`` is a wall-clock lower bound."""
+    return _req({"kind": "get_events", "severity": severity,
+                 "kinds": kind, "task_id": task_id, "actor_id": actor_id,
+                 "node_id": node_id, "worker_id": worker_id,
+                 "since": since, "limit": limit})["events"]
+
+
+def follow_events(severity: Optional[str] = None,
+                  kind: Optional[Any] = None,
+                  task_id: Optional[str] = None,
+                  actor_id: Optional[str] = None,
+                  node_id: Optional[str] = None,
+                  worker_id: Optional[str] = None,
+                  wait_s: float = 2.0):
+    """Generator of cluster events as they happen (the `rtpu events
+    --follow` backend). Each poll is an independent long-poll request on
+    the session's reconnecting client; the seq cursor survives a
+    controller bounce because the event log (and its seq counter) is
+    persisted alongside ``--state-path``."""
+    import time as _time
+
+    after_seq = None
+    while True:
+        try:
+            r = _req({"kind": "get_events", "severity": severity,
+                      "kinds": kind, "task_id": task_id,
+                      "actor_id": actor_id, "node_id": node_id,
+                      "worker_id": worker_id, "after_seq": after_seq,
+                      "wait_s": wait_s if after_seq is not None else 0,
+                      "limit": 1000})
+        except Exception:
+            _time.sleep(min(wait_s, 2.0) or 0.5)
+            continue
+        if after_seq is None:
+            # First poll establishes the cursor: only NEW events stream.
+            after_seq = r.get("seq", 0)
+            continue
+        after_seq = max(after_seq, r.get("seq", after_seq))
+        for ev in r.get("events", ()):
+            yield ev
+
+
 def metrics_address() -> Optional[str]:
     """host:port of the controller's Prometheus /metrics endpoint."""
     state = _req({"kind": "cluster_state"})

@@ -112,6 +112,29 @@ def test_drain_watcher_disabled_path_overhead(ray_start_regular,
         f"watcher-disabled task throughput {200/dt:.0f}/s below floor"
 
 
+def test_events_watchdog_disabled_path_overhead(ray_start_regular,
+                                                monkeypatch):
+    """Cluster-event + hang-watchdog guard (mirrors the RTPU_TASK_EVENTS
+    guard): with RTPU_EVENTS=0 every emit site pays one flag check and
+    nothing is stored/shipped, and with RTPU_HANG_WATCHDOG=0 no sweep task
+    even exists — the task round-trip holds the same throughput floor as
+    the plain benchmark, so the new subsystem can never silently tax the
+    hot path."""
+    monkeypatch.setenv("RTPU_EVENTS", "0")
+    monkeypatch.setenv("RTPU_HANG_WATCHDOG", "0")
+
+    @ray_tpu.remote
+    def nop():
+        return None
+
+    ray_tpu.get([nop.remote() for _ in range(8)])  # warm the pool
+    t0 = time.perf_counter()
+    ray_tpu.get([nop.remote() for _ in range(200)])
+    dt = time.perf_counter() - t0
+    assert 200 / dt > 30, \
+        f"events-disabled task throughput {200/dt:.0f}/s below floor"
+
+
 def test_large_object_bandwidth_floor(ray_start_regular):
     arr = np.ones(4 * 1024 * 1024, dtype=np.float64)  # 32MB
     t0 = time.perf_counter()
