@@ -194,11 +194,22 @@ def _driver_on_reconnect(client: CoreClient) -> None:
     client.token = secrets.token_hex(8)
     # The restarted controller has no lease ledger: forget leased routes so
     # fresh leases are negotiated (the workers themselves re-register as
-    # idle). Conn closes are fire-and-forget on the io loop.
+    # idle). Idle routes close now; routes with pushes IN FLIGHT are
+    # retired instead — the hosting workers survive the bounce, so their
+    # batches complete on the live direct connections (results publish to
+    # the restarted controller once the workers re-register) and the done
+    # callback closes each drained route. Closing them here would turn a
+    # controller bounce into spurious WorkerCrashedErrors on retry-less
+    # directly-pushed tasks.
     for pool in list(_task_pools.values()):
         with pool.lock:
             routes, pool.routes = pool.routes, []
+            busy = [r for r in routes if r.inflight > 0]
+            for r in busy:
+                r.retired = True
         for r in routes:
+            if r.inflight > 0:
+                continue
             try:
                 client.io.call_nowait(r.conn.close())
             except Exception:
@@ -207,6 +218,12 @@ def _driver_on_reconnect(client: CoreClient) -> None:
     with _inflight_lock:
         specs = [dict(s) for s in _inflight_specs.values()]
     for spec in specs:
+        # A spec whose direct push is still in flight on a surviving route
+        # must NOT be resubmitted — the live worker will run it; a
+        # duplicate through the queue would double-execute it.
+        if any(oid in _inflight_direct
+               for oid in (spec.get("return_ids") or ())):
+            continue
         # Stale placement/dispatch residue must not ride the resubmit.
         for k in ("loc_hints", "sched_node", "blocked", "state"):
             spec.pop(k, None)
@@ -252,6 +269,19 @@ def _untrack_inflight(object_id: str) -> None:
         if spec:
             for oid in spec.get("return_ids") or ():
                 _inflight_oid2task.pop(oid, None)
+
+
+def _untrack_inflight_many(object_ids) -> None:
+    hits = [oid for oid in object_ids if oid in _inflight_oid2task]
+    if not hits:
+        return
+    with _inflight_lock:
+        for object_id in hits:
+            tid = _inflight_oid2task.pop(object_id, None)
+            spec = _inflight_specs.pop(tid, None) if tid else None
+            if spec:
+                for oid in spec.get("return_ids") or ():
+                    _inflight_oid2task.pop(oid, None)
 
 
 def _atexit_shutdown() -> None:
@@ -590,6 +620,13 @@ class RemoteFunction:
         self._options = options or {}
         self._func_id: Optional[str] = None
         self._registered_with: Optional[str] = None
+        # Amortized submission: the spec's static fields (closure id,
+        # validated resources, normalized strategy, retry options) are
+        # computed once per session and shared by every call's spec — each
+        # .remote() builds only its ids and args, and batched pushes pickle
+        # the shared sub-objects once per frame (pickle memo), not per call.
+        self._tmpl: Optional[Tuple[Dict[str, Any], bool, Any]] = None
+        self._tmpl_key: Optional[str] = None
         functools.update_wrapper(self, fn)
 
     def options(self, **opts) -> "RemoteFunction":
@@ -618,8 +655,10 @@ class RemoteFunction:
                 raise
         return self._func_id
 
-    def remote(self, *args, **kwargs):
-        wc = ctx.get_worker_context()
+    def _ensure_template(self, wc: ctx.WorkerContext):
+        key = wc.client.token
+        if self._tmpl is not None and self._tmpl_key == key:
+            return self._tmpl
         func_id = self._ensure_registered(wc)
         opts = self._options
         num_returns = opts.get("num_returns", 1)
@@ -630,15 +669,8 @@ class RemoteFunction:
             resources["TPU"] = float(opts["num_tpus"])
         _validate_accel_resources(resources)
         strategy, pg = _normalize_strategy(opts.get("scheduling_strategy"))
-        args_blob, deps, nested_refs = pack_args(args, kwargs)
-        n_rets = 0 if streaming else max(num_returns, 0)
-        return_ids = [ObjectID.generate() for _ in range(n_rets)]
-        spec = {
-            "task_id": TaskID.generate(),
+        tmpl = {
             "func_id": func_id,
-            "args_blob": args_blob,
-            "deps": deps,
-            "return_ids": return_ids,
             "resources": {k: v for k, v in resources.items() if v},
             "scheduling": strategy,
             "pg": pg,
@@ -649,19 +681,42 @@ class RemoteFunction:
             # not supported).
             "retry_exceptions": bool(opts.get("retry_exceptions", False)),
         }
+        self._tmpl = (tmpl, streaming, num_returns)
+        self._tmpl_key = key
+        return self._tmpl
+
+    def remote(self, *args, **kwargs):
+        wc = ctx.get_worker_context()
+        tmpl, streaming, num_returns = self._ensure_template(wc)
+        opts = self._options
+        args_blob, deps, nested_refs = pack_args(args, kwargs)
+        n_rets = 0 if streaming else max(num_returns, 0)
+        return_ids = [ObjectID.generate() for _ in range(n_rets)]
+        # Static fields come as shared references from the template; only
+        # ids and args are per-call.
+        spec = dict(tmpl)
+        spec["task_id"] = TaskID.generate()
+        spec["args_blob"] = args_blob
+        spec["deps"] = deps
+        spec["return_ids"] = return_ids
         _attach_runtime_env(wc, opts, spec)
         if streaming:
             _streaming_spec_opts(opts, spec)
-        _register_dep_holds(spec, nested_refs)
+        if deps or nested_refs:
+            _register_dep_holds(spec, nested_refs)
         tracing.inject_submit_span(spec, spec["label"])
         if flags.get("RTPU_TASK_EVENTS"):
             # Flight-recorder anchor: the executing worker derives
             # scheduling delay (submit -> dispatch arrival) from this.
             spec["submit_ts"] = time.time()
-        _track_inflight(spec)
         # Lease-then-push direct path first; the controller queue is the
         # fallback (and the only path for pg/affinity/streaming tasks).
+        # Only controller-path specs enter the bounce-resubmission buffer:
+        # a direct push has its own recovery (the batch done callback),
+        # and a bounce must not double-schedule work a live worker still
+        # holds.
         if not _try_direct_task(wc, spec, opts):
+            _track_inflight(spec)
             _pipelined_submit(wc, {"kind": "submit_task", "spec": spec},
                               spec["return_ids"])
         if streaming:
@@ -805,6 +860,130 @@ class _ActorRoute:
         self.conn = None  # protocol.Connection on the client's io loop
         self.worker_id: Optional[str] = None
         self.lock = threading.Lock()
+        self.batcher: Optional["_PushBatcher"] = None
+
+
+# ---- submit batching --------------------------------------------------------
+# Every spec appended during one event-loop beat rides ONE framed
+# direct_task_batch / direct_actor_task_batch message (one pickle, one
+# syscall) and ONE aggregated reply. Specs built from a shared template
+# (RemoteFunction._submit_template) reference the same static sub-objects,
+# so pickle's memo serializes the closure/option template once per batch —
+# each additional call costs its args and ids on the wire, nothing else.
+
+
+class _PushBatch:
+    __slots__ = ("specs", "fut", "maxn")
+
+    def __init__(self) -> None:
+        import concurrent.futures
+
+        self.specs: List[Dict[str, Any]] = []
+        self.fut: "Any" = concurrent.futures.Future()
+        # Seal bound, read once at batch open (not one flag read per add).
+        self.maxn = flags.get("RTPU_SUBMIT_BATCH_MAX")
+
+
+class _PushBatcher:
+    """Per-connection micro-batcher for direct pushes.
+
+    ``add`` appends a spec to the open batch and (once per batch) schedules
+    a flush on the io loop — the flush runs within the same loop beat, so a
+    lone call's latency is unchanged while a burst coalesces into one frame.
+    The ``on_done(batch, result, exc)`` callback fires once per batch with
+    the aggregated reply (or the transport error)."""
+
+    __slots__ = ("kind", "conn", "io", "on_done", "lock", "cur", "closed",
+                 "scheduled")
+
+    def __init__(self, kind: str, conn, io, on_done) -> None:
+        self.kind = kind
+        self.conn = conn
+        self.io = io
+        self.on_done = on_done
+        self.lock = threading.Lock()
+        self.cur: Optional[_PushBatch] = None
+        self.closed: List[_PushBatch] = []
+        self.scheduled = False
+
+    def add(self, spec: Dict[str, Any], return_ids, meta=None) -> Any:
+        """Append one spec; registers its return ids in the in-flight maps
+        under the batcher lock (so the batch's done callback, which pops
+        them, can never run before they are registered). Returns the
+        batch's shared future."""
+        with self.lock:
+            b = self.cur
+            if b is None:
+                b = self.cur = _PushBatch()
+            b.specs.append(spec)
+            for oid in return_ids:
+                _inflight_direct[oid] = b.fut
+                if meta is not None:
+                    _direct_task_meta[oid] = meta
+            if len(b.specs) >= b.maxn:
+                self.closed.append(b)
+                self.cur = None
+            if self.scheduled:
+                return b.fut
+            self.scheduled = True
+        try:
+            self.io.loop.call_soon_threadsafe(self._flush)
+        except RuntimeError as e:  # io loop gone (shutdown race)
+            self._fail_open_batches(ConnectionError(str(e)))
+        return b.fut
+
+    def _settle(self, b: _PushBatch, res, exc) -> None:
+        """Run the batch's bookkeeping callback, then resolve the shared
+        future (in that order: by the time a waiter in _await_inflight
+        wakes, the aggregated locations are cached and the in-flight maps
+        are settled)."""
+        try:
+            self.on_done(b, res, exc)
+        finally:
+            if exc is not None:
+                if not b.fut.done():
+                    b.fut.set_exception(exc)
+            elif not b.fut.done():
+                b.fut.set_result(res)
+
+    def _fail_open_batches(self, exc: BaseException) -> None:
+        with self.lock:
+            batches, self.closed = self.closed, []
+            if self.cur is not None:
+                batches.append(self.cur)
+                self.cur = None
+            self.scheduled = False
+        for b in batches:
+            self._settle(b, None, exc)
+
+    def _flush(self) -> None:
+        """Runs on the io loop: seal and send every pending batch, in
+        append order (FIFO scheduling keeps cross-batch submission order,
+        which the actor mailbox's seqno reordering relies on only as a
+        fallback)."""
+        with self.lock:
+            batches, self.closed = self.closed, []
+            if self.cur is not None:
+                batches.append(self.cur)
+                self.cur = None
+            self.scheduled = False
+        for b in batches:
+            try:
+                rfut = self.conn.request_threadsafe(
+                    {"kind": self.kind, "specs": b.specs})
+            except Exception as e:  # noqa: BLE001
+                self._settle(b, None, e)
+                continue
+
+            def _chain(f, b=b):
+                exc = f.exception() if not f.cancelled() else \
+                    ConnectionError("request cancelled")
+                if exc is not None:
+                    self._settle(b, None, exc)
+                else:
+                    self._settle(b, f.result() or {}, None)
+
+            rfut.add_done_callback(_chain)
 
 
 def _cache_loc(loc) -> None:
@@ -817,6 +996,23 @@ def _cache_loc(loc) -> None:
     # controller-bounce resubmission buffer.
     ownership.on_return_location(loc.object_id)
     _untrack_inflight(loc.object_id)
+
+
+def _cache_locs(locs) -> None:
+    """Batch form of _cache_loc for aggregated direct replies: one lock
+    round per batch for the ownership release and the in-flight buffer
+    instead of one per location (this runs on the io thread — its GIL
+    share comes straight out of the submitting thread's budget)."""
+    if not locs:
+        return
+    oids = []
+    for loc in locs:
+        _local_locs[loc.object_id] = loc
+        oids.append(loc.object_id)
+    while len(_local_locs) > _LOCAL_LOCS_MAX:
+        _local_locs.popitem(last=False)
+    ownership.on_return_locations(oids)
+    _untrack_inflight_many(oids)
 
 
 _actor_seqnos: Dict[str, int] = {}
@@ -846,11 +1042,17 @@ def _register_dep_holds(spec: Dict[str, Any], nested_refs=()) -> None:
 
 def _claim_return_refs(return_ids) -> List[ObjectRef]:
     """Task returns are owned by the calling process (reference semantics:
-    the caller, not the executing worker, owns task results)."""
-    addr = ownership.self_addr()
+    the caller, not the executing worker, owns task results). One locked
+    pass claims + counts every id; the handles are built via __new__ so
+    __init__ doesn't take the ref lock a second time per id."""
+    addr = ownership.claim_return_refs(return_ids)
+    refs = []
     for oid in return_ids:
-        ownership.claim_ownership(oid)
-    return [ObjectRef(oid, addr) for oid in return_ids]
+        r = ObjectRef.__new__(ObjectRef)
+        r.object_id = oid
+        r.owner = addr
+        refs.append(r)
+    return refs
 
 
 def _get_route(wc, actor_id: str) -> "_ActorRoute":
@@ -897,7 +1099,36 @@ def _resolve_route(wc, route: "_ActorRoute", actor_id: str) -> bool:
             route.conn = None
             return False
         route.worker_id = d["worker_id"]
+        route.batcher = _PushBatcher(
+            "direct_actor_task_batch", route.conn, wc.client.io,
+            _make_actor_batch_done(wc, route))
         return True
+
+
+def _make_actor_batch_done(wc, route: "_ActorRoute"):
+    """Done-callback for one actor route's call batches (io thread)."""
+
+    def done(batch: _PushBatch, res, exc) -> None:
+        if exc is None:
+            if not getattr(batch.fut, "_rtpu_cached", False):
+                batch.fut._rtpu_cached = True
+                _cache_locs(res.get("locations"))
+                _cache_locs(res.get("error_locations"))
+            for spec in batch.specs:
+                for oid in spec.get("return_ids", ()):
+                    _inflight_direct.pop(oid, None)
+        else:
+            for spec in batch.specs:
+                for oid in spec.get("return_ids", ()):
+                    _inflight_direct.pop(oid, None)
+            # Runs on the io thread — hand recovery to a plain thread (it
+            # issues blocking controller RPCs).
+            threading.Thread(
+                target=_direct_failure_specs,
+                args=(wc, route, list(batch.specs), exc),
+                daemon=True, name="direct-recover").start()
+
+    return done
 
 
 # In-flight direct calls by return id: get() awaits these instead of asking
@@ -918,6 +1149,12 @@ def _direct_submit(wc, route: "_ActorRoute", spec: Dict[str, Any]) -> bool:
         # let the caller take the controller path / re-resolve.
         _invalidate_route(wc, route)
         return False
+    batcher = route.batcher
+    if batcher is not None and flags.get("RTPU_SUBMIT_BATCH"):
+        # Batched push: calls appended in one loop beat ride one frame;
+        # per-batch bookkeeping lives in _make_actor_batch_done.
+        batcher.add(spec, spec.get("return_ids", ()))
+        return True
     try:
         fut = conn.request_threadsafe(
             {"kind": "direct_actor_task", "spec": spec})
@@ -951,7 +1188,14 @@ def _direct_submit(wc, route: "_ActorRoute", spec: Dict[str, Any]) -> bool:
 
 def _direct_failure(wc, route: "_ActorRoute", spec: Dict[str, Any],
                     exc: BaseException) -> None:
-    """The direct call failed. Resubmit through the controller ONLY when
+    _direct_failure_specs(wc, route, [spec], exc)
+
+
+def _direct_failure_specs(wc, route: "_ActorRoute",
+                          specs: List[Dict[str, Any]],
+                          exc: BaseException) -> None:
+    """Direct actor call(s) failed — one push or a whole batch; the same
+    decision applies per spec. Resubmit through the controller ONLY when
     the call provably never executed:
 
     - NeverSentError: the route's connection was already closed at submit —
@@ -974,19 +1218,18 @@ def _direct_failure(wc, route: "_ActorRoute", spec: Dict[str, Any],
     task_done may have carried real result locations before it died — a
     completed call must stay completed for third-party consumers.
     """
-    import pickle as _p
-
     from . import protocol
-    from .controller import ActorDiedError, ActorNotHostedError
-    from .object_store import ObjectLocation
+    from .controller import ActorNotHostedError
 
     old_worker = route.worker_id
     _invalidate_route(wc, route)
     resubmit = isinstance(exc, (protocol.NeverSentError, ActorNotHostedError))
+    done_ids: set = set()
+    moved = False
     if not resubmit and isinstance(exc, (ConnectionError, OSError, EOFError)):
         try:
             info = wc.client.request(
-                {"kind": "resolve_actor", "actor_id": spec["actor_id"]})
+                {"kind": "resolve_actor", "actor_id": specs[0]["actor_id"]})
         except Exception:
             info = None
         d = (info or {}).get("direct") or {}
@@ -995,16 +1238,44 @@ def _direct_failure(wc, route: "_ActorRoute", spec: Dict[str, Any],
             or (info.get("state") == "alive"
                 and d.get("worker_id") not in (None, old_worker)))
         if moved:
+            # Which calls completed before the worker left? Migration
+            # publishes completed results before the old worker exits, so
+            # one wait probe splits the batch: published ⇒ completed
+            # (cache, never re-run), unpublished ⇒ never ran (resubmit).
+            all_ids = [oid for s in specs
+                       for oid in (s.get("return_ids") or ())]
             try:
-                locs = wc.client.request(
-                    {"kind": "get_locations",
-                     "object_ids": list(spec.get("return_ids", ())),
-                     "timeout": 0})
-                for loc in locs.values():
-                    _cache_loc(loc)
-                return  # the call completed before the worker left
+                ready = wc.client.request(
+                    {"kind": "wait", "object_ids": all_ids,
+                     "num_returns": len(all_ids), "timeout": 0})
+                done_ids = set(ready or ())
             except Exception:
-                resubmit = True  # no published results: it never ran
+                done_ids = set()
+            if done_ids:
+                try:
+                    locs = wc.client.request(
+                        {"kind": "get_locations",
+                         "object_ids": sorted(done_ids), "timeout": 1})
+                    for loc in locs.values():
+                        _cache_loc(loc)
+                except Exception:
+                    pass
+            resubmit = True
+    for spec in specs:
+        rids = spec.get("return_ids") or ()
+        if moved and (not rids
+                      or all(oid in done_ids for oid in rids)):
+            continue  # the call completed before the worker left
+        _finish_failed_actor_call(wc, spec, exc, resubmit)
+
+
+def _finish_failed_actor_call(wc, spec: Dict[str, Any], exc: BaseException,
+                              resubmit: bool) -> None:
+    import pickle as _p
+
+    from .controller import ActorDiedError
+    from .object_store import ObjectLocation
+
     if resubmit:
         try:
             wc.client.request({"kind": "submit_actor_task", "spec": spec})
@@ -1053,7 +1324,8 @@ def _reset_direct_state(wc=None) -> None:
 
 _LEASE_PIPELINE = 1         # grow the pool when every route is busy
 _LEASE_IDLE_S = 2.0         # release a lease unused this long
-_LEASE_BACKOFF_S = 0.5      # after a failed lease attempt, don't retry sooner
+_LEASE_BACKOFF_S = 0.5      # after an EMPTY grant, don't retry sooner
+_LEASE_GROW_THROTTLE_S = 0.1  # min spacing between growth RPCs otherwise
 
 
 def _reclaim_leases(lease_ids) -> None:
@@ -1070,13 +1342,13 @@ def _reclaim_leases(lease_ids) -> None:
             # hand a mid-release route to a new submit (double-booked
             # worker + spurious WorkerCrashedError on a retry-less task).
             pool.routes = [r for r in pool.routes if r not in victims]
-        for r in victims:
-            pool._release(wc, r)
+        if victims:
+            pool._release_many(wc, victims)
 
 
 class _TaskRoute:
     __slots__ = ("conn", "lease_id", "worker_id", "node_id", "inflight",
-                 "last_used")
+                 "last_used", "batcher", "retired")
 
     def __init__(self, conn, lease_id: str, worker_id: str,
                  node_id: str = "") -> None:
@@ -1086,6 +1358,11 @@ class _TaskRoute:
         self.node_id = node_id
         self.inflight = 0
         self.last_used = time.monotonic()
+        self.batcher: Optional[_PushBatcher] = None
+        # A retired route (controller bounced: its lease ledger is gone)
+        # serves its in-flight pushes to completion but takes no new work;
+        # the batch done-callback closes the conn once inflight drains.
+        self.retired = False
 
 
 class _TaskRoutePool:
@@ -1095,44 +1372,125 @@ class _TaskRoutePool:
         self.next_try = 0.0    # monotonic; backoff after failed lease
         self.acquiring = 0     # in-flight _acquire calls (caps pool growth)
 
-    def _acquire(self, wc, resources, env_hash, runtime_env,
-                 arg_bytes=None) -> Optional[_TaskRoute]:
+    def _acquire_block(self, wc, resources, env_hash, runtime_env,
+                       arg_bytes=None, count: int = 1
+                       ) -> Optional[_TaskRoute]:
+        """ONE lease_block round trip grants up to ``count`` workers; every
+        grant becomes a live route, so the wave fans across the block with
+        no further controller involvement. Returns the first route born
+        checked-out (the caller's task rides it); extra routes join the
+        pool idle."""
         from . import protocol
 
         try:
             got = wc.client.request({
-                "kind": "lease_worker", "resources": resources,
+                "kind": "lease_block", "count": max(1, count),
+                "resources": resources,
                 "env_hash": env_hash, "runtime_env": runtime_env,
                 "arg_bytes": arg_bytes or {}})
         except Exception:
             got = None
-        if not got or not got.get("lease_id"):
+        grants = (got or {}).get("grants") or []
+        if not grants:
+            # Empty grant: the cluster has nothing leasable for this
+            # signature right now — back off the full window. A PARTIAL
+            # grant only keeps the shorter pick()-side growth throttle:
+            # punitive backoff there serialized genuinely-parallel work
+            # onto one route for the whole window.
             with self.lock:
                 self.next_try = time.monotonic() + _LEASE_BACKOFF_S
             return None
-        try:
-            conn = wc.client.io.call(
-                protocol.connect(got["host"], got["port"],
-                                 name=f"lease->{got['worker_id'][:8]}"),
-                timeout=5)
-        except Exception:
+        first: Optional[_TaskRoute] = None
+        stranded: List[str] = []
+        for g in grants:
             try:
-                wc.client.request({"kind": "release_lease",
-                                   "lease_id": got["lease_id"]})
+                conn = wc.client.io.call(
+                    protocol.connect(g["host"], g["port"],
+                                     name=f"lease->{g['worker_id'][:8]}"),
+                    timeout=5)
+            except Exception:
+                stranded.append(g["lease_id"])
+                continue
+            route = _TaskRoute(conn, g["lease_id"], g["worker_id"],
+                               g.get("node_id") or "")
+            route.batcher = _PushBatcher(
+                "direct_task_batch", conn, wc.client.io,
+                self._make_batch_done(wc, route))
+            if first is None:
+                # Born checked-out (inflight=1): a freshly acquired route
+                # must never be visible to _reclaim_leases / the idle
+                # reaper with inflight==0 while its first submit is still
+                # in flight (advisor r4: that window releases the lease
+                # under the push and fabricates a WorkerCrashedError on a
+                # retry-less task).
+                route.inflight = 1
+                first = route
+            with self.lock:
+                self.routes.append(route)
+        if stranded:
+            try:
+                wc.client.conn.request_threadsafe(
+                    {"kind": "release_lease", "lease_ids": stranded})
             except Exception:
                 pass
-            return None
-        route = _TaskRoute(conn, got["lease_id"], got["worker_id"],
-                           got.get("node_id") or "")
-        # Born checked-out (inflight=1): a freshly acquired route must never
-        # be visible to _reclaim_leases / the idle reaper with inflight==0
-        # while its first submit is still in flight (advisor r4: that window
-        # releases the lease under the push and fabricates a
-        # WorkerCrashedError on a retry-less task).
-        route.inflight = 1
+        return first
+
+    def _make_batch_done(self, wc, route: "_TaskRoute"):
+        """Done-callback for one route's push batches (io thread): settle
+        bookkeeping for every spec in the batch, cache the aggregated
+        result locations once, and hand transport failures to a recovery
+        thread that distinguishes completed entries from never-ran ones."""
+
+        def done(batch: _PushBatch, res, exc) -> None:
+            specs = batch.specs
+            with self.lock:
+                route.inflight -= len(specs)
+                route.last_used = time.monotonic()
+                close_retired = route.retired and route.inflight <= 0
+            if exc is None:
+                # Same mark _await_inflight uses: whichever side processes
+                # the aggregated payload first spares the other the walk.
+                if not getattr(batch.fut, "_rtpu_cached", False):
+                    batch.fut._rtpu_cached = True
+                    _cache_locs(res.get("locations"))
+                    _cache_locs(res.get("error_locations"))
+                for spec in specs:
+                    for oid in spec.get("return_ids", ()):
+                        _inflight_direct.pop(oid, None)
+                        _direct_task_meta.pop(oid, None)
+                if close_retired:
+                    try:
+                        wc.client.io.call_nowait(route.conn.close())
+                    except Exception:
+                        pass
+            else:
+                for spec in specs:
+                    for oid in spec.get("return_ids", ()):
+                        _inflight_direct.pop(oid, None)
+                        _direct_task_meta.pop(oid, None)
+                threading.Thread(
+                    target=_direct_batch_task_failure,
+                    args=(wc, self, route, list(specs)),
+                    daemon=True, name="lease-recover").start()
+
+        return done
+
+    def _release_many(self, wc, routes: List["_TaskRoute"]) -> None:
+        """Hand back several leases in ONE framed message + close conns."""
         with self.lock:
-            self.routes.append(route)
-        return route
+            self.routes = [r for r in self.routes if r not in routes]
+        ids = [r.lease_id for r in routes]
+        if ids:
+            try:
+                wc.client.conn.request_threadsafe(
+                    {"kind": "release_lease", "lease_ids": ids})
+            except Exception:
+                pass
+        for r in routes:
+            try:
+                wc.client.io.call_nowait(r.conn.close())
+            except Exception:
+                pass
 
     def _release(self, wc, route: _TaskRoute) -> None:
         with self.lock:
@@ -1149,7 +1507,8 @@ class _TaskRoutePool:
             pass
 
     def pick(self, wc, resources, env_hash, runtime_env,
-             arg_bytes=None) -> Optional[_TaskRoute]:
+             arg_bytes=None, lease_max: Optional[int] = None
+             ) -> Optional[_TaskRoute]:
         """Least-loaded live route; grows the pool synchronously whenever
         every route is busy (one leased worker per concurrent task, the
         reference's lease-per-pending-task shape — async growth would
@@ -1159,19 +1518,29 @@ class _TaskRoutePool:
         controller on pool growth so new leases land there too."""
         now = time.monotonic()
         with self.lock:
-            live = [r for r in self.routes if not r.conn.closed.is_set()]
-            # Reap idle leases — every one: a held lease pins a CPU the
-            # scheduler can't use for queued tasks or actor creation. Reaped
-            # routes leave the pool BEFORE selection so this submit can't
-            # ride a lease being handed back.
-            reap = [r for r in live
-                    if r.inflight == 0 and now - r.last_used > _LEASE_IDLE_S]
-            live = [r for r in live if r not in reap]
+            # One pass: drop dead routes, reap idle leases, find the
+            # least-loaded survivor (this runs per submit — list-building
+            # per call showed up in submission profiles). Reap every idle
+            # lease: a held lease pins a CPU the scheduler can't use for
+            # queued tasks or actor creation. Reaped routes leave the pool
+            # BEFORE selection so this submit can't ride a lease being
+            # handed back.
+            live: List[_TaskRoute] = []
+            reap: List[_TaskRoute] = []
+            best = None
+            for r in self.routes:
+                if r.conn.closed.is_set():
+                    continue
+                if r.inflight == 0 and now - r.last_used > _LEASE_IDLE_S:
+                    reap.append(r)
+                    continue
+                live.append(r)
+                if best is None or r.inflight < best.inflight:
+                    best = r
             self.routes = live
             for r in reap:
                 threading.Thread(target=self._release, args=(wc, r),
                                  daemon=True).start()
-            best = min(live, key=lambda r: r.inflight, default=None)
             want_local = False
             if arg_bytes and live:
                 # Locality preference: an unsaturated route on the node
@@ -1188,7 +1557,8 @@ class _TaskRoutePool:
                     # grants there) instead of shipping the bytes over the
                     # network forever through an idle wrong-node route.
                     want_local = True
-            lease_max = flags.get("RTPU_TASK_LEASE_MAX")
+            if lease_max is None:
+                lease_max = flags.get("RTPU_TASK_LEASE_MAX")
             # acquiring counts toward the cap: N threads deciding to grow
             # simultaneously must not overshoot lease_max between them.
             need_grow = ((best is None
@@ -1196,6 +1566,12 @@ class _TaskRoutePool:
                           or want_local)
                          and len(live) + self.acquiring < lease_max
                          and now >= self.next_try)
+            # Bulk negotiation: ask for a whole block up front (the first
+            # grow of a wave fills the pool in one RPC; later grows top it
+            # up), never past the per-signature lease cap.
+            block_n = min(max(1, flags.get("RTPU_LEASE_BLOCK")),
+                          lease_max - len(live) - self.acquiring) \
+                if need_grow else 0
             if best is not None:
                 # Checkout under THIS lock acquisition (advisor r4): the
                 # route leaves pick() already counted busy, so the reclaim
@@ -1205,14 +1581,20 @@ class _TaskRoutePool:
                 best.inflight += 1
                 best.last_used = now
             if need_grow:
-                self.acquiring += 1
+                self.acquiring += block_n
+                # Rolling growth throttle: at most one negotiation RPC per
+                # window while saturated (a wave would otherwise pay one
+                # per submit); an EMPTY grant extends this to the full
+                # backoff in _acquire_block.
+                self.next_try = now + _LEASE_GROW_THROTTLE_S
         if need_grow:
             try:
-                got = self._acquire(wc, resources, env_hash, runtime_env,
-                                    arg_bytes=arg_bytes)
+                got = self._acquire_block(wc, resources, env_hash,
+                                          runtime_env, arg_bytes=arg_bytes,
+                                          count=block_n)
             finally:
                 with self.lock:
-                    self.acquiring -= 1
+                    self.acquiring -= block_n
             if want_local and got is not None and arg_bytes and \
                     got.node_id != max(arg_bytes, key=arg_bytes.get):
                 # Grew FOR locality but the grant landed off the data node
@@ -1230,11 +1612,24 @@ class _TaskRoutePool:
                         best.inflight -= 1
                         best.last_used = time.monotonic()
                 best = got
+            elif best is not None and not want_local:
+                # Growth was ATTEMPTED because every route was saturated,
+                # and the grant came back empty: the cluster has no idle
+                # worker for this signature right now. Spill THIS submit to
+                # the controller queue (which spawns workers / dispatches
+                # when one frees) instead of deepening a busy route's
+                # serial queue — two long concurrent tasks must not
+                # serialize behind one lease while CPUs sit free. Bounded:
+                # only the submit that performed the (throttled+backed-off)
+                # negotiation spills; the wave keeps riding the pool.
+                with self.lock:
+                    best.inflight -= 1
+                    best.last_used = time.monotonic()
+                return None
         return best
 
     def shutdown(self, wc) -> None:
-        for r in list(self.routes):
-            self._release(wc, r)
+        self._release_many(wc, list(self.routes))
 
 
 _task_pools: Dict[Tuple, _TaskRoutePool] = {}
@@ -1243,13 +1638,14 @@ _task_pools_lock = threading.Lock()
 
 def _try_direct_task(wc, spec: Dict[str, Any], opts: Dict[str, Any]) -> bool:
     """Push a plain task to a leased worker; False -> controller path."""
+    lease_max = flags.get("RTPU_TASK_LEASE_MAX")
     if (spec.get("pg") is not None
             or spec.get("scheduling", {}).get("type") != "DEFAULT"
             or spec.get("retry_exceptions")  # app-error retry is a
             # controller-queue feature: the direct path reports errors
             # straight back to the caller
             or spec.get("streaming")
-            or not flags.get("RTPU_TASK_LEASE_MAX")
+            or not lease_max
             or not flags.get("RTPU_DIRECT_DISPATCH")):
         return False
     # Deps guard: a leased worker BLOCKS in get_locations for unresolved
@@ -1280,7 +1676,7 @@ def _try_direct_task(wc, spec: Dict[str, Any], opts: Dict[str, Any]) -> bool:
         if loc.node_id and loc.inline is None:
             arg_bytes[loc.node_id] = arg_bytes.get(loc.node_id, 0) + loc.size
     route = pool.pick(wc, resources, env_hash, spec.get("runtime_env"),
-                      arg_bytes=arg_bytes)
+                      arg_bytes=arg_bytes, lease_max=lease_max)
     if route is None:
         return False
     if hints:
@@ -1289,6 +1685,13 @@ def _try_direct_task(wc, spec: Dict[str, Any], opts: Dict[str, Any]) -> bool:
         # went stale while queued there would turn a recoverable miss into
         # a task read failure (advisor r4).
         spec["loc_hints"] = hints
+    if flags.get("RTPU_SUBMIT_BATCH"):
+        # Batched push: the spec rides the route's open multi-spec frame;
+        # bookkeeping (inflight maps, location caching, failure recovery)
+        # is settled per batch by the route's done callback.
+        route.batcher.add(spec, spec.get("return_ids", ()),
+                          meta=(spec["task_id"], route.conn))
+        return True
     try:
         fut = route.conn.request_threadsafe(
             {"kind": "direct_task", "spec": spec})
@@ -1305,6 +1708,12 @@ def _try_direct_task(wc, spec: Dict[str, Any], opts: Dict[str, Any]) -> bool:
         with pool.lock:
             route.inflight -= 1
             route.last_used = time.monotonic()
+            close_retired = route.retired and route.inflight <= 0
+        if close_retired:
+            try:
+                wc.client.io.call_nowait(route.conn.close())
+            except Exception:
+                pass
         for oid in spec.get("return_ids", ()):
             _inflight_direct.pop(oid, None)
             _direct_task_meta.pop(oid, None)
@@ -1332,6 +1741,15 @@ def _try_direct_task(wc, spec: Dict[str, Any], opts: Dict[str, Any]) -> bool:
 def _direct_task_failure(wc, pool: "_TaskRoutePool", route: "_TaskRoute",
                          spec: Dict[str, Any]) -> None:
     pool._release(wc, route)
+    _requeue_or_fail_direct_task(wc, route, spec)
+
+
+def _requeue_or_fail_direct_task(wc, route: "_TaskRoute",
+                                 spec: Dict[str, Any]) -> None:
+    """The push failed and the task did NOT provably complete. The direct
+    attempt counts against max_retries exactly like a controller-tracked
+    attempt; with no budget left the at-most-once contract stands and the
+    task fails with WorkerCrashedError."""
     retries = int(spec.get("max_retries", 0))
     if retries > 0:
         spec = dict(spec, max_retries=retries - 1)
@@ -1339,6 +1757,7 @@ def _direct_task_failure(wc, pool: "_TaskRoutePool", route: "_TaskRoute",
         # just crashed — the controller path must re-resolve fresh.
         spec.pop("loc_hints", None)
         try:
+            _track_inflight(spec)  # it now rides the controller queue
             _pipelined_submit(wc, {"kind": "submit_task", "spec": spec},
                               spec.get("return_ids", ()))
         except Exception:
@@ -1363,6 +1782,41 @@ def _direct_task_failure(wc, pool: "_TaskRoutePool", route: "_TaskRoute",
                 {"kind": "put_location", "loc": loc, "if_absent": True})
         except Exception:
             pass
+
+
+def _direct_batch_task_failure(wc, pool: "_TaskRoutePool",
+                               route: "_TaskRoute",
+                               specs: List[Dict[str, Any]]) -> None:
+    """A batched push failed mid-flight (worker death / dead connection).
+    Entries that already completed published their result locations to the
+    controller through the worker's completion batcher — ONE wait probe
+    sorts the batch into completed (cache, never re-run: no duplication)
+    and unacked (re-route through the controller: no loss)."""
+    pool._release(wc, route)
+    all_ids = [oid for s in specs for oid in (s.get("return_ids") or ())]
+    done_ids: set = set()
+    if all_ids:
+        try:
+            ready = wc.client.request(
+                {"kind": "wait", "object_ids": all_ids,
+                 "num_returns": len(all_ids), "timeout": 0})
+            done_ids = set(ready or ())
+        except Exception:
+            done_ids = set()
+        if done_ids:
+            try:
+                locs = wc.client.request(
+                    {"kind": "get_locations",
+                     "object_ids": sorted(done_ids), "timeout": 1})
+                for loc in locs.values():
+                    _cache_loc(loc)
+            except Exception:
+                pass  # get() re-asks the controller; completion stands
+    for spec in specs:
+        rids = spec.get("return_ids") or ()
+        if rids and all(oid in done_ids for oid in rids):
+            continue  # completed and published before the route died
+        _requeue_or_fail_direct_task(wc, route, spec)
 
 
 def _pipelined_submit(wc, msg: Dict[str, Any], return_ids) -> None:
@@ -1419,12 +1873,16 @@ def _pipelined_submit(wc, msg: Dict[str, Any], return_ids) -> None:
 
 def _await_inflight(ids, timeout: Optional[float]) -> None:
     """Wait for in-flight direct replies covering `ids` (their locations
-    land in _local_locs via the completion callback)."""
+    land in _local_locs via the completion callback). Batched pushes share
+    one future across many return ids — each distinct future is awaited
+    and its aggregated payload processed once, not once per id."""
     deadline = None if timeout is None else time.monotonic() + timeout
+    seen: set = set()
     for oid in ids:
         fut = _inflight_direct.get(oid)
-        if fut is None:
+        if fut is None or id(fut) in seen:
             continue
+        seen.add(id(fut))
         try:
             res = fut.result(None if deadline is None
                              else max(0.0, deadline - time.monotonic()))
@@ -1433,11 +1891,14 @@ def _await_inflight(ids, timeout: Optional[float]) -> None:
             # callback / recovery thread; fall through to the controller.
             continue
         # Cache here too: the done-callback runs on the io thread and may
-        # not have fired yet when result() unblocks (idempotent with it).
-        for loc in ((res or {}).get("locations") or ()):
-            _cache_loc(loc)
-        for loc in ((res or {}).get("error_locations") or ()):
-            _cache_loc(loc)
+        # not have fired yet when result() unblocks (idempotent with it;
+        # the _rtpu_cached mark keeps a 500-entry batch from being
+        # re-walked for every one of its ids).
+        if getattr(fut, "_rtpu_cached", False):
+            continue
+        fut._rtpu_cached = True
+        _cache_locs((res or {}).get("locations"))
+        _cache_locs((res or {}).get("error_locations"))
 
 
 def exit_actor() -> None:
@@ -1486,6 +1947,10 @@ class ActorHandle:
         self._actor_id = actor_id
         self._method_names = list(method_names)
         self._method_defaults = dict(method_defaults or {})
+        # Per-method static spec template (see RemoteFunction._tmpl): a
+        # call serializes only its args, ids and seqno; batched pushes
+        # pickle the shared fields once per frame.
+        self._tmpls: Dict[str, Dict[str, Any]] = {}
 
     def __getattr__(self, name: str) -> ActorMethod:
         if name.startswith("_"):
@@ -1502,26 +1967,30 @@ class ActorHandle:
         args_blob, deps, nested_refs = pack_args(args, kwargs)
         n_rets = 0 if streaming else max(num_returns, 0)
         return_ids = [ObjectID.generate() for _ in range(n_rets)]
-        spec = {
-            "task_id": TaskID.generate(),
-            "actor_id": self._actor_id,
-            "method_name": method,
-            "args_blob": args_blob,
-            "deps": deps,
-            "return_ids": return_ids,
-            "resources": {},
-            "label": f"actor.{method}",
-            # Per-(caller, actor) sequence numbers: calls from one caller
-            # can ride different paths (direct socket vs controller
-            # fallback) and overtake each other; the mailbox restores
-            # submission order (reference: direct_actor_task_submitter's
-            # per-caller sequence_no).
-            "caller": ownership.process_token(),
-            "seqno": _next_actor_seqno(self._actor_id),
-        }
+        tmpl = self._tmpls.get(method)
+        if tmpl is None:
+            tmpl = self._tmpls[method] = {
+                "actor_id": self._actor_id,
+                "method_name": method,
+                "resources": {},
+                "label": f"actor.{method}",
+                # "caller" anchors the per-(caller, actor) sequence
+                # numbers: calls from one caller can ride different paths
+                # (direct socket vs controller fallback) and overtake each
+                # other; the mailbox restores submission order (reference:
+                # direct_actor_task_submitter's per-caller sequence_no).
+                "caller": ownership.process_token(),
+            }
+        spec = dict(tmpl)
+        spec["task_id"] = TaskID.generate()
+        spec["args_blob"] = args_blob
+        spec["deps"] = deps
+        spec["return_ids"] = return_ids
+        spec["seqno"] = _next_actor_seqno(self._actor_id)
         if streaming:
             _streaming_spec_opts({}, spec)
-        _register_dep_holds(spec, nested_refs)
+        if deps or nested_refs:
+            _register_dep_holds(spec, nested_refs)
         tracing.inject_submit_span(spec, spec["label"])
         if flags.get("RTPU_TASK_EVENTS"):
             spec["submit_ts"] = time.time()

@@ -329,6 +329,11 @@ class Controller:
         # Direct-dispatch worker leases (lease_id -> {worker_id, node_id,
         # resources, owner conn}) and on-demand profiling collection state.
         self._leases: Dict[str, Dict[str, Any]] = {}
+        # Lease-block accounting (/metrics rtpu_lease_* counters): blocks
+        # granted, individual leases granted, reclaim nudges, and grants
+        # refused at admission (the direct path's spillback analog).
+        self.lease_stats: Dict[str, int] = {
+            "blocks": 0, "granted": 0, "reclaims": 0, "mem_refused": 0}
         self._profiles: Dict[str, Dict[str, Any]] = {}
         self._last_reclaim_nudge = 0.0
         # App-defined metrics (util/metrics.py): name -> {type, help,
@@ -1819,6 +1824,16 @@ class Controller:
         self._wake_scheduler()
         return {"ok": True}
 
+    async def _h_task_done_batch(self, conn, msg):
+        """Multi-entry completion report: one framed message carries many
+        task_done payloads (acks + result-location publishes) shipped by a
+        worker's completion batcher — one unpickle and one handler pass for
+        a whole burst of finishes (reference: CoreWorker's batched task
+        status/export reports riding one gRPC call)."""
+        for item in msg.get("items") or ():
+            await self._h_task_done(conn, item)
+        return {"ok": True}
+
     def _record_lineage(self, spec: Dict[str, Any], msg: Dict[str, Any]) -> None:
         """Remember the spec of a successfully finished plain task so its
         outputs can be reconstructed after a node loss."""
@@ -1829,6 +1844,10 @@ class Controller:
             or spec.get("is_actor_creation")
             or spec.get("streaming")
             or not spec.get("return_ids")
+            # Slim leased-completion reports (inline-only results carry
+            # their bytes in the stored location) have no func_id — there
+            # is nothing to re-execute and nothing that can be lost.
+            or not spec.get("func_id")
         ):
             return
         for oid in spec["return_ids"]:
@@ -2020,19 +2039,15 @@ class Controller:
     # worker's resources until returned. Controller keeps directory/health/
     # lineage; the per-call path is peer-to-peer.
 
-    async def _h_lease_worker(self, conn, msg):
-        """Grant an idle worker to the requesting driver for direct task
-        pushes. Returns {lease_id, worker_id, host, port} or {lease_id:
-        None} when nothing is available (caller falls back to the queued
-        controller path, which can also spawn new workers)."""
-        resources: Dict[str, float] = msg.get("resources") or {"CPU": 1.0}
-        env_hash = msg.get("env_hash") or ""
+    def _grant_one_lease(self, conn, resources: Dict[str, float],
+                         env_hash: str, arg_bytes: Dict[str, int],
+                         block_id: str = "") -> Optional[Dict[str, Any]]:
+        """One lease grant against current availability; None when no node
+        can serve it. Shared by the single-lease and lease-block handlers —
+        a block grant is just this loop run N times against the availability
+        it is itself decrementing."""
         needs_tpu = resources.get("TPU", 0) > 0
         mem_limit = flags.get("RTPU_SPILLBACK_MEM_FRACTION")
-        # Locality term for the DIRECT path: the driver ships the byte
-        # placement of the task's (cached-location) args so lease grants
-        # rank nodes the same way queue placement does.
-        arg_bytes: Dict[str, int] = msg.get("arg_bytes") or {}
         candidates = [n for n in self.nodes.values()
                       if n.alive and not n.draining]
         for node in self._hybrid_order(candidates, arg_bytes):
@@ -2042,6 +2057,7 @@ class Controller:
             # analog — pushed tasks never pass the worker's execute_task
             # check, so screen the node's reported memory pressure here).
             if mem_limit and node.mem_fraction >= mem_limit:
+                self.lease_stats["mem_refused"] += 1
                 continue
             # Server-side lease bound (advisor r4): once a node already
             # holds a lease, never lease away its LAST schedulable CPU.
@@ -2067,22 +2083,82 @@ class Controller:
             self._leases[lease_id] = {"worker_id": w.worker_id,
                                       "node_id": node.node_id,
                                       "resources": dict(resources),
+                                      "block_id": block_id,
                                       "owner": conn}
+            self.lease_stats["granted"] += 1
             peer = w.conn.writer.get_extra_info("peername")
             host = peer[0] if peer else "127.0.0.1"
             return {"lease_id": lease_id, "worker_id": w.worker_id,
                     "host": host, "port": w.direct_port,
                     "node_id": node.node_id}
-        # Nothing idle: nudge a spawn so a later lease request can succeed —
-        # in the SAME locality order as grants, so "grow toward the data
-        # node" creates the worker where the bytes are.
+        return None
+
+    def _nudge_lease_spawns(self, resources: Dict[str, float],
+                            runtime_env, arg_bytes: Dict[str, int],
+                            count: int = 1) -> None:
+        """Nothing idle: nudge spawns so a later lease request can succeed —
+        in the SAME locality order as grants, so "grow toward the data
+        node" creates the worker where the bytes are."""
+        needs_tpu = resources.get("TPU", 0) > 0
+        candidates = [n for n in self.nodes.values()
+                      if n.alive and not n.draining]
         for node in self._hybrid_order(candidates, arg_bytes):
-            if _res_fits(node.available, resources):
-                self._maybe_spawn_worker(node, needs_tpu,
-                                         msg.get("runtime_env"),
-                                         tpu_chips=int(resources.get("TPU", 0)))
+            if count <= 0:
                 break
+            if _res_fits(node.available, resources):
+                self._maybe_spawn_worker(node, needs_tpu, runtime_env,
+                                         tpu_chips=int(resources.get("TPU", 0)))
+                count -= 1
+
+    async def _h_lease_worker(self, conn, msg):
+        """Grant an idle worker to the requesting driver for direct task
+        pushes. Returns {lease_id, worker_id, host, port} or {lease_id:
+        None} when nothing is available (caller falls back to the queued
+        controller path, which can also spawn new workers)."""
+        resources: Dict[str, float] = msg.get("resources") or {"CPU": 1.0}
+        # Locality term for the DIRECT path: the driver ships the byte
+        # placement of the task's (cached-location) args so lease grants
+        # rank nodes the same way queue placement does.
+        arg_bytes: Dict[str, int] = msg.get("arg_bytes") or {}
+        got = self._grant_one_lease(conn, resources,
+                                    msg.get("env_hash") or "", arg_bytes)
+        if got is not None:
+            return got
+        self._nudge_lease_spawns(resources, msg.get("runtime_env"),
+                                 arg_bytes)
         return {"lease_id": None}
+
+    async def _h_lease_block(self, conn, msg):
+        """Bulk lease negotiation: grant up to ``count`` workers for one
+        (resources, env) signature in a single round trip (reference: the
+        raylet's lease tables keyed by scheduling class — the owner asks
+        once per class, not once per worker, direct_task_transport.h:75).
+        The driver fans its submission wave across the returned block with
+        zero further controller involvement; partial grants are normal
+        (the driver spills the remainder back through the queued path) and
+        a shortfall nudges spawns so the next negotiation finds workers."""
+        resources: Dict[str, float] = msg.get("resources") or {"CPU": 1.0}
+        env_hash = msg.get("env_hash") or ""
+        arg_bytes: Dict[str, int] = msg.get("arg_bytes") or {}
+        count = max(1, int(msg.get("count", 1)))
+        block_id = uuid.uuid4().hex[:12]
+        grants: List[Dict[str, Any]] = []
+        while len(grants) < count:
+            got = self._grant_one_lease(conn, resources, env_hash,
+                                        arg_bytes, block_id=block_id)
+            if got is None:
+                break
+            grants.append(got)
+        if grants:
+            self.lease_stats["blocks"] += 1
+        else:
+            # Spawn nudges only on an EMPTY grant: a partial block means
+            # the cluster is resource-saturated for this signature, where
+            # a speculative spawn would burn ~50ms in this handler and
+            # produce a worker the lease guard cannot grant anyway.
+            self._nudge_lease_spawns(resources, msg.get("runtime_env"),
+                                     arg_bytes)
+        return {"block_id": block_id if grants else None, "grants": grants}
 
     def _release_lease(self, lease_id: str, to_idle: bool = True) -> None:
         """to_idle=False: the holder vanished without draining (driver
@@ -2106,7 +2182,11 @@ class Controller:
         self._wake_scheduler()
 
     async def _h_release_lease(self, conn, msg):
-        self._release_lease(msg["lease_id"])
+        # Accepts one lease_id or a lease_ids list (a block released in one
+        # framed message — pool shutdown / reclaim hand back N at once).
+        for lid in (msg.get("lease_ids") or
+                    ([msg["lease_id"]] if msg.get("lease_id") else [])):
+            self._release_lease(lid)
         return {"ok": True}
 
     async def _h_resolve_actor(self, conn, msg):
@@ -2844,6 +2924,9 @@ class Controller:
         task-event ring (keyed by task_id, consumed by timeline()), fold
         each phase duration into its derived Prometheus histogram, and
         collect shipped tracing spans for get_cluster_spans()."""
+        import bisect
+
+        hists: Dict[Tuple[str, str], dict] = {}  # (metric,label) -> state
         for ev in msg.get("events", ()):
             entry = {
                 "task_id": ev.get("task_id"),
@@ -2862,8 +2945,32 @@ class Controller:
             label = entry["label"] or "?"
             for key, mname in PHASE_METRIC_NAMES.items():
                 v = entry["phases"].get(key)
-                if v is not None:
-                    self._observe_phase(mname, label, float(v))
+                if v is None:
+                    continue
+                # Resolve each (metric, label) histogram once per shipped
+                # batch, not once per observation — a worker's flush lands
+                # hundreds of same-label events at once and this handler
+                # runs on the controller's hot thread.
+                hk = (mname, label)
+                hist = hists.get(hk)
+                if hist is None:
+                    st = self.app_metrics.setdefault(
+                        mname, {"type": "histogram",
+                                "help": PHASE_METRIC_HELP.get(mname, ""),
+                                "boundaries": list(PHASE_BOUNDARIES),
+                                "data": {}})
+                    h = st["data"].setdefault(
+                        (("label", label),),
+                        {"buckets": [0] * (len(st["boundaries"]) + 1),
+                         "sum": 0.0, "count": 0})
+                    hist = hists[hk] = {"bounds": st["boundaries"], "h": h}
+                v = float(v)
+                h = hist["h"]
+                bounds = hist["bounds"]
+                h["buckets"][min(bisect.bisect_left(bounds, v),
+                                 len(bounds))] += 1
+                h["sum"] += v
+                h["count"] += 1
         for d in msg.get("spans", ()):
             self.cluster_spans.append(d)
         return {"ok": True}
@@ -3133,7 +3240,17 @@ class Controller:
             f"rtpu_uptime_seconds {time.time() - self.start_time:.1f}",
             "# TYPE rtpu_objects_spilled_total counter",
             f"rtpu_objects_spilled_total {self.spilled_count}",
+            # Bulk-lease accounting: active leases + lifetime grant/reclaim
+            # counters so the direct-dispatch control plane is observable.
+            "# TYPE rtpu_leases_active gauge",
+            f"rtpu_leases_active {len(self._leases)}",
+            "# HELP rtpu_lease_events_total Direct-dispatch lease "
+            "lifecycle: blocks/leases granted, reclaim nudges sent, "
+            "grants refused under memory pressure",
+            "# TYPE rtpu_lease_events_total counter",
         ]
+        for k, v in sorted(self.lease_stats.items()):
+            lines.append(f'rtpu_lease_events_total{{event="{k}"}} {v}')
         if self._arena is not None:
             st = self._arena.stats()
             lines += [
@@ -3881,6 +3998,7 @@ class Controller:
         for lid, lease in leases.items():
             owners.setdefault(lease["owner"], []).append(lid)
         for conn, lids in owners.items():
+            self.lease_stats["reclaims"] += len(lids)
             try:
                 await conn.send({"kind": "lease_reclaim", "lease_ids": lids})
             except Exception:

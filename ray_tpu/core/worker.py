@@ -65,7 +65,10 @@ class ActorMailbox:
         self.actor_id = actor_id
         self.instance: Any = None
         self.spec: Optional[Dict[str, Any]] = None  # creation spec (re-claim)
-        self.q: "queue.Queue[Optional[Dict[str, Any]]]" = queue.Queue()
+        # SimpleQueue: C-implemented put/get, no per-op lock dance — the
+        # mailbox hop is on every actor call's critical path.
+        self.q: "queue.SimpleQueue[Optional[Dict[str, Any]]]" = \
+            queue.SimpleQueue()
         self.exited = False  # exit_actor ran: refuse everything queued
         # Per-caller sequence reordering state: caller -> {next, held}.
         self._seq: Dict[str, Dict[str, Any]] = {}
@@ -196,6 +199,58 @@ class ActorMailbox:
             self.runtime.run_task(spec, actor_instance=self.instance, mailbox=self)
 
 
+class _NullSpan:
+    """No-op stand-in for tracing.task_span when the spec carries no trace
+    context — the per-task fast path pays an attribute check, not a scope."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return None
+
+    def __exit__(self, et, ev, tb):
+        return False
+
+    def detach_context(self) -> None:
+        pass
+
+
+_NULL_SPAN = _NullSpan()
+
+
+class _BatchReply:
+    """Aggregation state for one pushed batch: entries contribute their
+    result locations as they finish; the single correlated response
+    resolves when the last one lands."""
+
+    __slots__ = ("loop", "fut", "remaining", "locations", "error_locations",
+                 "lock")
+
+    def __init__(self, loop, fut, n: int):
+        self.loop = loop
+        self.fut = fut
+        self.remaining = n
+        self.locations: List[ObjectLocation] = []
+        self.error_locations: List[ObjectLocation] = []
+        self.lock = threading.Lock()
+
+    def contribute(self, payload: Dict[str, Any]) -> None:
+        with self.lock:
+            self.locations.extend(payload.get("locations") or ())
+            self.error_locations.extend(payload.get("error_locations") or ())
+            self.remaining -= 1
+            if self.remaining > 0:
+                return
+            result = {"locations": self.locations,
+                      "error_locations": self.error_locations}
+
+        def _set():
+            if not self.fut.done():
+                self.fut.set_result(result)
+
+        self.loop.call_soon_threadsafe(_set)
+
+
 class WorkerRuntime:
     def __init__(self, controller_addr: str, node_id: str):
         host, port = controller_addr.rsplit(":", 1)
@@ -205,6 +260,12 @@ class WorkerRuntime:
                                  reconnect=True,
                                  on_reconnect=self._on_reconnect)
         self.pool = ThreadPoolExecutor(max_workers=32, thread_name_prefix="task")
+        # Completion batcher: task_done payloads (acks + result-location
+        # publishes) buffered here coalesce into one task_done_batch frame
+        # per io-loop beat instead of one loop wakeup + pickle per task.
+        self._done_buf: List[Dict[str, Any]] = []
+        self._done_lock = threading.Lock()
+        self._done_scheduled = False
         self.functions: Dict[str, Any] = {}
         self.actors: Dict[str, ActorMailbox] = {}
         self.running_threads: Dict[str, int] = {}  # task_id -> thread ident
@@ -472,16 +533,50 @@ class WorkerRuntime:
         """Peer-pushed actor task: enqueue on the mailbox, answer with the
         result locations when it completes. The response rides the same
         connection (request/response correlation), so the caller gets the
-        locations with zero controller involvement."""
+        locations with zero controller involvement.
+
+        The *_batch kinds carry many specs in one framed message (one
+        unpickle per wave-slice instead of per call); the single response
+        aggregates every entry's result locations and resolves when the
+        last entry finishes — per-entry results stream to the controller
+        via the completion batcher in the meantime, so a mid-batch worker
+        death leaves the caller able to distinguish completed entries
+        (locations published) from never-ran ones."""
         import asyncio
 
-        if msg["kind"].startswith("ref_"):
+        kind = msg["kind"]
+        if kind.startswith("ref_"):
             from . import ownership
 
             return ownership.handle_ref_message(msg)
-        if msg["kind"] == "cancel_task":
+        if kind == "cancel_task":
             self._cancel_task(msg["task_id"])
             return None
+        loop = asyncio.get_running_loop()
+        if kind in ("direct_task_batch", "direct_actor_task_batch"):
+            specs = msg["specs"]
+            fut = loop.create_future()
+            state = _BatchReply(loop, fut, len(specs))
+            if kind == "direct_actor_task_batch":
+                mb = self.actors.get(specs[0]["actor_id"]) if specs else None
+                if mb is None:
+                    # Typed refusal BEFORE any entry runs: the whole batch
+                    # provably never executed, so the caller resubmits it
+                    # through the controller.
+                    raise ActorNotHostedError(
+                        f"actor {(specs[0]['actor_id'][:8]) if specs else '?'}"
+                        f" is not hosted on this worker")
+            now = time.time() if task_events.enabled() else None
+            for spec in specs:
+                if now is not None:
+                    spec["__recv_ts__"] = now
+                spec["__batch__"] = state
+                if kind == "direct_task_batch":
+                    spec["__leased__"] = True
+                    self._lease_submit(spec)
+                else:
+                    mb.submit(spec)
+            return await fut
         spec = msg["spec"]
         if task_events.enabled():
             spec["__recv_ts__"] = time.time()
@@ -493,18 +588,17 @@ class WorkerRuntime:
         # future to a local BEFORE handing the spec over, or a fast task
         # completes (and pops) before this coroutine evaluates the
         # subscript and the await raises KeyError.
-        loop = asyncio.get_running_loop()
         fut = loop.create_future()
-        if msg["kind"] == "direct_task":
+        if kind == "direct_task":
             # Leased stateless task (reference direct_task_transport.h:222):
             # executes SERIALLY — the lease reserves one CPU, so pushed
             # tasks queue here instead of fanning out over the pool.
             spec["__direct__"] = (fut, loop)
             spec["__leased__"] = True
-            self._lease_pool().submit(self.run_task, spec)
+            self._lease_submit(spec)
             return await fut
-        if msg["kind"] != "direct_actor_task":
-            raise ValueError(f"direct server: unknown kind {msg['kind']!r}")
+        if kind != "direct_actor_task":
+            raise ValueError(f"direct server: unknown kind {kind!r}")
         mb = self.actors.get(spec["actor_id"])
         if mb is None:
             # Typed refusal BEFORE any user code runs: the caller knows the
@@ -517,16 +611,31 @@ class WorkerRuntime:
         mb.submit(spec)
         return await fut
 
-    def _lease_pool(self) -> ThreadPoolExecutor:
-        pool = getattr(self, "_lease_exec", None)
-        if pool is None:
-            pool = self._lease_exec = ThreadPoolExecutor(
-                max_workers=1, thread_name_prefix="lease")
-        return pool
+    def _lease_submit(self, spec: Dict[str, Any]) -> None:
+        """Queue a leased task for SERIAL execution. A dedicated thread +
+        SimpleQueue instead of a ThreadPoolExecutor: submit() there takes
+        locks and allocates an unused Future per task — measurable at
+        direct-dispatch rates."""
+        q = getattr(self, "_lease_q", None)
+        if q is None:
+            q = self._lease_q = queue.SimpleQueue()
+
+            def _run() -> None:
+                while True:
+                    s = q.get()
+                    self.run_task(s)
+
+            threading.Thread(target=_run, name="lease",
+                             daemon=True).start()
+        q.put(spec)
 
     def _finish_direct(self, spec: Dict[str, Any], payload: Dict[str, Any]) -> bool:
         """Resolve a direct caller's future; returns True if this spec came
-        through the direct server."""
+        through the direct server (single push or batch entry)."""
+        st = spec.pop("__batch__", None)
+        if st is not None:
+            st.contribute(payload)
+            return True
         df = spec.pop("__direct__", None)
         if df is None:
             return False
@@ -854,22 +963,30 @@ class WorkerRuntime:
             tls.task_id = None
             return
         self.running_threads[task_id] = threading.get_ident()
-        from . import ownership
-
         # Borrow every dep (ordered before the hold_release on the same
         # owner connection), so the submitter's in-flight holds can retire
         # the moment this worker protects the objects itself. The handles
         # die with this frame — after arg VALUES are materialized the dep
-        # bytes are no longer needed here.
-        _held = ownership.acquire_spec_refs(spec)  # noqa: F841
-        from ray_tpu.util.tracing import task_span
+        # bytes are no longer needed here. Guarded on dep_owners so a
+        # dep-less task (the direct-dispatch common case) skips the module
+        # call and its flag read entirely.
+        if spec.get("dep_owners"):
+            from . import ownership
 
+            _held = ownership.acquire_spec_refs(spec)  # noqa: F841
         # Manual span scope: the consumer span must cover the ACTUAL body —
         # for async actor methods the user code runs in the awaited
         # coroutine, so span ownership transfers into drive() and closes
         # there (a `with` around the sync call would record a ~0ms success
-        # for a 10s coroutine and miss its exceptions).
-        span = task_span(spec)
+        # for a 10s coroutine and miss its exceptions). A spec with no
+        # carried trace context (tracing off at the submitter — the
+        # default) gets the no-op span, skipping scope setup per task.
+        if spec.get("trace_ctx"):
+            from ray_tpu.util.tracing import task_span
+
+            span = task_span(spec)
+        else:
+            span = _NULL_SPAN
         span.__enter__()
         span_transferred = False
         try:
@@ -966,6 +1083,46 @@ class WorkerRuntime:
                 # output is complete once the result is observable.
                 self._log_attributor.flush()
 
+    def _ship_done(self, msg: Dict[str, Any]) -> None:
+        """Fire-and-forget a task_done to the controller, coalesced: every
+        payload buffered during one io-loop beat ships as a single framed
+        task_done_batch (one wakeup, one pickle, one syscall). Best-effort
+        exactly like the per-task send it replaces — a batch in flight when
+        the controller bounces is covered by the driver's resubmission and
+        the direct caller's recovery probe, not by redelivery here."""
+        if not flags.get("RTPU_SUBMIT_BATCH"):
+            self.client.send_nowait(msg)
+            return
+        flush_now = False
+        with self._done_lock:
+            self._done_buf.append(msg)
+            if len(self._done_buf) >= flags.get("RTPU_SUBMIT_BATCH_MAX"):
+                flush_now = True
+            elif self._done_scheduled:
+                return
+            self._done_scheduled = True
+        try:
+            if flush_now:
+                self._flush_done_threadsafe()
+            else:
+                self.client.io.loop.call_soon_threadsafe(
+                    self._flush_done_threadsafe)
+        except RuntimeError:
+            pass  # io loop torn down (shutdown): parity with send_nowait
+
+    def _flush_done_threadsafe(self) -> None:
+        with self._done_lock:
+            items, self._done_buf = self._done_buf, []
+            self._done_scheduled = False
+        if not items:
+            return
+        msg = items[0] if len(items) == 1 else {"kind": "task_done_batch",
+                                                "items": items}
+        try:
+            self.client.send_nowait(msg)
+        except Exception:
+            pass
+
     def _record_phases(self, spec: Dict[str, Any], outcome: str) -> None:
         """Finalize + buffer this task's phase event (flight recorder).
         Pops ``__ph__`` so a completion that re-routes (store failure →
@@ -1015,14 +1172,24 @@ class WorkerRuntime:
         if spec.pop("__leased__", False):
             # The controller never saw this (directly-pushed) spec; ship it
             # with the completion so lineage + task events stay complete.
-            msg["spec"] = {k: v for k, v in spec.items()
-                           if not k.startswith("__")}
+            # Fully-inline results need no lineage — the location the
+            # controller stores CARRIES the bytes, so the object can never
+            # need reconstruction; a slim spec (ids + label) keeps the
+            # task-event trail while skipping the args/closure payload and
+            # the controller-side lineage write on the hot path.
+            if all(loc.inline is not None for loc in locations):
+                msg["spec"] = {"task_id": spec["task_id"],
+                               "label": spec.get("label"),
+                               "return_ids": spec["return_ids"]}
+            else:
+                msg["spec"] = {k: v for k, v in spec.items()
+                               if not k.startswith("__")}
             msg["started_ts"] = spec.get("__start_ts__")
         # Fire-and-forget: nothing consumes the ack, and the worker is not
         # eligible for new work until the controller processes this message
         # anyway (state flips to idle there) — so dropping the round trip
         # costs nothing and saves a response pickle + wakeup per task.
-        self.client.send_nowait(msg)
+        self._ship_done(msg)
 
     def _complete_error(self, spec: Dict[str, Any], e: BaseException, tb: str) -> None:
         self._record_phases(spec, "failed")
@@ -1061,7 +1228,7 @@ class WorkerRuntime:
                            if not k.startswith("__")}
             msg["started_ts"] = spec.get("__start_ts__")
         try:
-            self.client.send_nowait(msg)
+            self._ship_done(msg)
         except Exception:
             pass
 

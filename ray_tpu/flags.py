@@ -75,6 +75,25 @@ _define("RTPU_CONTAINER_RUNTIME", str, "podman",
 _define("RTPU_TASK_LEASE_MAX", int, 16,
         "Max leased workers per (resources, env) signature for direct "
         "stateless-task dispatch; 0 disables task leasing entirely.")
+_define("RTPU_LEASE_BLOCK", int, 8,
+        "Workers requested per lease_block controller RPC: one round trip "
+        "grants a block of direct-dispatch workers for a (resources, env) "
+        "signature, so a submission wave fans across the pool with no "
+        "further controller involvement (reference: the raylet granting "
+        "leases per scheduling class, direct_task_transport.h:75). 1 "
+        "degenerates to the old one-lease-per-RPC negotiation.")
+_define("RTPU_SUBMIT_BATCH", bool, True,
+        "Coalesce direct task/actor-call pushes, their completion acks, "
+        "and result-location publishes into multi-entry framed messages: "
+        "specs submitted in the same event-loop beat ride one pickle and "
+        "one syscall per hop (reference: the batched lease/push RPCs in "
+        "direct_task_transport + CoreWorker's batched task-status "
+        "reports). 0 reverts to one message per call; the submit path "
+        "then pays one flag check.")
+_define("RTPU_SUBMIT_BATCH_MAX", int, 512,
+        "Entries per open submit batch: a batch reaching this many pending "
+        "specs is sealed and a new one opened, bounding both frame size "
+        "and the per-batch reply payload.")
 _define("RTPU_DISTRIBUTED_REFS", bool, True,
         "Distributed ownership: ObjectRef handles are counted per process, "
         "borrowers register with owners worker-to-worker, and drained "
@@ -324,6 +343,34 @@ _define("TPU_VISIBLE_CHIPS", str, None,
         external=True)
 
 
+# Hot-path environment access: os.environ.get pays encodekey + a decoded
+# copy on every call (~2us), and flag reads sit on the per-call submit and
+# execute paths. os._Environ keeps the real mapping in ``_data`` keyed by
+# ENCODED names; reading it directly with a precomputed key skips both
+# costs while staying write-coherent (os.environ.__setitem__/__delitem__ —
+# including monkeypatch.setenv — mutate the same dict). Fallback to the
+# public API when the implementation detail is absent.
+_env_data = getattr(os.environ, "_data", None)
+try:
+    _encode_key = os.environ.encodekey  # type: ignore[attr-defined]
+except AttributeError:
+    _env_data = None
+    _encode_key = None
+_keyb: Dict[str, Any] = {}
+
+
+def _env_raw(name: str) -> Optional[str]:
+    if _env_data is None:
+        return os.environ.get(name)
+    kb = _keyb.get(name)
+    if kb is None:
+        kb = _keyb[name] = _encode_key(name)
+    raw = _env_data.get(kb)
+    if raw is None:
+        return None
+    return os.fsdecode(raw)
+
+
 def get(name: str, default: Any = None) -> Any:
     """Read a registered flag from the environment (call-time).
 
@@ -331,7 +378,7 @@ def get(name: str, default: Any = None) -> Any:
     (for call sites with contextual fallbacks).
     """
     f = REGISTRY[name]
-    raw = os.environ.get(name)
+    raw = _env_raw(name)
     if raw is None:
         return default if default is not None else f.default
     if f.type is bool:

@@ -1,0 +1,293 @@
+"""Bulk worker-lease blocks + batched direct pushes under chaos.
+
+Reference behaviors matched: the raylet grants leases per scheduling class
+(direct_task_transport.h:75) and owners push tasks peer-to-peer; here one
+lease_block RPC grants N workers and multi-spec frames carry the pushes.
+The chaos half proves the fast path degrades safely: a leased worker
+SIGKILLed mid-batch re-routes the batch's unacked tasks without loss or
+duplication, and a controller bounce mid-wave completes the wave after the
+driver renegotiates fresh lease blocks (PR-1 reconnect semantics).
+"""
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.testing import WorkerKiller
+
+PKG_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _start_head(port, state_path, log_path=None, extra_env=None):
+    cmd = [sys.executable, "-m", "ray_tpu.testing.head",
+           "--port", str(port), "--state-path", state_path,
+           "--num-cpus", "2"]
+    env = dict(os.environ)
+    env["PYTHONPATH"] = PKG_ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    env.pop("RTPU_ARENA", None)
+    env.pop("RTPU_HOST_ID", None)
+    if extra_env:
+        env.update(extra_env)
+    log = open(log_path or os.devnull, "ab")
+    proc = subprocess.Popen(cmd, env=env, stdout=log,
+                            stderr=subprocess.STDOUT)
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:
+        if proc.poll() is not None:
+            raise RuntimeError(f"head exited rc={proc.returncode}")
+        try:
+            with socket.create_connection(("127.0.0.1", port), timeout=0.2):
+                return proc
+        except OSError:
+            time.sleep(0.05)
+    raise TimeoutError("head did not start listening")
+
+
+def _wait_snapshot(state_path, pred, timeout=30):
+    """Poll the persisted snapshot until `pred(snap)` holds (the health
+    loop writes it within ~2s of a dirtying change)."""
+    import pickle
+
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            with open(state_path, "rb") as f:
+                snap = pickle.load(f)
+            if pred(snap):
+                return snap
+        except Exception:
+            pass
+        time.sleep(0.2)
+    raise TimeoutError(f"snapshot at {state_path} never satisfied predicate")
+
+
+def _cleanup(head, client=None):
+    pids = []
+    if client is not None:
+        try:
+            pids = [w["pid"] for w in client.request(
+                {"kind": "list_state", "what": "workers", "limit": 1000})
+                if w.get("pid")]
+        except Exception:
+            pass
+    try:
+        ray_tpu.shutdown()
+    except Exception:
+        pass
+    if head is not None and head.poll() is None:
+        try:
+            head.terminate()
+            head.wait(timeout=10)
+        except Exception:
+            head.kill()
+    for pid in pids:
+        try:
+            os.kill(pid, signal.SIGKILL)
+        except OSError:
+            pass
+
+
+def _warm_lease_pool(nop, n=8, settle=0.7):
+    ray_tpu.get([nop.remote() for _ in range(n)])
+    time.sleep(settle)  # past the lease backoff
+    ray_tpu.get([nop.remote() for _ in range(16)])
+
+
+def test_lease_block_and_batched_pushes_engage():
+    """A submission wave negotiates its worker pool through lease_block
+    RPCs (not per-worker lease_worker calls), carries pushes in multi-spec
+    frames, and ships completions in task_done_batch frames — all while
+    producing correct results. (Own cluster: the chaos tests in this
+    module manage their own lifecycles, so no module fixture here.)"""
+    ray_tpu.init(num_cpus=4)
+    try:
+        from ray_tpu.core import api
+        from ray_tpu.core import context as ctx
+
+        client = ctx.get_worker_context().client
+
+        @ray_tpu.remote
+        def nop():
+            return None
+
+        @ray_tpu.remote
+        def mul(a, b):
+            return a * b
+
+        _warm_lease_pool(nop)
+        before = client.request({"kind": "rpc_stats"})
+        assert ray_tpu.get([mul.remote(i, 3) for i in range(300)],
+                           timeout=60) == [3 * i for i in range(300)]
+        stats = client.request({"kind": "rpc_stats"})
+        # Bulk negotiation: the pool grew via lease_block (the legacy
+        # single-lease RPC stays available but the driver no longer
+        # uses it).
+        assert stats.get("lease_block", 0) >= 1, stats
+        assert stats.get("lease_worker", 0) == before.get("lease_worker", 0)
+        # The pool actually engaged and completions rode batched frames.
+        assert any(p.routes for p in api._task_pools.values())
+        assert stats.get("task_done_batch", 0) >= 1, stats
+    finally:
+        ray_tpu.shutdown()
+
+
+@pytest.mark.chaos
+def test_worker_killed_mid_batch_reroutes_without_loss_or_dup(tmp_path):
+    """SIGKILL the leased worker while a pushed batch sits behind a slow
+    blocker: nothing behind the blocker ever ran, so the whole batch
+    re-routes through the controller. Every task completes with the right
+    value (no loss) and every side-effect marker is written exactly once
+    (no duplication)."""
+    os.environ["RTPU_TASK_LEASE_MAX"] = "4"
+    try:
+        ray_tpu.init(num_cpus=2)  # lease guard => exactly one leased route
+        from ray_tpu.core import context as ctx
+
+        @ray_tpu.remote
+        def nop():
+            return None
+
+        @ray_tpu.remote(max_retries=2)
+        def slow_marker(path, sec):
+            time.sleep(sec)  # killed mid-sleep => marker never written
+            with open(path, "a") as f:
+                f.write("ran\n")
+            return "slow-ok"
+
+        @ray_tpu.remote(max_retries=2)
+        def marker(path, i):
+            with open(path, "a") as f:
+                f.write("ran\n")
+            return i * 7
+
+        _warm_lease_pool(nop)
+        slow_path = str(tmp_path / "slow.marker")
+        paths = [str(tmp_path / f"m{i}.marker") for i in range(40)]
+        refs = [slow_marker.remote(slow_path, 2.0)]
+        refs += [marker.remote(p, i) for i, p in enumerate(paths)]
+        time.sleep(0.6)  # batch flushed; blocker executing on the lease
+
+        killer = WorkerKiller(
+            worker_filter=lambda w: w.get("state") == "leased")
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            if killer.kill_once():
+                break
+            time.sleep(0.1)
+        assert killer.kills, "no leased worker found to kill"
+
+        out = ray_tpu.get(refs, timeout=120)
+        assert out[0] == "slow-ok"
+        assert out[1:] == [i * 7 for i in range(40)]  # no task lost
+        for p in [slow_path] + paths:  # no task ran twice
+            with open(p) as f:
+                assert f.read() == "ran\n", p
+    finally:
+        os.environ.pop("RTPU_TASK_LEASE_MAX", None)
+        ray_tpu.shutdown()
+
+
+@pytest.mark.chaos
+def test_controller_bounce_mid_wave_completes_and_renegotiates(tmp_path):
+    """SIGKILL the controller while a pushed wave is mid-flight on leased
+    workers. The live direct connections finish the wave (results arrive
+    with zero controller involvement; the retired routes drain), the
+    reconnect path drops the stale lease ledger, and the next wave
+    renegotiates fresh lease blocks against the restarted controller —
+    with every result correct and every side effect exactly once."""
+    port = _free_port()
+    state = str(tmp_path / "state.pkl")
+    head = _start_head(port, state, log_path=str(tmp_path / "head1.log"))
+    client = None
+    try:
+        ray_tpu.init(address=f"127.0.0.1:{port}")
+        from ray_tpu.core import context as ctx
+
+        client = ctx.get_worker_context().client
+
+        @ray_tpu.remote
+        def nop():
+            return None
+
+        @ray_tpu.remote
+        def slow_then(x, sec):
+            time.sleep(sec)
+            return x + 1
+
+        @ray_tpu.remote
+        def marker(path, i):
+            with open(path, "a") as f:
+                f.write("ran\n")
+            return i + 100
+
+        _warm_lease_pool(nop)
+        # Register every function blob with the controller and wait for
+        # the snapshot to persist the function table: post-bounce workers
+        # resolve func_ids from the RESTARTED controller's table.
+        assert ray_tpu.get(slow_then.remote(0, 0.0), timeout=60) == 1
+        p0 = str(tmp_path / "warm.marker")
+        assert ray_tpu.get(marker.remote(p0, 0), timeout=60) == 100
+        _wait_snapshot(state, lambda s: len(s.get("functions", {})) >= 3
+                       and s.get("nodes"))
+        paths = [str(tmp_path / f"w{i}.marker") for i in range(30)]
+        # Pin the WHOLE wave to the direct path: a saturated-pool growth
+        # attempt spills one submit to the controller queue, and
+        # controller-path specs are resubmitted on reconnect (PR-1's
+        # documented at-least-once semantics) — this test asserts the
+        # DIRECT path's exactly-once behavior across the bounce, so keep
+        # growth (and thus spill) quiet for the submission burst.
+        from ray_tpu.core import api
+
+        for pool in api._task_pools.values():
+            with pool.lock:
+                pool.next_try = time.monotonic() + 30
+        # Blocker first: everything behind it is still unacked in the
+        # leased worker's queue when the controller dies.
+        refs = [slow_then.remote(41, 4.0)]
+        refs += [marker.remote(p, i) for i, p in enumerate(paths)]
+        time.sleep(0.5)  # batches flushed to the worker; blocker running
+
+        head.send_signal(signal.SIGKILL)
+        head.wait(timeout=10)
+        head = _start_head(port, state,
+                           extra_env={"RTPU_RECONNECT_GRACE_S": "6"},
+                           log_path=str(tmp_path / "head2.log"))
+
+        # First controller-touching call trips the reconnect path: the
+        # driver re-registers and retires the stale lease routes (busy
+        # ones keep serving their in-flight batches until drained).
+        assert ray_tpu.nodes()
+
+        out = ray_tpu.get(refs, timeout=120)
+        assert out[0] == 42
+        assert out[1:] == [i + 100 for i in range(30)]
+        for p in paths:  # the bounce did not double-run acked work
+            with open(p) as f:
+                assert f.read() == "ran\n", p
+
+        # A fresh wave renegotiates lease blocks with the NEW controller.
+        assert ray_tpu.get([nop.remote() for _ in range(8)],
+                           timeout=120) == [None] * 8
+        time.sleep(0.7)
+        assert ray_tpu.get(
+            [slow_then.remote(i, 0.0) for i in range(20)],
+            timeout=120) == [i + 1 for i in range(20)]
+        stats = client.request({"kind": "rpc_stats"})
+        assert stats.get("lease_block", 0) >= 1, stats
+    finally:
+        _cleanup(head, client)

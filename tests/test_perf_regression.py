@@ -135,6 +135,54 @@ def test_events_watchdog_disabled_path_overhead(ray_start_regular,
         f"events-disabled task throughput {200/dt:.0f}/s below floor"
 
 
+def test_submit_batch_disabled_path_overhead(ray_start_regular,
+                                             monkeypatch):
+    """Submit-batching guard (mirrors the RTPU_TASK_EVENTS guard): with
+    RTPU_SUBMIT_BATCH=0 every direct push reverts to one message per call
+    and the submit path pays one flag check — the round-trip holds the
+    same throughput floor as the always-on benchmark, so the batching
+    subsystem can never silently tax the unbatched path."""
+    monkeypatch.setenv("RTPU_SUBMIT_BATCH", "0")
+
+    @ray_tpu.remote
+    def nop():
+        return None
+
+    ray_tpu.get([nop.remote() for _ in range(8)])  # warm the pool
+    t0 = time.perf_counter()
+    ray_tpu.get([nop.remote() for _ in range(200)])
+    dt = time.perf_counter() - t0
+    assert 200 / dt > 30, \
+        f"batching-disabled task throughput {200/dt:.0f}/s below floor"
+
+
+@pytest.mark.slow
+def test_task_throughput_2x_r05_floor(ray_start_regular):
+    """Bulk-lease/batched-push win guard: steady-state submit+get waves
+    must beat 2x the r05 baseline (2910 tasks/s, benchmarks/PERF.json at
+    round 5) so the control-plane scale-out can't silently regress.
+    Slow-marked: a full-size wave on a loaded CI host is too noisy for
+    tier-1, and the unmarked floors above already catch order-of-magnitude
+    breakage."""
+
+    @ray_tpu.remote
+    def nop():
+        return None
+
+    ray_tpu.get([nop.remote() for _ in range(8)])
+    time.sleep(0.7)  # past the lease backoff: steady-state direct path
+    ray_tpu.get([nop.remote() for _ in range(64)])
+    best = 0.0
+    for _ in range(3):
+        time.sleep(0.3)
+        t0 = time.perf_counter()
+        ray_tpu.get([nop.remote() for _ in range(2000)])
+        dt = time.perf_counter() - t0
+        best = max(best, 2000 / dt)
+    assert best > 2 * 2910, \
+        f"task throughput {best:.0f}/s below 2x r05 baseline (5820/s)"
+
+
 def test_large_object_bandwidth_floor(ray_start_regular):
     arr = np.ones(4 * 1024 * 1024, dtype=np.float64)  # 32MB
     t0 = time.perf_counter()

@@ -57,6 +57,10 @@ def test_idle_lease_released(ray_start_regular, monkeypatch):
     from ray_tpu.core import api
 
     monkeypatch.setattr(api, "_LEASE_IDLE_S", 0.2)
+    # Block size 1: the reap-triggering submit below must not itself
+    # renegotiate a whole fresh lease block after reaping the idle ones —
+    # this test pins the release behavior, not the bulk-negotiation width.
+    monkeypatch.setenv("RTPU_LEASE_BLOCK", "1")
 
     @ray_tpu.remote
     def nop():
