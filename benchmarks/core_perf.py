@@ -212,6 +212,92 @@ def main():
 
     run_metric(results, "fanin_1000_refs_s", fanin_metric)
 
+    # 8. cross-node transfer: streamed pull vs the serial per-chunk
+    # baseline, and one-hop broadcast. A second/third "host" is simulated
+    # via distinct RTPU_HOST_ID agents so the bytes really stream over TCP
+    # (the same trick the transfer tests use).
+    from ray_tpu.core.cluster_utils import Cluster
+
+    cluster = Cluster(initialize_head=False)
+
+    def transfer_metric():
+        from ray_tpu.util.scheduling_strategies import (
+            NodeAffinitySchedulingStrategy)
+
+        nid = cluster.add_node({"CPU": 2}, remote=True,
+                               host_id="bench-host-b")
+
+        @ray_tpu.remote(scheduling_strategy=NodeAffinitySchedulingStrategy(
+            node_id=nid, soft=False))
+        def produce(seed):
+            return np.full(16 * 1024 * 1024, seed, dtype=np.float64)  # 128MB
+
+        def measure(n_runs=3):
+            best = 0.0
+            for seed in range(n_runs):
+                ref = produce.remote(float(seed))
+                ray_tpu.wait([ref], num_returns=1, timeout=120,
+                             fetch_local=False)
+                t0 = time.perf_counter()
+                out = ray_tpu.get(ref, timeout=120)
+                dt = time.perf_counter() - t0
+                assert float(out[0]) == float(seed)
+                best = max(best, out.nbytes / dt / 1e9)
+                ray_tpu.free([ref])
+                del out
+            return best
+
+        stream = measure()
+        os.environ["RTPU_PULL_STREAM"] = "0"
+        try:
+            serial = measure()
+        finally:
+            os.environ.pop("RTPU_PULL_STREAM", None)
+        for name, val in (("transfer_gbps", stream),
+                          ("transfer_serial_gbps", serial)):
+            r = {"metric": name, "value": round(val, 2), "unit": "GB/s",
+                 "n": 0.128}
+            if name == "transfer_gbps":
+                r["vs_serial"] = round(stream / serial, 2)
+            print(json.dumps(r), flush=True)
+            results.append(r)
+
+    run_metric(results, "transfer_gbps", transfer_metric)
+
+    def broadcast_metric():
+        nid_c = cluster.add_node({"CPU": 1}, remote=True,
+                                 host_id="bench-host-c")
+        nid_d = cluster.add_node({"CPU": 1}, remote=True,
+                                 host_id="bench-host-d")
+        targets_by_n = {1: [nid_c], 2: [nid_c, nid_d]}
+        arr = np.ones(8 * 1024 * 1024, dtype=np.float64)  # 64MB
+        for n, targets in sorted(targets_by_n.items()):
+            ref = ray_tpu.put(arr)
+            t0 = time.perf_counter()
+            res = ray_tpu.broadcast(ref, targets, timeout=180)
+            dt = time.perf_counter() - t0
+            assert res["ok"], f"broadcast failed: {res}"
+            r = {"metric": f"broadcast_gbps_n{n}",
+                 "value": round(n * arr.nbytes / dt / 1e9, 2),
+                 "unit": "GB/s", "n": n,
+                 # The acceptance signal: bytes leaving the SOURCE stay
+                 # ~one object size however many nodes receive a copy.
+                 "source_bytes": res["stats"]["source_bytes"],
+                 "object_bytes": arr.nbytes,
+                 "wall_s": round(dt, 3)}
+            print(json.dumps(r), flush=True)
+            results.append(r)
+            ray_tpu.free([ref])
+            time.sleep(0.2)
+
+    run_metric(results, "broadcast_gbps", broadcast_metric)
+
+    for proc in cluster._agent_procs:
+        try:
+            proc.terminate()
+        except Exception:
+            pass
+
     ray_tpu.shutdown()
     return results
 

@@ -23,6 +23,7 @@ from .core.api import (
     ObjectRefGenerator,
     RemoteFunction,
     available_resources,
+    broadcast,
     cluster_resources,
     free,
     get,
@@ -66,6 +67,7 @@ __all__ = [
     "put",
     "wait",
     "free",
+    "broadcast",
     "cancel",
     "exit_actor",
     "kill",

@@ -393,9 +393,12 @@ def get(refs: Union[ObjectRef, Sequence[ObjectRef]], *, timeout: Optional[float]
               if r.owner and r.object_id in missing}
 
     def fetch():
+        # node_id: the controller resolves replica-aware (consumer-local
+        # copies of broadcast objects beat cross-host pulls).
         return wc.client.request(
             {"kind": "get_locations", "object_ids": missing,
-             "timeout": remaining_timeout, "owners": owners}
+             "timeout": remaining_timeout, "owners": owners,
+             "node_id": wc.node_id}
         )
 
     locs = _with_block_notify(fetch) if missing else {}
@@ -416,7 +419,7 @@ def get(refs: Union[ObjectRef, Sequence[ObjectRef]], *, timeout: Optional[float]
             # the authority.
             loc = wc.client.request(
                 {"kind": "get_locations", "object_ids": [oid],
-                 "timeout": remaining_timeout})[oid]
+                 "timeout": remaining_timeout, "node_id": wc.node_id})[oid]
         val, loc = get_bytes_with_refresh(loc, oid, wc.client.request)
         if loc.is_error:
             if isinstance(val, BaseException):
@@ -424,6 +427,33 @@ def get(refs: Union[ObjectRef, Sequence[ObjectRef]], *, timeout: Optional[float]
             raise RuntimeError(str(val))
         out.append(val)
     return out[0] if single else out
+
+
+def broadcast(ref: ObjectRef, node_ids: Optional[Sequence[str]] = None,
+              *, timeout: float = 120.0) -> Dict[str, Any]:
+    """Replicate one object's bytes onto N nodes in a single pass.
+
+    The bytes move source -> N over a pipelined chain of hosts (each hop
+    stores a full local copy while forwarding downstream), so the producer
+    ships each byte ~once regardless of fan-out — the weight-distribution
+    primitive for async-RL topologies (reference: Ray's object-manager
+    Push + ray.experimental.channel broadcast). Afterwards, ``get()`` (and
+    task argument resolution) on a target node reads the local replica
+    over shared memory.
+
+    ``node_ids=None`` targets every alive node that doesn't already hold
+    the bytes. Returns ``{ok, replicas: {node_id: "ok"}, skipped: {...},
+    stats: {source_bytes}, rounds}``; nodes that die or drain mid-flight
+    are re-routed onto a fresh chain and reported in ``skipped``.
+    """
+    if not isinstance(ref, ObjectRef):
+        raise TypeError(f"broadcast() expects an ObjectRef, got {type(ref)}")
+    wc = ctx.get_worker_context()
+    return wc.client.request(
+        {"kind": "broadcast_object", "object_id": ref.object_id,
+         "node_ids": list(node_ids) if node_ids is not None else None,
+         "timeout": timeout},
+        timeout=timeout + 10)
 
 
 def wait(

@@ -297,6 +297,17 @@ class Controller:
         self.actors: Dict[str, ActorInfo] = {}
         self.named_actors: Dict[Tuple[str, str], str] = {}  # (namespace, name) -> actor_id
         self.objects: Dict[str, ObjectLocation] = {}
+        # Broadcast replicas: oid -> {node_id: ObjectLocation} — full extra
+        # copies of an object's bytes on other hosts (reference: the object
+        # directory tracking multiple locations per object,
+        # object_directory.h). get_locations prefers the consumer-local
+        # copy; remote consumers get the list for parallel pulls.
+        self.object_replicas: Dict[str, Dict[str, ObjectLocation]] = {}
+        # In-flight broadcast rounds: bid -> shared completion state.
+        self._broadcasts: Dict[str, Dict[str, Any]] = {}
+        # Cumulative broadcast byte accounting for /metrics
+        # (rtpu_broadcast_bytes_total{role}).
+        self.broadcast_bytes: Dict[str, int] = {"source": 0, "hop": 0}
         self.object_waiters: Dict[str, List[asyncio.Event]] = {}
         # oid -> callbacks fired (once) when the object's location lands;
         # the incremental path used by wait (vs the Event-based get path).
@@ -527,6 +538,8 @@ class Controller:
 
     async def shutdown(self) -> None:
         self._closing = True
+        for t in getattr(self, "_bcast_push_tasks", ()):  # in-flight chains
+            t.cancel()
         self._snapshot_state()
         for w in list(self.workers.values()):
             try:
@@ -659,11 +672,21 @@ class Controller:
             if nid == node.node_id:
                 self._agent_spawns.pop(tok, None)
                 self._tpu_spawn_tokens.discard(tok)
-        # Objects whose bytes lived only on the dead host are lost. If the
-        # producing task's spec is in the lineage table and its deps are
-        # still resolvable, re-execute it (reference:
-        # object_recovery_manager.h ReconstructObject); otherwise store a
-        # clear error so a later get() doesn't dial a dead pull server.
+        # Replicas hosted on the dead host are gone; prune them first so
+        # promotion below never hands out a dead copy.
+        for oid, reps in list(self.object_replicas.items()):
+            for nid in [k for k, r in reps.items()
+                        if r.host_id == node.host_id]:
+                reps.pop(nid, None)
+            if not reps:
+                self.object_replicas.pop(oid, None)
+        # Objects whose bytes lived only on the dead host are lost. A
+        # surviving broadcast replica is promoted to primary (no recompute,
+        # no re-pull); else if the producing task's spec is in the lineage
+        # table and its deps are still resolvable, re-execute it
+        # (reference: object_recovery_manager.h ReconstructObject);
+        # otherwise store a clear error so a later get() doesn't dial a
+        # dead pull server.
         resubmitted: Set[str] = set()
         for oid, loc in list(self.objects.items()):
             if (
@@ -671,6 +694,8 @@ class Controller:
                 and loc.host_id is not None
                 and loc.host_id == node.host_id
             ):
+                if self._promote_replica(oid):
+                    continue
                 if self._maybe_reconstruct(oid, resubmitted):
                     continue
                 self._store_error(
@@ -681,6 +706,21 @@ class Controller:
                     ),
                 )
         self._wake_scheduler()
+
+    def _promote_replica(self, oid: str) -> bool:
+        """Primary copy lost: promote a surviving broadcast replica to the
+        object table so consumers (and lineage) never notice."""
+        reps = self.object_replicas.get(oid)
+        if not reps:
+            return False
+        for nid, rep in list(reps.items()):
+            if self._host_alive(rep.host_id):
+                reps.pop(nid, None)
+                if not reps:
+                    self.object_replicas.pop(oid, None)
+                self.objects[oid] = rep
+                return True
+        return False
 
     def _maybe_reconstruct(self, oid: str, resubmitted: Set[str]) -> bool:
         """Resubmit the producing task of a lost object. Single-level: deps
@@ -1264,6 +1304,10 @@ class Controller:
         ids: List[str] = msg["object_ids"]
         timeout = msg.get("timeout")
         owners: Dict[str, str] = msg.get("owners") or {}
+        # Consumer node (when the requester reports it): replica-aware
+        # resolution hands back the copy local to that host, so a
+        # broadcast object is read over shm instead of re-pulled.
+        req_node = msg.get("node_id")
         deadline = None if timeout is None else time.monotonic() + timeout
         out: Dict[str, ObjectLocation] = {}
         now = time.monotonic()
@@ -1276,7 +1320,8 @@ class Controller:
                 # restart.
                 await self._owner_locate(oid, owners[oid])
             try:
-                out[oid] = await self._wait_for_object(oid, deadline)
+                loc = await self._wait_for_object(oid, deadline)
+                out[oid] = self._replica_view(oid, loc, req_node)
                 self.object_touch[oid] = now
             except asyncio.TimeoutError:
                 raise GetTimeoutError(f"object {oid[:8]} not ready within {timeout}s") from None
@@ -1480,19 +1525,28 @@ class Controller:
         for oid in msg["object_ids"]:
             loc = self.objects.pop(oid, None)
             self.object_touch.pop(oid, None)
+            # Broadcast replicas die with the primary: each copy frees on
+            # its own host (same routing as the primary's bytes).
+            reps = self.object_replicas.pop(oid, None)
+            for rep in (reps or {}).values():
+                await self._free_one_location(rep)
             if loc is None:
                 continue
-            if loc.host_id is not None and loc.host_id != self.host_id:
-                # Bytes live on another host: route the free to its agent.
-                node = self.nodes.get(loc.node_id or "")
-                if node is not None and node.agent_conn is not None:
-                    try:
-                        await node.agent_conn.send({"kind": "free_object", "loc": loc})
-                    except Exception:
-                        pass
-                continue
-            free_location(loc)
+            await self._free_one_location(loc)
         return {"ok": True}
+
+    async def _free_one_location(self, loc: ObjectLocation) -> None:
+        if loc.host_id is not None and loc.host_id != self.host_id:
+            # Bytes live on another host: route the free to its agent.
+            node = self.nodes.get(loc.node_id or "")
+            if node is not None and node.agent_conn is not None:
+                try:
+                    await node.agent_conn.send(
+                        {"kind": "free_object", "loc": loc})
+                except Exception:
+                    pass
+            return
+        free_location(loc)
 
     async def _h_register_function(self, conn, msg):
         self.functions[msg["func_id"]] = msg["blob"]
@@ -2833,6 +2887,15 @@ class Controller:
             if (loc.inline is not None or loc.is_error
                     or loc.host_id != node.host_id):
                 continue
+            # A broadcast replica on a surviving host already re-homes the
+            # bytes: promote it instead of pulling them to head spill.
+            reps = self.object_replicas.get(oid) or {}
+            rep = next((r for nid, r in reps.items()
+                        if nid != node.node_id and r.host_id != node.host_id
+                        and self._host_alive(r.host_id)), None)
+            if rep is not None:
+                self.objects[oid] = rep
+                continue
             path = os.path.join(spill_dir(), f"{oid[:32]}.bin")
             try:
                 with open(path, "wb") as f:
@@ -3240,6 +3303,19 @@ class Controller:
             f"rtpu_uptime_seconds {time.time() - self.start_time:.1f}",
             "# TYPE rtpu_objects_spilled_total counter",
             f"rtpu_objects_spilled_total {self.spilled_count}",
+            # Broadcast byte accounting: 'source' is what left the origin
+            # host (~one object size per broadcast regardless of fan-out),
+            # 'hop' is the sum received across all chain hops.
+            "# HELP rtpu_broadcast_bytes_total Object bytes moved by "
+            "broadcast chains, by role (source/hop)",
+            "# TYPE rtpu_broadcast_bytes_total counter",
+            f'rtpu_broadcast_bytes_total{{role="source"}} '
+            f'{self.broadcast_bytes["source"]}',
+            f'rtpu_broadcast_bytes_total{{role="hop"}} '
+            f'{self.broadcast_bytes["hop"]}',
+            "# TYPE rtpu_object_replicas gauge",
+            f"rtpu_object_replicas "
+            f"{sum(len(r) for r in self.object_replicas.values())}",
             # Bulk-lease accounting: active leases + lifetime grant/reclaim
             # counters so the direct-dispatch control plane is observable.
             "# TYPE rtpu_leases_active gauge",
@@ -3573,6 +3649,318 @@ class Controller:
         from .transfer import read_location_range
 
         return read_location_range(msg["loc"], msg["offset"], msg["length"])
+
+    async def _h_pull_stream(self, conn, msg):
+        """Streamed pull of head-host object bytes: chunks ship back-to-back
+        under the consumer's credit window (transfer.py protocol)."""
+        from . import transfer
+
+        return await transfer.handle_pull_server_message(conn, msg)
+
+    async def _h_pull_credit(self, conn, msg):
+        from . import transfer
+
+        return await transfer.handle_pull_server_message(conn, msg)
+
+    # ------------------------------------------------- broadcast / replicas
+    # One-hop broadcast (reference: ray.experimental.channel's bounded
+    # broadcast + the pull manager's location fan-out): the source streams
+    # each byte once down a pipelined chain of hosts; every hop stores a
+    # full local replica and reports it here, so later consumer-local
+    # get_locations never cross the network again.
+
+    def _head_node_id(self) -> str:
+        for n in self.nodes.values():
+            if n.agent_conn is None and n.alive:
+                return n.node_id
+        return "head"
+
+    def _node_host(self, node: "NodeInfo") -> Optional[str]:
+        """A node's host identity; agent-less (head/virtual) nodes live in
+        the controller's process and share its host."""
+        return node.host_id or self.host_id
+
+    async def _replicate_report(self, payload):
+        await self._h_replica_added(None, payload)
+
+    async def _h_replicate_begin(self, conn, msg):
+        from . import transfer
+
+        return await transfer.handle_replicate_message(
+            conn, msg, node_id=self._head_node_id(),
+            report=self._replicate_report)
+
+    async def _h_replicate_chunk(self, conn, msg):
+        from . import transfer
+
+        return await transfer.handle_replicate_message(
+            conn, msg, node_id=self._head_node_id(),
+            report=self._replicate_report)
+
+    async def _h_replicate_end(self, conn, msg):
+        from . import transfer
+
+        return await transfer.handle_replicate_message(
+            conn, msg, node_id=self._head_node_id(),
+            report=self._replicate_report)
+
+    async def _h_replica_added(self, conn, msg):
+        """A chain hop sealed its local copy: record the replica location
+        and resolve the owning broadcast's pending set."""
+        oid = msg["object_id"]
+        loc: ObjectLocation = msg["loc"]
+        node_id = msg["node_id"]
+        if oid in self.objects:
+            self.object_replicas.setdefault(oid, {})[node_id] = loc
+        else:
+            # Object freed while the chain was in flight: release the
+            # hop's freshly sealed storage instead of leaking it.
+            await self._free_one_location(loc)
+        self.broadcast_bytes["hop"] += int(msg.get("bytes_in") or 0)
+        st = self._broadcasts.get(msg.get("bid") or "")
+        if st is not None:
+            st["done"][node_id] = "ok"
+            st["pending"].discard(node_id)
+            st["event"].set()
+        return {"ok": True}
+
+    async def _h_replicate_push_done(self, conn, msg):
+        """Source-side completion report: bytes the source actually shipped
+        (each byte once, independent of chain length)."""
+        self.broadcast_bytes["source"] += int(msg.get("bytes") or 0)
+        st = self._broadcasts.get(msg.get("bid") or "")
+        if st is not None:
+            st["stats"]["source_bytes"] += int(msg.get("bytes") or 0)
+            if msg.get("error"):
+                st["stats"].setdefault("errors", []).append(msg["error"])
+            st["pushes"] -= 1
+            st["event"].set()
+        return None
+
+    def _broadcast_targets(self, loc: ObjectLocation,
+                           node_ids: Optional[List[str]],
+                           reps: Dict[str, ObjectLocation]):
+        """Resolve + filter broadcast targets: alive, not draining, with a
+        reachable sink, one per host, skipping hosts that already hold the
+        bytes. Returns ([NodeInfo...], {node_id: skip_reason})."""
+        if node_ids:
+            nodes = []
+            skipped: Dict[str, str] = {}
+            for nid in node_ids:
+                node = self.nodes.get(nid) or next(
+                    (n for k, n in self.nodes.items() if k.startswith(nid)),
+                    None)
+                if node is None:
+                    skipped[nid] = "unknown node"
+                else:
+                    nodes.append(node)
+        else:
+            nodes, skipped = list(self.nodes.values()), {}
+        have = {loc.host_id} | {r.host_id for r in reps.values()}
+        out, seen_hosts = [], set()
+        for node in nodes:
+            host = self._node_host(node)
+            if not node.alive or node.drained:
+                skipped[node.node_id] = "node not alive"
+            elif node.node_id in self.pending_drains:
+                skipped[node.node_id] = "node draining"
+            elif host in have or node.node_id in reps:
+                skipped[node.node_id] = "already local"
+            elif host in seen_hosts:
+                skipped[node.node_id] = "host already targeted"
+            elif node.agent_conn is not None and node.agent_addr is None:
+                skipped[node.node_id] = "no sink address"
+            else:
+                seen_hosts.add(host)
+                out.append(node)
+        return out, skipped
+
+    def _broadcast_sink(self, node: "NodeInfo") -> Dict[str, Any]:
+        if node.agent_addr is not None:
+            return {"node_id": node.node_id, "host": node.agent_addr[0],
+                    "port": node.agent_addr[1]}
+        return {"node_id": node.node_id, "host": self.host,
+                "port": self.port}
+
+    async def _launch_broadcast_chain(self, bid: str, loc: ObjectLocation,
+                                      chain: List[Dict[str, Any]],
+                                      st: Dict[str, Any]) -> bool:
+        """Start one chain round from wherever the bytes live: the
+        controller itself for head-host sources, else the source host's
+        agent (replicate_push)."""
+        from . import transfer
+
+        if loc.host_id == self.host_id:
+            st["pushes"] += 1
+
+            async def _push():
+                try:
+                    sent = await transfer.push_replicate_chain(loc, chain, bid)
+                    st["stats"]["source_bytes"] += sent
+                    self.broadcast_bytes["source"] += sent
+                except asyncio.CancelledError:
+                    raise
+                except Exception as e:  # noqa: BLE001 — retried by the round loop
+                    st["stats"].setdefault("errors", []).append(repr(e)[:300])
+                st["pushes"] -= 1
+                st["event"].set()
+
+            task = asyncio.get_running_loop().create_task(_push())
+            tasks = getattr(self, "_bcast_push_tasks", None)
+            if tasks is None:
+                tasks = self._bcast_push_tasks = set()
+            tasks.add(task)
+            task.add_done_callback(tasks.discard)
+            return True
+        src_node = next(
+            (n for n in self.nodes.values()
+             if n.alive and n.agent_conn is not None
+             and n.host_id == loc.host_id), None)
+        if src_node is None:
+            return False
+        try:
+            await src_node.agent_conn.request(
+                {"kind": "replicate_push", "bid": bid, "loc": loc,
+                 "chain": chain, "chunk": flags.get("RTPU_PULL_CHUNK"),
+                 "window": flags.get("RTPU_PULL_WINDOW")}, timeout=10)
+            st["pushes"] += 1
+            return True
+        except Exception:
+            return False
+
+    async def _h_broadcast_object(self, conn, msg):
+        """rtpu.broadcast backend: replicate one object's bytes onto N
+        hosts over a pipelined chain. Re-routes remaining targets on a
+        fresh chain when a hop dies or drains mid-flight; source-side
+        bytes stay ~one object size per round regardless of N."""
+        oid = msg["object_id"]
+        timeout = float(msg.get("timeout") or 120.0)
+        deadline = time.monotonic() + timeout
+        loc = await self._wait_for_object(oid, deadline)
+        if loc.is_error:
+            raise ObjectLostError(f"cannot broadcast errored object {oid[:8]}")
+        reps = self.object_replicas.setdefault(oid, {})
+        if loc.inline is not None:
+            # Inline bytes ride the control plane with the location itself:
+            # every consumer already gets a local copy.
+            return {"ok": True, "inline": True, "replicas": {}, "skipped": {},
+                    "stats": {"source_bytes": 0}}
+        targets, skipped = self._broadcast_targets(
+            loc, msg.get("node_ids"), reps)
+        st = {
+            "pending": {n.node_id for n in targets},
+            "done": {nid: "already local" for nid in skipped
+                     if skipped[nid] == "already local"},
+            "event": asyncio.Event(),
+            "stats": {"source_bytes": 0},
+            "pushes": 0,  # launched chains still owing a byte report
+        }
+        rounds = 0
+        bids: List[str] = []
+        while st["pending"] and rounds < 3 and time.monotonic() < deadline:
+            rounds += 1
+            live = []
+            for nid in sorted(st["pending"]):
+                node = self.nodes.get(nid)
+                if node is None or not node.alive or node.drained \
+                        or nid in self.pending_drains:
+                    st["pending"].discard(nid)
+                    st["done"][nid] = "node left during broadcast"
+                    continue
+                live.append(node)
+            if not live:
+                break
+            bid = ObjectID.generate()[:16]
+            # Registered until the RPC returns (not per round): late
+            # replica_added / push-done reports must still resolve state.
+            self._broadcasts[bid] = st
+            bids.append(bid)
+            chain = [self._broadcast_sink(n) for n in live]
+            src = loc
+            if not await self._launch_broadcast_chain(bid, src, chain, st):
+                # Source host gone: any sealed replica can re-seed.
+                reseed = next((r for r in reps.values()
+                               if self._host_alive(r.host_id)), None)
+                if reseed is None or not await self._launch_broadcast_chain(
+                        bid, reseed, chain, st):
+                    break
+            round_deadline = min(deadline,
+                                 time.monotonic() + max(10.0, timeout / 3))
+            while st["pending"] and time.monotonic() < round_deadline:
+                st["event"].clear()
+                # Nodes that die or drain mid-round are re-routed next round.
+                changed = False
+                for nid in list(st["pending"]):
+                    node = self.nodes.get(nid)
+                    if node is None or not node.alive \
+                            or nid in self.pending_drains:
+                        changed = True
+                if changed:
+                    break
+                try:
+                    await asyncio.wait_for(
+                        st["event"].wait(),
+                        max(0.05, min(0.5, round_deadline - time.monotonic())))
+                except asyncio.TimeoutError:
+                    pass
+        # Let in-flight source pushes report their byte counts before the
+        # reply is built (stats.source_bytes is the acceptance signal that
+        # each byte left the source once).
+        drain_deadline = time.monotonic() + 5.0
+        while st["pushes"] > 0 and time.monotonic() < drain_deadline:
+            st["event"].clear()
+            try:
+                await asyncio.wait_for(st["event"].wait(), 0.25)
+            except asyncio.TimeoutError:
+                pass
+        for b in bids:
+            self._broadcasts.pop(b, None)
+        for nid in st["pending"]:
+            st["done"][nid] = "timed out"
+        return {
+            "ok": not st["pending"],
+            "replicas": {nid: v for nid, v in st["done"].items()
+                         if v == "ok"},
+            "skipped": {**skipped,
+                        **{nid: v for nid, v in st["done"].items()
+                           if v not in ("ok",)}},
+            "stats": st["stats"],
+            "rounds": rounds,
+        }
+
+    def _host_alive(self, host_id: Optional[str]) -> bool:
+        if host_id == self.host_id:
+            return True
+        return any(n.alive and n.host_id == host_id
+                   for n in self.nodes.values())
+
+    def _replica_view(self, oid: str, loc: ObjectLocation,
+                      req_node_id: Optional[str]) -> ObjectLocation:
+        """Consumer-aware location: hand back the copy local to the
+        requester's host when one exists; otherwise attach the replica
+        list so the pull can fan across source hosts."""
+        reps = self.object_replicas.get(oid)
+        if not reps or loc.inline is not None:
+            return loc
+        req_host = None
+        if req_node_id:
+            node = self.nodes.get(req_node_id)
+            if node is not None:
+                req_host = self._node_host(node)
+        if req_host:
+            if loc.host_id == req_host:
+                return loc
+            for rep in reps.values():
+                if rep.host_id == req_host:
+                    return rep
+        extra = [r for r in reps.values()
+                 if r.host_id != loc.host_id
+                 and self._host_alive(r.host_id)]
+        if not extra:
+            return loc
+        import dataclasses as _dc
+
+        return _dc.replace(loc, replicas=extra)
 
     def _restore_state(self) -> None:
         self._restored_detached: List[Dict[str, Any]] = []

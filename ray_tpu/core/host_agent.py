@@ -35,9 +35,8 @@ import time
 import uuid
 from typing import Any, Dict, Optional
 
-from . import native_store, protocol
+from . import native_store, protocol, transfer
 from .ids import NodeID
-from .transfer import read_location_range
 
 HEARTBEAT_S = flags.get("RTPU_HEARTBEAT_S")
 
@@ -335,8 +334,31 @@ class HostAgent:
         if kind == "shutdown":
             self._stop.set()
             return {"ok": True}
-        if kind == "pull_chunk":
-            return read_location_range(msg["loc"], msg["offset"], msg["length"])
+        if kind in transfer.PULL_SERVER_KINDS:
+            return await transfer.handle_pull_server_message(conn, msg)
+        if kind == "replicate_push":
+            # Broadcast source on this host: stream the object's bytes down
+            # the hop chain (each byte leaves this host once) and report
+            # how many were shipped so the controller's per-broadcast
+            # source-byte accounting stays truthful.
+            async def _push(msg=msg):
+                sent = 0
+                err = None
+                try:
+                    sent = await transfer.push_replicate_chain(
+                        msg["loc"], msg["chain"], msg["bid"],
+                        chunk=msg.get("chunk"), window=msg.get("window"))
+                except Exception as e:  # noqa: BLE001 — reported, re-routed
+                    err = repr(e)[:300]
+                try:
+                    await self.ctrl.send(
+                        {"kind": "replicate_push_done", "bid": msg["bid"],
+                         "bytes": sent, "error": err})
+                except Exception:
+                    pass
+
+            asyncio.get_running_loop().create_task(_push())
+            return {"ok": True}
         if kind == "list_logs":
             # This host's worker log files with sizes (cluster log index
             # building block; reference: the dashboard log API's per-node
@@ -531,9 +553,18 @@ class HostAgent:
 
     async def _on_peer_msg(self, conn, msg: Dict[str, Any]) -> Any:
         kind = msg["kind"]
-        if kind == "pull_chunk":
-            # Range reads touch shm only; run inline (no blocking I/O).
-            return read_location_range(msg["loc"], msg["offset"], msg["length"])
+        if kind in transfer.PULL_SERVER_KINDS:
+            return await transfer.handle_pull_server_message(conn, msg)
+        if kind in transfer.REPLICATE_KINDS:
+            # Broadcast chain hop: write incoming chunks into this host's
+            # arena/shm and forward downstream while still receiving; the
+            # sealed replica is reported to the controller over the agent's
+            # control connection (reconnect-safe channel).
+            async def _report(payload):
+                await self.ctrl.send(payload)
+
+            return await transfer.handle_replicate_message(
+                conn, msg, node_id=self.node_id, report=_report)
         if kind == "ping":
             return {"pong": True, "node_id": self.node_id}
         raise ValueError(f"host_agent peer: unknown message kind {kind!r}")

@@ -96,9 +96,24 @@ def load_library():
         try:
             lib = ctypes.CDLL(_lib_path())
         except OSError as e:
-            logger.warning("native store load failed: %r", e)
-            _lib_failed = True
-            return None
+            # A .so that exists but won't dlopen is a stale artifact from a
+            # different environment (e.g. built against a glibc where
+            # shm_open didn't need -lrt). Rebuild in-tree once from
+            # src/store and retry; no toolchain -> graceful skip as before.
+            logger.warning("native store load failed: %r; rebuilding", e)
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+            if flags.get("RTPU_STORE_LIB") or not _build():
+                _lib_failed = True
+                return None
+            try:
+                lib = ctypes.CDLL(_lib_path())
+            except OSError as e2:
+                logger.warning("native store rebuild still fails: %r", e2)
+                _lib_failed = True
+                return None
         lib.rtpu_store_create.restype = ctypes.c_void_p
         lib.rtpu_store_create.argtypes = [ctypes.c_char_p, ctypes.c_uint64]
         lib.rtpu_store_attach.restype = ctypes.c_void_p

@@ -114,6 +114,16 @@ class ObjectLocation:
     # spill, local_object_manager.h:103-122): same byte layout as the arena
     # object, in a file.
     spill_path: Optional[str] = None
+    # "host:port" of the producing process's own pull server (its direct /
+    # ref channel): consumers on another host try the producer first and
+    # fall back to the host agent when it is gone (Ray's plasma/pull-manager
+    # split — the controller keeps location metadata only).
+    serve_addr: Optional[str] = None
+    # Extra full copies of the same bytes on other hosts (broadcast
+    # replicas). Attached by the controller on get_locations responses so a
+    # consumer can fan one pull across several source hosts; never set on
+    # stored locations.
+    replicas: List["ObjectLocation"] = field(default_factory=list)
 
 
 def serialize(value: Any) -> Tuple[bytes, List[pickle.PickleBuffer]]:
@@ -147,8 +157,10 @@ def put_bytes(value: Any, object_id: str, node_id: str) -> ObjectLocation:
             data = pickle.dumps(value, protocol=pickle.HIGHEST_PROTOCOL)
         return ObjectLocation(object_id=object_id, size=len(data), inline=data, node_id=node_id)
 
+    serve_addr = _self_serve_addr()
     loc = _put_arena(data, oob, total, object_id, node_id)
     if loc is not None:
+        loc.serve_addr = serve_addr
         return loc
     from . import native_store
 
@@ -159,6 +171,7 @@ def put_bytes(value: Any, object_id: str, node_id: str) -> ObjectLocation:
         # backpressure on the putting task.
         loc = _put_spill(data, oob, total, object_id, node_id)
         if loc is not None:
+            loc.serve_addr = serve_addr
             return loc
 
     # Layout: [pickle stream][buf0][buf1]... with a location-table in metadata.
@@ -187,9 +200,29 @@ def put_bytes(value: Any, object_id: str, node_id: str) -> ObjectLocation:
         pickle_off=pickle_off,
         pickle_len=pickle_len,
         host_id=current_host_id(),
+        serve_addr=serve_addr,
     )
     seg.close()
     return loc
+
+
+def _self_serve_addr() -> Optional[str]:
+    """This process's own pull-serving "host:port" (its direct/ref server),
+    stamped into produced locations so cross-host consumers can pull from
+    the producer without a host-agent hop. None outside a live session or
+    when worker-serving is disabled."""
+    if not flags.get("RTPU_WORKER_SERVE"):
+        return None
+    from . import context as ctx
+
+    if not ctx.is_initialized():
+        return None
+    from . import ownership
+
+    addr = ownership.self_addr()
+    if not addr:
+        return None
+    return addr.partition("|")[0]
 
 
 def _arena_oid(object_id: str) -> int:
@@ -327,6 +360,10 @@ class PinnedBuffer:
     buffer object as ``.base`` — so the array's lifetime transitively holds
     the pin, and mutation is blocked because the exported view is read-only
     (same contract as plasma: values from get() are immutable).
+
+    ``__buffer__`` is honored from Python 3.12; on older interpreters use
+    :func:`pinned_buffer`, which returns a numpy-array wrapper exporting the
+    buffer protocol natively (same pin/read-only contract).
     """
 
     __slots__ = ("_mv", "_pin")
@@ -340,6 +377,35 @@ class PinnedBuffer:
 
     def __len__(self) -> int:
         return self._mv.nbytes
+
+
+def pinned_buffer(mv: memoryview, pin: _Pin):
+    """Buffer-protocol export of a pinned shared-memory view.
+
+    Python < 3.12 ignores ``__buffer__`` (PEP 688), so a plain PinnedBuffer
+    is rejected by every real consumer (``np.frombuffer`` raised TypeError —
+    the long-standing get()-path env failure). Instead: a ctypes array
+    mapped over the view holds the pin as an instance attribute, and a
+    read-only uint8 ndarray over it is what pickle5 hands to consumers.
+    numpy's base-chain collapse keeps the MEMORY OWNER (the ctypes holder)
+    alive, so every reconstructed array transitively holds the pin — the
+    plasma buffer-lifetime contract — while staying immutable.
+    """
+    import ctypes
+
+    try:
+        import numpy as np
+    except ImportError:
+        return PinnedBuffer(mv, pin)
+    if mv.readonly:
+        # from_buffer needs a writable exporter; today every call site
+        # passes writable shm/arena slices. PEP-688 fallback otherwise.
+        return PinnedBuffer(mv, pin)
+    holder = (ctypes.c_char * mv.nbytes).from_buffer(mv)
+    holder._rtpu_pin = pin
+    arr = np.frombuffer(holder, dtype=np.uint8)
+    arr.flags.writeable = False
+    return arr
 
 
 import weakref
@@ -413,7 +479,7 @@ def get_bytes(loc: ObjectLocation, copy: bool = False) -> Any:
         # stays alive even if the cache drops it (free_segment) while views
         # are exported; POSIX keeps unlinked memory valid until munmap.
         pin = _Pin(lambda seg=seg: None)
-        bufs = [PinnedBuffer(seg.buf[off:off + n], pin)
+        bufs = [pinned_buffer(seg.buf[off:off + n], pin)
                 for off, n in loc.buffers]
     return pickle.loads(data, buffers=bufs)
 
@@ -448,7 +514,7 @@ def _get_arena_bytes(loc: ObjectLocation, copy: bool) -> Any:
     # exit via the atexit drain). The controller can still force-delete —
     # same contract as plasma.
     pin = _Pin(lambda a=arena, o=loc.arena_oid: a.release(o))
-    bufs = [PinnedBuffer(view[off:off + n], pin) for off, n in loc.buffers]
+    bufs = [pinned_buffer(view[off:off + n], pin) for off, n in loc.buffers]
     return pickle.loads(data, buffers=bufs)
 
 

@@ -172,6 +172,13 @@ def _start_ref_server() -> str:
 
 
 async def _handle_async(conn, msg):
+    if msg.get("kind", "").startswith("pull_"):
+        # The ref server doubles as this process's pull server: a driver's
+        # put objects are served to remote consumers straight from here
+        # (same producer-serving contract as the worker direct server).
+        from . import transfer
+
+        return await transfer.handle_pull_server_message(conn, msg)
     return handle_ref_message(msg)
 
 

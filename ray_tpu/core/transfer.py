@@ -1,32 +1,51 @@
-"""Inter-node object transfer: chunked pull of object bytes over TCP.
+"""Inter-node object transfer: the object-plane fast path.
 
 Role-equivalent to the reference's object manager push/pull protocol
 (ray: src/ray/object_manager/object_manager.h, object_manager.proto Push/Pull
-chunked transfer), collapsed to a pull-only design: the consumer asks the
-node that *produced* an object for byte ranges and reassembles locally.
+chunked transfer + the pull manager, pull_manager.h):
+
+- **Streamed pulls** (RTPU_PULL_STREAM, default on): one ``pull_stream``
+  request ships every chunk back-to-back under a credit window
+  (RTPU_PULL_WINDOW) instead of one request/response round trip per
+  RTPU_PULL_CHUNK bytes. Chunks land zero-copy into one preallocated
+  buffer; the serial per-chunk loop remains as the disabled path.
+- **Producer serving**: the process that produced an object serves its own
+  bytes over its existing direct/ref server (``ObjectLocation.serve_addr``);
+  the host agent is the fallback when the producer is gone — mid-pull death
+  resumes at the last verified offset instead of restarting.
+- **Parallel pulls**: when the controller attaches broadcast replicas to a
+  location, the byte range splits across source hosts (RTPU_PULL_PARALLEL).
+- **Replicate chains** (broadcast): ``replicate_begin/chunk/end`` pushes a
+  full copy down a pipelined chain of hosts so the source ships each byte
+  once regardless of fan-out (the weight-distribution path; reference:
+  ray.experimental.channel / collective broadcast over the object store).
 
 Serving side: `read_location_range(loc, offset, length)` — runs on any
-process on the producer's host (the host agent, or the controller for the
-head node); it attaches the arena / shm segment named in the location and
-returns raw bytes. No per-agent object directory is needed: the
-ObjectLocation itself is the capability.
-
-Consumer side: `fetch_remote_value(loc)` — resolves the producer node's
-serving address via the controller (cached), pulls `PULL_CHUNK`-sized ranges,
-and unpickles with the out-of-band buffer table from the location.
+process on the producer's host; it attaches the arena / shm segment named
+in the location and returns raw bytes. The ObjectLocation itself is the
+capability.
 """
 from __future__ import annotations
 
+import asyncio
 import pickle
+import secrets
 import threading
-from typing import Dict, Optional, Tuple
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from ray_tpu import flags
 
 from .object_store import ObjectLocation
 
 PULL_CHUNK = 4 * 1024 * 1024
-# Per-chunk pull deadline: generous for a loaded host, small enough that a
-# dead peer turns into a refresh instead of a hung get().
+# Per-chunk (serial) / per-progress (streamed) deadline: generous for a
+# loaded host, small enough that a dead peer turns into a refresh instead of
+# a hung get().
 PULL_CHUNK_TIMEOUT_S = 20.0
+
+# Spans smaller than this are not worth splitting across parallel sources.
+_PARALLEL_MIN_SPAN = 8 * 1024 * 1024
 
 
 def read_location_range(loc: ObjectLocation, offset: int, length: int) -> bytes:
@@ -60,12 +79,90 @@ def read_location_range(loc: ObjectLocation, offset: int, length: int) -> bytes:
     return bytes(seg.buf[offset : offset + length])
 
 
-def decode_value(loc: ObjectLocation, buf: bytes):
-    """Unpickle an object's assembled bytes using the location's layout."""
-    data = buf[loc.pickle_off : loc.pickle_off + loc.pickle_len]
+def read_location_view(loc: ObjectLocation, offset: int, length: int):
+    """Zero-copy serving read: ``(view, release)`` where `view` aliases the
+    object's storage directly (no bytes() copy) and `release` drops the
+    read pin once the bytes are on the wire. Spill files and inline
+    payloads fall back to a plain copy."""
+    if loc.arena is not None:
+        from . import native_store
+
+        arena = native_store.get_arena()
+        if arena is None or arena.name != loc.arena:
+            arena = native_store.attach_named(loc.arena)
+        if arena is None:
+            raise RuntimeError(f"cannot attach arena {loc.arena!r} to serve pull")
+        view = arena.get(loc.arena_oid)
+        if view is None:
+            raise KeyError(f"object {loc.object_id[:8]} missing from arena")
+        return (view[offset : offset + length],
+                lambda: arena.release(loc.arena_oid))
+    if loc.shm_name is not None:
+        from .object_store import _segments
+
+        seg = _segments.attach(loc.shm_name)
+        return seg.buf[offset : offset + length], (lambda: None)
+    return read_location_range(loc, offset, length), (lambda: None)
+
+
+def decode_value(loc: ObjectLocation, buf) -> Any:
+    """Unpickle an object's assembled bytes using the location's layout.
+
+    ``buf`` may be bytes or a bytearray — the streamed pull path hands the
+    preallocated assembly buffer straight in (no bytes() copy; the
+    reconstructed arrays privately alias it)."""
+    data = bytes(buf[loc.pickle_off : loc.pickle_off + loc.pickle_len])
     mv = memoryview(buf)
     bufs = [mv[off : off + n] for off, n in loc.buffers]
     return pickle.loads(data, buffers=bufs)
+
+
+# --------------------------------------------------------------- accounting
+# Per-process transfer counters, mirrored to /metrics via util.metrics
+# (rtpu_transfer_bytes_total{path} + rtpu_pull_seconds) and readable
+# in-process by tests/benchmarks via transfer_stats().
+
+_stats_lock = threading.Lock()
+_stats: Dict[str, int] = {}
+_metrics = None
+
+
+def _metric_handles():
+    global _metrics
+    if _metrics is None:
+        from ray_tpu.util import metrics as um
+
+        _metrics = (
+            um.Counter(
+                "rtpu_transfer_bytes_total",
+                description="Object bytes moved by the transfer plane, "
+                            "by path (stream/serial/broadcast)",
+                tag_keys=("path",)),
+            um.Histogram(
+                "rtpu_pull_seconds",
+                description="Wall seconds per remote object pull",
+                boundaries=[0.001, 0.01, 0.05, 0.25, 1.0, 5.0, 30.0]),
+        )
+    return _metrics
+
+
+def _account(path: str, nbytes: int, seconds: Optional[float] = None) -> None:
+    with _stats_lock:
+        _stats[path] = _stats.get(path, 0) + nbytes
+    try:
+        bytes_total, pull_seconds = _metric_handles()
+        if nbytes:
+            bytes_total.inc(nbytes, tags={"path": path})
+        if seconds is not None:
+            pull_seconds.observe(seconds)
+    except Exception:
+        pass  # metrics must never fail a transfer
+
+
+def transfer_stats() -> Dict[str, int]:
+    """Snapshot of this process's transfer byte counters, by path."""
+    with _stats_lock:
+        return dict(_stats)
 
 
 # ---------------------------------------------------------------- pull client
@@ -73,6 +170,12 @@ def decode_value(loc: ObjectLocation, buf: bytes):
 _agent_addr_cache: Dict[str, Tuple[str, int]] = {}  # node_id -> (host, port)
 _conn_cache: Dict[Tuple[str, int], "object"] = {}  # addr -> CoreClient
 _cache_lock = threading.Lock()
+
+# Pooled blocking sockets for the streamed data plane: addr -> [socket].
+# The consumer thread is synchronous anyway (it's inside get()), and a raw
+# socket lets chunk payloads recv_into() the destination buffer directly —
+# zero client-side assembly copies, no event-loop hop per chunk.
+_sync_socks: Dict[Tuple[str, int], List["object"]] = {}
 
 
 def _resolve_serving_addr(node_id: Optional[str]) -> Tuple[str, int]:
@@ -107,44 +210,255 @@ def _serving_client(addr: Tuple[str, int]):
     return cli
 
 
-def fetch_remote_value(loc: ObjectLocation):
-    """Pull a remote object's bytes from its producer host and decode.
+def _evict_client(addr: Tuple[str, int], cli) -> None:
+    with _cache_lock:
+        if _conn_cache.get(addr) is cli:
+            _conn_cache.pop(addr, None)
+    try:
+        cli.close()
+    except Exception:
+        pass
 
-    Every chunk request carries a timeout and any failure evicts the
-    cached connection: location caches mean a pull can target a host that
-    died since the location was learned, and an unbounded request there
-    hangs the whole get() instead of letting the caller's refresh path
-    re-resolve (and possibly lineage-reconstruct) the object."""
-    addr = _resolve_serving_addr(loc.node_id)
-    cli = _serving_client(addr)
-    buf = bytearray(loc.size)
-    off = 0
-    while off < loc.size:
-        n = min(PULL_CHUNK, loc.size - off)
+
+# ---------------------------------------------------- sync streamed client
+
+def _sync_sock(addr: Tuple[str, int]):
+    import socket
+
+    with _cache_lock:
+        pool = _sync_socks.get(addr)
+        if pool:
+            return pool.pop()
+    sock = socket.create_connection(addr, timeout=PULL_CHUNK_TIMEOUT_S)
+    sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    return sock
+
+
+def _return_sock(addr: Tuple[str, int], sock) -> None:
+    with _cache_lock:
+        _sync_socks.setdefault(addr, []).append(sock)
+
+
+def _sock_frame(msg: Dict[str, Any]) -> bytes:
+    from . import protocol
+
+    data = protocol.dumps(msg)
+    return protocol._LEN.pack(len(data)) + data
+
+
+def _recv_exact_into(sock, mv: memoryview) -> None:
+    while mv.nbytes:
+        n = sock.recv_into(mv)
+        if n == 0:
+            raise ConnectionError("pull connection closed mid-stream")
+        mv = mv[n:]
+
+
+def _recv_exact(sock, n: int) -> bytes:
+    buf = bytearray(n)
+    _recv_exact_into(sock, memoryview(buf))
+    return bytes(buf)
+
+
+class _PullPartial(ConnectionError):
+    """A streamed pull died mid-flight; `received` bytes landed
+    contiguously (in-order TCP stream), so the caller resumes there."""
+
+    def __init__(self, received: int, cause: BaseException):
+        super().__init__(f"pull interrupted after {received} bytes: {cause!r}")
+        self.received = received
+        self.cause = cause
+
+
+def _sync_stream_pull(addr: Tuple[str, int], loc: ObjectLocation,
+                      mv: memoryview, offset: int, length: int) -> None:
+    """Stream [offset, offset+length) into `mv` over a pooled blocking
+    socket: one pull_stream request, then chunk payloads recv_into() the
+    destination directly (raw-tail frames — no pickle, no assembly copy).
+    Raises _PullPartial carrying the contiguous progress on failure."""
+    from . import protocol
+
+    sid = secrets.token_hex(8)
+    received = 0
+    try:
+        sock = _sync_sock(addr)
+    except OSError as e:
+        raise _PullPartial(0, e) from e
+    credit = _sock_frame({"kind": "pull_credit", "sid": sid, "n": 1})
+    try:
+        sock.sendall(_sock_frame({
+            "kind": "pull_stream", "sid": sid, "loc": loc,
+            "offset": offset, "length": length,
+            "chunk": flags.get("RTPU_PULL_CHUNK"),
+            "window": flags.get("RTPU_PULL_WINDOW"),
+            "rid": 1,
+        }))
+        while True:
+            (n,) = protocol._LEN.unpack(_recv_exact(sock, 8))
+            if n & protocol._RAW_BIT:
+                n &= ~protocol._RAW_BIT
+                (raw_len,) = protocol._LEN.unpack(_recv_exact(sock, 8))
+                header = _recv_exact(sock, n - 8 - raw_len)
+                msg = protocol.loads(header)
+                if msg.get("kind") != "pull_data" or msg.get("sid") != sid:
+                    _recv_exact(sock, raw_len)  # drop stray frame
+                    continue
+                rel = msg["off"] - offset
+                if rel != received or rel + raw_len > length:
+                    raise ConnectionError(
+                        f"pull chunk out of order at {msg['off']}")
+                _recv_exact_into(sock, mv[rel : rel + raw_len])
+                received += raw_len
+                sock.sendall(credit)
+                continue
+            msg = protocol.loads(_recv_exact(sock, n))
+            if msg.get("kind") == "__response__":
+                if msg.get("error") is not None:
+                    raise msg["error"]
+                if received != length:
+                    raise ConnectionError(
+                        f"pull ended short: {received}/{length} bytes")
+                _return_sock(addr, sock)
+                return
+            # Unrelated push on a pooled socket (shouldn't happen): skip.
+    except _PullPartial:
+        raise
+    except BaseException as e:  # noqa: BLE001 — progress survives as resume point
         try:
-            chunk = cli.request(
+            sock.close()
+        except Exception:
+            pass
+        raise _PullPartial(received, e) from e
+
+
+def _candidate_addrs(loc: ObjectLocation) -> List[Tuple[str, int]]:
+    """Pull sources for one location, best first: the producing process's
+    own server (worker-serving), then the host agent for its node."""
+    out: List[Tuple[str, int]] = []
+    if loc.serve_addr and flags.get("RTPU_WORKER_SERVE"):
+        host, _, port = loc.serve_addr.rpartition(":")
+        try:
+            out.append((host, int(port)))
+        except ValueError:
+            pass
+    try:
+        agent = _resolve_serving_addr(loc.node_id)
+        if agent not in out:
+            out.append(agent)
+    except Exception:
+        pass  # controller unreachable for the moment: producer may still work
+    return out
+
+
+def _serial_range(addr: Tuple[str, int], loc: ObjectLocation,
+                  mv: memoryview, offset: int, length: int) -> None:
+    """The pre-stream pull loop: one request/response round trip per chunk.
+    Kept as the RTPU_PULL_STREAM=0 path and the measured baseline."""
+    cli = _serving_client(addr)
+    end = offset + length
+    off = offset
+    chunk = flags.get("RTPU_PULL_CHUNK")
+    while off < end:
+        n = min(chunk, end - off)
+        try:
+            data = cli.request(
                 {"kind": "pull_chunk", "loc": loc, "offset": off,
                  "length": n},
                 timeout=PULL_CHUNK_TIMEOUT_S,
             )
-        except Exception as e:
-            with _cache_lock:
-                if _conn_cache.get(addr) is cli:
-                    _conn_cache.pop(addr, None)
+        except Exception:
+            _evict_client(addr, cli)
+            raise
+        if not data:
+            raise ConnectionError(
+                f"short pull of object {loc.object_id[:8]} at offset {off}")
+        mv[off - offset : off - offset + len(data)] = data
+        off += len(data)
+
+
+def _pull_span(sources: List[ObjectLocation], mv: memoryview,
+               offset: int, length: int, streamed: bool) -> None:
+    """Fill one byte span, failing over producer -> agent -> next replica
+    and RESUMING at the verified offset after each failure (a mid-pull
+    worker death costs the tail, not the whole object — the in-order
+    stream makes the received count a contiguous high-water mark)."""
+    last_err: Optional[BaseException] = None
+    done = 0
+    for src in sources:
+        for addr in _candidate_addrs(src):
+            if done >= length:
+                return
+            base = offset + done
+            sub = memoryview(mv)[done:length]
             try:
-                cli.close()
-            except Exception:
-                pass
-            raise ConnectionError(
-                f"pull of object {loc.object_id[:8]} from {addr} failed "
-                f"at offset {off}: {e!r}") from e
-        if not chunk:
-            raise ConnectionError(
-                f"short pull of object {loc.object_id[:8]} at offset {off}"
-            )
-        buf[off : off + len(chunk)] = chunk
-        off += len(chunk)
-    return decode_value(loc, bytes(buf))
+                if streamed:
+                    _sync_stream_pull(addr, src, sub, base, length - done)
+                else:
+                    _serial_range(addr, src, sub, base, length - done)
+                done = length
+            except _PullPartial as e:
+                done += e.received
+                last_err = e.cause
+                continue
+            except Exception as e:  # noqa: BLE001 — retry from the next source
+                last_err = e
+                continue
+            return
+    raise ConnectionError(
+        f"pull of object {sources[0].object_id[:8]} failed at offset "
+        f"{offset + done}: {last_err!r}") from last_err
+
+
+def fetch_remote_value(loc: ObjectLocation):
+    """Pull a remote object's bytes from its producer/replica hosts and
+    decode. Streamed (one request, chunks back-to-back under a credit
+    window) with parallel range-splitting across replica hosts; serial
+    per-chunk under RTPU_PULL_STREAM=0. Failures fail over producer ->
+    host agent -> replicas with offset resume; exhausting every source
+    raises ConnectionError so the caller's refresh path re-resolves (and
+    possibly lineage-reconstructs) the object."""
+    t0 = time.perf_counter()
+    streamed = bool(flags.get("RTPU_PULL_STREAM"))
+    buf = bytearray(loc.size)
+    mv = memoryview(buf)
+    sources = [loc] + [r for r in (loc.replicas or ())
+                       if r.inline is None and not r.is_error]
+    fanout = min(len(sources), max(1, flags.get("RTPU_PULL_PARALLEL")),
+                 max(1, loc.size // _PARALLEL_MIN_SPAN))
+    if not streamed or fanout <= 1:
+        _pull_span(sources, mv, 0, loc.size, streamed)
+    else:
+        # Split the byte range across source hosts; each span prefers a
+        # different source first but can fail over to any of them.
+        span = (loc.size + fanout - 1) // fanout
+        spans = []
+        for i in range(fanout):
+            a = i * span
+            b = min(loc.size, a + span)
+            if a >= b:
+                continue
+            order = sources[i % len(sources):] + sources[: i % len(sources)]
+            spans.append((order, a, b - a))
+        errs: List[BaseException] = []
+
+        def run(order, a, n):
+            try:
+                _pull_span(order, memoryview(buf)[a:a + n], a, n, True)
+            except BaseException as e:  # noqa: BLE001
+                errs.append(e)
+
+        threads = [threading.Thread(target=run, args=s, daemon=True)
+                   for s in spans[1:]]
+        for t in threads:
+            t.start()
+        run(*spans[0])
+        for t in threads:
+            t.join()
+        if errs:
+            raise errs[0]
+    _account("stream" if streamed else "serial", loc.size,
+             time.perf_counter() - t0)
+    return decode_value(loc, buf)
 
 
 def reset_transfer_caches() -> None:
@@ -153,8 +467,345 @@ def reset_transfer_caches() -> None:
         conns = list(_conn_cache.values())
         _conn_cache.clear()
         _agent_addr_cache.clear()
+        socks = [s for pool in _sync_socks.values() for s in pool]
+        _sync_socks.clear()
     for c in conns:
         try:
             c.close()
         except Exception:
             pass
+    for s in socks:
+        try:
+            s.close()
+        except Exception:
+            pass
+
+
+# ----------------------------------------------------------------- pull server
+# Shared by every serving process: host agents (peer + controller conns),
+# the controller (head-host objects), workers (direct server) and drivers
+# (ref server). `pull_chunk` is the one-shot range read; `pull_stream`
+# ships a whole range back-to-back under a credit window.
+
+_server_credits: Dict[Tuple[int, str], asyncio.Semaphore] = {}
+
+
+async def handle_pull_server_message(conn, msg: Dict[str, Any]) -> Any:
+    kind = msg["kind"]
+    if kind == "pull_chunk":
+        return read_location_range(msg["loc"], msg["offset"], msg["length"])
+    if kind == "pull_credit":
+        sem = _server_credits.get((id(conn), msg["sid"]))
+        if sem is not None:
+            for _ in range(int(msg.get("n", 1))):
+                sem.release()
+        return None
+    if kind == "pull_stream":
+        return await _serve_pull_stream(conn, msg)
+    raise ValueError(f"pull server: unknown message kind {kind!r}")
+
+
+async def _serve_pull_stream(conn, msg: Dict[str, Any]) -> Dict[str, Any]:
+    from .protocol import testing_delay_s
+
+    loc: ObjectLocation = msg["loc"]
+    off = int(msg.get("offset", 0))
+    end = off + int(msg.get("length", loc.size - off))
+    chunk = int(msg.get("chunk") or flags.get("RTPU_PULL_CHUNK"))
+    window = max(1, int(msg.get("window") or flags.get("RTPU_PULL_WINDOW")))
+    sid = msg["sid"]
+    key = (id(conn), sid)
+    sem = _server_credits[key] = asyncio.Semaphore(window)
+    sent = 0
+    try:
+        while off < end:
+            await asyncio.wait_for(sem.acquire(), PULL_CHUNK_TIMEOUT_S)
+            n = min(chunk, end - off)
+            # Zero-copy serve: the shm/arena view goes straight to the
+            # transport (the pin drops once the write returns — by then
+            # the bytes are sent or buffered). Raw-tail frames then skip
+            # pickle on both ends: per-byte copy count is what bounds
+            # GB/s on a CPU-bound host, not the socket.
+            view, release = read_location_view(loc, off, n)
+            try:
+                if len(view) != n:
+                    raise ConnectionError(
+                        f"short read serving {loc.object_id[:8]} at {off}")
+                d = testing_delay_s("pull_data")  # chaos: per-chunk pacing
+                if d:
+                    await asyncio.sleep(d)
+                await conn.send_with_raw(
+                    {"kind": "pull_data", "sid": sid, "off": off}, view)
+            finally:
+                release()
+            off += n
+            sent += n
+    finally:
+        _server_credits.pop(key, None)
+    return {"ok": True, "sent": sent}
+
+
+# ------------------------------------------------------------ replicate chain
+# One-hop broadcast: the source pushes chunks to the first hop; every hop
+# writes locally and forwards downstream while still receiving (pipelined),
+# so the source ships each byte once regardless of fan-out. Used by the
+# controller (head-host sources / head-node sinks) and host agents.
+
+_sinks: Dict[str, Dict[str, Any]] = {}  # bid -> hop state
+_push_credits: Dict[Tuple[int, str], asyncio.Semaphore] = {}
+
+
+class ReplicaSink:
+    """Local storage writer for one incoming replica: prefers the node
+    arena, falls back to a per-object shm segment, then a spill file —
+    the same layouts every read path already understands."""
+
+    def __init__(self, src: ObjectLocation, node_id: str):
+        from multiprocessing import shared_memory
+
+        from . import native_store
+        from .object_store import (_arena_oid, _untrack, current_host_id,
+                                   spill_dir)
+
+        self.src = src
+        self.node_id = node_id
+        self.host_id = current_host_id()
+        self._view = None
+        self._arena = None
+        self._seg = None
+        self._file = None
+        self._spill_path = None
+        arena = native_store.get_arena()
+        if arena is not None:
+            oid = _arena_oid(src.object_id)
+            view = arena.create_object(oid, src.size)
+            if view is not None:
+                self._arena, self._arena_oid, self._view = arena, oid, view
+                return
+        try:
+            name = "rtpu_" + secrets.token_hex(8)
+            seg = shared_memory.SharedMemory(
+                name=name, create=True, size=max(src.size, 1))
+            _untrack(name)
+            self._seg, self._view = seg, seg.buf
+            return
+        except OSError:
+            pass
+        import os
+
+        self._spill_path = os.path.join(
+            spill_dir(), f"{src.object_id[:32]}.rep.bin")
+        self._file = open(self._spill_path, "wb")
+        self._file.truncate(src.size)
+
+    def write(self, off: int, data) -> None:
+        if self._view is not None:
+            self._view[off : off + len(data)] = data
+        else:
+            self._file.seek(off)
+            self._file.write(data)
+
+    def finish(self) -> ObjectLocation:
+        import dataclasses as _dc
+
+        src = self.src
+        common = dict(
+            object_id=src.object_id, size=src.size, node_id=self.node_id,
+            buffers=list(src.buffers), pickle_off=src.pickle_off,
+            pickle_len=src.pickle_len, host_id=self.host_id)
+        if self._arena is not None:
+            del self._view
+            self._arena.seal(self._arena_oid)
+            return ObjectLocation(arena=self._arena.name,
+                                  arena_oid=self._arena_oid, **common)
+        if self._seg is not None:
+            self._seg.close()
+            return ObjectLocation(shm_name=self._seg.name, **common)
+        self._file.close()
+        return ObjectLocation(spill_path=self._spill_path, **common)
+
+    def abort(self) -> None:
+        import os
+
+        try:
+            if self._arena is not None:
+                del self._view
+                self._arena.delete(self._arena_oid, force=True)
+            elif self._seg is not None:
+                name = self._seg.name
+                self._seg.close()
+                from .object_store import free_segment
+
+                free_segment(name)
+            elif self._file is not None:
+                self._file.close()
+                os.unlink(self._spill_path)
+        except Exception:
+            pass
+
+
+async def push_replicate_chain(loc: ObjectLocation,
+                               chain: List[Dict[str, Any]],
+                               bid: str,
+                               chunk: Optional[int] = None,
+                               window: Optional[int] = None) -> int:
+    """Source side of a broadcast: stream `loc`'s bytes to the first hop
+    (which forwards down `chain[1:]`). Returns bytes shipped — each byte
+    leaves the source exactly once, however long the chain is."""
+    from . import protocol
+    from .protocol import testing_delay_s
+
+    chunk = chunk or flags.get("RTPU_PULL_CHUNK")
+    window = max(1, window or flags.get("RTPU_PULL_WINDOW"))
+    first = chain[0]
+
+    async def on_msg(conn, msg):
+        if msg.get("kind") == "replicate_credit":
+            sem = _push_credits.get((id(conn), msg["bid"]))
+            if sem is not None:
+                for _ in range(int(msg.get("n", 1))):
+                    sem.release()
+        return None
+
+    conn = await protocol.connect(first["host"], int(first["port"]),
+                                  handler=on_msg, name="replicate-push")
+    sem = _push_credits[(id(conn), bid)] = asyncio.Semaphore(window)
+    sent = 0
+    try:
+        await conn.request(
+            {"kind": "replicate_begin", "bid": bid, "loc": loc,
+             "chain": chain[1:], "window": window}, timeout=30)
+        off = 0
+        while off < loc.size:
+            await asyncio.wait_for(sem.acquire(), PULL_CHUNK_TIMEOUT_S)
+            n = min(chunk, loc.size - off)
+            view, release = read_location_view(loc, off, n)
+            try:
+                d = testing_delay_s("replicate_chunk")  # chaos pacing
+                if d:
+                    await asyncio.sleep(d)
+                await conn.send_with_raw(
+                    {"kind": "replicate_chunk", "bid": bid, "off": off}, view)
+            finally:
+                release()
+            off += n
+            sent += n
+        await conn.request({"kind": "replicate_end", "bid": bid}, timeout=60)
+    finally:
+        _push_credits.pop((id(conn), bid), None)
+        try:
+            await conn.close()
+        except Exception:
+            pass
+    _account("broadcast", sent)
+    return sent
+
+
+async def handle_replicate_message(conn, msg: Dict[str, Any], *,
+                                   node_id: str, report) -> Any:
+    """One chain hop: write incoming chunks locally AND forward them
+    downstream while the upstream is still sending (pipelined). `report`
+    is an async callable(payload) delivering replica_added to the
+    controller when the local copy is sealed."""
+    kind = msg["kind"]
+    bid = msg["bid"]
+    if kind == "replicate_begin":
+        sink = await asyncio.to_thread(ReplicaSink, msg["loc"], node_id)
+        st = _sinks[bid] = {
+            "sink": sink, "loc": msg["loc"], "size": msg["loc"].size,
+            "received": 0, "forwarded": 0,
+            "done": asyncio.Event(), "fwd_done": asyncio.Event(),
+            "next": None, "next_sem": None,
+            "window": max(1, int(msg.get("window", 8))),
+        }
+        chain = msg.get("chain") or []
+        if st["size"] == 0:
+            st["done"].set()
+            st["fwd_done"].set()
+        if chain:
+            from . import protocol
+
+            async def on_down(dconn, dmsg):
+                if dmsg.get("kind") == "replicate_credit":
+                    sem = _push_credits.get((id(dconn), dmsg["bid"]))
+                    if sem is not None:
+                        for _ in range(int(dmsg.get("n", 1))):
+                            sem.release()
+                return None
+
+            nxt = chain[0]
+            dconn = await protocol.connect(
+                nxt["host"], int(nxt["port"]), handler=on_down,
+                name="replicate-fwd")
+            st["next"] = dconn
+            st["next_sem"] = _push_credits[(id(dconn), bid)] = \
+                asyncio.Semaphore(st["window"])
+            await dconn.request(
+                {"kind": "replicate_begin", "bid": bid, "loc": msg["loc"],
+                 "chain": chain[1:], "window": st["window"]}, timeout=30)
+        else:
+            st["fwd_done"].set()
+        return {"ok": True}
+    st = _sinks.get(bid)
+    if st is None:
+        raise ValueError(f"replicate: unknown broadcast {bid!r}")
+    if kind == "replicate_chunk":
+        data = msg["data"]
+        # Synchronous local write BEFORE any await: chunk handlers are
+        # spawned in arrival order, so writes stay ordered and complete
+        # exactly when `received` says they do.
+        st["sink"].write(msg["off"], data)
+        st["received"] += len(data)
+        if st["received"] >= st["size"]:
+            st["done"].set()
+        if st["next"] is not None:
+            await asyncio.wait_for(st["next_sem"].acquire(),
+                                   PULL_CHUNK_TIMEOUT_S)
+            await st["next"].send_with_raw(
+                {"kind": "replicate_chunk", "bid": bid, "off": msg["off"]},
+                data)
+            st["forwarded"] += len(data)
+            if st["forwarded"] >= st["size"]:
+                st["fwd_done"].set()
+        # Upstream credit only after the local write and the forward are
+        # both enqueued: chain backpressure propagates to the source.
+        await conn.send({"kind": "replicate_credit", "bid": bid, "n": 1})
+        return None
+    if kind == "replicate_end":
+        try:
+            await asyncio.wait_for(st["done"].wait(), 120)
+            loc2 = await asyncio.to_thread(st["sink"].finish)
+            try:
+                await report({"kind": "replica_added", "bid": bid,
+                              "object_id": st["loc"].object_id, "loc": loc2,
+                              "node_id": node_id,
+                              "bytes_in": st["received"]})
+            except Exception:
+                pass
+            if st["next"] is not None:
+                try:
+                    await asyncio.wait_for(st["fwd_done"].wait(), 120)
+                    await st["next"].request(
+                        {"kind": "replicate_end", "bid": bid}, timeout=60)
+                except Exception:
+                    pass  # downstream failure is re-routed by the controller
+            return {"ok": True}
+        except asyncio.TimeoutError:
+            st["sink"].abort()
+            raise ConnectionError(
+                f"replica of {st['loc'].object_id[:8]} incomplete: "
+                f"{st['received']}/{st['size']} bytes")
+        finally:
+            nxt = st.get("next")
+            if nxt is not None:
+                _push_credits.pop((id(nxt), bid), None)
+                try:
+                    await nxt.close()
+                except Exception:
+                    pass
+            _sinks.pop(bid, None)
+    raise ValueError(f"replicate: unknown message kind {kind!r}")
+
+
+PULL_SERVER_KINDS = ("pull_chunk", "pull_stream", "pull_credit")
+REPLICATE_KINDS = ("replicate_begin", "replicate_chunk", "replicate_end")

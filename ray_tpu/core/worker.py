@@ -549,6 +549,14 @@ class WorkerRuntime:
             from . import ownership
 
             return ownership.handle_ref_message(msg)
+        if kind.startswith("pull_"):
+            # Producer-served object plane: this worker serves its own
+            # objects' bytes over the direct server (Ray's plasma/pull-
+            # manager split — the controller keeps location metadata only;
+            # consumers fall back to the host agent when this worker dies).
+            from . import transfer
+
+            return await transfer.handle_pull_server_message(conn, msg)
         if kind == "cancel_task":
             self._cancel_task(msg["task_id"])
             return None
@@ -909,7 +917,8 @@ class WorkerRuntime:
             still = [oid for oid in ref_ids if oid not in locs]
             if still:
                 locs.update(self.client.request(
-                    {"kind": "get_locations", "object_ids": still}))
+                    {"kind": "get_locations", "object_ids": still,
+                     "node_id": self.node_id}))
 
         def resolve(v: Any) -> Any:
             if isinstance(v, ArgRef):

@@ -195,6 +195,31 @@ _define("RTPU_PREEMPTION_URL", str,
 _define("RTPU_PREEMPTION_POLL_S", float, 1.0,
         "Preemption watcher polling period.")
 
+# -- object transfer (inter-node pulls / broadcast) --------------------------
+_define("RTPU_PULL_STREAM", bool, True,
+        "Streamed inter-node object pulls: one pull_stream request ships "
+        "every chunk back-to-back under a credit window instead of one "
+        "request/response round trip per chunk (reference: the object "
+        "manager's chunked Push/Pull, object_manager.proto). 0 reverts to "
+        "the serial per-chunk loop; the pull path then pays one flag check.")
+_define("RTPU_PULL_CHUNK", int, 4 * 1024 * 1024,
+        "Chunk size in bytes for inter-node object transfer (streamed and "
+        "serial pulls, broadcast chains).")
+_define("RTPU_PULL_WINDOW", int, 8,
+        "Credit window for streamed pulls / broadcast chains: how many "
+        "chunks may be in flight before the sender waits for the "
+        "receiver's consumption credits.")
+_define("RTPU_PULL_PARALLEL", int, 2,
+        "Max concurrent source hosts one pull fans across when the "
+        "controller knows replica locations (broadcast copies). 1 "
+        "disables range-splitting.")
+_define("RTPU_WORKER_SERVE", bool, True,
+        "Producing processes serve their own objects' bytes over their "
+        "existing direct-call/ref server (Ray's plasma + pull-manager "
+        "split: the controller keeps location metadata only). Consumers "
+        "fall back to the host agent when the producer is gone. 0 routes "
+        "every cross-host pull through the host agent.")
+
 # -- object store / spilling -------------------------------------------------
 _define("RTPU_NATIVE_STORE", bool, True,
         "Use the C++ shm arena when available (0 forces pickle fallback).")

@@ -140,6 +140,17 @@ def follow_events(severity: Optional[str] = None,
             yield ev
 
 
+def broadcast(object_id: str, node_ids: Optional[List[str]] = None,
+              timeout: float = 120.0) -> Dict[str, Any]:
+    """Replicate an object's bytes onto N nodes over a pipelined chain
+    (the ``ray_tpu.broadcast`` backend, addressable by raw object id from
+    operational tooling). The source ships each byte ~once regardless of
+    fan-out; consumer-local ``get_locations`` then resolves to the replica
+    on the consumer's own host. Returns {ok, replicas, skipped, stats}."""
+    return _req({"kind": "broadcast_object", "object_id": object_id,
+                 "node_ids": node_ids, "timeout": timeout})
+
+
 def metrics_address() -> Optional[str]:
     """host:port of the controller's Prometheus /metrics endpoint."""
     state = _req({"kind": "cluster_state"})
