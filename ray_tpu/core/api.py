@@ -182,9 +182,14 @@ def _driver_on_reconnect(client: CoreClient) -> None:
     in-flight plain tasks so blocked get()s complete without a driver
     restart (at-least-once for retryable work; actor routes stay — live
     actor workers keep serving direct calls through the bounce)."""
+    # Bounded handshake when the partition-hardening RPC timeout is on: a
+    # re-dial into a still-blackholed network must fail fast and keep
+    # retrying from ensure_connected, not camp on a 30s wait.
+    _t = float(flags.get("RTPU_RPC_TIMEOUT_S") or 0.0)
     client.io.call(
-        client.conn.request({"kind": "register", "role": "driver"}),
-        timeout=30)
+        client.conn.request({"kind": "register", "role": "driver"},
+                            timeout=_t * 2 if _t else None),
+        timeout=(_t * 2 if _t else 30) + 5)
     # Rotate the client token: per-session caches keyed on it (function
     # registrations, actor routes) re-validate against the restarted
     # controller instead of trusting state it may not have. (Functions of
@@ -903,7 +908,7 @@ class _ActorRoute:
 
 
 class _PushBatch:
-    __slots__ = ("specs", "fut", "maxn")
+    __slots__ = ("specs", "fut", "maxn", "settled")
 
     def __init__(self) -> None:
         import concurrent.futures
@@ -912,6 +917,10 @@ class _PushBatch:
         self.fut: "Any" = concurrent.futures.Future()
         # Seal bound, read once at batch open (not one flag read per add).
         self.maxn = flags.get("RTPU_SUBMIT_BATCH_MAX")
+        # One settle per batch: the partition-hardening timeout watchdog
+        # and the (late) real reply race onto the same io thread; whichever
+        # fires first wins, the other is a no-op.
+        self.settled = False
 
 
 class _PushBatcher:
@@ -967,6 +976,9 @@ class _PushBatcher:
         future (in that order: by the time a waiter in _await_inflight
         wakes, the aggregated locations are cached and the in-flight maps
         are settled)."""
+        if b.settled:
+            return
+        b.settled = True
         try:
             self.on_done(b, res, exc)
         finally:
@@ -997,6 +1009,10 @@ class _PushBatcher:
                 batches.append(self.cur)
                 self.cur = None
             self.scheduled = False
+        try:
+            rpc_t = float(flags.get("RTPU_RPC_TIMEOUT_S") or 0.0)
+        except Exception:
+            rpc_t = 0.0
         for b in batches:
             try:
                 rfut = self.conn.request_threadsafe(
@@ -1014,6 +1030,24 @@ class _PushBatcher:
                     self._settle(b, f.result() or {}, None)
 
             rfut.add_done_callback(_chain)
+            if rpc_t:
+                # Partition hardening: a push into a blackholed-but-open
+                # connection never answers — after a generous multiple of
+                # the RPC timeout, fail the batch into the normal recovery
+                # path (replayable actors resubmit safely; plain tasks run
+                # the published-vs-unacked probe). 4x the control-plane
+                # timeout so genuinely slow calls don't trip it; 0
+                # (default) arms nothing.
+                def _expire(b=b, rfut=rfut):
+                    if not b.settled:
+                        self._settle(b, None, ConnectionError(
+                            f"direct push unanswered after "
+                            f"{rpc_t * 4:.1f}s (suspected partition)"))
+
+                try:
+                    self.io.loop.call_later(rpc_t * 4, _expire)
+                except RuntimeError:
+                    pass
 
 
 def _cache_loc(loc) -> None:
@@ -1254,6 +1288,11 @@ def _direct_failure_specs(wc, route: "_ActorRoute",
     old_worker = route.worker_id
     _invalidate_route(wc, route)
     resubmit = isinstance(exc, (protocol.NeverSentError, ActorNotHostedError))
+    if not resubmit and specs and specs[0].get("replay"):
+        # Exactly-once replay actor (max_task_retries): resubmission needs
+        # no never-ran proof — calls that DID execute short-circuit on the
+        # restored journal, so re-sending can never double-apply them.
+        resubmit = True
     done_ids: set = set()
     moved = False
     if not resubmit and isinstance(exc, (ConnectionError, OSError, EOFError)):
@@ -1973,10 +2012,15 @@ class ActorHandle:
     """Client-side handle to an actor (reference: actor.py ActorHandle)."""
 
     def __init__(self, actor_id: str, method_names: Sequence[str],
-                 method_defaults: Optional[Dict[str, Dict[str, Any]]] = None):
+                 method_defaults: Optional[Dict[str, Dict[str, Any]]] = None,
+                 replayable: bool = False):
         self._actor_id = actor_id
         self._method_names = list(method_names)
         self._method_defaults = dict(method_defaults or {})
+        # max_task_retries actor: calls carry the replay flag, so a failed
+        # path may resubmit them without a never-ran proof (the actor's
+        # exactly-once journal dedups any that actually executed).
+        self._replayable = bool(replayable)
         # Per-method static spec template (see RemoteFunction._tmpl): a
         # call serializes only its args, ids and seqno; batched pushes
         # pickle the shared fields once per frame.
@@ -2004,6 +2048,7 @@ class ActorHandle:
                 "method_name": method,
                 "resources": {},
                 "label": f"actor.{method}",
+                **({"replay": True} if self._replayable else {}),
                 # "caller" anchors the per-(caller, actor) sequence
                 # numbers: calls from one caller can ride different paths
                 # (direct socket vs controller fallback) and overtake each
@@ -2046,7 +2091,7 @@ class ActorHandle:
 
     def __reduce__(self):
         return (ActorHandle, (self._actor_id, self._method_names,
-                              self._method_defaults))
+                              self._method_defaults, self._replayable))
 
     def __repr__(self) -> str:
         return f"ActorHandle({self._actor_id[:16]})"
@@ -2093,6 +2138,14 @@ class ActorClass:
             n for n in dir(self._cls)
             if not n.startswith("_") and callable(getattr(self._cls, n, None))
         ]
+        # Crash-consistent fault tolerance (reference: ray actor options
+        # max_restarts/max_task_retries + the Ray paper's actor
+        # checkpointing): checkpoint_interval_s / checkpoint_every_n make
+        # the hosting worker durably checkpoint the instance (plus the
+        # exactly-once call journal); max_task_retries != 0 (-1 = always)
+        # opts method calls into replay-on-failure — retried calls dedup
+        # against the journal, so replay is exactly-once, not at-least.
+        max_task_retries = int(opts.get("max_task_retries", 0))
         spec = {
             "task_id": TaskID.generate(),
             "actor_id": actor_id,
@@ -2108,6 +2161,10 @@ class ActorClass:
             "detached": opts.get("lifetime") == "detached",
             "max_concurrency": opts.get("max_concurrency", 1),
             "max_restarts": int(opts.get("max_restarts", 0)),
+            "max_task_retries": max_task_retries,
+            "checkpoint_interval_s": float(
+                opts.get("checkpoint_interval_s") or 0.0),
+            "checkpoint_every_n": int(opts.get("checkpoint_every_n") or 0),
             "label": f"{self._cls.__name__}.__init__",
         }
         _attach_runtime_env(wc, opts, spec)
@@ -2121,9 +2178,12 @@ class ActorClass:
         }
         wc.client.request(
             {"kind": "kv_put", "ns": "__actor_methods__", "key": actor_id,
-             "value": cloudpickle.dumps((method_names, method_defaults))}
+             "value": cloudpickle.dumps(
+                 (method_names, method_defaults,
+                  {"replayable": bool(max_task_retries)}))}
         )
-        return ActorHandle(actor_id, method_names, method_defaults)
+        return ActorHandle(actor_id, method_names, method_defaults,
+                           replayable=bool(max_task_retries))
 
     def bind(self, *args, **kwargs):
         """Lazy actor construction node (reference python/ray/dag/class_node.py)."""
@@ -2183,11 +2243,16 @@ def get_actor(name: str, namespace: Optional[str] = None) -> ActorHandle:
         {"kind": "kv_get", "ns": "__actor_methods__", "key": info["actor_id"]}
     )
     blob = cloudpickle.loads(methods_blob) if methods_blob else []
+    meta: Dict[str, Any] = {}
     if isinstance(blob, tuple):
-        methods, defaults = blob
+        if len(blob) >= 3:
+            methods, defaults, meta = blob[0], blob[1], blob[2] or {}
+        else:
+            methods, defaults = blob
     else:  # pre-@method registrations stored a bare name list
         methods, defaults = blob, {}
-    return ActorHandle(info["actor_id"], methods, defaults)
+    return ActorHandle(info["actor_id"], methods, defaults,
+                       replayable=bool(meta.get("replayable")))
 
 
 # --------------------------------------------------------------- cluster info

@@ -1,0 +1,121 @@
+"""Durable actor checkpoints: record format + host-local file store.
+
+Crash-consistent actor fault tolerance (reference: the actor checkpointing
+story of the Ray paper, 1712.05889 §4.2.3, and gcs_actor_manager restart
+semantics): an actor's hosting worker periodically serializes the live
+instance TOGETHER with its exactly-once call journal and a monotonic epoch
+into one record. The record is written to a host-local file (cheap, survives
+worker SIGKILL) and an async copy ships to the controller (survives whole-
+node loss). A crash restart restores the newest reachable record instead of
+re-running the constructor; the journal inside it lets retried calls
+short-circuit to their published results instead of re-executing.
+
+The same record format is used by drain-migration snapshots
+(worker._snapshot_actor), so a migrated replayable actor keeps its dedup
+journal — ``decode`` also accepts the legacy raw-instance blobs those
+snapshots used to carry.
+"""
+from __future__ import annotations
+
+import os
+import tempfile
+from typing import Any, Dict, Optional, Tuple
+
+import cloudpickle
+
+from ray_tpu import flags
+
+RECORD_VERSION = 1
+
+# File name shape: <actor_id>.<epoch zero-padded>.ckpt — lexicographic order
+# IS epoch order, so "newest" is one sorted listing.
+_SUFFIX = ".ckpt"
+
+
+def checkpoint_dir() -> str:
+    d = flags.get("RTPU_CHECKPOINT_DIR")
+    if not d:
+        d = os.path.join(tempfile.gettempdir(), "rtpu_checkpoints")
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def encode(instance: Any, journal: Dict[str, Dict[int, Any]],
+           epoch: int) -> bytes:
+    """One checkpoint record: instance + exactly-once journal + epoch."""
+    return cloudpickle.dumps({
+        "v": RECORD_VERSION,
+        "epoch": int(epoch),
+        "instance": instance,
+        "journal": journal,
+    })
+
+
+def decode(blob: bytes) -> Dict[str, Any]:
+    """Record dict from a blob; legacy raw-instance blobs (pre-checkpoint
+    drain snapshots) decode to an epoch-0 record with an empty journal."""
+    obj = cloudpickle.loads(blob)
+    if isinstance(obj, dict) and obj.get("v") == RECORD_VERSION \
+            and "instance" in obj:
+        obj.setdefault("journal", {})
+        obj.setdefault("epoch", 0)
+        return obj
+    return {"v": 0, "epoch": 0, "instance": obj, "journal": {}}
+
+
+def _path(actor_id: str, epoch: int) -> str:
+    return os.path.join(checkpoint_dir(),
+                        f"{actor_id}.{int(epoch):020d}{_SUFFIX}")
+
+
+def write_local(actor_id: str, epoch: int, blob: bytes) -> str:
+    """Atomically write one epoch's record; older epochs of the same actor
+    are pruned (the newest record subsumes them)."""
+    path = _path(actor_id, epoch)
+    tmp = path + f".tmp{os.getpid()}"
+    with open(tmp, "wb") as f:
+        f.write(blob)
+    os.replace(tmp, path)
+    prune_local(actor_id, keep_epoch=epoch)
+    return path
+
+
+def _list_local(actor_id: str):
+    d = checkpoint_dir()
+    prefix = actor_id + "."
+    try:
+        names = [n for n in os.listdir(d)
+                 if n.startswith(prefix) and n.endswith(_SUFFIX)]
+    except OSError:
+        return []
+    out = []
+    for n in names:
+        try:
+            epoch = int(n[len(prefix):-len(_SUFFIX)])
+        except ValueError:
+            continue
+        out.append((epoch, os.path.join(d, n)))
+    return sorted(out)
+
+
+def newest_local(actor_id: str) -> Optional[Tuple[int, bytes]]:
+    """(epoch, blob) of the newest readable local record, or None."""
+    for epoch, path in reversed(_list_local(actor_id)):
+        try:
+            with open(path, "rb") as f:
+                return epoch, f.read()
+        except OSError:
+            continue
+    return None
+
+
+def prune_local(actor_id: str, keep_epoch: Optional[int] = None) -> None:
+    """Delete local records older than ``keep_epoch`` (all, when None —
+    actor retired for good)."""
+    for epoch, path in _list_local(actor_id):
+        if keep_epoch is not None and epoch >= keep_epoch:
+            continue
+        try:
+            os.unlink(path)
+        except OSError:
+            pass

@@ -27,6 +27,17 @@ from . import protocol
 
 _BACKOFF_CAP_S = 2.0
 
+# Transport-level timeout classes (asyncio.wait_for / Future.result). Exact
+# types only — application errors like controller.GetTimeoutError subclass
+# builtin TimeoutError and must surface to the caller, never retry.
+import concurrent.futures as _cf  # noqa: E402
+
+_TRANSPORT_TIMEOUTS = (asyncio.TimeoutError, _cf.TimeoutError, TimeoutError)
+
+
+def _is_transport_timeout(e: BaseException) -> bool:
+    return type(e) in _TRANSPORT_TIMEOUTS
+
 
 class EventLoopThread:
     def __init__(self, name: str = "rtpu-io"):
@@ -155,10 +166,12 @@ class CoreClient:
                         except Exception:
                             pass
                     return
-                except ConnectionError as e:
-                    # The FRESH connection died mid-handshake — the
-                    # controller bounced again under us. Not fatal: keep
-                    # dialing until the deadline.
+                except (ConnectionError, asyncio.TimeoutError,
+                        _cf.TimeoutError) as e:
+                    # The FRESH connection died mid-handshake (controller
+                    # bounced again under us) or the handshake timed out
+                    # (still partitioned). Not fatal: keep dialing until
+                    # the deadline.
                     try:
                         self.io.call_nowait(conn.close())
                     except Exception:
@@ -169,11 +182,37 @@ class CoreClient:
     def request(self, msg: Dict[str, Any], timeout: Optional[float] = None) -> Any:
         if msg.get("kind") == "subscribe" and msg.get("channel"):
             self._subscriptions.add(msg["channel"])
+        # Per-call timeout with capped exponential backoff (partition
+        # hardening, RTPU_RPC_TIMEOUT_S): an open-but-blackholed connection
+        # never answers, so an unbounded request would hang forever. When
+        # the flag is set AND the caller imposed no timeout of its own, each
+        # attempt is bounded; a timed-out attempt treats the connection as
+        # suspect — close, re-dial, re-send — with the attempt window
+        # doubling (capped) so a slow-but-healthy controller isn't hammered.
+        # Safe for blind re-sends: the controller's submit/create handlers
+        # are idempotent by task/actor id. 0 (default) keeps the old
+        # wait-forever behavior.
+        rpc_t = 0.0
+        if timeout is None and self.reconnect_enabled and not self._closed:
+            try:
+                rpc_t = float(flags.get("RTPU_RPC_TIMEOUT_S") or 0.0)
+            except Exception:
+                rpc_t = 0.0
+        attempt_t = rpc_t or None
         retry_deadline: Optional[float] = None
         while True:
             try:
-                return self.io.call(self.conn.request(msg, timeout), timeout=None)
-            except ConnectionError:
+                return self.io.call(
+                    self.conn.request(msg, timeout if not rpc_t
+                                      else attempt_t),
+                    timeout=None)
+            except (ConnectionError, asyncio.TimeoutError,
+                    _cf.TimeoutError) as e:
+                timed_out = _is_transport_timeout(e)
+                if not timed_out and not isinstance(e, ConnectionError):
+                    raise  # app-level timeout subclass (GetTimeoutError)
+                if timed_out and not rpc_t:
+                    raise  # the caller's own timeout: surface it unchanged
                 if self._closed or not self.reconnect_enabled:
                     raise
                 # One retry window across flapping reconnects: each
@@ -185,7 +224,25 @@ class CoreClient:
                                       + flags.get("RTPU_RECONNECT_MAX_S"))
                 elif time.monotonic() >= retry_deadline:
                     raise
-                self.ensure_connected()
+                if timed_out:
+                    # Suspect connection (open but silent): force a fresh
+                    # dial; the re-send below goes out on the new one.
+                    try:
+                        self.io.call(self.conn.close(), timeout=2)
+                    except Exception:
+                        pass
+                    attempt_t = min((attempt_t or rpc_t) * 2,
+                                    max(rpc_t * 8, 10.0))
+                try:
+                    self.ensure_connected()
+                except (asyncio.TimeoutError, _cf.TimeoutError) as e2:
+                    # The reconnect handshake itself timed out (still
+                    # partitioned): keep retrying inside the window.
+                    if time.monotonic() >= retry_deadline:
+                        raise ConnectionError(
+                            f"controller handshake kept timing out "
+                            f"({e2!r})") from e2
+                    time.sleep(min(0.2, rpc_t or 0.2))
 
     def request_async(self, msg: Dict[str, Any]) -> "asyncio.Future":
         return self.io.call_nowait(self.conn.request(msg))

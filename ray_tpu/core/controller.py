@@ -145,6 +145,13 @@ class NodeInfo:
     drained: bool = False
     drain_reason: str = ""
     drain_deadline: float = 0.0  # wall clock (survives a controller bounce)
+    # Two-phase failure detector (SWIM-style suspect phase in front of the
+    # death declaration): heartbeat silence past RTPU_NODE_TIMEOUT_S marks
+    # the node suspect — scheduling pauses, actor calls buffer, nothing is
+    # killed — and only silence past RTPU_DEAD_TIMEOUT_S declares death, so
+    # a healed partition rejoins without actor churn or double-allocation.
+    suspect: bool = False
+    suspect_since: float = 0.0  # monotonic
 
 
 @dataclass
@@ -196,6 +203,10 @@ class ActorInfo:
     max_restarts: int = 0
     restart_count: int = 0
     creation_spec: Optional[Dict[str, Any]] = None
+    # Newest durable checkpoint shipped by the hosting worker:
+    # {epoch, blob, bytes, ts}. A crash restart restores it instead of
+    # re-running the constructor (core/checkpoint.py record format).
+    checkpoint: Optional[Dict[str, Any]] = None
 
 
 @dataclass
@@ -345,6 +356,9 @@ class Controller:
         # refused at admission (the direct path's spillback analog).
         self.lease_stats: Dict[str, int] = {
             "blocks": 0, "granted": 0, "reclaims": 0, "mem_refused": 0}
+        # Actor-checkpoint accounting (rtpu_actor_checkpoints_total /
+        # rtpu_actor_checkpoint_bytes on /metrics).
+        self.ckpt_stats: Dict[str, int] = {"count": 0, "bytes": 0}
         self._profiles: Dict[str, Dict[str, Any]] = {}
         self._last_reclaim_nudge = 0.0
         # App-defined metrics (util/metrics.py): name -> {type, help,
@@ -625,6 +639,7 @@ class Controller:
         if not node.alive:
             return
         node.alive = False
+        node.suspect = False  # terminal: past suspicion
         if node.draining:
             # The node left while (or because) it was draining — a
             # preemption that fired before the grace window closed, or the
@@ -698,6 +713,22 @@ class Controller:
                     continue
                 if self._maybe_reconstruct(oid, resubmitted):
                     continue
+                lspec = self.lineage.get(oid)
+                if lspec is None:
+                    reason = "no lineage recorded"
+                else:
+                    reason = (f"reconstruction cap reached "
+                              f"({lspec.get('_reconstructions', 0)}/"
+                              f"{flags.get('RTPU_MAX_RECONSTRUCTIONS')})")
+                self._emit_event(
+                    "ERROR", "OBJECT_LOST",
+                    f"object {oid[:8]} lost with node {node.node_id[:8]} "
+                    f"({reason})",
+                    node_id=node.node_id,
+                    task_id=lspec["task_id"] if lspec else None,
+                    data={"object_id": oid, "reason": reason,
+                          "attempts": int(lspec.get("_reconstructions", 0))
+                          if lspec else 0})
                 self._store_error(
                     oid,
                     ObjectLostError(
@@ -763,10 +794,28 @@ class Controller:
         self.tasks[spec["task_id"]] = spec
         self.pending_queue.append(spec)
         self._record_task_event(spec, "reconstruct")
+        self._emit_event(
+            "WARNING", "OBJECT_RECONSTRUCTING",
+            f"object {oid[:8]} lost; re-executing producing task "
+            f"{spec.get('label') or spec['task_id'][:8]} "
+            f"(attempt {spec['_reconstructions']}/"
+            f"{flags.get('RTPU_MAX_RECONSTRUCTIONS')})",
+            task_id=spec["task_id"],
+            data={"object_id": oid,
+                  "attempt": spec["_reconstructions"],
+                  "label": spec.get("label")})
         return True
 
     async def _on_worker_death(self, w: WorkerInfo) -> None:
         self.workers.pop(w.worker_id, None)
+        # Flip hosted actors to restarting BEFORE the awaited post-mortem
+        # fetch below: a call resubmitted in that window (the client's
+        # recovery thread races the death handler) must buffer in
+        # pending_calls, not observe an alive actor with no worker.
+        for aid in list(w.actor_ids):
+            _a = self.actors.get(aid)
+            if _a is not None and _a.state == "alive":
+                _a.state = "restarting"
         # Crash post-mortem (reference: worker-death exit_detail quoting
         # the crashed process's stderr in RayTaskError / ActorDiedError):
         # fetched only when the death actually fails user work.
@@ -963,11 +1012,19 @@ class Controller:
                 return False
         elif actor.restart_count >= actor.max_restarts:
             return False
-        else:
-            # A crash restart re-runs the constructor: a state snapshot
-            # left by an earlier drain migration must not resurrect stale
-            # state past a real failure.
-            spec.pop("state_blob", None)
+        # Restore the newest reachable state instead of re-running the
+        # constructor. An UNCONSUMED migration/restore blob in the spec
+        # wins: it is popped at actor_ready, so its presence proves the
+        # restored instance never confirmed — never mutated past the
+        # snapshot, and always at least as new as the last checkpoint
+        # (previously the crash path dropped it here, silently losing
+        # migrated state when the restore target died between dispatch
+        # and actor_ready). Otherwise the newest durable checkpoint — its
+        # record carries the exactly-once journal, so replayed calls
+        # dedup against everything it covers.
+        if spec.get("state_blob") is None and actor.checkpoint is not None \
+                and actor.checkpoint.get("blob") is not None:
+            spec["state_blob"] = actor.checkpoint["blob"]
         if not preempted:
             actor.restart_count += 1
         actor.state = "restarting"
@@ -987,6 +1044,9 @@ class Controller:
         # Fail calls already forwarded to the dead worker — but NOT calls
         # still buffered in pending_calls (never dispatched): those replay
         # after restart, and erroring them here would double-signal.
+        # Replay-enabled calls (max_task_retries actors) re-buffer instead
+        # of failing: the restored actor's journal short-circuits any that
+        # actually executed, so redelivery is exactly-once, not at-least.
         buffered = {p["task_id"] for p in actor.pending_calls}
         for tid, t in list(self.tasks.items()):
             if (
@@ -994,7 +1054,12 @@ class Controller:
                 and not t.get("is_actor_creation")
                 and tid not in buffered
             ):
-                self._fail_task(t, err)
+                if t.get("replay"):
+                    t.pop("sched_node", None)
+                    t.pop("__dispatch_ts", None)
+                    actor.pending_calls.append(t)
+                else:
+                    self._fail_task(t, err)
         node = self.nodes.get(actor.node_id or "")
         if node and actor.reserved:
             actor.reserved = False
@@ -1603,6 +1668,16 @@ class Controller:
 
     async def _h_submit_task(self, conn, msg):
         spec = msg["spec"]
+        # Idempotent by task id (partition hardening): a blind re-send
+        # after an RPC timeout — or a driver-reconnect resubmission racing
+        # a controller that never actually lost the first copy — must not
+        # double-schedule.
+        tid = spec["task_id"]
+        if tid in self.tasks:
+            return {"ok": True, "dup": True}
+        rids = spec.get("return_ids") or ()
+        if rids and all(r in self.objects for r in rids):
+            return {"ok": True, "dup": True}
         self.tasks[spec["task_id"]] = spec
         spec["state"] = "waiting_deps"
         if spec.get("streaming"):
@@ -1940,6 +2015,10 @@ class Controller:
     async def _h_create_actor(self, conn, msg):
         spec = msg["spec"]
         actor_id = spec["actor_id"]
+        if actor_id in self.actors:
+            # Idempotent by actor id (partition hardening): a retried
+            # create after an RPC timeout joins the original creation.
+            return {"ok": True, "dup": True}
         name = spec.get("name")
         namespace = spec.get("namespace", "default")
         if name:
@@ -1975,6 +2054,17 @@ class Controller:
         actor = self.actors.get(msg["actor_id"])
         if actor is None:
             return {"ok": False}
+        # Stale-sender guard: an actor_ready that raced the sender's death
+        # (e.g. delayed in flight while the worker was killed and the
+        # restart already re-queued the creation) must not flip a
+        # restarting actor alive — the restart path owns it now, and the
+        # consumed-blob pop below would discard state the re-queued
+        # creation still needs.
+        sender = next((w for w in self.workers.values() if w.conn is conn),
+                      None)
+        if actor.worker_id is None or (
+                sender is not None and sender.worker_id != actor.worker_id):
+            return {"ok": False, "stale": True}
         if actor.creation_task_id:
             spec = self.tasks.pop(actor.creation_task_id, None)
             if spec is not None:
@@ -1989,11 +2079,23 @@ class Controller:
             for call in calls:
                 await self._dispatch_actor_call(actor, call)
         actor.state = "alive"
-        # A drain-migration state snapshot is single-use: the instance
-        # mutates from here on, so a later (crash) re-creation must run the
-        # constructor, not resurrect this stale blob.
+        # The restore is CONFIRMED (the worker loaded the record before
+        # sending actor_ready): the blob is consumed now — the instance
+        # mutates from here on, so a later crash re-creation must restore
+        # from a durable checkpoint (or the constructor), never this copy.
+        # Until this point the blob stays in the spec, so a restore target
+        # dying between dispatch and actor_ready retries with state intact.
         if actor.creation_spec is not None:
             actor.creation_spec.pop("state_blob", None)
+        if msg.get("restored_epoch") is not None:
+            self._emit_event(
+                "INFO", "ACTOR_RESTORED",
+                f"actor {actor.name or actor.actor_id[:8]} restored from "
+                f"checkpoint epoch {msg['restored_epoch']} on node "
+                f"{(actor.node_id or '?')[:8]}",
+                actor_id=actor.actor_id, node_id=actor.node_id,
+                worker_id=actor.worker_id,
+                data={"epoch": int(msg["restored_epoch"])})
         self._export_event("ACTOR", {"actor_id": actor.actor_id,
                                      "event": "alive", "name": actor.name,
                                      "node_id": actor.node_id,
@@ -2044,8 +2146,62 @@ class Controller:
         self._wake_scheduler()
         return {"ok": True}
 
+    def _store_actor_checkpoint(self, actor: ActorInfo, epoch: int,
+                                blob: bytes) -> bool:
+        """Record one shipped checkpoint (newest epoch wins; duplicates and
+        stragglers are dropped). Detached actors additionally persist the
+        record next to --state-path so it survives a controller bounce."""
+        epoch = int(epoch)
+        cur = actor.checkpoint
+        if cur is not None and cur["epoch"] >= epoch:
+            return False
+        actor.checkpoint = {"epoch": epoch, "blob": blob,
+                            "bytes": len(blob), "ts": time.time()}
+        self.ckpt_stats["count"] += 1
+        self.ckpt_stats["bytes"] += len(blob)
+        if actor.detached and self.persist_path:
+            # 8-byte big-endian epoch header + opaque record: the restore
+            # path reads the epoch without unpickling user state into the
+            # controller process.
+            import struct as _struct
+
+            path = f"{self.persist_path}.ckpt.{actor.actor_id}"
+            tmp = path + f".tmp{os.getpid()}"
+            try:
+                with open(tmp, "wb") as f:
+                    f.write(_struct.pack("!Q", epoch) + blob)
+                os.replace(tmp, path)
+            except OSError:
+                pass
+        self._emit_event(
+            "DEBUG", "ACTOR_CHECKPOINTED",
+            f"actor {actor.name or actor.actor_id[:8]} checkpointed "
+            f"(epoch {epoch}, {len(blob)} bytes)",
+            actor_id=actor.actor_id, node_id=actor.node_id,
+            worker_id=actor.worker_id,
+            data={"epoch": epoch, "bytes": len(blob)})
+        return True
+
+    async def _h_actor_checkpoint(self, conn, msg):
+        """Async copy of a worker's durable actor checkpoint (the host-
+        local file is the fast copy; this one survives whole-node loss)."""
+        actor = self.actors.get(msg["actor_id"])
+        if actor is None or actor.state == "dead":
+            return None
+        self._store_actor_checkpoint(actor, msg["epoch"], msg["blob"])
+        return None
+
     async def _h_submit_actor_task(self, conn, msg):
         spec = msg["spec"]
+        # Idempotent by task id (partition hardening): a timed-out-and-
+        # retried submit whose original landed must not run twice — known
+        # in-flight specs and already-published results answer ok.
+        tid = spec["task_id"]
+        if tid in self.tasks:
+            return {"ok": True, "dup": True}
+        rids = spec.get("return_ids") or ()
+        if rids and all(r in self.objects for r in rids):
+            return {"ok": True, "dup": True}
         actor = self.actors.get(spec["actor_id"])
         if actor is None:
             raise ValueError(f"unknown actor {spec['actor_id']}")
@@ -2070,7 +2226,20 @@ class Controller:
     async def _dispatch_actor_call(self, actor: ActorInfo, spec: Dict[str, Any]) -> None:
         w = self.workers.get(actor.worker_id or "")
         if w is None:
-            self._fail_task(spec, ActorDiedError("actor worker gone"))
+            if spec.get("replay") and actor.state != "dead":
+                # Worker death mid-handling: a replayable call parks and
+                # redelivers after the restart (journal dedups).
+                actor.pending_calls.append(spec)
+            else:
+                self._fail_task(spec, ActorDiedError("actor worker gone"))
+            return
+        node = self.nodes.get(actor.node_id or "")
+        if node is not None and node.suspect:
+            # Suspect host (heartbeat-silent, possibly partitioned): a
+            # fire-and-forget dispatch there would vanish. Buffer — the
+            # heal path flushes in order; the death path re-buffers or
+            # fails per the actor's replay setting.
+            actor.pending_calls.append(spec)
             return
         # Per-actor ordered dispatch (direct_actor_task_submitter.h sequencing).
         async with actor.order_lock:
@@ -2103,7 +2272,7 @@ class Controller:
         needs_tpu = resources.get("TPU", 0) > 0
         mem_limit = flags.get("RTPU_SPILLBACK_MEM_FRACTION")
         candidates = [n for n in self.nodes.values()
-                      if n.alive and not n.draining]
+                      if self._schedulable(n)]
         for node in self._hybrid_order(candidates, arg_bytes):
             if not _res_fits(node.available, resources):
                 continue
@@ -2155,7 +2324,7 @@ class Controller:
         node" creates the worker where the bytes are."""
         needs_tpu = resources.get("TPU", 0) > 0
         candidates = [n for n in self.nodes.values()
-                      if n.alive and not n.draining]
+                      if self._schedulable(n)]
         for node in self._hybrid_order(candidates, arg_bytes):
             if count <= 0:
                 break
@@ -2307,6 +2476,12 @@ class Controller:
 
     def _mark_actor_dead(self, actor: ActorInfo, err: Exception) -> None:
         actor.state = "dead"
+        actor.checkpoint = None  # retired for good: nothing may restore it
+        if actor.detached and self.persist_path:
+            try:
+                os.unlink(f"{self.persist_path}.ckpt.{actor.actor_id}")
+            except OSError:
+                pass
         self._export_event("ACTOR", {"actor_id": actor.actor_id,
                                      "event": "dead", "ts": time.time()})
         self._emit_event(
@@ -2386,7 +2561,7 @@ class Controller:
         if pg.state != "pending":
             return
         nodes = [n for n in self.nodes.values()
-                 if n.alive and not n.draining]
+                 if self._schedulable(n)]
         nodes.sort(key=lambda n: n.index)
         trial = {n.node_id: dict(n.available) for n in nodes}
         assignment: List[str] = []
@@ -2578,6 +2753,10 @@ class Controller:
                     "node_id": a.node_id,
                     "worker_id": a.worker_id,
                     "restarts": a.restart_count,
+                    # Newest durable checkpoint the controller holds (0 =
+                    # none): tests/operators poll this to know a restart
+                    # will restore rather than re-run the constructor.
+                    "checkpoint_epoch": (a.checkpoint or {}).get("epoch", 0),
                 }
                 for a in list(self.actors.values())[:limit]
             ]
@@ -2700,7 +2879,16 @@ class Controller:
             return "dead"
         if node.draining:
             return "draining"
+        if node.suspect:
+            return "suspect"
         return "alive"
+
+    @staticmethod
+    def _schedulable(node: NodeInfo) -> bool:
+        """May NEW work land on this node? Draining nodes are leaving;
+        suspect nodes (heartbeat-silent, possibly partitioned) pause
+        placements so a heal rejoins without double-scheduled work."""
+        return node.alive and not node.draining and not node.suspect
 
     async def _h_drain_node(self, conn, msg):
         """Start (or report) a node drain. Idempotent: re-draining a
@@ -3318,6 +3506,16 @@ class Controller:
             f"{sum(len(r) for r in self.object_replicas.values())}",
             # Bulk-lease accounting: active leases + lifetime grant/reclaim
             # counters so the direct-dispatch control plane is observable.
+            # Actor-checkpoint accounting (durable checkpoints shipped to
+            # the controller: count + cumulative record bytes).
+            "# HELP rtpu_actor_checkpoints_total Durable actor "
+            "checkpoints stored by the controller",
+            "# TYPE rtpu_actor_checkpoints_total counter",
+            f"rtpu_actor_checkpoints_total {self.ckpt_stats['count']}",
+            "# HELP rtpu_actor_checkpoint_bytes Cumulative bytes of "
+            "stored actor checkpoint records",
+            "# TYPE rtpu_actor_checkpoint_bytes counter",
+            f"rtpu_actor_checkpoint_bytes {self.ckpt_stats['bytes']}",
             "# TYPE rtpu_leases_active gauge",
             f"rtpu_leases_active {len(self._leases)}",
             "# HELP rtpu_lease_events_total Direct-dispatch lease "
@@ -3549,6 +3747,8 @@ class Controller:
             node.available = dict(msg["resources"])
             node.labels = msg.get("labels") or node.labels
             node.alive = True
+            node.suspect = False  # a re-register IS a heartbeat
+            node.suspect_since = 0.0
             node.last_heartbeat = time.monotonic()
             node.spawning = 0
             node.spawning_tpu = 0
@@ -3560,6 +3760,7 @@ class Controller:
                 "INFO", "NODE_RECONNECTED",
                 f"node {nid[:8]} re-registered after a bounce",
                 node_id=nid, data={"host_id": node.host_id})
+            await self._flush_suspect_calls(node)
             if nid in self.pending_drains:
                 # The drain outlived a controller bounce: the re-registered
                 # node resumes draining with its original deadline.
@@ -3591,6 +3792,19 @@ class Controller:
         node = self.nodes.get(msg["node_id"])
         if node is not None:
             node.last_heartbeat = time.monotonic()
+            if node.suspect and node.alive:
+                # The partition/stall healed before the death deadline:
+                # un-suspect, resume scheduling, flush buffered actor
+                # calls — no actor churn, no double-allocation.
+                node.suspect = False
+                node.suspect_since = 0.0
+                self._emit_event(
+                    "INFO", "NODE_HEALED",
+                    f"node {node.node_id[:8]} heartbeating again after "
+                    f"suspect phase; scheduling resumed",
+                    node_id=node.node_id)
+                await self._flush_suspect_calls(node)
+                self._wake_scheduler()
             node.arena_stats = msg.get("arena") or {}
             if msg.get("mem_fraction") is not None:
                 node.mem_fraction = float(msg["mem_fraction"])
@@ -3764,6 +3978,8 @@ class Controller:
                 skipped[node.node_id] = "node not alive"
             elif node.node_id in self.pending_drains:
                 skipped[node.node_id] = "node draining"
+            elif node.suspect:
+                skipped[node.node_id] = "node suspect"
             elif host in have or node.node_id in reps:
                 skipped[node.node_id] = "already local"
             elif host in seen_hosts:
@@ -4032,7 +4248,7 @@ class Controller:
             actor_id = spec["actor_id"]
             if actor_id in self.actors:
                 continue
-            self.actors[actor_id] = ActorInfo(
+            actor = ActorInfo(
                 actor_id=actor_id,
                 name=spec.get("name"),
                 state="restarting",
@@ -4043,6 +4259,23 @@ class Controller:
                 max_restarts=int(spec.get("max_restarts", 0)),
                 creation_spec=spec,
             )
+            # A persisted checkpoint record survives the bounce: the
+            # re-created instance restores it instead of re-running the
+            # constructor. The 8-byte epoch header keeps the record itself
+            # opaque to the controller (user state never unpickles here).
+            try:
+                import struct as _struct
+
+                with open(f"{self.persist_path}.ckpt.{actor_id}",
+                          "rb") as f:
+                    raw = f.read()
+                (epoch,) = _struct.unpack_from("!Q", raw)
+                actor.checkpoint = {"epoch": int(epoch), "blob": raw[8:],
+                                    "bytes": len(raw) - 8,
+                                    "ts": time.time()}
+            except Exception:
+                pass
+            self.actors[actor_id] = actor
         self._restored_detached = resumable
         if resumable:
             self._adopt_grace_until = (
@@ -4065,6 +4298,11 @@ class Controller:
             actor = self.actors.get(actor_id)
             if actor is None or actor.state in ("alive", "dead"):
                 continue  # adopted by a reconnected worker (or retired)
+            if actor.checkpoint is not None \
+                    and actor.checkpoint.get("blob") is not None:
+                # Restored persisted checkpoint: the re-creation restores
+                # state instead of re-running the constructor.
+                spec["state_blob"] = actor.checkpoint["blob"]
             spec["state"] = "pending"
             spec.pop("sched_node", None)
             self.tasks[spec["task_id"]] = spec
@@ -4152,6 +4390,26 @@ class Controller:
                         f"{frac:.0%} >= {threshold:.0%}, killing worker "
                         f"{victim.worker_id[:8]} "
                         f"(task {victim.current_task or 'idle'})\n")
+                    # Best-effort final checkpoint before the kill: an
+                    # actor victim's state survives when headroom still
+                    # allows the serialize (never when the host is already
+                    # past the hard ceiling — a checkpoint allocates).
+                    if (victim.actor_ids
+                            and flags.get("RTPU_ACTOR_CHECKPOINT")
+                            and frac < min(0.99, threshold + 0.03)):
+                        for aid in list(victim.actor_ids):
+                            actor = self.actors.get(aid)
+                            if actor is None:
+                                continue
+                            try:
+                                res = await victim.conn.request(
+                                    {"kind": "checkpoint_actor",
+                                     "actor_id": aid}, timeout=3)
+                            except Exception:
+                                continue
+                            if isinstance(res, dict) and res.get("blob"):
+                                self._store_actor_checkpoint(
+                                    actor, res["epoch"], res["blob"])
                     await self._shutdown_worker(victim)
                     if victim.spawn_token is not None:
                         # Agent-spawned: no local proc handle — escalate to
@@ -4193,31 +4451,76 @@ class Controller:
         pool = [w for w in running if retriable(w)] or running
         if pool:
             return max(pool, key=lambda w: w.task_started)
-        # Last resort: an actor worker (state lost; reference kills tasks
-        # first for exactly this reason).
+        # Last resort: an actor worker. Prefer one whose actors ALL have a
+        # durable checkpoint — its state survives the kill (restored on
+        # restart), while an uncheckpointed actor's state is simply lost;
+        # ties break to the newest task as before.
         actors = [
             w for wid in node.workers
             if (w := self.workers.get(wid)) is not None and w.actor_ids
         ]
-        return max(actors, key=lambda w: w.task_started, default=None)
+
+        def checkpointed(w: WorkerInfo) -> bool:
+            return all(
+                (a := self.actors.get(aid)) is not None
+                and a.checkpoint is not None
+                for aid in w.actor_ids)
+
+        return max(actors,
+                   key=lambda w: (checkpointed(w), w.task_started),
+                   default=None)
+
+    async def _flush_suspect_calls(self, node: NodeInfo) -> None:
+        """Dispatch actor calls buffered while the node was suspect."""
+        for actor in list(self.actors.values()):
+            if actor.node_id != node.node_id or actor.state != "alive":
+                continue
+            while actor.pending_calls:
+                calls, actor.pending_calls = actor.pending_calls, []
+                for call in calls:
+                    await self._dispatch_actor_call(actor, call)
 
     async def _health_check_loop(self) -> None:
-        """Mark agent nodes dead when heartbeats stop (reference:
-        gcs_health_check_manager.h:39 periodic health checks); also runs the
-        arena memory-pressure check (spill cold objects past the high
-        watermark, reference local_object_manager.h:103-122)."""
-        timeout = flags.get("RTPU_NODE_TIMEOUT_S")
+        """Two-phase failure detector over agent heartbeats (reference:
+        gcs_health_check_manager.h:39 periodic checks, with a SWIM-style
+        suspect phase in front): silence past RTPU_NODE_TIMEOUT_S marks a
+        node SUSPECT — scheduling pauses, actor calls buffer, nothing is
+        killed — and only silence past RTPU_DEAD_TIMEOUT_S declares it
+        DEAD, so a partition shorter than that heals with no actor churn.
+        Also runs the arena memory-pressure check (spill cold objects past
+        the high watermark, reference local_object_manager.h:103-122)."""
         while True:
-            await asyncio.sleep(min(2.0, timeout / 3))
+            suspect_after = flags.get("RTPU_NODE_TIMEOUT_S")
+            dead_after = max(flags.get("RTPU_DEAD_TIMEOUT_S"), suspect_after)
+            await asyncio.sleep(min(2.0, suspect_after / 3))
             now = time.monotonic()
             for node in list(self.nodes.values()):
                 if (
                     node.alive
                     and node.agent_conn is not None
                     and node.last_heartbeat
-                    and now - node.last_heartbeat > timeout
                 ):
-                    await self._on_node_death(node)
+                    silence = now - node.last_heartbeat
+                    if silence > dead_after:
+                        self._emit_event(
+                            "ERROR", "NODE_DEAD_TIMEOUT",
+                            f"node {node.node_id[:8]} silent for "
+                            f"{silence:.1f}s (> RTPU_DEAD_TIMEOUT_S); "
+                            f"declaring it dead",
+                            node_id=node.node_id,
+                            data={"silence_s": round(silence, 2)})
+                        await self._on_node_death(node)
+                    elif silence > suspect_after and not node.suspect:
+                        node.suspect = True
+                        node.suspect_since = now
+                        self._emit_event(
+                            "WARNING", "NODE_SUSPECT",
+                            f"node {node.node_id[:8]} missed heartbeats "
+                            f"for {silence:.1f}s: suspect — scheduling "
+                            f"paused until it heals or "
+                            f"RTPU_DEAD_TIMEOUT_S passes",
+                            node_id=node.node_id,
+                            data={"silence_s": round(silence, 2)})
             try:
                 await self._maybe_spill_cold_objects()
             except Exception as e:  # pragma: no cover — keep the loop alive
@@ -4399,7 +4702,7 @@ class Controller:
         # Draining nodes take no new placements (reference: DrainNode makes
         # the raylet unschedulable while its deadline runs down).
         nodes = [n for n in self.nodes.values()
-                 if n.alive and not n.draining]
+                 if self._schedulable(n)]
         st = strategy.get("type", "DEFAULT")
         # Nodes that spilled this spec back are out for the retry pass
         # (reference: spillback carries the rejecting raylet in the lease

@@ -129,6 +129,8 @@ class HostAgent:
             await ctrl.closed.wait()
             if self._stop.is_set():
                 return
+            if self.ctrl is not ctrl:
+                continue  # deliberately swapped by _try_reregister
             if not await self._reconnect():
                 self._terminate_workers()
                 self._stop.set()
@@ -160,6 +162,35 @@ class HostAgent:
                 await asyncio.sleep(min(backoff, deadline - now))
                 backoff = min(backoff * 2, 2.0)
         return False
+
+    async def _try_reregister(self, rpc_t: float) -> bool:
+        """Dial a fresh connection and re-register on it WITHOUT dropping
+        the current one; only a successful handshake swaps them (the
+        controller's register handler updates node.agent_conn, so the old
+        conn's close is then harmless)."""
+        host, port = self.controller_addr.rsplit(":", 1)
+        ctrl = None
+        try:
+            ctrl = await protocol.connect(
+                host, int(port), self._on_controller_msg,
+                name="agent->controller")
+            await ctrl.request(self._register_msg(),
+                               timeout=max(rpc_t * 2, 2.0))
+        except Exception:
+            if ctrl is not None:
+                try:
+                    await ctrl.close()
+                except Exception:
+                    pass
+            return False
+        old, self.ctrl = self.ctrl, ctrl
+        sys.stderr.write("[host_agent] re-registered over a fresh "
+                         "connection after unacknowledged heartbeats\n")
+        try:
+            await old.close()
+        except Exception:
+            pass
+        return True
 
     # ------------------------------------------------- drain / preemption
 
@@ -504,6 +535,14 @@ class HostAgent:
 
     async def _heartbeat_loop(self) -> None:
         self._psutil_cache: Dict[int, Any] = {}
+        # Partition detection (RTPU_RPC_TIMEOUT_S > 0): heartbeats become
+        # acknowledged requests; once the controller has not answered one
+        # for RTPU_NODE_TIMEOUT_S the agent assumes the connection is
+        # blackholed-but-open and closes it, entering the reconnect loop —
+        # a healed partition re-registers (the controller's suspect phase
+        # kept the node's actors), a dead controller fate-shares as before.
+        # 0 (default) keeps heartbeats fire-and-forget.
+        last_ack = time.monotonic()
         while not self._stop.is_set():
             stats = self.arena.stats() if self.arena else {}
             try:
@@ -520,24 +559,41 @@ class HostAgent:
                 cpu_percent = None
             from .worker_logs import log_volume_bytes
 
-            try:
-                await self.ctrl.send(
-                    {
-                        "kind": "heartbeat",
-                        "node_id": self.node_id,
-                        "t": time.time(),
-                        "arena": stats,
-                        "num_workers": len(self.procs),
-                        "mem_fraction": mem_fraction,
-                        # Host CPU% (the `rtpu status` per-node column).
-                        "cpu_percent": cpu_percent,
-                        "proc_stats": self._proc_stats(),
-                        # Per-node log volume (rtpu_worker_log_bytes gauge).
-                        "log_bytes": log_volume_bytes(),
-                    }
-                )
-            except Exception:
-                pass
+            hb = {
+                "kind": "heartbeat",
+                "node_id": self.node_id,
+                "t": time.time(),
+                "arena": stats,
+                "num_workers": len(self.procs),
+                "mem_fraction": mem_fraction,
+                # Host CPU% (the `rtpu status` per-node column).
+                "cpu_percent": cpu_percent,
+                "proc_stats": self._proc_stats(),
+                # Per-node log volume (rtpu_worker_log_bytes gauge).
+                "log_bytes": log_volume_bytes(),
+            }
+            rpc_t = flags.get("RTPU_RPC_TIMEOUT_S")
+            if rpc_t:
+                try:
+                    await self.ctrl.request(hb, timeout=max(rpc_t, 1.0))
+                    last_ack = time.monotonic()
+                except Exception:
+                    if (time.monotonic() - last_ack
+                            > flags.get("RTPU_NODE_TIMEOUT_S")):
+                        # Suspected partition: try a PARALLEL re-register.
+                        # The old connection stays up meanwhile — closing
+                        # it would FIN through the blackhole and make the
+                        # controller declare this node dead, exactly the
+                        # churn the suspect phase avoids; an app-level heal
+                        # resumes the old conn, a TCP-level death heals via
+                        # the fresh dial.
+                        if await self._try_reregister(rpc_t):
+                            last_ack = time.monotonic()
+            else:
+                try:
+                    await self.ctrl.send(hb)
+                except Exception:
+                    pass
             await self._flush_events()
             try:
                 await asyncio.wait_for(self._stop.wait(), HEARTBEAT_S)

@@ -110,6 +110,67 @@ def testing_delay_s(kind: Optional[str]) -> float:
         _delay_cache = (raw, table)
     return table.get(kind or "", table.get("*", 0.0))
 
+
+# RTPU_TESTING_RPC_DROP: per-kind probabilities of silently DISCARDING a
+# received message before its handler runs (no response ever sent) — models
+# a lossy network / one-way partition. Same "kind=value" spec shape and
+# lazy-parse cache as the delay hook.
+_drop_cache: tuple = (None, {})
+
+
+def testing_drop_prob(kind: Optional[str]) -> float:
+    """Injected drop probability for one message kind (0 = never drop)."""
+    from ray_tpu import flags
+
+    raw = flags.raw("RTPU_TESTING_RPC_DROP")
+    if not raw:
+        return 0.0
+    global _drop_cache
+    cached_raw, table = _drop_cache
+    if raw != cached_raw:
+        table = {}
+        for part in raw.split(","):
+            name, _, p = part.partition("=")
+            try:
+                table[name.strip()] = float(p)
+            except ValueError:
+                continue
+        _drop_cache = (raw, table)
+    return table.get(kind or "", table.get("*", 0.0))
+
+
+# Symmetric process blackhole (testing.NetworkPartitioner): a process whose
+# RTPU_TESTING_NET_ID appears in the shared partition file's "isolated" list
+# drops every inbound AND outbound frame at this layer — TCP connections
+# stay open, bytes vanish, exactly like a network partition. The verdict is
+# cached and re-read at most every 50ms so the per-frame cost when the
+# feature is unused is one monotonic() read and two comparisons.
+_partition_state = {"next": 0.0, "active": False}
+
+
+def partition_active() -> bool:
+    st = _partition_state
+    now = time.monotonic()
+    if now < st["next"]:
+        return st["active"]
+    st["next"] = now + 0.05
+    from ray_tpu import flags
+
+    path = flags.raw("RTPU_TESTING_PARTITION_FILE")
+    my_id = flags.raw("RTPU_TESTING_NET_ID") if path else None
+    active = False
+    if path and my_id:
+        import json as _json
+
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                data = _json.load(f)
+            active = my_id in (data.get("isolated") or ())
+        except Exception:
+            active = False
+    st["active"] = active
+    return active
+
 # Messages are small control-plane payloads; large values go via the object
 # store.  A high cap catches protocol bugs (accidentally inlined tensors).
 MAX_MSG_BYTES = 1 << 31
@@ -195,6 +256,8 @@ class Connection:
 
     def _buffered_write(self, data: bytes) -> None:
         """Queue one framed message; flushed once per loop iteration."""
+        if partition_active():
+            return  # blackholed process: outbound frames vanish (testing)
         if self._loop is None:  # not started (shouldn't happen): direct path
             self.writer.write(data)
             return
@@ -227,6 +290,8 @@ class Connection:
         try:
             while True:
                 msg = await read_msg(self.reader)
+                if partition_active():
+                    continue  # blackholed process: inbound frames vanish
                 if msg.get("kind") == "__response__":
                     fut = self._pending.pop(msg["rid"], None)
                     if fut is not None and not fut.done():
@@ -264,6 +329,12 @@ class Connection:
     async def _serve(self, msg: Dict[str, Any]) -> None:
         rid = msg.get("rid")
         try:
+            drop = testing_drop_prob(msg.get("kind"))
+            if drop:
+                import random as _random
+
+                if _random.random() < drop:
+                    return  # message lost en route: no handler, no response
             delay = testing_delay_s(msg.get("kind"))
             if delay:
                 await asyncio.sleep(delay)
@@ -307,6 +378,8 @@ class Connection:
         header = dumps(msg)
         raw_len = memoryview(raw).nbytes
         total = _LEN.size + len(header) + raw_len
+        if partition_active():
+            return  # blackholed process (testing): the chunk vanishes
         async with self._send_lock:
             self._flush()  # previously queued frames keep their order
             try:

@@ -73,6 +73,25 @@ class ActorMailbox:
         # Per-caller sequence reordering state: caller -> {next, held}.
         self._seq: Dict[str, Dict[str, Any]] = {}
         self._seq_lock = threading.Lock()
+        # Crash-consistent fault tolerance (core/checkpoint.py): durable
+        # checkpoint cadence + the exactly-once replay journal. Configured
+        # from the creation spec via configure(); all off by default so a
+        # plain actor pays nothing.
+        self.ckpt_every_n = 0
+        self.ckpt_interval = 0.0
+        self.ckpt_enabled = False
+        self.replay = False          # journal (caller, seqno) -> result
+        self.ckpt_epoch = 0
+        self.calls_since_ckpt = 0
+        self.last_ckpt = time.monotonic()
+        self._ckpt_pending = False
+        # caller -> {seqno: result payload} of APPLIED calls; a retried
+        # (caller, seqno) short-circuits to its recorded payload instead of
+        # re-executing (reference: the dedup the per-handle sequence_no of
+        # direct_actor_task_submitter enables). Bounded per caller.
+        self.journal: Dict[str, Dict[int, Dict[str, Any]]] = {}
+        self._inflight_keys: set = set()   # accepted, not yet journaled
+        self._dup_waiters: Dict[tuple, List[Dict[str, Any]]] = {}
         self.aio_loop: Any = None  # created lazily for async actors
         self.aio_sem: Any = None
         self._aio_lock = threading.Lock()
@@ -89,6 +108,129 @@ class ActorMailbox:
     # this actor restarted and joined the caller's sequence mid-stream).
     _SEQ_GAP_TIMEOUT_S = 1.0
 
+    # Journal entries retained per caller (seqnos are dense per handle, so
+    # this bounds dedup memory to the retry horizon, not actor lifetime).
+    _JOURNAL_MAX = 1024
+
+    def configure(self, spec: Dict[str, Any]) -> None:
+        """Arm checkpointing / exactly-once replay from the creation spec
+        (called once, before the creation closure is queued)."""
+        self.ckpt_every_n = int(spec.get("checkpoint_every_n") or 0)
+        self.ckpt_interval = float(spec.get("checkpoint_interval_s") or 0.0)
+        self.ckpt_enabled = bool(
+            flags.get("RTPU_ACTOR_CHECKPOINT")
+            and (self.ckpt_every_n > 0 or self.ckpt_interval > 0.0))
+        self.replay = bool(spec.get("max_task_retries"))
+
+    # ------------------------------------------- exactly-once call replay
+
+    @staticmethod
+    def _journal_key(spec: Dict[str, Any]):
+        caller = spec.get("caller")
+        seq = spec.get("seqno")
+        if caller is None or seq is None:
+            return None
+        return (caller, seq)
+
+    def _journal_lookup(self, key) -> Optional[Dict[str, Any]]:
+        with self._seq_lock:
+            entries = self.journal.get(key[0])
+            return entries.get(key[1]) if entries else None
+
+    def _intercept_replay(self, spec: Dict[str, Any]) -> bool:
+        """Dedup a retried call BEFORE it enters the mailbox: an already-
+        applied (caller, seqno) short-circuits to its journaled result; one
+        still in flight parks as a dup-waiter completed alongside the
+        original. Returns True when the spec was consumed here."""
+        key = self._journal_key(spec)
+        if key is None:
+            return False
+        with self._seq_lock:
+            entries = self.journal.get(key[0])
+            hit = entries.get(key[1]) if entries else None
+            if hit is None:
+                if key in self._inflight_keys:
+                    self._dup_waiters.setdefault(key, []).append(spec)
+                    return True
+                self._inflight_keys.add(key)
+                return False
+        self.runtime._complete_replayed(spec, hit)
+        return True
+
+    def note_result(self, spec: Dict[str, Any],
+                    payload: Dict[str, Any]) -> None:
+        """Record one applied call's result (journal + dup waiters) and
+        advance the checkpoint cadence. Runs on whichever thread completed
+        the call; checkpointing itself is enqueued onto the mailbox."""
+        key = self._journal_key(spec)
+        waiters: List[Dict[str, Any]] = []
+        if key is not None and self.replay:
+            with self._seq_lock:
+                entries = self.journal.setdefault(key[0], {})
+                entries[key[1]] = payload
+                if len(entries) > self._JOURNAL_MAX:
+                    for s in sorted(entries)[:len(entries)
+                                             - self._JOURNAL_MAX]:
+                        entries.pop(s, None)
+                self._inflight_keys.discard(key)
+                waiters = self._dup_waiters.pop(key, [])
+        for w in waiters:
+            self.runtime._complete_replayed(w, payload)
+        if self.ckpt_enabled:
+            self.calls_since_ckpt += 1
+            if self.ckpt_every_n \
+                    and self.calls_since_ckpt >= self.ckpt_every_n:
+                self.request_checkpoint()
+
+    # ------------------------------------------------ durable checkpoints
+
+    def ckpt_due(self) -> bool:
+        return (self.ckpt_enabled and self.ckpt_interval > 0.0
+                and self.instance is not None and not self.exited
+                and not self._ckpt_pending
+                and time.monotonic() - self.last_ckpt >= self.ckpt_interval)
+
+    def request_checkpoint(self) -> None:
+        """Enqueue a checkpoint on the mailbox (strictly after every call
+        queued before it, so the record reflects results callers saw)."""
+        if self._ckpt_pending or self.exited:
+            return
+        self._ckpt_pending = True
+        self.q.put({"__create__": self.do_checkpoint})
+
+    def do_checkpoint(self) -> Optional[bytes]:
+        """Serialize instance + journal under the next epoch, write the
+        host-local file, ship an async copy to the controller. Mailbox
+        thread only (actor state is thread-affine). Best-effort: an
+        unpicklable actor keeps running with checkpointing broken, exactly
+        like the drain-snapshot fallback."""
+        from . import checkpoint
+
+        self._ckpt_pending = False
+        if self.exited or self.instance is None:
+            return None
+        with self._seq_lock:
+            journal = {c: dict(e) for c, e in self.journal.items()}
+        try:
+            blob = checkpoint.encode(self.instance, journal,
+                                     self.ckpt_epoch + 1)
+        except Exception:
+            return None
+        self.ckpt_epoch += 1
+        self.calls_since_ckpt = 0
+        self.last_ckpt = time.monotonic()
+        try:
+            checkpoint.write_local(self.actor_id, self.ckpt_epoch, blob)
+        except OSError:
+            pass
+        try:
+            self.runtime.client.send_nowait(
+                {"kind": "actor_checkpoint", "actor_id": self.actor_id,
+                 "epoch": self.ckpt_epoch, "blob": blob})
+        except Exception:
+            pass
+        return blob
+
     def submit(self, spec: Dict[str, Any]) -> None:
         """Enqueue in per-caller SUBMISSION order (reference:
         direct_actor_task_submitter sequence_no). Calls from one caller can
@@ -101,6 +243,8 @@ class ActorMailbox:
             # Arrival stamp for the queue-wait phase: covers time spent in
             # the hold-back buffer AND the mailbox queue.
             spec["__recv_ts__"] = time.time()
+        if self.replay and self._intercept_replay(spec):
+            return  # duplicate of an applied/in-flight call: deduped
         caller = spec.get("caller")
         seq = spec.get("seqno")
         if caller is None or seq is None:
@@ -404,10 +548,15 @@ class WorkerRuntime:
         hosted actors, drop actors the controller says were re-created
         elsewhere while we were away."""
         deadline = time.monotonic() + flags.get("RTPU_RECONNECT_MAX_S")
+        # Bounded handshake under the partition-hardening RPC timeout: a
+        # register into a still-blackholed network fails fast and retries
+        # from the client's dial loop instead of camping 30s per attempt.
+        rpc_t = float(flags.get("RTPU_RPC_TIMEOUT_S") or 0.0)
         while True:
             reply = client.io.call(
-                client.conn.request(self._register_msg(reconnect=True)),
-                timeout=30)
+                client.conn.request(self._register_msg(reconnect=True),
+                                    timeout=rpc_t * 2 if rpc_t else None),
+                timeout=(rpc_t * 2 if rpc_t else 30) + 5)
             if reply and reply.get("ok"):
                 break
             if not (reply and reply.get("retry")) \
@@ -708,6 +857,9 @@ class WorkerRuntime:
         self.actors.pop(aid, None)
         if mb is not None:
             mb.stop()
+        from . import checkpoint as _ckpt
+
+        _ckpt.prune_local(aid)  # retired for good: no record may resurrect it
 
     def _cancel_task(self, task_id: str) -> None:
         """Non-force ray.cancel (reference: TaskCancelledError raised in
@@ -805,13 +957,24 @@ class WorkerRuntime:
             # complete. Best-effort: unpicklable/slow actors fall back to a
             # fresh constructor run on the new node.
             return await self._snapshot_actor(msg["actor_id"])
+        elif kind == "checkpoint_actor":
+            # On-demand durable checkpoint (the memory monitor's final
+            # checkpoint before an OOM kill, and tests): the response
+            # carries the record so the controller stores it synchronously
+            # before the SIGKILL lands.
+            return await self._checkpoint_actor(msg["actor_id"])
         elif kind == "drop_actor":
             # The controller moved this actor elsewhere: retire the local
-            # instance so post-snapshot mutations cannot be silently lost.
+            # instance so post-snapshot mutations cannot be silently lost —
+            # and prune this host's checkpoint files, which are stale the
+            # moment the actor lives (and checkpoints) somewhere else.
             mb = self.actors.pop(msg["actor_id"], None)
             if mb is not None:
                 mb.exited = True
                 mb.stop()
+            from . import checkpoint as _ckpt
+
+            _ckpt.prune_local(msg["actor_id"])
         elif kind == "cancel_task":
             self._cancel_task(msg["task_id"])
         elif kind == "shutdown":
@@ -855,8 +1018,16 @@ class WorkerRuntime:
         fut: "asyncio.Future" = loop.create_future()
 
         def snap():
+            from . import checkpoint
+
             try:
-                blob = cloudpickle.dumps(mb.instance)
+                # Record format (instance + replay journal + epoch): a
+                # migrated replayable actor keeps its dedup journal, and
+                # the snapshot supersedes any older durable checkpoint.
+                with mb._seq_lock:
+                    journal = {c: dict(e) for c, e in mb.journal.items()}
+                blob = checkpoint.encode(mb.instance, journal,
+                                         mb.ckpt_epoch + 1)
                 payload: Dict[str, Any] = {"blob": blob}
             except Exception as e:  # unpicklable state: ctor fallback
                 payload = {"error": repr(e)}
@@ -870,6 +1041,32 @@ class WorkerRuntime:
             return await asyncio.wait_for(fut, timeout=8.0)
         except asyncio.TimeoutError:
             return {"error": "snapshot timed out behind queued calls"}
+
+    async def _checkpoint_actor(self, actor_id: str) -> Dict[str, Any]:
+        """On-demand durable checkpoint, on the mailbox thread after every
+        queued call. Returns {epoch, blob} so the caller (the controller's
+        OOM path) can store the record without waiting for the async ship."""
+        import asyncio
+
+        mb = self.actors.get(actor_id)
+        if mb is None or mb.exited or mb.instance is None:
+            return {"error": "actor not hosted here"}
+        loop = asyncio.get_running_loop()
+        fut: "asyncio.Future" = loop.create_future()
+
+        def run():
+            blob = mb.do_checkpoint()
+            payload = ({"epoch": mb.ckpt_epoch, "blob": blob}
+                       if blob is not None
+                       else {"error": "checkpoint failed"})
+            loop.call_soon_threadsafe(
+                lambda: fut.done() or fut.set_result(payload))
+
+        mb.q.put({"__create__": run})
+        try:
+            return await asyncio.wait_for(fut, timeout=8.0)
+        except asyncio.TimeoutError:
+            return {"error": "checkpoint timed out behind queued calls"}
 
     def _format_stacks(self) -> str:
         import sys
@@ -963,6 +1160,10 @@ class WorkerRuntime:
         tls.label = spec.get("label", "")
         if spec.get("actor_id") and actor_instance is not None:
             tls.actor_id = spec["actor_id"]
+        if mailbox is not None and (mailbox.replay or mailbox.ckpt_enabled):
+            # Completion paths journal the result / advance the checkpoint
+            # cadence through this handle (popped exactly once there).
+            spec["__mb__"] = mailbox
         if task_id in self.cancelled_tasks:
             from .controller import TaskCancelledError
 
@@ -1171,6 +1372,11 @@ class WorkerRuntime:
         if ph is not None:
             ph["result_store_s"] = max(0.0, time.time() - t_store)
         self._record_phases(spec, "finished")
+        mb = spec.pop("__mb__", None)
+        if mb is not None:
+            # Journal BEFORE the caller can observe the result: a duplicate
+            # arriving right after the reply must hit the journal.
+            mb.note_result(spec, {"locations": locations})
         msg = {
             "kind": "task_done",
             "task_id": spec["task_id"],
@@ -1224,6 +1430,13 @@ class WorkerRuntime:
             ObjectLocation(object_id=oid, size=len(data), inline=data, is_error=True)
             for oid in err_ids
         ]
+        mb = spec.pop("__mb__", None)
+        if mb is not None:
+            # Errors journal too: the call WAS applied (it raised) — a
+            # replayed duplicate must observe the same exception, not
+            # re-execute the method.
+            mb.note_result(spec, {"error_locations": err_locs,
+                                  "is_error": True})
         msg = {
             "kind": "task_done",
             "task_id": spec["task_id"],
@@ -1236,6 +1449,23 @@ class WorkerRuntime:
             msg["spec"] = {k: v for k, v in spec.items()
                            if not k.startswith("__")}
             msg["started_ts"] = spec.get("__start_ts__")
+        try:
+            self._ship_done(msg)
+        except Exception:
+            pass
+
+    def _complete_replayed(self, spec: Dict[str, Any],
+                           payload: Dict[str, Any]) -> None:
+        """A deduped duplicate of an already-applied call: republish the
+        journaled outcome — locations or error — without re-executing
+        (exactly-once replay). The task_done retires a controller-path
+        resubmission of the same task_id; the location store is idempotent,
+        so replying twice is safe."""
+        self._finish_direct(spec, payload)
+        msg = {"kind": "task_done", "task_id": spec["task_id"],
+               "worker_id": self.worker_id}
+        msg.update(payload)
+        spec.pop("__leased__", None)
         try:
             self._ship_done(msg)
         except Exception:
@@ -1331,29 +1561,65 @@ class WorkerRuntime:
                 )
         return [put_bytes(v, oid, self.node_id) for v, oid in zip(values, return_ids)]
 
+    def _restore_record(self, spec: Dict[str, Any],
+                        mb: "ActorMailbox") -> Optional[Dict[str, Any]]:
+        """Newest reachable checkpoint/snapshot record for this actor: the
+        controller-shipped blob riding the spec vs a (possibly newer)
+        host-local checkpoint file — epochs are monotonic across hosts, so
+        the comparison is one int. None -> run the constructor."""
+        from . import checkpoint
+
+        blob = spec.get("state_blob")
+        rec: Optional[Dict[str, Any]] = None
+        if blob is not None:
+            rec = checkpoint.decode(blob)
+        if mb.ckpt_enabled:
+            local = checkpoint.newest_local(mb.actor_id)
+            if local is not None and local[0] > (rec or {}).get("epoch", 0):
+                try:
+                    rec = checkpoint.decode(local[1])
+                except Exception:
+                    pass  # torn/stale file: the shipped copy (or ctor) wins
+        return rec
+
     def _instantiate_actor(self, spec: Dict[str, Any]) -> None:
         actor_id = spec["actor_id"]
         mb = ActorMailbox(self, actor_id, spec.get("max_concurrency", 1))
         mb.spec = spec  # kept for re-claiming the actor after a controller bounce
+        mb.configure(spec)
         self.actors[actor_id] = mb
+        if mb.ckpt_enabled and mb.ckpt_interval > 0.0:
+            self._ensure_ckpt_timer()
 
         def create():
             from . import ownership
 
             _held = ownership.acquire_spec_refs(spec)  # noqa: F841
             try:
-                blob = spec.get("state_blob")
-                if blob is not None:
-                    # Drain migration: restore the serialized instance from
-                    # the old node instead of re-running the constructor —
-                    # the actor arrives with its state intact.
-                    mb.instance = cloudpickle.loads(blob)
+                rec = self._restore_record(spec, mb)
+                restored_epoch = None
+                if rec is not None:
+                    # Drain migration or crash restart: restore the newest
+                    # reachable record instead of re-running the
+                    # constructor — the actor arrives with state AND its
+                    # exactly-once journal intact.
+                    mb.instance = rec["instance"]
+                    mb.ckpt_epoch = int(rec.get("epoch", 0))
+                    if mb.replay and rec.get("journal"):
+                        with mb._seq_lock:
+                            mb.journal = {c: dict(e) for c, e
+                                          in rec["journal"].items()}
+                    restored_epoch = mb.ckpt_epoch
                 else:
                     cls = self._load_function(spec["func_id"])
                     args, kwargs = self._resolve_args(spec)
                     mb.instance = cls(*args, **kwargs)
                 ctx.task_local.actor_id = actor_id
-                self.client.request({"kind": "actor_ready", "actor_id": actor_id})
+                ready: Dict[str, Any] = {"kind": "actor_ready",
+                                         "actor_id": actor_id}
+                if restored_epoch is not None:
+                    ready["restored_epoch"] = restored_epoch
+                self.client.request(ready)
             except BaseException as e:  # noqa: BLE001
                 tb = traceback.format_exc()
                 self.client.request(
@@ -1366,6 +1632,27 @@ class WorkerRuntime:
 
         # __init__ runs on the mailbox thread so actor state is thread-affine.
         mb.q.put({"__create__": create})
+
+    def _ensure_ckpt_timer(self) -> None:
+        """One daemon sweep thread for interval-based checkpoints, started
+        lazily at the first hosted actor with checkpoint_interval_s — a
+        worker hosting none never grows the thread."""
+        if getattr(self, "_ckpt_timer_started", False):
+            return
+        self._ckpt_timer_started = True
+
+        def _tick() -> None:
+            while not self.shutdown_event.is_set():
+                time.sleep(flags.get("RTPU_CHECKPOINT_TICK_S"))
+                for mb in list(self.actors.values()):
+                    try:
+                        if mb.ckpt_due():
+                            mb.request_checkpoint()
+                    except Exception:
+                        pass  # checkpointing must never hurt the actor
+
+        threading.Thread(target=_tick, name="ckpt-timer",
+                         daemon=True).start()
 
     def serve_forever(self) -> None:
         self.shutdown_event.wait()

@@ -142,7 +142,17 @@ _define("RTPU_METRICS_PORT", int, 0,
 _define("RTPU_MAX_RECONSTRUCTIONS", int, 3,
         "Max lineage re-executions per object before giving up.")
 _define("RTPU_NODE_TIMEOUT_S", float, 10.0,
-        "Heartbeat silence after which a node is declared dead.")
+        "Heartbeat silence after which a node is marked SUSPECT: the "
+        "scheduler stops placing work on it and actor calls buffer, but "
+        "nothing is killed — a healed partition rejoins without actor "
+        "churn (reference: the SWIM-style suspect phase in front of "
+        "gcs_health_check_manager death declarations).")
+_define("RTPU_DEAD_TIMEOUT_S", float, 30.0,
+        "Heartbeat silence after which a suspect node is declared DEAD "
+        "and its work re-queues/restarts elsewhere. The suspect->dead "
+        "two-phase detector means a partition shorter than this heals "
+        "with no duplicate actor instance and no double-allocation; "
+        "must be >= RTPU_NODE_TIMEOUT_S (clamped if not).")
 _define("RTPU_HEARTBEAT_S", float, 2.0,
         "Host-agent heartbeat period.")
 _define("RTPU_MEMORY_MONITOR", bool, True,
@@ -172,6 +182,31 @@ _define("RTPU_TESTING_RPC_DELAY_MS", str, None,
         "'register=200,heartbeat=50' or '*=20' (reference: "
         "RAY_testing_asio_delay_us). Applied server-side in the protocol "
         "layer before the handler runs; testing only.")
+_define("RTPU_TESTING_RPC_DROP", str, None,
+        "Fault-injection: per-message-kind DROP probabilities, e.g. "
+        "'submit_actor_task=0.3,*=0.05'. A dropped message is read off "
+        "the wire and silently discarded before its handler runs — no "
+        "response is ever sent, modeling a lossy/partitioned network. "
+        "Survivable only for idempotent request kinds retried under "
+        "RTPU_RPC_TIMEOUT_S; testing only.")
+_define("RTPU_TESTING_NET_ID", str, None,
+        "Fault-injection: this process's identity for NetworkPartitioner "
+        "blackholes (testing.NetworkPartitioner). Inherited by spawned "
+        "children, so tagging a host agent partitions its whole host.")
+_define("RTPU_TESTING_PARTITION_FILE", str, None,
+        "Fault-injection: JSON file naming partitioned net ids "
+        "({\"isolated\": [...]}). A process whose RTPU_TESTING_NET_ID is "
+        "listed drops every inbound AND outbound protocol frame (a "
+        "symmetric blackhole: TCP stays open, bytes vanish) until the "
+        "entry is removed; testing only.")
+_define("RTPU_RPC_TIMEOUT_S", float, 0.0,
+        "Per-request control-plane timeout with capped exponential "
+        "backoff retry: a blocking client request that gets no response "
+        "within this window treats the connection as suspect, re-dials, "
+        "and re-sends (submit handlers are idempotent by task/actor id, "
+        "so blind re-sends never double-execute). 0 (default) disables — "
+        "requests wait indefinitely, as before; enable on partition- or "
+        "loss-prone networks (chaos tests set it).")
 
 # -- node drain / preemption -------------------------------------------------
 _define("RTPU_DRAIN_DEADLINE_S", float, 30.0,
@@ -194,6 +229,28 @@ _define("RTPU_PREEMPTION_URL", str,
         "testing.PreemptionInjector fake.")
 _define("RTPU_PREEMPTION_POLL_S", float, 1.0,
         "Preemption watcher polling period.")
+
+# -- actor checkpoints / exactly-once replay ---------------------------------
+_define("RTPU_ACTOR_CHECKPOINT", bool, True,
+        "Durable actor checkpoints: actors created with "
+        "checkpoint_interval_s / checkpoint_every_n periodically "
+        "serialize their state (plus the exactly-once call journal) to a "
+        "host-local file and ship an async copy to the controller, so a "
+        "crash restart restores the newest reachable checkpoint instead "
+        "of re-running the constructor (reference: gcs_actor_manager "
+        "restart + the Ray paper's actor checkpointing story). 0 "
+        "disables the subsystem entirely: no checkpoint threads exist "
+        "and the per-call path pays one flag check at actor creation.")
+_define("RTPU_CHECKPOINT_DIR", str, None,
+        "Directory for host-local actor checkpoint files (default: a "
+        "per-host dir under the system temp root). Shared by every "
+        "worker on the host so a restarted actor placed on the same "
+        "host can restore a checkpoint newer than the controller's "
+        "shipped copy.")
+_define("RTPU_CHECKPOINT_TICK_S", float, 0.25,
+        "Worker-side sweep period for interval-based actor checkpoints "
+        "(the timer thread only exists while a checkpointing actor with "
+        "checkpoint_interval_s is hosted).")
 
 # -- object transfer (inter-node pulls / broadcast) --------------------------
 _define("RTPU_PULL_STREAM", bool, True,

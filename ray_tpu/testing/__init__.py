@@ -7,9 +7,11 @@ Reference: ray's ``_private/test_utils.py`` ResourceKiller hierarchy and the
 from .fault_injection import (  # noqa: F401
     ControllerKiller,
     HostAgentKiller,
+    NetworkPartitioner,
     PreemptionInjector,
     ProcessSuspender,
     ResourceKillerBase,
     WorkerKiller,
     rpc_delays,
+    rpc_drops,
 )

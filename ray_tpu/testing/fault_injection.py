@@ -314,6 +314,86 @@ class PreemptionInjector:
             self._kill_thread.join(timeout=10)
 
 
+class NetworkPartitioner:
+    """Symmetric process blackholes at the protocol layer (chaos testing).
+
+    Models a network partition without root/iptables: every participating
+    process carries a net id (``RTPU_TESTING_NET_ID``, inherited by spawned
+    children — tagging a host agent partitions its whole host) and shares a
+    partition file (``RTPU_TESTING_PARTITION_FILE``). ``isolate(id)`` makes
+    each process with that id drop ALL inbound and outbound protocol frames
+    — TCP connections stay open, heartbeats/requests/responses simply
+    vanish — until ``heal()``. This is the honest failure mode the
+    suspect→dead detector and the RTPU_RPC_TIMEOUT_S retry path exist for:
+    nothing crashes, nothing disconnects, the bytes just stop.
+
+        part = NetworkPartitioner()
+        env = {**part.env("driverB"), ...}   # for the process to isolate
+        ...
+        with part.partition("driverB"):      # ~two-way blackhole
+            time.sleep(10)
+        part.stop()
+    """
+
+    def __init__(self, path: "Optional[str]" = None):
+        import json
+        import tempfile
+
+        if path is None:
+            fd, path = tempfile.mkstemp(prefix="rtpu-partition-",
+                                        suffix=".json")
+            os.close(fd)
+        self.path = path
+        self._json = json
+        self.isolated: set = set()
+        self._write()
+
+    def _write(self) -> None:
+        tmp = self.path + f".tmp{os.getpid()}"
+        with open(tmp, "w", encoding="utf-8") as f:
+            self._json.dump({"isolated": sorted(self.isolated)}, f)
+        os.replace(tmp, self.path)
+
+    def env(self, net_id: str) -> Dict[str, str]:
+        """Env vars that enroll one process (tree) under ``net_id``."""
+        return {"RTPU_TESTING_NET_ID": net_id,
+                "RTPU_TESTING_PARTITION_FILE": self.path}
+
+    def enroll_self(self, net_id: str) -> None:
+        """Enroll the CURRENT process (e.g. the test's driver side)."""
+        from ray_tpu import flags
+
+        flags.set_env("RTPU_TESTING_NET_ID", net_id)
+        flags.set_env("RTPU_TESTING_PARTITION_FILE", self.path)
+
+    def isolate(self, *net_ids: str) -> None:
+        self.isolated.update(net_ids)
+        self._write()
+
+    def heal(self, *net_ids: str) -> None:
+        """Remove ids from the blackhole set (all of them when none given)."""
+        if net_ids:
+            self.isolated.difference_update(net_ids)
+        else:
+            self.isolated.clear()
+        self._write()
+
+    @contextlib.contextmanager
+    def partition(self, *net_ids: str):
+        self.isolate(*net_ids)
+        try:
+            yield self
+        finally:
+            self.heal(*net_ids)
+
+    def stop(self) -> None:
+        self.heal()
+        try:
+            os.unlink(self.path)
+        except OSError:
+            pass
+
+
 @contextlib.contextmanager
 def rpc_delays(spec: str):
     """Scoped ``RTPU_TESTING_RPC_DELAY_MS`` (reference:
@@ -336,3 +416,28 @@ def rpc_delays(spec: str):
             flags.unset_env("RTPU_TESTING_RPC_DELAY_MS")
         else:
             flags.set_env("RTPU_TESTING_RPC_DELAY_MS", prev)
+
+
+@contextlib.contextmanager
+def rpc_drops(spec: str):
+    """Scoped ``RTPU_TESTING_RPC_DROP``: probabilistically discard matching
+    received messages before their handler runs, in THIS process and every
+    child spawned inside the scope (lossy-network soak testing; pair with
+    ``RTPU_RPC_TIMEOUT_S`` so idempotent requests retry through the loss).
+
+        with rpc_drops("submit_actor_task=0.3,get_locations=0.2"):
+            ...
+
+    Format: ``kind=prob[,kind=prob...]``; ``*`` matches every kind.
+    """
+    from ray_tpu import flags
+
+    prev = flags.raw("RTPU_TESTING_RPC_DROP")
+    flags.set_env("RTPU_TESTING_RPC_DROP", spec)
+    try:
+        yield
+    finally:
+        if prev is None:
+            flags.unset_env("RTPU_TESTING_RPC_DROP")
+        else:
+            flags.set_env("RTPU_TESTING_RPC_DROP", prev)

@@ -71,6 +71,12 @@ def generate_dashboard(prom_text: str,
             if name == "rtpu_events_total":
                 exprs = [(f"sum(rate({name}[5m])) by (severity)",
                           "{{severity}}")]
+            elif name == "rtpu_actor_checkpoints_total":
+                # Checkpoint cadence + volume on one panel: the durable-
+                # actor story is healthy when both tick together.
+                exprs = [(f"rate({name}[5m])", "checkpoints/s"),
+                         ("rate(rtpu_actor_checkpoint_bytes[5m])",
+                          "bytes/s")]
             else:
                 exprs = [(f"rate({name}[5m])", "{{instance}}")]
             ptitle = f"{name} (rate/s)"
@@ -96,6 +102,18 @@ def generate_dashboard(prom_text: str,
             # Per-node gauges (log volume, arena usage) legend by node so
             # one panel fans out across the cluster; per-worker-process
             # gauges (heartbeat cpu/rss) additionally split by pid.
+            if name == "rtpu_nodes":
+                # Drain/failure-detector lifecycle mix (alive/suspect/
+                # draining/drained/dead) — a suspect spike is the first
+                # visible sign of a partition.
+                exprs = [("sum(rtpu_nodes) by (state)", "{{state}}")]
+                panels.append(_panel(pid, f"{name} (by state)", exprs, x, y,
+                                     description=doc))
+                pid += 1
+                x = 12 - x
+                if x == 0:
+                    y += 8
+                continue
             if name in ("rtpu_worker_cpu_percent", "rtpu_worker_rss_bytes"):
                 legend = "{{node}}/{{pid}}"
             elif name in ("rtpu_worker_log_bytes",

@@ -266,6 +266,53 @@ def test_transfer_stream_beats_serial_floor():
         cluster.shutdown()
 
 
+def test_actor_checkpoint_disabled_path_overhead(ray_start_regular,
+                                                 monkeypatch):
+    """Actor-checkpoint guard (mirrors the RTPU_TASK_EVENTS guard): with
+    RTPU_ACTOR_CHECKPOINT=0 no checkpoint thread exists and an actor —
+    even one created WITH checkpoint options — pays one flag check at
+    creation and nothing per call; the actor-call round-trip holds the
+    same throughput floor as the always-on benchmark."""
+    monkeypatch.setenv("RTPU_ACTOR_CHECKPOINT", "0")
+
+    @ray_tpu.remote
+    class A:
+        def f(self):
+            return None
+
+    a = A.options(checkpoint_every_n=1, max_restarts=1).remote()
+    ray_tpu.get(a.f.remote())
+    t0 = time.perf_counter()
+    ray_tpu.get([a.f.remote() for _ in range(300)])
+    dt = time.perf_counter() - t0
+    assert 300 / dt > 100, \
+        f"checkpoint-disabled actor throughput {300/dt:.0f}/s below floor"
+
+
+def test_fault_injection_disabled_path_overhead(ray_start_regular,
+                                                monkeypatch):
+    """Partition/drop-injection guard: with RTPU_TESTING_RPC_DROP and the
+    partition file unset (the production state), the protocol layer pays
+    one cached check per frame and per served message — the task
+    round-trip holds the same throughput floor as the plain benchmark, so
+    the chaos hooks can never silently tax a healthy cluster. The RPC
+    timeout stays at its 0 default, so no per-request timers exist."""
+    monkeypatch.delenv("RTPU_TESTING_RPC_DROP", raising=False)
+    monkeypatch.delenv("RTPU_TESTING_PARTITION_FILE", raising=False)
+    monkeypatch.delenv("RTPU_RPC_TIMEOUT_S", raising=False)
+
+    @ray_tpu.remote
+    def nop():
+        return None
+
+    ray_tpu.get([nop.remote() for _ in range(8)])  # warm the pool
+    t0 = time.perf_counter()
+    ray_tpu.get([nop.remote() for _ in range(200)])
+    dt = time.perf_counter() - t0
+    assert 200 / dt > 30, \
+        f"injection-disabled task throughput {200/dt:.0f}/s below floor"
+
+
 def test_large_object_bandwidth_floor(ray_start_regular):
     arr = np.ones(4 * 1024 * 1024, dtype=np.float64)  # 32MB
     t0 = time.perf_counter()
