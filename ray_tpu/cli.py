@@ -264,6 +264,183 @@ def cmd_stack(args) -> int:
         rt.shutdown()
 
 
+_SPARK_BARS = "▁▂▃▄▅▆▇█"
+
+
+def _spark(vals) -> str:
+    """Unicode sparkline over a value series (the `rtpu top` history
+    cells)."""
+    vals = list(vals)
+    if not vals:
+        return ""
+    lo, hi = min(vals), max(vals)
+    span = (hi - lo) or 1.0
+    return "".join(
+        _SPARK_BARS[int((v - lo) / span * (len(_SPARK_BARS) - 1))]
+        for v in vals)
+
+
+def _fmt_bytes(n) -> str:
+    n = float(n or 0)
+    for unit in ("B", "KB", "MB", "GB", "TB"):
+        if n < 1024 or unit == "TB":
+            return f"{n:.1f}{unit}" if unit != "B" else f"{int(n)}B"
+        n /= 1024
+    return f"{n:.1f}TB"
+
+
+def _top_frame(window: float = 120.0, spark_points: int = 30) -> str:
+    """One `rtpu top` frame: cluster header, node table, per-label task
+    rates + exec p99 with history sparklines, object-store bytes, firing
+    alerts, event tail — all from cluster_state + the telemetry ring
+    (query_metrics), zero external services."""
+    from ray_tpu.core import context as ctx
+    from ray_tpu.util import state as state_api
+
+    cs = ctx.get_worker_context().client.request({"kind": "cluster_state"})
+    since = time.time() - window
+    lines = []
+    nodes = cs.get("nodes") or []
+    alive = sum(1 for n in nodes if n.get("alive"))
+    lines.append(
+        f"ray_tpu top — uptime {cs.get('uptime_s', 0):.0f}s · "
+        f"nodes {alive}/{len(nodes)} alive · "
+        f"workers {cs.get('num_workers', 0)} · "
+        f"actors {len(cs.get('actors') or {})} · "
+        f"pending {cs.get('pending_tasks', 0)}")
+    try:
+        firing = state_api.list_alerts().get("firing") or []
+    except Exception:
+        firing = []
+    for a in firing:
+        tags = ",".join(f"{k}={v}" for k, v in sorted(a["tags"].items()))
+        lines.append(f"!! ALERT FIRING: {a['alert']}"
+                     + (f" {{{tags}}}" if tags else "")
+                     + f" value={a.get('value', 0):.4g}")
+    lines.append("")
+    lines.append(f"{'NODE':14} {'STATE':10} {'CPU%':>6} {'MEM%':>6} "
+                 f"{'WORKERS':>8} {'TPU':>5}")
+    for n in sorted(nodes, key=lambda n: n.get("index", 0)):
+        st = n.get("state", "alive" if n.get("alive") else "dead")
+        tpu = (n.get("resources") or {}).get("TPU", 0)
+        lines.append(
+            f"{n['node_id'][:12]:14} {st:10} "
+            f"{n.get('cpu_percent') or 0.0:>6.1f} "
+            f"{(n.get('mem_fraction') or 0.0) * 100:>6.1f} "
+            f"{n.get('num_workers', 0):>8} {tpu:>5.0f}")
+
+    def q(**kw):
+        try:
+            resp = state_api.query_metrics(since=since, **kw)
+            return resp.get("series", []) if resp.get("enabled") else None
+        except Exception:
+            return None
+
+    rate = q(name="rtpu_task_exec_s", stat="rate", window_s=30.0)
+    p99 = q(name="rtpu_task_exec_s", stat="p99", window_s=window)
+    if rate is None:
+        lines.append("")
+        lines.append("telemetry disabled (RTPU_TSDB=0) — task-rate and "
+                     "history views need the controller TSDB")
+    else:
+        p99_by_tags = {tuple(sorted(s["tags"].items())): s for s in p99 or []}
+        lines.append("")
+        lines.append(f"{'TASK LABEL':24} {'RATE/S':>8} {'EXEC P99':>10}  "
+                     f"HISTORY (rate, {window:.0f}s)")
+        for ser in sorted(rate, key=lambda s: str(s["tags"])):
+            label = ser["tags"].get("label", "?")
+            pts = [v for _, v in ser["points"]]
+            cur = pts[-1] if pts else 0.0
+            pser = p99_by_tags.get(tuple(sorted(ser["tags"].items())))
+            pv = (pser["points"][-1][1]
+                  if pser and pser["points"] else 0.0)
+            lines.append(f"{label[:24]:24} {cur:>8.1f} {pv:>9.4f}s  "
+                         f"{_spark(pts[-spark_points:])}")
+        if not rate:
+            lines.append("  (no task history yet)")
+        arena = q(name="rtpu_arena_used_bytes") or []
+        for ser in arena:
+            pts = [v for _, v in ser["points"]]
+            if pts:
+                lines.append("")
+                lines.append(
+                    f"object store  used {_fmt_bytes(pts[-1]):>10}  "
+                    f"{_spark(pts[-spark_points:])}")
+    lines.append("")
+    try:
+        events = state_api.list_events(limit=6)
+    except Exception:
+        events = []
+    lines.append("EVENTS")
+    for ev in events[-6:]:
+        lines.append("  " + _fmt_event(ev))
+    if not events:
+        lines.append("  (none)")
+    return "\n".join(lines)
+
+
+def cmd_top(args) -> int:
+    """`rtpu top` (reference: the dashboard's live cluster view / `htop`
+    for the cluster): a refreshing terminal view of nodes, per-label task
+    rates + exec p99 with sparkline history, object-store bytes, firing
+    alerts, and the event tail — served entirely from the controller's
+    in-process telemetry ring."""
+    rt = _connect(args)
+    try:
+        if args.once:
+            print(_top_frame(window=args.window))
+            return 0
+        while True:
+            frame = _top_frame(window=args.window)
+            # Clear + home; one write so the frame never tears.
+            sys.stdout.write("\x1b[2J\x1b[H" + frame + "\n")
+            sys.stdout.flush()
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        return 0
+    finally:
+        rt.shutdown()
+
+
+def cmd_profile(args) -> int:
+    """`rtpu profile` (reference: py-spy flamegraphs via the dashboard /
+    `ray stack --native`): sample wall-clock stacks across the targeted
+    workers for --duration seconds, merge into one cluster-wide profile,
+    write a self-contained flamegraph HTML."""
+    rt = _connect(args)
+    from ray_tpu.util import state
+
+    try:
+        res = state.profile(
+            duration=args.duration, task_id=args.task_id,
+            actor_id=args.actor_id, node_id=args.node,
+            worker_id=args.worker_id, hz=args.hz)
+        if res.get("error"):
+            print(f"profile failed: {res['error']}", file=sys.stderr)
+            return 1
+        stacks = res.get("stacks") or {}
+        from ray_tpu.core import profiler
+
+        meta = (f"{res.get('samples', 0)} samples over "
+                f"{res.get('duration', 0):.1f}s at {res.get('hz', 0):.0f}Hz "
+                f"from {len(res.get('workers') or {})} worker(s)")
+        profiler.save_flamegraph(args.out, stacks,
+                                 title="rtpu cluster profile", meta=meta)
+        if args.collapsed_out:
+            with open(args.collapsed_out, "w") as f:
+                f.write(profiler.to_collapsed_text(stacks))
+        print(f"{meta} -> {args.out}", file=sys.stderr)
+        # The terminal gets the hot leaves (self-heavy stacks), the HTML
+        # the full picture.
+        top = sorted(stacks.items(), key=lambda kv: -kv[1])[:5]
+        for key, n in top:
+            leaf = key.rsplit(";", 1)[-1]
+            print(f"  {n:>6}  {leaf}", file=sys.stderr)
+        return 0
+    finally:
+        rt.shutdown()
+
+
 def cmd_summary(args) -> int:
     rt = _connect(args)
     from ray_tpu.util import state
@@ -656,6 +833,41 @@ def main(argv=None) -> int:
     p.add_argument("--timeout", type=float, default=2.0,
                    help="seconds to wait for worker replies")
     p.set_defaults(fn=cmd_stack)
+
+    p = sub.add_parser("top", help="live cluster view: nodes, task "
+                                   "rates/p99 with sparkline history, "
+                                   "firing alerts, event tail")
+    p.add_argument("--address", default=None)
+    p.add_argument("--interval", type=float, default=2.0,
+                   help="refresh period seconds")
+    p.add_argument("--window", type=float, default=120.0,
+                   help="history window seconds for rates/sparklines")
+    p.add_argument("--once", action="store_true",
+                   help="print one frame and exit (no screen clearing)")
+    p.set_defaults(fn=cmd_top)
+
+    p = sub.add_parser("profile", help="cluster-wide wall-clock "
+                                       "flamegraph (sampling profiler "
+                                       "across workers)")
+    p.add_argument("--address", default=None)
+    p.add_argument("--duration", type=float, default=2.0,
+                   help="seconds each targeted worker samples")
+    p.add_argument("--hz", type=float, default=None,
+                   help="sampling frequency (default RTPU_PROFILER_HZ)")
+    p.add_argument("--task-id", default=None,
+                   help="only the worker executing this task (prefix ok)")
+    p.add_argument("--actor-id", default=None,
+                   help="only the worker hosting this actor (prefix ok)")
+    p.add_argument("--node", default=None,
+                   help="only workers on this node (prefix ok)")
+    p.add_argument("--worker-id", default=None,
+                   help="only this worker (prefix ok)")
+    p.add_argument("-o", "--out", default="profile.html",
+                   help="flamegraph HTML output path")
+    p.add_argument("--collapsed-out", default=None, metavar="FILE",
+                   help="also write collapsed-stack text "
+                        "(flamegraph.pl/speedscope format)")
+    p.set_defaults(fn=cmd_profile)
 
     p = sub.add_parser("drain", help="gracefully drain a node "
                                      "(migrate actors, re-queue tasks, "

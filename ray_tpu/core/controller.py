@@ -65,6 +65,78 @@ PHASE_METRIC_HELP = {
 PHASE_BOUNDARIES = [0.0001, 0.0005, 0.001, 0.005, 0.01, 0.05,
                     0.1, 0.5, 1.0, 5.0, 10.0, 30.0, 60.0]
 
+# Every core metric family the controller exports: name -> (type, help).
+# Single source of truth for the /metrics exposition, the telemetry ring
+# (core/telemetry.py samples _metrics_families each step), grafana panel
+# derivation, and the metrics lint (tests/test_metrics_lint.py) that
+# refuses rtpu_* names without help text.
+CORE_METRIC_META: Dict[str, Tuple[str, str]] = {
+    "rtpu_tasks": ("gauge", "Tasks currently in each lifecycle state "
+                            "(bounded event window)"),
+    "rtpu_pending_tasks": ("gauge", "Tasks waiting in the scheduler queue"),
+    "rtpu_workers": ("gauge", "Registered worker processes"),
+    "rtpu_actors": ("gauge", "Registered actors"),
+    "rtpu_nodes_alive": ("gauge", "Nodes currently alive"),
+    "rtpu_objects": ("gauge", "Objects tracked by the object directory"),
+    "rtpu_nodes": ("gauge", "Nodes by drain-lifecycle state "
+                            "(alive/draining/drained/dead)"),
+    "rtpu_node_drains_total": ("counter", "Node drains initiated, "
+                                          "by reason"),
+    "rtpu_uptime_seconds": ("counter", "Controller uptime"),
+    "rtpu_objects_spilled_total": ("counter", "Objects spilled to disk"),
+    "rtpu_broadcast_bytes_total": (
+        "counter", "Object bytes moved by broadcast chains, by role "
+                   "(source/hop)"),
+    "rtpu_object_replicas": ("gauge", "Extra object replicas held by "
+                                      "broadcast chain hops"),
+    "rtpu_actor_checkpoints_total": (
+        "counter", "Durable actor checkpoints stored by the controller"),
+    "rtpu_actor_checkpoint_bytes": (
+        "counter", "Cumulative bytes of stored actor checkpoint records"),
+    "rtpu_leases_active": ("gauge", "Active direct-dispatch worker "
+                                    "leases"),
+    "rtpu_lease_events_total": (
+        "counter", "Direct-dispatch lease lifecycle: blocks/leases "
+                   "granted, reclaim nudges sent, grants refused under "
+                   "memory pressure"),
+    "rtpu_arena_used_bytes": ("gauge", "Controller-host object arena "
+                                       "bytes in use"),
+    "rtpu_arena_capacity_bytes": ("gauge", "Controller-host object arena "
+                                           "capacity"),
+    "rtpu_node_arena_used_bytes": ("gauge", "Per-node object arena bytes "
+                                            "in use (agent heartbeats)"),
+    "rtpu_node_mem_fraction": (
+        "gauge", "Per-node host memory utilization 0-1 (agent "
+                 "heartbeats; controller-host sample for local nodes)"),
+    "rtpu_node_cpu_percent": (
+        "gauge", "Per-node host CPU percent (agent heartbeats; "
+                 "controller-host sample for local nodes)"),
+    "rtpu_worker_log_bytes": ("gauge", "Bytes of worker log files per "
+                                       "node"),
+    "rtpu_events_total": ("counter", "Cluster events recorded, by source "
+                                     "and severity"),
+    "rtpu_worker_cpu_percent": ("gauge", "Worker process CPU percent "
+                                         "(host-agent heartbeats)"),
+    "rtpu_worker_rss_bytes": ("gauge", "Worker process resident set size "
+                                       "(host-agent heartbeats)"),
+    "rtpu_rpc_handled_total": ("counter", "Control-plane RPCs handled, "
+                                          "by message kind"),
+    "rtpu_rpc_handler_seconds_total": (
+        "counter", "Cumulative RPC handler seconds, by message kind"),
+}
+
+# Families whose HELP/TYPE lines are emitted even with no samples yet
+# (the exposition always carried these headers; conditional families —
+# drains, arena, per-node/per-pid gauges — appear once they have data).
+_ALWAYS_EXPORT = frozenset({
+    "rtpu_tasks", "rtpu_pending_tasks", "rtpu_workers", "rtpu_actors",
+    "rtpu_nodes_alive", "rtpu_objects", "rtpu_nodes",
+    "rtpu_uptime_seconds", "rtpu_objects_spilled_total",
+    "rtpu_broadcast_bytes_total", "rtpu_object_replicas",
+    "rtpu_actor_checkpoints_total", "rtpu_actor_checkpoint_bytes",
+    "rtpu_leases_active", "rtpu_lease_events_total",
+})
+
 
 def _hist_quantile(bounds: List[float], h: Dict[str, Any], q: float) -> float:
     """Percentile estimate from cumulative bucket counts (the
@@ -426,6 +498,25 @@ class Controller:
         # (a hung task yields ONE event, not one per sweep).
         self._hang_reported: Set[str] = set()
         self._watchdog_task: Optional[asyncio.Task] = None
+        # Telemetry plane (core/telemetry.py): metrics-history ring +
+        # alert rules, persisted beside --state-path so `rtpu top`
+        # history and firing alerts survive a controller bounce.
+        self.tsdb = None
+        self.alerts = None
+        self._telemetry_task: Optional[asyncio.Task] = None
+        if flags.get("RTPU_TSDB"):
+            from . import telemetry
+
+            self.tsdb = telemetry.MetricsTSDB(
+                step_s=flags.get("RTPU_TSDB_STEP_S"),
+                retain=flags.get("RTPU_TSDB_RETAIN"),
+                persist_path=(self.persist_path + ".tsdb")
+                if self.persist_path else None,
+                persist_every_s=flags.get("RTPU_TSDB_PERSIST_S"))
+            self.alerts = telemetry.AlertEngine(
+                telemetry.load_alert_rules(flags.get("RTPU_ALERT_RULES")),
+                self._emit_event)
+            self.alerts.restore(self.tsdb.restored_alert_state)
 
     # ------------------------------------------------------------------ setup
 
@@ -451,6 +542,10 @@ class Controller:
             # Off => no task, no per-sweep work: the disabled-path perf
             # floor is literally zero controller cycles.
             self._watchdog_task = loop.create_task(self._hang_watchdog_loop())
+        if self.tsdb is not None:
+            # RTPU_TSDB=0 => no task, no per-step sampling work: the
+            # disabled path is zero controller cycles (perf-floor test).
+            self._telemetry_task = loop.create_task(self._telemetry_loop())
         # Resume drains interrupted by a controller bounce: restored
         # (non-agent) nodes become unschedulable immediately, but the
         # drain task itself waits out the reconnect grace — the node's
@@ -589,6 +684,12 @@ class Controller:
             self._memory_task.cancel()
         if self._watchdog_task is not None:
             self._watchdog_task.cancel()
+        if self._telemetry_task is not None:
+            self._telemetry_task.cancel()
+        if self.tsdb is not None:
+            # Clean shutdown persists unconditionally (maybe_persist is
+            # period-gated); a bounce resumes history where it stopped.
+            self.tsdb.save(self.alerts.snapshot() if self.alerts else None)
         if getattr(self, "_metrics_server", None) is not None:
             self._metrics_server.close()
         if self.server is not None:
@@ -2637,16 +2738,23 @@ class Controller:
             "stack_dump", float(msg.get("timeout", 2.0)))
         return {"req_id": req_id, "requested": requested, "workers": workers}
 
-    async def _gather_from_workers(self, kind: str, timeout: float):
-        """Fan a request to every live worker and gather replies (arriving
-        as profile_result messages) until all respond or the deadline
-        passes — partial results, never an error."""
+    async def _gather_from_workers(self, kind: str, timeout: float,
+                                   extra: Optional[Dict[str, Any]] = None,
+                                   worker_ids: Optional[List[str]] = None):
+        """Fan a request to the target workers (default: all live) and
+        gather replies (arriving as profile_result messages) until all
+        respond or the deadline passes — partial results, never an
+        error. ``extra`` fields ride along on the request frame."""
         req_id = uuid.uuid4().hex[:12]
         self._profiles[req_id] = {}
         targets = []
-        for w in list(self.workers.values()):
+        pool = (list(self.workers.values()) if worker_ids is None
+                else [self.workers[w] for w in worker_ids
+                      if w in self.workers])
+        for w in pool:
             try:
-                await w.conn.send({"kind": kind, "req_id": req_id})
+                await w.conn.send(
+                    dict(extra or {}, kind=kind, req_id=req_id))
                 targets.append(w.worker_id)
             except Exception:
                 pass
@@ -2661,6 +2769,101 @@ class Controller:
         if bucket is not None:
             bucket[msg["worker_id"]] = msg["text"]
         return {"ok": True}
+
+    def _profile_targets(self, msg) -> Optional[List[str]]:
+        """Resolve a profile request's scope to worker ids (None = every
+        live worker). Entity ids match on prefix, same as the event
+        filters."""
+        tid = msg.get("task_id")
+        aid = msg.get("actor_id")
+        nid = msg.get("node_id")
+        wid = msg.get("worker_id")
+        if not (tid or aid or nid or wid):
+            return None
+        out: Set[str] = set()
+        if wid:
+            out |= {w for w in self.workers if w.startswith(wid)}
+        if nid:
+            out |= {w.worker_id for w in self.workers.values()
+                    if w.node_id.startswith(nid)}
+        if aid:
+            for a in self.actors.values():
+                if a.actor_id.startswith(aid) and a.worker_id:
+                    out.add(a.worker_id)
+        if tid:
+            for w in self.workers.values():
+                if w.current_task and w.current_task.startswith(tid):
+                    out.add(w.worker_id)
+        return sorted(out)
+
+    async def _h_profile(self, conn, msg):
+        """Cluster flamegraph profiler (reference: the dashboard's
+        py-spy flamegraph button, dashboard/modules/reporter — here a
+        pure-Python wall-clock sampler inside our own workers): fan the
+        sampling request to the target workers, gather their collapsed
+        stacks, merge. Partial results are still a profile; a worker
+        stuck in native code just misses the window."""
+        if not flags.get("RTPU_PROFILER"):
+            return {"error": "profiler disabled (RTPU_PROFILER=0)"}
+        duration = min(120.0, max(0.1, float(msg.get("duration", 2.0))))
+        hz = float(msg.get("hz") or flags.get("RTPU_PROFILER_HZ"))
+        targets = self._profile_targets(msg)
+        if targets is not None and not targets:
+            return {"error": "no live workers match the requested "
+                             "task/actor/node/worker filter"}
+        from . import profiler
+
+        _, requested, replies = await self._gather_from_workers(
+            "profile", duration + 5.0,
+            extra={"duration": duration, "hz": hz},
+            worker_ids=targets)
+        merged = profiler.merge_collapsed(replies)
+        return {"requested": requested, "duration": duration, "hz": hz,
+                "stacks": merged["stacks"], "samples": merged["samples"],
+                "workers": merged["workers"]}
+
+    # ------------------------------------------------------ telemetry plane
+
+    async def _telemetry_loop(self) -> None:
+        """Sample every metric family into the TSDB ring each step and
+        run the alert rules over it (core/telemetry.py)."""
+        while True:
+            await asyncio.sleep(self.tsdb.step_s)
+            try:
+                now = time.time()
+                self.tsdb.sample(now, self._metrics_families())
+                if self.alerts is not None:
+                    self.alerts.evaluate(now, self.tsdb)
+                self.tsdb.maybe_persist(
+                    now, self.alerts.snapshot() if self.alerts else None)
+            except Exception as e:
+                # History must never hurt the control plane.
+                sys.stderr.write(f"[controller] telemetry step failed: "
+                                 f"{e!r}\n")
+
+    async def _h_query_metrics(self, conn, msg):
+        """Metrics history (rtpu top / dashboard sparklines / alert
+        tooling): plottable series from the TSDB ring with counter->rate
+        and histogram->p50/p99 derivation done server-side."""
+        if self.tsdb is None:
+            return {"enabled": False, "series": [], "now": time.time(),
+                    "step_s": 0.0}
+        series = self.tsdb.query(
+            name=msg.get("name"), prefix=msg.get("prefix"),
+            tags=msg.get("tags"), since=msg.get("since"),
+            stat=msg.get("stat"),
+            window_s=float(msg.get("window_s", 60.0)),
+            limit_series=int(msg.get("limit_series", 64)))
+        return {"enabled": True, "series": series, "now": time.time(),
+                "step_s": self.tsdb.step_s,
+                "retain": self.tsdb.retain}
+
+    async def _h_list_alerts(self, conn, msg):
+        """Alert rules + current firing state (rtpu top header, tests)."""
+        if self.alerts is None:
+            return {"enabled": False, "rules": [], "firing": []}
+        return {"enabled": True, "rules": list(self.alerts.rules),
+                "firing": self.alerts.firing()}
 
     async def _h_memory_summary(self, conn, msg):
         """`rtpu memory` backend (reference: `ray memory` reference-table
@@ -3444,110 +3647,108 @@ class Controller:
             await asyncio.sleep(0.05)
         return (self._profiles.pop(req_id, None) or {}).get(w.worker_id, "")
 
-    def _metrics_text(self) -> str:
-        """Prometheus text exposition (reference: _private/metrics_agent.py
-        + ray_metrics_export — collapsed to a controller-local scrape)."""
+    def _metrics_families(self) -> Dict[str, Dict[str, Any]]:
+        """Every exportable metric family, in exposition order:
+        {name: {"type", "help", "boundaries", "data": {tags_tuple: v}}}.
+        Single source for the Prometheus text endpoint AND the telemetry
+        ring (core/telemetry.py samples this each step), so history
+        covers exactly what /metrics shows."""
+        def fam(name: str, data: Dict) -> Dict[str, Any]:
+            mtype, help_ = CORE_METRIC_META[name]
+            return {"type": mtype, "help": help_, "boundaries": [],
+                    "data": data}
+
+        families: Dict[str, Dict[str, Any]] = {}
         counts: Dict[str, int] = {}
         for ev in self._latest_task_events().values():
             counts[ev["event"]] = counts.get(ev["event"], 0) + 1
-        # Gauge, not counter: the value is "tasks currently in state X" over
-        # a bounded event window — it goes down on transitions/eviction,
-        # which would break Prometheus rate() on a counter type.
-        lines = [
-            "# TYPE rtpu_tasks gauge",
-        ]
-        for state, n in sorted(counts.items()):
-            lines.append(f'rtpu_tasks{{state="{state}"}} {n}')
-        lines += [
-            "# TYPE rtpu_pending_tasks gauge",
-            f"rtpu_pending_tasks {len(self.pending_queue)}",
-            "# TYPE rtpu_workers gauge",
-            f"rtpu_workers {len(self.workers)}",
-            "# TYPE rtpu_actors gauge",
-            f"rtpu_actors {len(self.actors)}",
-            "# TYPE rtpu_nodes_alive gauge",
-            f"rtpu_nodes_alive {sum(1 for n in self.nodes.values() if n.alive)}",
-            "# TYPE rtpu_objects gauge",
-            f"rtpu_objects {len(self.objects)}",
-            "# HELP rtpu_nodes Nodes by drain-lifecycle state "
-            "(alive/draining/drained/dead)",
-            "# TYPE rtpu_nodes gauge",
-        ]
+        # Gauge, not counter: "tasks currently in state X" over a bounded
+        # event window goes down on transitions/eviction, which would
+        # break Prometheus rate() on a counter type.
+        families["rtpu_tasks"] = fam("rtpu_tasks", {
+            (("state", s),): n for s, n in counts.items()})
+        families["rtpu_pending_tasks"] = fam(
+            "rtpu_pending_tasks", {(): len(self.pending_queue)})
+        families["rtpu_workers"] = fam("rtpu_workers",
+                                       {(): len(self.workers)})
+        families["rtpu_actors"] = fam("rtpu_actors",
+                                      {(): len(self.actors)})
+        families["rtpu_nodes_alive"] = fam("rtpu_nodes_alive", {
+            (): sum(1 for n in self.nodes.values() if n.alive)})
+        families["rtpu_objects"] = fam("rtpu_objects",
+                                       {(): len(self.objects)})
         node_states: Dict[str, int] = {}
         for n in self.nodes.values():
             st = self._node_state(n)
             node_states[st] = node_states.get(st, 0) + 1
-        for st, cnt in sorted(node_states.items()):
-            lines.append(f'rtpu_nodes{{state="{st}"}} {cnt}')
-        if self.drain_counts:
-            lines.append("# HELP rtpu_node_drains_total Node drains "
-                         "initiated, by reason")
-            lines.append("# TYPE rtpu_node_drains_total counter")
-            for reason, cnt in sorted(self.drain_counts.items()):
-                lines.append(
-                    f'rtpu_node_drains_total{{reason="{reason}"}} {cnt}')
-        lines += [
-            "# TYPE rtpu_uptime_seconds counter",
-            f"rtpu_uptime_seconds {time.time() - self.start_time:.1f}",
-            "# TYPE rtpu_objects_spilled_total counter",
-            f"rtpu_objects_spilled_total {self.spilled_count}",
-            # Broadcast byte accounting: 'source' is what left the origin
-            # host (~one object size per broadcast regardless of fan-out),
-            # 'hop' is the sum received across all chain hops.
-            "# HELP rtpu_broadcast_bytes_total Object bytes moved by "
-            "broadcast chains, by role (source/hop)",
-            "# TYPE rtpu_broadcast_bytes_total counter",
-            f'rtpu_broadcast_bytes_total{{role="source"}} '
-            f'{self.broadcast_bytes["source"]}',
-            f'rtpu_broadcast_bytes_total{{role="hop"}} '
-            f'{self.broadcast_bytes["hop"]}',
-            "# TYPE rtpu_object_replicas gauge",
-            f"rtpu_object_replicas "
-            f"{sum(len(r) for r in self.object_replicas.values())}",
-            # Bulk-lease accounting: active leases + lifetime grant/reclaim
-            # counters so the direct-dispatch control plane is observable.
-            # Actor-checkpoint accounting (durable checkpoints shipped to
-            # the controller: count + cumulative record bytes).
-            "# HELP rtpu_actor_checkpoints_total Durable actor "
-            "checkpoints stored by the controller",
-            "# TYPE rtpu_actor_checkpoints_total counter",
-            f"rtpu_actor_checkpoints_total {self.ckpt_stats['count']}",
-            "# HELP rtpu_actor_checkpoint_bytes Cumulative bytes of "
-            "stored actor checkpoint records",
-            "# TYPE rtpu_actor_checkpoint_bytes counter",
-            f"rtpu_actor_checkpoint_bytes {self.ckpt_stats['bytes']}",
-            "# TYPE rtpu_leases_active gauge",
-            f"rtpu_leases_active {len(self._leases)}",
-            "# HELP rtpu_lease_events_total Direct-dispatch lease "
-            "lifecycle: blocks/leases granted, reclaim nudges sent, "
-            "grants refused under memory pressure",
-            "# TYPE rtpu_lease_events_total counter",
-        ]
-        for k, v in sorted(self.lease_stats.items()):
-            lines.append(f'rtpu_lease_events_total{{event="{k}"}} {v}')
+        families["rtpu_nodes"] = fam("rtpu_nodes", {
+            (("state", s),): c for s, c in node_states.items()})
+        families["rtpu_node_drains_total"] = fam(
+            "rtpu_node_drains_total",
+            {(("reason", r),): c for r, c in self.drain_counts.items()})
+        families["rtpu_uptime_seconds"] = fam(
+            "rtpu_uptime_seconds",
+            {(): round(time.time() - self.start_time, 1)})
+        families["rtpu_objects_spilled_total"] = fam(
+            "rtpu_objects_spilled_total", {(): self.spilled_count})
+        # Broadcast byte accounting: 'source' is what left the origin
+        # host (~one object size per broadcast regardless of fan-out),
+        # 'hop' is the sum received across all chain hops.
+        families["rtpu_broadcast_bytes_total"] = fam(
+            "rtpu_broadcast_bytes_total",
+            {(("role", "source"),): self.broadcast_bytes["source"],
+             (("role", "hop"),): self.broadcast_bytes["hop"]})
+        families["rtpu_object_replicas"] = fam(
+            "rtpu_object_replicas",
+            {(): sum(len(r) for r in self.object_replicas.values())})
+        families["rtpu_actor_checkpoints_total"] = fam(
+            "rtpu_actor_checkpoints_total", {(): self.ckpt_stats["count"]})
+        families["rtpu_actor_checkpoint_bytes"] = fam(
+            "rtpu_actor_checkpoint_bytes", {(): self.ckpt_stats["bytes"]})
+        families["rtpu_leases_active"] = fam("rtpu_leases_active",
+                                             {(): len(self._leases)})
+        families["rtpu_lease_events_total"] = fam(
+            "rtpu_lease_events_total",
+            {(("event", k),): v for k, v in self.lease_stats.items()})
         if self._arena is not None:
             st = self._arena.stats()
-            lines += [
-                "# TYPE rtpu_arena_used_bytes gauge",
-                f"rtpu_arena_used_bytes {st['used']}",
-                "# TYPE rtpu_arena_capacity_bytes gauge",
-                f"rtpu_arena_capacity_bytes {st['capacity']}",
-            ]
-        for n in self.nodes.values():
-            if n.arena_stats:
-                lines.append(
-                    f'rtpu_node_arena_used_bytes{{node="{n.node_id[:12]}"}} '
-                    f"{n.arena_stats.get('used', 0)}")
-        # Per-node worker-log volume (agent heartbeats; the controller
-        # samples its own host at scrape time for agent-less nodes).
-        log_lines = []
+            families["rtpu_arena_used_bytes"] = fam(
+                "rtpu_arena_used_bytes", {(): st["used"]})
+            families["rtpu_arena_capacity_bytes"] = fam(
+                "rtpu_arena_capacity_bytes", {(): st["capacity"]})
+        families["rtpu_node_arena_used_bytes"] = fam(
+            "rtpu_node_arena_used_bytes",
+            {(("node", n.node_id[:12]),): n.arena_stats.get("used", 0)
+             for n in self.nodes.values() if n.arena_stats})
+        # Node-level host cpu/mem/log-volume (agent heartbeats; the
+        # controller samples its own host once per pass for agent-less
+        # nodes — same contract as cluster_state).
+        local_cpu = local_mem = None
         local_log_bytes: Optional[int] = None
+        mem_data: Dict[Tuple, Any] = {}
+        cpu_data: Dict[Tuple, Any] = {}
+        log_data: Dict[Tuple, Any] = {}
         for n in self.nodes.values():
             if not n.alive:
                 continue
+            key = (("node", n.node_id[:12]),)
             if n.agent_conn is not None:
-                v = n.log_bytes
+                mem_data[key] = n.mem_fraction
+                cpu_data[key] = n.cpu_percent
+                log_data[key] = n.log_bytes
             else:
+                if local_cpu is None:
+                    try:
+                        import psutil
+
+                        local_cpu = psutil.cpu_percent(None)
+                        local_mem = psutil.virtual_memory().percent / 100.0
+                    except Exception:
+                        local_cpu = local_mem = -1.0
+                mem_data[key] = (n.mem_fraction if local_mem in (None, -1.0)
+                                 else local_mem)
+                cpu_data[key] = (n.cpu_percent if local_cpu in (None, -1.0)
+                                 else local_cpu)
                 if local_log_bytes is None:
                     from .worker_logs import log_volume_bytes
 
@@ -3555,67 +3756,61 @@ class Controller:
                         local_log_bytes = log_volume_bytes()
                     except Exception:
                         local_log_bytes = 0
-                v = local_log_bytes
-            log_lines.append(
-                f'rtpu_worker_log_bytes{{node="{n.node_id[:12]}"}} {v}')
-        if log_lines:
-            lines.append("# HELP rtpu_worker_log_bytes Bytes of worker "
-                         "log files per node")
-            lines.append("# TYPE rtpu_worker_log_bytes gauge")
-            lines.extend(log_lines)
-        # Cluster-event accounting (core/events.py EventLog counters).
-        if getattr(self, "events", None) is not None and self.events.counts:
-            lines.append("# HELP rtpu_events_total Cluster events "
-                         "recorded, by source and severity")
-            lines.append("# TYPE rtpu_events_total counter")
-            for (source, severity), n in sorted(self.events.counts.items()):
-                lines.append(
-                    f'rtpu_events_total{{source="{source}",'
-                    f'severity="{severity}"}} {n}')
-        # Per-worker-process cpu/rss from host-agent heartbeats (dashboard
-        # reporter parity, now scrapeable + grafana-panelled).
-        cpu_lines, rss_lines = [], []
+                log_data[key] = local_log_bytes
+        families["rtpu_node_mem_fraction"] = fam("rtpu_node_mem_fraction",
+                                                 mem_data)
+        families["rtpu_node_cpu_percent"] = fam("rtpu_node_cpu_percent",
+                                                cpu_data)
+        families["rtpu_worker_log_bytes"] = fam("rtpu_worker_log_bytes",
+                                                log_data)
+        families["rtpu_events_total"] = fam("rtpu_events_total", {
+            (("source", src), ("severity", sev)): n
+            for (src, sev), n in
+            (self.events.counts.items()
+             if getattr(self, "events", None) is not None else ())})
+        wcpu: Dict[Tuple, Any] = {}
+        wrss: Dict[Tuple, Any] = {}
         for n in self.nodes.values():
             if not n.alive:
                 continue
-            for pid, st in sorted(n.proc_stats.items()):
-                node_l = n.node_id[:12]
-                cpu_lines.append(
-                    f'rtpu_worker_cpu_percent{{node="{node_l}",'
-                    f'pid="{pid}"}} {st.get("cpu_percent", 0.0)}')
-                rss_lines.append(
-                    f'rtpu_worker_rss_bytes{{node="{node_l}",'
-                    f'pid="{pid}"}} {st.get("rss", 0.0)}')
-        if cpu_lines:
-            lines.append("# HELP rtpu_worker_cpu_percent Worker process "
-                         "CPU percent (host-agent heartbeats)")
-            lines.append("# TYPE rtpu_worker_cpu_percent gauge")
-            lines.extend(cpu_lines)
-            lines.append("# HELP rtpu_worker_rss_bytes Worker process "
-                         "resident set size (host-agent heartbeats)")
-            lines.append("# TYPE rtpu_worker_rss_bytes gauge")
-            lines.extend(rss_lines)
-        # Control-plane RPC accounting (protocol.py handler stats): count +
-        # cumulative handler seconds per message kind.
+            for pid, st in n.proc_stats.items():
+                key = (("node", n.node_id[:12]), ("pid", str(pid)))
+                wcpu[key] = st.get("cpu_percent", 0.0)
+                wrss[key] = st.get("rss", 0.0)
+        families["rtpu_worker_cpu_percent"] = fam(
+            "rtpu_worker_cpu_percent", wcpu)
+        families["rtpu_worker_rss_bytes"] = fam(
+            "rtpu_worker_rss_bytes", wrss)
         rpc = protocol.handler_stats()
-        if rpc:
-            lines.append("# TYPE rtpu_rpc_handled_total counter")
-            for kind, (n_served, _) in sorted(rpc.items()):
-                lines.append(
-                    f'rtpu_rpc_handled_total{{kind="{kind}"}} {n_served}')
-            lines.append("# TYPE rtpu_rpc_handler_seconds_total counter")
-            for kind, (_, secs) in sorted(rpc.items()):
-                lines.append(
-                    f'rtpu_rpc_handler_seconds_total{{kind="{kind}"}} '
-                    f"{secs:.6f}")
-        # App-defined metrics (util/metrics.py).
+        families["rtpu_rpc_handled_total"] = fam(
+            "rtpu_rpc_handled_total",
+            {(("kind", k),): n for k, (n, _) in rpc.items()})
+        families["rtpu_rpc_handler_seconds_total"] = fam(
+            "rtpu_rpc_handler_seconds_total",
+            {(("kind", k),): round(s, 6) for k, (_, s) in rpc.items()})
+        # Conditional families appear once they have samples; the
+        # always-set keeps its HELP/TYPE headers from day one.
+        for name in [n for n, f in families.items()
+                     if not f["data"] and n not in _ALWAYS_EXPORT]:
+            del families[name]
+        # App-defined metrics (util/metrics.py), sorted by name after the
+        # core families.
+        for name, m in sorted(self.app_metrics.items()):
+            families[name] = m
+        return families
+
+    def _metrics_text(self) -> str:
+        """Prometheus text exposition (reference: _private/metrics_agent.py
+        + ray_metrics_export — collapsed to a controller-local scrape),
+        rendered generically from _metrics_families()."""
         def esc(v) -> str:
             # Prometheus label-value escaping: one bad value must not
             # corrupt the whole scrape payload.
             return (str(v).replace("\\", "\\\\").replace('"', '\\"')
                     .replace("\n", "\\n"))
 
-        for name, m in sorted(self.app_metrics.items()):
+        lines: List[str] = []
+        for name, m in self._metrics_families().items():
             if m["help"]:
                 lines.append(f"# HELP {name} {m['help']}")
             ptype = "histogram" if m["type"] == "histogram" else m["type"]

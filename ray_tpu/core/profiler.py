@@ -1,0 +1,226 @@
+"""Sampling wall-clock profiler + flamegraph rendering (no py-spy).
+
+Parity target: the reference's py-spy-backed `ray stack --native` /
+dashboard flamegraph button. py-spy is an external Rust binary that needs
+ptrace rights; inside our own workers a pure-Python
+``sys._current_frames()`` sampler gets the same wall-clock picture of
+Python code for free: the controller fans a ``profile`` RPC out to the
+target workers, each samples its threads for the requested duration,
+ships collapsed stacks back, and the controller merges them into one
+cluster-wide profile rendered as a self-contained flamegraph HTML.
+
+Collapsed-stack format is the Brendan Gregg interchange text: one line
+per unique stack, frames root->leaf joined by ';', then a space and the
+sample count — so the output also feeds external flamegraph.pl /
+speedscope tooling unchanged.
+"""
+from __future__ import annotations
+
+import html
+import json
+import sys
+import threading
+import time
+from typing import Dict, List, Optional
+
+MAX_DEPTH = 128
+
+
+def _frame_name(frame) -> str:
+    co = frame.f_code
+    fn = co.co_filename.rsplit("/", 1)[-1]
+    # def-line, not current line: the same function paused at different
+    # lines must merge into ONE flamegraph frame or hot functions shatter
+    # into per-line slivers.
+    return f"{co.co_name} ({fn}:{co.co_firstlineno})"
+
+
+def sample_stacks(duration_s: float, hz: float = 67.0,
+                  skip_threads: Optional[set] = None) -> Dict[str, int]:
+    """Sample every thread's Python stack for ``duration_s`` at ``hz``.
+
+    Returns collapsed-stack -> count. The sampler's own thread is skipped
+    (it would otherwise dominate every profile with its sleep loop), as is
+    any thread id in ``skip_threads``.
+    """
+    period = 1.0 / max(1.0, float(hz))
+    deadline = time.monotonic() + max(0.05, float(duration_s))
+    counts: Dict[str, int] = {}
+    self_id = threading.get_ident()
+    skip = set(skip_threads or ())
+    skip.add(self_id)
+    while time.monotonic() < deadline:
+        t0 = time.monotonic()
+        names = {t.ident: t.name for t in threading.enumerate()}
+        for tid, frame in sys._current_frames().items():
+            if tid in skip:
+                continue
+            stack: List[str] = []
+            f = frame
+            while f is not None and len(stack) < MAX_DEPTH:
+                stack.append(_frame_name(f))
+                f = f.f_back
+            stack.append(f"thread:{names.get(tid, tid)}")
+            key = ";".join(reversed(stack))
+            counts[key] = counts.get(key, 0) + 1
+        elapsed = time.monotonic() - t0
+        if elapsed < period:
+            time.sleep(period - elapsed)
+    return counts
+
+
+def profile_and_encode(duration_s: float, hz: float = 67.0) -> str:
+    """Worker-side entry point: sample and JSON-encode for the
+    profile_result reply (rides the same gather path as stack_dump)."""
+    t0 = time.monotonic()
+    stacks = sample_stacks(duration_s, hz)
+    return json.dumps({
+        "stacks": stacks,
+        "samples": sum(stacks.values()),
+        "duration_s": round(time.monotonic() - t0, 3),
+    })
+
+
+def merge_collapsed(per_worker: Dict[str, str]) -> Dict[str, dict]:
+    """Merge worker profile_result texts (JSON from profile_and_encode).
+
+    Returns {"stacks": {collapsed: count}, "samples": int,
+    "workers": {worker_id: samples|error-string}} — a worker whose reply
+    failed to parse is reported, never fatal (partial profiles are still
+    profiles, same contract as profile_workers).
+    """
+    stacks: Dict[str, int] = {}
+    samples = 0
+    workers: Dict[str, object] = {}
+    for wid, text in per_worker.items():
+        try:
+            payload = json.loads(text)
+            if "error" in payload:
+                workers[wid] = str(payload["error"])
+                continue
+            for key, n in payload.get("stacks", {}).items():
+                stacks[key] = stacks.get(key, 0) + int(n)
+            n = int(payload.get("samples", 0))
+            samples += n
+            workers[wid] = n
+        except Exception as e:
+            workers[wid] = f"unparseable reply: {e}"
+    return {"stacks": stacks, "samples": samples, "workers": workers}
+
+
+# ------------------------------------------------------------- rendering
+
+
+def _build_tree(stacks: Dict[str, int]) -> dict:
+    root = {"name": "all", "value": 0, "children": {}}
+    for key, count in stacks.items():
+        root["value"] += count
+        node = root
+        for frame in key.split(";"):
+            child = node["children"].get(frame)
+            if child is None:
+                child = node["children"][frame] = {
+                    "name": frame, "value": 0, "children": {}}
+            child["value"] += count
+            node = child
+    return root
+
+
+def _tree_to_json(node: dict) -> dict:
+    return {"n": node["name"], "v": node["value"],
+            "c": [_tree_to_json(c) for c in
+                  sorted(node["children"].values(),
+                         key=lambda x: -x["value"])]}
+
+
+_HTML_TEMPLATE = """<!doctype html>
+<html><head><meta charset="utf-8"><title>__TITLE__</title>
+<style>
+body { font: 12px -apple-system, Segoe UI, sans-serif; margin: 12px;
+       background: #1b1f27; color: #dde; }
+h1 { font-size: 15px; }
+#meta { color: #8892a6; margin-bottom: 8px; }
+#fg { position: relative; width: 100%; }
+.fr { position: absolute; height: 17px; box-sizing: border-box;
+      overflow: hidden; white-space: nowrap; font-size: 11px;
+      line-height: 17px; padding: 0 3px; border: 1px solid #1b1f27;
+      border-radius: 2px; cursor: pointer; color: #201a10; }
+.fr:hover { border-color: #fff; }
+#tip { position: fixed; background: #000c; color: #fff; padding: 4px 8px;
+       border-radius: 4px; pointer-events: none; display: none;
+       max-width: 70vw; font-size: 11px; z-index: 9; }
+</style></head><body>
+<h1>__TITLE__</h1>
+<div id="meta">__META__ &mdash; click a frame to zoom, click the root to
+reset</div>
+<div id="fg"></div><div id="tip"></div>
+<script>
+var DATA = __DATA__;
+var fg = document.getElementById('fg'), tip = document.getElementById('tip');
+var ROW = 18, focusNode = DATA;
+function color(s) {
+  var h = 0; for (var i = 0; i < s.length; i++) h = (h * 31 + s.charCodeAt(i)) >>> 0;
+  return 'hsl(' + (20 + h % 40) + ',' + (60 + h % 30) + '%,' + (52 + h % 16) + '%)';
+}
+function depth(n) { var d = 1, m = 0;
+  n.c.forEach(function(c){ m = Math.max(m, depth(c)); }); return d + m; }
+function render() {
+  fg.innerHTML = '';
+  var W = fg.clientWidth || 960;
+  fg.style.height = (depth(focusNode) * ROW + 4) + 'px';
+  function draw(node, x, w, row) {
+    if (w < 1) return;
+    var d = document.createElement('div');
+    d.className = 'fr';
+    d.style.left = x + 'px'; d.style.top = (row * ROW) + 'px';
+    d.style.width = w + 'px';
+    d.style.background = color(node.n);
+    d.textContent = w > 28 ? node.n : '';
+    d.onclick = function(ev) { ev.stopPropagation();
+      focusNode = (node === focusNode) ? DATA : node; render(); };
+    d.onmousemove = function(ev) {
+      tip.style.display = 'block';
+      tip.style.left = Math.min(ev.clientX + 12, innerWidth - 320) + 'px';
+      tip.style.top = (ev.clientY + 12) + 'px';
+      tip.textContent = node.n + ' — ' + node.v + ' samples (' +
+        (100 * node.v / DATA.v).toFixed(1) + '%)';
+    };
+    d.onmouseout = function() { tip.style.display = 'none'; };
+    fg.appendChild(d);
+    var cx = x;
+    node.c.forEach(function(ch) {
+      var cw = w * ch.v / node.v; draw(ch, cx, cw, row + 1); cx += cw;
+    });
+  }
+  draw(focusNode, 0, W, 0);
+}
+window.onresize = render; render();
+</script></body></html>
+"""
+
+
+def render_flamegraph_html(stacks: Dict[str, int],
+                           title: str = "rtpu profile",
+                           meta: str = "") -> str:
+    """Self-contained flamegraph page (zero external assets — it must
+    open from a laptop with no network path back to the cluster)."""
+    tree = _tree_to_json(_build_tree(stacks))
+    total = sum(stacks.values())
+    info = meta or f"{total} samples, {len(stacks)} unique stacks"
+    return (_HTML_TEMPLATE
+            .replace("__TITLE__", html.escape(title))
+            .replace("__META__", html.escape(info))
+            .replace("__DATA__", json.dumps(tree)))
+
+
+def save_flamegraph(path: str, stacks: Dict[str, int],
+                    title: str = "rtpu profile", meta: str = "") -> None:
+    with open(path, "w") as f:
+        f.write(render_flamegraph_html(stacks, title=title, meta=meta))
+
+
+def to_collapsed_text(stacks: Dict[str, int]) -> str:
+    """flamegraph.pl / speedscope interchange text."""
+    return "".join(f"{k} {v}\n"
+                   for k, v in sorted(stacks.items(),
+                                      key=lambda kv: -kv[1]))

@@ -1,0 +1,444 @@
+"""In-controller metrics history + alert rules (the cluster telemetry plane).
+
+Parity target: the reference dashboard's built-in time-series view
+(dashboard agents -> GCS -> dashboard head) and its alerting hooks. Here
+the controller is already the aggregation point for every metric family —
+its own ``rtpu_*`` gauges/counters/histograms plus the app metrics shipped
+by ``util/metrics.py`` — so history is a fixed-step ring buffer sampled
+in-process each ``RTPU_TSDB_STEP_S`` and served by the ``query_metrics``
+RPC. No Prometheus server, no sidecar: `rtpu top` and the dashboard
+sparklines read the same ring.
+
+Counters are stored cumulative and converted to per-second rates at query
+time (clamped at zero so a controller bounce's counter reset never shows
+as a negative spike). Histograms are stored as cumulative bucket states;
+a query derives p50/p99/mean/rate over a trailing window by differencing
+the cumulative state across the window and interpolating inside the
+winning bucket (the PromQL histogram_quantile scheme, reusing the
+controller's ``_hist_quantile``).
+
+The ring (and the alert engine's firing state) pickles beside
+``--state-path`` so history survives a controller bounce with a gap
+bounded by the downtime, and an alert that was firing does not re-fire
+after the restart.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import os
+import pickle
+import time
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+logger = logging.getLogger(__name__)
+
+_TagTuple = Tuple[Tuple[str, str], ...]
+_SeriesKey = Tuple[str, _TagTuple]
+
+# Backstop against unbounded label cardinality (e.g. per-pid worker gauges
+# on a churning cluster): once the ring holds this many distinct series,
+# new keys are dropped rather than grown.
+MAX_SERIES = 4096
+
+_OPS: Dict[str, Callable[[float, float], bool]] = {
+    ">": lambda v, t: v > t,
+    ">=": lambda v, t: v >= t,
+    "<": lambda v, t: v < t,
+    "<=": lambda v, t: v <= t,
+}
+
+
+def _hist_quantile(bounds: List[float], h: Dict[str, Any], q: float) -> float:
+    # Same linear interpolation as controller._hist_quantile; duplicated
+    # here (12 lines) rather than importing the controller module into the
+    # telemetry unit tests.
+    total = h.get("count", 0)
+    if not total:
+        return 0.0
+    target = q * total
+    cum = 0.0
+    lo = 0.0
+    for i, b in enumerate(bounds):
+        c = h["buckets"][i]
+        if c and cum + c >= target:
+            return lo + (b - lo) * ((target - cum) / c)
+        cum += c
+        lo = b
+    return bounds[-1] if bounds else 0.0
+
+
+def _hist_delta(new: Dict[str, Any], old: Optional[Dict[str, Any]]) -> dict:
+    """Cumulative histogram state difference new - old (old=None => new).
+    A counter reset (controller bounce) shows as any negative component;
+    the caller treats the delta as the full new state then."""
+    if old is None:
+        return {"buckets": list(new["buckets"]), "sum": new["sum"],
+                "count": new["count"]}
+    if (len(old["buckets"]) != len(new["buckets"])
+            or new["count"] < old["count"]):
+        return {"buckets": list(new["buckets"]), "sum": new["sum"],
+                "count": new["count"]}
+    return {
+        "buckets": [max(0, n - o)
+                    for n, o in zip(new["buckets"], old["buckets"])],
+        "sum": max(0.0, new["sum"] - old["sum"]),
+        "count": new["count"] - old["count"],
+    }
+
+
+class MetricsTSDB:
+    """Fixed-step ring of every metric family the controller can see.
+
+    ``sample(now, families)`` appends one point per (name, tags) series;
+    ``query(...)`` returns plottable [t, value] series with counter->rate
+    and histogram->p50/p99/mean/rate derivation done server-side so
+    consumers (rtpu top, dashboard sparklines, alert rules) never touch
+    bucket math.
+    """
+
+    def __init__(self, step_s: float, retain: int,
+                 persist_path: Optional[str] = None,
+                 persist_every_s: float = 0.0) -> None:
+        self.step_s = max(0.05, float(step_s))
+        self.retain = max(2, int(retain))
+        self.persist_path = persist_path
+        self.persist_every_s = float(persist_every_s)
+        self._last_persist = 0.0
+        # key -> {"type", "boundaries", "points": deque[(ts, value)]}
+        # gauge/counter points hold floats; histogram points hold the
+        # cumulative {"buckets", "sum", "count"} state at sample time.
+        self.series: Dict[_SeriesKey, dict] = {}
+        self.restored_alert_state: Dict[Any, dict] = {}
+        if persist_path:
+            self._load()
+
+    # ------------------------------------------------------------- sampling
+
+    def sample(self, now: float, families: Dict[str, dict]) -> None:
+        for name, fam in families.items():
+            ftype = fam.get("type", "gauge")
+            bounds = list(fam.get("boundaries") or ())
+            for tags, value in fam.get("data", {}).items():
+                key = (name, tuple(tags))
+                ser = self.series.get(key)
+                if ser is None:
+                    if len(self.series) >= MAX_SERIES:
+                        continue
+                    ser = self.series[key] = {
+                        "type": ftype, "boundaries": bounds,
+                        "points": deque(maxlen=self.retain)}
+                if isinstance(value, dict):
+                    # Histogram: the aggregator mutates its state in place;
+                    # snapshot a copy or every ring point aliases "now".
+                    value = {"buckets": list(value.get("buckets", ())),
+                             "sum": float(value.get("sum", 0.0)),
+                             "count": int(value.get("count", 0))}
+                else:
+                    value = float(value)
+                ser["points"].append((now, value))
+
+    # -------------------------------------------------------------- queries
+
+    def query(self, name: Optional[str] = None,
+              prefix: Optional[str] = None,
+              tags: Optional[Dict[str, str]] = None,
+              since: Optional[float] = None,
+              stat: Optional[str] = None,
+              window_s: float = 60.0,
+              limit_series: int = 64) -> List[dict]:
+        """Plottable series. ``stat`` picks the derived statistic for
+        histograms ("p50" | "p99" | "mean" | "rate"; default emits p50 and
+        p99 series) and is ignored for gauges; counters always emit their
+        per-second rate plus a final cumulative "total" field."""
+        out: List[dict] = []
+        want_tags = tuple(sorted((tags or {}).items()))
+        for (mname, mtags), ser in self.series.items():
+            if name is not None and mname != name:
+                continue
+            if prefix is not None and not mname.startswith(prefix):
+                continue
+            if want_tags and not set(want_tags) <= set(mtags):
+                continue
+            pts = [p for p in ser["points"]
+                   if since is None or p[0] >= since]
+            if not pts:
+                continue
+            base = {"name": mname, "tags": dict(mtags),
+                    "type": ser["type"]}
+            if ser["type"] == "counter":
+                out.append(dict(base, stat="rate",
+                                total=pts[-1][1],
+                                points=self._rate_points(pts)))
+            elif ser["type"] == "histogram":
+                stats = [stat] if stat else ["p50", "p99"]
+                for st in stats:
+                    out.append(dict(base, stat=st,
+                                    points=self._hist_points(
+                                        ser, pts, st, window_s)))
+            else:
+                out.append(dict(base, stat="value",
+                                points=[[t, v] for t, v in pts]))
+            if len(out) >= limit_series:
+                break
+        out.sort(key=lambda s: (s["name"], sorted(s["tags"].items()),
+                                s.get("stat", "")))
+        return out
+
+    def latest(self, name: str, tags: Optional[Dict[str, str]] = None,
+               stat: Optional[str] = None,
+               window_s: float = 60.0) -> List[Tuple[dict, float]]:
+        """(series-descriptor, latest-value) pairs — the alert engine's
+        view. Histograms default to p99 here, not the p50+p99 pair."""
+        st = stat or "p99"
+        res = []
+        for ser in self.query(name=name, tags=tags, stat=st,
+                              window_s=window_s):
+            if ser["points"]:
+                res.append((ser, ser["points"][-1][1]))
+        return res
+
+    def _rate_points(self, pts: List[Tuple[float, float]]) -> List[list]:
+        out = []
+        for i in range(1, len(pts)):
+            dt = pts[i][0] - pts[i - 1][0]
+            if dt <= 0:
+                continue
+            out.append([pts[i][0],
+                        max(0.0, (pts[i][1] - pts[i - 1][1]) / dt)])
+        return out
+
+    def _hist_points(self, ser: dict, pts: List[Tuple[float, Any]],
+                     stat: str, window_s: float) -> List[list]:
+        bounds = ser["boundaries"]
+        out = []
+        for i, (t, cum) in enumerate(pts):
+            # Trailing window: difference against the newest point at or
+            # before t - window_s (absent for early points => since start).
+            old = None
+            for j in range(i - 1, -1, -1):
+                if pts[j][0] <= t - window_s:
+                    old = pts[j]
+                    break
+            d = _hist_delta(cum, old[1] if old else None)
+            if stat == "rate":
+                dt = (t - old[0]) if old else window_s
+                v = d["count"] / dt if dt > 0 else 0.0
+            elif stat == "mean":
+                v = d["sum"] / d["count"] if d["count"] else 0.0
+            elif stat == "p50":
+                v = _hist_quantile(bounds, d, 0.5)
+            else:
+                v = _hist_quantile(bounds, d, 0.99)
+            out.append([t, v])
+        return out
+
+    # -------------------------------------------------------- persistence
+
+    def save(self, alert_state: Optional[Dict[Any, dict]] = None) -> None:
+        if not self.persist_path:
+            return
+        payload = {
+            "v": 1,
+            "step_s": self.step_s,
+            "series": [
+                {"name": k[0], "tags": list(k[1]), "type": s["type"],
+                 "boundaries": s["boundaries"],
+                 "points": list(s["points"])}
+                for k, s in self.series.items()
+            ],
+            "alerts": alert_state or {},
+        }
+        tmp = self.persist_path + f".tmp{os.getpid()}"
+        try:
+            with open(tmp, "wb") as f:
+                pickle.dump(payload, f)
+            os.replace(tmp, self.persist_path)
+        except Exception:
+            logger.debug("tsdb persist failed", exc_info=True)
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+
+    def maybe_persist(self, now: float,
+                      alert_state: Optional[Dict[Any, dict]] = None) -> None:
+        if not self.persist_path or self.persist_every_s <= 0:
+            return
+        if now - self._last_persist >= self.persist_every_s:
+            self._last_persist = now
+            self.save(alert_state)
+
+    def _load(self) -> None:
+        try:
+            with open(self.persist_path, "rb") as f:
+                payload = pickle.load(f)
+        except FileNotFoundError:
+            return
+        except Exception:
+            logger.warning("tsdb restore failed; starting empty",
+                           exc_info=True)
+            return
+        for ser in payload.get("series", ()):
+            key = (ser["name"], tuple(tuple(t) for t in ser["tags"]))
+            if len(self.series) >= MAX_SERIES:
+                break
+            self.series[key] = {
+                "type": ser["type"], "boundaries": ser["boundaries"],
+                "points": deque(ser["points"], maxlen=self.retain)}
+        self.restored_alert_state = payload.get("alerts", {})
+
+
+# ---------------------------------------------------------------- alerting
+
+# Threshold + for-duration rules, the Prometheus alerting-rule shape
+# evaluated in-process over the ring. Defaults catch the regressions the
+# ROADMAP cares about without any configuration; RTPU_ALERT_RULES merges
+# user rules over these by name ({"name": ..., "disabled": true} removes).
+DEFAULT_ALERT_RULES: List[dict] = [
+    {"name": "queue_wait_p99_high", "metric": "rtpu_task_queue_wait_s",
+     "stat": "p99", "op": ">", "threshold": 5.0, "for_s": 10.0,
+     "severity": "WARNING",
+     "message": "task queue-wait p99 above 5s — cluster saturated"},
+    {"name": "node_mem_high", "metric": "rtpu_node_mem_fraction",
+     "op": ">", "threshold": 0.92, "for_s": 30.0, "severity": "WARNING",
+     "message": "node memory above 92% — OOM-kill risk"},
+    {"name": "suspect_nodes", "metric": "rtpu_nodes",
+     "tags": {"state": "suspect"}, "op": ">", "threshold": 0.0,
+     "for_s": 0.0, "severity": "ERROR",
+     "message": "node(s) missing heartbeats (suspect)"},
+]
+
+
+def load_alert_rules(spec: Optional[str]) -> List[dict]:
+    """DEFAULT_ALERT_RULES overlaid by the RTPU_ALERT_RULES JSON list,
+    merged by rule name. A malformed spec logs and keeps the defaults —
+    alerting config must never take the controller down."""
+    rules = {r["name"]: dict(r) for r in DEFAULT_ALERT_RULES}
+    if spec:
+        try:
+            user = json.loads(spec)
+            if not isinstance(user, list):
+                raise ValueError("RTPU_ALERT_RULES must be a JSON list")
+            for r in user:
+                if not isinstance(r, dict) or not r.get("name"):
+                    raise ValueError("each rule needs a name")
+                merged = dict(rules.get(r["name"], {}), **r)
+                rules[r["name"]] = merged
+        except Exception:
+            logger.warning("bad RTPU_ALERT_RULES; using defaults",
+                           exc_info=True)
+    out = []
+    for r in rules.values():
+        if r.get("disabled"):
+            continue
+        if not r.get("metric") or "threshold" not in r:
+            logger.warning("alert rule %r missing metric/threshold; "
+                           "skipped", r.get("name"))
+            continue
+        out.append(r)
+    return out
+
+
+class AlertEngine:
+    """Evaluates rules over the TSDB each sampling step.
+
+    Per (rule, series) state machine: condition true -> pending; pending
+    for ``for_s`` -> ALERT_FIRING (once); condition false or series gone
+    -> ALERT_RESOLVED (once, only if it fired). State snapshots into the
+    TSDB persist file so a bounced controller neither duplicates the
+    FIRING event nor forgets to RESOLVE.
+    """
+
+    def __init__(self, rules: List[dict],
+                 emit_fn: Callable[..., None]) -> None:
+        self.rules = rules
+        self.emit = emit_fn
+        # (rule_name, tags_tuple) -> {"pending_since": ts|None,
+        #                             "firing": bool, "value": float}
+        self.state: Dict[Tuple[str, _TagTuple], dict] = {}
+
+    def evaluate(self, now: float, tsdb: MetricsTSDB) -> None:
+        for rule in self.rules:
+            op = _OPS.get(rule.get("op", ">"), _OPS[">"])
+            thresh = float(rule["threshold"])
+            for_s = float(rule.get("for_s", 0.0))
+            hits = tsdb.latest(rule["metric"], tags=rule.get("tags"),
+                               stat=rule.get("stat"),
+                               window_s=float(rule.get("window_s", 60.0)))
+            seen = set()
+            for ser, value in hits:
+                key = (rule["name"], tuple(sorted(ser["tags"].items())))
+                seen.add(key)
+                st = self.state.setdefault(
+                    key, {"pending_since": None, "firing": False,
+                          "value": 0.0})
+                st["value"] = value
+                if op(value, thresh):
+                    if st["pending_since"] is None:
+                        st["pending_since"] = now
+                    if (not st["firing"]
+                            and now - st["pending_since"] >= for_s):
+                        st["firing"] = True
+                        self._emit_firing(rule, ser, value)
+                else:
+                    self._clear(rule, key, st)
+            # A series that stopped reporting (node gone, label idle past
+            # retention) resolves rather than staying firing forever.
+            for key, st in self.state.items():
+                if key[0] == rule["name"] and key not in seen:
+                    self._clear(rule, key, st,
+                                tags=dict(key[1]))
+
+    def _clear(self, rule: dict, key, st: dict,
+               tags: Optional[dict] = None) -> None:
+        st["pending_since"] = None
+        if st["firing"]:
+            st["firing"] = False
+            t = tags if tags is not None else dict(key[1])
+            self.emit("INFO", "ALERT_RESOLVED",
+                      f"alert {rule['name']} resolved "
+                      f"({self._series_label(rule, t)})",
+                      data={"alert": rule["name"], "tags": t,
+                            "value": st.get("value", 0.0)})
+
+    def _emit_firing(self, rule: dict, ser: dict, value: float) -> None:
+        msg = rule.get("message") or (
+            f"{rule['metric']} {rule.get('op', '>')} {rule['threshold']}")
+        self.emit(rule.get("severity", "WARNING"), "ALERT_FIRING",
+                  f"alert {rule['name']}: {msg} "
+                  f"({self._series_label(rule, ser['tags'])}, "
+                  f"value={value:.4g})",
+                  data={"alert": rule["name"], "tags": ser["tags"],
+                        "value": value,
+                        "threshold": rule["threshold"],
+                        "metric": rule["metric"]})
+
+    @staticmethod
+    def _series_label(rule: dict, tags: dict) -> str:
+        inner = ",".join(f"{k}={v}" for k, v in sorted(tags.items()))
+        return f"{rule['metric']}{{{inner}}}" if inner else rule["metric"]
+
+    # ------------------------------------------------------- persistence
+
+    def snapshot(self) -> Dict[Any, dict]:
+        return {k: dict(v) for k, v in self.state.items()}
+
+    def restore(self, snap: Dict[Any, dict]) -> None:
+        names = {r["name"] for r in self.rules}
+        for k, v in (snap or {}).items():
+            try:
+                if k[0] in names:
+                    self.state[(k[0], tuple(tuple(t) for t in k[1]))] = \
+                        dict(v)
+            except Exception:
+                continue
+
+    def firing(self) -> List[dict]:
+        out = []
+        for (name, tags), st in self.state.items():
+            if st.get("firing"):
+                out.append({"alert": name, "tags": dict(tags),
+                            "value": st.get("value", 0.0)})
+        return out

@@ -1001,6 +1001,37 @@ class WorkerRuntime:
                     {"kind": "profile_result", "req_id": msg["req_id"],
                      "worker_id": self.worker_id, "text": text}),
                 daemon=True).start()
+        elif kind == "profile":
+            # Wall-clock sampling profiler (core/profiler.py): sample this
+            # process's threads for the requested duration on a daemon
+            # thread (the sampler sleeps between ticks — it must not sit
+            # on the event loop), then reply via the stack_dump path.
+            def _run_profile(duration=float(msg.get("duration", 2.0)),
+                             hz=float(msg.get("hz", 67.0)),
+                             req_id=msg["req_id"]):
+                from . import profiler
+
+                try:
+                    if not flags.get("RTPU_PROFILER"):
+                        import json as _json
+
+                        text = _json.dumps(
+                            {"error": "profiler disabled on worker "
+                                      "(RTPU_PROFILER=0)"})
+                    else:
+                        text = profiler.profile_and_encode(duration, hz)
+                except Exception as e:
+                    import json as _json
+
+                    text = _json.dumps({"error": repr(e)})
+                try:
+                    self.client.request(
+                        {"kind": "profile_result", "req_id": req_id,
+                         "worker_id": self.worker_id, "text": text})
+                except Exception:
+                    pass
+
+            threading.Thread(target=_run_profile, daemon=True).start()
         elif kind == "pubsub":
             ctx.deliver_pubsub(msg["channel"], msg["data"])
         elif kind == "pubsub_batch":

@@ -30,6 +30,10 @@ Endpoints:
                              ?worker_id, ?offset)
     GET /api/events          cluster event feed (?severity, ?kind,
                              ?task_id, ?actor_id, ?node, ?worker_id)
+    GET /api/telemetry       metrics history series from the controller
+                             TSDB (?name, ?prefix, ?since, ?stat,
+                             ?window) — the overview sparkline backend
+    GET /api/alerts          alert rules + currently-firing alerts
     GET /logs                log viewer page (live tail via /api/logs)
     GET /events              event feed page (hang events expose their
                              captured stacks)
@@ -79,6 +83,7 @@ _PAGE = """<!doctype html>
 <p><a href="/logs">log viewer</a> · <a href="/timeline">timeline</a> ·
 <a href="/events">events</a></p>
 <h2>Nodes</h2>{nodes}
+<h2>Telemetry</h2>{telemetry}
 <h2>Recent events</h2>{events}
 <h2>Actors</h2>{actors}
 <h2>Task summary</h2>{tasks}
@@ -116,6 +121,23 @@ def _fmt_ts(ts) -> str:
         return _time.strftime("%H:%M:%S", _time.localtime(float(ts or 0)))
     except Exception:
         return "?"
+
+
+def _sparkline(points, w: int = 220, h: int = 34) -> str:
+    """Inline SVG polyline over [t, v] points — rendered server-side so
+    the str.format overview template stays JS-free."""
+    vals = [p[1] for p in points]
+    if not vals:
+        return "<i>no data</i>"
+    lo, hi = min(vals), max(vals)
+    span = (hi - lo) or 1.0
+    n = len(vals)
+    xs = [(i * (w - 2) / max(1, n - 1)) + 1 for i in range(n)]
+    ys = [h - 2 - (v - lo) / span * (h - 4) for v in vals]
+    pts = " ".join(f"{x:.1f},{y:.1f}" for x, y in zip(xs, ys))
+    return (f'<svg width="{w}" height="{h}">'
+            f'<polyline fill="none" stroke="#4e79a7" stroke-width="1.5" '
+            f'points="{pts}"/></svg>')
 
 
 def _log_link(param: str, value) -> str:
@@ -310,6 +332,11 @@ class Dashboard:
         self._started = threading.Event()
         self._stop = None  # asyncio.Event inside the loop
         self._loop = None
+        # /metrics proxy cache: (scrape wall-time, text). Serves repeat
+        # scrapes within ~1s without re-hitting the controller, and keeps
+        # the LAST GOOD payload to serve (as a 503) when a scrape times
+        # out — a slow controller degrades the proxy, never blanks it.
+        self._metrics_cache: tuple = (0.0, None)
 
     # -- request handlers --------------------------------------------------
     async def _index(self, request):
@@ -367,8 +394,46 @@ class Dashboard:
         return web.Response(
             text=_PAGE.format(cluster=cluster, nodes=nodes, actors=actors,
                               tasks=tasks, recent=recent, jobs=jobs,
-                              events=events),
+                              events=events,
+                              telemetry=self._telemetry_html()),
             content_type="text/html")
+
+    def _telemetry_html(self) -> str:
+        """Sparkline history charts on the overview (reference: the
+        dashboard's time-series panels), fed by the controller TSDB via
+        query_metrics — zero external services."""
+        wanted = [("rtpu_pending_tasks", None), ("rtpu_workers", None),
+                  ("rtpu_nodes_alive", None), ("rtpu_task_exec_s", "p99"),
+                  ("rtpu_node_cpu_percent", None),
+                  ("rtpu_node_mem_fraction", None),
+                  ("rtpu_arena_used_bytes", None)]
+        rows = []
+        enabled = False
+        for name, stat in wanted:
+            resp = self._safe(lambda n=name, s=stat: state_api.
+                              query_metrics(n, stat=s, limit_series=8))
+            if not isinstance(resp, dict) or not resp.get("enabled"):
+                continue
+            enabled = True
+            for ser in resp.get("series", ()):
+                tag = ",".join(f"{k}={v}"
+                               for k, v in sorted(ser["tags"].items()))
+                label = ser["name"] + (f"{{{tag}}}" if tag else "")
+                if ser.get("stat") not in (None, "value"):
+                    label += f" ({ser['stat']})"
+                pts = ser.get("points") or []
+                last = pts[-1][1] if pts else 0.0
+                rows.append(
+                    f"<tr><td><code>{html.escape(label)}</code></td>"
+                    f"<td>{_sparkline(pts)}</td>"
+                    f'<td style="text-align:right">{last:.4g}</td></tr>')
+        if not enabled:
+            return ("<p><i>telemetry disabled (RTPU_TSDB=0) or "
+                    "controller unreachable</i></p>")
+        if not rows:
+            return "<p><i>no samples yet</i></p>"
+        return ("<table><tr><th>series</th><th>history</th><th>latest"
+                "</th></tr>" + "".join(rows) + "</table>")
 
     @staticmethod
     def _safe(fn):
@@ -430,6 +495,20 @@ class Dashboard:
                     None, lambda: state_api.profile_workers(t))
             elif kind == "usage":
                 data = _local_usage()
+            elif kind == "telemetry":
+                # Metrics history from the controller's TSDB ring
+                # (?name=, ?prefix=, ?since=, ?stat=, ?window=): the
+                # sparkline charts' backend, and a generic JSON series
+                # API for anything else that wants history.
+                q = request.query
+                data = state_api.query_metrics(
+                    q.get("name"), prefix=q.get("prefix"),
+                    since=float(q["since"]) if q.get("since") else None,
+                    stat=q.get("stat"),
+                    window_s=float(q.get("window", 60.0)),
+                    limit_series=int(q.get("limit", 64)))
+            elif kind == "alerts":
+                data = state_api.list_alerts()
             elif kind == "events":
                 q = request.query
                 data = state_api.list_events(
@@ -557,7 +636,12 @@ class Dashboard:
         if not addr:
             return web.Response(status=503, text="# metrics disabled\n")
         import asyncio
+        import time as _time
         import urllib.request
+
+        ts, cached = self._metrics_cache
+        if cached is not None and _time.time() - ts < 1.0:
+            return web.Response(text=cached, content_type="text/plain")
 
         def scrape() -> str:
             with urllib.request.urlopen(f"http://{addr}/metrics",
@@ -569,8 +653,15 @@ class Dashboard:
             # not stall every other dashboard request for the 2s timeout.
             text = await asyncio.get_running_loop().run_in_executor(
                 None, scrape)
+            self._metrics_cache = (_time.time(), text)
             return web.Response(text=text, content_type="text/plain")
         except Exception as e:
+            if cached is not None:
+                # Stale-but-real beats empty: a Prometheus poller keeps
+                # its series (and sees the 503) while the controller is
+                # slow.
+                return web.Response(status=503, text=cached,
+                                    content_type="text/plain")
             return web.Response(status=502, text=f"# scrape failed: {e!r}\n")
 
     # -- lifecycle ---------------------------------------------------------

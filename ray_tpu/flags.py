@@ -395,6 +395,38 @@ _define("RTPU_EXIT_DETAIL_BYTES", int, 2048,
         "process's log tail in the task/actor error surfaced to the "
         "driver (reference: RayTaskError exit_detail); 0 disables the "
         "post-mortem fetch.")
+_define("RTPU_TSDB", bool, True,
+        "In-controller metrics history (core/telemetry.py): every "
+        "registered metric family (core rtpu_* gauges/counters/histograms "
+        "plus util/metrics.py app metrics) is sampled into a fixed-step "
+        "ring buffer served by the query_metrics RPC and `rtpu top` / the "
+        "dashboard sparklines (reference: the Ray dashboard's built-in "
+        "time-series view). 0 disables the sampler loop entirely; "
+        "query_metrics then reports disabled.")
+_define("RTPU_TSDB_STEP_S", float, 5.0,
+        "Telemetry sampling step: one point per series per step.")
+_define("RTPU_TSDB_RETAIN", int, 720,
+        "Points retained per series (ring buffer length); with the "
+        "default 5s step this holds one hour of history.")
+_define("RTPU_TSDB_PERSIST_S", float, 15.0,
+        "How often the telemetry ring (and alert state) is persisted "
+        "beside --state-path so history survives a controller bounce. "
+        "0 persists only on clean shutdown.")
+_define("RTPU_ALERT_RULES", str, None,
+        "JSON list of alert rules evaluated over the telemetry ring each "
+        "sampling step, merged by name over the built-in defaults "
+        "(telemetry.DEFAULT_ALERT_RULES). Rule: {name, metric, stat?, "
+        "tags?, op, threshold, for_s, severity?, message?, disabled?}. "
+        "Firing/resolving rules emit ALERT_FIRING/ALERT_RESOLVED cluster "
+        "events (rtpu events --kind ALERT_FIRING).")
+_define("RTPU_PROFILER", bool, True,
+        "Cluster flamegraph profiler (core/profiler.py): the profile RPC "
+        "fans a pure-Python sys._current_frames() wall-clock sampler out "
+        "to workers and merges collapsed stacks (reference: py-spy-based "
+        "`ray stack` / dashboard flamegraphs, without the py-spy "
+        "dependency). 0 rejects profile requests; workers never sample.")
+_define("RTPU_PROFILER_HZ", float, 67.0,
+        "Default sampling frequency of the wall-clock profiler.")
 
 # -- bench -------------------------------------------------------------------
 _define("RTPU_BENCH_TPU_TIMEOUT", int, 1500,

@@ -194,7 +194,8 @@ def build_streaming_llm_deployment(cfg, params_factory, *, name: str = "llm-stre
                     cfg, self._params, num_slots=num_slots,
                     max_prompt_len=max_prompt_len,
                     max_new_tokens=max_new_tokens,
-                    seed=int.from_bytes(os.urandom(4), "little"))
+                    seed=int.from_bytes(os.urandom(4), "little"),
+                    model=name)
                 self._stop = threading.Event()
                 self._ticker = threading.Thread(
                     target=self._engine.run_forever, args=(self._stop,),

@@ -29,6 +29,41 @@ from typing import Any, Dict, List, Optional
 import numpy as np
 
 
+_serve_metrics_cache = None
+
+
+def _serve_metrics():
+    """Lazy shared serve metrics (util/metrics.py plane; tagged by model
+    so every engine in the process shares the three instruments). The
+    ROADMAP serve item: TTFT p99 and tokens/s must be first-class on
+    /metrics, not benchmark-script printouts."""
+    global _serve_metrics_cache
+    if _serve_metrics_cache is None:
+        from ray_tpu.util.metrics import Counter, Gauge, Histogram
+
+        _serve_metrics_cache = {
+            "ttft": Histogram(
+                "rtpu_serve_ttft_s",
+                description="Serve time-to-first-token: request submit "
+                            "to first sampled token (prefill + splice "
+                            "wait)",
+                boundaries=[0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0,
+                            10.0, 30.0],
+                tag_keys=("model",)),
+            "tokens": Counter(
+                "rtpu_serve_decode_tokens_total",
+                description="Decode tokens emitted by the "
+                            "continuous-batching engine",
+                tag_keys=("model",)),
+            "slots": Gauge(
+                "rtpu_serve_slots_busy",
+                description="Continuous-batching slots currently "
+                            "generating",
+                tag_keys=("model",)),
+        }
+    return _serve_metrics_cache
+
+
 def bucket_len(n: int, max_len: int, floor: int = 8) -> int:
     """Power-of-2 length bucket (>= floor, <= max_len): THE compile-count
     bound shared by the batch deployment and the engine — one definition
@@ -44,7 +79,7 @@ class ContinuousBatchingEngine:
 
     def __init__(self, cfg, params, *, num_slots: int = 4,
                  max_prompt_len: int = 128, max_new_tokens: int = 64,
-                 seed: int = 0):
+                 seed: int = 0, model: str = ""):
         import jax
         import jax.numpy as jnp
 
@@ -52,6 +87,8 @@ class ContinuousBatchingEngine:
 
         self.cfg = cfg
         self.params = params
+        self.model = model or "default"
+        self._mtags = {"model": self.model}
         self.B = num_slots
         self.max_prompt_len = max_prompt_len
         self.max_new = max_new_tokens
@@ -131,6 +168,7 @@ class ContinuousBatchingEngine:
         Returns a stable REQUEST id; poll with peek(), collect with
         result() — valid even after the slot is recycled."""
         jnp = self._jnp
+        t_submit = time.monotonic()
         ids = np.asarray(tokens, np.int32)
         if ids.ndim != 1 or ids.size == 0:
             raise ValueError("tokens must be a non-empty 1-D integer list")
@@ -174,6 +212,10 @@ class ContinuousBatchingEngine:
             # First token comes from the prefill logits, decided under the
             # lock with the slot's sampling config.
             first = self._pick_host(np.asarray(logits1), temperature)
+            m = _serve_metrics()
+            m["ttft"].observe(time.monotonic() - t_submit,
+                              tags=self._mtags)
+            m["tokens"].inc(1.0, tags=self._mtags)
             n = min(max_new_tokens or self.max_new, self.max_new)
             self.active[slot] = True
             self.budget[slot] = n - 1
@@ -191,6 +233,7 @@ class ContinuousBatchingEngine:
             if self.budget[slot] <= 0 or (eos_id is not None
                                           and int(first) == eos_id):
                 self._retire_locked(slot)
+            m["slots"].set(self.B - len(self._free), tags=self._mtags)
             return req
 
     def _pick_host(self, logits: np.ndarray, temperature: float) -> int:
@@ -258,15 +301,21 @@ class ContinuousBatchingEngine:
             nxt_host = np.asarray(nxt)
             self.cache = cache
             self.cur_tok = nxt
+            emitted = 0
             for s in range(self.B):
                 if not self.active[s]:
                     continue
                 tok = int(nxt_host[s])
                 self.out[s].append(tok)
+                emitted += 1
                 self.budget[s] -= 1
                 if self.budget[s] <= 0 or (self.eos[s] is not None
                                            and tok == self.eos[s]):
                     self._retire_locked(s)
+            if emitted:
+                m = _serve_metrics()
+                m["tokens"].inc(float(emitted), tags=self._mtags)
+                m["slots"].set(self.B - len(self._free), tags=self._mtags)
             return sum(self.active)
 
     # ------------------------------------------------------------- results

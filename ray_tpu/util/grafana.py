@@ -117,7 +117,9 @@ def generate_dashboard(prom_text: str,
             if name in ("rtpu_worker_cpu_percent", "rtpu_worker_rss_bytes"):
                 legend = "{{node}}/{{pid}}"
             elif name in ("rtpu_worker_log_bytes",
-                          "rtpu_node_arena_used_bytes"):
+                          "rtpu_node_arena_used_bytes",
+                          "rtpu_node_mem_fraction",
+                          "rtpu_node_cpu_percent"):
                 legend = "{{node}}"
             else:
                 legend = "{{instance}}"

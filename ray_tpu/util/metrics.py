@@ -42,8 +42,15 @@ def _hist_state(boundaries: Sequence[float]) -> dict:
 
 
 def _hist_merge(dst: dict, src: dict) -> None:
+    if len(dst["buckets"]) != len(src["buckets"]):
+        # Clamp-merging mismatched bucket grids silently corrupts
+        # quantiles; boundary mismatches are rejected at record time, so
+        # reaching here is a programming error worth surfacing.
+        raise ValueError(
+            f"histogram bucket count mismatch: {len(dst['buckets'])} != "
+            f"{len(src['buckets'])}")
     for i, c in enumerate(src["buckets"]):
-        dst["buckets"][min(i, len(dst["buckets"]) - 1)] += c
+        dst["buckets"][i] += c
     dst["sum"] += src["sum"]
     dst["count"] += src["count"]
 
@@ -64,6 +71,14 @@ class _Aggregator:
             m = self.pending.setdefault(
                 name, {"type": mtype, "help": help_,
                        "boundaries": list(boundaries), "data": {}})
+            if mtype == "histogram" and m["boundaries"] != list(boundaries):
+                # Two Histogram instances sharing a name but not a bucket
+                # grid: merging them clamp-corrupts quantiles server-side.
+                # Fail the observe() loudly instead.
+                raise ValueError(
+                    f"histogram {name!r} re-registered with different "
+                    f"boundaries {list(boundaries)} (existing: "
+                    f"{m['boundaries']})")
             if mtype == "gauge":
                 m["data"][tags] = value
             elif mtype == "counter":
@@ -82,9 +97,12 @@ class _Aggregator:
                 h["buckets"][i] += 1
                 h["sum"] += value
                 h["count"] += 1
-        self._ensure_flusher()
+            # Under the lock: two first-record threads racing the
+            # alive-check outside it could each spawn a flusher, leaking
+            # a duplicate flush loop for the process lifetime.
+            self._ensure_flusher_locked()
 
-    def _ensure_flusher(self) -> None:
+    def _ensure_flusher_locked(self) -> None:
         if self._thread is not None and self._thread.is_alive():
             return
         self._thread = threading.Thread(

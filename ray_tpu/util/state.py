@@ -57,6 +57,58 @@ def profile_workers(timeout: float = 2.0) -> Dict[str, Any]:
     return _req({"kind": "profile_workers", "timeout": timeout})
 
 
+def profile(duration: float = 2.0, *,
+            task_id: Optional[str] = None,
+            actor_id: Optional[str] = None,
+            node_id: Optional[str] = None,
+            worker_id: Optional[str] = None,
+            hz: Optional[float] = None) -> Dict[str, Any]:
+    """Cluster flamegraph profile (reference: the dashboard's py-spy
+    flamegraph button / `ray stack --native`, without py-spy): every
+    targeted worker samples its threads' wall-clock stacks for
+    ``duration`` seconds; the controller merges them into collapsed-stack
+    format. Entity ids scope the fan-out and match on prefix; with no
+    filter every live worker participates. Returns {"stacks":
+    {collapsed: count}, "samples", "workers", "requested"} or {"error"}
+    when RTPU_PROFILER=0. Render with core/profiler.save_flamegraph or
+    `rtpu profile --out prof.html`."""
+    return ctx.get_worker_context().client.request(
+        {"kind": "profile", "duration": duration, "task_id": task_id,
+         "actor_id": actor_id, "node_id": node_id, "worker_id": worker_id,
+         "hz": hz},
+        # The fan-out itself takes >= duration; the session default RPC
+        # timeout may be shorter.
+        timeout=duration + 30.0)
+
+
+def query_metrics(name: Optional[str] = None, *,
+                  prefix: Optional[str] = None,
+                  tags: Optional[Dict[str, str]] = None,
+                  since: Optional[float] = None,
+                  stat: Optional[str] = None,
+                  window_s: float = 60.0,
+                  limit_series: int = 64) -> Dict[str, Any]:
+    """Metrics history from the controller's telemetry ring (reference:
+    the dashboard's built-in time-series view; no Prometheus server
+    needed). Filter by exact ``name`` or ``prefix`` and a tags subset;
+    ``since`` is a wall-clock lower bound. Counters come back as
+    per-second rates, histograms as derived series (``stat`` in
+    p50/p99/mean/rate; default both quantiles). Returns {"enabled",
+    "series": [{name, tags, type, stat, points: [[t, v], ...]}],
+    "now", "step_s", "retain"}."""
+    return _req({"kind": "query_metrics", "name": name, "prefix": prefix,
+                 "tags": tags, "since": since, "stat": stat,
+                 "window_s": window_s, "limit_series": limit_series})
+
+
+def list_alerts() -> Dict[str, Any]:
+    """Alert rules (telemetry.DEFAULT_ALERT_RULES merged with
+    RTPU_ALERT_RULES) and which are currently firing. Firing/resolving
+    transitions also land in the event log as ALERT_FIRING /
+    ALERT_RESOLVED (`rtpu events --kind ALERT_FIRING`)."""
+    return _req({"kind": "list_alerts"})
+
+
 def summarize_tasks(breakdown: bool = False) -> Dict[str, Dict[str, Any]]:
     """Per-function counts of task events (reference: `ray summary tasks`).
 

@@ -140,11 +140,14 @@ def test_metrics_hist_merge():
     src = {"buckets": [1, 2, 3], "sum": 4.5, "count": 6}
     _hist_merge(dst, src)
     assert dst == {"buckets": [1, 2, 3], "sum": 4.5, "count": 6}
-    # Length mismatch overflows into the +Inf bucket instead of dropping.
+    # Length mismatch is rejected outright: record() refuses mismatched
+    # boundary re-registration, so a mismatched grid reaching the merge is
+    # a programming error — clamp-merging it would silently corrupt
+    # quantiles.
     wide = {"buckets": [1, 1, 1, 1, 1], "sum": 5.0, "count": 5}
-    _hist_merge(dst, wide)
-    assert dst["buckets"] == [2, 3, 6]
-    assert dst["count"] == 11 and dst["sum"] == 9.5
+    with pytest.raises(ValueError, match="bucket count"):
+        _hist_merge(dst, wide)
+    assert dst == {"buckets": [1, 2, 3], "sum": 4.5, "count": 6}
 
 
 def test_metrics_atexit_flush_registered():

@@ -133,6 +133,47 @@ def test_discard_releases_state_and_ticker_failure_surfaces(engine_setup):
     eng._tick = orig
 
 
+def test_serve_metrics_reach_prometheus(engine_setup, ray_start_regular):
+    """A generate call records TTFT, decode-token, and slot-occupancy
+    metrics that surface on the controller's /metrics endpoint tagged by
+    model — the ROADMAP serve item: serving health must be first-class
+    telemetry, not benchmark printouts."""
+    import time
+    import urllib.request
+
+    from ray_tpu.util import state as state_api
+    from ray_tpu.util.metrics import flush_metrics
+
+    cfg, params = engine_setup
+    eng = ContinuousBatchingEngine(cfg, params, num_slots=2,
+                                   max_prompt_len=16, max_new_tokens=3,
+                                   model="tiny-test")
+    r = eng.submit([5, 9, 2])
+    while eng.tick():
+        pass
+    assert len(eng.result(r, timeout=60)) == 3
+    flush_metrics()
+
+    addr = state_api.metrics_address()
+    assert addr, "metrics endpoint not enabled in test session"
+    deadline = time.time() + 15
+    text = ""
+    while time.time() < deadline:
+        with urllib.request.urlopen(f"http://{addr}/metrics",
+                                    timeout=5) as resp:
+            text = resp.read().decode()
+        if "rtpu_serve_ttft_s" in text:
+            break
+        time.sleep(0.3)
+    assert '# TYPE rtpu_serve_ttft_s histogram' in text, text[-800:]
+    assert 'rtpu_serve_ttft_s_bucket{model="tiny-test",le="+Inf"} 1' in text
+    assert 'rtpu_serve_ttft_s_count{model="tiny-test"} 1' in text
+    # 1 prefill token + 2 decode ticks = 3 tokens for the request.
+    assert 'rtpu_serve_decode_tokens_total{model="tiny-test"} 3.0' in text
+    # All slots idle again after the request retired.
+    assert 'rtpu_serve_slots_busy{model="tiny-test"} 0.0' in text
+
+
 def test_sampled_slots_vary_and_respect_budget(engine_setup):
     cfg, params = engine_setup
     outs = []

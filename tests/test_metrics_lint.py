@@ -1,0 +1,155 @@
+"""Metric-name lint: every ``rtpu_*`` metric referenced in the codebase
+must be registered with help text, and every registered family must derive
+a Grafana panel.
+
+The failure this prevents: someone exports a new gauge straight from an
+f-string, it shows on /metrics with no HELP, never gets a dashboard panel,
+and the telemetry ring samples an undocumented series. New metrics must
+land in controller.CORE_METRIC_META / PHASE_METRIC_HELP or go through a
+util.metrics Counter/Gauge/Histogram with a description.
+"""
+import os
+import re
+
+from ray_tpu.core.controller import CORE_METRIC_META, PHASE_METRIC_HELP
+from ray_tpu.util import grafana
+
+PKG = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(
+    __file__))), "ray_tpu")
+
+# Quote-delimited rtpu_* literals; no trailing underscore, so prefix
+# literals like "rtpu_task_" don't count as names.
+_NAME_RE = re.compile(r'["\'](rtpu_[a-z0-9]+(?:_[a-z0-9]+)*)["\']')
+# util.metrics instrument registration: Instrument("name", ...).
+_INSTRUMENT_RE = re.compile(
+    r'(Counter|Gauge|Histogram)\(\s*["\'](rtpu_[a-z0-9_]+)["\']')
+
+# Literals that share the rtpu_ prefix but are NOT metric names (paths,
+# subprocess names, header keys). Adding a metric here instead of
+# registering it defeats the lint — keep this to genuinely-non-metric ids.
+NON_METRIC_LITERALS = {
+    "rtpu_checkpoints",       # checkpoint directory name
+    "rtpu_clusters",          # launcher state directory
+    "rtpu_logs",              # worker log directory
+    "rtpu_memcpy_mt",         # native-store build artifact
+    "rtpu_multiplexed_model_id",  # serve request header key
+    "rtpu_results",           # tune results directory
+    "rtpu_runtime_envs",      # runtime-env cache directory
+}
+
+
+def _py_files():
+    for root, dirs, files in os.walk(PKG):
+        dirs[:] = [d for d in dirs if d != "__pycache__"]
+        for fn in files:
+            if fn.endswith(".py"):
+                yield os.path.join(root, fn)
+
+
+def _instrument_registrations():
+    """{name: (metric type, has description)} for every util.metrics
+    instrument constructed with a literal rtpu_* name."""
+    out = {}
+    types = {"Counter": "counter", "Gauge": "gauge",
+             "Histogram": "histogram"}
+    for path in _py_files():
+        text = open(path).read()
+        for m in _INSTRUMENT_RE.finditer(text):
+            # The description kwarg must appear inside this call — look in
+            # the argument span up to the matching close (approximated by
+            # the next instrument or a generous window).
+            window = text[m.start():m.start() + 600]
+            out[m.group(2)] = (types[m.group(1)],
+                               "description=" in window)
+    return out
+
+
+def _registry():
+    """Every legitimately-registered family: name -> metric type."""
+    reg = {name: mtype for name, (mtype, _) in CORE_METRIC_META.items()}
+    for name in PHASE_METRIC_HELP:
+        reg[name] = "histogram"
+    for name, (mtype, _) in _instrument_registrations().items():
+        reg[name] = mtype
+    return reg
+
+
+def test_core_metric_meta_is_complete():
+    for name, (mtype, help_) in CORE_METRIC_META.items():
+        assert mtype in ("gauge", "counter", "histogram"), (name, mtype)
+        assert help_ and len(help_) > 10, \
+            f"{name}: core metrics must ship real help text"
+    for name, help_ in PHASE_METRIC_HELP.items():
+        assert help_, f"{name}: phase histogram missing help text"
+    # The two registries must not disagree about a name.
+    assert not set(CORE_METRIC_META) & set(PHASE_METRIC_HELP)
+
+
+def test_every_metric_literal_is_registered():
+    reg = _registry()
+    unregistered = {}
+    for path in _py_files():
+        text = open(path).read()
+        for m in _NAME_RE.finditer(text):
+            name = m.group(1)
+            if name in NON_METRIC_LITERALS:
+                continue
+            base = re.sub(r"_(bucket|sum|count)$", "", name)
+            if name not in reg and base not in reg:
+                unregistered.setdefault(name, set()).add(
+                    os.path.relpath(path, PKG))
+    assert not unregistered, (
+        "rtpu_* metric names referenced but never registered with help "
+        f"text (CORE_METRIC_META / PHASE_METRIC_HELP / util.metrics "
+        f"instrument): {unregistered}")
+
+
+def test_instrument_registrations_carry_descriptions():
+    inst = _instrument_registrations()
+    assert inst, "expected at least the transfer + serve instruments"
+    missing = [n for n, (_, has_desc) in inst.items() if not has_desc]
+    assert not missing, \
+        f"rtpu_* instruments registered without description=: {missing}"
+
+
+def test_counter_names_follow_total_convention():
+    # Pre-existing cumulative families whose names predate this lint;
+    # renaming them would break every deployed scrape config. New
+    # counters don't get added here — they get named *_total.
+    legacy = {"rtpu_uptime_seconds", "rtpu_actor_checkpoint_bytes"}
+    reg = _registry()
+    bad = [n for n, t in reg.items()
+           if t == "counter" and not n.endswith("_total")
+           and n not in legacy]
+    assert not bad, f"counters must end in _total: {bad}"
+
+
+def test_every_family_derives_a_grafana_panel():
+    """grafana.generate_dashboard builds panels from exposition metadata:
+    synthesize a scrape covering every registered family and require one
+    panel per family — a metric that can't derive a panel is a metric
+    nobody will ever see."""
+    reg = _registry()
+    help_by_name = {n: h for n, (_, h) in CORE_METRIC_META.items()}
+    help_by_name.update(PHASE_METRIC_HELP)
+    lines = []
+    for name, mtype in sorted(reg.items()):
+        lines.append(f"# HELP {name} {help_by_name.get(name, 'registered')}")
+        lines.append(f"# TYPE {name} {mtype}")
+    dash = grafana.generate_dashboard("\n".join(lines) + "\n")
+    titles = [p["title"] for p in dash["panels"]]
+    for name in reg:
+        assert any(t == name or t.startswith(name + " ")
+                   for t in titles), \
+            f"{name} derives no Grafana panel (titles: {titles[:5]}...)"
+
+
+def test_grafana_special_cases_reference_real_metrics():
+    """The reverse direction: every rtpu_* literal hard-coded in
+    grafana.py's legend special cases must be a registered family, so a
+    rename can't silently orphan a special case."""
+    reg = _registry()
+    src = open(grafana.__file__.rstrip("c")).read()
+    for m in _NAME_RE.finditer(src):
+        assert m.group(1) in reg, \
+            f"grafana.py references unregistered metric {m.group(1)}"

@@ -313,6 +313,39 @@ def test_fault_injection_disabled_path_overhead(ray_start_regular,
         f"injection-disabled task throughput {200/dt:.0f}/s below floor"
 
 
+def test_telemetry_disabled_path_overhead(ray_start_regular, monkeypatch):
+    """Telemetry-plane guard (mirrors the RTPU_TASK_EVENTS guard): with
+    RTPU_TSDB=0 no sampling loop exists (the ring and alert engine are
+    never constructed) and with RTPU_PROFILER=0 the profile RPC answers
+    with one flag check — the task round-trip holds the same throughput
+    floor as the plain benchmark, so history/alerting/profiling can never
+    silently tax the hot path."""
+    monkeypatch.setenv("RTPU_TSDB", "0")
+    monkeypatch.setenv("RTPU_PROFILER", "0")
+
+    @ray_tpu.remote
+    def nop():
+        return None
+
+    ray_tpu.get([nop.remote() for _ in range(8)])  # warm the pool
+    t0 = time.perf_counter()
+    ray_tpu.get([nop.remote() for _ in range(200)])
+    dt = time.perf_counter() - t0
+    assert 200 / dt > 30, \
+        f"telemetry-disabled task throughput {200/dt:.0f}/s below floor"
+
+    # Profiler off: the RPC short-circuits at the controller flag check —
+    # a 5s-duration request answers in well under a second instead of
+    # fanning out and sampling.
+    from ray_tpu.util import state
+
+    t0 = time.perf_counter()
+    res = state.profile(duration=5.0)
+    dt = time.perf_counter() - t0
+    assert "error" in res and "RTPU_PROFILER" in res["error"]
+    assert dt < 2.0, f"disabled profile RPC took {dt:.1f}s"
+
+
 def test_large_object_bandwidth_floor(ray_start_regular):
     arr = np.ones(4 * 1024 * 1024, dtype=np.float64)  # 32MB
     t0 = time.perf_counter()
