@@ -72,6 +72,155 @@ def run_metric(results, name, fn):
         results.append(out)
 
 
+def dag_metrics(results):
+    """Compiled-DAG channel execution vs the submit path, same 3-stage
+    actor pipeline both ways (the flag flip recompiles; flags are read at
+    compile time, so both modes run in one process)."""
+    from ray_tpu.dag import InputNode
+
+    # Busy-spinning before the doorbell block steals the only core from
+    # the stages themselves on small CI hosts (flag doc: 0 is right there).
+    if (os.cpu_count() or 1) <= 2:
+        os.environ.setdefault("RTPU_DAG_SPIN_US", "0")
+
+    @ray_tpu.remote
+    class Add:
+        def __init__(self, k):
+            self.k = k
+
+        def step(self, x):
+            return x + self.k
+
+    def build(window):
+        a, b, c = Add.bind(1), Add.bind(10), Add.bind(100)
+        with InputNode() as inp:
+            dag = c.step.bind(b.step.bind(a.step.bind(inp)))
+        return dag.experimental_compile(max_in_flight=window)
+
+    def measure(compiled, n_steps, chunk):
+        refs = [compiled.execute(i) for i in range(16)]  # fill/warm
+        for r in refs:
+            r.get(timeout=60)
+        # Dispatch cost: execute() alone with a free window (chunk <
+        # max_in_flight, drained between chunks) — what one steady-state
+        # submission costs the driver before any round-trip.
+        t_exec, total = 0.0, 0
+        while total < n_steps:
+            t0 = time.perf_counter()
+            refs = [compiled.execute(i) for i in range(chunk)]
+            t_exec += time.perf_counter() - t0
+            for r in refs:
+                r.get(timeout=60)
+            total += chunk
+        dispatch_us = t_exec / total * 1e6
+        # Pipelined throughput: window-limited execute+get over the same
+        # pipeline (per-step cost includes the full 3-stage traversal).
+        t0 = time.perf_counter()
+        refs = [compiled.execute(i) for i in range(n_steps)]
+        for r in refs:
+            r.get(timeout=120)
+        dt = time.perf_counter() - t0
+        return dispatch_us, n_steps / dt, dt / n_steps * 1e6
+
+    compiled = build(64)
+    mode = compiled._mode
+    ch_dispatch, ch_steps, ch_step_us = measure(compiled, 2000, 32)
+    compiled.teardown()
+
+    os.environ["RTPU_DAG_CHANNELS"] = "0"
+    try:
+        sub = build(64)
+        assert sub._mode == "submit"
+        sub_dispatch, sub_steps, sub_step_us = measure(sub, 400, 32)
+        sub.teardown()
+    finally:
+        os.environ.pop("RTPU_DAG_CHANNELS", None)
+
+    for name, value, unit, extra in (
+        ("dag_dispatch_us", ch_dispatch, "us", {"mode": mode}),
+        ("dag_pipeline_steps_per_s", ch_steps, "steps/s",
+         {"step_us": round(ch_step_us, 1)}),
+        ("dag_dispatch_us_submit", sub_dispatch, "us", {}),
+        ("dag_pipeline_steps_per_s_submit", sub_steps, "steps/s",
+         {"step_us": round(sub_step_us, 1)}),
+        ("dag_dispatch_speedup", sub_dispatch / ch_dispatch, "x", {}),
+        ("dag_step_speedup", sub_step_us / ch_step_us, "x", {}),
+    ):
+        r = {"metric": name, "value": round(value, 2), "unit": unit, **extra}
+        print(json.dumps(r), flush=True)
+        results.append(r)
+
+
+def mpmd_metrics(results):
+    """MPMD pipeline flagship: per-microbatch completion gap with channel
+    overlap vs the submit baseline. Stages do real (numpy) work so the gap
+    shows overlap — steady-state gap ~ slowest stage, not sum of stages."""
+    from ray_tpu.parallel import MPMDPipeline
+
+    if (os.cpu_count() or 1) <= 2:
+        os.environ.setdefault("RTPU_DAG_SPIN_US", "0")
+
+    def factory(idx, n, mesh):
+        rng = np.random.default_rng(idx)
+        w = rng.standard_normal((256, 256))
+
+        def step(x):
+            return x @ w
+
+        return step
+
+    x0 = np.random.default_rng(0).standard_normal((64, 256))
+
+    def measure(n_mb):
+        p = MPMDPipeline([factory] * 3, max_in_flight=8)
+        p.run([x0] * min(8, n_mb))  # warm: route + numpy buffers
+        p.run([x0] * n_mb)
+        stats = p.gap_stats()
+        mode = p.mode
+        p.teardown()
+        return stats, mode
+
+    ch_stats, ch_mode = measure(64)
+    os.environ["RTPU_DAG_CHANNELS"] = "0"
+    try:
+        sub_stats, sub_mode = measure(32)
+        assert sub_mode == "submit"
+    finally:
+        os.environ.pop("RTPU_DAG_CHANNELS", None)
+
+    for name, stats, extra in (
+        ("mpmd_gap_us", ch_stats, {"mode": ch_mode}),
+        ("mpmd_gap_us_submit", sub_stats, {}),
+    ):
+        r = {"metric": name, "value": round(stats["mean_us"], 1),
+             "unit": "us", "p50_us": round(stats["p50_us"], 1),
+             "n": stats["n"], **extra}
+        print(json.dumps(r), flush=True)
+        results.append(r)
+    r = {"metric": "mpmd_gap_speedup",
+         "value": round(sub_stats["mean_us"] / ch_stats["mean_us"], 2),
+         "unit": "x"}
+    print(json.dumps(r), flush=True)
+    results.append(r)
+
+
+def dag_main():
+    """Just the compiled-DAG + MPMD section (BENCH_r08.json)."""
+    results = []
+    ray_tpu.init(num_cpus=4)
+
+    @ray_tpu.remote
+    def nop():
+        return None
+
+    ray_tpu.get([nop.remote() for _ in range(8)])
+    settle_leases()
+    run_metric(results, "dag_dispatch_us", lambda: dag_metrics(results))
+    run_metric(results, "mpmd_gap_us", lambda: mpmd_metrics(results))
+    ray_tpu.shutdown()
+    return results
+
+
 def main():
     import os
 
@@ -128,6 +277,12 @@ def main():
     results.append(bench(
         "actor_calls_per_s", 2000,
         lambda: ray_tpu.get([a.call.remote() for _ in range(2000)])))
+
+    # 2b. compiled-DAG channel dispatch + MPMD pipeline gap (r08).
+    settle_leases()
+    run_metric(results, "dag_dispatch_us", lambda: dag_metrics(results))
+    run_metric(results, "mpmd_gap_us", lambda: mpmd_metrics(results))
+    settle_leases()
 
     # 3. put throughput (64MB arrays through the arena). Steady-state: one
     # warm-up wave faults the arena pages this working set will cycle
@@ -303,6 +458,12 @@ def main():
 
 
 if __name__ == "__main__":
-    rs = main()
-    with open(__file__.replace("core_perf.py", "PERF.json"), "w") as f:
-        json.dump({r["metric"]: r for r in rs}, f, indent=1)
+    if "--dag-only" in sys.argv:
+        rs = dag_main()
+        with open(__file__.replace("core_perf.py", "BENCH_r08.json"),
+                  "w") as f:
+            json.dump({r["metric"]: r for r in rs}, f, indent=1)
+    else:
+        rs = main()
+        with open(__file__.replace("core_perf.py", "PERF.json"), "w") as f:
+            json.dump({r["metric"]: r for r in rs}, f, indent=1)

@@ -165,6 +165,20 @@ def cmd_status(args) -> int:
                   f"{n.get('num_workers', 0):>8}  "
                   f"{json.dumps(n.get('resources', {}))}", file=sys.stderr)
         print(file=sys.stderr)
+    # Compiled DAGs with live channel plans: their steady-state dispatch
+    # bypasses the controller, so this registry is the only place an
+    # operator can see which pipelines hold resident actor loops.
+    dags = state.get("compiled_dags") or {}
+    if dags:
+        print(f"{'COMPILED DAG':14} {'STAGES':>6} {'DEPTH':>6}  EDGES",
+              file=sys.stderr)
+        for did, d in sorted(dags.items()):
+            kinds = d.get("edges") or {}
+            summary = ",".join(
+                f"{eid}:{kind}" for eid, kind in sorted(kinds.items()))
+            print(f"{did[:12]:14} {d.get('stages', 0):>6} "
+                  f"{d.get('depth', 0):>6}  {summary}", file=sys.stderr)
+        print(file=sys.stderr)
     print(json.dumps(state, indent=1, default=str))
     # Quote recent hang/straggler findings: the watchdog's whole point is
     # that a silently hung step shows up where operators already look.

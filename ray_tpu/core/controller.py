@@ -379,6 +379,10 @@ class Controller:
         self.workers: Dict[str, WorkerInfo] = {}
         self.actors: Dict[str, ActorInfo] = {}
         self.named_actors: Dict[Tuple[str, str], str] = {}  # (namespace, name) -> actor_id
+        # Compiled DAGs with live channel plans (dag_id -> registration):
+        # bookkeeping only — the channel data plane never touches the
+        # controller between compile and teardown.
+        self.compiled_dags: Dict[str, Dict[str, Any]] = {}
         self.objects: Dict[str, ObjectLocation] = {}
         # Broadcast replicas: oid -> {node_id: ObjectLocation} — full extra
         # copies of an object's bytes on other hosts (reference: the object
@@ -2534,10 +2538,31 @@ class Controller:
         if actor.state == "alive" and w is not None and w.direct_port:
             peer = w.conn.writer.get_extra_info("peername")
             host = peer[0] if peer else "127.0.0.1"
+            # node_id lets callers decide locality (compiled-DAG edges
+            # choose shm rings for same-node hops, streams otherwise).
             direct = {"worker_id": w.worker_id, "host": host,
-                      "port": w.direct_port}
+                      "port": w.direct_port, "node_id": w.node_id}
         return {"state": actor.state, "direct": direct,
                 "restarts": actor.restart_count}
+
+    async def _h_dag_compiled(self, conn, msg):
+        """A driver compiled a channel-based DAG: record the plan shape so
+        `rtpu status` / state.list_state can show what pipelines hold
+        resident loops on which actors. Steady-state execution never calls
+        here — this pair of RPCs (with dag_torndown) is the controller's
+        ENTIRE involvement in a compiled DAG's lifetime."""
+        self.compiled_dags[msg["dag_id"]] = {
+            "dag_id": msg["dag_id"],
+            "stages": msg.get("stages", []),
+            "edges": msg.get("edges", {}),
+            "depth": msg.get("depth", 0),
+            "since": time.time(),
+        }
+        return {"ok": True}
+
+    async def _h_dag_torndown(self, conn, msg):
+        self.compiled_dags.pop(msg["dag_id"], None)
+        return {"ok": True}
 
     async def _h_get_named_actor(self, conn, msg):
         key = (msg.get("namespace", "default"), msg["name"])
@@ -3006,6 +3031,17 @@ class Controller:
                     ],
                 }
                 for pg in list(self.pgs.values())[:limit]
+            ]
+        if what == "dags":
+            return [
+                {
+                    "dag_id": d["dag_id"],
+                    "stages": [dict(s) for s in d.get("stages", ())],
+                    "edges": dict(d.get("edges", {})),
+                    "depth": d.get("depth", 0),
+                    "since": d.get("since", 0.0),
+                }
+                for d in list(self.compiled_dags.values())[:limit]
             ]
         if what == "summary":
             counts: Dict[str, Dict[str, int]] = {}
@@ -3910,6 +3946,13 @@ class Controller:
             "pending_tasks": len(self.pending_queue),
             "uptime_s": time.time() - self.start_time,
             "metrics_port": getattr(self, "metrics_port", 0),
+            "compiled_dags": {
+                did: {"stages": len(d.get("stages", ())),
+                      "edges": d.get("edges", {}),
+                      "depth": d.get("depth", 0),
+                      "since": d.get("since", 0.0)}
+                for did, d in self.compiled_dags.items()
+            },
         }
 
     async def _h_add_node(self, conn, msg):

@@ -612,3 +612,196 @@ def free_segment(shm_name: str) -> None:
 
 def close_process_segments() -> None:
     _segments.close_all()
+
+
+# ---------------------------------------------- mutable channel slot rings
+#
+# The compiled-DAG channel substrate (reference: MutableObjectManager's
+# mutable plasma objects backing aDAG channels). Unlike every object above,
+# a slot ring is MUTABLE shared memory: one writer and up to MAX_READERS
+# readers on the same host rendezvous on a fixed ring of slots, re-used for
+# every execution, so the steady-state cost of moving a value between two
+# processes is one memcpy + two 8-byte header stores — no allocation, no
+# pickle of locations, no controller message. Layout (all u64, aligned):
+#
+#   [write_seq][closed][depth][slot_size][n_readers][writer_waiting]
+#   [read_seq[0]][reader_waiting[0]] ... x MAX_READERS
+#   then `depth` slots of (seq, kind, len) + slot_size payload bytes.
+#
+# Single-writer/multi-reader protocol: the writer fills slot seq%depth and
+# THEN publishes by storing write_seq=seq+1; a reader consumes the slot and
+# THEN stores its read_seq=seq+1. Aligned 8-byte stores are atomic on every
+# platform we run on, and each field has exactly one writing process, so no
+# locks exist anywhere on the hot path. A slot is reusable once every
+# reader's read_seq has passed it (min_read_seq), which is what bounds the
+# pipeline to `depth` in-flight items. The waiting flags let the peer skip
+# the doorbell syscall when nobody is blocked (dag/channels.py owns the
+# doorbells; this class is pure layout + accounting).
+
+import struct as _struct
+import threading as _threading
+
+_U64 = _struct.Struct("<Q")
+_SLOT_HDR = _struct.Struct("<QQQ")  # seq, kind, len
+
+# Per-process accounting of OPEN channel segments (rings + sidecars): the
+# chaos tests assert teardown leaks nothing by diffing this.
+_channel_lock = _threading.Lock()
+_channel_open: Dict[str, int] = {}  # name -> mapped bytes
+
+
+def track_channel_segment(name: str, nbytes: int) -> None:
+    with _channel_lock:
+        _channel_open[name] = nbytes
+
+
+def untrack_channel_segment(name: str) -> None:
+    with _channel_lock:
+        _channel_open.pop(name, None)
+
+
+def channel_segment_stats() -> Dict[str, int]:
+    """Open channel segments (slot rings + oversize sidecars) mapped by
+    THIS process: {"segments": count, "bytes": total mapped}."""
+    with _channel_lock:
+        return {"segments": len(_channel_open),
+                "bytes": sum(_channel_open.values())}
+
+
+class SlotRing:
+    """One mutable shm channel: a depth-bounded ring of fixed-size slots.
+
+    Created by the producing process, attached by every consumer on the
+    same host. `kind` is an application tag rode along with each item
+    (dag/channels.py uses it for inline-pickle vs sidecar vs error)."""
+
+    MAX_READERS = 8
+    _RHDR = 48                       # fixed header bytes before reader table
+    _SLOTS_OFF = _RHDR + 16 * MAX_READERS
+
+    def __init__(self, seg: shared_memory.SharedMemory, created: bool):
+        self._seg = seg
+        self._created = created
+        buf = seg.buf
+        self.depth = _U64.unpack_from(buf, 16)[0]
+        self.slot_size = _U64.unpack_from(buf, 24)[0]
+        self.n_readers = _U64.unpack_from(buf, 32)[0]
+        self._stride = _SLOT_HDR.size + self.slot_size
+        track_channel_segment(seg.name, seg.size)
+
+    # -- lifecycle ---------------------------------------------------------
+    @classmethod
+    def create(cls, depth: int, slot_size: int, n_readers: int,
+               name: Optional[str] = None) -> "SlotRing":
+        if n_readers > cls.MAX_READERS:
+            raise ValueError(
+                f"slot ring supports at most {cls.MAX_READERS} same-host "
+                f"readers (got {n_readers})")
+        depth = max(1, int(depth))
+        total = cls._SLOTS_OFF + depth * (_SLOT_HDR.size + slot_size)
+        name = name or ("rtpu_ch_" + secrets.token_hex(8))
+        seg = shared_memory.SharedMemory(name=name, create=True, size=total)
+        _untrack(name)
+        seg.buf[:cls._SLOTS_OFF] = bytes(cls._SLOTS_OFF)
+        _U64.pack_into(seg.buf, 16, depth)
+        _U64.pack_into(seg.buf, 24, slot_size)
+        _U64.pack_into(seg.buf, 32, n_readers)
+        return cls(seg, created=True)
+
+    @classmethod
+    def attach(cls, name: str) -> "SlotRing":
+        # _untrack: on 3.10 attaching registers with the resource tracker,
+        # which would unlink the ring when the FIRST attacher exits; ring
+        # lifetime belongs to the creating writer.
+        seg = shared_memory.SharedMemory(name=name)
+        _untrack(name)
+        return cls(seg, created=False)
+
+    @property
+    def name(self) -> str:
+        return self._seg.name
+
+    def close(self) -> None:
+        untrack_channel_segment(self._seg.name)
+        try:
+            self._seg.close()
+        except Exception:
+            pass
+
+    def unlink(self) -> None:
+        name = self._seg.name
+        self.close()
+        try:
+            import _posixshmem
+
+            _posixshmem.shm_unlink("/" + name)
+        except Exception:
+            pass
+
+    # -- header fields (each has exactly one writing process) --------------
+    def write_seq(self) -> int:
+        return _U64.unpack_from(self._seg.buf, 0)[0]
+
+    def closed(self) -> bool:
+        return _U64.unpack_from(self._seg.buf, 8)[0] != 0
+
+    def mark_closed(self) -> None:
+        _U64.pack_into(self._seg.buf, 8, 1)
+
+    def read_seq(self, idx: int) -> int:
+        return _U64.unpack_from(self._seg.buf, self._RHDR + 16 * idx)[0]
+
+    def min_read_seq(self) -> int:
+        return min(self.read_seq(i) for i in range(self.n_readers))
+
+    def writer_waiting(self) -> bool:
+        return _U64.unpack_from(self._seg.buf, 40)[0] != 0
+
+    def set_writer_waiting(self, v: bool) -> None:
+        _U64.pack_into(self._seg.buf, 40, 1 if v else 0)
+
+    def reader_waiting(self, idx: int) -> bool:
+        off = self._RHDR + 16 * idx + 8
+        return _U64.unpack_from(self._seg.buf, off)[0] != 0
+
+    def set_reader_waiting(self, idx: int, v: bool) -> None:
+        _U64.pack_into(self._seg.buf, self._RHDR + 16 * idx + 8,
+                       1 if v else 0)
+
+    # -- writer side -------------------------------------------------------
+    def has_space(self, seq: int) -> bool:
+        return seq - self.min_read_seq() < self.depth
+
+    def write(self, seq: int, kind: int, payload) -> None:
+        """Fill slot seq%depth and publish it (write_seq := seq+1). The
+        caller must hold has_space(seq); payload must fit slot_size."""
+        n = memoryview(payload).nbytes
+        if n > self.slot_size:
+            raise ValueError(f"payload {n}B exceeds slot {self.slot_size}B")
+        off = self._SLOTS_OFF + (seq % self.depth) * self._stride
+        _SLOT_HDR.pack_into(self._seg.buf, off, seq, kind, n)
+        self._seg.buf[off + _SLOT_HDR.size: off + _SLOT_HDR.size + n] = \
+            payload
+        _U64.pack_into(self._seg.buf, 0, seq + 1)  # publish
+
+    # -- reader side -------------------------------------------------------
+    def readable(self, idx: int) -> bool:
+        return self.write_seq() > self.read_seq(idx)
+
+    def read(self, idx: int) -> Tuple[int, int, bytes]:
+        """Copy out the next item for reader idx WITHOUT advancing; the
+        caller advances after it has finished with the bytes."""
+        seq = self.read_seq(idx)
+        off = self._SLOTS_OFF + (seq % self.depth) * self._stride
+        sseq, kind, n = _SLOT_HDR.unpack_from(self._seg.buf, off)
+        if sseq != seq:  # torn ring (writer died mid-slot / layout skew)
+            raise RuntimeError(
+                f"channel ring {self.name}: slot seq {sseq} != expected "
+                f"{seq}")
+        data = bytes(
+            self._seg.buf[off + _SLOT_HDR.size: off + _SLOT_HDR.size + n])
+        return seq, kind, data
+
+    def advance(self, idx: int) -> None:
+        _U64.pack_into(self._seg.buf, self._RHDR + 16 * idx,
+                       self.read_seq(idx) + 1)

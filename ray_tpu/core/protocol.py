@@ -209,6 +209,19 @@ def write_msg(writer: asyncio.StreamWriter, msg: Dict[str, Any]) -> None:
     writer.write(data)
 
 
+def encode_raw_prefix(msg: Dict[str, Any], raw) -> bytes:
+    """Frame prefix for a raw-tail message: length word (with _RAW_BIT),
+    raw length, pickled header. The caller writes this prefix and then the
+    raw bytes; read_msg on the other end reassembles msg["data"] as a
+    zero-copy memoryview. One encoder shared by the asyncio transport
+    (Connection.send_with_raw) and synchronous blocking-socket senders
+    (transfer.RawStreamSender) so the framing cannot drift."""
+    header = dumps(msg)
+    raw_len = memoryview(raw).nbytes
+    total = _LEN.size + len(header) + raw_len
+    return _LEN.pack(total | _RAW_BIT) + _LEN.pack(raw_len) + header
+
+
 class Connection:
     """A bidirectional message channel with request/response correlation.
 
@@ -375,23 +388,42 @@ class Connection:
         transport — no pickle embedding, no frame concatenation — which
         halves the per-byte copy count of the bulk data plane (the chunk
         cost is what bounds transfer GB/s on a CPU-bound host)."""
-        header = dumps(msg)
-        raw_len = memoryview(raw).nbytes
-        total = _LEN.size + len(header) + raw_len
         if partition_active():
             return  # blackholed process (testing): the chunk vanishes
+        prefix = encode_raw_prefix(msg, raw)
         async with self._send_lock:
             self._flush()  # previously queued frames keep their order
             try:
                 w = self.writer
-                w.write(_LEN.pack(total | _RAW_BIT) + _LEN.pack(raw_len)
-                        + header)
+                w.write(prefix)
                 w.write(raw)
             except Exception:
                 return  # reader task notices the broken pipe and closes
             if (self.writer.transport.get_write_buffer_size()
                     > self._DRAIN_ABOVE):
                 await self.writer.drain()
+
+    def send_with_raw_threadsafe(self, msg: Dict[str, Any], raw) -> None:
+        """Fire-and-forget raw-tail push from a non-loop thread.
+
+        Serialization happens on the calling thread; the loop thread only
+        queues bytes (same division of labor as request_threadsafe). The
+        raw payload is copied here — the caller's buffer (a channel slot)
+        may be rewritten before the loop flushes. Compiled-DAG edges that
+        terminate at the driver ride this over the driver's existing
+        control connection to the worker, so a cross-host terminal needs
+        no extra listening socket on the driver."""
+        prefix = encode_raw_prefix(msg, raw)
+        payload = bytes(raw)
+
+        def _send() -> None:
+            try:
+                self._buffered_write(prefix)
+                self._buffered_write(payload)
+            except Exception:
+                pass  # reader task notices the broken pipe and closes
+
+        self._loop.call_soon_threadsafe(_send)
 
     async def request(self, msg: Dict[str, Any], timeout: Optional[float] = None) -> Any:
         """Send a request and await the correlated response."""

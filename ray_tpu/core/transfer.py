@@ -48,6 +48,44 @@ PULL_CHUNK_TIMEOUT_S = 20.0
 _PARALLEL_MIN_SPAN = 8 * 1024 * 1024
 
 
+class RawStreamSender:
+    """Persistent raw-tail stream to a peer's direct server.
+
+    One long-lived blocking TCP connection carrying `encode_raw_prefix`
+    frames — the cross-host leg of a compiled-DAG channel (and any future
+    worker→worker push stream). Unlike the asyncio Connection this is
+    callable from an actor's mailbox thread with no loop hop: the resident
+    DAG loop writes a frame with two sendall()s and returns to compute.
+    The receiver is the peer worker's ordinary direct server; frames with
+    no rid get no response, so the stream is strictly one-way and the
+    socket is never read. Thread-safe (frames cannot interleave)."""
+
+    def __init__(self, host: str, port: int, connect_timeout: float = 10.0):
+        import socket as _socket
+
+        self._sock = _socket.create_connection((host, port),
+                                               timeout=connect_timeout)
+        self._sock.setsockopt(_socket.IPPROTO_TCP, _socket.TCP_NODELAY, 1)
+        self._sock.settimeout(None)
+        self._lock = threading.Lock()
+        self.addr = (host, port)
+
+    def send(self, msg: Dict[str, Any], raw) -> None:
+        from . import protocol
+
+        prefix = protocol.encode_raw_prefix(msg, raw)
+        with self._lock:
+            self._sock.sendall(prefix)
+            if memoryview(raw).nbytes:
+                self._sock.sendall(raw)
+
+    def close(self) -> None:
+        try:
+            self._sock.close()
+        except Exception:
+            pass
+
+
 def read_location_range(loc: ObjectLocation, offset: int, length: int) -> bytes:
     """Serve `length` bytes at `offset` of the object at `loc` (local host)."""
     if loc.inline is not None:

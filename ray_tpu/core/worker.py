@@ -412,6 +412,9 @@ class WorkerRuntime:
         self._done_scheduled = False
         self.functions: Dict[str, Any] = {}
         self.actors: Dict[str, ActorMailbox] = {}
+        # Installed compiled-DAG plans (dag_id -> dag.resident.WorkerDAG):
+        # resident loops + producer rings + stream inboxes on this worker.
+        self.dag_channels: Dict[str, Any] = {}
         self.running_threads: Dict[str, int] = {}  # task_id -> thread ident
         self.cancelled_tasks: set = set()  # ray.cancel'd before/while running
         self.shutdown_event = threading.Event()
@@ -706,6 +709,13 @@ class WorkerRuntime:
             from . import transfer
 
             return await transfer.handle_pull_server_message(conn, msg)
+        if kind.startswith("dag_"):
+            # Compiled-DAG channel plane: install/teardown/status ride the
+            # driver's per-DAG connection; dag_channel_item frames are the
+            # cross-host channel legs (raw-tail pushes, no response).
+            from ray_tpu.dag import resident
+
+            return resident.handle_direct_message(self, conn, msg)
         if kind == "cancel_task":
             self._cancel_task(msg["task_id"])
             return None

@@ -12,7 +12,13 @@ from ray_tpu.dag.dag_node import (
     InputNode,
     MultiOutputNode,
 )
-from ray_tpu.dag.compiled_dag import CompiledDAG, CompiledDAGRef, compile_dag
+from ray_tpu.dag.channels import DAGTeardownError
+from ray_tpu.dag.compiled_dag import (
+    ChannelDAGRef,
+    CompiledDAG,
+    CompiledDAGRef,
+    compile_dag,
+)
 
 __all__ = [
     "DAGNode",
@@ -24,5 +30,7 @@ __all__ = [
     "MultiOutputNode",
     "CompiledDAG",
     "CompiledDAGRef",
+    "ChannelDAGRef",
+    "DAGTeardownError",
     "compile_dag",
 ]

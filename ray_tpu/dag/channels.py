@@ -1,0 +1,461 @@
+"""Mutable channels for compiled DAGs: shm slot rings + raw-tail streams.
+
+Parity: reference python/ray/experimental/channel/ (shared_memory_channel.py
+backed by MutableObjectManager in CoreWorker). A compiled DAG allocates one
+reusable channel per edge at compile() time; every execute() thereafter is
+a header write + one wake, with zero per-call control plane.
+
+Two transports, chosen per edge by locality:
+
+- **Same-host edges** ride a `core.object_store.SlotRing`: a depth-bounded
+  ring of fixed-size shm slots with a seqno+len header per slot. The
+  producer publishes by bumping the slot seq; consumers copy out and
+  advance their read cursor. Values larger than a slot ship via a one-off
+  sidecar shm segment named inside the slot (the reference spills oversize
+  mutable objects the same way). Wakeups are *doorbells*: tiny unix
+  datagram sockets derived from the ring name — a peer rings only when the
+  waiter has advertised it is blocking (waiting flags in the ring header),
+  so the steady-state fast path is a pure shm poll with no syscalls.
+- **Cross-host edges** ride a persistent raw-tail stream (PR 7's
+  `encode_raw_prefix` framing): worker→worker legs hold a dedicated
+  blocking TCP connection (`transfer.RawStreamSender`) to the consumer's
+  direct server; driver↔worker legs reuse the per-DAG install connection
+  (`Connection.send_with_raw_threadsafe`), so the driver needs no extra
+  listening socket. Receivers land items in a `StreamInbox`.
+
+Both readers expose the same ``recv(timeout) -> (seq, kind, payload)``
+surface, so the resident DAG loop (dag/resident.py) is transport-blind.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+import socket
+import tempfile
+import threading
+import time
+from collections import deque
+from multiprocessing import shared_memory
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from ray_tpu import flags
+from ray_tpu.core import object_store
+from ray_tpu.util import metrics as um
+
+# Value kinds carried in the slot/frame header (SlotRing's `kind` field /
+# the dag_channel_item "vk" field).
+KIND_DATA = 0      # payload = pickle of the stage result / input value
+KIND_ERROR = 1     # payload = pickle of the exception (flows downstream)
+KIND_SIDECAR = 2   # payload = pickle of (inner_kind, shm_name, nbytes)
+
+_BYTES = um.Counter(
+    "rtpu_dag_channel_bytes_total",
+    description="Bytes moved through compiled-DAG channels, by edge "
+                "transport (shm slot rings vs persistent raw-tail streams)",
+    tag_keys=("edge_kind",),
+)
+
+
+class DAGTeardownError(RuntimeError):
+    """The compiled DAG was torn down while this result was outstanding.
+
+    Raised by ``CompiledDAGRef.get()`` for every in-flight ``execute()``
+    when a participant dies (worker SIGKILL, node loss, actor restart) or
+    the DAG is explicitly torn down. Carries the first underlying cause in
+    ``args`` / ``__cause__`` when one is known.
+    """
+
+
+class ChannelClosed(Exception):
+    """Internal control-flow signal: the channel's DAG stopped (teardown,
+    peer death, or writer drain). Resident loops exit on it; the driver
+    translates it into DAGTeardownError for user-visible refs."""
+
+
+# --------------------------------------------------------------------------
+# doorbells
+
+
+def _bell_dir() -> str:
+    return tempfile.gettempdir()
+
+
+def writer_bell_path(ring_name: str) -> str:
+    return os.path.join(_bell_dir(), ring_name + "_w")
+
+
+def reader_bell_path(ring_name: str, idx: int) -> str:
+    return os.path.join(_bell_dir(), f"{ring_name}_r{idx}")
+
+
+_ring_sock: Optional[socket.socket] = None
+_ring_sock_lock = threading.Lock()
+
+
+def ring_bell(path: str) -> None:
+    """Fire-and-forget one-byte wake. Datagram sends are atomic, so one
+    shared unbound socket serves every thread in the process; a missing or
+    full peer socket is ignored — waits are timeout-bounded precisely so a
+    lost wake costs latency, never correctness."""
+    global _ring_sock
+    s = _ring_sock
+    if s is None:
+        with _ring_sock_lock:
+            s = _ring_sock
+            if s is None:
+                s = _ring_sock = socket.socket(socket.AF_UNIX,
+                                               socket.SOCK_DGRAM)
+    try:
+        s.sendto(b"\0", path)
+    except OSError:
+        pass
+
+
+class Doorbell:
+    """The waiting side of a wakeup pair: a bound unix datagram socket.
+
+    The waiter advertises intent via the ring header's waiting flags, then
+    blocks in ``wait()``; peers ``ring_bell()`` the deterministic path
+    derived from the ring name. Stale paths from a crashed previous run
+    are unlinked on bind."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._sock = socket.socket(socket.AF_UNIX, socket.SOCK_DGRAM)
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
+        self._sock.bind(path)
+
+    def wait(self, timeout: float) -> bool:
+        self._sock.settimeout(timeout if timeout > 0 else 0.001)
+        try:
+            self._sock.recv(16)
+        except (socket.timeout, OSError):
+            return False
+        # Drain queued rings so a burst of publishes costs one wake.
+        self._sock.settimeout(0.0)
+        try:
+            while True:
+                self._sock.recv(16)
+        except (BlockingIOError, socket.timeout, OSError):
+            pass
+        return True
+
+    def close(self) -> None:
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        try:
+            os.unlink(self.path)
+        except OSError:
+            pass
+
+
+def _spin_until(cond: Callable[[], bool], spin_us: int) -> bool:
+    """Busy-poll ``cond`` for up to ``spin_us`` microseconds. Zero (the
+    right setting for 1-core hosts) skips straight to the doorbell."""
+    if spin_us <= 0:
+        return cond()
+    deadline = time.monotonic_ns() + spin_us * 1_000
+    while True:
+        if cond():
+            return True
+        if time.monotonic_ns() >= deadline:
+            return False
+
+
+# --------------------------------------------------------------------------
+# value encoding
+
+
+def encode_value(value: Any) -> bytes:
+    return pickle.dumps(value, protocol=pickle.HIGHEST_PROTOCOL)
+
+
+def encode_error(exc: BaseException) -> bytes:
+    try:
+        return pickle.dumps(exc, protocol=pickle.HIGHEST_PROTOCOL)
+    except Exception:
+        return pickle.dumps(
+            RuntimeError(f"{type(exc).__name__}: {exc!r}"))
+
+
+def decode(payload: bytes) -> Any:
+    return pickle.loads(payload)
+
+
+def apply_selector(value: Any, key: Any) -> Any:
+    """InputAttributeNode semantics, applied consumer-side: the full input
+    value travels the channel once; each binding selects into it locally
+    (same contract as InputAttributeNode._execute_impl)."""
+    if isinstance(key, int) and isinstance(value, (list, tuple)):
+        return value[key]
+    if isinstance(value, dict):
+        return value[key]
+    return getattr(value, key)
+
+
+# --------------------------------------------------------------------------
+# shm transport
+
+
+class ShmEdgeWriter:
+    """Producer side of a same-host edge: owns the SlotRing segment.
+
+    Single writer (the producing stage's resident loop, or the driver's
+    execute thread under its lock). Oversize values spill to a per-seq
+    sidecar segment reaped when the slot is provably recycled — space for
+    seq implies every reader advanced past seq-depth, so that sidecar can
+    be unlinked before the new write."""
+
+    def __init__(self, ring: object_store.SlotRing):
+        self.ring = ring
+        self._bell = Doorbell(writer_bell_path(ring.name))
+        self._spin_us = int(flags.get("RTPU_DAG_SPIN_US"))
+        self._sidecars: Dict[int, str] = {}
+        self._closed = False
+
+    def write(self, seq: int, kind: int, payload: bytes,
+              stop: Optional[Callable[[], bool]] = None) -> None:
+        ring = self.ring
+        if len(payload) > ring.slot_size:
+            kind, payload = self._spill(seq, kind, payload)
+        if not ring.has_space(seq):
+            self._wait_space(seq, stop)
+        old = self._sidecars.pop(seq - ring.depth, None)
+        if old is not None:
+            _unlink_segment(old)
+        ring.write(seq, kind, payload)
+        _BYTES.inc(len(payload), {"edge_kind": "shm"})
+        for i in range(ring.n_readers):
+            if ring.reader_waiting(i):
+                # Clear the flag ourselves: the queued datagram already
+                # guarantees the reader wakes, so later writes in this
+                # burst skip the (expensive) redundant sendto. The reader
+                # re-arms the flag every blocking cycle, so no lost wake.
+                ring.set_reader_waiting(i, False)
+                ring_bell(reader_bell_path(ring.name, i))
+
+    def _wait_space(self, seq: int, stop) -> None:
+        ring = self.ring
+        if _spin_until(lambda: ring.has_space(seq), self._spin_us):
+            return
+        while True:
+            if stop is not None and stop():
+                raise ChannelClosed(f"edge ring {ring.name} stopped")
+            ring.set_writer_waiting(True)
+            try:
+                if ring.has_space(seq):
+                    return
+                self._bell.wait(0.05)
+            finally:
+                ring.set_writer_waiting(False)
+
+    def _spill(self, seq: int, kind: int, payload: bytes
+               ) -> Tuple[int, bytes]:
+        name = f"{self.ring.name}s{seq}"
+        seg = shared_memory.SharedMemory(name=name, create=True,
+                                         size=len(payload))
+        object_store._untrack(name)
+        seg.buf[: len(payload)] = payload
+        object_store.track_channel_segment(name, len(payload))
+        seg.close()
+        self._sidecars[seq] = name
+        return KIND_SIDECAR, pickle.dumps((kind, name, len(payload)))
+
+    def close(self) -> None:
+        """Mark the ring drained and release everything this writer owns.
+        Readers observe ``closed`` once the ring is empty and raise
+        ChannelClosed; sidecars and the ring segment unlink here (creator
+        owns the name)."""
+        if self._closed:
+            return
+        self._closed = True
+        try:
+            self.ring.mark_closed()
+        except Exception:
+            pass
+        for i in range(self.ring.n_readers):
+            if self.ring.reader_waiting(i):
+                ring_bell(reader_bell_path(self.ring.name, i))
+        for name in self._sidecars.values():
+            _unlink_segment(name)
+        self._sidecars.clear()
+        self._bell.close()
+        self.ring.unlink()
+
+
+def _unlink_segment(name: str) -> None:
+    object_store.untrack_channel_segment(name)
+    try:
+        import _posixshmem
+
+        _posixshmem.shm_unlink("/" + name)
+    except Exception:
+        pass
+
+
+class ShmEdgeReader:
+    """One consumer cursor on a same-host edge's SlotRing."""
+
+    def __init__(self, ring_name: str, idx: int,
+                 attach_timeout: float = 10.0):
+        self.idx = idx
+        self.ring = _attach_retry(ring_name, attach_timeout)
+        self._bell = Doorbell(reader_bell_path(ring_name, idx))
+        self._spin_us = int(flags.get("RTPU_DAG_SPIN_US"))
+
+    def recv(self, timeout: float,
+             stop: Optional[Callable[[], bool]] = None
+             ) -> Optional[Tuple[int, int, bytes]]:
+        ring, idx = self.ring, self.idx
+        if not ring.readable(idx):
+            if not _spin_until(lambda: ring.readable(idx), self._spin_us):
+                deadline = time.monotonic() + timeout
+                while True:
+                    if stop is not None and stop():
+                        raise ChannelClosed(f"edge ring {ring.name} stopped")
+                    ring.set_reader_waiting(idx, True)
+                    try:
+                        if ring.readable(idx):
+                            break
+                        if ring.closed():
+                            raise ChannelClosed(
+                                f"edge ring {ring.name} closed by writer")
+                        remaining = deadline - time.monotonic()
+                        if remaining <= 0:
+                            return None
+                        self._bell.wait(min(0.05, remaining))
+                    finally:
+                        ring.set_reader_waiting(idx, False)
+        seq, kind, payload = ring.read(idx)
+        if kind == KIND_SIDECAR:
+            kind, payload = _read_sidecar(payload)
+        ring.advance(idx)
+        if ring.writer_waiting():
+            # Same elision as the writer side: one queued bell wakes the
+            # writer, which re-arms its flag before blocking again.
+            ring.set_writer_waiting(False)
+            ring_bell(writer_bell_path(ring.name))
+        return seq, kind, payload
+
+    def close(self) -> None:
+        self._bell.close()
+        self.ring.close()
+
+
+def _attach_retry(name: str, timeout: float) -> object_store.SlotRing:
+    """Attach to a peer-created ring. The producer creates it during
+    dag_install; install order across workers is unordered, so consumers
+    tolerate a startup window."""
+    deadline = time.monotonic() + timeout
+    while True:
+        try:
+            ring = object_store.SlotRing.attach(name)
+            # The creator zero-fills then writes the header; an attach
+            # landing inside that window sees depth=0 — not ready yet.
+            if ring.depth > 0 and ring.n_readers > 0:
+                return ring
+            ring.close()
+        except FileNotFoundError:
+            pass
+        except ValueError:
+            # Attach landed between the creator's shm_open and ftruncate:
+            # the segment exists but is still zero-sized ("cannot mmap an
+            # empty file"). Same not-ready window as depth==0.
+            pass
+        if time.monotonic() >= deadline:
+            raise ChannelClosed(
+                f"edge ring {name} never appeared (producer install "
+                f"failed or tore down)")
+        time.sleep(0.005)
+
+
+def _read_sidecar(marker: bytes) -> Tuple[int, bytes]:
+    kind, name, n = pickle.loads(marker)
+    seg = shared_memory.SharedMemory(name=name)
+    object_store._untrack(name)  # writer owns the unlink
+    try:
+        return kind, bytes(seg.buf[:n])
+    finally:
+        seg.close()
+
+
+# --------------------------------------------------------------------------
+# stream transport (receiver side; senders live in transfer/protocol)
+
+
+class StreamInbox:
+    """Landing queue for one (edge, endpoint) fed by raw-tail frames.
+
+    The direct server / install-conn handler pushes from the io loop; the
+    resident loop (or driver pump) blocks in ``recv``. Capacity is bounded
+    by the driver's in-flight window, so no backpressure of its own."""
+
+    def __init__(self) -> None:
+        self._dq: deque = deque()
+        self._cond = threading.Condition()
+        self._closed = False
+
+    def push(self, seq: int, kind: int, payload: bytes) -> None:
+        with self._cond:
+            self._dq.append((seq, kind, payload))
+            self._cond.notify_all()
+
+    def recv(self, timeout: float,
+             stop: Optional[Callable[[], bool]] = None
+             ) -> Optional[Tuple[int, int, bytes]]:
+        deadline = time.monotonic() + timeout
+        with self._cond:
+            while not self._dq:
+                if self._closed or (stop is not None and stop()):
+                    raise ChannelClosed("stream inbox closed")
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return None
+                self._cond.wait(min(0.05, remaining))
+            return self._dq.popleft()
+
+    def poke(self) -> None:
+        with self._cond:
+            self._cond.notify_all()
+
+    def close(self) -> None:
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+
+
+class EdgeWriter:
+    """Fan-out writer for one DAG edge: at most one shm ring (all same-host
+    consumers share it) plus one stream send per cross-host consumer.
+
+    Streams go first — they never block — then the ring write, which may
+    wait on the in-flight window."""
+
+    def __init__(self, dag_id: str, edge_id: str,
+                 ring_writer: Optional[ShmEdgeWriter] = None,
+                 stream_targets: Optional[
+                     List[Tuple[Callable[[Dict[str, Any], bytes], None],
+                                str]]] = None):
+        self.dag_id = dag_id
+        self.edge_id = edge_id
+        self.ring_writer = ring_writer
+        self.stream_targets = list(stream_targets or ())
+
+    def write(self, seq: int, kind: int, payload: bytes,
+              stop: Optional[Callable[[], bool]] = None) -> None:
+        for send, endpoint in self.stream_targets:
+            send({"kind": "dag_channel_item", "dag": self.dag_id,
+                  "edge": self.edge_id, "to": endpoint, "seq": seq,
+                  "vk": kind}, payload)
+            _BYTES.inc(len(payload), {"edge_kind": "stream"})
+        if self.ring_writer is not None:
+            self.ring_writer.write(seq, kind, payload, stop)
+
+    def close(self) -> None:
+        if self.ring_writer is not None:
+            self.ring_writer.close()

@@ -1,4 +1,4 @@
-"""Compiled DAGs: persistent actor pipelines with pipelined dispatch.
+"""Compiled DAGs: persistent actor pipelines with channel-based dispatch.
 
 Parity: reference python/ray/dag/compiled_dag_node.py (CompiledDAG,
 ExecutableTask) + experimental/channel/shared_memory_channel.py. The
@@ -6,14 +6,15 @@ reference compiles an actor-method DAG into reusable mutable-plasma
 channels so repeated executions skip per-call RPC setup; GPU-GPU hops ride
 NCCL P2P. The TPU-native translation has two halves:
 
-- **Host half (this file):** actors are instantiated once at compile time
-  and every ``execute()`` submits the whole stage chain up front, wiring
-  stage N's ObjectRef straight into stage N+1's arg list. Intermediates
-  flow worker→worker through the shared-memory arena (ray_tpu's channel
-  equivalent); the driver touches only the final ref. Because per-actor
-  mailboxes are ordered, ``execute()`` calls issued back-to-back overlap
-  across stages — item *i+1* is in stage 0 while item *i* is in stage 1 —
-  which is the aDAG pipelining win without a bespoke channel type.
+- **Host half (this file + dag/channels.py + dag/resident.py):**
+  ``compile()`` turns the graph into a static *channel plan* — one
+  reusable mutable channel per DAG edge (shm slot ring for same-host
+  consumers, persistent raw-tail stream for cross-host ones, depth =
+  ``max_in_flight``) — and installs a resident loop on each participating
+  actor's mailbox thread. Steady-state ``execute()`` is one slot write +
+  one doorbell: the controller sees compile and teardown only. A dead
+  participant tears the whole DAG down with ``DAGTeardownError`` on every
+  outstanding ref rather than hanging.
 - **Device half:** chip-to-chip movement inside a stage is XLA's job
   (collectives over ICI scheduled by the compiler — see
   ray_tpu/parallel/pipeline.py for the in-graph microbatch pipeline). A
@@ -21,16 +22,27 @@ NCCL P2P. The TPU-native translation has two halves:
   needs NCCL channels because torch ops don't compose across processes;
   jitted steps already internalize their collectives.
 
-``max_in_flight`` bounds pipeline depth the way the reference's
-``_max_buffered_results`` does: executing past the window blocks on the
-oldest outstanding result.
+``RTPU_DAG_CHANNELS=0`` (or a graph shape channels can't express — bare
+task nodes, no InputNode, nested-container bindings) falls back to the
+original submit path: every ``execute()`` re-submits the stage chain
+through normal actor calls, with ``max_in_flight`` bounding pipeline depth
+via ``api.wait`` on the oldest outstanding ref. The submit path is the
+baseline the dispatch benchmarks compare against.
 """
 from __future__ import annotations
 
+import os
+import threading
+import time
+import uuid
 from collections import deque
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
+from ray_tpu import flags
 from ray_tpu.core import api
+from ray_tpu.core import context as ctx
+from ray_tpu.dag import channels
+from ray_tpu.dag.channels import DAGTeardownError
 from ray_tpu.dag.dag_node import (
     ClassMethodNode,
     ClassNode,
@@ -40,10 +52,37 @@ from ray_tpu.dag.dag_node import (
     InputNode,
     MultiOutputNode,
 )
+from ray_tpu.util import metrics as um
+
+_m_compiled = um.Gauge(
+    "rtpu_dag_compiled",
+    description="Compiled DAGs currently live in this process with a "
+                "channel execution plan installed on workers")
+_m_execute = um.Histogram(
+    "rtpu_dag_execute_seconds",
+    description="Compiled-DAG end-to-end step latency: input channel "
+                "write to final result available at the driver",
+    boundaries=(0.0001, 0.001, 0.01, 0.1, 1.0, 10.0))
+
+_live_lock = threading.Lock()
+_live_count = 0
+
+
+def _live_delta(d: int) -> None:
+    global _live_count
+    with _live_lock:
+        _live_count = max(0, _live_count + d)
+        _m_compiled.set(_live_count)
+
+
+class _ChannelUnsupported(Exception):
+    """This graph shape can't compile to channels; use the submit path."""
 
 
 class CompiledDAGRef:
-    """Future for one compiled execution (reference CompiledDAGRef)."""
+    """Future for one compiled execution (reference CompiledDAGRef).
+
+    Submit-path flavor: wraps the ObjectRef(s) of the final stage."""
 
     def __init__(self, ref):
         self._ref = ref
@@ -56,6 +95,23 @@ class CompiledDAGRef:
         return self._ref
 
 
+class ChannelDAGRef:
+    """Future for one channel-mode execution: a (dag, seq) pair. The value
+    never has an ObjectRef — it lives in the terminal channel until the
+    driver pump stores it."""
+
+    def __init__(self, dag: "CompiledDAG", seq: int):
+        self._dag = dag
+        self._seq = seq
+
+    @property
+    def seq(self) -> int:
+        return self._seq
+
+    def get(self, timeout: Optional[float] = None):
+        return self._dag._get_result(self._seq, timeout)
+
+
 class CompiledDAG:
     def __init__(self, output_node: DAGNode, *, max_in_flight: int = 16):
         self._output = output_node
@@ -63,6 +119,8 @@ class CompiledDAG:
         self._max_in_flight = max(1, int(max_in_flight))
         self._inflight: deque = deque()
         self._torn_down = False
+        self._teardown_done = threading.Event()
+        self._cond = threading.Condition()
         # Validate the whole graph BEFORE creating anything: a rejected
         # graph must not leak half-instantiated actors.
         for n in self._nodes:
@@ -89,10 +147,451 @@ class CompiledDAG:
         for n in self._nodes:
             if isinstance(n, ClassNode):
                 self._actor_handles[id(n)] = n._execute_memo(boot_memo)
+        self._mode = "submit"
+        self.dag_id = uuid.uuid4().hex
+        if flags.get("RTPU_DAG_CHANNELS"):
+            try:
+                self._compile_channels()
+                self._mode = "channels"
+            except _ChannelUnsupported:
+                pass  # submit fallback stays fully functional
 
-    def execute(self, *args, **kwargs) -> CompiledDAGRef:
+    # ===================================================== channel compile
+
+    def _compile_channels(self) -> None:
+        """Build the channel plan and install it. Raises
+        _ChannelUnsupported for graph shapes the plan can't express —
+        anything else is a real compile error and propagates."""
+        plan = self._analyze()
+        wc = ctx.get_worker_context()
+        self._wc = wc
+        self._plan = plan
+        self._place_edges(plan)
+        self._conns: Dict[str, Any] = {}
+        self._inboxes: Dict[tuple, channels.StreamInbox] = {}
+        self._terminal_readers: Dict[str, Any] = {}
+        self._input_writer: Optional[channels.EdgeWriter] = None
+        self._results: Dict[int, Dict[str, Tuple[int, bytes]]] = {}
+        self._finished: set = set()
+        self._exec_ts: Dict[int, float] = {}
+        self._next_seq = 0
+        self._done_contig = 0
+        self._error: Optional[BaseException] = None
+        self._xlock = threading.Lock()
+        self._pump_stop = threading.Event()
+        try:
+            self._connect_workers(plan)
+            self._install(plan)
+            self._open_driver_channels(plan)
+        except Exception:
+            self._teardown_channels(kill_actors=False)
+            raise
+        try:
+            wc.client.request(
+                {"kind": "dag_compiled", "dag_id": self.dag_id,
+                 "stages": [{"idx": s["idx"], "actor_id": s["actor_id"],
+                             "method": s["method"]}
+                            for s in plan["stages"]],
+                 "edges": {eid: ("shm" if e.get("ring") and not e["streams"]
+                                 else "stream" if not e.get("ring")
+                                 else "mixed")
+                           for eid, e in plan["edges"].items()},
+                 "depth": plan["depth"]}, timeout=5)
+        except Exception:
+            pass  # bookkeeping only; the data plane doesn't need it
+        self._pump_thread = threading.Thread(
+            target=self._pump, name=f"dag-pump-{self.dag_id[:8]}",
+            daemon=True)
+        self._pump_thread.start()
+        _live_delta(+1)
+
+    # -- graph analysis ----------------------------------------------------
+
+    def _analyze(self) -> Dict[str, Any]:
+        nodes = self._nodes
+        input_node: Optional[InputNode] = None
+        stages: List[Dict[str, Any]] = []
+        stage_of: Dict[int, int] = {}  # id(ClassMethodNode) -> stage idx
+        for n in nodes:
+            if isinstance(n, FunctionNode):
+                raise _ChannelUnsupported("bare task nodes")
+            if isinstance(n, InputNode):
+                input_node = n
+            if isinstance(n, ClassMethodNode):
+                stage_of[id(n)] = len(stages)
+                stages.append({"node": n})
+        if input_node is None or not stages:
+            raise _ChannelUnsupported("no InputNode / no actor stages")
+        out = self._output
+        if isinstance(out, MultiOutputNode):
+            for o in out._outputs:
+                if not isinstance(o, ClassMethodNode):
+                    raise _ChannelUnsupported("non-stage terminal output")
+            terminal_stages = [stage_of[id(o)] for o in out._outputs]
+        elif isinstance(out, ClassMethodNode):
+            terminal_stages = [stage_of[id(out)]]
+        else:
+            raise _ChannelUnsupported("output must be an actor stage")
+
+        def classify(v) -> tuple:
+            if isinstance(v, InputNode):
+                return ("input", None)
+            if isinstance(v, InputAttributeNode):
+                return ("input", v._key)
+            if isinstance(v, ClassMethodNode):
+                return ("stage", stage_of[id(v)])
+            if isinstance(v, DAGNode):
+                raise _ChannelUnsupported(f"binding {type(v).__name__}")
+            if isinstance(v, (list, tuple, dict)):
+                probe = [v]
+                while probe:
+                    x = probe.pop()
+                    if isinstance(x, DAGNode):
+                        raise _ChannelUnsupported(
+                            "DAG node nested inside a container arg")
+                    if isinstance(x, (list, tuple)):
+                        probe.extend(x)
+                    elif isinstance(x, dict):
+                        probe.extend(x.values())
+            return ("const", v)
+
+        for idx, st in enumerate(stages):
+            n: ClassMethodNode = st["node"]
+            owner = n._owner
+            if isinstance(owner, ClassNode):
+                handle = self._actor_handles[id(owner)]
+            elif isinstance(owner, DAGNode):
+                raise _ChannelUnsupported("unsupported method owner")
+            else:
+                handle = owner  # pre-existing ActorHandle
+            args = [classify(a) for a in n._bound_args]
+            kwargs = {k: classify(v) for k, v in n._bound_kwargs.items()}
+            if not any(b[0] != "const" for b in
+                       list(args) + list(kwargs.values())):
+                # A stage with only constant bindings would free-run ahead
+                # of the per-seq lockstep the channel loop executes in.
+                raise _ChannelUnsupported("stage with no data dependency")
+            st.update({"idx": idx, "actor_id": handle._actor_id,
+                       "handle": handle, "method": n._method,
+                       "raw_args": args, "raw_kwargs": kwargs})
+        return {
+            "dag_id": self.dag_id,
+            "depth": self._max_in_flight,
+            "slot_bytes": int(flags.get("RTPU_DAG_SLOT_BYTES")),
+            "stages": stages,
+            "terminal_stages": terminal_stages,
+        }
+
+    def _place_edges(self, plan: Dict[str, Any]) -> None:
+        """Resolve every stage actor to its worker, then assign each edge
+        its transport per consumer: same-node consumers share one slot
+        ring on the producer's host; cross-node consumers each get a
+        persistent raw-tail stream."""
+        wc = self._wc
+        endpoints: Dict[str, Dict[str, Any]] = {
+            "driver": {"node_id": wc.node_id}}
+        for st in plan["stages"]:
+            d = wc.client.request(
+                {"kind": "resolve_actor", "actor_id": st["actor_id"]},
+                timeout=10)
+            if d.get("state") != "alive" or not d.get("direct"):
+                raise RuntimeError(
+                    f"compiled DAG: actor {st['actor_id'][:8]} is not "
+                    f"alive / directly reachable (state={d.get('state')})")
+            info = dict(d["direct"])
+            info["actor_id"] = st["actor_id"]
+            endpoints[f"s{st['idx']}"] = info
+        plan["endpoints"] = endpoints
+
+        # Edge discovery: one edge per producer ("in" for the driver's
+        # input, "e<idx>" per stage), with stage-level consumers — a
+        # diamond is ONE ring with two reader cursors, not two copies.
+        edges: Dict[str, Dict[str, Any]] = {}
+
+        def consume(eid: str, producer: str, consumer_ep: str) -> None:
+            e = edges.setdefault(eid, {"producer": producer,
+                                       "consumers": []})
+            if consumer_ep not in e["consumers"]:
+                e["consumers"].append(consumer_ep)
+
+        for st in plan["stages"]:
+            ep = f"s{st['idx']}"
+
+            def bind(b):
+                if b[0] == "const":
+                    return ("const", b[1])
+                if b[0] == "input":
+                    consume("in", "driver", ep)
+                    return ("chan", "in", b[1])
+                prod = plan["stages"][b[1]]
+                if prod["actor_id"] == st["actor_id"]:
+                    # Same actor: the value never leaves the resident
+                    # loop's memory; no channel, no serialization.
+                    return ("local", b[1])
+                consume(f"e{b[1]}", f"s{b[1]}", ep)
+                return ("chan", f"e{b[1]}", None)
+
+            st["args"] = [bind(b) for b in st["raw_args"]]
+            st["kwargs"] = {k: bind(b) for k, b in st["raw_kwargs"].items()}
+        self._output_edges: List[str] = []
+        for tidx in plan["terminal_stages"]:
+            consume(f"e{tidx}", f"s{tidx}", "driver")
+            self._output_edges.append(f"e{tidx}")
+        for st in plan["stages"]:
+            eid = f"e{st['idx']}"
+            st["out_edge"] = eid if eid in edges else None
+
+        from ray_tpu.core.object_store import SlotRing
+
+        for eid, e in edges.items():
+            prod_node = endpoints[e["producer"]]["node_id"]
+            ring_eps = [c for c in e["consumers"]
+                        if endpoints[c]["node_id"] == prod_node]
+            stream_eps = [c for c in e["consumers"]
+                          if endpoints[c]["node_id"] != prod_node]
+            if len(ring_eps) > SlotRing.MAX_READERS:
+                raise _ChannelUnsupported(
+                    f"edge {eid}: {len(ring_eps)} same-host consumers "
+                    f"exceeds the slot-ring reader table")
+            e["streams"] = stream_eps
+            e["ring"] = ({"name": f"rtpu_ch_{self.dag_id[:12]}{eid}",
+                          "n_readers": len(ring_eps)}
+                         if ring_eps else None)
+            e["ring_idx"] = {c: i for i, c in enumerate(ring_eps)}
+            e.pop("consumers")
+        plan["edges"] = edges
+
+    # -- wiring ------------------------------------------------------------
+
+    def _connect_workers(self, plan: Dict[str, Any]) -> None:
+        """One dedicated long-lived connection per participating worker:
+        dag_install/dag_teardown/dag_status ride it, and so do cross-host
+        driver↔worker channel legs (raw-tail frames), so the driver needs
+        no extra listening socket."""
+        from ray_tpu.core import protocol
+
+        workers: Dict[str, Dict[str, Any]] = {}
+        for ep, info in plan["endpoints"].items():
+            if ep == "driver":
+                continue
+            w = workers.setdefault(
+                info["worker_id"],
+                {"host": info["host"], "port": info["port"]})
+            w.setdefault("endpoints", []).append(ep)
+        plan["workers"] = workers
+        for wid, w in workers.items():
+            self._conns[wid] = self._wc.client.io.call(
+                protocol.connect(w["host"], w["port"],
+                                 handler=self._on_conn_msg,
+                                 name=f"dag-{self.dag_id[:8]}"),
+                timeout=10)
+
+    async def _on_conn_msg(self, conn, msg):
+        if msg.get("kind") != "dag_channel_item":
+            return None
+        inbox = self._inboxes.get((msg["edge"], msg["to"]))
+        if inbox is not None:
+            inbox.push(msg["seq"], msg["vk"], bytes(msg["data"]))
+        return None
+
+    def _install(self, plan: Dict[str, Any]) -> None:
+        wire = {
+            "dag_id": plan["dag_id"], "depth": plan["depth"],
+            "slot_bytes": plan["slot_bytes"],
+            "stages": [{"idx": s["idx"], "actor_id": s["actor_id"],
+                        "method": s["method"], "args": s["args"],
+                        "kwargs": s["kwargs"], "out_edge": s["out_edge"]}
+                       for s in plan["stages"]],
+            "edges": plan["edges"],
+            "endpoints": plan["endpoints"],
+        }
+        futs = [(wid, conn.request_threadsafe(
+            {"kind": "dag_install", "plan": wire}))
+            for wid, conn in self._conns.items()]
+        for wid, f in futs:
+            f.result(15)
+
+    def _open_driver_channels(self, plan: Dict[str, Any]) -> None:
+        # Input edge: the driver is the producer.
+        in_edge = plan["edges"].get("in")
+        if in_edge is not None:
+            from ray_tpu.core.object_store import SlotRing
+
+            ring_writer = None
+            if in_edge["ring"]:
+                ring_writer = channels.ShmEdgeWriter(SlotRing.create(
+                    plan["depth"], plan["slot_bytes"],
+                    in_edge["ring"]["n_readers"],
+                    name=in_edge["ring"]["name"]))
+            targets = []
+            for dst in in_edge["streams"]:
+                conn = self._conns[plan["endpoints"][dst]["worker_id"]]
+                targets.append((conn.send_with_raw_threadsafe, dst))
+            self._input_writer = channels.EdgeWriter(
+                self.dag_id, "in", ring_writer, targets)
+        # Terminal edges: the driver is a consumer.
+        for eid in set(self._output_edges):
+            e = plan["edges"][eid]
+            if "driver" in e["streams"]:
+                inbox = channels.StreamInbox()
+                self._inboxes[(eid, "driver")] = inbox
+                self._terminal_readers[eid] = inbox
+            else:
+                self._terminal_readers[eid] = channels.ShmEdgeReader(
+                    e["ring"]["name"], e["ring_idx"]["driver"])
+
+    # -- driver pump -------------------------------------------------------
+
+    def _pump(self) -> None:
+        """Eagerly drains terminal channels into the result map (so unread
+        results never clog the window), watches for stalls, and probes
+        participant liveness when one appears."""
+        readers = self._terminal_readers
+        slice_s = 0.05 if len(readers) == 1 else 0.002
+        want = len(readers)
+        last_progress = time.monotonic()
+        stall_s = float(flags.get("RTPU_DAG_STALL_S"))
+        while not self._pump_stop.is_set():
+            progressed = False
+            for eid, r in readers.items():
+                try:
+                    item = r.recv(slice_s, stop=self._pump_stop.is_set)
+                except channels.ChannelClosed:
+                    if not self._pump_stop.is_set():
+                        self._fail(DAGTeardownError(
+                            f"compiled DAG {self.dag_id[:8]}: terminal "
+                            f"channel {eid} closed by its producer"))
+                    return
+                if item is None:
+                    continue
+                progressed = True
+                seq, kind, payload = item
+                t0 = None
+                with self._cond:
+                    entry = self._results.setdefault(seq, {})
+                    entry[eid] = (kind, payload)
+                    if len(entry) == want:
+                        self._finished.add(seq)
+                        while self._done_contig in self._finished:
+                            self._done_contig += 1
+                        t0 = self._exec_ts.pop(seq, None)
+                        self._cond.notify_all()
+                if t0 is not None:
+                    _m_execute.observe(time.perf_counter() - t0)
+            if progressed:
+                last_progress = time.monotonic()
+                continue
+            with self._cond:
+                outstanding = self._next_seq - self._done_contig
+            if outstanding == 0:
+                last_progress = time.monotonic()
+            elif time.monotonic() - last_progress > stall_s:
+                if not self._probe():
+                    return
+                last_progress = time.monotonic()
+
+    def _probe(self) -> bool:
+        """Zero progress with work outstanding: ask every participant
+        directly, then double-check actor liveness with the controller.
+        Returns False when the DAG was failed (pump must exit)."""
+        plan = self._plan
+        for wid, conn in self._conns.items():
+            try:
+                r = conn.request_threadsafe(
+                    {"kind": "dag_status", "dag": self.dag_id}).result(3)
+            except Exception as e:
+                self._fail(DAGTeardownError(
+                    f"compiled DAG {self.dag_id[:8]}: participant worker "
+                    f"{wid[:8]} is unreachable ({type(e).__name__}: {e})"))
+                return False
+            if not r.get("known"):
+                self._fail(DAGTeardownError(
+                    f"compiled DAG {self.dag_id[:8]}: worker {wid[:8]} "
+                    f"lost its execution plan (restarted?)"))
+                return False
+            if r.get("failed"):
+                self._fail(DAGTeardownError(
+                    f"compiled DAG {self.dag_id[:8]}: resident loop "
+                    f"failed: {r['failed']}"))
+                return False
+        for ep, info in plan["endpoints"].items():
+            if ep == "driver":
+                continue
+            try:
+                d = self._wc.client.request(
+                    {"kind": "resolve_actor", "actor_id": info["actor_id"],
+                     "wait": 0}, timeout=5)
+            except Exception:
+                continue  # controller hiccup: not evidence of actor death
+            direct = d.get("direct") or {}
+            if (d.get("state") != "alive"
+                    or direct.get("worker_id") != info["worker_id"]):
+                self._fail(DAGTeardownError(
+                    f"compiled DAG {self.dag_id[:8]}: stage actor "
+                    f"{info['actor_id'][:8]} died or moved "
+                    f"(state={d.get('state')}); channels cannot be "
+                    f"re-established — recompile the DAG"))
+                return False
+        return True
+
+    def _fail(self, err: BaseException) -> None:
+        with self._cond:
+            if self._error is None:
+                self._error = err
+            self._cond.notify_all()
+        # Full teardown: drain the window, free channels, release actors'
+        # mailbox threads. Every outstanding ref resolves with the error.
+        self.teardown(kill_actors=False, _already_failed=True)
+
+    # ===================================================== public surface
+
+    def execute(self, *args, **kwargs):
         if self._torn_down:
-            raise RuntimeError("CompiledDAG has been torn down")
+            if self._mode == "channels" and self._error is not None:
+                raise DAGTeardownError(str(self._error)) from self._error
+            raise DAGTeardownError("CompiledDAG has been torn down")
+        if self._mode != "channels":
+            return self._execute_submit(args, kwargs)
+        # InputNode contract, evaluated eagerly so a bad call fails before
+        # a seq is allocated.
+        if args and kwargs:
+            raise TypeError(
+                "DAG execute() got both positional and keyword inputs; "
+                "pass one or the other (use a dict input for named access)")
+        if kwargs:
+            value: Any = kwargs
+        elif len(args) == 1:
+            value = args[0]
+        else:
+            value = args
+        payload = channels.encode_value(value)
+        with self._xlock:
+            with self._cond:
+                while (self._error is None and not self._torn_down
+                       and self._next_seq - self._done_contig
+                       >= self._max_in_flight):
+                    self._cond.wait(0.05)
+                if self._error is not None:
+                    raise DAGTeardownError(
+                        str(self._error)) from self._error
+                if self._torn_down:
+                    raise RuntimeError("CompiledDAG has been torn down")
+                seq = self._next_seq
+                self._next_seq += 1
+                self._exec_ts[seq] = time.perf_counter()
+            if self._input_writer is not None:
+                try:
+                    self._input_writer.write(
+                        seq, channels.KIND_DATA, payload,
+                        stop=lambda: self._torn_down)
+                except channels.ChannelClosed:
+                    err = self._error
+                    raise DAGTeardownError(
+                        "CompiledDAG was torn down mid-execute"
+                        + (f": {err}" if err else "")) from err
+        return ChannelDAGRef(self, seq)
+
+    def _execute_submit(self, args, kwargs) -> CompiledDAGRef:
         while len(self._inflight) >= self._max_in_flight:
             oldest = self._inflight.popleft()
             refs = oldest.ref if isinstance(oldest.ref, list) else [oldest.ref]
@@ -103,18 +602,146 @@ class CompiledDAG:
         self._inflight.append(out)
         return out
 
-    def teardown(self, *, kill_actors: bool = True) -> None:
-        if self._torn_down:
+    def _get_result(self, seq: int, timeout: Optional[float]):
+        from ray_tpu.core.controller import GetTimeoutError
+
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._cond:
+            while seq not in self._finished:
+                if self._error is not None:
+                    raise DAGTeardownError(
+                        str(self._error)) from self._error
+                if self._torn_down:
+                    raise DAGTeardownError(
+                        "CompiledDAG was torn down with this execution "
+                        "outstanding")
+                remaining = (None if deadline is None
+                             else deadline - time.monotonic())
+                if remaining is not None and remaining <= 0:
+                    raise GetTimeoutError(
+                        f"compiled DAG result seq={seq} not ready within "
+                        f"{timeout}s")
+                self._cond.wait(0.05 if remaining is None
+                                else min(0.05, remaining))
+            entry = self._results[seq]
+        values = []
+        for eid in self._output_edges:
+            kind, payload = entry[eid]
+            if kind == channels.KIND_ERROR:
+                raise channels.decode(payload)
+            values.append(channels.decode(payload))
+        if not isinstance(self._output, MultiOutputNode):
+            return values[0]
+        return values
+
+    def teardown(self, *, kill_actors: bool = True,
+                 _already_failed: bool = False) -> None:
+        with self._cond:
+            already = self._torn_down
+            self._torn_down = True
+        if already:
+            # Another thread (typically the pump, via _fail) owns the
+            # teardown; block until it finishes so resources are really
+            # released when this call returns.
+            self._teardown_done.wait(timeout=10)
             return
-        self._torn_down = True
         self._inflight.clear()
-        if kill_actors:
-            for h in self._actor_handles.values():
+        try:
+            if self._mode == "channels":
+                self._teardown_channels(kill_actors=kill_actors,
+                                        notify=True,
+                                        _already_failed=_already_failed)
+            if kill_actors:
+                for h in self._actor_handles.values():
+                    try:
+                        api.kill(h)
+                    except Exception:
+                        pass
+            self._actor_handles.clear()
+        finally:
+            self._teardown_done.set()
+
+    def _teardown_channels(self, *, kill_actors: bool = False,
+                           notify: bool = False,
+                           _already_failed: bool = False) -> None:
+        self._pump_stop.set()
+        with self._cond:
+            self._cond.notify_all()
+        # Tell every participant to stop its resident loops and release
+        # its rings; a dead worker simply errors, its host's segments die
+        # with the process tree / the force-unlink sweep.
+        futs = []
+        for wid, conn in self._conns.items():
+            try:
+                futs.append(conn.request_threadsafe(
+                    {"kind": "dag_teardown", "dag": self.dag_id}))
+            except Exception:
+                pass
+        for f in futs:
+            try:
+                f.result(3)
+            except Exception:
+                pass
+        pump = getattr(self, "_pump_thread", None)
+        if pump is not None and pump is not threading.current_thread():
+            pump.join(timeout=3)
+        if self._input_writer is not None:
+            try:
+                self._input_writer.close()
+            except Exception:
+                pass
+            self._input_writer = None
+        for r in self._terminal_readers.values():
+            if isinstance(r, channels.ShmEdgeReader):
                 try:
-                    api.kill(h)
+                    r.close()
                 except Exception:
                     pass
-        self._actor_handles.clear()
+        self._terminal_readers.clear()
+        for inbox in self._inboxes.values():
+            inbox.close()
+        for conn in self._conns.values():
+            try:
+                self._wc.client.io.call_nowait(conn.close())
+            except Exception:
+                pass
+        self._conns.clear()
+        self._sweep_channel_names()
+        if notify:
+            try:
+                self._wc.client.send_nowait(
+                    {"kind": "dag_torndown", "dag_id": self.dag_id})
+            except Exception:
+                pass
+            _live_delta(-1)
+        if not _already_failed:
+            with self._cond:
+                self._cond.notify_all()
+
+    def _sweep_channel_names(self) -> None:
+        """Defensive last pass: unlink every shm segment and doorbell path
+        the plan could have created on THIS host. Surviving workers clean
+        their own; a SIGKILLed producer leaves its ring, sidecars, and
+        bell sockets behind, and only the driver knows all the names."""
+        import glob
+
+        for edge in self._plan.get("edges", {}).values():
+            ring = edge.get("ring")
+            if not ring:
+                continue
+            name = ring["name"]
+            matches = glob.glob(f"/dev/shm/{name}*")
+            for path in matches:
+                channels._unlink_segment(os.path.basename(path))
+            if not matches:
+                channels._unlink_segment(name)
+            for bell in [channels.writer_bell_path(name)] + [
+                    channels.reader_bell_path(name, i)
+                    for i in range(ring["n_readers"])]:
+                try:
+                    os.unlink(bell)
+                except OSError:
+                    pass
 
     def __enter__(self) -> "CompiledDAG":
         return self

@@ -184,6 +184,16 @@ class ClassMethodNode(DAGNode):
         self._owner = owner  # ClassNode or ActorHandle
         self._method = method
 
+    @property
+    def owner(self):
+        """The ClassNode (deferred actor) or live ActorHandle this method
+        dispatches on — the channel compiler keys stages by it."""
+        return self._owner
+
+    @property
+    def method_name(self) -> str:
+        return self._method
+
     def _upstream(self) -> List[DAGNode]:
         ups = super()._upstream()
         if isinstance(self._owner, DAGNode):
@@ -251,6 +261,12 @@ class InputAttributeNode(DAGNode):
         self._parent = parent
         self._key = key
 
+    @property
+    def key(self):
+        """The selector applied to the execute() input. Channel mode ships
+        the full input once per seq and applies this consumer-side."""
+        return self._key
+
     def _upstream(self) -> List[DAGNode]:
         return [self._parent]
 
@@ -272,6 +288,10 @@ class MultiOutputNode(DAGNode):
     def __init__(self, outputs: List[DAGNode]):
         super().__init__((tuple(outputs),), {})
         self._outputs = list(outputs)
+
+    @property
+    def outputs(self) -> List[DAGNode]:
+        return list(self._outputs)
 
     def _execute_impl(self, memo):
         return [o._execute_memo(memo) for o in self._outputs]

@@ -1,0 +1,347 @@
+"""Worker-side half of compiled-DAG channel execution.
+
+``dag_install`` (pushed over the driver's per-DAG direct connection)
+creates this worker's producer rings, registers stream inboxes, and parks
+one *resident loop* on each participating actor's mailbox thread via the
+``__create__`` closure lane — the same lane actor construction rides, so
+the loop starts strictly after the actor exists and occupies the mailbox
+until teardown (ordinary queued calls wait behind it, preserving the
+actor's single-threaded execution contract).
+
+The loop is transport-blind: it blocks on its input channels (shm ring or
+stream inbox, both exposing ``recv``), runs the bound method, writes the
+result into its output edge, and advances to the next global seq. Errors
+are *values*: a raised exception is encoded as a KIND_ERROR item and flows
+downstream edge-by-edge until it reaches the driver, which surfaces it on
+that seq's ref — the pipeline itself keeps running for later seqs.
+
+Infra failures (torn ring, dead peer, closed driver conn) stop the loop
+and record ``wd.fail``; the driver's stall probe reads it via
+``dag_status`` and tears the whole DAG down with a typed error.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from ray_tpu.core import object_store
+from ray_tpu.dag import channels
+
+
+def _dags(runtime) -> Dict[str, "WorkerDAG"]:
+    d = getattr(runtime, "dag_channels", None)
+    if d is None:
+        d = runtime.dag_channels = {}
+    return d
+
+
+def handle_direct_message(runtime, conn, msg):
+    """Dispatch dag_* kinds arriving on the worker's direct server."""
+    kind = msg["kind"]
+    if kind == "dag_install":
+        return handle_install(runtime, conn, msg)
+    if kind == "dag_teardown":
+        return handle_teardown(runtime, msg)
+    if kind == "dag_status":
+        return handle_status(runtime, msg)
+    if kind == "dag_channel_item":
+        return handle_item(runtime, msg)
+    raise ValueError(f"direct server: unknown kind {kind!r}")
+
+
+def handle_install(runtime, conn, msg):
+    plan = msg["plan"]
+    wd = WorkerDAG(runtime, conn, plan)
+    _dags(runtime)[plan["dag_id"]] = wd
+    wd.setup()
+    return {"ok": True, "worker_id": runtime.worker_id}
+
+
+def handle_teardown(runtime, msg):
+    wd = _dags(runtime).pop(msg["dag"], None)
+    if wd is not None:
+        wd.stop()
+    return {"ok": True}
+
+
+def handle_status(runtime, msg):
+    wd = _dags(runtime).get(msg["dag"])
+    if wd is None:
+        return {"ok": True, "known": False}
+    return {"ok": True, "known": True,
+            "failed": repr(wd.fail) if wd.fail is not None else None,
+            "progress": dict(wd.progress)}
+
+
+def handle_item(runtime, msg):
+    """A raw-tail stream frame landed: route into the (edge, endpoint)
+    inbox. Fire-and-forget (no rid) — a frame for an unknown DAG (already
+    torn down) is dropped, matching the mutable-channel contract that
+    stale items are superseded, never queued."""
+    wd = _dags(runtime).get(msg["dag"])
+    if wd is None:
+        return None
+    inbox = wd.inboxes.get((msg["edge"], msg["to"]))
+    if inbox is not None:
+        inbox.push(msg["seq"], msg["vk"], bytes(msg["data"]))
+    return None
+
+
+class _Err:
+    """Local-edge error marker: a same-actor stage→stage binding whose
+    producer raised carries the encoded payload forward unchanged."""
+
+    __slots__ = ("payload",)
+
+    def __init__(self, payload: bytes):
+        self.payload = payload
+
+
+class WorkerDAG:
+    """Everything this worker holds for one compiled DAG."""
+
+    def __init__(self, runtime, conn, plan: Dict[str, Any]):
+        self.runtime = runtime
+        self.driver_conn = conn
+        self.plan = plan
+        self.dag_id = plan["dag_id"]
+        self.stopped = threading.Event()
+        self.fail: Optional[BaseException] = None
+        self.progress: Dict[int, int] = {}  # stage idx -> last finished seq
+        self.rings: Dict[str, object_store.SlotRing] = {}  # edges I produce
+        self.inboxes: Dict[tuple, channels.StreamInbox] = {}
+        self._senders: Dict[tuple, Any] = {}  # (host, port) -> RawStreamSender
+        self._lock = threading.Lock()
+        self._cleaned: set = set()
+
+    # -- install -----------------------------------------------------------
+
+    def _my_endpoints(self) -> List[str]:
+        wid = self.runtime.worker_id
+        return [ep for ep, info in self.plan["endpoints"].items()
+                if info.get("worker_id") == wid]
+
+    def setup(self) -> None:
+        plan = self.plan
+        mine = set(self._my_endpoints())
+        # Producer rings first: same-host consumers (possibly on other
+        # workers) attach by name with a bounded retry window.
+        for eid, edge in plan["edges"].items():
+            if edge["producer"] in mine and edge.get("ring"):
+                self.rings[eid] = object_store.SlotRing.create(
+                    plan["depth"], plan["slot_bytes"],
+                    edge["ring"]["n_readers"], name=edge["ring"]["name"])
+        # Stream inboxes for every cross-host edge that lands here.
+        by_actor: Dict[str, List[Dict[str, Any]]] = {}
+        for stage in plan["stages"]:
+            ep = f"s{stage['idx']}"
+            if ep not in mine:
+                continue
+            for b in list(stage["args"]) + list(stage["kwargs"].values()):
+                if b[0] == "chan" and ep in plan["edges"][b[1]]["streams"]:
+                    self.inboxes.setdefault(
+                        (b[1], ep), channels.StreamInbox())
+            by_actor.setdefault(stage["actor_id"], []).append(stage)
+        from ray_tpu.core.controller import ActorNotHostedError
+
+        for aid, stages in by_actor.items():
+            mb = self.runtime.actors.get(aid)
+            if mb is None:
+                raise ActorNotHostedError(
+                    f"dag_install: actor {aid[:8]} is not hosted here")
+            stages = sorted(stages, key=lambda s: s["idx"])
+            mb.q.put({"__create__":
+                      (lambda mb=mb, st=stages: self._actor_loop(mb, st))})
+
+    def sender(self, host: str, port: int):
+        """One persistent raw-tail stream per downstream worker, shared by
+        every edge and stage on this worker that targets it."""
+        key = (host, port)
+        with self._lock:
+            s = self._senders.get(key)
+            if s is None:
+                from ray_tpu.core.transfer import RawStreamSender
+
+                s = self._senders[key] = RawStreamSender(host, port)
+            return s
+
+    # -- the resident loop -------------------------------------------------
+
+    def _stop_requested(self) -> bool:
+        return self.stopped.is_set() or self.driver_conn.closed.is_set()
+
+    def _build_stage_io(self, stage):
+        """Readers for each channel edge this stage consumes, writer for
+        the edge it produces (None when only same-actor locals consume)."""
+        plan = self.plan
+        ep = f"s{stage['idx']}"
+        readers: Dict[str, Any] = {}
+        for b in list(stage["args"]) + list(stage["kwargs"].values()):
+            if b[0] != "chan" or b[1] in readers:
+                continue
+            eid = b[1]
+            edge = plan["edges"][eid]
+            if ep in edge["streams"]:
+                readers[eid] = self.inboxes[(eid, ep)]
+            else:
+                readers[eid] = channels.ShmEdgeReader(
+                    edge["ring"]["name"], edge["ring_idx"][ep])
+        writer = None
+        eid = stage.get("out_edge")
+        if eid is not None:
+            edge = plan["edges"][eid]
+            ring_writer = None
+            if eid in self.rings:
+                ring_writer = channels.ShmEdgeWriter(self.rings[eid])
+            targets = []
+            for dst in edge["streams"]:
+                if dst == "driver":
+                    targets.append(
+                        (self.driver_conn.send_with_raw_threadsafe, dst))
+                else:
+                    info = plan["endpoints"][dst]
+                    s = self.sender(info["host"], info["port"])
+                    targets.append((s.send, dst))
+            writer = channels.EdgeWriter(self.dag_id, eid,
+                                         ring_writer, targets)
+        return readers, writer
+
+    def _actor_loop(self, mb, stages: List[Dict[str, Any]]) -> None:
+        """Runs ON the actor's mailbox thread until teardown."""
+        from ray_tpu.core import context as ctx
+
+        ctx.task_local.actor_id = mb.actor_id
+        io = []
+        try:
+            for stage in stages:
+                io.append(self._build_stage_io(stage))
+        except Exception as e:
+            self.fail = self.fail or e
+            self._cleanup(io)
+            return
+        local_vals: Dict[int, Any] = {}
+        seq = 0
+        try:
+            while not self._stop_requested():
+                for stage, (readers, writer) in zip(stages, io):
+                    if not self._run_stage(mb, stage, readers, writer,
+                                           seq, local_vals):
+                        return
+                    self.progress[stage["idx"]] = seq
+                seq += 1
+        except channels.ChannelClosed:
+            pass  # upstream tore down first; the driver handles fallout
+        except BaseException as e:
+            self.fail = self.fail or e
+        finally:
+            self._cleanup(io)
+
+    def _run_stage(self, mb, stage, readers, writer, seq,
+                   local_vals) -> bool:
+        err_payload: Optional[bytes] = None
+        chan_vals: Dict[str, Any] = {}
+        for eid, reader in readers.items():
+            while True:
+                item = reader.recv(0.1, stop=self._stop_requested)
+                if item is not None:
+                    break
+                if self._stop_requested():
+                    raise channels.ChannelClosed("teardown")
+            got_seq, kind, payload = item
+            if got_seq != seq:
+                raise RuntimeError(
+                    f"dag {self.dag_id[:8]} edge {eid}: expected seq "
+                    f"{seq}, got {got_seq} (torn channel)")
+            if kind == channels.KIND_ERROR:
+                if err_payload is None:
+                    err_payload = payload
+            else:
+                chan_vals[eid] = channels.decode(payload)
+
+        def resolve(b):
+            nonlocal err_payload
+            if b[0] == "const":
+                return b[1]
+            if b[0] == "local":
+                v = local_vals.get(b[1])
+                if isinstance(v, _Err):
+                    err_payload = err_payload or v.payload
+                    return None
+                return v
+            v = chan_vals.get(b[1])
+            if b[1] not in chan_vals:
+                return None  # an upstream error consumed this edge's value
+            if b[2] is not None:
+                return channels.apply_selector(v, b[2])
+            return v
+
+        args = [resolve(b) for b in stage["args"]]
+        kwargs = {k: resolve(b) for k, b in stage["kwargs"].items()}
+        if err_payload is not None:
+            out_kind, out_payload = channels.KIND_ERROR, err_payload
+            local_vals[stage["idx"]] = _Err(err_payload)
+        else:
+            try:
+                result = getattr(mb.instance, stage["method"])(
+                    *args, **kwargs)
+                out_kind = channels.KIND_DATA
+                out_payload = channels.encode_value(result)
+                local_vals[stage["idx"]] = result
+            except BaseException as e:
+                out_kind = channels.KIND_ERROR
+                out_payload = channels.encode_error(e)
+                local_vals[stage["idx"]] = _Err(out_payload)
+        if writer is not None:
+            writer.write(seq, out_kind, out_payload,
+                         stop=self._stop_requested)
+        return True
+
+    # -- teardown ----------------------------------------------------------
+
+    def stop(self) -> None:
+        """Called from the io loop (dag_teardown) or failure paths: flips
+        the stop flag and pokes every blocking wait. Resident loops exit
+        within one wait slice and release their channels; a timer sweeps
+        anything a never-started loop would have owned."""
+        self.stopped.set()
+        for inbox in self.inboxes.values():
+            inbox.close()
+        threading.Timer(5.0, self._force_unlink).start()
+
+    def _cleanup(self, io) -> None:
+        for readers, writer in io:
+            for r in readers.values():
+                if isinstance(r, channels.ShmEdgeReader):
+                    try:
+                        r.close()
+                    except Exception:
+                        pass
+            if writer is not None:
+                try:
+                    writer.close()  # marks closed + unlinks the ring
+                except Exception:
+                    pass
+                if writer.ring_writer is not None:
+                    with self._lock:
+                        self._cleaned.add(writer.edge_id)
+        with self._lock:
+            senders, self._senders = dict(self._senders), {}
+        for s in senders.values():
+            try:
+                s.close()
+            except Exception:
+                pass
+
+    def _force_unlink(self) -> None:
+        """Defensive sweep: unlink producer rings whose loop never ran
+        (actor died before the closure executed) or died uncleanly."""
+        with self._lock:
+            leftovers = {eid: ring for eid, ring in self.rings.items()
+                         if eid not in self._cleaned}
+            self._cleaned.update(leftovers)
+        for ring in leftovers.values():
+            try:
+                ring.unlink()
+            except Exception:
+                pass

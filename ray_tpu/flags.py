@@ -277,6 +277,32 @@ _define("RTPU_WORKER_SERVE", bool, True,
         "fall back to the host agent when the producer is gone. 0 routes "
         "every cross-host pull through the host agent.")
 
+# -- compiled DAG channels ---------------------------------------------------
+_define("RTPU_DAG_CHANNELS", bool, True,
+        "Compiled DAGs execute over reusable mutable channels: one shm "
+        "slot ring (same-host edges) or persistent raw-tail stream "
+        "(cross-host edges) per DAG edge, with a resident per-actor loop "
+        "on the worker, so steady-state execute() is a header write + one "
+        "doorbell with zero controller involvement (reference: aDAG's "
+        "MutableObjectManager channels, SURVEY.md §2.2). 0 falls back to "
+        "per-execute task submission through the normal submit path.")
+_define("RTPU_DAG_SLOT_BYTES", int, 128 * 1024,
+        "Payload capacity of one shm channel slot. A value that pickles "
+        "larger than this ships via a one-off sidecar shm segment named "
+        "in the slot (still zero controller involvement); size it to the "
+        "common per-edge payload so the sidecar path stays cold.")
+_define("RTPU_DAG_SPIN_US", int, 200,
+        "How long a channel reader/writer spins on the seqno header "
+        "before arming its doorbell and blocking — spinning covers the "
+        "common back-to-back case without syscalls; 0 blocks immediately "
+        "(right for oversubscribed 1-core hosts).")
+_define("RTPU_DAG_STALL_S", float, 2.0,
+        "How long a compiled-DAG get() tolerates zero channel progress "
+        "before probing participant liveness (direct dag_status pings, "
+        "then resolve_actor). Probes run only when stalled, so the "
+        "steady state stays controller-free; a dead/restarted "
+        "participant tears the DAG down with DAGTeardownError.")
+
 # -- object store / spilling -------------------------------------------------
 _define("RTPU_NATIVE_STORE", bool, True,
         "Use the C++ shm arena when available (0 forces pickle fallback).")

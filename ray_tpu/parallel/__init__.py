@@ -13,6 +13,16 @@ from .mesh import (
     single_device_mesh,
 )
 from .pipeline import pipeline_apply, pipeline_loss_fn
+
+
+def __getattr__(name):
+    # mpmd imports ray_tpu (actor API) — lazy so `import ray_tpu.parallel`
+    # from inside a worker stays cheap and cycle-free.
+    if name in ("MPMDPipeline", "StageFactory"):
+        from ray_tpu.parallel import mpmd
+
+        return getattr(mpmd, name)
+    raise AttributeError(name)
 from .sharding import (
     DEFAULT_RULES,
     RULES_DP,
@@ -27,6 +37,8 @@ from .sharding import (
 )
 
 __all__ = [
+    "MPMDPipeline",
+    "StageFactory",
     "pipeline_apply",
     "pipeline_loss_fn",
     "AXIS_ORDER",

@@ -42,6 +42,15 @@ def list_objects(limit: int = 1000) -> List[Dict[str, Any]]:
     return _req({"kind": "list_state", "what": "objects", "limit": limit})
 
 
+def list_compiled_dags(limit: int = 1000) -> List[Dict[str, Any]]:
+    """Compiled DAGs with live channel plans: stages (actor + method per
+    pipeline position), per-edge transport (shm ring vs raw-tail stream),
+    and the in-flight window depth. The controller only sees compile and
+    teardown, so this is the registry of pipelines whose steady-state
+    dispatch bypasses it entirely."""
+    return _req({"kind": "list_state", "what": "dags", "limit": limit})
+
+
 def list_placement_groups(limit: int = 1000) -> List[Dict[str, Any]]:
     """Reference: `ray list placement-groups` (util/state/api.py) — id,
     name, state, strategy, and per-bundle resources/placement."""

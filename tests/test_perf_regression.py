@@ -346,6 +346,97 @@ def test_telemetry_disabled_path_overhead(ray_start_regular, monkeypatch):
     assert dt < 2.0, f"disabled profile RPC took {dt:.1f}s"
 
 
+def test_dag_channels_disabled_path_overhead(ray_start_regular,
+                                             monkeypatch):
+    """Compiled-DAG channel guard (mirrors the RTPU_TASK_EVENTS guard):
+    with RTPU_DAG_CHANNELS=0 compile() never analyzes the graph for
+    channels — no rings, no resident loops, no per-DAG connections — and
+    execute() is exactly the old submit path, which must hold the same
+    actor-call-derived floor as before the channel plane existed."""
+    monkeypatch.setenv("RTPU_DAG_CHANNELS", "0")
+    from ray_tpu.dag import InputNode
+
+    @ray_tpu.remote
+    class A:
+        def f(self, x):
+            return x
+
+    a = A.bind()
+    with InputNode() as inp:
+        dag = a.f.bind(inp)
+    compiled = dag.experimental_compile(max_in_flight=8)
+    try:
+        assert compiled._mode == "submit"
+        compiled.execute(0).get(timeout=30)  # warm the route
+        t0 = time.perf_counter()
+        refs = [compiled.execute(i) for i in range(100)]
+        out = [r.get(timeout=30) for r in refs]
+        dt = time.perf_counter() - t0
+        assert out == list(range(100))
+        assert 100 / dt > 30, \
+            f"submit-path DAG throughput {100/dt:.0f}/s below floor"
+    finally:
+        compiled.teardown()
+
+
+@pytest.mark.slow
+def test_dag_channel_dispatch_beats_submit_5x(ray_start_regular,
+                                              monkeypatch):
+    """Channel-execution win guard: per-step cost through a 3-stage
+    pipeline must beat the RTPU_DAG_CHANNELS=0 submit path by >= 5x on
+    the 1-core container. BENCH_r08.json records the full measured ratio
+    (>= 10x acceptance); the in-test floor halves it for CI noise.
+    Slow-marked like the 2x-r05 floor: full waves on a loaded host are
+    too noisy for tier-1."""
+    import os
+
+    from ray_tpu.dag import InputNode
+
+    if (os.cpu_count() or 1) <= 2:
+        monkeypatch.setenv("RTPU_DAG_SPIN_US", "0")
+
+    @ray_tpu.remote
+    class Add:
+        def __init__(self, k):
+            self.k = k
+
+        def step(self, x):
+            return x + self.k
+
+    def build():
+        a, b, c = Add.bind(1), Add.bind(10), Add.bind(100)
+        with InputNode() as inp:
+            dag = c.step.bind(b.step.bind(a.step.bind(inp)))
+        return dag.experimental_compile(max_in_flight=32)
+
+    def step_us(compiled, n):
+        refs = [compiled.execute(i) for i in range(16)]
+        [r.get(timeout=60) for r in refs]
+        best = None
+        for _ in range(3):
+            t0 = time.perf_counter()
+            refs = [compiled.execute(i) for i in range(n)]
+            [r.get(timeout=120) for r in refs]
+            dt = time.perf_counter() - t0
+            best = dt if best is None else min(best, dt)
+        return best / n * 1e6
+
+    compiled = build()
+    assert compiled._mode == "channels"
+    chan_us = step_us(compiled, 500)
+    compiled.teardown()
+
+    monkeypatch.setenv("RTPU_DAG_CHANNELS", "0")
+    sub = build()
+    assert sub._mode == "submit"
+    submit_us = step_us(sub, 100)
+    sub.teardown()
+
+    assert submit_us / chan_us >= 5, \
+        f"channel dispatch {chan_us:.0f}us/step only " \
+        f"{submit_us/chan_us:.1f}x better than submit {submit_us:.0f}us/step"
+
+
 def test_large_object_bandwidth_floor(ray_start_regular):
     arr = np.ones(4 * 1024 * 1024, dtype=np.float64)  # 32MB
     t0 = time.perf_counter()
