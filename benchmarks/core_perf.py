@@ -151,6 +151,49 @@ def dag_metrics(results):
         results.append(r)
 
 
+def dag_recovery_metrics(results):
+    """Self-healing compiled DAGs (r09): SIGKILL the middle stage's worker
+    of an idle-but-installed 3-stage pipeline, then measure kill -> first
+    post-recovery result. Covers stall detection (RTPU_DAG_STALL_S),
+    quiesce, checkpointed stage restart, affected-edge rebuild, and
+    seqno-exact replay end to end."""
+    import signal
+
+    from ray_tpu.core import context as ctx
+    from ray_tpu.parallel import MPMDPipeline
+
+    def factory(idx, n, mesh):
+        return lambda x: x + 1
+
+    p = MPMDPipeline([factory] * 3, max_in_flight=4,
+                     stage_options=[{"checkpoint_every_n": 1}] * 3)
+    assert p.mode == "channels"
+    outs = p.run(list(range(8)))  # warm + prove the route
+    assert outs == [i + 3 for i in range(8)]
+
+    victim = p._compiled._plan["endpoints"]["s1"]["worker_id"]
+    rows = ctx.get_worker_context().client.request(
+        {"kind": "list_state", "what": "workers"})
+    pid = next(w["pid"] for w in rows if w["worker_id"] == victim)
+    t0 = time.perf_counter()
+    os.kill(pid, signal.SIGKILL)
+    refs = [p.submit(100 + i) for i in range(4)]
+    first = refs[0].get(timeout=120)
+    dt = time.perf_counter() - t0
+    assert first == 103
+    assert [r.get(timeout=60) for r in refs[1:]] == [104, 105, 106]
+    recoveries = p.recoveries
+    p.teardown()
+    assert recoveries >= 1
+
+    r = {"metric": "dag_recovery_s", "value": round(dt, 3), "unit": "s",
+         "recoveries": recoveries, "cause": "worker_killed",
+         "note": "kill -> first post-recovery result; includes the "
+                 "RTPU_DAG_STALL_S detection window"}
+    print(json.dumps(r), flush=True)
+    results.append(r)
+
+
 def mpmd_metrics(results):
     """MPMD pipeline flagship: per-microbatch completion gap with channel
     overlap vs the submit baseline. Stages do real (numpy) work so the gap
@@ -205,7 +248,7 @@ def mpmd_metrics(results):
 
 
 def dag_main():
-    """Just the compiled-DAG + MPMD section (BENCH_r08.json)."""
+    """Just the compiled-DAG + MPMD + recovery section (BENCH_r09.json)."""
     results = []
     ray_tpu.init(num_cpus=4)
 
@@ -217,6 +260,8 @@ def dag_main():
     settle_leases()
     run_metric(results, "dag_dispatch_us", lambda: dag_metrics(results))
     run_metric(results, "mpmd_gap_us", lambda: mpmd_metrics(results))
+    run_metric(results, "dag_recovery_s",
+               lambda: dag_recovery_metrics(results))
     ray_tpu.shutdown()
     return results
 
@@ -460,7 +505,7 @@ def main():
 if __name__ == "__main__":
     if "--dag-only" in sys.argv:
         rs = dag_main()
-        with open(__file__.replace("core_perf.py", "BENCH_r08.json"),
+        with open(__file__.replace("core_perf.py", "BENCH_r09.json"),
                   "w") as f:
             json.dump({r["metric"]: r for r in rs}, f, indent=1)
     else:

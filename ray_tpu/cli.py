@@ -170,14 +170,18 @@ def cmd_status(args) -> int:
     # operator can see which pipelines hold resident actor loops.
     dags = state.get("compiled_dags") or {}
     if dags:
-        print(f"{'COMPILED DAG':14} {'STAGES':>6} {'DEPTH':>6}  EDGES",
-              file=sys.stderr)
+        print(f"{'COMPILED DAG':14} {'STAGES':>6} {'DEPTH':>6} "
+              f"{'RECOV':>6}  EDGES", file=sys.stderr)
         for did, d in sorted(dags.items()):
             kinds = d.get("edges") or {}
             summary = ",".join(
                 f"{eid}:{kind}" for eid, kind in sorted(kinds.items()))
+            recov = str(d.get("recoveries", 0))
+            if d.get("recovering"):
+                recov += "*"  # a recovery is in flight right now
             print(f"{did[:12]:14} {d.get('stages', 0):>6} "
-                  f"{d.get('depth', 0):>6}  {summary}", file=sys.stderr)
+                  f"{d.get('depth', 0):>6} {recov:>6}  {summary}",
+                  file=sys.stderr)
         print(file=sys.stderr)
     print(json.dumps(state, indent=1, default=str))
     # Quote recent hang/straggler findings: the watchdog's whole point is

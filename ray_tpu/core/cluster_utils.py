@@ -72,7 +72,11 @@ class Cluster:
         deadline = time.monotonic() + timeout
         while time.monotonic() < deadline:
             state = wc.client.request({"kind": "cluster_state"})
-            new = [n for n in state["nodes"] if n["node_id"] not in before]
+            # The head's own row can register concurrently with the agent:
+            # it must never be mistaken for the node we just spawned.
+            new = [n for n in state["nodes"]
+                   if n["node_id"] not in before
+                   and (n.get("labels") or {}).get("head") != "1"]
             if new:
                 return new[0]["node_id"]
             if proc.poll() is not None:

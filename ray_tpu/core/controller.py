@@ -2564,6 +2564,54 @@ class Controller:
         self.compiled_dags.pop(msg["dag_id"], None)
         return {"ok": True}
 
+    async def _h_dag_recovery(self, conn, msg):
+        """A driver's self-healing pipeline reports a recovery phase
+        transition (participant died / rebuilding / resumed / gave up).
+        Bookkeeping + events only — the healing itself is driver-driven."""
+        dag_id = msg["dag_id"]
+        phase = msg.get("phase")
+        d = self.compiled_dags.get(dag_id)
+        if d is not None:
+            if phase == "died":
+                d["recovering"] = True
+            elif phase == "recovering":
+                d["recovering"] = True
+            elif phase == "recovered":
+                d["recovering"] = False
+                d["recoveries"] = int(d.get("recoveries", 0)) + 1
+                d["last_recovery_s"] = float(msg.get("duration_s", 0.0))
+                d["last_cause"] = msg.get("cause")
+            elif phase == "failed":
+                d["recovering"] = False
+                d["recovery_failures"] = (
+                    int(d.get("recovery_failures", 0)) + 1)
+        actors = msg.get("actors") or []
+        short = ",".join(a[:8] for a in actors) or "?"
+        cause = msg.get("cause", "?")
+        if phase == "died":
+            self._emit_event(
+                "WARNING", "DAG_PARTICIPANT_DIED",
+                f"compiled DAG {dag_id[:8]}: stage actor(s) {short} died "
+                f"({cause}); pausing pipeline for in-place recovery")
+        elif phase == "recovering":
+            self._emit_event(
+                "INFO", "DAG_RECOVERING",
+                f"compiled DAG {dag_id[:8]}: quiescing survivors, "
+                f"restarting {short}, rebuilding affected channels")
+        elif phase == "recovered":
+            self._emit_event(
+                "INFO", "DAG_RECOVERED",
+                f"compiled DAG {dag_id[:8]}: recovered from {cause} in "
+                f"{float(msg.get('duration_s', 0.0)):.2f}s "
+                f"(stage actor(s) {short} restarted, channels rebuilt, "
+                f"retained items replayed)")
+        elif phase == "failed":
+            self._emit_event(
+                "ERROR", "DAG_RECOVERY_FAILED",
+                f"compiled DAG {dag_id[:8]}: recovery from {cause} "
+                f"failed; tearing the pipeline down")
+        return {"ok": True}
+
     async def _h_get_named_actor(self, conn, msg):
         key = (msg.get("namespace", "default"), msg["name"])
         aid = self.named_actors.get(key)
@@ -3040,6 +3088,10 @@ class Controller:
                     "edges": dict(d.get("edges", {})),
                     "depth": d.get("depth", 0),
                     "since": d.get("since", 0.0),
+                    "recoveries": d.get("recoveries", 0),
+                    "recovering": d.get("recovering", False),
+                    "last_recovery_s": d.get("last_recovery_s"),
+                    "last_cause": d.get("last_cause"),
                 }
                 for d in list(self.compiled_dags.values())[:limit]
             ]
@@ -3950,7 +4002,9 @@ class Controller:
                 did: {"stages": len(d.get("stages", ())),
                       "edges": d.get("edges", {}),
                       "depth": d.get("depth", 0),
-                      "since": d.get("since", 0.0)}
+                      "since": d.get("since", 0.0),
+                      "recoveries": d.get("recoveries", 0),
+                      "recovering": d.get("recovering", False)}
                 for did, d in self.compiled_dags.items()
             },
         }

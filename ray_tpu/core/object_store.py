@@ -676,7 +676,7 @@ class SlotRing:
     (dag/channels.py uses it for inline-pickle vs sidecar vs error)."""
 
     MAX_READERS = 8
-    _RHDR = 48                       # fixed header bytes before reader table
+    _RHDR = 64                       # fixed header bytes before reader table
     _SLOTS_OFF = _RHDR + 16 * MAX_READERS
 
     def __init__(self, seg: shared_memory.SharedMemory, created: bool):
@@ -692,7 +692,12 @@ class SlotRing:
     # -- lifecycle ---------------------------------------------------------
     @classmethod
     def create(cls, depth: int, slot_size: int, n_readers: int,
-               name: Optional[str] = None) -> "SlotRing":
+               name: Optional[str] = None, epoch: int = 0, base: int = 0,
+               reader_starts: Optional[List[int]] = None) -> "SlotRing":
+        """`epoch`/`base`/`reader_starts` exist for DAG recovery: a rebuilt
+        ring starts mid-stream (write_seq=base, each reader's cursor at the
+        first seqno it still needs) under a bumped epoch so a stale cursor
+        can never be satisfied by the wrong incarnation."""
         if n_readers > cls.MAX_READERS:
             raise ValueError(
                 f"slot ring supports at most {cls.MAX_READERS} same-host "
@@ -703,9 +708,16 @@ class SlotRing:
         seg = shared_memory.SharedMemory(name=name, create=True, size=total)
         _untrack(name)
         seg.buf[:cls._SLOTS_OFF] = bytes(cls._SLOTS_OFF)
-        _U64.pack_into(seg.buf, 16, depth)
+        _U64.pack_into(seg.buf, 0, base)
         _U64.pack_into(seg.buf, 24, slot_size)
         _U64.pack_into(seg.buf, 32, n_readers)
+        _U64.pack_into(seg.buf, 48, epoch)
+        for i in range(n_readers):
+            start = base if reader_starts is None else reader_starts[i]
+            _U64.pack_into(seg.buf, cls._RHDR + 16 * i, start)
+        # depth is the attachers' readiness gate — publish it last so a
+        # racing attach never observes cursors/epoch mid-initialization.
+        _U64.pack_into(seg.buf, 16, depth)
         return cls(seg, created=True)
 
     @classmethod
@@ -748,8 +760,14 @@ class SlotRing:
     def mark_closed(self) -> None:
         _U64.pack_into(self._seg.buf, 8, 1)
 
+    def epoch(self) -> int:
+        return _U64.unpack_from(self._seg.buf, 48)[0]
+
     def read_seq(self, idx: int) -> int:
         return _U64.unpack_from(self._seg.buf, self._RHDR + 16 * idx)[0]
+
+    def set_read_seq(self, idx: int, seq: int) -> None:
+        _U64.pack_into(self._seg.buf, self._RHDR + 16 * idx, seq)
 
     def min_read_seq(self) -> int:
         return min(self.read_seq(i) for i in range(self.n_readers))

@@ -1076,8 +1076,17 @@ class WorkerRuntime:
                 lambda: fut.done() or fut.set_result(payload))
 
         # Rides the mailbox's closure lane (same as __init__), so it runs
-        # strictly after every call queued before the migration began.
-        mb.q.put({"__create__": snap})
+        # strictly after every call queued before the migration began. A
+        # compiled-DAG resident loop owns the mailbox thread and never
+        # drains that lane — hand the closure to the loop instead; it runs
+        # it between microbatches (a seq-consistent point) and parks.
+        routed = False
+        for wd in self.dag_channels.values():
+            if wd.request_snapshot(actor_id, snap):
+                routed = True
+                break
+        if not routed:
+            mb.q.put({"__create__": snap})
         try:
             return await asyncio.wait_for(fut, timeout=8.0)
         except asyncio.TimeoutError:
@@ -1646,10 +1655,16 @@ class WorkerRuntime:
                     # exactly-once journal intact.
                     mb.instance = rec["instance"]
                     mb.ckpt_epoch = int(rec.get("epoch", 0))
-                    if mb.replay and rec.get("journal"):
+                    if rec.get("journal"):
+                        # Call-replay dedup entries only matter when replay
+                        # is armed, but __dag__* entries (a compiled DAG's
+                        # per-stage seq journal) must survive the restore
+                        # regardless — DAG recovery resumes from them.
                         with mb._seq_lock:
-                            mb.journal = {c: dict(e) for c, e
-                                          in rec["journal"].items()}
+                            mb.journal = {
+                                c: dict(e)
+                                for c, e in rec["journal"].items()
+                                if mb.replay or c.startswith("__dag__")}
                     restored_epoch = mb.ckpt_epoch
                 else:
                     cls = self._load_function(spec["func_id"])

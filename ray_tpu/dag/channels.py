@@ -302,9 +302,11 @@ class ShmEdgeReader:
     """One consumer cursor on a same-host edge's SlotRing."""
 
     def __init__(self, ring_name: str, idx: int,
-                 attach_timeout: float = 10.0):
+                 attach_timeout: float = 10.0,
+                 expect_epoch: Optional[int] = None):
         self.idx = idx
-        self.ring = _attach_retry(ring_name, attach_timeout)
+        self.ring = _attach_retry(ring_name, attach_timeout,
+                                  expect_epoch=expect_epoch)
         self._bell = Doorbell(reader_bell_path(ring_name, idx))
         self._spin_us = int(flags.get("RTPU_DAG_SPIN_US"))
 
@@ -347,17 +349,22 @@ class ShmEdgeReader:
         self.ring.close()
 
 
-def _attach_retry(name: str, timeout: float) -> object_store.SlotRing:
+def _attach_retry(name: str, timeout: float,
+                  expect_epoch: Optional[int] = None
+                  ) -> object_store.SlotRing:
     """Attach to a peer-created ring. The producer creates it during
-    dag_install; install order across workers is unordered, so consumers
-    tolerate a startup window."""
+    dag_install (or a recovery rebuild); install order across workers is
+    unordered, so consumers tolerate a startup window. ``expect_epoch``
+    rejects a stale incarnation of the ring: a rebuilt reader must never
+    have its cursor satisfied by the previous epoch's segment."""
     deadline = time.monotonic() + timeout
     while True:
         try:
             ring = object_store.SlotRing.attach(name)
             # The creator zero-fills then writes the header; an attach
             # landing inside that window sees depth=0 — not ready yet.
-            if ring.depth > 0 and ring.n_readers > 0:
+            if ring.depth > 0 and ring.n_readers > 0 and (
+                    expect_epoch is None or ring.epoch() == expect_epoch):
                 return ring
             ring.close()
         except FileNotFoundError:
@@ -434,27 +441,71 @@ class EdgeWriter:
     consumers share it) plus one stream send per cross-host consumer.
 
     Streams go first — they never block — then the ring write, which may
-    wait on the in-flight window."""
+    wait on the in-flight window.
+
+    ``retain`` keeps the last N (seq, kind, payload) items in a deque
+    (appended BEFORE any transport touches them) so DAG recovery can
+    replay everything a rebuilt/restarted consumer has not yet applied.
+    ``epoch`` rides every stream frame so a consumer that survived a
+    rebuild can drop frames from a superseded incarnation of the edge."""
 
     def __init__(self, dag_id: str, edge_id: str,
                  ring_writer: Optional[ShmEdgeWriter] = None,
                  stream_targets: Optional[
                      List[Tuple[Callable[[Dict[str, Any], bytes], None],
-                                str]]] = None):
+                                str]]] = None,
+                 retain: int = 0, epoch: int = 0):
         self.dag_id = dag_id
         self.edge_id = edge_id
         self.ring_writer = ring_writer
         self.stream_targets = list(stream_targets or ())
+        self.retained: Optional[deque] = (
+            deque(maxlen=retain) if retain > 0 else None)
+        self.epoch = epoch
+        self.aborted = False  # recovery retired this writer mid-write
 
     def write(self, seq: int, kind: int, payload: bytes,
               stop: Optional[Callable[[], bool]] = None) -> None:
+        if self.retained is not None:
+            # An aborted-then-retried write (quiesce interrupted the ring
+            # leg) must not append the same seq twice.
+            if not (self.retained and self.retained[-1][0] == seq):
+                self.retained.append((seq, kind, payload))
         for send, endpoint in self.stream_targets:
-            send({"kind": "dag_channel_item", "dag": self.dag_id,
-                  "edge": self.edge_id, "to": endpoint, "seq": seq,
-                  "vk": kind}, payload)
+            try:
+                send({"kind": "dag_channel_item", "dag": self.dag_id,
+                      "edge": self.edge_id, "to": endpoint, "seq": seq,
+                      "vk": kind, "epoch": self.epoch}, payload)
+            except Exception:
+                if self.retained is None:
+                    raise  # fail-fast semantics (RTPU_DAG_RECOVERY=0)
+                # Dead peer mid-pipeline: the item is retained, recovery
+                # replays it once the edge is rebuilt.
+                continue
             _BYTES.inc(len(payload), {"edge_kind": "stream"})
         if self.ring_writer is not None:
             self.ring_writer.write(seq, kind, payload, stop)
+
+    def replay(self, needs: Dict[str, int], ring_base: Optional[int],
+               stop: Optional[Callable[[], bool]] = None) -> None:
+        """Recovery re-delivery: push every retained item each consumer
+        still needs. Stream targets filter per-endpoint on ``needs``; the
+        rebuilt ring (created with write_seq == ring_base) takes every
+        retained item from ring_base up, in order."""
+        for seq, kind, payload in list(self.retained or ()):
+            for send, endpoint in self.stream_targets:
+                if seq >= needs.get(endpoint, seq + 1):
+                    try:
+                        send({"kind": "dag_channel_item",
+                              "dag": self.dag_id, "edge": self.edge_id,
+                              "to": endpoint, "seq": seq, "vk": kind,
+                              "epoch": self.epoch}, payload)
+                    except Exception:
+                        continue  # double failure; the stall probe re-runs
+                    _BYTES.inc(len(payload), {"edge_kind": "stream"})
+            if (self.ring_writer is not None and ring_base is not None
+                    and seq >= ring_base):
+                self.ring_writer.write(seq, kind, payload, stop)
 
     def close(self) -> None:
         if self.ring_writer is not None:

@@ -63,6 +63,18 @@ _m_execute = um.Histogram(
     description="Compiled-DAG end-to-end step latency: input channel "
                 "write to final result available at the driver",
     boundaries=(0.0001, 0.001, 0.01, 0.1, 1.0, 10.0))
+_m_recoveries = um.Counter(
+    "rtpu_dag_recoveries_total",
+    description="Compiled-DAG in-place recoveries completed (stage "
+                "restarted, affected channels rebuilt, retained items "
+                "replayed), by detected cause",
+    tag_keys=("cause",))
+_m_recovery_s = um.Histogram(
+    "rtpu_dag_recovery_seconds",
+    description="Compiled-DAG recovery latency: participant death "
+                "detected to pipeline resumed with channels rebuilt and "
+                "retained items replayed",
+    boundaries=(0.1, 0.5, 1.0, 2.0, 5.0, 10.0, 30.0, 60.0))
 
 _live_lock = threading.Lock()
 _live_count = 0
@@ -179,6 +191,9 @@ class CompiledDAG:
         self._error: Optional[BaseException] = None
         self._xlock = threading.Lock()
         self._pump_stop = threading.Event()
+        self._recovering = False
+        self._recovery_count = 0
+        self._terminal_next: Dict[str, int] = {}  # edge -> next unseen seq
         try:
             self._connect_workers(plan)
             self._install(plan)
@@ -358,6 +373,7 @@ class CompiledDAG:
                           "n_readers": len(ring_eps)}
                          if ring_eps else None)
             e["ring_idx"] = {c: i for i, c in enumerate(ring_eps)}
+            e["epoch"] = 0
             e.pop("consumers")
         plan["edges"] = edges
 
@@ -389,13 +405,18 @@ class CompiledDAG:
     async def _on_conn_msg(self, conn, msg):
         if msg.get("kind") != "dag_channel_item":
             return None
+        edge = self._plan["edges"].get(msg["edge"])
+        if edge is not None and int(msg.get("epoch", 0)) != int(
+                edge.get("epoch", 0)):
+            return None  # frame from a superseded incarnation of the edge
         inbox = self._inboxes.get((msg["edge"], msg["to"]))
         if inbox is not None:
             inbox.push(msg["seq"], msg["vk"], bytes(msg["data"]))
         return None
 
-    def _install(self, plan: Dict[str, Any]) -> None:
-        wire = {
+    @staticmethod
+    def _wire_plan(plan: Dict[str, Any]) -> Dict[str, Any]:
+        return {
             "dag_id": plan["dag_id"], "depth": plan["depth"],
             "slot_bytes": plan["slot_bytes"],
             "stages": [{"idx": s["idx"], "actor_id": s["actor_id"],
@@ -405,11 +426,21 @@ class CompiledDAG:
             "edges": plan["edges"],
             "endpoints": plan["endpoints"],
         }
+
+    def _install(self, plan: Dict[str, Any]) -> None:
+        wire = self._wire_plan(plan)
         futs = [(wid, conn.request_threadsafe(
             {"kind": "dag_install", "plan": wire}))
             for wid, conn in self._conns.items()]
         for wid, f in futs:
             f.result(15)
+
+    def _retain_depth(self) -> int:
+        # +2 covers the cursor positions a paused consumer can report
+        # beyond its last applied seq (one consumed-not-applied, one
+        # mid-advance), so replay always finds what a reader still needs.
+        return (self._max_in_flight + 2
+                if flags.get("RTPU_DAG_RECOVERY") else 0)
 
     def _open_driver_channels(self, plan: Dict[str, Any]) -> None:
         # Input edge: the driver is the producer.
@@ -419,16 +450,21 @@ class CompiledDAG:
 
             ring_writer = None
             if in_edge["ring"]:
+                cfg = in_edge["ring"]
                 ring_writer = channels.ShmEdgeWriter(SlotRing.create(
-                    plan["depth"], plan["slot_bytes"],
-                    in_edge["ring"]["n_readers"],
-                    name=in_edge["ring"]["name"]))
+                    plan["depth"], plan["slot_bytes"], cfg["n_readers"],
+                    name=cfg["name"],
+                    epoch=int(in_edge.get("epoch", 0)),
+                    base=int(cfg.get("base", 0)),
+                    reader_starts=cfg.get("starts")))
             targets = []
             for dst in in_edge["streams"]:
                 conn = self._conns[plan["endpoints"][dst]["worker_id"]]
                 targets.append((conn.send_with_raw_threadsafe, dst))
             self._input_writer = channels.EdgeWriter(
-                self.dag_id, "in", ring_writer, targets)
+                self.dag_id, "in", ring_writer, targets,
+                retain=self._retain_depth(),
+                epoch=int(in_edge.get("epoch", 0)))
         # Terminal edges: the driver is a consumer.
         for eid in set(self._output_edges):
             e = plan["edges"][eid]
@@ -438,22 +474,24 @@ class CompiledDAG:
                 self._terminal_readers[eid] = inbox
             else:
                 self._terminal_readers[eid] = channels.ShmEdgeReader(
-                    e["ring"]["name"], e["ring_idx"]["driver"])
+                    e["ring"]["name"], e["ring_idx"]["driver"],
+                    expect_epoch=int(e.get("epoch", 0)))
 
     # -- driver pump -------------------------------------------------------
 
     def _pump(self) -> None:
         """Eagerly drains terminal channels into the result map (so unread
         results never clog the window), watches for stalls, and probes
-        participant liveness when one appears."""
-        readers = self._terminal_readers
-        slice_s = 0.05 if len(readers) == 1 else 0.002
-        want = len(readers)
+        participant liveness when one appears. Readers are re-read every
+        sweep: a recovery may swap an affected terminal edge's reader for
+        a fresh one mid-flight."""
+        slice_s = 0.05 if len(self._terminal_readers) == 1 else 0.002
+        want = len(self._terminal_readers)
         last_progress = time.monotonic()
         stall_s = float(flags.get("RTPU_DAG_STALL_S"))
         while not self._pump_stop.is_set():
             progressed = False
-            for eid, r in readers.items():
+            for eid, r in list(self._terminal_readers.items()):
                 try:
                     item = r.recv(slice_s, stop=self._pump_stop.is_set)
                 except channels.ChannelClosed:
@@ -466,6 +504,8 @@ class CompiledDAG:
                     continue
                 progressed = True
                 seq, kind, payload = item
+                if seq >= self._terminal_next.get(eid, 0):
+                    self._terminal_next[eid] = seq + 1
                 t0 = None
                 with self._cond:
                     entry = self._results.setdefault(seq, {})
@@ -493,7 +533,16 @@ class CompiledDAG:
     def _probe(self) -> bool:
         """Zero progress with work outstanding: ask every participant
         directly, then double-check actor liveness with the controller.
-        Returns False when the DAG was failed (pump must exit)."""
+        Returns False when the DAG was failed (pump must exit). With
+        RTPU_DAG_RECOVERY on, a dead restartable participant triggers an
+        in-place recovery instead of teardown."""
+        if not flags.get("RTPU_DAG_RECOVERY"):
+            return self._probe_failfast()
+        return self._probe_recover()
+
+    def _probe_failfast(self) -> bool:
+        """PR 10 semantics (RTPU_DAG_RECOVERY=0): any participant anomaly
+        tears the whole DAG down with a typed error."""
         plan = self._plan
         for wid, conn in self._conns.items():
             try:
@@ -534,6 +583,401 @@ class CompiledDAG:
                 return False
         return True
 
+    # -- self-healing (RTPU_DAG_RECOVERY) ---------------------------------
+
+    def _probe_recover(self) -> bool:
+        """Classify each participant: fine / suspect (unreachable but the
+        controller still believes in it — partitions heal without a
+        restart) / dead (controller confirms it died, moved, or is
+        restarting). Dead restartable participants start a recovery; a
+        participant whose restart budget is exhausted still fails the DAG
+        with the PR 10 typed error."""
+        plan = self._plan
+        unreachable: set = set()
+        for wid, conn in list(self._conns.items()):
+            try:
+                r = conn.request_threadsafe(
+                    {"kind": "dag_status", "dag": self.dag_id}).result(3)
+            except Exception:
+                unreachable.add(wid)
+                continue
+            if not r.get("known"):
+                unreachable.add(wid)  # worker lost its plan (restarted)
+                continue
+            if r.get("failed"):
+                self._fail(DAGTeardownError(
+                    f"compiled DAG {self.dag_id[:8]}: resident loop "
+                    f"failed: {r['failed']}"))
+                return False
+        dead_eps: Dict[str, str] = {}
+        for ep, info in plan["endpoints"].items():
+            if ep == "driver":
+                continue
+            try:
+                d = self._wc.client.request(
+                    {"kind": "resolve_actor",
+                     "actor_id": info["actor_id"], "wait": 0}, timeout=5)
+            except Exception:
+                continue  # controller hiccup: not evidence of death
+            state = d.get("state")
+            direct = d.get("direct") or {}
+            if state == "dead":
+                self._fail(DAGTeardownError(
+                    f"compiled DAG {self.dag_id[:8]}: stage actor "
+                    f"{info['actor_id'][:8]} is dead and will not restart "
+                    f"(max_restarts=0 or restart budget exhausted)"))
+                return False
+            if state != "alive" or not d.get("direct"):
+                dead_eps[ep] = "worker_killed"
+            elif direct.get("worker_id") != info["worker_id"]:
+                dead_eps[ep] = ("worker_killed"
+                                if info["worker_id"] in unreachable
+                                else "drain")
+            # alive on the recorded worker but the worker is unreachable:
+            # suspected partition — stay patient, the next stall re-probes.
+        if dead_eps:
+            causes = set(dead_eps.values())
+            cause = "drain" if causes == {"drain"} else "worker_killed"
+            return self._recover(dead_eps, cause)
+        return True
+
+    def _notify_recovery(self, phase: str, **extra) -> None:
+        try:
+            self._wc.client.send_nowait(
+                {"kind": "dag_recovery", "dag_id": self.dag_id,
+                 "phase": phase, **extra})
+        except Exception:
+            pass
+
+    def _recover(self, dead_eps: Dict[str, str], cause: str) -> bool:
+        """Heal in place: quiesce survivors, wait out the controller's
+        actor restart, rebuild only the affected edges under a bumped
+        epoch, replay retained items, resume. Runs on the pump thread."""
+        t0 = time.monotonic()
+        plan = self._plan
+        dead_aids = sorted({plan["endpoints"][ep]["actor_id"]
+                            for ep in dead_eps})
+        self._recovering = True
+        self._recovery_count += 1
+        self._notify_recovery("died", cause=cause, actors=dead_aids)
+        try:
+            self._recover_inner(dead_eps, cause)
+        except Exception as e:
+            self._recovering = False
+            with self._cond:
+                self._cond.notify_all()
+            self._notify_recovery("failed", cause=cause, actors=dead_aids)
+            self._fail(DAGTeardownError(
+                f"compiled DAG {self.dag_id[:8]}: recovery failed "
+                f"({type(e).__name__}: {e})"))
+            return False
+        self._recovering = False
+        with self._cond:
+            self._cond.notify_all()
+        dt = time.monotonic() - t0
+        _m_recoveries.inc(1, {"cause": cause})
+        _m_recovery_s.observe(dt)
+        self._notify_recovery("recovered", cause=cause, actors=dead_aids,
+                              duration_s=dt)
+        return True
+
+    def _recover_inner(self, dead_eps: Dict[str, str], cause: str) -> None:
+        plan = self._plan
+        dead_ep_set = set(dead_eps)
+        dead_actor_eps: Dict[str, List[str]] = {}
+        for ep in sorted(dead_eps):
+            dead_actor_eps.setdefault(
+                plan["endpoints"][ep]["actor_id"], []).append(ep)
+        self._notify_recovery("recovering", cause=cause,
+                              actors=sorted(dead_actor_eps))
+
+        # 1. Quiesce the survivors. A conn whose worker hosted only dead
+        # endpoints is expectedly unreachable; anything else failing
+        # mid-pause is a double fault and aborts the recovery.
+        eps_of_wid: Dict[str, List[str]] = {}
+        for ep, info in plan["endpoints"].items():
+            if ep != "driver":
+                eps_of_wid.setdefault(info["worker_id"], []).append(ep)
+        survivors: Dict[str, Any] = {}
+        for wid, conn in list(self._conns.items()):
+            try:
+                conn.request_threadsafe(
+                    {"kind": "dag_pause", "dag": self.dag_id}).result(5)
+                survivors[wid] = conn
+            except Exception:
+                if all(ep in dead_ep_set
+                       for ep in eps_of_wid.get(wid, [])):
+                    self._conns.pop(wid, None)
+                    try:
+                        self._wc.client.io.call_nowait(conn.close())
+                    except Exception:
+                        pass
+                else:
+                    raise RuntimeError(
+                        f"worker {wid[:8]} unreachable during quiesce")
+
+        # 2. Barrier: every surviving loop parks and reports its exact
+        # position (next seq + which inputs it already consumed for it).
+        positions: Dict[int, Dict[str, Any]] = {}
+        deadline = time.monotonic() + 20.0
+        pending = dict(survivors)
+        while pending:
+            if time.monotonic() > deadline:
+                raise RuntimeError("pipeline did not quiesce within 20s")
+            for wid, conn in list(pending.items()):
+                r = conn.request_threadsafe(
+                    {"kind": "dag_positions",
+                     "dag": self.dag_id}).result(5)
+                if r.get("failed"):
+                    raise RuntimeError(
+                        f"resident loop failed during quiesce: "
+                        f"{r['failed']}")
+                if r.get("known") and r.get("parked"):
+                    positions.update(
+                        {int(k): v
+                         for k, v in (r.get("positions") or {}).items()})
+                    pending.pop(wid)
+            if pending:
+                time.sleep(0.05)
+
+        # 3. Wait for the controller's restart path to bring every dead
+        # actor back (checkpoint restore happens inside actor re-create).
+        timeout_s = float(flags.get("RTPU_DAG_RECOVERY_TIMEOUT_S"))
+        deadline = time.monotonic() + timeout_s
+        for aid, eps in dead_actor_eps.items():
+            while True:
+                if time.monotonic() > deadline:
+                    raise RuntimeError(
+                        f"stage actor {aid[:8]} did not come back within "
+                        f"{timeout_s:.0f}s")
+                try:
+                    d = self._wc.client.request(
+                        {"kind": "resolve_actor", "actor_id": aid,
+                         "wait": 0}, timeout=5)
+                except Exception:
+                    time.sleep(0.25)
+                    continue
+                if d.get("state") == "dead":
+                    raise RuntimeError(
+                        f"stage actor {aid[:8]} is dead (max_restarts=0 "
+                        f"or restart budget exhausted)")
+                direct = d.get("direct") or {}
+                if (d.get("state") == "alive" and direct
+                        and direct.get("worker_id")
+                        not in {plan["endpoints"][ep]["worker_id"]
+                                for ep in eps}):
+                    info = dict(direct)
+                    info["actor_id"] = aid
+                    for ep in eps:
+                        plan["endpoints"][ep] = dict(info)
+                    break
+                time.sleep(0.25)
+
+        # 4. Dial connections for workers joining the DAG.
+        from ray_tpu.core import protocol
+
+        workers: Dict[str, Dict[str, Any]] = {}
+        for ep, info in plan["endpoints"].items():
+            if ep == "driver":
+                continue
+            w = workers.setdefault(
+                info["worker_id"],
+                {"host": info["host"], "port": info["port"]})
+            w.setdefault("endpoints", []).append(ep)
+        plan["workers"] = workers
+        for wid, w in workers.items():
+            if wid not in self._conns:
+                self._conns[wid] = self._wc.client.io.call(
+                    protocol.connect(w["host"], w["port"],
+                                     handler=self._on_conn_msg,
+                                     name=f"dag-{self.dag_id[:8]}"),
+                    timeout=10)
+
+        # 5. Replay positions for restarted stages, from the journal each
+        # actor's restored checkpoint carries (exactly-once resume); a
+        # stage with no journal restarts from the oldest seq any consumer
+        # could still need.
+        journals: Dict[str, Dict[int, int]] = {}
+        by_wid: Dict[str, List[str]] = {}
+        for aid, eps in dead_actor_eps.items():
+            wid = plan["endpoints"][eps[0]]["worker_id"]
+            by_wid.setdefault(wid, []).append(aid)
+        for wid, aids in by_wid.items():
+            try:
+                r = self._conns[wid].request_threadsafe(
+                    {"kind": "dag_resume_info", "dag": self.dag_id,
+                     "actors": aids}).result(5)
+                journals.update(r.get("journals") or {})
+            except Exception:
+                pass
+        resume: Dict[int, int] = {}
+        for aid, eps in dead_actor_eps.items():
+            j = journals.get(aid) or {}
+            for ep in eps:
+                idx = int(ep[1:])
+                resume[idx] = (int(j[idx]) + 1 if idx in j
+                               else self._done_contig)
+
+        # 6. Rewrite only the affected edges: bumped epoch, fresh ring
+        # name, per-reader start cursors, transport split recomputed for
+        # the new placement. Surviving edges keep rings and cursors.
+        from ray_tpu.core.object_store import SlotRing
+
+        affected: set = set()
+        for eid, e in plan["edges"].items():
+            consumers = list(e["ring_idx"].keys()) + list(e["streams"])
+            if (e["producer"] in dead_ep_set
+                    or any(c in dead_ep_set for c in consumers)):
+                affected.add(eid)
+
+        def consumer_need(eid: str, c: str) -> int:
+            if c == "driver":
+                return int(self._terminal_next.get(eid, 0))
+            idx = int(c[1:])
+            if c in dead_ep_set:
+                return int(resume[idx])
+            pos = positions.get(idx)
+            if pos is None:
+                return int(self._done_contig)
+            need = int(pos["next"])
+            if eid in (pos.get("have") or ()):
+                need += 1
+            return need
+
+        starts_msg: Dict[str, Dict[str, int]] = {}
+        for eid in sorted(affected):
+            e = plan["edges"][eid]
+            consumers = list(e["ring_idx"].keys()) + list(e["streams"])
+            e["epoch"] = int(e.get("epoch", 0)) + 1
+            needs = {c: consumer_need(eid, c) for c in consumers}
+            starts_msg[eid] = needs
+            prod = e["producer"]
+            prod_node = plan["endpoints"][prod]["node_id"]
+            ring_eps = [c for c in consumers
+                        if plan["endpoints"][c]["node_id"] == prod_node]
+            stream_eps = [c for c in consumers
+                          if plan["endpoints"][c]["node_id"] != prod_node]
+            if len(ring_eps) > SlotRing.MAX_READERS:
+                raise RuntimeError(
+                    f"edge {eid}: rebuilt placement has {len(ring_eps)} "
+                    f"same-host consumers, exceeding the reader table")
+            if prod == "driver":
+                prod_first = self._next_seq
+            elif prod in dead_ep_set:
+                prod_first = resume[int(prod[1:])]
+            else:
+                ppos = positions.get(int(prod[1:]))
+                prod_first = (int(ppos["next"]) if ppos
+                              else int(self._done_contig))
+            e["streams"] = stream_eps
+            e["ring"] = (
+                {"name": (f"rtpu_ch_{self.dag_id[:12]}{eid}"
+                          f"p{e['epoch']}"),
+                 "n_readers": len(ring_eps),
+                 "base": min([needs[c] for c in ring_eps]
+                             + [int(prod_first)]),
+                 "starts": [needs[c] for c in ring_eps]}
+                if ring_eps else None)
+            e["ring_idx"] = {c: i for i, c in enumerate(ring_eps)}
+
+        # 7. Driver-local rebuild. Stream inboxes swap BEFORE broadcast
+        # (replayed frames can land immediately); ring readers re-attach
+        # AFTER it (the producer creates the fresh segment on rebuild).
+        in_edge = plan["edges"].get("in")
+        if in_edge is not None and "in" in affected:
+            old_writer = self._input_writer
+            retained = old_writer.retained if old_writer else None
+            if old_writer is not None:
+                old_writer.aborted = True
+                try:
+                    old_writer.close()
+                except Exception:
+                    pass
+            ring_writer = None
+            if in_edge["ring"]:
+                cfg = in_edge["ring"]
+                ring_writer = channels.ShmEdgeWriter(SlotRing.create(
+                    plan["depth"], plan["slot_bytes"], cfg["n_readers"],
+                    name=cfg["name"], epoch=in_edge["epoch"],
+                    base=cfg["base"], reader_starts=cfg["starts"]))
+            targets = []
+            for dst in in_edge["streams"]:
+                conn = self._conns[plan["endpoints"][dst]["worker_id"]]
+                targets.append((conn.send_with_raw_threadsafe, dst))
+            new_writer = channels.EdgeWriter(
+                self.dag_id, "in", ring_writer, targets,
+                retain=self._retain_depth(), epoch=in_edge["epoch"])
+            if retained and new_writer.retained is not None:
+                new_writer.retained.extend(retained)
+            self._input_writer = new_writer
+        ring_reattach: List[str] = []
+        for eid in set(self._output_edges):
+            if eid not in affected:
+                continue
+            e = plan["edges"][eid]
+            old = self._terminal_readers.get(eid)
+            if "driver" in e["streams"]:
+                inbox = channels.StreamInbox()
+                self._inboxes[(eid, "driver")] = inbox
+                self._terminal_readers[eid] = inbox
+            else:
+                ring_reattach.append(eid)
+            if isinstance(old, channels.ShmEdgeReader):
+                try:
+                    old.close()
+                except Exception:
+                    pass
+            elif (isinstance(old, channels.StreamInbox)
+                    and old is not self._terminal_readers.get(eid)):
+                old.close()
+
+        # 8. Broadcast the rebuild. Every participant — including a
+        # worker whose stages all moved away — applies it; parked loops
+        # wake, swap affected IO, replay, and resume (or exit).
+        wire = self._wire_plan(plan)
+        futs = [(wid, conn.request_threadsafe(
+            {"kind": "dag_rebuild", "plan": wire, "starts": starts_msg,
+             "resume": resume, "affected": sorted(affected)}))
+            for wid, conn in self._conns.items()]
+        for wid, f in futs:
+            f.result(20)
+
+        for eid in ring_reattach:
+            e = plan["edges"][eid]
+            stale = self._inboxes.pop((eid, "driver"), None)
+            if stale is not None:
+                stale.close()
+            self._terminal_readers[eid] = channels.ShmEdgeReader(
+                e["ring"]["name"], e["ring_idx"]["driver"],
+                expect_epoch=int(e["epoch"]))
+
+        # 9. Driver-side replay: the input edge re-delivers retained
+        # items the rebuilt consumers still need; when the input edge
+        # survived untouched, re-deliver only the tail an aborted
+        # mid-recovery execute left unwritten.
+        iw = self._input_writer
+        if iw is not None:
+            if "in" in affected:
+                base = (in_edge.get("ring") or {}).get("base")
+                iw.replay(starts_msg.get("in", {}), base,
+                          stop=lambda: self._torn_down)
+            elif iw.ring_writer is not None and iw.retained:
+                ws = iw.ring_writer.ring.write_seq()
+                for seq, kind, payload in list(iw.retained):
+                    if seq >= ws:
+                        iw.write(seq, kind, payload,
+                                 stop=lambda: self._torn_down)
+        iw = None
+
+        # 10. Drop connections to workers the DAG no longer touches.
+        for wid in list(self._conns):
+            if wid not in workers:
+                conn = self._conns.pop(wid)
+                try:
+                    self._wc.client.io.call_nowait(conn.close())
+                except Exception:
+                    pass
+
     def _fail(self, err: BaseException) -> None:
         with self._cond:
             if self._error is None:
@@ -568,8 +1012,9 @@ class CompiledDAG:
         with self._xlock:
             with self._cond:
                 while (self._error is None and not self._torn_down
-                       and self._next_seq - self._done_contig
-                       >= self._max_in_flight):
+                       and (self._recovering
+                            or self._next_seq - self._done_contig
+                            >= self._max_in_flight)):
                     self._cond.wait(0.05)
                 if self._error is not None:
                     raise DAGTeardownError(
@@ -583,12 +1028,23 @@ class CompiledDAG:
                 try:
                     self._input_writer.write(
                         seq, channels.KIND_DATA, payload,
-                        stop=lambda: self._torn_down)
+                        stop=lambda: self._torn_down or self._recovering)
                 except channels.ChannelClosed:
-                    err = self._error
-                    raise DAGTeardownError(
-                        "CompiledDAG was torn down mid-execute"
-                        + (f": {err}" if err else "")) from err
+                    # A recovery interrupted the write mid-flight. The
+                    # payload is already in the retained window (appended
+                    # before any transport leg), so the rebuild replays it;
+                    # just wait the recovery out and hand back the ref.
+                    with self._cond:
+                        while (self._recovering and self._error is None
+                               and not self._torn_down):
+                            self._cond.wait(0.05)
+                        clean = (self._error is None
+                                 and not self._torn_down)
+                    if not clean:
+                        err = self._error
+                        raise DAGTeardownError(
+                            "CompiledDAG was torn down mid-execute"
+                            + (f": {err}" if err else "")) from err
         return ChannelDAGRef(self, seq)
 
     def _execute_submit(self, args, kwargs) -> CompiledDAGRef:
@@ -720,28 +1176,29 @@ class CompiledDAG:
 
     def _sweep_channel_names(self) -> None:
         """Defensive last pass: unlink every shm segment and doorbell path
-        the plan could have created on THIS host. Surviving workers clean
-        their own; a SIGKILLed producer leaves its ring, sidecars, and
-        bell sockets behind, and only the driver knows all the names."""
+        the DAG could have created on THIS host — all edges, all recovery
+        epochs, all per-seq sidecars. Surviving workers clean their own; a
+        SIGKILLed producer leaves its ring, sidecars, and bell sockets
+        behind, and only the driver knows the name prefix."""
         import glob
+        import tempfile
 
+        prefix = f"rtpu_ch_{self.dag_id[:12]}"
+        named = set()
         for edge in self._plan.get("edges", {}).values():
             ring = edge.get("ring")
-            if not ring:
-                continue
-            name = ring["name"]
-            matches = glob.glob(f"/dev/shm/{name}*")
-            for path in matches:
-                channels._unlink_segment(os.path.basename(path))
-            if not matches:
-                channels._unlink_segment(name)
-            for bell in [channels.writer_bell_path(name)] + [
-                    channels.reader_bell_path(name, i)
-                    for i in range(ring["n_readers"])]:
-                try:
-                    os.unlink(bell)
-                except OSError:
-                    pass
+            if ring:
+                named.add(ring["name"])
+        for path in glob.glob(f"/dev/shm/{prefix}*"):
+            channels._unlink_segment(os.path.basename(path))
+        for name in named:
+            channels._unlink_segment(name)  # non-Linux: no /dev/shm to glob
+        for bell in glob.glob(
+                os.path.join(tempfile.gettempdir(), f"{prefix}*")):
+            try:
+                os.unlink(bell)
+            except OSError:
+                pass
 
     def __enter__(self) -> "CompiledDAG":
         return self

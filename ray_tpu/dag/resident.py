@@ -18,13 +18,33 @@ that seq's ref — the pipeline itself keeps running for later seqs.
 Infra failures (torn ring, dead peer, closed driver conn) stop the loop
 and record ``wd.fail``; the driver's stall probe reads it via
 ``dag_status`` and tears the whole DAG down with a typed error.
+
+**Recovery (RTPU_DAG_RECOVERY).** When a participant dies, the driver
+quiesces the survivors (``dag_pause`` → every loop parks between
+microbatches and reports its exact position: the next seq it will apply
+plus which input edges it already consumed for it), waits for the
+controller's restart path to bring the dead stage back (restoring its
+durable checkpoint when one is configured), then pushes ``dag_rebuild``:
+an updated plan in which only the affected edges carry a bumped epoch, a
+fresh ring name, and per-reader start cursors. Parked loops swap the
+affected halves of their channel IO in place, producers replay their
+retained unacked items, and the pipeline resumes with every microbatch
+delivered exactly once. The loop journals its last-applied seq (plus a
+window of encoded outputs) per stage under the ``__dag__<dag_id>`` key of
+the actor's PR 8 exactly-once journal, inside the same durable checkpoint
+record — a restarted stage resumes from there instead of seq 0 and
+re-emits journaled outputs without re-executing them. ``drain_node``
+rides the same machinery: the worker intercepts the migration snapshot,
+runs it at a seq-consistent point, parks the loop, and the stall probe
+turns the migrated stage into an ordinary recovery with zero failed refs.
 """
 from __future__ import annotations
 
 import threading
-import time
-from typing import Any, Dict, List, Optional
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional
 
+from ray_tpu import flags
 from ray_tpu.core import object_store
 from ray_tpu.dag import channels
 
@@ -47,6 +67,14 @@ def handle_direct_message(runtime, conn, msg):
         return handle_status(runtime, msg)
     if kind == "dag_channel_item":
         return handle_item(runtime, msg)
+    if kind == "dag_pause":
+        return handle_pause(runtime, msg)
+    if kind == "dag_positions":
+        return handle_positions(runtime, msg)
+    if kind == "dag_rebuild":
+        return handle_rebuild(runtime, conn, msg)
+    if kind == "dag_resume_info":
+        return handle_resume_info(runtime, msg)
     raise ValueError(f"direct server: unknown kind {kind!r}")
 
 
@@ -77,15 +105,72 @@ def handle_status(runtime, msg):
 def handle_item(runtime, msg):
     """A raw-tail stream frame landed: route into the (edge, endpoint)
     inbox. Fire-and-forget (no rid) — a frame for an unknown DAG (already
-    torn down) is dropped, matching the mutable-channel contract that
-    stale items are superseded, never queued."""
+    torn down) or from a superseded edge epoch (a writer incarnation a
+    rebuild replaced) is dropped, matching the mutable-channel contract
+    that stale items are superseded, never queued."""
     wd = _dags(runtime).get(msg["dag"])
     if wd is None:
+        return None
+    edge = wd.plan["edges"].get(msg["edge"])
+    if edge is not None and int(msg.get("epoch", 0)) != int(
+            edge.get("epoch", 0)):
         return None
     inbox = wd.inboxes.get((msg["edge"], msg["to"]))
     if inbox is not None:
         inbox.push(msg["seq"], msg["vk"], bytes(msg["data"]))
     return None
+
+
+def handle_pause(runtime, msg):
+    """Quiesce request: flip the pause flag and poke every blocking wait.
+    Returns immediately — the driver polls ``dag_positions`` for the
+    actual barrier so the worker io loop never blocks behind a stage."""
+    wd = _dags(runtime).get(msg["dag"])
+    if wd is None:
+        return {"ok": True, "known": False}
+    wd.pause()
+    return {"ok": True, "known": True}
+
+
+def handle_positions(runtime, msg):
+    wd = _dags(runtime).get(msg["dag"])
+    if wd is None:
+        return {"ok": True, "known": False}
+    return {"ok": True, "known": True, "parked": wd.all_parked(),
+            "positions": wd.positions_snapshot(),
+            "failed": repr(wd.fail) if wd.fail is not None else None}
+
+
+def handle_rebuild(runtime, conn, msg):
+    dag_id = msg["plan"]["dag_id"]
+    wd = _dags(runtime).get(dag_id)
+    if wd is None:
+        # This worker joins the DAG mid-life: it hosts a restarted stage.
+        wd = WorkerDAG(runtime, conn, msg["plan"])
+        wd.recover = {"resume": msg["resume"], "starts": msg["starts"],
+                      "affected": set(msg["affected"])}
+        _dags(runtime)[dag_id] = wd
+        wd.setup()
+    else:
+        wd.apply_rebuild(conn, msg["plan"], msg["starts"], msg["resume"],
+                         set(msg["affected"]))
+    return {"ok": True, "worker_id": runtime.worker_id}
+
+
+def handle_resume_info(runtime, msg):
+    """Report the last seq each requested (restarted) actor's DAG journal
+    recorded per stage — the driver derives replay positions from it."""
+    journals: Dict[str, Dict[int, int]] = {}
+    key = "__dag__" + msg["dag"]
+    for aid in msg["actors"]:
+        mb = runtime.actors.get(aid)
+        if mb is None:
+            continue
+        with mb._seq_lock:
+            ent = mb.journal.get(key) or {}
+            journals[aid] = {int(idx): int(rec["seq"])
+                             for idx, rec in ent.items()}
+    return {"ok": True, "journals": journals}
 
 
 class _Err:
@@ -96,6 +181,26 @@ class _Err:
 
     def __init__(self, payload: bytes):
         self.payload = payload
+
+
+class _Paused(Exception):
+    """Control flow: a quiesce / snapshot request interrupted a stage;
+    unwind to the loop top (partial per-seq progress survives in the
+    cache) and handle it there."""
+
+
+def _sweep_ring(ring) -> None:
+    """Unlink a superseded ring incarnation plus any per-seq sidecar
+    segments it spilled (named ``<ring>s<seq>``)."""
+    import glob
+    import os
+
+    for path in glob.glob(f"/dev/shm/{ring.name}s*"):
+        channels._unlink_segment(os.path.basename(path))
+    try:
+        ring.unlink()
+    except Exception:
+        pass
 
 
 class WorkerDAG:
@@ -110,10 +215,26 @@ class WorkerDAG:
         self.fail: Optional[BaseException] = None
         self.progress: Dict[int, int] = {}  # stage idx -> last finished seq
         self.rings: Dict[str, object_store.SlotRing] = {}  # edges I produce
+        self.ring_bases: Dict[str, int] = {}
         self.inboxes: Dict[tuple, channels.StreamInbox] = {}
         self._senders: Dict[tuple, Any] = {}  # (host, port) -> RawStreamSender
         self._lock = threading.Lock()
         self._cleaned: set = set()
+        # -- recovery state --
+        self.recover: Optional[Dict[str, Any]] = None  # set for mid-life join
+        self.pause_req = threading.Event()
+        self.resume_gen = 0
+        self._resume_cond = threading.Condition()
+        self._parked: set = set()       # actor ids currently at the barrier
+        self._loop_actors: set = set()  # actor ids with a live loop
+        self._suspended: set = set()    # drain-snapshotted, awaiting rebuild
+        self._snap_reqs: Dict[str, Any] = {}  # actor id -> snapshot closure
+        self._pos: Dict[int, int] = {}  # stage idx -> next seq to apply
+        self._cache: Dict[int, Dict[str, Any]] = {}  # partial per-seq state
+        self._affected: set = set()
+        self._starts: Dict[str, Dict[str, int]] = {}
+        self._retain = (int(plan["depth"]) + 2
+                        if flags.get("RTPU_DAG_RECOVERY") else 0)
 
     # -- install -----------------------------------------------------------
 
@@ -122,16 +243,29 @@ class WorkerDAG:
         return [ep for ep, info in self.plan["endpoints"].items()
                 if info.get("worker_id") == wid]
 
+    def _create_ring(self, eid: str, edge: Dict[str, Any]) -> None:
+        old = self.rings.get(eid)
+        if old is not None:
+            _sweep_ring(old)  # superseded epoch; loop-side close is a no-op
+        cfg = edge["ring"]
+        base = int(cfg.get("base", 0))
+        self.rings[eid] = object_store.SlotRing.create(
+            self.plan["depth"], self.plan["slot_bytes"], cfg["n_readers"],
+            name=cfg["name"], epoch=int(edge.get("epoch", 0)),
+            base=base, reader_starts=cfg.get("starts"))
+        self.ring_bases[eid] = base
+
     def setup(self) -> None:
         plan = self.plan
         mine = set(self._my_endpoints())
+        if self.recover is not None:
+            self._starts = dict(self.recover.get("starts") or {})
+            self._affected = set(self.recover.get("affected") or ())
         # Producer rings first: same-host consumers (possibly on other
         # workers) attach by name with a bounded retry window.
         for eid, edge in plan["edges"].items():
             if edge["producer"] in mine and edge.get("ring"):
-                self.rings[eid] = object_store.SlotRing.create(
-                    plan["depth"], plan["slot_bytes"],
-                    edge["ring"]["n_readers"], name=edge["ring"]["name"])
+                self._create_ring(eid, edge)
         # Stream inboxes for every cross-host edge that lands here.
         by_actor: Dict[str, List[Dict[str, Any]]] = {}
         for stage in plan["stages"]:
@@ -145,14 +279,19 @@ class WorkerDAG:
             by_actor.setdefault(stage["actor_id"], []).append(stage)
         from ray_tpu.core.controller import ActorNotHostedError
 
+        resume = (self.recover or {}).get("resume") or {}
         for aid, stages in by_actor.items():
             mb = self.runtime.actors.get(aid)
             if mb is None:
                 raise ActorNotHostedError(
                     f"dag_install: actor {aid[:8]} is not hosted here")
             stages = sorted(stages, key=lambda s: s["idx"])
+            for st in stages:
+                self._pos[st["idx"]] = int(resume.get(st["idx"], 0))
+            rec = self.recover
             mb.q.put({"__create__":
-                      (lambda mb=mb, st=stages: self._actor_loop(mb, st))})
+                      (lambda mb=mb, st=stages, rec=rec:
+                       self._actor_loop(mb, st, recover=rec))})
 
     def sender(self, host: str, port: int):
         """One persistent raw-tail stream per downstream worker, shared by
@@ -166,69 +305,247 @@ class WorkerDAG:
                 s = self._senders[key] = RawStreamSender(host, port)
             return s
 
+    # -- quiesce / rebuild (driver-orchestrated recovery) ------------------
+
+    def pause(self) -> None:
+        self.pause_req.set()
+        for inbox in self.inboxes.values():
+            inbox.poke()
+
+    def all_parked(self) -> bool:
+        with self._resume_cond:
+            return self._loop_actors <= self._parked
+
+    def positions_snapshot(self) -> Dict[int, Dict[str, Any]]:
+        out: Dict[int, Dict[str, Any]] = {}
+        for idx, nxt in list(self._pos.items()):
+            cache = self._cache.get(idx)
+            have: List[str] = []
+            if cache is not None and cache.get("seq") == nxt:
+                have = list(cache["vals"].keys())
+            out[idx] = {"next": int(nxt), "have": have}
+        return out
+
+    def apply_rebuild(self, conn, plan, starts, resume, affected) -> None:
+        """Adopt the driver's post-recovery plan (runs on the io-loop
+        thread): fresh rings for affected edges I produce, fresh inboxes
+        for affected stream edges I consume, loops for stages newly hosted
+        here, then wake every parked loop to swap its affected IO in place
+        and replay."""
+        self.driver_conn = conn
+        self.plan = plan
+        self._starts = {eid: dict(d) for eid, d in (starts or {}).items()}
+        self._affected = set(affected)
+        mine = set(self._my_endpoints())
+        for eid in self._affected:
+            edge = plan["edges"].get(eid)
+            if edge and edge["producer"] in mine and edge.get("ring"):
+                self._create_ring(eid, edge)
+            elif eid in self.rings and (
+                    edge is None or edge["producer"] not in mine):
+                # The producer moved off this worker (drain): the old
+                # incarnation's ring is ours to reap, nobody else's.
+                _sweep_ring(self.rings.pop(eid))
+                self.ring_bases.pop(eid, None)
+        # Fresh inboxes for affected stream edges landing here (the old
+        # deque may hold frames from the superseded epoch).
+        adopted: Dict[str, List[Dict[str, Any]]] = {}
+        for stage in plan["stages"]:
+            ep = f"s{stage['idx']}"
+            if ep not in mine:
+                continue
+            for b in list(stage["args"]) + list(stage["kwargs"].values()):
+                if b[0] == "chan" and ep in plan["edges"][b[1]]["streams"]:
+                    key = (b[1], ep)
+                    if b[1] in self._affected or key not in self.inboxes:
+                        old = self.inboxes.get(key)
+                        self.inboxes[key] = channels.StreamInbox()
+                        if old is not None:
+                            old.close()
+            if stage["idx"] not in self._pos:
+                adopted.setdefault(stage["actor_id"], []).append(stage)
+        for aid, stages in adopted.items():
+            if aid in self._loop_actors:
+                continue
+            mb = self.runtime.actors.get(aid)
+            if mb is None:
+                continue  # restart still materializing; driver re-probes
+            stages = sorted(stages, key=lambda s: s["idx"])
+            for st in stages:
+                self._pos[st["idx"]] = int(resume.get(st["idx"], 0))
+            rec = {"resume": resume, "starts": self._starts,
+                   "affected": self._affected}
+            mb.q.put({"__create__":
+                      (lambda mb=mb, st=stages, rec=rec:
+                       self._actor_loop(mb, st, recover=rec))})
+        with self._resume_cond:
+            self.resume_gen += 1
+            self.pause_req.clear()
+            self._resume_cond.notify_all()
+
+    def request_snapshot(self, actor_id: str, fn) -> bool:
+        """Drain migration support: a resident loop owns the mailbox, so
+        the ordinary snapshot closure lane would time out behind it. Hand
+        the closure to the loop instead — it runs it between microbatches
+        (a seq-consistent point) and then parks until the driver rebuilds
+        the pipeline around the migrated stage."""
+        if actor_id not in self._loop_actors:
+            return False
+        self._snap_reqs[actor_id] = fn
+        for inbox in self.inboxes.values():
+            inbox.poke()
+        return True
+
     # -- the resident loop -------------------------------------------------
 
     def _stop_requested(self) -> bool:
         return self.stopped.is_set() or self.driver_conn.closed.is_set()
 
+    def _make_reader(self, stage, eid: str):
+        edge = self.plan["edges"][eid]
+        ep = f"s{stage['idx']}"
+        if ep in edge["streams"]:
+            return self.inboxes[(eid, ep)]
+        return channels.ShmEdgeReader(
+            edge["ring"]["name"], edge["ring_idx"][ep],
+            expect_epoch=int(edge.get("epoch", 0)))
+
     def _build_stage_io(self, stage):
         """Readers for each channel edge this stage consumes, writer for
-        the edge it produces (None when only same-actor locals consume)."""
-        plan = self.plan
-        ep = f"s{stage['idx']}"
+        the edge it produces (None when only same-actor locals consume).
+        Returned as a mutable [readers, writer] pair so a rebuild can swap
+        the affected halves in place."""
         readers: Dict[str, Any] = {}
         for b in list(stage["args"]) + list(stage["kwargs"].values()):
             if b[0] != "chan" or b[1] in readers:
                 continue
-            eid = b[1]
-            edge = plan["edges"][eid]
-            if ep in edge["streams"]:
-                readers[eid] = self.inboxes[(eid, ep)]
-            else:
-                readers[eid] = channels.ShmEdgeReader(
-                    edge["ring"]["name"], edge["ring_idx"][ep])
-        writer = None
-        eid = stage.get("out_edge")
-        if eid is not None:
-            edge = plan["edges"][eid]
-            ring_writer = None
-            if eid in self.rings:
-                ring_writer = channels.ShmEdgeWriter(self.rings[eid])
-            targets = []
-            for dst in edge["streams"]:
-                if dst == "driver":
-                    targets.append(
-                        (self.driver_conn.send_with_raw_threadsafe, dst))
-                else:
-                    info = plan["endpoints"][dst]
-                    s = self.sender(info["host"], info["port"])
-                    targets.append((s.send, dst))
-            writer = channels.EdgeWriter(self.dag_id, eid,
-                                         ring_writer, targets)
-        return readers, writer
+            readers[b[1]] = self._make_reader(stage, b[1])
+        return [readers, self._build_stage_writer(stage)]
 
-    def _actor_loop(self, mb, stages: List[Dict[str, Any]]) -> None:
+    def _build_stage_writer(self, stage):
+        plan = self.plan
+        eid = stage.get("out_edge")
+        if eid is None:
+            return None
+        edge = plan["edges"][eid]
+        ring_writer = None
+        if eid in self.rings:
+            ring_writer = channels.ShmEdgeWriter(self.rings[eid])
+        targets = []
+        for dst in edge["streams"]:
+            if dst == "driver":
+                targets.append(
+                    (self.driver_conn.send_with_raw_threadsafe, dst))
+            else:
+                info = plan["endpoints"][dst]
+                s = self.sender(info["host"], info["port"])
+                targets.append((s.send, dst))
+        return channels.EdgeWriter(self.dag_id, eid, ring_writer, targets,
+                                   retain=self._retain,
+                                   epoch=int(edge.get("epoch", 0)))
+
+    def _journal_apply(self, mb, idx: int, seq: int, kind: int,
+                       payload: bytes) -> None:
+        """Record one applied stage output in the actor's exactly-once
+        journal (PR 8 record format, caller key ``__dag__<dag_id>``). Runs
+        strictly BEFORE the edge write, so an output a crash or pause
+        interrupted mid-write is still replayable from the journal."""
+        if self._retain == 0:
+            return
+        key = "__dag__" + self.dag_id
+        with mb._seq_lock:
+            ent = mb.journal.setdefault(key, {}).get(idx)
+            if ent is None:
+                ent = mb.journal[key][idx] = {
+                    "seq": -1, "outs": deque(maxlen=self._retain)}
+            ent["outs"].append((seq, kind, payload))
+            ent["seq"] = seq
+
+    def _seed_writer(self, mb, stage, writer) -> None:
+        """Restart path: refill a fresh writer's retention window from the
+        journaled outputs the previous incarnation checkpointed."""
+        if writer is None or writer.retained is None or writer.retained:
+            return
+        key = "__dag__" + self.dag_id
+        with mb._seq_lock:
+            ent = (mb.journal.get(key) or {}).get(stage["idx"])
+            outs = list(ent["outs"]) if ent else []
+        writer.retained.extend(outs)
+
+    def _maybe_checkpoint(self, mb) -> None:
+        """Durable-checkpoint cadence for a mailbox this loop occupies:
+        ``request_checkpoint`` would park behind us forever, so run the
+        checkpoint inline — we ARE the mailbox thread."""
+        if not getattr(mb, "ckpt_enabled", False):
+            return
+        mb.calls_since_ckpt += 1
+        due = (mb.ckpt_every_n and mb.calls_since_ckpt >= mb.ckpt_every_n)
+        if due or mb.ckpt_due():
+            try:
+                mb.do_checkpoint()
+            except Exception:
+                pass
+
+    def _actor_loop(self, mb, stages: List[Dict[str, Any]],
+                    recover: Optional[Dict[str, Any]] = None) -> None:
         """Runs ON the actor's mailbox thread until teardown."""
         from ray_tpu.core import context as ctx
 
         ctx.task_local.actor_id = mb.actor_id
-        io = []
+        aid = mb.actor_id
+
+        def interrupted() -> bool:
+            return (self.pause_req.is_set() or aid in self._suspended
+                    or aid in self._snap_reqs)
+
+        with self._resume_cond:
+            self._loop_actors.add(aid)
+        io: List[list] = []
         try:
-            for stage in stages:
-                io.append(self._build_stage_io(stage))
-        except Exception as e:
-            self.fail = self.fail or e
-            self._cleanup(io)
-            return
-        local_vals: Dict[int, Any] = {}
-        seq = 0
-        try:
-            while not self._stop_requested():
-                for stage, (readers, writer) in zip(stages, io):
-                    if not self._run_stage(mb, stage, readers, writer,
-                                           seq, local_vals):
+            try:
+                for stage in stages:
+                    io.append(self._build_stage_io(stage))
+            except Exception as e:
+                self.fail = self.fail or e
+                return
+            nexts = {st["idx"]: int(self._pos.get(st["idx"], 0))
+                     for st in stages}
+            if recover is not None:
+                self._replay_writers(mb, stages, io,
+                                     set(recover.get("affected") or ()))
+            local_vals: Dict[int, Any] = {}
+            seq = min(nexts.values()) if nexts else 0
+            while True:
+                if self._stop_requested():
+                    raise channels.ChannelClosed("teardown")
+                if self.pause_req.is_set() or aid in self._suspended:
+                    if self._park(mb, stages, io) == "exit":
                         return
-                    self.progress[stage["idx"]] = seq
+                    continue
+                snap = self._snap_reqs.pop(aid, None)
+                if snap is not None:
+                    # Drain snapshot at a seq-consistent point; then park
+                    # until the driver rebuilds around the migrated stage.
+                    # No post-snapshot seq may run here, or its side
+                    # effects would repeat on the restored copy.
+                    try:
+                        snap()
+                    finally:
+                        self._suspended.add(aid)
+                    continue
+                try:
+                    for stage, sio in zip(stages, io):
+                        idx = stage["idx"]
+                        if seq < nexts[idx]:
+                            self._skip_stage(mb, stage, seq, local_vals)
+                            continue
+                        self._run_stage(mb, stage, sio, seq, local_vals,
+                                        interrupted)
+                        nexts[idx] = self._pos[idx] = seq + 1
+                        self.progress[idx] = seq
+                    self._maybe_checkpoint(mb)
+                except _Paused:
+                    continue
                 seq += 1
         except channels.ChannelClosed:
             pass  # upstream tore down first; the driver handles fallout
@@ -236,81 +553,230 @@ class WorkerDAG:
             self.fail = self.fail or e
         finally:
             self._cleanup(io)
+            with self._resume_cond:
+                self._loop_actors.discard(aid)
+                self._suspended.discard(aid)
+                self._parked.discard(aid)
+                self._resume_cond.notify_all()
 
-    def _run_stage(self, mb, stage, readers, writer, seq,
-                   local_vals) -> bool:
-        err_payload: Optional[bytes] = None
-        chan_vals: Dict[str, Any] = {}
-        for eid, reader in readers.items():
-            while True:
-                item = reader.recv(0.1, stop=self._stop_requested)
-                if item is not None:
-                    break
+    def _park(self, mb, stages, io) -> str:
+        """Quiesce barrier: advertise this loop as parked, wait for the
+        driver's rebuild (or teardown), then swap the affected channel IO
+        in place and replay retained items. Returns "exit" when the
+        post-rebuild plan no longer hosts this actor's stages here
+        (migrated away)."""
+        aid = mb.actor_id
+        with self._resume_cond:
+            gen = self.resume_gen
+            self._parked.add(aid)
+            self._resume_cond.notify_all()
+            try:
+                while (self.resume_gen == gen
+                       and not self._stop_requested()):
+                    self._resume_cond.wait(0.1)
+            finally:
+                self._parked.discard(aid)
+        if self._stop_requested():
+            raise channels.ChannelClosed("teardown")
+        self._suspended.discard(aid)
+        mine = set(self._my_endpoints())
+        if any(f"s{st['idx']}" not in mine for st in stages):
+            for st in stages:
+                self._pos.pop(st["idx"], None)
+                self._cache.pop(st["idx"], None)
+            return "exit"
+        affected = set(self._affected)
+        for stage, sio in zip(stages, io):
+            readers = sio[0]
+            for eid in list(readers.keys()):
+                if eid not in affected:
+                    continue
+                old = readers.pop(eid)
+                if isinstance(old, channels.ShmEdgeReader):
+                    try:
+                        old.close()
+                    except Exception:
+                        pass
+                readers[eid] = self._make_reader(stage, eid)
+                # A consumed-but-unapplied cached value from the old
+                # incarnation stays valid: positions reported it, so
+                # upstream replay starts after it.
+            eid = stage.get("out_edge")
+            if eid is not None and eid in affected and sio[1] is not None:
+                old_writer = sio[1]
+                old_writer.aborted = True
+                retained = old_writer.retained
+                try:
+                    old_writer.close()  # unlinks the superseded ring
+                except Exception:
+                    pass
+                new_writer = self._build_stage_writer(stage)
+                if retained and new_writer.retained is not None:
+                    new_writer.retained.extend(retained)
+                sio[1] = new_writer
+        self._replay_writers(mb, stages, io, affected)
+        return "resume"
+
+    def _replay_writers(self, mb, stages, io, affected) -> None:
+        """Re-deliver retained items on every affected edge this actor
+        produces: the rebuilt ring takes everything from its base up, and
+        stream consumers are filtered by their reported need."""
+        for stage, sio in zip(stages, io):
+            eid = stage.get("out_edge")
+            writer = sio[1]
+            if writer is None or eid is None or eid not in affected:
+                continue
+            self._seed_writer(mb, stage, writer)
+            writer.replay(self._starts.get(eid, {}),
+                          self.ring_bases.get(eid),
+                          stop=self._stop_requested)
+
+    def _skip_stage(self, mb, stage, seq, local_vals) -> None:
+        """This stage already applied ``seq`` in a previous incarnation:
+        re-expose its journaled output for same-actor consumers without
+        re-executing (exactly-once side effects)."""
+        idx = stage["idx"]
+        key = "__dag__" + self.dag_id
+        with mb._seq_lock:
+            ent = (mb.journal.get(key) or {}).get(idx)
+            hit = None
+            if ent is not None:
+                for s, kind, payload in ent["outs"]:
+                    if s == seq:
+                        hit = (kind, payload)
+                        break
+        if hit is not None:
+            kind, payload = hit
+            local_vals[idx] = (_Err(payload)
+                               if kind == channels.KIND_ERROR
+                               else channels.decode(payload))
+
+    def _recv_input(self, reader, eid: str, seq: int,
+                    interrupted: Callable[[], bool]):
+        """Blocking recv with quiesce awareness and stale-skip: a replayed
+        duplicate (seq below what this stage needs) is dropped — recovery
+        re-delivery is at-least-once per transport, exactly-once at the
+        consumer."""
+        while True:
+            item = reader.recv(0.1, stop=self._stop_requested)
+            if item is None:
                 if self._stop_requested():
                     raise channels.ChannelClosed("teardown")
-            got_seq, kind, payload = item
-            if got_seq != seq:
+                if interrupted():
+                    raise _Paused()
+                continue
+            got_seq = item[0]
+            if got_seq < seq:
+                continue  # superseded replay duplicate
+            if got_seq > seq:
                 raise RuntimeError(
                     f"dag {self.dag_id[:8]} edge {eid}: expected seq "
                     f"{seq}, got {got_seq} (torn channel)")
-            if kind == channels.KIND_ERROR:
-                if err_payload is None:
-                    err_payload = payload
-            else:
-                chan_vals[eid] = channels.decode(payload)
+            return item
 
-        def resolve(b):
-            nonlocal err_payload
-            if b[0] == "const":
-                return b[1]
-            if b[0] == "local":
-                v = local_vals.get(b[1])
-                if isinstance(v, _Err):
-                    err_payload = err_payload or v.payload
-                    return None
+    def _run_stage(self, mb, stage, sio, seq, local_vals,
+                   interrupted) -> None:
+        idx = stage["idx"]
+        readers, writer = sio[0], sio[1]
+        cache = self._cache.get(idx)
+        if cache is None or cache.get("seq") != seq:
+            cache = self._cache[idx] = {"seq": seq, "vals": {}, "out": None}
+        if cache["out"] is None:
+            for eid, reader in readers.items():
+                if eid in cache["vals"]:
+                    continue  # consumed before a pause interrupted us
+                got_seq, kind, payload = self._recv_input(
+                    reader, eid, seq, interrupted)
+                cache["vals"][eid] = (kind, payload)
+            err_payload: Optional[bytes] = None
+            chan_vals: Dict[str, Any] = {}
+            for eid in readers:
+                kind, payload = cache["vals"][eid]
+                if kind == channels.KIND_ERROR:
+                    if err_payload is None:
+                        err_payload = payload
+                else:
+                    chan_vals[eid] = channels.decode(payload)
+
+            def resolve(b):
+                nonlocal err_payload
+                if b[0] == "const":
+                    return b[1]
+                if b[0] == "local":
+                    v = local_vals.get(b[1])
+                    if isinstance(v, _Err):
+                        err_payload = err_payload or v.payload
+                        return None
+                    return v
+                v = chan_vals.get(b[1])
+                if b[1] not in chan_vals:
+                    return None  # an upstream error consumed this value
+                if b[2] is not None:
+                    return channels.apply_selector(v, b[2])
                 return v
-            v = chan_vals.get(b[1])
-            if b[1] not in chan_vals:
-                return None  # an upstream error consumed this edge's value
-            if b[2] is not None:
-                return channels.apply_selector(v, b[2])
-            return v
 
-        args = [resolve(b) for b in stage["args"]]
-        kwargs = {k: resolve(b) for k, b in stage["kwargs"].items()}
-        if err_payload is not None:
-            out_kind, out_payload = channels.KIND_ERROR, err_payload
-            local_vals[stage["idx"]] = _Err(err_payload)
-        else:
-            try:
-                result = getattr(mb.instance, stage["method"])(
-                    *args, **kwargs)
-                out_kind = channels.KIND_DATA
-                out_payload = channels.encode_value(result)
-                local_vals[stage["idx"]] = result
-            except BaseException as e:
-                out_kind = channels.KIND_ERROR
-                out_payload = channels.encode_error(e)
-                local_vals[stage["idx"]] = _Err(out_payload)
+            args = [resolve(b) for b in stage["args"]]
+            kwargs = {k: resolve(b) for k, b in stage["kwargs"].items()}
+            if err_payload is not None:
+                out_kind, out_payload = channels.KIND_ERROR, err_payload
+                local_vals[idx] = _Err(err_payload)
+            else:
+                try:
+                    result = getattr(mb.instance, stage["method"])(
+                        *args, **kwargs)
+                    out_kind = channels.KIND_DATA
+                    out_payload = channels.encode_value(result)
+                    local_vals[idx] = result
+                except BaseException as e:
+                    out_kind = channels.KIND_ERROR
+                    out_payload = channels.encode_error(e)
+                    local_vals[idx] = _Err(out_payload)
+            cache["out"] = (out_kind, out_payload)
+            self._journal_apply(mb, idx, seq, out_kind, out_payload)
         if writer is not None:
-            writer.write(seq, out_kind, out_payload,
-                         stop=self._stop_requested)
-        return True
+            out_kind, out_payload = cache["out"]
+            try:
+                writer.write(
+                    seq, out_kind, out_payload,
+                    stop=lambda: self._stop_requested() or interrupted())
+            except channels.ChannelClosed:
+                if interrupted() and not self._stop_requested():
+                    # Applied + journaled; the post-rebuild replay (or a
+                    # plain retry after an unaffected-edge resume, which
+                    # the retention dedup makes idempotent) delivers it.
+                    raise _Paused()
+                raise
+        self._cache.pop(idx, None)
 
     # -- teardown ----------------------------------------------------------
 
     def stop(self) -> None:
         """Called from the io loop (dag_teardown) or failure paths: flips
         the stop flag and pokes every blocking wait. Resident loops exit
-        within one wait slice and release their channels; a timer sweeps
-        anything a never-started loop would have owned."""
+        within one wait slice and release their channels; persistent
+        cross-host senders close here too (a loop mid-send surfaces an
+        OSError and exits), and a timer sweeps anything a never-started
+        loop would have owned."""
         self.stopped.set()
         for inbox in self.inboxes.values():
             inbox.close()
+        with self._resume_cond:
+            self._resume_cond.notify_all()
+        self._close_senders()
         threading.Timer(5.0, self._force_unlink).start()
 
+    def _close_senders(self) -> None:
+        with self._lock:
+            senders, self._senders = dict(self._senders), {}
+        for s in senders.values():
+            try:
+                s.close()
+            except Exception:
+                pass
+
     def _cleanup(self, io) -> None:
-        for readers, writer in io:
+        for sio in io:
+            readers, writer = sio[0], sio[1]
             for r in readers.values():
                 if isinstance(r, channels.ShmEdgeReader):
                     try:
@@ -325,23 +791,17 @@ class WorkerDAG:
                 if writer.ring_writer is not None:
                     with self._lock:
                         self._cleaned.add(writer.edge_id)
-        with self._lock:
-            senders, self._senders = dict(self._senders), {}
-        for s in senders.values():
-            try:
-                s.close()
-            except Exception:
-                pass
+        self._close_senders()
 
     def _force_unlink(self) -> None:
         """Defensive sweep: unlink producer rings whose loop never ran
-        (actor died before the closure executed) or died uncleanly."""
+        (actor died before the closure executed) or died uncleanly — and
+        any per-seq sidecar segments those rings spilled, which a
+        SIGKILLed peer's teardown would otherwise leak."""
         with self._lock:
             leftovers = {eid: ring for eid, ring in self.rings.items()
                          if eid not in self._cleaned}
             self._cleaned.update(leftovers)
         for ring in leftovers.values():
-            try:
-                ring.unlink()
-            except Exception:
-                pass
+            _sweep_ring(ring)
+        self._close_senders()

@@ -302,6 +302,20 @@ _define("RTPU_DAG_STALL_S", float, 2.0,
         "then resolve_actor). Probes run only when stalled, so the "
         "steady state stays controller-free; a dead/restarted "
         "participant tears the DAG down with DAGTeardownError.")
+_define("RTPU_DAG_RECOVERY", bool, True,
+        "Compiled DAGs heal in place: when the stall probe finds a dead "
+        "restartable participant, the driver quiesces the pipeline, waits "
+        "for the controller's actor-restart path (restoring the stage's "
+        "durable checkpoint when one is configured), rebuilds only the "
+        "affected edges under a bumped ring epoch, and replays retained "
+        "items from the last seqno each reader applied, so every "
+        "microbatch is delivered exactly once. Non-restartable stages "
+        "(max_restarts=0) and an exhausted restart budget still raise "
+        "DAGTeardownError; 0 restores the PR 10 fail-fast semantics.")
+_define("RTPU_DAG_RECOVERY_TIMEOUT_S", float, 60.0,
+        "How long a recovering DAG waits for a dead stage actor to come "
+        "back alive (restart scheduling + checkpoint restore) before "
+        "giving up and tearing down with DAGTeardownError.")
 
 # -- object store / spilling -------------------------------------------------
 _define("RTPU_NATIVE_STORE", bool, True,

@@ -79,7 +79,24 @@ class MPMDPipeline:
     per-stage lists: a ``MeshSpec`` gives that stage its own device mesh,
     options dicts pass through to ``.options()`` (resources, chips, …) so
     stages land on disjoint hardware.
+
+    ``recovery=True`` (the default) makes every stage restartable with
+    periodic durable checkpoints, so a SIGKILLed stage worker or a drained
+    node heals in place: the compiled DAG pauses, the controller restarts
+    the stage from its checkpoint, only the affected channels are rebuilt,
+    and retained microbatches replay exactly once. Explicit per-stage
+    ``stage_options`` win over these defaults; pass ``recovery=False`` for
+    PR-10-style fail-fast teardown semantics.
     """
+
+    #: Per-stage defaults installed by ``recovery=True``: enough restart
+    #: budget for repeated chaos, and a checkpoint cadence that bounds how
+    #: much stage state a restart can lose.
+    RECOVERY_STAGE_OPTIONS = {
+        "max_restarts": 4,
+        "max_task_retries": 1,
+        "checkpoint_interval_s": 2.0,
+    }
 
     def __init__(
         self,
@@ -88,6 +105,7 @@ class MPMDPipeline:
         max_in_flight: int = 8,
         mesh_specs: Optional[Sequence[Any]] = None,
         stage_options: Optional[Sequence[Optional[dict]]] = None,
+        recovery: bool = True,
     ):
         if not stage_factories:
             raise ValueError("MPMDPipeline needs at least one stage")
@@ -98,10 +116,12 @@ class MPMDPipeline:
             raise ValueError("stage_options must match stage count")
         self.num_stages = n
         self.max_in_flight = max_in_flight
+        self.recovery = bool(recovery)
         handles = []
         for i, factory in enumerate(stage_factories):
             cls = _StageActor
-            opts = stage_options[i] if stage_options else None
+            opts = dict(self.RECOVERY_STAGE_OPTIONS) if recovery else {}
+            opts.update((stage_options[i] if stage_options else None) or {})
             if opts:
                 cls = cls.options(**opts)
             spec = mesh_specs[i] if mesh_specs else None
@@ -145,6 +165,11 @@ class MPMDPipeline:
         self.last_gaps_s = [
             stamps[i] - stamps[i - 1] for i in range(1, len(stamps))]
         return outs
+
+    @property
+    def recoveries(self) -> int:
+        """In-place recoveries the compiled plan has completed so far."""
+        return getattr(self._compiled, "_recovery_count", 0)
 
     def gap_stats(self) -> Dict[str, float]:
         """Summary of the last run's per-microbatch completion gaps.

@@ -45,8 +45,11 @@ def list_objects(limit: int = 1000) -> List[Dict[str, Any]]:
 def list_compiled_dags(limit: int = 1000) -> List[Dict[str, Any]]:
     """Compiled DAGs with live channel plans: stages (actor + method per
     pipeline position), per-edge transport (shm ring vs raw-tail stream),
-    and the in-flight window depth. The controller only sees compile and
-    teardown, so this is the registry of pipelines whose steady-state
+    the in-flight window depth, and self-healing counters (``recoveries``
+    completed in place, ``recovering`` when a heal is in flight,
+    ``last_recovery_s``/``last_cause`` for the most recent one). The
+    controller only sees compile, teardown, and recovery phase
+    transitions, so this is the registry of pipelines whose steady-state
     dispatch bypasses it entirely."""
     return _req({"kind": "list_state", "what": "dags", "limit": limit})
 

@@ -1,0 +1,472 @@
+"""Self-healing compiled DAGs: stage restart, channel rebuild, and
+seqno-exact replay instead of whole-pipeline teardown.
+
+Chaos proofs for the DAG recovery layer (RTPU_DAG_RECOVERY, default on):
+
+- SIGKILL a stage worker mid-stream: the pipeline pauses at a quiesce
+  barrier, the controller restarts the stage from its durable checkpoint,
+  only the affected channels are rebuilt, retained microbatches replay —
+  every result is delivered exactly once and every stage side effect lands
+  exactly once (seqno journal inside the actor checkpoint).
+- Whole-node SIGKILL: the stage restores on ANOTHER node from the
+  controller-shipped checkpoint copy; cross-host stream edges re-dial.
+- A slow stage plus a 10s protocol blackhole (NetworkPartitioner): the
+  probe classifies the unreachable-but-alive participant as SUSPECT and
+  stays patient — heal resumes the same instances, zero recoveries.
+- `drain_node` mid-pipeline: proactive stage migration with zero failed
+  refs.
+- RTPU_DAG_RECOVERY=0 keeps the PR-10 fail-fast contract: a dead
+  participant tears the whole DAG down typed, even when restart budget
+  exists — and teardown after a peer SIGKILL reaps stream-edge state and
+  per-seq sidecar segments (no arena accounting drift, no /dev/shm
+  leftovers).
+"""
+import glob
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu import flags
+from ray_tpu.core.object_store import channel_segment_stats
+from ray_tpu.dag import DAGTeardownError, InputNode
+
+PKG_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _client():
+    from ray_tpu.core import context as ctx
+
+    return ctx.get_worker_context().client
+
+
+def _wait_for(pred, timeout=30.0, interval=0.05, desc="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        v = pred()
+        if v:
+            return v
+        time.sleep(interval)
+    raise TimeoutError(f"timed out waiting for {desc}")
+
+
+def _worker_row(worker_id):
+    rows = _client().request({"kind": "list_state", "what": "workers"})
+    return next(w for w in rows if w["worker_id"] == worker_id)
+
+
+def _event_kinds(**filters):
+    evs = _client().request({"kind": "get_events", **filters})["events"]
+    return [e["kind"] for e in evs]
+
+
+def _shm_leftovers(dag_id: str):
+    return glob.glob(f"/dev/shm/rtpu_ch_{dag_id[:12]}*")
+
+
+def _wait_no_leftovers(dag_id: str, timeout=10.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if not _shm_leftovers(dag_id):
+            return []
+        time.sleep(0.1)
+    return _shm_leftovers(dag_id)
+
+
+class _MarkingStage:
+    """Stateful, checkpointable stage step: records every microbatch it
+    applied to a marker file (the exactly-once side-effect subject) and in
+    its own state (the checkpoint-restore subject)."""
+
+    def __init__(self, idx, marker):
+        self.idx = idx
+        self.marker = marker
+        self.applied = 0
+
+    def __call__(self, x):
+        self.applied += 1
+        if self.marker:
+            with open(self.marker, "a") as f:
+                f.write(f"{x}\n")
+                f.flush()
+        return x + 10 ** self.idx
+
+
+def _marking_factory(marker_for_stage1):
+    def factory(idx, n, mesh):
+        return _MarkingStage(
+            idx, marker_for_stage1 if idx == 1 else None)
+
+    return factory
+
+
+@pytest.mark.chaos
+def test_stage_worker_sigkill_heals_exactly_once(tmp_path):
+    """ACCEPTANCE: SIGKILL the middle stage's worker mid-stream. The DAG
+    recovers in place (no teardown): all N results arrive exactly once,
+    the stage's marker side effects land exactly once, DAG_RECOVERED is
+    emitted, and the registry counts the recovery."""
+    from ray_tpu.parallel import MPMDPipeline
+    from ray_tpu.testing.fault_injection import WorkerKiller
+
+    ray_tpu.init(num_cpus=4)
+    p = None
+    try:
+        marker = str(tmp_path / "markers.txt")
+        # checkpoint_every_n=1: the seq journal is durable after every
+        # microbatch, so a kill landing while the stage is idle (the
+        # driver throttles ~30ms between executes; the stage step is µs)
+        # loses nothing and replays nothing twice.
+        p = MPMDPipeline(
+            [_marking_factory(marker)] * 3, max_in_flight=4,
+            stage_options=[{"checkpoint_every_n": 1}] * 3)
+        assert p.mode == "channels"
+        victim = p._compiled._plan["endpoints"]["s1"]["worker_id"]
+        killer = WorkerKiller(
+            worker_filter=lambda w: w.get("worker_id") == victim)
+
+        n = 24
+        refs = []
+        for i in range(n):
+            refs.append(p.submit(i))
+            time.sleep(0.03)
+            if i == 7:
+                assert killer.kill_once() is not None
+        outs = [r.get(timeout=120) for r in refs]
+        assert outs == [i + 111 for i in range(n)]
+
+        lines = open(marker).read().split()
+        # Stage 1 marks what it RECEIVED — stage 0's output, i + 1.
+        assert sorted(lines, key=int) == [str(i + 1) for i in range(n)], \
+            f"stage-1 side effects must land exactly once, got {lines}"
+        assert p.recoveries >= 1
+        kinds = _event_kinds(kinds=["DAG_PARTICIPANT_DIED",
+                                    "DAG_RECOVERING", "DAG_RECOVERED"])
+        assert "DAG_PARTICIPANT_DIED" in kinds
+        assert "DAG_RECOVERED" in kinds
+        from ray_tpu.util import state as state_api
+
+        row = next(d for d in state_api.list_compiled_dags()
+                   if d["dag_id"] == p._compiled.dag_id)
+        assert row["recoveries"] >= 1
+        assert row["last_cause"] == "worker_killed"
+        assert row["last_recovery_s"] > 0
+    finally:
+        if p is not None:
+            p.teardown()
+        ray_tpu.shutdown()
+
+
+@pytest.mark.chaos
+def test_whole_node_sigkill_restores_on_another_node(tmp_path):
+    """ACCEPTANCE: kill the stage's worker AND its host agent (whole node
+    gone, host-local checkpoints unreachable): the stage restores on
+    another node from the controller-shipped checkpoint copy, the rebuilt
+    cross-host stream edges re-dial, and every result lands exactly once
+    with the restored state intact."""
+    from ray_tpu.core.cluster_utils import Cluster
+    from ray_tpu.parallel import MPMDPipeline
+    from ray_tpu.util.scheduling_strategies import (
+        NodeAffinitySchedulingStrategy)
+
+    cluster = Cluster(head_resources={"CPU": 4})
+    p = None
+    try:
+        nid = cluster.add_node({"CPU": 2}, remote=True,
+                               host_id="dagrec-host-b")
+        marker = str(tmp_path / "markers.txt")
+        p = MPMDPipeline(
+            [_marking_factory(marker)] * 3, max_in_flight=4,
+            stage_options=[
+                None,
+                {"checkpoint_every_n": 1,
+                 "scheduling_strategy": NodeAffinitySchedulingStrategy(
+                     node_id=nid, soft=True)},
+                None])
+        assert p.mode == "channels"
+        ep = p._compiled._plan["endpoints"]["s1"]
+        assert ep["node_id"] == nid
+        # The middle stage is on the remote node: both its edges stream.
+        assert "s1" in p._compiled._plan["edges"]["e0"]["streams"]
+
+        n = 20
+        refs = []
+        for i in range(n):
+            refs.append(p.submit(i))
+            time.sleep(0.03)
+            if i == 6:
+                os.kill(_worker_row(ep["worker_id"])["pid"],
+                        signal.SIGKILL)
+                cluster.kill_node_agent(0)  # the whole host is gone
+        outs = [r.get(timeout=120) for r in refs]
+        assert outs == [i + 111 for i in range(n)]
+        lines = open(marker).read().split()
+        assert sorted(lines, key=int) == [str(i + 1) for i in range(n)]
+        assert p.recoveries >= 1
+        # Restored elsewhere: the rebuilt endpoint left the dead node.
+        assert p._compiled._plan["endpoints"]["s1"]["node_id"] != nid
+    finally:
+        if p is not None:
+            p.teardown()
+        cluster.shutdown()
+
+
+@pytest.mark.chaos
+def test_partition_suspect_stays_patient_zero_recoveries(monkeypatch):
+    """A slow stage keeps tripping the stall probe, and a 10s protocol
+    blackhole makes its host unreachable on top: the probe must classify
+    it SUSPECT (controller still believes in it) and stay patient — no
+    restart, no recovery, same instances after the heal."""
+    from ray_tpu.parallel import MPMDPipeline
+    from ray_tpu.testing import NetworkPartitioner
+    from ray_tpu.util.scheduling_strategies import (
+        NodeAffinitySchedulingStrategy)
+
+    monkeypatch.setenv("RTPU_NODE_TIMEOUT_S", "1.5")
+    monkeypatch.setenv("RTPU_DEAD_TIMEOUT_S", "120")
+    monkeypatch.setenv("RTPU_RPC_TIMEOUT_S", "1.0")
+    monkeypatch.setenv("RTPU_HEARTBEAT_S", "0.5")
+    part = NetworkPartitioner()
+    monkeypatch.setenv("RTPU_TESTING_PARTITION_FILE", part.path)
+    ray_tpu.init(num_cpus=2)
+    agent = None
+    p = None
+    try:
+        env = flags.child_env(**part.env("dagrec-nodeB"))
+        env.pop("RTPU_ARENA", None)
+        env.pop("RTPU_HOST_ID", None)
+        env["PYTHONPATH"] = (PKG_ROOT + os.pathsep
+                             + env.get("PYTHONPATH", ""))
+        from ray_tpu.core import context as ctx
+
+        before = {n["node_id"] for n in
+                  _client().request({"kind": "cluster_state"})["nodes"]}
+        agent = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu.core.host_agent",
+             "--controller",
+             ctx.get_worker_context().extra.get("address", ""),
+             "--resources", json.dumps({"CPU": 3, "blue": 3})],
+            env=env)
+        nid = _wait_for(
+            lambda: next(
+                (n["node_id"] for n in
+                 _client().request({"kind": "cluster_state"})["nodes"]
+                 if n["node_id"] not in before
+                 and (n.get("labels") or {}).get("head") != "1"), None),
+            desc="agent registration")
+
+        def slow_factory(idx, n, mesh):
+            def step(x):
+                if idx == 0:
+                    return x  # pass-through: s1 sees the raw input
+                if idx == 1 and x == 1:
+                    # One long microbatch (>> RTPU_DAG_STALL_S=2.0): the
+                    # driver's stall probes fire repeatedly while this
+                    # sleeps, and the blackhole below fits entirely
+                    # inside it — no channel frame crosses the wire
+                    # while frames are being dropped.
+                    time.sleep(20.0)
+                return x + (10 if idx == 1 else 100)
+
+            return step
+
+        # Whole pipeline on nodeB: stage-to-stage edges are local rings
+        # there, so the blackhole starves only the control plane — the
+        # exact signature of a partition, not a death.
+        pin = {"resources": {"blue": 1},
+               "scheduling_strategy": NodeAffinitySchedulingStrategy(
+                   node_id=nid, soft=False)}
+        p = MPMDPipeline([slow_factory] * 3, max_in_flight=2,
+                         stage_options=[dict(pin) for _ in range(3)])
+        assert p.mode == "channels"
+        assert p.submit(0).get(timeout=60) == 110  # pipe works pre-chaos
+        # Blackhole the host while a microbatch sleeps inside s1. The
+        # probe sees the worker unreachable, but the controller still
+        # calls the actor alive on the SAME worker: a partition signature,
+        # not a death — the probe must stay patient. The node goes
+        # SUSPECT; the heal lands before s1 wakes, so the terminal frame
+        # (fire-and-forget) is sent on a clean wire.
+        ref = p.submit(1)
+        time.sleep(0.5)  # input frame crosses before the blackhole
+        with part.partition("dagrec-nodeB"):
+            _wait_for(lambda: next(
+                (n for n in
+                 _client().request({"kind": "cluster_state"})["nodes"]
+                 if n["node_id"] == nid), {}).get("state") == "suspect",
+                timeout=8, desc="suspect state")
+            time.sleep(8)  # ~10s of blackhole total, heal before t=20
+        assert ref.get(timeout=120) == 111
+        assert p.submit(2).get(timeout=60) == 112  # post-heal flow
+        assert p.recoveries == 0, \
+            "a partition that heals must not burn a restart"
+        kinds = _event_kinds(kinds=["NODE_SUSPECT", "DAG_RECOVERING"])
+        assert "NODE_SUSPECT" in kinds
+        assert "DAG_RECOVERING" not in kinds
+    finally:
+        if p is not None:
+            p.teardown()
+        ray_tpu.shutdown()
+        if agent is not None:
+            agent.terminate()
+        part.stop()
+
+
+@pytest.mark.chaos
+def test_drain_migrates_stage_with_zero_failed_refs(tmp_path):
+    """ACCEPTANCE: `drain_node` under a live pipeline proactively migrates
+    the hosted stage (snapshot at a seq boundary, restore elsewhere,
+    channel rebuild, replay): every ref resolves with its value — zero
+    failed refs — and the node finishes draining."""
+    from ray_tpu.core.cluster_utils import Cluster
+    from ray_tpu.parallel import MPMDPipeline
+    from ray_tpu.util import state as state_api
+    from ray_tpu.util.scheduling_strategies import (
+        NodeAffinitySchedulingStrategy)
+
+    cluster = Cluster(head_resources={"CPU": 4})
+    p = None
+    try:
+        nid = cluster.add_node({"CPU": 2}, remote=True,
+                               host_id="dagrec-host-drain")
+        marker = str(tmp_path / "markers.txt")
+        p = MPMDPipeline(
+            [_marking_factory(marker)] * 3, max_in_flight=4,
+            stage_options=[
+                None,
+                {"checkpoint_every_n": 1,
+                 "scheduling_strategy": NodeAffinitySchedulingStrategy(
+                     node_id=nid, soft=True)},
+                None])
+        assert p.mode == "channels"
+        assert p._compiled._plan["endpoints"]["s1"]["node_id"] == nid
+
+        n = 24
+        refs = []
+        drain_res = {}
+        for i in range(n):
+            refs.append(p.submit(i))
+            time.sleep(0.03)
+            if i == 6:
+                drain_res = state_api.drain_node(
+                    nid, reason="manual", deadline_s=60)
+        outs = [r.get(timeout=120) for r in refs]  # ZERO failed refs
+        assert outs == [i + 111 for i in range(n)]
+        lines = open(marker).read().split()
+        assert sorted(lines, key=int) == [str(i + 1) for i in range(n)]
+        assert drain_res.get("state") in ("drained", "draining")
+        assert p._compiled._plan["endpoints"]["s1"]["node_id"] != nid
+        assert p.recoveries >= 1
+    finally:
+        if p is not None:
+            p.teardown()
+        cluster.shutdown()
+
+
+@pytest.mark.chaos
+def test_recovery_disabled_keeps_failfast_teardown(monkeypatch):
+    """RTPU_DAG_RECOVERY=0 reproduces the PR-10 contract byte-for-byte:
+    a dead participant tears the whole DAG down with DAGTeardownError on
+    every outstanding ref — even when the stage actor HAS restart budget
+    and durable checkpoints that recovery could have used."""
+    from ray_tpu.testing.fault_injection import WorkerKiller
+
+    monkeypatch.setenv("RTPU_DAG_RECOVERY", "0")
+    ray_tpu.init(num_cpus=4)
+    try:
+
+        @ray_tpu.remote
+        class Restartable:
+            def step(self, x):
+                time.sleep(0.05)
+                return x + 1
+
+        stages = [Restartable.options(
+            max_restarts=4, max_task_retries=1,
+            checkpoint_every_n=1).bind() for _ in range(3)]
+        with InputNode() as inp:
+            dag = stages[2].step.bind(
+                stages[1].step.bind(stages[0].step.bind(inp)))
+        compiled = dag.experimental_compile(max_in_flight=8)
+        assert compiled._mode == "channels"
+        dag_id = compiled.dag_id
+        refs = [compiled.execute(i) for i in range(8)]
+        victim = compiled._plan["endpoints"]["s1"]["worker_id"]
+        killer = WorkerKiller(
+            worker_filter=lambda w: w.get("worker_id") == victim)
+        assert killer.kill_once() is not None
+        outcomes = []
+        for r in refs:
+            try:
+                outcomes.append(("ok", r.get(timeout=30)))
+            except DAGTeardownError as e:
+                outcomes.append(("torn", str(e)))
+        assert any(kind == "torn" for kind, _ in outcomes), outcomes
+        with pytest.raises(DAGTeardownError):
+            compiled.execute(99)
+        compiled.teardown()
+        assert _wait_no_leftovers(dag_id) == []
+    finally:
+        ray_tpu.shutdown()
+
+
+@pytest.mark.chaos
+def test_teardown_reaps_stream_state_and_sidecars_after_peer_kill():
+    """Teardown hygiene across a cross-host edge after the peer was
+    SIGKILLed (fail-fast mode for determinism): the surviving side's
+    stream-edge state and every per-seq sidecar segment (oversize
+    payloads) are reaped — channel arena accounting returns to baseline
+    and /dev/shm holds nothing under the DAG's prefix."""
+    from ray_tpu.core.cluster_utils import Cluster
+    from ray_tpu.util.scheduling_strategies import (
+        NodeAffinitySchedulingStrategy)
+
+    flags.set_env("RTPU_DAG_RECOVERY", "0")
+    cluster = Cluster(head_resources={"CPU": 4})
+    try:
+        nid = cluster.add_node({"CPU": 2}, remote=True,
+                               host_id="dagrec-host-leak")
+        before = channel_segment_stats()
+
+        @ray_tpu.remote
+        class Echo:
+            def step(self, x):
+                time.sleep(0.02)
+                return x
+
+        a = Echo.remote()
+        b = Echo.options(
+            scheduling_strategy=NodeAffinitySchedulingStrategy(
+                node_id=nid, soft=False)).remote()
+        c = Echo.remote()
+        # Warm the handles: compile resolves endpoints without waiting,
+        # and the remote-node actor starts slower than a local one.
+        ray_tpu.get([h.step.remote(0) for h in (a, b, c)], timeout=60)
+        with InputNode() as inp:
+            dag = c.step.bind(b.step.bind(a.step.bind(inp)))
+        compiled = dag.experimental_compile(max_in_flight=4)
+        assert compiled._mode == "channels"
+        dag_id = compiled.dag_id
+        # Cross-host hops both ways around s1: stream edges with per-seq
+        # sidecars (payload > slot size spills).
+        assert "s1" in compiled._plan["edges"]["e0"]["streams"]
+        big = bytes(2 * int(flags.get("RTPU_DAG_SLOT_BYTES")))
+        refs = [compiled.execute(big) for i in range(6)]
+        os.kill(
+            _worker_row(compiled._plan["endpoints"]["s1"]["worker_id"])
+            ["pid"], signal.SIGKILL)
+        for r in refs:
+            try:
+                r.get(timeout=30)
+            except DAGTeardownError:
+                pass
+        compiled.teardown()
+        assert channel_segment_stats() == before
+        assert _wait_no_leftovers(dag_id) == []
+    finally:
+        flags.unset_env("RTPU_DAG_RECOVERY")
+        cluster.shutdown()

@@ -385,6 +385,10 @@ def telemetry_cluster():
     }
     old = {k: os.environ.get(k) for k in env}
     os.environ.update(env)
+    # A session left behind by an earlier module was initialized BEFORE
+    # the env above — reusing it would run the TSDB at the default step
+    # and none of the timing below would hold. Always start fresh.
+    ray_tpu.shutdown()
     handle = ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
     yield handle
     ray_tpu.shutdown()
@@ -421,13 +425,16 @@ def test_query_metrics_live_history(telemetry_cluster):
         resp = state.query_metrics("rtpu_workers")
         if not resp["enabled"]:
             return None
+        # Poll the step too: early responses can arrive while the TSDB
+        # thread is still picking up the fixture's configured cadence.
+        if resp["step_s"] != pytest.approx(0.2):
+            return None
         ser = [s for s in resp["series"] if len(s["points"]) >= 3]
         return (resp, ser[0]) if ser else None
 
     got = _poll(gauge_ready, timeout=30)
-    assert got, "rtpu_workers never accumulated 3 ring points"
+    assert got, "rtpu_workers never accumulated 3 ring points at step 0.2"
     resp, ser = got
-    assert resp["step_s"] == pytest.approx(0.2)
     ts = [t for t, _ in ser["points"]]
     assert ts == sorted(ts)
     # The earliest samples can predate worker spawn (0 workers); the ring
