@@ -43,6 +43,7 @@ from .core.api import (
 )
 from .core.controller import (
     ActorDiedError,
+    DeadlineExceededError,
     DependencyError,
     NodePreemptedError,
     ObjectLostError,
@@ -87,6 +88,7 @@ __all__ = [
     "ActorClass",
     "RemoteFunction",
     "RayTpuError",
+    "DeadlineExceededError",
     "TaskCancelledError",
     "TaskError",
     "GetTimeoutError",

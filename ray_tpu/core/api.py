@@ -734,6 +734,14 @@ class RemoteFunction:
         spec["args_blob"] = args_blob
         spec["deps"] = deps
         spec["return_ids"] = return_ids
+        if opts.get("deadline_s") is not None:
+            # Absolute end-to-end deadline: every queue boundary (scheduler
+            # pop, worker dequeue) drops the spec once it passes.
+            spec["deadline_ts"] = time.time() + float(opts["deadline_s"])
+        ptid = ctx.current_task_id()
+        if ptid:
+            # Ownership edge for rtpu.cancel(recursive=True).
+            spec["parent_task_id"] = ptid
         _attach_runtime_env(wc, opts, spec)
         if streaming:
             _streaming_spec_opts(opts, spec)
@@ -754,6 +762,12 @@ class RemoteFunction:
             _track_inflight(spec)
             _pipelined_submit(wc, {"kind": "submit_task", "spec": spec},
                               spec["return_ids"])
+        elif "parent_task_id" in spec:
+            # Direct push: the controller never sees the submission, so the
+            # ownership edge for recursive cancel ships as a fire-and-forget
+            # note (only paid when running INSIDE a task — driver submits
+            # carry no parent and skip this entirely).
+            _note_task_lineage(wc, spec)
         if streaming:
             return ObjectRefGenerator(spec["task_id"])
         refs = _claim_return_refs(return_ids)
@@ -1181,10 +1195,12 @@ def _make_actor_batch_done(wc, route: "_ActorRoute"):
             for spec in batch.specs:
                 for oid in spec.get("return_ids", ()):
                     _inflight_direct.pop(oid, None)
+                    _direct_task_meta.pop(oid, None)
         else:
             for spec in batch.specs:
                 for oid in spec.get("return_ids", ()):
                     _inflight_direct.pop(oid, None)
+                    _direct_task_meta.pop(oid, None)
             # Runs on the io thread — hand recovery to a plain thread (it
             # issues blocking controller RPCs).
             threading.Thread(
@@ -1203,6 +1219,18 @@ _inflight_direct: Dict[str, Any] = {}
 _direct_task_meta: Dict[str, Any] = {}
 
 
+def _note_task_lineage(wc, spec: Dict[str, Any]) -> None:
+    """Ship the parent->child ownership edge for a directly-pushed spec so
+    rtpu.cancel(recursive=True) can find it (fire-and-forget; only emitted
+    when submitting from INSIDE a task)."""
+    try:
+        wc.client.send_nowait(
+            {"kind": "task_lineage",
+             "edges": [(spec["parent_task_id"], spec["task_id"])]})
+    except Exception:
+        pass
+
+
 def _direct_submit(wc, route: "_ActorRoute", spec: Dict[str, Any]) -> bool:
     conn = route.conn
     if conn is None:
@@ -1217,6 +1245,8 @@ def _direct_submit(wc, route: "_ActorRoute", spec: Dict[str, Any]) -> bool:
     if batcher is not None and flags.get("RTPU_SUBMIT_BATCH"):
         # Batched push: calls appended in one loop beat ride one frame;
         # per-batch bookkeeping lives in _make_actor_batch_done.
+        for oid in spec.get("return_ids", ()):
+            _direct_task_meta[oid] = (spec["task_id"], conn)
         batcher.add(spec, spec.get("return_ids", ()))
         return True
     try:
@@ -1227,6 +1257,10 @@ def _direct_submit(wc, route: "_ActorRoute", spec: Dict[str, Any]) -> bool:
         return False
     for oid in spec.get("return_ids", ()):
         _inflight_direct[oid] = fut
+        # Cancel routing: rtpu.cancel(ref) on a direct-pushed actor call
+        # rides this same connection straight to the hosting worker — the
+        # controller never saw the spec, so it could not help.
+        _direct_task_meta[oid] = (spec["task_id"], conn)
 
     def done(f, wc=wc, route=route, spec=spec):
         for oid in spec.get("return_ids", ()):
@@ -1990,16 +2024,20 @@ def method(*, num_returns: int = 1):
 
 
 class ActorMethod:
-    def __init__(self, handle: "ActorHandle", name: str, num_returns=1):
+    def __init__(self, handle: "ActorHandle", name: str, num_returns=1,
+                 deadline_s=None):
         self._handle = handle
         self._name = name
         self._num_returns = num_returns
+        self._deadline_s = deadline_s
 
-    def options(self, num_returns=1) -> "ActorMethod":
-        return ActorMethod(self._handle, self._name, num_returns)
+    def options(self, num_returns=1, deadline_s=None) -> "ActorMethod":
+        return ActorMethod(self._handle, self._name, num_returns, deadline_s)
 
     def remote(self, *args, **kwargs):
-        return self._handle._submit(self._name, args, kwargs, self._num_returns)
+        return self._handle._submit(self._name, args, kwargs,
+                                    self._num_returns,
+                                    deadline_s=self._deadline_s)
 
     def bind(self, *args, **kwargs):
         """Lazy DAG node for this method on an existing actor handle."""
@@ -2035,7 +2073,8 @@ class ActorHandle:
                            self._method_defaults.get(name, {}).get(
                                "num_returns", 1))
 
-    def _submit(self, method: str, args, kwargs, num_returns):
+    def _submit(self, method: str, args, kwargs, num_returns,
+                deadline_s=None):
         wc = ctx.get_worker_context()
         streaming = num_returns == "streaming"
         args_blob, deps, nested_refs = pack_args(args, kwargs)
@@ -2062,6 +2101,13 @@ class ActorHandle:
         spec["deps"] = deps
         spec["return_ids"] = return_ids
         spec["seqno"] = _next_actor_seqno(self._actor_id)
+        if deadline_s is not None:
+            # Absolute deadline: mailbox dequeue drops the call once it
+            # passes instead of executing dead work.
+            spec["deadline_ts"] = time.time() + float(deadline_s)
+        ptid = ctx.current_task_id()
+        if ptid:
+            spec["parent_task_id"] = ptid
         if streaming:
             _streaming_spec_opts({}, spec)
         if deps or nested_refs:
@@ -2080,6 +2126,8 @@ class ActorHandle:
                 submitted = _direct_submit(wc, route, spec)
         if not submitted:
             wc.client.request({"kind": "submit_actor_task", "spec": spec})
+        elif "parent_task_id" in spec:
+            _note_task_lineage(wc, spec)
         if streaming:
             return ObjectRefGenerator(spec["task_id"])
         refs = _claim_return_refs(return_ids)
@@ -2208,14 +2256,18 @@ def remote(*args, **kwargs):
     return wrap
 
 
-def cancel(ref: ObjectRef, *, force: bool = False) -> None:
+def cancel(ref: ObjectRef, *, force: bool = False,
+           recursive: bool = False) -> None:
     """Cancel the task producing ``ref`` (reference: ray.cancel). Queued
-    tasks fail immediately with TaskCancelledError; running tasks get the
-    exception raised in their executing thread (force=True kills the
-    hosting worker instead, for code that swallows exceptions)."""
+    tasks fail immediately with TaskCancelledError — no worker round-trip;
+    running tasks get the exception raised in their executing thread
+    (force=True kills the hosting worker instead, for code that swallows
+    exceptions). recursive=True also cancels every live descendant task
+    via the controller's ownership tree. Cancelling a finished ref (or
+    cancelling twice) is a no-op."""
     wc = ctx.get_worker_context()
     meta = _direct_task_meta.get(ref.object_id)
-    if meta is not None and not force:
+    if meta is not None and not force and not recursive:
         # Directly-pushed task: the controller never saw the spec — the
         # cancel rides the same lease connection the push did.
         task_id, conn = meta
@@ -2225,8 +2277,26 @@ def cancel(ref: ObjectRef, *, force: bool = False) -> None:
             return
         except Exception:
             pass  # route died: the crash path fails the task anyway
-    wc.client.request({"kind": "cancel_task", "object_id": ref.object_id,
-                       "force": force})
+    msg = {"kind": "cancel_task", "object_id": ref.object_id,
+           "force": force, "recursive": recursive}
+    tid = _inflight_oid2task.get(ref.object_id)
+    if tid is not None:
+        # Controller-routed task: name it outright so a recursive cancel
+        # of an already-FINISHED parent can still walk the ownership tree
+        # (the return-oid scan only finds live specs).
+        msg["task_id"] = tid
+    if meta is not None:
+        # Direct push + recursive: the controller holds only the lineage
+        # note, keyed by task id — send it so the walk can start there,
+        # and reach the task itself through the lease route as usual.
+        msg["task_id"] = meta[0]
+        task_id, conn = meta
+        try:
+            wc.client.io.call_nowait(conn.send(
+                {"kind": "cancel_task", "task_id": task_id}))
+        except Exception:
+            pass
+    wc.client.request(msg)
 
 
 def kill(actor: ActorHandle, *, no_restart: bool = True) -> None:

@@ -416,6 +416,20 @@ class Controller:
         self.lineage: "_collections.OrderedDict[str, Dict[str, Any]]" = (
             _collections.OrderedDict())
         self.lineage_max = flags.get("RTPU_LINEAGE_MAX")
+        # Ownership tree for recursive cancel: parent task id -> live child
+        # task ids, plus child -> parent back-pointers for pruning. Edges
+        # come from spec["parent_task_id"] (controller-path submissions) or
+        # fire-and-forget task_lineage notes (direct pushes). A finished
+        # task drops its own parent edge but keeps its children set so a
+        # recursive cancel can still traverse THROUGH a finished middle
+        # task to running grandchildren; the set self-cleans as they finish.
+        self.task_children: Dict[str, Set[str]] = {}
+        self.task_parent: Dict[str, str] = {}
+        # Finished-task return-oid -> task id (bounded FIFO): a recursive
+        # cancel of an ALREADY-FINISHED parent must still locate the
+        # subtree root to kill its running descendants.
+        self.done_oid2task: "_collections.OrderedDict[str, str]" = (
+            _collections.OrderedDict())
         self.functions: Dict[str, bytes] = {}  # function/class table (gcs_function_manager)
         self.kv: Dict[Tuple[str, str], bytes] = {}
         self.pgs: Dict[str, PGInfo] = {}
@@ -1784,6 +1798,7 @@ class Controller:
         if rids and all(r in self.objects for r in rids):
             return {"ok": True, "dup": True}
         self.tasks[spec["task_id"]] = spec
+        self._note_child(spec)
         spec["state"] = "waiting_deps"
         if spec.get("streaming"):
             self.generators[spec["task_id"]] = GeneratorState(
@@ -1884,8 +1899,40 @@ class Controller:
                 return DependencyError(f"upstream task failed for object {oid[:8]}")
         return None
 
+    def _note_child(self, spec: Dict[str, Any]) -> None:
+        ptid = spec.get("parent_task_id")
+        if not ptid:
+            return
+        # Hard cap: a pathological fan-out must not let the tree outgrow
+        # the task table it mirrors.
+        if len(self.task_parent) > 4 * self.lineage_max:
+            return
+        self.task_children.setdefault(ptid, set()).add(spec["task_id"])
+        self.task_parent[spec["task_id"]] = ptid
+
+    async def _h_task_lineage(self, conn, msg):
+        """Fire-and-forget ownership note for directly-pushed child tasks
+        (the controller never sees their submission): parent -> child edges
+        feeding the recursive-cancel tree."""
+        for parent, child in msg.get("edges") or ():
+            if parent and child and len(self.task_parent) <= 4 * self.lineage_max:
+                self.task_children.setdefault(parent, set()).add(child)
+                self.task_parent[child] = parent
+        return {"ok": True}
+
+    def _prune_child(self, task_id: str) -> None:
+        ptid = self.task_parent.pop(task_id, None)
+        if ptid is None:
+            return
+        kids = self.task_children.get(ptid)
+        if kids is not None:
+            kids.discard(task_id)
+            if not kids:
+                self.task_children.pop(ptid, None)
+
     def _fail_task(self, spec, err: Exception) -> None:
         self.tasks.pop(spec["task_id"], None)
+        self._prune_child(spec["task_id"])
         self._record_task_event(spec, "failed")
         self._finalize_generator(spec["task_id"], err)
         for oid in spec["return_ids"]:
@@ -1902,41 +1949,124 @@ class Controller:
     async def _h_cancel_task(self, conn, msg):
         """ray.cancel (reference: python/ray/_private/worker.py cancel +
         CancelTask RPC): a QUEUED task is failed in place with
-        TaskCancelledError; a RUNNING one gets an async-raise in its
-        executing thread (force=True kills the worker process instead —
-        for code that swallows exceptions)."""
-        oid = msg["object_id"]
+        TaskCancelledError — no worker round-trip; a RUNNING one gets an
+        async-raise in its executing thread (force=True kills the worker
+        process instead — for code that swallows exceptions). An actor
+        call's cancel removes the still-queued spec or interrupts the
+        hosting worker's mailbox entry. recursive=True additionally walks
+        the ownership tree and cancels every live descendant. Every path
+        is idempotent: double-cancel and cancel-of-finished are no-ops."""
         force = bool(msg.get("force"))
+        recursive = bool(msg.get("recursive"))
+        oid = msg.get("object_id")
+        task_id = msg.get("task_id")
         spec = None
-        for t in self.tasks.values():
-            if oid in (t.get("return_ids") or ()):
-                spec = t
-                break
-        if spec is None:
+        if task_id is not None:
+            spec = self.tasks.get(task_id)
+        if spec is None and oid is not None:
+            for t in self.tasks.values():
+                if oid in (t.get("return_ids") or ()):
+                    spec = t
+                    task_id = t["task_id"]
+                    break
+        if spec is None and task_id is None and oid is not None:
+            # Finished parent: resolve the subtree root from the bounded
+            # done-oid map so recursive still reaches live descendants.
+            task_id = self.done_oid2task.get(oid)
+        if spec is None and oid is not None and oid in self.objects \
+                and not (recursive and task_id):
+            # Already finished: a cancel is a no-op, not an error.
+            return {"ok": True, "state": "finished"}
+        if spec is None and not (recursive and task_id):
             return {"ok": False, "reason": "unknown or already finished"}
+        state = await self._cancel_one(spec, force) or "finished"
+        descendants = 0
+        if recursive and task_id:
+            seen = {task_id}
+            frontier = list(self.task_children.get(task_id, ()))
+            while frontier:
+                child = frontier.pop()
+                if child in seen:
+                    continue
+                seen.add(child)
+                frontier.extend(self.task_children.get(child, ()))
+                cspec = self.tasks.get(child)
+                if cspec is not None:
+                    if await self._cancel_one(cspec, force):
+                        descendants += 1
+                elif child in self.task_parent:
+                    # A live edge but no controller-side spec: the child
+                    # was pushed directly to a leased worker. Broadcast the
+                    # mark — its host refuses it at dequeue or async-raises
+                    # the running thread; everyone else ignores it.
+                    await self._broadcast_cancel(child)
+                    descendants += 1
+        return {"ok": True, "state": state, "descendants": descendants}
+
+    async def _cancel_one(self, spec, force: bool) -> Optional[str]:
+        """Cancel a single live spec; returns the resulting state, or None
+        when there was nothing to do."""
+        if spec is None:
+            return None
         task_id = spec["task_id"]
+        if spec.get("__cancelled__"):
+            return "already_cancelled"
+        if spec.get("actor_id"):
+            actor = self.actors.get(spec["actor_id"])
+            spec["__cancelled__"] = True
+            spec["max_retries"] = 0
+            if actor is not None and spec in actor.pending_calls:
+                try:
+                    actor.pending_calls.remove(spec)
+                except ValueError:
+                    pass
+                self._fail_task(spec, TaskCancelledError(
+                    f"actor call {task_id[:8]} was cancelled before it started"))
+                self._record_task_event(spec, "cancelled")
+                return "queued"
+            w = self.workers.get(actor.worker_id or "") if actor else None
+            if w is None:
+                return "marked"
+            # The hosting worker either refuses the mailbox entry at
+            # dequeue or async-raises the running call. force degrades to
+            # the async-raise: killing the worker would take the whole
+            # actor (that is rtpu.kill's job).
+            try:
+                await w.conn.send({"kind": "cancel_task", "task_id": task_id})
+            except Exception:
+                pass
+            self._record_task_event(spec, "cancel_requested",
+                                    worker_id=w.worker_id)
+            return "running"
         w = next((x for x in self.workers.values()
                   if x.current_task == task_id), None)
         if w is None:
-            # Still queued: remove + fail the returns.
+            # Still queued: remove + fail the returns at the controller.
             self.pending_queue.remove(task_id)
             self._release_task_resources(spec)
             self._fail_task(spec, TaskCancelledError(
                 f"task {task_id[:8]} was cancelled before it started"))
             self._record_task_event(spec, "cancelled")
-            return {"ok": True, "state": "queued"}
+            return "queued"
+        spec["max_retries"] = 0  # a cancel must not resurrect it
+        spec["__cancelled__"] = True
         if force:
-            spec["max_retries"] = 0  # a force-cancel must not resurrect it
-            spec["__cancelled__"] = True
             await self._shutdown_worker(w)
-            return {"ok": True, "state": "force_killed"}
+            return "force_killed"
         try:
             await w.conn.send({"kind": "cancel_task", "task_id": task_id})
         except Exception:
             pass
         self._record_task_event(spec, "cancel_requested",
                                 worker_id=w.worker_id)
-        return {"ok": True, "state": "running"}
+        return "running"
+
+    async def _broadcast_cancel(self, task_id: str) -> None:
+        for w in list(self.workers.values()):
+            try:
+                await w.conn.send({"kind": "cancel_task", "task_id": task_id})
+            except Exception:
+                pass
 
     async def _h_task_spillback(self, conn, msg):
         """A worker's admission check rejected a dispatched task
@@ -2012,7 +2142,12 @@ class Controller:
             await self._resolve_deps_then_queue(spec)
             self._wake_scheduler()
             return {"ok": True}
+        self._prune_child(task_id)
         if spec is not None:
+            for oid in spec.get("return_ids") or ():
+                self.done_oid2task[oid] = task_id
+            while len(self.done_oid2task) > 4 * self.lineage_max:
+                self.done_oid2task.popitem(last=False)
             self._record_task_event(
                 spec, "failed" if msg.get("is_error") else "finished",
                 worker_id=msg.get("worker_id"))
@@ -2040,6 +2175,13 @@ class Controller:
             # and the task events. Resources stay pinned by the lease. The
             # worker's start timestamp synthesizes the "running" event the
             # timeline pairs with the terminal one.
+            for oid in msg["spec"].get("return_ids") or ():
+                # Leased tasks resolve through done_oid2task too: without
+                # this, a recursive cancel rooted at a FINISHED direct-push
+                # parent cannot find the subtree.
+                self.done_oid2task[oid] = msg["spec"].get("task_id", task_id)
+            while len(self.done_oid2task) > 4 * self.lineage_max:
+                self.done_oid2task.popitem(last=False)
             if msg.get("started_ts"):
                 w_lease = self.workers.get(msg.get("worker_id", ""))
                 self.task_events.append({
@@ -2322,6 +2464,7 @@ class Controller:
                 self._store_error(oid, err)
             return {"ok": True}
         self.tasks[spec["task_id"]] = spec
+        self._note_child(spec)
         if actor.state in ("pending", "restarting"):
             actor.pending_calls.append(spec)
         else:
@@ -2329,6 +2472,14 @@ class Controller:
         return {"ok": True}
 
     async def _dispatch_actor_call(self, actor: ActorInfo, spec: Dict[str, Any]) -> None:
+        dl = spec.get("deadline_ts")
+        if dl is not None and time.time() > dl:
+            # Expired while parked in pending_calls (or on arrival): the
+            # mailbox never sees dead work.
+            self._fail_task(spec, DeadlineExceededError(
+                f"actor call {spec['task_id'][:8]} deadline passed while queued"))
+            self._record_task_event(spec, "deadline_exceeded")
+            return
         w = self.workers.get(actor.worker_id or "")
         if w is None:
             if spec.get("replay") and actor.state != "dead":
@@ -4954,6 +5105,15 @@ class Controller:
                     q.popleft()
                     self.pending_queue._count -= 1
                     continue
+                dl = spec.get("deadline_ts")
+                if dl is not None and time.time() > dl:
+                    # Expired while queued: dead work never places.
+                    q.popleft()
+                    self.pending_queue._count -= 1
+                    self._fail_task(spec, DeadlineExceededError(
+                        f"task {spec['task_id'][:8]} deadline passed while queued"))
+                    self._record_task_event(spec, "deadline_exceeded")
+                    continue
                 placed = await self._try_place(spec)
                 if not placed:
                     stuck = True
@@ -5634,6 +5794,14 @@ class OutOfMemoryError(RayTpuError):
 class TaskCancelledError(RayTpuError):
     """The task was cancelled via ray_tpu.cancel (reference:
     ray.exceptions.TaskCancelledError)."""
+
+
+class DeadlineExceededError(RayTpuError, TimeoutError):
+    """The request's end-to-end deadline passed before (or while) it ran.
+    Raised at every queue boundary — scheduler pop, actor-mailbox dequeue,
+    serve router/replica/batcher — so expired work is dropped instead of
+    executed (reference: Serve request timeouts + gRPC DEADLINE_EXCEEDED
+    semantics)."""
 
 
 class ObjectLostError(RayTpuError):

@@ -308,6 +308,11 @@ DEFAULT_ALERT_RULES: List[dict] = [
      "tags": {"state": "suspect"}, "op": ">", "threshold": 0.0,
      "for_s": 0.0, "severity": "ERROR",
      "message": "node(s) missing heartbeats (suspect)"},
+    {"name": "serve_shed_rate_high", "metric": "rtpu_serve_shed_total",
+     "stat": "rate", "op": ">", "threshold": 1.0, "for_s": 10.0,
+     "severity": "WARNING",
+     "message": "serve shedding >1 req/s for 10s — sustained overload "
+                "(queue_full / breaker_open)"},
 ]
 
 

@@ -245,6 +245,8 @@ class ActorMailbox:
             spec["__recv_ts__"] = time.time()
         if self.replay and self._intercept_replay(spec):
             return  # duplicate of an applied/in-flight call: deduped
+        if spec.get("task_id"):
+            self.runtime.queued_actor_tasks[spec["task_id"]] = spec
         caller = spec.get("caller")
         seq = spec.get("seqno")
         if caller is None or seq is None:
@@ -338,7 +340,11 @@ class ActorMailbox:
             if self.exited:
                 # exit_actor already ran: a queued call must FAIL, not
                 # execute on (or double-complete against) a retired actor.
-                self.runtime._refuse_exited(spec)
+                # The claim pop keeps a racing cancel from also completing.
+                tid = spec.get("task_id")
+                if not tid or self.runtime.queued_actor_tasks.pop(
+                        tid, None) is not None:
+                    self.runtime._refuse_exited(spec)
                 continue
             self.runtime.run_task(spec, actor_instance=self.instance, mailbox=self)
 
@@ -417,6 +423,12 @@ class WorkerRuntime:
         self.dag_channels: Dict[str, Any] = {}
         self.running_threads: Dict[str, int] = {}  # task_id -> thread ident
         self.cancelled_tasks: set = set()  # ray.cancel'd before/while running
+        # Actor calls sitting in a mailbox (or its hold-back buffer), not
+        # yet executing: task_id -> spec. A cancel that atomically pops an
+        # entry owns its completion and fails it IMMEDIATELY — no waiting
+        # behind whatever runs ahead of it; run_task's matching pop claims
+        # execution, and a miss there means a cancel won the race.
+        self.queued_actor_tasks: Dict[str, Dict[str, Any]] = {}
         self.shutdown_event = threading.Event()
         # Direct-dispatch server: peers push actor tasks here without a
         # controller hop (reference: direct task transport,
@@ -877,6 +889,20 @@ class WorkerRuntime:
         still QUEUED here (lease executor / actor mailbox) is marked and
         refused at run_task start; a RUNNING one sees the exception at its
         next bytecode boundary."""
+        queued = self.queued_actor_tasks.pop(task_id, None)
+        if queued is not None:
+            # Still in an actor mailbox: this pop claims the call — fail
+            # it NOW, without waiting behind whatever executes ahead of it
+            # (the mailbox dequeue sees the missing claim and skips).
+            from .controller import TaskCancelledError
+
+            self._complete_error(queued, TaskCancelledError(
+                f"actor call {task_id[:8]} was cancelled while queued"), "")
+            return
+        if len(self.cancelled_tasks) > 8192:
+            # Recursive-cancel broadcasts mark every worker; ids for tasks
+            # that never arrive here would otherwise accumulate forever.
+            self.cancelled_tasks.pop()
         self.cancelled_tasks.add(task_id)
         ident = self.running_threads.get(task_id)
         if ident is not None:
@@ -1210,16 +1236,36 @@ class WorkerRuntime:
         tls.label = spec.get("label", "")
         if spec.get("actor_id") and actor_instance is not None:
             tls.actor_id = spec["actor_id"]
-        if mailbox is not None and (mailbox.replay or mailbox.ckpt_enabled):
-            # Completion paths journal the result / advance the checkpoint
-            # cadence through this handle (popped exactly once there).
-            spec["__mb__"] = mailbox
+        if mailbox is not None:
+            if (spec.get("actor_id")
+                    and self.queued_actor_tasks.pop(task_id, None) is None):
+                # A cancel atomically claimed this call while it sat in
+                # the mailbox and already completed it with
+                # TaskCancelledError — skip without double-completing.
+                tls.task_id = None
+                return
+            if mailbox.replay or mailbox.ckpt_enabled:
+                # Completion paths journal the result / advance the
+                # checkpoint cadence through this handle (popped exactly
+                # once there).
+                spec["__mb__"] = mailbox
         if task_id in self.cancelled_tasks:
             from .controller import TaskCancelledError
 
             self.cancelled_tasks.discard(task_id)
             self._complete_error(spec, TaskCancelledError(
                 f"task {task_id[:8]} was cancelled before it started"), "")
+            tls.task_id = None
+            return
+        dl = spec.get("deadline_ts")
+        if dl is not None and time.time() > dl:
+            # Dequeue-time deadline check (.options(deadline_s=...)): an
+            # expired spec — plain-task pool or actor mailbox alike — is
+            # refused, never executed.
+            from .controller import DeadlineExceededError
+
+            self._complete_error(spec, DeadlineExceededError(
+                f"task {task_id[:8]} deadline passed before it started"), "")
             tls.task_id = None
             return
         self.running_threads[task_id] = threading.get_ident()

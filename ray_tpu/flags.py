@@ -468,6 +468,40 @@ _define("RTPU_PROFILER", bool, True,
 _define("RTPU_PROFILER_HZ", float, 67.0,
         "Default sampling frequency of the wall-clock profiler.")
 
+# -- serve: deadlines, admission control, circuit breaking -------------------
+_define("RTPU_SERVE_ADMISSION", bool, True,
+        "Overload protection in the serve router: bounded per-deployment "
+        "queues (shed with BackPressureError -> HTTP 503 + Retry-After), "
+        "per-replica circuit breakers the power-of-two picker skips, and "
+        "a retry budget capped as a fraction of admitted traffic. 0 "
+        "restores the legacy unbounded-queue router; the request path "
+        "then pays exactly one flag check.")
+_define("RTPU_SERVE_MAX_QUEUED", int, 100,
+        "Default per-deployment queued-request bound (queued = accepted "
+        "by routers beyond the replicas' max_ongoing_requests capacity) "
+        "when the deployment does not set max_queued_requests. -1 means "
+        "unbounded.")
+_define("RTPU_SERVE_REQUEST_TIMEOUT_S", float, 60.0,
+        "Default end-to-end deadline for serve requests that do not carry "
+        "an explicit one (HTTP X-Request-Timeout-S header, gRPC envelope "
+        "timeout_s, or handle .options(deadline_s=...)). Expired work is "
+        "dropped with DeadlineExceededError at every queue boundary "
+        "instead of executing. <=0 means no default deadline.")
+_define("RTPU_SERVE_READY_TIMEOUT_S", float, 60.0,
+        "How long serve.run() waits for a deployment's replicas to become "
+        "ready before raising (was a hard-coded 60s).")
+_define("RTPU_SERVE_BREAKER_THRESHOLD", int, 5,
+        "Consecutive failures/timeouts on one replica before its circuit "
+        "breaker opens and the router routes around it.")
+_define("RTPU_SERVE_BREAKER_COOLDOWN_S", float, 5.0,
+        "How long an open replica breaker waits before letting one "
+        "half-open probe request through.")
+_define("RTPU_SERVE_RETRY_BUDGET", float, 0.2,
+        "Retry budget as a fraction of admitted traffic: each admitted "
+        "request earns this many retry tokens (bucket capped at 10x), "
+        "each retry spends one. Prevents retry amplification during an "
+        "outage.")
+
 # -- bench -------------------------------------------------------------------
 _define("RTPU_BENCH_TPU_TIMEOUT", int, 1500,
         "bench.py per-attempt TPU wall clock budget (seconds).")

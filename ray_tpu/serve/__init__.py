@@ -10,9 +10,13 @@ Parity map (reference python/ray/serve/, SURVEY.md §2.6):
 - serve.run/start/delete/status         -> api.py
 - LLM deployment over models.generate    -> llm.py
 """
+from ray_tpu.core.controller import DeadlineExceededError
+
+from .admission import BackPressureError
 from .api import (delete, get_app_handle, get_deployment_handle, run,
                   shutdown, start, status)
 from .batching import batch
+from .context import get_request_context, remaining_s
 from .multiplex import get_multiplexed_model_id, multiplexed
 from .deployment import Application, AutoscalingConfig, Deployment, deployment
 from .llm import build_llm_deployment, build_streaming_llm_deployment
@@ -21,6 +25,10 @@ from .handle import (DeploymentHandle, DeploymentResponse,
                      DeploymentStreamingResponse)
 
 __all__ = [
+    "BackPressureError",
+    "DeadlineExceededError",
+    "get_request_context",
+    "remaining_s",
     "deployment",
     "Deployment",
     "Application",

@@ -89,6 +89,7 @@ def run(target: Application, *, name: str = "default",
         cfg = {
             "num_replicas": dep.config.num_replicas,
             "max_ongoing_requests": dep.config.max_ongoing_requests,
+            "max_queued_requests": dep.config.max_queued_requests,
             "ray_actor_options": dep.config.ray_actor_options,
             "user_config": dep.config.user_config,
             "autoscaling_config": (
@@ -104,7 +105,10 @@ def run(target: Application, *, name: str = "default",
     # Wait for the ingress deployment to have live replicas; a deployment
     # whose constructor keeps failing must raise with the real error, not
     # hand back a handle that can never route.
-    deadline = time.time() + 60
+    from ray_tpu import flags
+
+    ready_timeout = flags.get("RTPU_SERVE_READY_TIMEOUT_S")
+    deadline = time.time() + ready_timeout
     while True:
         _, reps = ray_tpu.get(
             ctrl.get_replicas.remote(target.deployment.name))
@@ -115,7 +119,8 @@ def run(target: Application, *, name: str = "default",
                 ctrl.get_last_error.remote(target.deployment.name))
             raise RuntimeError(
                 f"deployment {target.deployment.name!r} has no live "
-                f"replicas after 60s; last replica error: {err}")
+                f"replicas after {ready_timeout:g}s; last replica error: "
+                f"{err}")
         time.sleep(0.1)
     if _http:
         start(http_port=http_port)

@@ -35,8 +35,12 @@ class _Batcher:
     def submit(self, item: Any) -> Any:
         # Note: the caller's frame keeps `self` strongly referenced for the
         # duration, so the batcher cannot be collected mid-request.
+        # The request deadline (serve context, set by the replica around
+        # user code) rides along so the seal step can drop expired items.
+        from ray_tpu.serve import context as serve_context
+
         slot: "queue.Queue" = queue.Queue(1)
-        self._queue.put((item, slot))
+        self._queue.put((item, slot, serve_context.get_request_deadline()))
         result = slot.get()
         if isinstance(result, _Err):
             raise result.exc
@@ -52,7 +56,7 @@ def _batcher_loop(ref: "weakref.ref[_Batcher]") -> None:
         timeout_s, max_bs = self.timeout_s, self.max_batch_size
         del self  # hold no strong ref (to batcher OR owner) while blocked
         try:
-            item, slot = q.get(timeout=1.0)
+            entry = q.get(timeout=1.0)
         except queue.Empty:
             continue
         # Deref fn only now: fetching it before the blocking get would root
@@ -65,7 +69,7 @@ def _batcher_loop(ref: "weakref.ref[_Batcher]") -> None:
             return
         fn = self.fn
         del self
-        batch = [(item, slot)]
+        batch = [entry]
         # Coalesce: wait up to timeout_s for more, cap at max size.
         t_end = time.time() + timeout_s
         while len(batch) < max_bs:
@@ -76,6 +80,24 @@ def _batcher_loop(ref: "weakref.ref[_Batcher]") -> None:
                 batch.append(q.get(timeout=remaining))
             except queue.Empty:
                 break
+        # Seal-time expiry sweep: items whose request deadline has already
+        # passed get the typed error instead of a seat in the batch — an
+        # expired request must never consume TPU batch capacity.
+        now = time.time()
+        live = []
+        for b in batch:
+            dl = b[2]
+            if dl is not None and now > dl:
+                from ray_tpu.core.controller import DeadlineExceededError
+
+                b[1].put(_Err(DeadlineExceededError(
+                    "request deadline passed while waiting in batch queue")))
+            else:
+                live.append(b)
+        batch = live
+        if not batch:
+            del fn
+            continue
         items = [b[0] for b in batch]
         try:
             results = fn(items)
@@ -83,11 +105,11 @@ def _batcher_loop(ref: "weakref.ref[_Batcher]") -> None:
                 raise ValueError(
                     f"batch fn returned {len(results)} results for "
                     f"{len(items)} inputs")
-            for (_, s), r in zip(batch, results):
-                s.put(r)
+            for b, r in zip(batch, results):
+                b[1].put(r)
         except Exception as e:
-            for _, s in batch:
-                s.put(_Err(e))
+            for b in batch:
+                b[1].put(_Err(e))
         del fn
 
 

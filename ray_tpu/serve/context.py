@@ -1,0 +1,53 @@
+"""Per-request serve context: the deadline that rides the whole path.
+
+Parity: reference serve/context.py (_serve_request_context ContextVar
+carrying request id + deadline). The proxy (or a handle's
+``.options(deadline_s=...)``) stamps an ABSOLUTE wall-clock deadline;
+every downstream hop — router assign, replica execution, @serve.batch
+seal, llm_engine slot wait — reads it from here, so nested handle
+composition inherits the caller's budget without threading kwargs
+through user code.
+"""
+from __future__ import annotations
+
+import contextvars
+import time
+from typing import Optional
+
+_request_ctx: "contextvars.ContextVar[Optional[dict]]" = (
+    contextvars.ContextVar("serve_request_ctx", default=None))
+
+
+def set_request_context(*, deadline_ts: Optional[float] = None,
+                        request_id: str = ""):
+    """Install the current request's context; returns a reset token."""
+    return _request_ctx.set(
+        {"deadline_ts": deadline_ts, "request_id": request_id})
+
+
+def reset_request_context(token) -> None:
+    _request_ctx.reset(token)
+
+
+def get_request_context() -> Optional[dict]:
+    return _request_ctx.get()
+
+
+def get_request_deadline() -> Optional[float]:
+    """Absolute (epoch-seconds) deadline of the active request, or None."""
+    c = _request_ctx.get()
+    return c.get("deadline_ts") if c else None
+
+
+def remaining_s(default: Optional[float] = None) -> Optional[float]:
+    """Seconds left on the active request's deadline. Expired requests
+    return 0.0 (never negative); no deadline returns ``default``."""
+    dl = get_request_deadline()
+    if dl is None:
+        return default
+    return max(0.0, dl - time.time())
+
+
+def expired() -> bool:
+    dl = get_request_deadline()
+    return dl is not None and time.time() > dl

@@ -118,6 +118,20 @@ class ServeController:
             raise KeyError(f"no deployment {name!r}")
         return info.version, list(info.replicas)
 
+    def get_routing_config(self, name: str) -> Dict[str, Any]:
+        """Admission-relevant config subset, fetched by routers alongside
+        the replica list: replica concurrency bound + queued-request bound
+        (None max_queued_requests defers to the RTPU_SERVE_MAX_QUEUED
+        flag default; -1 means unbounded)."""
+        info = self._deployments.get(name)
+        if info is None:
+            raise KeyError(f"no deployment {name!r}")
+        return {
+            "max_ongoing_requests": int(
+                info.config.get("max_ongoing_requests", 16) or 16),
+            "max_queued_requests": info.config.get("max_queued_requests"),
+        }
+
     def get_deployment_names(self) -> List[str]:
         return list(self._deployments)
 

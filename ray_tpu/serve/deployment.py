@@ -27,6 +27,12 @@ class AutoscalingConfig:
 class DeploymentConfig:
     num_replicas: int = 1
     max_ongoing_requests: int = 16
+    # Queue bound ABOVE the replicas' max_ongoing capacity: requests past
+    # num_replicas*max_ongoing + max_queued_requests shed with
+    # BackPressureError (HTTP 503 + Retry-After). None defers to the
+    # RTPU_SERVE_MAX_QUEUED flag default; -1 means unbounded (reference:
+    # Serve max_queued_requests, handle-side).
+    max_queued_requests: Optional[int] = None
     ray_actor_options: Dict[str, Any] = dataclasses.field(
         default_factory=dict)
     autoscaling_config: Optional[AutoscalingConfig] = None
@@ -86,6 +92,7 @@ def deployment(
     name: Optional[str] = None,
     num_replicas: Optional[int] = None,
     max_ongoing_requests: Optional[int] = None,
+    max_queued_requests: Optional[int] = None,
     ray_actor_options: Optional[Dict[str, Any]] = None,
     autoscaling_config: Optional[Union[AutoscalingConfig, Dict]] = None,
     user_config: Optional[Dict[str, Any]] = None,
@@ -100,6 +107,8 @@ def deployment(
             cfg.num_replicas = num_replicas
         if max_ongoing_requests is not None:
             cfg.max_ongoing_requests = max_ongoing_requests
+        if max_queued_requests is not None:
+            cfg.max_queued_requests = max_queued_requests
         if ray_actor_options is not None:
             cfg.ray_actor_options = dict(ray_actor_options)
         if autoscaling_config is not None:

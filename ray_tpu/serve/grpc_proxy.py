@@ -16,9 +16,25 @@ from concurrent import futures
 from typing import Any, Dict, Optional
 
 import ray_tpu
+from ray_tpu import flags
+from ray_tpu.core.controller import DeadlineExceededError
 
+from .admission import BackPressureError
 from .controller import CONTROLLER_NAME
 from .handle import DeploymentHandle
+
+
+def _envelope_timeout_s(request) -> float:
+    """Per-request budget from the JSON envelope's timeout_s field, else
+    the RTPU_SERVE_REQUEST_TIMEOUT_S flag default (the fix for the old
+    hard-coded 60s)."""
+    try:
+        v = float(request.get("timeout_s") or 0)
+        if v > 0:
+            return v
+    except (TypeError, ValueError):
+        pass
+    return float(flags.get("RTPU_SERVE_REQUEST_TIMEOUT_S"))
 
 
 def _ser(obj) -> bytes:
@@ -97,21 +113,49 @@ class GRPCProxy:
         return handle, info
 
     def _call(self, request, context):
+        import grpc
+
         try:
             handle, _ = self._handle_for(request)
-            result = handle.remote(request.get("input")).result(timeout=60)
+            result = handle.options(
+                deadline_s=_envelope_timeout_s(request)).remote(
+                request.get("input")).result()
             return {"result": result}
+        except BackPressureError as e:
+            context.set_trailing_metadata(
+                (("retry-after-s", f"{e.retry_after_s:g}"),))
+            context.abort(grpc.StatusCode.RESOURCE_EXHAUSTED, str(e))
+        except DeadlineExceededError as e:
+            context.abort(grpc.StatusCode.DEADLINE_EXCEEDED, str(e))
         except Exception as e:
-            import grpc
-
             context.abort(grpc.StatusCode.INTERNAL, str(e))
 
     def _call_stream(self, request, context):
+        import grpc
+
+        stream = None
         try:
             handle, _ = self._handle_for(request)
-            for item in handle.options(stream=True).remote(request.get("input")):
+            stream = iter(handle.options(
+                stream=True,
+                deadline_s=_envelope_timeout_s(request)).remote(
+                request.get("input")))
+            for item in stream:
+                if not context.is_active():
+                    # Client went away mid-stream: stop pulling; the
+                    # finally's close() aborts the replica generator and
+                    # frees its engine slot now.
+                    return
                 yield {"item": item}
+        except BackPressureError as e:
+            context.set_trailing_metadata(
+                (("retry-after-s", f"{e.retry_after_s:g}"),))
+            context.abort(grpc.StatusCode.RESOURCE_EXHAUSTED, str(e))
+        except DeadlineExceededError as e:
+            context.abort(grpc.StatusCode.DEADLINE_EXCEEDED, str(e))
         except Exception as e:
-            import grpc
-
             context.abort(grpc.StatusCode.INTERNAL, str(e))
+        finally:
+            close = getattr(stream, "close", None)
+            if close is not None:
+                close()

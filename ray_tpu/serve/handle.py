@@ -17,7 +17,13 @@ import time
 from typing import Any, Dict, List, Optional
 
 import ray_tpu
+from ray_tpu import flags
+from ray_tpu.core.controller import (ActorDiedError, DeadlineExceededError,
+                                     GetTimeoutError, TaskCancelledError,
+                                     TaskError, WorkerCrashedError)
 
+from . import admission
+from . import context as serve_context
 from .controller import CONTROLLER_NAME
 
 
@@ -25,21 +31,64 @@ class DeploymentNotFoundError(Exception):
     """The handle's deployment no longer exists on the controller."""
 
 
+def _unwrap(err: BaseException) -> BaseException:
+    """Typed control-flow errors (deadline, cancel) travel wrapped in
+    TaskError when they fire inside the worker; callers want the type."""
+    if isinstance(err, TaskError) and isinstance(
+            err.cause, (DeadlineExceededError, TaskCancelledError)):
+        return err.cause
+    return err
+
+
 class DeploymentResponse:
     """Future-like result of handle.remote() (reference DeploymentResponse:
     resolves to the result; .result() blocks; ._to_object_ref for chaining)."""
 
-    def __init__(self, ref, router, replica_key):
+    def __init__(self, ref, router, replica_key, deadline_ts=None):
         self._ref = ref
         self._router = router
         self._replica_key = replica_key
+        self._deadline_ts = deadline_ts
         self._done = False
 
     def result(self, timeout: Optional[float] = None) -> Any:
+        if timeout is None and self._deadline_ts is not None:
+            # Default the wait to the request's remaining budget.
+            timeout = max(0.0, self._deadline_ts - time.time())
         try:
-            return ray_tpu.get(self._ref, timeout=timeout)
+            out = ray_tpu.get(self._ref, timeout=timeout)
+        except GetTimeoutError as e:
+            if (self._deadline_ts is not None
+                    and time.time() >= self._deadline_ts):
+                # The request's own budget ran out — that is the client's
+                # deadline, not a replica fault: no breaker strike.
+                admission.deadline_exceeded(self._router.name)
+                raise DeadlineExceededError(
+                    f"request to {self._router.name} deadline exceeded "
+                    f"while awaiting the result") from e
+            self._router._note_result(self._replica_key, e)
+            raise
+        except Exception as e:
+            e2 = _unwrap(e)
+            self._router._note_result(self._replica_key, e2)
+            if e2 is not e:
+                raise e2 from e
+            raise
+        else:
+            self._router._note_result(self._replica_key, None)
+            return out
         finally:
             self._release()
+
+    def cancel(self) -> None:
+        """Cancel the in-flight replica call: a queued mailbox entry is
+        refused at dequeue, a running one gets the async-raise."""
+        try:
+            ray_tpu.cancel(self._ref)
+        except Exception:
+            pass
+        admission.cancelled(self._router.name)
+        self._release()
 
     def _release(self) -> None:
         if not self._done:
@@ -64,25 +113,46 @@ class DeploymentStreamingResponse:
     DeploymentResponseGenerator, serve/handle.py). Yields VALUES; the
     underlying transport is the core streaming-generator protocol."""
 
-    def __init__(self, ref_gen, router, replica_key):
+    def __init__(self, ref_gen, router, replica_key, deadline_ts=None):
         self._gen = ref_gen
         self._router = router
         self._replica_key = replica_key
+        self._deadline_ts = deadline_ts
         self._done = False
+        self._exhausted = False
 
     def __iter__(self):
         return self
 
     def __next__(self):
+        if (self._deadline_ts is not None
+                and time.time() > self._deadline_ts):
+            # The consumer's budget ran out mid-stream: stop pulling and
+            # close the producer (frees its engine slot).
+            admission.deadline_exceeded(self._router.name)
+            self._release()
+            raise DeadlineExceededError(
+                f"stream from {self._router.name} deadline exceeded")
         try:
             ref = next(self._gen)
         except StopIteration:
+            self._exhausted = True
+            self._router._note_result(self._replica_key, None)
             self._release()
             raise
-        except Exception:
+        except Exception as e:
+            self._router._note_result(self._replica_key, _unwrap(e))
             self._release()
             raise
         return ray_tpu.get(ref)
+
+    def close(self) -> None:
+        """Client walked away (HTTP disconnect / explicit abort): close the
+        producer generator — the replica sees GeneratorExit and aborts its
+        engine request, freeing the KV slot immediately."""
+        if not self._done and not self._exhausted:
+            admission.cancelled(self._router.name)
+        self._release()
 
     def _release(self) -> None:
         if not self._done:
@@ -150,6 +220,13 @@ class Router:
         self._avoid: set = set()
         self._controller = None
         self._last_refresh = 0.0
+        # Admission control (RTPU_SERVE_ADMISSION): per-replica circuit
+        # breakers, the retry token bucket, and the deployment's queue
+        # bound (refreshed with the replica list; None until fetched).
+        self._board = admission.BreakerBoard(deployment_name)
+        self._budget = admission.RetryBudget()
+        self._max_ongoing = 16
+        self._max_queued: Optional[int] = None
         _routers.add(self)
         _ensure_push_subscription()
 
@@ -174,6 +251,13 @@ class Router:
                     self._replicas = []
                 raise DeploymentNotFoundError(self.name) from e
             raise
+        rcfg = None
+        if flags.get("RTPU_SERVE_ADMISSION"):
+            try:
+                rcfg = ray_tpu.get(
+                    self._ctrl().get_routing_config.remote(self.name))
+            except Exception:
+                rcfg = None  # older controller: keep previous bounds
         avoid = self._replicas_on_draining_nodes(replicas)
         with self._lock:
             self._version = version
@@ -182,6 +266,12 @@ class Router:
             self._inflight = {r._actor_id: self._inflight.get(r._actor_id, 0)
                               for r in replicas}
             self._last_refresh = now
+            if rcfg is not None:
+                self._max_ongoing = int(rcfg.get("max_ongoing_requests", 16))
+                mq = rcfg.get("max_queued_requests")
+                self._max_queued = (flags.get("RTPU_SERVE_MAX_QUEUED")
+                                    if mq is None else int(mq))
+        self._board.prune([r._actor_id for r in replicas])
 
     @staticmethod
     def _replicas_on_draining_nodes(replicas) -> set:
@@ -207,14 +297,26 @@ class Router:
         except Exception:
             return set()
 
-    def _pick(self):
+    def _pick(self, use_breaker: bool = False):
         """Power-of-two-choices over local in-flight counts; replicas on
-        draining nodes are out of the draw while any alternative exists."""
+        draining nodes are out of the draw while any alternative exists,
+        and (admission on) so are replicas with open circuit breakers."""
         with self._lock:
             reps = [r for r in self._replicas
                     if r._actor_id not in self._avoid] or self._replicas
             if not reps:
                 raise RuntimeError(f"no replicas for {self.name}")
+            if use_breaker:
+                ok = [r for r in reps
+                      if self._board.would_allow(r._actor_id)]
+                if not ok:
+                    admission.shed(self.name, "breaker_open")
+                    raise admission.BackPressureError(
+                        f"all replicas of {self.name} have open circuit "
+                        f"breakers",
+                        retry_after_s=flags.get(
+                            "RTPU_SERVE_BREAKER_COOLDOWN_S"))
+                reps = ok
             if len(reps) == 1:
                 r = reps[0]
             else:
@@ -230,7 +332,8 @@ class Router:
             if key in self._inflight and self._inflight[key] > 0:
                 self._inflight[key] -= 1
 
-    def _pick_affine(self, model_id: str, exclude: Optional[set] = None):
+    def _pick_affine(self, model_id: str, exclude: Optional[set] = None,
+                     use_breaker: bool = False):
         """Model-affine pick: rendezvous hash over replicas, so one model's
         requests land where it is already loaded (reference model-multiplex
         routing). `exclude` holds replicas that already failed this call —
@@ -244,6 +347,19 @@ class Router:
                     if not exclude or r._actor_id not in exclude]
             live = [r for r in reps if r._actor_id not in self._avoid]
             reps = live or reps
+            if use_breaker and reps:
+                # Breaker-open replicas leave the hash ring too (affinity
+                # is a preference; a tripped replica is not).
+                ok = [r for r in reps
+                      if self._board.would_allow(r._actor_id)]
+                if not ok:
+                    admission.shed(self.name, "breaker_open")
+                    raise admission.BackPressureError(
+                        f"all replicas of {self.name} have open circuit "
+                        f"breakers",
+                        retry_after_s=flags.get(
+                            "RTPU_SERVE_BREAKER_COOLDOWN_S"))
+                reps = ok
             if not reps:
                 raise RuntimeError(f"no replicas for {self.name}")
             r = max(
@@ -254,38 +370,120 @@ class Router:
             self._inflight[r._actor_id] = self._inflight.get(r._actor_id, 0) + 1
             return r
 
+    def _note_result(self, key: str, err: Optional[BaseException]) -> None:
+        """Result-side accounting: successes close breakers, replica
+        faults strike them; deadline/cancel outcomes go to their counters
+        (client decisions, never a replica's fault)."""
+        if err is None:
+            if flags.get("RTPU_SERVE_ADMISSION"):
+                self._board.on_success(key)
+            return
+        if isinstance(err, DeadlineExceededError):
+            admission.deadline_exceeded(self.name)
+            return
+        if isinstance(err, TaskCancelledError):
+            admission.cancelled(self.name)
+            return
+        if (flags.get("RTPU_SERVE_ADMISSION")
+                and isinstance(err, (ActorDiedError, WorkerCrashedError,
+                                     TaskError, GetTimeoutError))):
+            self._board.on_failure(key)
+
+    def _admit(self) -> None:
+        """Bounded-queue admission: total locally-tracked in-flight beyond
+        num_replicas*max_ongoing + max_queued sheds with BackPressureError
+        (reference: Serve max_queued_requests, handle-side)."""
+        with self._lock:
+            n = len(self._replicas)
+            total = sum(self._inflight.values())
+            max_q = self._max_queued
+            if max_q is None:
+                max_q = flags.get("RTPU_SERVE_MAX_QUEUED")
+        if n == 0 or max_q < 0:
+            # Cold start (no replicas yet — the pick path retries) or
+            # explicitly unbounded.
+            self._budget.on_admitted()
+            return
+        cap = n * self._max_ongoing + max_q
+        if total >= cap:
+            admission.shed(self.name, "queue_full")
+            raise admission.BackPressureError(
+                f"deployment {self.name} is at capacity: {total} requests "
+                f"in flight >= {n} replicas x {self._max_ongoing} ongoing "
+                f"+ {max_q} queued", retry_after_s=1.0)
+        self._budget.on_admitted()
+
     def assign(self, method_name: str, args, kwargs,
                retries: int = 3, stream: bool = False,
-               multiplexed_model_id: str = ""):
+               multiplexed_model_id: str = "",
+               deadline_ts: Optional[float] = None):
+        if deadline_ts is None:
+            # Nested composition: a handle call made INSIDE a serve
+            # request inherits the enclosing request's budget.
+            deadline_ts = serve_context.get_request_deadline()
+        if deadline_ts is not None and time.time() > deadline_ts:
+            admission.deadline_exceeded(self.name)
+            raise DeadlineExceededError(
+                f"request to {self.name} expired before assignment")
         self._refresh()
+        admit = bool(flags.get("RTPU_SERVE_ADMISSION"))
+        if admit:
+            self._admit()
         last_err: Optional[Exception] = None
         failed: set = set()
         for attempt in range(retries):
+            if attempt > 0:
+                if admit and not self._budget.try_spend():
+                    # Retry budget exhausted: surfacing the error beats
+                    # amplifying an outage with retry traffic.
+                    break
+                # Jittered exponential backoff, never past the deadline.
+                delay = min(0.1 * (2 ** (attempt - 1)), 2.0)
+                delay *= 0.5 + random.random()
+                if deadline_ts is not None:
+                    delay = min(delay, max(0.0, deadline_ts - time.time()))
+                time.sleep(delay)
+                self._refresh(force=True)
+                if deadline_ts is not None and time.time() > deadline_ts:
+                    admission.deadline_exceeded(self.name)
+                    raise DeadlineExceededError(
+                        f"request to {self.name} expired while retrying")
             try:
                 if multiplexed_model_id:
-                    replica = self._pick_affine(multiplexed_model_id, failed)
+                    replica = self._pick_affine(multiplexed_model_id, failed,
+                                                use_breaker=admit)
                 else:
-                    replica = self._pick()
+                    replica = self._pick(use_breaker=admit)
             except RuntimeError as e:
                 last_err = e
-                time.sleep(0.2 * (attempt + 1))
-                self._refresh(force=True)
                 continue
+            rid = replica._actor_id
+            if admit and not self._board.admit(rid):
+                # Lost the half-open probe race: count as a failed attempt.
+                self._on_done(rid)
+                last_err = RuntimeError(f"replica {rid[:8]} breaker open")
+                continue
+            remaining = (None if deadline_ts is None
+                         else max(0.0, deadline_ts - time.time()))
             try:
                 if stream:
                     ref_gen = replica.handle_request_streaming.options(
-                        num_returns="streaming"
+                        num_returns="streaming", deadline_s=remaining,
                     ).remote(method_name, args, kwargs,
-                             multiplexed_model_id)
+                             multiplexed_model_id, deadline_ts)
                     return DeploymentStreamingResponse(
-                        ref_gen, self, replica._actor_id)
-                ref = replica.handle_request.remote(
-                    method_name, args, kwargs, multiplexed_model_id)
-                return DeploymentResponse(ref, self, replica._actor_id)
+                        ref_gen, self, rid, deadline_ts)
+                ref = replica.handle_request.options(
+                    deadline_s=remaining,
+                ).remote(method_name, args, kwargs, multiplexed_model_id,
+                         deadline_ts)
+                return DeploymentResponse(ref, self, rid, deadline_ts)
             except Exception as e:  # dead replica: drop + refresh
                 last_err = e
-                failed.add(replica._actor_id)
-                self._on_done(replica._actor_id)
+                failed.add(rid)
+                self._on_done(rid)
+                if admit:
+                    self._board.on_failure(rid)
                 self._refresh(force=True)
         raise RuntimeError(
             f"could not assign request to {self.name}: {last_err}")
@@ -293,11 +491,13 @@ class Router:
 
 class DeploymentHandle:
     def __init__(self, deployment_name: str, method_name: str = "__call__",
-                 stream: bool = False, multiplexed_model_id: str = ""):
+                 stream: bool = False, multiplexed_model_id: str = "",
+                 deadline_s: Optional[float] = None):
         self.deployment_name = deployment_name
         self._method_name = method_name
         self._stream = stream
         self._multiplexed_model_id = multiplexed_model_id
+        self._deadline_s = deadline_s
         self._router: Optional[Router] = None
 
     # Routers hold runtime state; rebuild lazily after pickling (handles are
@@ -306,24 +506,28 @@ class DeploymentHandle:
         return {"deployment_name": self.deployment_name,
                 "_method_name": self._method_name,
                 "_stream": self._stream,
-                "_multiplexed_model_id": self._multiplexed_model_id}
+                "_multiplexed_model_id": self._multiplexed_model_id,
+                "_deadline_s": self._deadline_s}
 
     def __setstate__(self, state):
         self.deployment_name = state["deployment_name"]
         self._method_name = state["_method_name"]
         self._stream = state.get("_stream", False)
         self._multiplexed_model_id = state.get("_multiplexed_model_id", "")
+        self._deadline_s = state.get("_deadline_s")
         self._router = None
 
     def options(self, *, method_name: Optional[str] = None,
                 stream: Optional[bool] = None,
-                multiplexed_model_id: Optional[str] = None) -> "DeploymentHandle":
+                multiplexed_model_id: Optional[str] = None,
+                deadline_s: Optional[float] = None) -> "DeploymentHandle":
         h = DeploymentHandle(
             self.deployment_name,
             method_name if method_name is not None else self._method_name,
             stream if stream is not None else self._stream,
             (multiplexed_model_id if multiplexed_model_id is not None
              else self._multiplexed_model_id),
+            deadline_s if deadline_s is not None else self._deadline_s,
         )
         h._router = self._ensure_router()
         return h
@@ -352,6 +556,9 @@ class DeploymentHandle:
         return h
 
     def remote(self, *args, **kwargs):
+        deadline_ts = (None if self._deadline_s is None
+                       else time.time() + self._deadline_s)
         return self._ensure_router().assign(
             self._method_name, args, kwargs, stream=self._stream,
-            multiplexed_model_id=self._multiplexed_model_id)
+            multiplexed_model_id=self._multiplexed_model_id,
+            deadline_ts=deadline_ts)

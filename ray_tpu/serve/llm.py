@@ -233,10 +233,15 @@ def build_streaming_llm_deployment(cfg, params_factory, *, name: str = "llm-stre
                 # stream tokens as the slot emits them.
                 import time as _t
 
+                from ray_tpu.serve import context as serve_context
+
                 try:
+                    # The slot wait is bounded by the request's remaining
+                    # deadline budget (serve context) when one is set.
                     req = self._engine.submit(
                         ids, max_new_tokens=n, temperature=temp,
-                        eos_id=eos, timeout=300)
+                        eos_id=eos,
+                        timeout=serve_context.remaining_s(default=300.0))
                 except TimeoutError as e:
                     # Backpressure uses the same error-chunk contract as
                     # malformed requests — not a raw stream exception.
@@ -245,6 +250,15 @@ def build_streaming_llm_deployment(cfg, params_factory, *, name: str = "llm-stre
                 sent = 0
                 try:
                     while True:
+                        if serve_context.expired():
+                            # Deadline passed mid-decode: stop emitting;
+                            # the finally's abort() frees the slot now.
+                            from ray_tpu.core.controller import (
+                                DeadlineExceededError,
+                            )
+
+                            raise DeadlineExceededError(
+                                "request deadline passed mid-stream")
                         toks = self._engine.peek(req)
                         while sent < len(toks):
                             yield {"token": toks[sent]}
@@ -264,9 +278,11 @@ def build_streaming_llm_deployment(cfg, params_factory, *, name: str = "llm-stre
                             return
                         _t.sleep(0.005)
                 finally:
-                    # Client disconnect closes this generator mid-loop:
-                    # release the request's engine state either way.
-                    self._engine.discard(req)
+                    # Client disconnect (GeneratorExit) or deadline closes
+                    # this generator mid-loop: abort frees the KV slot
+                    # between engine steps, not at some later tick. After
+                    # a normal pop_result this is a no-op.
+                    self._engine.abort(req)
             logits, cache = self._prefill(self._params, ids[None])
             for i in range(n):
                 if temp > 0:

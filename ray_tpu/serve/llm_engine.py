@@ -277,6 +277,28 @@ class ContinuousBatchingEngine:
                 self._discarded.add(req)
                 self.budget[slot] = 0  # retire at the next tick
 
+    def abort(self, req: int) -> bool:
+        """Cancel a request NOW, between engine steps: the slot (and its
+        KV rows) frees immediately under the engine lock and any stored
+        output is dropped. Unlike discard(), which lets the slot retire at
+        the NEXT tick, abort is the disconnect path's guarantee that
+        capacity frees within one step. Returns True if the request was
+        known (live or finished), False for an unknown/already-released
+        id — callers treat double-abort as a no-op."""
+        with self.lock:
+            slot = self._req_slot.get(req)
+            if slot is not None:
+                self._discarded.add(req)
+                self._retire_locked(slot)
+                _serve_metrics()["slots"].set(
+                    self.B - len(self._free), tags=self._mtags)
+                return True
+            if req in self._results or req in self._done_ev:
+                self._results.pop(req, None)
+                self._done_ev.pop(req, None)
+                return True
+            return False
+
     # ---------------------------------------------------------------- tick
 
     def tick(self) -> int:

@@ -15,9 +15,27 @@ import threading
 from typing import Any, Dict, Optional
 
 import ray_tpu
+from ray_tpu import flags
+from ray_tpu.core.controller import DeadlineExceededError
 
+from .admission import BackPressureError
 from .controller import CONTROLLER_NAME
 from .handle import DeploymentHandle, DeploymentNotFoundError
+
+
+def _request_timeout_s(request) -> float:
+    """Per-request end-to-end budget: X-Request-Timeout-S header when the
+    client sends one, else the RTPU_SERVE_REQUEST_TIMEOUT_S flag default
+    (the fix for the old hard-coded 60s)."""
+    hdr = request.headers.get("X-Request-Timeout-S")
+    if hdr:
+        try:
+            v = float(hdr)
+            if v > 0:
+                return v
+        except ValueError:
+            pass
+    return float(flags.get("RTPU_SERVE_REQUEST_TIMEOUT_S"))
 
 
 class HTTPProxy:
@@ -78,28 +96,44 @@ class HTTPProxy:
             except json.JSONDecodeError:
                 arg = body.decode()
         handle = self._handles.setdefault(name, DeploymentHandle(name))
+        timeout_s = _request_timeout_s(request)
         if info.get("stream"):
-            return await self._handle_streaming(request, handle, name, arg)
+            return await self._handle_streaming(request, handle, name, arg,
+                                                timeout_s)
         try:
+            # The deadline threads end-to-end: router admission, replica
+            # dequeue, and batch seal all honor it — result() just waits
+            # out the same budget.
             resp = await asyncio.get_running_loop().run_in_executor(
-                None, lambda: handle.remote(arg).result(timeout=60))
+                None, lambda: handle.options(deadline_s=timeout_s)
+                .remote(arg).result())
         except DeploymentNotFoundError:
             # Deployment was deleted: drop the stale route + handle.
             self._handles.pop(name, None)
             self._refresh_routes()
             return web.json_response(
                 {"error": f"deployment {name} not found"}, status=404)
+        except BackPressureError as e:
+            return web.json_response(
+                {"error": str(e)}, status=503,
+                headers={"Retry-After":
+                         f"{max(1, round(e.retry_after_s))}"})
+        except DeadlineExceededError as e:
+            return web.json_response({"error": str(e)}, status=504)
         except Exception as e:
             return web.json_response({"error": str(e)}, status=500)
         if isinstance(resp, (dict, list, int, float, bool)) or resp is None:
             return web.json_response({"result": resp})
         return web.Response(text=str(resp))
 
-    async def _handle_streaming(self, request, handle, name: str, arg):
+    async def _handle_streaming(self, request, handle, name: str, arg,
+                                timeout_s: Optional[float] = None):
         """Chunked-transfer response fed by a streaming deployment call
         (reference: serve HTTP streaming responses over the generator
         protocol). Each yielded item becomes one chunk; str/bytes pass
-        through, anything else is JSON + newline."""
+        through, anything else is JSON + newline. Client disconnect closes
+        the deployment stream, which aborts the replica-side generator
+        (GeneratorExit) and frees its engine slot immediately."""
         from aiohttp import web
 
         loop = asyncio.get_running_loop()
@@ -108,28 +142,44 @@ class HTTPProxy:
             # the proxy event loop (the non-streaming path does the same).
             gen = await loop.run_in_executor(
                 self._stream_pool,
-                lambda: iter(handle.options(stream=True).remote(arg)))
+                lambda: iter(handle.options(
+                    stream=True, deadline_s=timeout_s).remote(arg)))
+        except BackPressureError as e:
+            return web.json_response(
+                {"error": str(e)}, status=503,
+                headers={"Retry-After":
+                         f"{max(1, round(e.retry_after_s))}"})
+        except DeadlineExceededError as e:
+            return web.json_response({"error": str(e)}, status=504)
         except Exception as e:
             return web.json_response({"error": str(e)}, status=500)
         resp = web.StreamResponse()
         resp.enable_chunked_encoding()
         await resp.prepare(request)
         _END = object()
-        while True:
-            try:
-                item = await loop.run_in_executor(
-                    self._stream_pool, lambda: next(gen, _END))
-            except Exception:
-                break  # mid-stream failure: terminate the chunked body
-            if item is _END:
-                break
-            if isinstance(item, bytes):
-                data = item
-            elif isinstance(item, str):
-                data = item.encode()
-            else:
-                data = (json.dumps(item) + "\n").encode()
-            await resp.write(data)
+        try:
+            while True:
+                try:
+                    item = await loop.run_in_executor(
+                        self._stream_pool, lambda: next(gen, _END))
+                except Exception:
+                    break  # mid-stream failure: terminate the chunked body
+                if item is _END:
+                    break
+                if isinstance(item, bytes):
+                    data = item
+                elif isinstance(item, str):
+                    data = item.encode()
+                else:
+                    data = (json.dumps(item) + "\n").encode()
+                await resp.write(data)
+        finally:
+            # Reached on normal end AND on client disconnect (aiohttp
+            # raises/cancels out of resp.write): cancel the producer so a
+            # walked-away client never keeps a KV slot warm.
+            close = getattr(gen, "close", None)
+            if close is not None:
+                await loop.run_in_executor(self._stream_pool, close)
         await resp.write_eof()
         return resp
 

@@ -9,6 +9,7 @@ user_config reconfiguration.
 from __future__ import annotations
 
 import threading
+import time
 from typing import Any, Callable, Dict, Optional, Tuple
 
 import cloudpickle
@@ -32,14 +33,29 @@ class ReplicaActor:
 
     # ---------------------------------------------------------------- serving
 
+    @staticmethod
+    def _check_deadline(deadline_ts, where: str):
+        """Pre-execution expiry gate: an expired request is dropped with
+        the typed error instead of burning replica capacity."""
+        if deadline_ts is not None and time.time() > deadline_ts:
+            from ray_tpu.core.controller import DeadlineExceededError
+
+            raise DeadlineExceededError(
+                f"request deadline passed {where}")
+
     def handle_request(self, method_name: str, args: Tuple, kwargs: Dict,
-                       multiplexed_model_id: str = ""):
+                       multiplexed_model_id: str = "",
+                       deadline_ts: Optional[float] = None):
+        from . import context as serve_context
         from .multiplex import _set_model_id
 
+        self._check_deadline(deadline_ts, "before replica execution")
         with self._lock:
             self._ongoing += 1
             self._total += 1
         token = _set_model_id(multiplexed_model_id)
+        ctx_token = serve_context.set_request_context(
+            deadline_ts=deadline_ts)
         try:
             if self._is_function:
                 return self._callable(*args, **kwargs)
@@ -49,23 +65,29 @@ class ReplicaActor:
         finally:
             from .multiplex import _model_id_ctx
 
+            serve_context.reset_request_context(ctx_token)
             _model_id_ctx.reset(token)
             with self._lock:
                 self._ongoing -= 1
 
     def handle_request_streaming(self, method_name: str, args: Tuple,
                                  kwargs: Dict,
-                                 multiplexed_model_id: str = ""):
+                                 multiplexed_model_id: str = "",
+                                 deadline_ts: Optional[float] = None):
         """Generator variant: the user handler returns a generator/iterable
         whose items stream to the caller one object at a time (reference:
         serve streaming responses over streaming generator returns,
         serve/_private/replica.py handle_request_streaming)."""
+        from . import context as serve_context
         from .multiplex import _set_model_id
 
+        self._check_deadline(deadline_ts, "before replica execution")
         with self._lock:
             self._ongoing += 1
             self._total += 1
         _set_model_id(multiplexed_model_id)
+        ctx_token = serve_context.set_request_context(
+            deadline_ts=deadline_ts)
         try:
             if self._is_function:
                 result = self._callable(*args, **kwargs)
@@ -76,6 +98,7 @@ class ReplicaActor:
             for item in result:
                 yield item
         finally:
+            serve_context.reset_request_context(ctx_token)
             with self._lock:
                 self._ongoing -= 1
 

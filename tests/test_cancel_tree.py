@@ -1,0 +1,165 @@
+"""Cancellation edge cases + recursive ownership-tree cancel
+(reference: ray.cancel semantics, python/ray/tests/test_cancel.py):
+queued cancels complete at the controller without a worker round-trip,
+double-cancel is idempotent, cancelling a finished ref is a no-op, and
+recursive=True kills the full descendant tree — including through an
+already-finished middle task."""
+import os
+import tempfile
+import time
+import uuid
+
+import pytest
+
+import ray_tpu
+
+
+def _sentinel(tag):
+    return os.path.join(tempfile.gettempdir(),
+                        f"{tag}_{uuid.uuid4().hex}")
+
+
+@ray_tpu.remote
+def _spin_hb(path, sec=30.0):
+    """Spin for `sec`, touching a heartbeat file each tick; writes a .done
+    marker only on natural completion."""
+    import pathlib
+
+    hb = pathlib.Path(path + ".hb")
+    pathlib.Path(path + ".started").touch()
+    t0 = time.time()
+    while time.time() - t0 < sec:
+        hb.touch()
+        time.sleep(0.05)
+    pathlib.Path(path + ".done").touch()
+    return 1
+
+
+def test_double_cancel_idempotent(ray_start_regular):
+    base = _sentinel("dc")
+    ref = _spin_hb.remote(base)
+    deadline = time.time() + 15
+    while not os.path.exists(base + ".started"):
+        assert time.time() < deadline, "task never started"
+        time.sleep(0.05)
+    ray_tpu.cancel(ref)
+    ray_tpu.cancel(ref)  # second cancel must be a no-op, not an error
+    with pytest.raises(Exception):
+        ray_tpu.get(ref, timeout=20)
+    ray_tpu.cancel(ref)  # cancel-after-failure is also a no-op
+
+
+def test_cancel_finished_ref_noop(ray_start_regular):
+    @ray_tpu.remote
+    def add(a, b):
+        return a + b
+
+    ref = add.remote(20, 22)
+    assert ray_tpu.get(ref, timeout=30) == 42
+    ray_tpu.cancel(ref)  # finished: must not raise
+    ray_tpu.cancel(ref, recursive=True)
+    # The stored value survives a post-completion cancel.
+    assert ray_tpu.get(ref, timeout=30) == 42
+
+
+def test_queued_actor_call_cancel_no_worker_roundtrip(ray_start_regular):
+    """Cancelling a call still QUEUED in an actor's mailbox resolves at
+    the controller — the caller sees TaskCancelledError long before the
+    call ahead of it finishes."""
+
+    @ray_tpu.remote
+    class Blocker:
+        def block(self, sec):
+            time.sleep(sec)
+            return "done"
+
+        def quick(self):
+            return "q"
+
+    a = Blocker.remote()
+    r1 = a.block.remote(12)
+    time.sleep(0.5)  # ensure block() is executing, quick() queued behind
+    r2 = a.quick.remote()
+    t0 = time.time()
+    ray_tpu.cancel(r2)
+    with pytest.raises(Exception) as ei:
+        ray_tpu.get(r2, timeout=8)
+    took = time.time() - t0
+    assert "timeout" not in type(ei.value).__name__.lower(), ei.value
+    assert took < 6, (
+        f"queued-call cancel took {took:.1f}s — it waited on the worker")
+    # The call ahead is untouched.
+    assert ray_tpu.get(r1, timeout=30) == "done"
+
+
+def _warm_cluster(n=4):
+    """Run a throwaway fan-out so every worker process exists before the
+    test submits nested tasks (cold-start worker spawn can exceed the
+    scheduling patience of a task submitted from INSIDE another task)."""
+
+    @ray_tpu.remote
+    def _noop(i):
+        return i
+
+    assert ray_tpu.get([_noop.remote(i) for i in range(n)],
+                       timeout=60) == list(range(n))
+
+
+def test_recursive_cancel_kills_child_tree(ray_start_regular):
+    """rtpu.cancel(parent_ref, recursive=True) interrupts the parent AND
+    every running child found via the controller's ownership table."""
+    _warm_cluster()
+    bases = [_sentinel("rc0"), _sentinel("rc1")]
+
+    @ray_tpu.remote
+    def parent(paths):
+        refs = [_spin_hb.remote(p) for p in paths]
+        return ray_tpu.get(refs)
+
+    pref = parent.remote(bases)
+    deadline = time.time() + 20
+    while not all(os.path.exists(b + ".started") for b in bases):
+        assert time.time() < deadline, "children never started"
+        time.sleep(0.05)
+    ray_tpu.cancel(pref, recursive=True)
+    with pytest.raises(Exception):
+        ray_tpu.get(pref, timeout=20)
+    # Children must stop spinning: their heartbeats go quiet well before
+    # the 30s natural runtime, and no .done marker ever appears.
+    time.sleep(3.0)
+    mtimes = [os.path.getmtime(b + ".hb") for b in bases]
+    time.sleep(2.0)
+    for b, m in zip(bases, mtimes):
+        assert os.path.getmtime(b + ".hb") == m, (
+            f"child {b} still heartbeating after recursive cancel")
+        assert not os.path.exists(b + ".done"), "child ran to completion"
+
+
+def test_recursive_cancel_through_finished_parent(ray_start_regular):
+    """A parent that already FINISHED (returned child refs) can still be
+    the root of a recursive cancel: the walk passes through the finished
+    task's retained children set."""
+    _warm_cluster()
+    base = _sentinel("rcf")
+
+    @ray_tpu.remote
+    def spawn(path):
+        # Returns immediately; the child keeps running.
+        return _spin_hb.remote(path)
+
+    pref = spawn.remote(base)
+    child_ref = ray_tpu.get(pref, timeout=30)
+    deadline = time.time() + 20
+    while not os.path.exists(base + ".started"):
+        assert time.time() < deadline, "child never started"
+        time.sleep(0.05)
+    ray_tpu.cancel(pref, recursive=True)  # parent finished, child alive
+    time.sleep(3.0)
+    m = os.path.getmtime(base + ".hb")
+    time.sleep(2.0)
+    assert os.path.getmtime(base + ".hb") == m, (
+        "child still heartbeating after recursive cancel of finished "
+        "parent")
+    assert not os.path.exists(base + ".done")
+    with pytest.raises(Exception):
+        ray_tpu.get(child_ref, timeout=20)

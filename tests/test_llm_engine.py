@@ -133,6 +133,32 @@ def test_discard_releases_state_and_ticker_failure_surfaces(engine_setup):
     eng._tick = orig
 
 
+def test_abort_frees_slot_between_steps(engine_setup):
+    """abort() is the disconnect path: the slot frees immediately under
+    the engine lock (no tick required), double-abort is a no-op, and
+    aborting a finished request drops its stored output."""
+    cfg, params = engine_setup
+    eng = ContinuousBatchingEngine(cfg, params, num_slots=1,
+                                   max_prompt_len=16, max_new_tokens=8)
+    r1 = eng.submit([5, 9, 2])
+    eng.tick()
+    assert eng.abort(r1) is True
+    assert r1 not in eng._req_slot and r1 not in eng._done_ev \
+        and not eng._results
+    # Capacity is back WITHOUT another tick: a bounded-wait submit on the
+    # single-slot engine succeeds right away.
+    r2 = eng.submit([7, 7], max_new_tokens=2, timeout=0.5)
+    assert eng.abort(r1) is False  # unknown id now: no-op
+    while eng.tick():
+        pass
+    assert eng.result(r2, timeout=60) == _naive(params, cfg, [7, 7], 2)
+    # Abort after completion releases the stored output; repeating it is
+    # a no-op again.
+    assert eng.abort(r2) is True
+    assert not eng._results and not eng._done_ev
+    assert eng.abort(r2) is False
+
+
 def test_serve_metrics_reach_prometheus(engine_setup, ray_start_regular):
     """A generate call records TTFT, decode-token, and slot-occupancy
     metrics that surface on the controller's /metrics endpoint tagged by

@@ -514,3 +514,30 @@ def test_large_object_bandwidth_floor(ray_start_regular):
     assert out.shape == arr.shape
     assert gbps > 0.2, f"put+get bandwidth {gbps:.2f} GB/s below floor"
     ray_tpu.free([ref])
+
+
+def test_serve_admission_disabled_path_overhead(ray_start_regular,
+                                                monkeypatch):
+    """Admission-plane guard (mirrors the RTPU_TASK_EVENTS guard): with
+    RTPU_SERVE_ADMISSION=0 the breaker board, retry budget, and queue
+    bound must cost the handle hot path nothing beyond one flag check —
+    serve call throughput holds the same order-of-magnitude floor."""
+    monkeypatch.setenv("RTPU_SERVE_ADMISSION", "0")
+    from ray_tpu import serve
+
+    @serve.deployment(name="perf-echo")
+    def echo(x):
+        return x
+
+    handle = serve.run(echo.bind(), route_prefix="/perf-echo")
+    try:
+        for i in range(8):  # warm replica + router caches
+            assert handle.remote(i).result(timeout=30) == i
+        t0 = time.perf_counter()
+        resps = [handle.remote(i) for i in range(100)]
+        assert [r.result(timeout=30) for r in resps] == list(range(100))
+        dt = time.perf_counter() - t0
+        assert 100 / dt > 20, \
+            f"admission-off serve throughput {100/dt:.0f}/s below floor"
+    finally:
+        serve.shutdown()

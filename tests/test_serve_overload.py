@@ -1,0 +1,449 @@
+"""Overload-safe serving: admission control (bounded queues + circuit
+breakers + retry budget), end-to-end deadlines, and cascading cancellation
+(reference behaviors: Serve max_queued_requests -> BackPressureError,
+request_timeout_s -> 408/504, client disconnect aborts the stream)."""
+import json
+import os
+import tempfile
+import threading
+import time
+import urllib.error
+import urllib.request
+import uuid
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+
+
+@pytest.fixture(scope="module")
+def serve_instance():
+    ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
+    yield
+    serve.shutdown()
+    ray_tpu.shutdown()
+
+
+def _gate_path():
+    return os.path.join(tempfile.gettempdir(),
+                        f"gate_{uuid.uuid4().hex}")
+
+
+# ------------------------------------------------------------ admission
+
+
+def test_queue_full_sheds_with_backpressure(serve_instance):
+    """Beyond num_replicas*max_ongoing + max_queued in-flight requests the
+    handle sheds synchronously with BackPressureError carrying a
+    retry-after hint; admitted requests are untouched."""
+    gate = _gate_path()
+
+    @serve.deployment(name="hold", max_ongoing_requests=1,
+                      max_queued_requests=1)
+    class Hold:
+        def __call__(self, path):
+            while not os.path.exists(path):
+                time.sleep(0.02)
+            return "ok"
+
+    handle = serve.run(Hold.bind(), route_prefix="/hold")
+    admitted, shed = [], []
+    try:
+        for _ in range(6):
+            try:
+                admitted.append(handle.remote(gate))
+            except serve.BackPressureError as e:
+                assert e.retry_after_s > 0
+                shed.append(e)
+            time.sleep(0.1)  # let the router's in-flight counts settle
+        # capacity = 1 replica x 1 ongoing + 1 queued = 2
+        assert len(admitted) == 2, (len(admitted), len(shed))
+        assert len(shed) == 4
+    finally:
+        with open(gate, "w"):
+            pass
+    assert [r.result(timeout=30) for r in admitted] == ["ok", "ok"]
+    os.unlink(gate)
+
+
+def test_admission_disabled_never_sheds(serve_instance, monkeypatch):
+    """RTPU_SERVE_ADMISSION=0 turns the whole admission plane off: the
+    same flood that sheds above is accepted in full."""
+    monkeypatch.setenv("RTPU_SERVE_ADMISSION", "0")
+    gate = _gate_path()
+
+    @serve.deployment(name="hold-off", max_ongoing_requests=1,
+                      max_queued_requests=1)
+    class Hold:
+        def __call__(self, path):
+            while not os.path.exists(path):
+                time.sleep(0.02)
+            return "ok"
+
+    handle = serve.run(Hold.bind(), route_prefix="/hold-off")
+    try:
+        resps = [handle.remote(gate) for _ in range(6)]
+    finally:
+        with open(gate, "w"):
+            pass
+    assert [r.result(timeout=60) for r in resps] == ["ok"] * 6
+    os.unlink(gate)
+
+
+# ------------------------------------------------------------- deadlines
+
+
+def test_deadline_expires_while_queued(serve_instance):
+    """A deadlined call stuck behind a slow one in the replica mailbox
+    surfaces DeadlineExceededError at its budget, NOT after the slow call
+    finishes — and it never executes on the replica."""
+    ran = os.path.join(tempfile.gettempdir(), f"ran_{uuid.uuid4().hex}")
+
+    @serve.deployment(name="slowq", max_ongoing_requests=1)
+    class Slow:
+        def __call__(self, sec, mark=None):
+            if mark:
+                with open(mark, "w"):
+                    pass
+            time.sleep(sec)
+            return sec
+
+    handle = serve.run(Slow.bind(), route_prefix="/slowq")
+    r1 = handle.remote(3.0)
+    time.sleep(0.3)  # r1 executing; the next call queues behind it
+    r2 = handle.options(deadline_s=0.5).remote(0.0, ran)
+    t0 = time.time()
+    with pytest.raises(serve.DeadlineExceededError):
+        r2.result()
+    took = time.time() - t0
+    assert took < 2.0, f"deadline surfaced only after {took:.1f}s"
+    assert r1.result(timeout=30) == 3.0
+    time.sleep(0.2)
+    assert not os.path.exists(ran), "expired request still executed"
+
+
+def test_deadline_preexpired_never_assigned(serve_instance):
+    @serve.deployment(name="noop-dl")
+    def noop(x):
+        return x
+
+    handle = serve.run(noop.bind(), route_prefix="/noop-dl")
+    assert handle.remote(1).result(timeout=30) == 1
+    with pytest.raises(serve.DeadlineExceededError):
+        handle.options(deadline_s=-0.1).remote(1)
+
+
+# ------------------------------------------------------- circuit breaker
+
+
+def test_breaker_trips_after_consecutive_failures(serve_instance,
+                                                  monkeypatch):
+    """Consecutive replica faults open the per-replica breaker; with every
+    replica tripped the router sheds instead of queueing doomed work, and
+    the half-open probe readmits traffic after the cooldown."""
+    monkeypatch.setenv("RTPU_SERVE_BREAKER_THRESHOLD", "3")
+    monkeypatch.setenv("RTPU_SERVE_BREAKER_COOLDOWN_S", "1.0")
+    fail_flag = _gate_path()
+    with open(fail_flag, "w"):
+        pass
+
+    @serve.deployment(name="faulty")
+    class Faulty:
+        def __call__(self, flag):
+            if os.path.exists(flag):
+                raise RuntimeError("replica fault")
+            return "healed"
+
+    handle = serve.run(Faulty.bind(), route_prefix="/faulty")
+    for _ in range(3):
+        with pytest.raises(Exception):
+            handle.remote(fail_flag).result(timeout=30)
+    with pytest.raises(serve.BackPressureError):
+        handle.remote(fail_flag).result(timeout=30)
+    # Half-open probe after the cooldown: the replica healed, one success
+    # closes the breaker again.
+    os.unlink(fail_flag)
+    deadline = time.time() + 15
+    while True:
+        try:
+            assert handle.remote(fail_flag).result(timeout=30) == "healed"
+            break
+        except serve.BackPressureError:
+            assert time.time() < deadline, "breaker never half-opened"
+            time.sleep(0.3)
+
+
+def test_breaker_routes_around_failing_replica(serve_instance,
+                                               monkeypatch):
+    """With one of two replicas persistently failing, its breaker opens
+    and the power-of-two pick stops offering it — traffic converges on
+    the healthy replica instead of coin-flipping into errors."""
+    monkeypatch.setenv("RTPU_SERVE_BREAKER_THRESHOLD", "3")
+    monkeypatch.setenv("RTPU_SERVE_BREAKER_COOLDOWN_S", "30.0")
+    claim = _gate_path()
+
+    @serve.deployment(name="flaky2", num_replicas=2)
+    class Flaky:
+        def __init__(self, claim_path):
+            self.bad = False
+            try:
+                fd = os.open(claim_path,
+                             os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+                os.close(fd)
+                self.bad = True  # first replica up claims the bad role
+            except FileExistsError:
+                pass
+
+        def __call__(self, x):
+            if self.bad:
+                raise RuntimeError("bad replica")
+            return x
+
+    handle = serve.run(Flaky.bind(claim), route_prefix="/flaky2")
+    failures = 0
+    streak = 0
+    for i in range(80):
+        try:
+            assert handle.remote(i).result(timeout=30) == i
+            streak += 1
+        except Exception:
+            failures += 1
+            streak = 0
+        if streak >= 12:
+            break
+    os.unlink(claim)
+    assert failures > 0, "bad replica never hit — claim file logic broken"
+    assert streak >= 12, (
+        f"router kept sending to the tripped replica "
+        f"({failures} failures, best streak {streak})")
+
+
+# ------------------------------------------------------- batch coalescer
+
+
+def test_batch_seal_drops_expired_items():
+    """@serve.batch seal-time sweep: an item whose request deadline passed
+    while coalescing gets DeadlineExceededError; live items run without
+    it ever reaching the batch fn."""
+    from ray_tpu.serve import batching
+    from ray_tpu.serve import context as serve_context
+
+    seen = []
+
+    @batching.batch(max_batch_size=4, batch_wait_timeout_s=0.4)
+    def fn(items):
+        seen.append(sorted(items))
+        return [i * 10 for i in items]
+
+    results = {}
+
+    def call(val, deadline_s):
+        tok = None
+        if deadline_s is not None:
+            tok = serve_context.set_request_context(
+                deadline_ts=time.time() + deadline_s)
+        try:
+            results[val] = fn(val)
+        except Exception as e:
+            results[val] = e
+        finally:
+            if tok is not None:
+                serve_context.reset_request_context(tok)
+
+    t1 = threading.Thread(target=call, args=(1, 0.05))  # expires in-queue
+    t2 = threading.Thread(target=call, args=(2, None))
+    t1.start()
+    t2.start()
+    t1.join(10)
+    t2.join(10)
+    from ray_tpu import DeadlineExceededError
+
+    assert isinstance(results[1], DeadlineExceededError), results[1]
+    assert results[2] == 20
+    assert seen == [[2]], f"expired item reached the batch fn: {seen}"
+
+
+# ------------------------------------------------------------ HTTP plane
+
+
+def test_http_503_retry_after_and_504_deadline(serve_instance):
+    """Proxy maps BackPressureError to 503 + Retry-After and a blown
+    per-request budget (X-Request-Timeout-S) to 504."""
+    gate = _gate_path()
+
+    @serve.deployment(name="hold-http", max_ongoing_requests=1,
+                      max_queued_requests=1)
+    class Hold:
+        def __call__(self, payload):
+            while not os.path.exists(gate):
+                time.sleep(0.02)
+            return {"ok": True}
+
+    serve.run(Hold.bind(), route_prefix="/hold-http", _http=True,
+              http_port=8141)
+    codes = []
+    retry_after = []
+    lock = threading.Lock()
+
+    def post():
+        req = urllib.request.Request(
+            "http://127.0.0.1:8141/hold-http",
+            data=json.dumps({}).encode(),
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req, timeout=60) as resp:
+                with lock:
+                    codes.append(resp.status)
+        except urllib.error.HTTPError as e:
+            with lock:
+                codes.append(e.code)
+                if e.code == 503:
+                    retry_after.append(e.headers.get("Retry-After"))
+
+    threads = []
+    try:
+        for _ in range(6):
+            t = threading.Thread(target=post)
+            t.start()
+            threads.append(t)
+            time.sleep(0.15)
+        deadline = time.time() + 20
+        while len(codes) < 4 and time.time() < deadline:
+            time.sleep(0.1)
+    finally:
+        with open(gate, "w"):
+            pass
+    for t in threads:
+        t.join(30)
+    assert codes.count(503) == 4, codes
+    assert codes.count(200) == 2, codes
+    assert retry_after and all(
+        ra is not None and float(ra) >= 1 for ra in retry_after), retry_after
+    os.unlink(gate)
+
+    # 504: the request's own budget expires while the replica works.
+    @serve.deployment(name="slow-http")
+    class SlowH:
+        def __call__(self, payload):
+            time.sleep(3.0)
+            return {"ok": True}
+
+    serve.run(SlowH.bind(), route_prefix="/slow-http", _http=True,
+              http_port=8141)
+    req = urllib.request.Request(
+        "http://127.0.0.1:8141/slow-http",
+        data=json.dumps({}).encode(),
+        headers={"Content-Type": "application/json",
+                 "X-Request-Timeout-S": "0.5"})
+    t0 = time.time()
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        urllib.request.urlopen(req, timeout=30)
+    assert ei.value.code == 504
+    assert time.time() - t0 < 2.5
+
+
+# ------------------------------------------- streaming cancel / abort
+
+
+def test_mid_stream_disconnect_frees_engine_slot(serve_instance):
+    """num_slots=1 continuous batching: closing stream A mid-decode aborts
+    its engine request (GeneratorExit -> engine.abort), so stream B gets
+    the KV slot and completes correctly instead of queueing behind A's
+    full natural generation."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ray_tpu.models import generate as gen_fn
+    from ray_tpu.models import transformer as tfm
+    from ray_tpu.models.configs import llama_tiny
+    from ray_tpu.serve.llm import build_streaming_llm_deployment
+
+    cfg = llama_tiny(remat=False)
+
+    def factory():
+        return tfm.init_params(jax.random.key(0), cfg)
+
+    LLM = build_streaming_llm_deployment(
+        cfg, factory, name="disc-llm", max_prompt_len=8,
+        max_new_tokens=48, continuous_batching=True, num_slots=1)
+    handle = serve.run(LLM.bind(), route_prefix="/disc-llm")
+    prompt = [3, 1, 4, 1, 5]
+    # Warm-up pays the prefill/step jit compile.
+    warm = handle.options(stream=True).remote(
+        {"tokens": prompt, "max_new_tokens": 2})
+    assert len([c["token"] for c in warm]) == 2
+    # Stream A: long generation, abandoned after the first token.
+    a = handle.options(stream=True).remote({"tokens": prompt})
+    first = next(iter(a))
+    assert "token" in first, first
+    a.close()
+    # Stream B: must get the (only) slot promptly and match greedy.
+    b = handle.options(stream=True, deadline_s=60).remote(
+        {"tokens": prompt, "max_new_tokens": 4})
+    toks = [c["token"] for c in b]
+    exp = np.asarray(gen_fn(
+        factory(), jnp.asarray([prompt], jnp.int32), cfg,
+        max_new_tokens=4))[0, len(prompt):].tolist()
+    assert toks == exp, (toks, exp)
+
+
+# ------------------------------------------------------------ chaos soak
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_overload_soak_goodput_and_bounded_latency(serve_instance):
+    """4x-capacity flood for several seconds: sheds are typed
+    BackPressureError (the 503 path), admitted requests all complete, and
+    admitted latency stays bounded by the queue cap instead of growing
+    with offered load."""
+    work_s = 0.05
+
+    @serve.deployment(name="soak", max_ongoing_requests=2,
+                      max_queued_requests=4)
+    class Soak:
+        def __call__(self, x):
+            time.sleep(work_s)
+            return x
+
+    handle = serve.run(Soak.bind(), route_prefix="/soak")
+    # capacity = 2 ongoing + 4 queued = 6 in flight; ~40 rps service rate.
+    stop = time.time() + 6.0
+    latencies = []
+    outcomes = {"ok": 0, "shed": 0, "other": 0}
+    lock = threading.Lock()
+
+    def client():
+        while time.time() < stop:
+            t0 = time.time()
+            try:
+                r = handle.remote(1)
+                assert r.result(timeout=30) == 1
+                with lock:
+                    outcomes["ok"] += 1
+                    latencies.append(time.time() - t0)
+            except serve.BackPressureError:
+                with lock:
+                    outcomes["shed"] += 1
+                time.sleep(0.01)  # honor the retry-after spirit
+            except Exception:
+                with lock:
+                    outcomes["other"] += 1
+
+    threads = [threading.Thread(target=client) for _ in range(16)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(60)
+    assert outcomes["other"] == 0, outcomes
+    assert outcomes["ok"] > 50, outcomes
+    assert outcomes["shed"] > 0, (
+        f"4x overload never shed — admission inert: {outcomes}")
+    latencies.sort()
+    p99 = latencies[int(0.99 * (len(latencies) - 1))]
+    # Worst admitted case waits out the full bounded queue ahead of it
+    # (6 x work_s = 0.3s) plus scheduling noise — NOT the unbounded
+    # offered-load backlog, which at 4x would grow without limit.
+    assert p99 < 6.0, f"admitted p99 {p99:.2f}s — queue bound not holding"
