@@ -384,6 +384,39 @@ def _top_frame(window: float = 120.0, spark_points: int = 30) -> str:
                 lines.append(
                     f"object store  used {_fmt_bytes(pts[-1]):>10}  "
                     f"{_spark(pts[-spark_points:])}")
+    # Serve plane: per-deployment pools with the controller's polled
+    # signals (queue depth, occupancy) + telemetry TTFT/token rates.
+    try:
+        import ray_tpu as _rt
+
+        _ctrl = _rt.get_actor("SERVE_CONTROLLER")
+        sstats = _rt.get(_ctrl.get_serve_stats.remote(), timeout=2.0)
+    except Exception:
+        sstats = None
+    if sstats:
+        ttft = {s["tags"].get("model"): s["points"][-1][1]
+                for s in (q(name="rtpu_serve_ttft_s", stat="p99",
+                            window_s=60.0) or []) if s["points"]}
+        toks = {s["tags"].get("model"): s["points"][-1][1]
+                for s in (q(name="rtpu_serve_decode_tokens_total") or [])
+                if s["points"]}
+        lines.append("")
+        lines.append(f"{'SERVE DEPLOYMENT':22} {'POOL':8} {'REPL':>5} "
+                     f"{'DRAIN':>6} {'QUEUE':>6} {'OCC%':>6} "
+                     f"{'TTFT P99':>9} {'TOK/S':>7}")
+        for dname in sorted(sstats):
+            d = sstats[dname]
+            base = dname.split("-")[0]
+            tv = ttft.get(dname, ttft.get(base))
+            kv = toks.get(dname, toks.get(base))
+            repl = f"{d.get('replicas', 0)}/{d.get('target', 0)}"
+            lines.append(
+                f"{dname[:22]:22} {str(d.get('pool', 'main'))[:8]:8} "
+                f"{repl:>5} {d.get('draining', 0):>6} "
+                f"{d.get('queue_depth', 0.0):>6.0f} "
+                f"{d.get('occupancy', 0.0) * 100:>6.1f} "
+                + (f"{tv:>8.3f}s" if tv is not None else f"{'-':>9}")
+                + (f" {kv:>7.1f}" if kv is not None else f" {'-':>7}"))
     lines.append("")
     try:
         events = state_api.list_events(limit=6)

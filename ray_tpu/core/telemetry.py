@@ -313,6 +313,11 @@ DEFAULT_ALERT_RULES: List[dict] = [
      "severity": "WARNING",
      "message": "serve shedding >1 req/s for 10s — sustained overload "
                 "(queue_full / breaker_open)"},
+    {"name": "serve_ttft_p99_high", "metric": "rtpu_serve_ttft_s",
+     "stat": "p99", "op": ">", "threshold": 5.0, "for_s": 15.0,
+     "severity": "WARNING",
+     "message": "serve TTFT p99 above 5s for 15s — scale the pool or "
+                "shed load (queue wait is counted since arrival)"},
 ]
 
 

@@ -502,6 +502,45 @@ _define("RTPU_SERVE_RETRY_BUDGET", float, 0.2,
         "each retry spends one. Prevents retry amplification during an "
         "outage.")
 
+# -- serve: disaggregated LLM plane (prefill/decode pools, prefix cache) -----
+_define("RTPU_SERVE_DISAGG", bool, True,
+        "Disaggregated LLM serving: build_disagg_llm_deployment splits "
+        "prefill and decode into separately-scaled replica pools with a "
+        "streamed K/V handoff between them. 0 collapses the builder to "
+        "the unified continuous-batching deployment (identical request/"
+        "response behavior, one pool).")
+_define("RTPU_SERVE_DISAGG_RETRIES", int, 3,
+        "How many times the disagg ingress re-dispatches a token stream "
+        "to another decode replica after a mid-stream replica failure "
+        "before surfacing the error to the client.")
+_define("RTPU_PREFIX_CACHE", bool, True,
+        "Decode-replica prefix cache: prefilled K/V keyed by token-prefix "
+        "hash stays resident (LRU by KV bytes), so repeated prompts skip "
+        "prefill entirely. 0 disables lookup, insert, and the "
+        "controller-side cluster index.")
+_define("RTPU_PREFIX_CACHE_MAX_MB", float, 256.0,
+        "Per-replica prefix-cache budget in MiB of cached K/V (+logits) "
+        "bytes; least-recently-used entries evict past it.")
+_define("RTPU_PREFIX_CACHE_PROMOTE_HITS", int, 3,
+        "Cluster-index promotion threshold: once a prefix accumulates "
+        "this many cluster-wide hits, the serve controller broadcasts it "
+        "to decode replicas that don't hold it yet. <=0 disables "
+        "promotion.")
+_define("RTPU_SERVE_AUTOSCALE", bool, True,
+        "Signal-driven serve autoscaler: pool replica counts follow TTFT "
+        "p99 / slot occupancy / queue depth through the AlertEngine's "
+        "threshold+for-duration machinery for deployments that set a "
+        "scaling_policy. 0 freezes pools at their deployed size (the "
+        "legacy queue-length autoscaling_config path is unaffected).")
+_define("RTPU_SERVE_DRAIN_DEADLINE_S", float, 30.0,
+        "Scale-down grace: a draining replica stops receiving new "
+        "requests immediately (routers drop it on version bump) but is "
+        "only killed once idle or after this many seconds, so in-flight "
+        "streams finish across a resize.")
+_define("RTPU_SERVE_SCALE_COOLDOWN_S", float, 5.0,
+        "Minimum seconds between two autoscaler actions on the same "
+        "deployment, bounding resize churn.")
+
 # -- bench -------------------------------------------------------------------
 _define("RTPU_BENCH_TPU_TIMEOUT", int, 1500,
         "bench.py per-attempt TPU wall clock budget (seconds).")

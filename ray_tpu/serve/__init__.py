@@ -21,6 +21,9 @@ from .multiplex import get_multiplexed_model_id, multiplexed
 from .deployment import Application, AutoscalingConfig, Deployment, deployment
 from .llm import build_llm_deployment, build_streaming_llm_deployment
 from .llm_engine import ContinuousBatchingEngine
+from .disagg import build_disagg_llm_deployment
+from .prefix_cache import PrefixCache, prefix_key
+from .autoscaler import ScalingPolicy
 from .handle import (DeploymentHandle, DeploymentResponse,
                      DeploymentStreamingResponse)
 
@@ -48,5 +51,9 @@ __all__ = [
     "batch",
     "build_llm_deployment",
     "build_streaming_llm_deployment",
+    "build_disagg_llm_deployment",
     "ContinuousBatchingEngine",
+    "PrefixCache",
+    "prefix_key",
+    "ScalingPolicy",
 ]

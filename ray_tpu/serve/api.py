@@ -96,6 +96,8 @@ def run(target: Application, *, name: str = "default",
                 vars(dep.config.autoscaling_config)
                 if dep.config.autoscaling_config else None),
             "stream": dep.config.stream,
+            "scaling_policy": dep.config.scaling_policy,
+            "pool": dep.config.pool,
         }
         prefix = route_prefix if node is target else None
         ray_tpu.get(ctrl.deploy.remote(

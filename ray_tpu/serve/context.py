@@ -19,10 +19,15 @@ _request_ctx: "contextvars.ContextVar[Optional[dict]]" = (
 
 
 def set_request_context(*, deadline_ts: Optional[float] = None,
-                        request_id: str = ""):
-    """Install the current request's context; returns a reset token."""
+                        request_id: str = "",
+                        start_ts: Optional[float] = None):
+    """Install the current request's context; returns a reset token.
+    ``start_ts`` (epoch seconds) is when the request entered the system —
+    stamped once at the outermost hop and inherited by nested handle
+    calls, so TTFT accounting includes every queue the request crossed."""
     return _request_ctx.set(
-        {"deadline_ts": deadline_ts, "request_id": request_id})
+        {"deadline_ts": deadline_ts, "request_id": request_id,
+         "start_ts": start_ts})
 
 
 def reset_request_context(token) -> None:
@@ -37,6 +42,12 @@ def get_request_deadline() -> Optional[float]:
     """Absolute (epoch-seconds) deadline of the active request, or None."""
     c = _request_ctx.get()
     return c.get("deadline_ts") if c else None
+
+
+def get_request_start() -> Optional[float]:
+    """Epoch-seconds arrival time of the active request, or None."""
+    c = _request_ctx.get()
+    return c.get("start_ts") if c else None
 
 
 def remaining_s(default: Optional[float] = None) -> Optional[float]:

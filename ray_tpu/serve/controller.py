@@ -18,11 +18,45 @@ from typing import Any, Dict, List, Optional, Tuple
 import cloudpickle
 
 import ray_tpu
+from ray_tpu import flags
+
+from .autoscaler import ServeAutoscaler
+from .prefix_cache import PrefixIndex
 from .replica import ReplicaActor
 
 logger = logging.getLogger(__name__)
 
 CONTROLLER_NAME = "SERVE_CONTROLLER"
+
+_plane_metrics_cache = None
+
+
+def _plane_metrics():
+    """Controller-exported serve-plane gauges: the autoscaler's inputs and
+    `rtpu top`'s SERVE section read these off the shared metrics plane."""
+    global _plane_metrics_cache
+    if _plane_metrics_cache is None:
+        from ray_tpu.util.metrics import Gauge
+
+        _plane_metrics_cache = {
+            "queue": Gauge(
+                "rtpu_serve_queue_depth",
+                description="Requests queued for a generation slot across "
+                            "a deployment's replicas (serve controller "
+                            "stats poll)",
+                tag_keys=("model",)),
+            "replicas": Gauge(
+                "rtpu_serve_replicas",
+                description="Live replica count per serve deployment "
+                            "(pool label: prefill | decode | main)",
+                tag_keys=("deployment", "pool")),
+            "occupancy": Gauge(
+                "rtpu_serve_slot_occupancy",
+                description="Continuous-batching slot occupancy in [0,1] "
+                            "across a deployment's replicas",
+                tag_keys=("model",)),
+        }
+    return _plane_metrics_cache
 
 
 class _DeploymentInfo:
@@ -35,8 +69,14 @@ class _DeploymentInfo:
         self.config = config
         self.target_replicas: int = config["num_replicas"]
         self.replicas: List[Any] = []  # ActorHandles
+        # Scale-down victims mid-drain: (handle, drain_start_ts). Out of
+        # the routed set (version bump) but alive until idle or the drain
+        # deadline — in-flight streams finish across a resize.
+        self.draining: List[Tuple[Any, float]] = []
         self.version = 0
         self.last_error: Optional[str] = None
+        # Latest aggregated serving signals from the stats poll.
+        self.signals: Dict[str, float] = {}
         # autoscaling bookkeeping: when the metric FIRST crossed the
         # threshold (None = currently below it) — delays require sustained
         # load, not merely time-since-last-event.
@@ -50,6 +90,10 @@ class ServeController:
         self._route_prefixes: Dict[str, str] = {}  # prefix -> deployment
         self._lock = threading.Lock()
         self._stop = threading.Event()
+        # Signal-driven pool scaling + cluster prefix index (per
+        # deployment), both fed by the per-tick replica stats poll.
+        self._autoscaler = ServeAutoscaler()
+        self._prefix_index: Dict[str, PrefixIndex] = {}
         self._loop = threading.Thread(target=self._control_loop, daemon=True)
         self._loop.start()
 
@@ -78,6 +122,7 @@ class ServeController:
                 self._publish_update(name, info.version)
             if route_prefix:
                 self._route_prefixes[route_prefix] = name
+            self._autoscaler.configure(name, config.get("scaling_policy"))
         self._reconcile()
 
     def _publish_update(self, name: str, version: int) -> None:
@@ -98,8 +143,12 @@ class ServeController:
             info = self._deployments.pop(name, None)
             self._route_prefixes = {
                 p: d for p, d in self._route_prefixes.items() if d != name}
+            self._autoscaler.forget(name)
+            self._prefix_index.pop(name, None)
         if info:
             for r in info.replicas:
+                self._kill_replica(r)
+            for r, _ in info.draining:
                 self._kill_replica(r)
 
     def shutdown(self) -> None:
@@ -126,11 +175,17 @@ class ServeController:
         info = self._deployments.get(name)
         if info is None:
             raise KeyError(f"no deployment {name!r}")
-        return {
+        out = {
             "max_ongoing_requests": int(
                 info.config.get("max_ongoing_requests", 16) or 16),
             "max_queued_requests": info.config.get("max_queued_requests"),
         }
+        idx = self._prefix_index.get(name)
+        if idx is not None:
+            # Hot-prefix steering table: hash -> holder replica ids, so
+            # routers send a request where its K/V already lives.
+            out["prefix_routes"] = idx.routes()
+        return out
 
     def get_deployment_names(self) -> List[str]:
         return list(self._deployments)
@@ -228,13 +283,212 @@ class ServeController:
                 while len(alive) < info.target_replicas:
                     alive.append(self._make_replica(info))
                     changed = True
+                now = time.time()
                 while len(alive) > info.target_replicas:
-                    self._kill_replica(alive.pop())
+                    # Scale-down DRAINS instead of killing: the victim
+                    # leaves the routed set on this version bump (routers
+                    # stop picking it) and _reap_draining() kills it only
+                    # once idle or past RTPU_SERVE_DRAIN_DEADLINE_S — a
+                    # resize never cuts an in-flight stream.
+                    info.draining.append((alive.pop(), now))
                     changed = True
                 if changed:
                     info.replicas = alive
                     info.version += 1
                     self._publish_update(info.name, info.version)
+
+    def _reap_draining(self) -> None:
+        """Kill draining replicas that went idle (or overstayed the drain
+        deadline). Probes run OUTSIDE the lock — a hung drain victim must
+        not stall deploys."""
+        with self._lock:
+            snapshot = [(info, list(info.draining))
+                        for info in self._deployments.values()
+                        if info.draining]
+        if not snapshot:
+            return
+        grace = flags.get("RTPU_SERVE_DRAIN_DEADLINE_S")
+        now = time.time()
+        for info, entries in snapshot:
+            reaped = []
+            for r, ts in entries:
+                kill = now - ts >= grace
+                if not kill:
+                    try:
+                        kill = ray_tpu.get(r.queue_len.remote(),
+                                           timeout=2.0) == 0
+                    except Exception:
+                        kill = True  # already dead
+                if kill:
+                    self._kill_replica(r)
+                    reaped.append(r)
+            if reaped:
+                with self._lock:
+                    info.draining = [(r, ts) for r, ts in info.draining
+                                     if r not in reaped]
+
+    # ------------------------------------------------------- signal plane
+
+    def _poll_stats(self) -> Dict[str, Dict[str, float]]:
+        """Per-deployment serving signals from replica stats(): queue
+        depth (blocked submitters), slot occupancy, prefix-cache holdings
+        (folded into the cluster PrefixIndex). Also exports the
+        controller-side gauges the autoscaler and `rtpu top` read."""
+        with self._lock:
+            snapshot = [(info, list(info.replicas))
+                        for info in self._deployments.values()]
+        signals: Dict[str, Dict[str, float]] = {}
+        try:
+            m = _plane_metrics()
+        except Exception:
+            m = None
+        for info, replicas in snapshot:
+            refs = []
+            for r in replicas:
+                try:
+                    refs.append((r, r.stats.remote()))
+                except Exception:
+                    pass
+            deadline = time.monotonic() + 2.0
+            polled = []
+            saturated = 0
+            for r, ref in refs:
+                try:
+                    polled.append((r._actor_id, ray_tpu.get(
+                        ref, timeout=max(0.1,
+                                         deadline - time.monotonic()))))
+                except ray_tpu.GetTimeoutError:
+                    # The replica is alive but its mailbox is so full the
+                    # stats probe couldn't get a thread — which IS the
+                    # overload signal. Count it as fully busy with a
+                    # waiting queue rather than dropping it, or the
+                    # autoscaler would read peak saturation as idle.
+                    saturated += 1
+                except Exception:
+                    pass
+            queue = float(saturated)
+            busy = total = float(saturated)
+            idx = self._prefix_index.get(info.name)
+            for rid, s in polled:
+                serve = (s or {}).get("serve") or {}
+                queue += float(serve.get("queued", 0.0))
+                if serve.get("slots_total"):
+                    busy += float(serve.get("slots_busy", 0.0))
+                    total += float(serve["slots_total"])
+                pref = serve.get("prefix")
+                if pref:
+                    if idx is None:
+                        idx = PrefixIndex()
+                        self._prefix_index[info.name] = idx
+                    idx.update_replica(rid, pref.get("holders") or [],
+                                       pref.get("hot") or {})
+            if idx is not None:
+                live = {r._actor_id for r in replicas}
+                for rid in list(idx._by_replica):
+                    if rid not in live:
+                        idx.drop_replica(rid)
+            sig = {"queue_depth": queue,
+                   "occupancy": (busy / total) if total else 0.0}
+            ttft = self._ttft_p99(info.name)
+            if ttft is not None:
+                sig["ttft_p99_s"] = ttft
+            info.signals = sig
+            signals[info.name] = sig
+            if m is not None:
+                pool = info.config.get("pool") or "main"
+                try:
+                    m["queue"].set(queue, tags={"model": info.name})
+                    m["replicas"].set(float(len(replicas)),
+                                      tags={"deployment": info.name,
+                                            "pool": pool})
+                    if total:
+                        m["occupancy"].set(sig["occupancy"],
+                                           tags={"model": info.name})
+                except Exception:
+                    pass
+        return signals
+
+    def _ttft_p99(self, name: str) -> Optional[float]:
+        """Latest per-model TTFT p99 from the telemetry plane — only
+        fetched when the deployment's policy actually triggers on it
+        (telemetry may be disabled; the signal is best-effort)."""
+        p = self._autoscaler.policy(name)
+        if p is None or p.ttft_p99_high_s <= 0:
+            return None
+        try:
+            from ray_tpu.util import state as util_state
+
+            res = util_state.query_metrics(
+                name="rtpu_serve_ttft_s", tags={"model": name},
+                stat="p99", window_s=30.0)
+            for ser in (res or {}).get("series") or []:
+                pts = ser.get("points") or []
+                if pts:
+                    return float(pts[-1][1])
+        except Exception:
+            pass
+        return None
+
+    def _autoscale_signals(self, now: float,
+                           signals: Dict[str, Dict[str, float]]) -> None:
+        """Apply the signal-driven autoscaler's ±1 steps (clamped to the
+        policy's replica range); reconcile realizes them — up through the
+        deployment path, down through the drain path."""
+        deltas = self._autoscaler.step(now, signals)
+        if not deltas:
+            return
+        with self._lock:
+            for name, d in deltas.items():
+                info = self._deployments.get(name)
+                p = self._autoscaler.policy(name)
+                if info is None or p is None:
+                    continue
+                new = max(p.min_replicas,
+                          min(p.max_replicas, info.target_replicas + d))
+                if new != info.target_replicas:
+                    logger.info("serve autoscaler: %s %d -> %d replicas",
+                                name, info.target_replicas, new)
+                    info.target_replicas = new
+
+    def _promote_prefixes(self) -> None:
+        """Broadcast cluster-hot prefixes: replicas missing one pull the
+        blob straight from a holder replica (fire-and-forget; bytes move
+        worker<->worker, never through the controller)."""
+        if not flags.get("RTPU_PREFIX_CACHE"):
+            return
+        with self._lock:
+            snapshot = [(info, list(info.replicas))
+                        for info in self._deployments.values()]
+        for info, replicas in snapshot:
+            idx = self._prefix_index.get(info.name)
+            if idx is None or len(replicas) < 2:
+                continue
+            by_rid = {r._actor_id: r for r in replicas}
+            for h, holder_rid, target_rid in idx.promotions(list(by_rid)):
+                holder = by_rid.get(holder_rid)
+                target = by_rid.get(target_rid)
+                if holder is None or target is None:
+                    continue
+                try:
+                    target.handle_request.remote(
+                        "pull_prefix", (h, holder), {})
+                except Exception:
+                    pass
+
+    def get_serve_stats(self) -> Dict[str, Any]:
+        """Per-deployment serving snapshot for `rtpu top` / dashboards:
+        replica counts (live/target/draining), pool label, and the latest
+        polled signals."""
+        out: Dict[str, Any] = {}
+        with self._lock:
+            for name, info in self._deployments.items():
+                d = {"replicas": len(info.replicas),
+                     "target": info.target_replicas,
+                     "draining": len(info.draining),
+                     "pool": info.config.get("pool") or "main"}
+                d.update(info.signals or {})
+                out[name] = d
+        return out
 
     # --------------------------------------------------------- autoscaling
 
@@ -285,8 +539,13 @@ class ServeController:
     def _control_loop(self) -> None:
         while not self._stop.is_set():
             try:
+                now = time.time()
+                signals = self._poll_stats()
                 self._autoscale()
+                self._autoscale_signals(now, signals)
                 self._reconcile()
+                self._reap_draining()
+                self._promote_prefixes()
             except Exception:
                 logger.exception("serve control loop error")
             self._stop.wait(1.0)

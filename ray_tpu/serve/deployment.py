@@ -41,6 +41,14 @@ class DeploymentConfig:
     # Handler returns a generator; calls stream item-by-item and the HTTP
     # proxy writes a chunked response (reference: serve streaming responses).
     stream: bool = False
+    # Signal-driven autoscaling (serve/autoscaler.py ScalingPolicy or its
+    # dict form): replica count follows queue depth / slot occupancy /
+    # TTFT p99 through the AlertEngine machinery. Orthogonal to the
+    # legacy queue-length autoscaling_config.
+    scaling_policy: Optional[Dict[str, Any]] = None
+    # Pool label for the disaggregated LLM plane ("prefill" | "decode");
+    # rides into the per-pool replica-count gauge.
+    pool: Optional[str] = None
 
 
 class Deployment:
@@ -97,12 +105,18 @@ def deployment(
     autoscaling_config: Optional[Union[AutoscalingConfig, Dict]] = None,
     user_config: Optional[Dict[str, Any]] = None,
     stream: bool = False,
+    scaling_policy: Optional[Dict[str, Any]] = None,
+    pool: Optional[str] = None,
 ):
     """@serve.deployment decorator (reference serve/api.py:deployment)."""
 
     def wrap(fc):
         cfg = DeploymentConfig()
         cfg.stream = bool(stream)
+        if scaling_policy is not None:
+            cfg.scaling_policy = dict(scaling_policy)
+        if pool is not None:
+            cfg.pool = str(pool)
         if num_replicas is not None:
             cfg.num_replicas = num_replicas
         if max_ongoing_requests is not None:

@@ -227,6 +227,9 @@ class Router:
         self._budget = admission.RetryBudget()
         self._max_ongoing = 16
         self._max_queued: Optional[int] = None
+        # Hot-prefix routing table from the controller's PrefixIndex:
+        # prefix hash -> replica ids already holding that prefix's K/V.
+        self._prefix_routes: Dict[str, List[str]] = {}
         _routers.add(self)
         _ensure_push_subscription()
 
@@ -252,7 +255,8 @@ class Router:
                 raise DeploymentNotFoundError(self.name) from e
             raise
         rcfg = None
-        if flags.get("RTPU_SERVE_ADMISSION"):
+        if (flags.get("RTPU_SERVE_ADMISSION")
+                or flags.get("RTPU_PREFIX_CACHE")):
             try:
                 rcfg = ray_tpu.get(
                     self._ctrl().get_routing_config.remote(self.name))
@@ -271,6 +275,7 @@ class Router:
                 mq = rcfg.get("max_queued_requests")
                 self._max_queued = (flags.get("RTPU_SERVE_MAX_QUEUED")
                                     if mq is None else int(mq))
+                self._prefix_routes = rcfg.get("prefix_routes", {})
         self._board.prune([r._actor_id for r in replicas])
 
     @staticmethod
@@ -362,6 +367,15 @@ class Router:
                 reps = ok
             if not reps:
                 raise RuntimeError(f"no replicas for {self.name}")
+            # Prefix steering: when the controller's cluster index says
+            # some live replicas already HOLD this prefix's K/V, restrict
+            # the hash ring to them — the request hits their cache and
+            # skips prefill. Falls back to plain rendezvous otherwise.
+            holders = self._prefix_routes.get(model_id)
+            if holders:
+                held = [r for r in reps if r._actor_id in holders]
+                if held:
+                    reps = held
             r = max(
                 reps,
                 key=lambda rep: hashlib.md5(
@@ -421,6 +435,11 @@ class Router:
             # Nested composition: a handle call made INSIDE a serve
             # request inherits the enclosing request's budget.
             deadline_ts = serve_context.get_request_deadline()
+        # Arrival stamp: set once at the outermost hop, inherited by nested
+        # calls — TTFT downstream measures from HERE, queue wait included.
+        start_ts = serve_context.get_request_start()
+        if start_ts is None:
+            start_ts = time.time()
         if deadline_ts is not None and time.time() > deadline_ts:
             admission.deadline_exceeded(self.name)
             raise DeadlineExceededError(
@@ -470,13 +489,13 @@ class Router:
                     ref_gen = replica.handle_request_streaming.options(
                         num_returns="streaming", deadline_s=remaining,
                     ).remote(method_name, args, kwargs,
-                             multiplexed_model_id, deadline_ts)
+                             multiplexed_model_id, deadline_ts, start_ts)
                     return DeploymentStreamingResponse(
                         ref_gen, self, rid, deadline_ts)
                 ref = replica.handle_request.options(
                     deadline_s=remaining,
                 ).remote(method_name, args, kwargs, multiplexed_model_id,
-                         deadline_ts)
+                         deadline_ts, start_ts)
                 return DeploymentResponse(ref, self, rid, deadline_ts)
             except Exception as e:  # dead replica: drop + refresh
                 last_err = e

@@ -208,6 +208,13 @@ def build_streaming_llm_deployment(cfg, params_factory, *, name: str = "llm-stre
             self._step = jax.jit(
                 lambda p, c, t: decode_step(p, c, t, cfg))
 
+        def serve_stats(self) -> Dict[str, Any]:
+            """Engine load for the controller's signal poll (slot
+            occupancy + blocked submitters drive the serve autoscaler)."""
+            if self._engine is None:
+                return {}
+            return self._engine.stats()
+
         def __call__(self, request: Dict[str, Any]):
             import jax
             import jax.numpy as jnp
@@ -238,10 +245,13 @@ def build_streaming_llm_deployment(cfg, params_factory, *, name: str = "llm-stre
                 try:
                     # The slot wait is bounded by the request's remaining
                     # deadline budget (serve context) when one is set.
+                    # TTFT measures from the router's arrival stamp so
+                    # queue wait counts (user-observed latency).
                     req = self._engine.submit(
                         ids, max_new_tokens=n, temperature=temp,
                         eos_id=eos,
-                        timeout=serve_context.remaining_s(default=300.0))
+                        timeout=serve_context.remaining_s(default=300.0),
+                        arrival_ts=serve_context.get_request_start())
                 except TimeoutError as e:
                     # Backpressure uses the same error-chunk contract as
                     # malformed requests — not a raw stream exception.

@@ -124,6 +124,9 @@ class ContinuousBatchingEngine:
         self.failed: Optional[BaseException] = None
         self._free = list(range(self.B))
         self._free_cv = threading.Condition(self.lock)
+        # Submitters blocked waiting for a slot: the queue-depth signal the
+        # serve autoscaler scales decode pools on.
+        self._waiting = 0
         self._rng = jax.random.key(seed)
         self._draws = 0
 
@@ -163,12 +166,18 @@ class ContinuousBatchingEngine:
 
     def submit(self, tokens, *, max_new_tokens: Optional[int] = None,
                temperature: float = 0.0, eos_id: Optional[int] = None,
-               timeout: Optional[float] = None) -> int:
+               timeout: Optional[float] = None,
+               arrival_ts: Optional[float] = None) -> int:
         """Attach a request to a free slot (blocking while all slots busy).
         Returns a stable REQUEST id; poll with peek(), collect with
-        result() — valid even after the slot is recycled."""
+        result() — valid even after the slot is recycled.
+
+        ``arrival_ts`` (epoch seconds) is when the request ENTERED the
+        system — the proxy/router stamp, not prefill start — so the TTFT
+        histogram includes queue wait and reflects user-observed latency
+        (the signal the serve autoscaler scales on). Defaults to now."""
         jnp = self._jnp
-        t_submit = time.monotonic()
+        t0 = time.time() if arrival_ts is None else float(arrival_ts)
         ids = np.asarray(tokens, np.int32)
         if ids.ndim != 1 or ids.size == 0:
             raise ValueError("tokens must be a non-empty 1-D integer list")
@@ -185,22 +194,74 @@ class ContinuousBatchingEngine:
         if pad:
             k1 = jnp.pad(k1, ((0, 0), (0, pad), (0, 0), (0, 0)))
             v1 = jnp.pad(v1, ((0, 0), (0, pad), (0, 0), (0, 0)))
+        return self._attach(k1, v1, len(ids), np.asarray(logits1),
+                            max_new_tokens=max_new_tokens,
+                            temperature=temperature, eos_id=eos_id,
+                            timeout=timeout, arrival_ts=t0)
+
+    def attach_prefilled(self, k, v, length: int, logits, *,
+                         max_new_tokens: Optional[int] = None,
+                         temperature: float = 0.0,
+                         eos_id: Optional[int] = None,
+                         timeout: Optional[float] = None,
+                         arrival_ts: Optional[float] = None) -> int:
+        """Attach a request whose prefill ran ELSEWHERE — a prefill-pool
+        replica's handoff or a prefix-cache hit — splicing the K/V
+        straight into a free slot with no prefill compute here.
+
+        ``k``/``v`` are one request's [L, S, KVH, hd] (S = any length
+        bucket <= max_len); ``logits`` the prefill's last-position [V]
+        row that decides the first token. Everything else matches
+        submit()."""
+        jnp = self._jnp
+        k = jnp.asarray(k, self.cfg.dtype)
+        v = jnp.asarray(v, self.cfg.dtype)
+        if k.ndim != 4 or v.shape != k.shape:
+            raise ValueError("k/v must be [L, S, KVH, hd] for one request")
+        S = int(k.shape[1])
+        length = int(length)
+        if not (0 < length <= S <= self.max_len):
+            raise ValueError(
+                f"bad handoff: length={length} bucket={S} "
+                f"max_len={self.max_len}")
+        pad = self.max_len - S
+        if pad:
+            k = jnp.pad(k, ((0, 0), (0, pad), (0, 0), (0, 0)))
+            v = jnp.pad(v, ((0, 0), (0, pad), (0, 0), (0, 0)))
+        return self._attach(k, v, length, np.asarray(logits),
+                            max_new_tokens=max_new_tokens,
+                            temperature=temperature, eos_id=eos_id,
+                            timeout=timeout, arrival_ts=arrival_ts)
+
+    def _attach(self, k1, v1, length: int, logits1: np.ndarray, *,
+                max_new_tokens: Optional[int], temperature: float,
+                eos_id: Optional[int], timeout: Optional[float],
+                arrival_ts: Optional[float]) -> int:
+        """Shared slot-wait + splice tail of submit()/attach_prefilled():
+        k1/v1 are already padded to max_len, logits1 is the host [V] row."""
+        jnp = self._jnp
+        t0 = time.time() if arrival_ts is None else float(arrival_ts)
         with self._free_cv:
             # One monotonic deadline for the whole wait: contended submits
             # that wake repeatedly must not restart the clock each time.
             deadline = (None if timeout is None
                         else time.monotonic() + timeout)
-            while not self._free:
-                # A dead ticker thread recorded the failure and notified
-                # this condition; blocking the full timeout (or forever)
-                # on an engine that will never free a slot helps nobody.
-                if self.failed is not None:
-                    raise RuntimeError(f"engine failed: {self.failed!r}")
-                remaining = (None if deadline is None
-                             else deadline - time.monotonic())
-                if remaining is not None and remaining <= 0:
-                    raise TimeoutError("no free generation slot")
-                self._free_cv.wait(timeout=remaining)
+            self._waiting += 1
+            try:
+                while not self._free:
+                    # A dead ticker thread recorded the failure and notified
+                    # this condition; blocking the full timeout (or forever)
+                    # on an engine that will never free a slot helps nobody.
+                    if self.failed is not None:
+                        raise RuntimeError(
+                            f"engine failed: {self.failed!r}")
+                    remaining = (None if deadline is None
+                                 else deadline - time.monotonic())
+                    if remaining is not None and remaining <= 0:
+                        raise TimeoutError("no free generation slot")
+                    self._free_cv.wait(timeout=remaining)
+            finally:
+                self._waiting -= 1
             if self.failed is not None:
                 raise RuntimeError(f"engine failed: {self.failed!r}")
             slot = self._free.pop()
@@ -211,10 +272,9 @@ class ContinuousBatchingEngine:
             self._done_ev[req] = threading.Event()
             # First token comes from the prefill logits, decided under the
             # lock with the slot's sampling config.
-            first = self._pick_host(np.asarray(logits1), temperature)
+            first = self._pick_host(logits1, temperature)
             m = _serve_metrics()
-            m["ttft"].observe(time.monotonic() - t_submit,
-                              tags=self._mtags)
+            m["ttft"].observe(max(0.0, time.time() - t0), tags=self._mtags)
             m["tokens"].inc(1.0, tags=self._mtags)
             n = min(max_new_tokens or self.max_new, self.max_new)
             self.active[slot] = True
@@ -224,7 +284,7 @@ class ContinuousBatchingEngine:
             self.out[slot] = [int(first)]
             ck, cv, pos, cur = self._splice(
                 self.cache.k, self.cache.v, self.cache.pos, self.cur_tok,
-                k1, v1, jnp.asarray(len(ids), jnp.int32),
+                k1, v1, jnp.asarray(length, jnp.int32),
                 jnp.asarray(int(first), jnp.int32), slot)
             from ray_tpu.models.generate import KVCache
 
@@ -235,6 +295,36 @@ class ContinuousBatchingEngine:
                 self._retire_locked(slot)
             m["slots"].set(self.B - len(self._free), tags=self._mtags)
             return req
+
+    def prefill_only(self, tokens):
+        """Run this engine's bucketed prefill WITHOUT taking a slot:
+        returns host ``(k, v, length, logits)`` with k/v [L, S, KVH, hd]
+        (S = the length bucket) — exactly the handoff blob
+        attach_prefilled() accepts. The prefill pool and the prefix cache
+        both speak this format."""
+        jnp = self._jnp
+        ids = np.asarray(tokens, np.int32)
+        if ids.ndim != 1 or ids.size == 0:
+            raise ValueError("tokens must be a non-empty 1-D integer list")
+        ids = ids[-self.max_prompt_len:]
+        S = bucket_len(len(ids), self.max_prompt_len)
+        padded = np.zeros((1, S), np.int32)
+        padded[0, :len(ids)] = ids
+        logits1, k1, v1 = self._prefill_one(
+            self.params, jnp.asarray(padded),
+            jnp.asarray([len(ids)], jnp.int32))
+        return (np.asarray(k1), np.asarray(v1), len(ids),
+                np.asarray(logits1))
+
+    def stats(self) -> Dict[str, float]:
+        """Load snapshot for the serve controller's signal poll: busy/total
+        slots, occupancy in [0,1], and submitters blocked on a slot."""
+        with self.lock:
+            busy = self.B - len(self._free)
+            return {"slots_busy": float(busy),
+                    "slots_total": float(self.B),
+                    "occupancy": busy / float(self.B),
+                    "queued": float(self._waiting)}
 
     def _pick_host(self, logits: np.ndarray, temperature: float) -> int:
         if temperature <= 0:

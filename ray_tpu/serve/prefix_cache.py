@@ -1,0 +1,249 @@
+"""KV prefix cache: hot prompts keep their prefilled K/V resident.
+
+Parity: vLLM automatic prefix caching / SGLang RadixAttention, adapted to
+the disaggregated plane (disagg.py): decode replicas cache the handoff
+blob — ``(k, v, length, logits)`` exactly as attach_prefilled() accepts
+it — keyed by a hash of the prompt tokens, LRU-evicted by KV BYTES (the
+resource that actually runs out), so a repeated system prompt never pays
+prefill again anywhere.
+
+Two layers:
+- ``PrefixCache``: per-replica store (this module's hot path; pure host
+  numpy, no JAX). Flag-gated by RTPU_PREFIX_CACHE so the disabled path
+  is uniform no-ops at every call site.
+- ``PrefixIndex``: controller-side cluster index mapping prefix hash ->
+  holder replicas + cluster-wide hit counts, fed by the controller's
+  replica stats poll. It derives (a) the hot-prefix routing table pushed
+  to routers so requests steer to replicas already holding their prefix,
+  and (b) promotion decisions: once a prefix is cluster-hot, replicas
+  that miss it pull the blob straight from a holder (worker<->worker,
+  bytes never transit the controller).
+"""
+from __future__ import annotations
+
+import hashlib
+import threading
+from collections import OrderedDict
+from typing import Any, Dict, List, Optional, Set, Tuple
+
+import numpy as np
+
+from ray_tpu import flags
+
+_cache_metrics_cache = None
+
+
+def _cache_metrics():
+    """Lazy shared prefix-cache metrics (one set per process, model tag)."""
+    global _cache_metrics_cache
+    if _cache_metrics_cache is None:
+        from ray_tpu.util.metrics import Counter, Gauge
+
+        _cache_metrics_cache = {
+            "hits": Counter(
+                "rtpu_prefix_cache_hits_total",
+                description="Prefix-cache hits: requests whose prefilled "
+                            "K/V was already resident (prefill skipped)",
+                tag_keys=("model",)),
+            "misses": Counter(
+                "rtpu_prefix_cache_misses_total",
+                description="Prefix-cache misses: requests that had to "
+                            "run (or wait for) a cold prefill",
+                tag_keys=("model",)),
+            "bytes": Gauge(
+                "rtpu_prefix_cache_bytes",
+                description="Resident prefix-cache K/V bytes on this "
+                            "replica (LRU evicts past the budget)",
+                tag_keys=("model",)),
+            "entries": Gauge(
+                "rtpu_prefix_cache_entries",
+                description="Resident prefix-cache entries on this "
+                            "replica",
+                tag_keys=("model",)),
+        }
+    return _cache_metrics_cache
+
+
+def prefix_key(tokens) -> str:
+    """Stable hash of a token sequence: the cache/index/routing key.
+
+    Exact-prompt keying (not per-block): a hit means THE WHOLE prefill is
+    skippable, which is the common win for repeated system prompts."""
+    ids = np.ascontiguousarray(np.asarray(tokens, np.int32))
+    return hashlib.blake2b(ids.tobytes(), digest_size=16).hexdigest()
+
+
+class PrefixEntry:
+    """One cached prefill handoff blob (host numpy, ready to splice)."""
+
+    __slots__ = ("k", "v", "length", "logits", "nbytes", "hits")
+
+    def __init__(self, k: np.ndarray, v: np.ndarray, length: int,
+                 logits: np.ndarray):
+        self.k = k
+        self.v = v
+        self.length = int(length)
+        self.logits = logits
+        self.nbytes = int(k.nbytes + v.nbytes + logits.nbytes)
+        self.hits = 0
+
+
+class PrefixCache:
+    """Per-replica LRU-by-bytes store of prefilled K/V blobs."""
+
+    def __init__(self, *, max_bytes: Optional[int] = None, model: str = ""):
+        if max_bytes is None:
+            max_bytes = int(flags.get("RTPU_PREFIX_CACHE_MAX_MB") * 2**20)
+        self.max_bytes = max_bytes
+        self.model = model or "default"
+        self._mtags = {"model": self.model}
+        self._lock = threading.Lock()
+        self._entries: "OrderedDict[str, PrefixEntry]" = OrderedDict()
+        self._bytes = 0
+        self.hits = 0
+        self.misses = 0
+
+    @property
+    def enabled(self) -> bool:
+        return bool(flags.get("RTPU_PREFIX_CACHE"))
+
+    def get(self, h: str) -> Optional[PrefixEntry]:
+        """Lookup + LRU touch; counts the hit/miss (the autoscaler and
+        BENCH read hit rate from these counters)."""
+        if not self.enabled:
+            return None
+        m = _cache_metrics()
+        with self._lock:
+            e = self._entries.get(h)
+            if e is None:
+                self.misses += 1
+                m["misses"].inc(1.0, tags=self._mtags)
+                return None
+            self._entries.move_to_end(h)
+            e.hits += 1
+            self.hits += 1
+        m["hits"].inc(1.0, tags=self._mtags)
+        return e
+
+    def put(self, h: str, k, v, length: int, logits) -> bool:
+        """Insert a blob (host copies); evicts LRU entries past the byte
+        budget. Oversized blobs (> budget) are refused rather than
+        wiping the whole cache for one entry."""
+        if not self.enabled:
+            return False
+        e = PrefixEntry(np.asarray(k), np.asarray(v), length,
+                        np.asarray(logits))
+        if e.nbytes > self.max_bytes:
+            return False
+        with self._lock:
+            old = self._entries.pop(h, None)
+            if old is not None:
+                self._bytes -= old.nbytes
+            self._entries[h] = e
+            self._bytes += e.nbytes
+            while self._bytes > self.max_bytes and len(self._entries) > 1:
+                _, victim = self._entries.popitem(last=False)
+                self._bytes -= victim.nbytes
+            m = _cache_metrics()
+            m["bytes"].set(float(self._bytes), tags=self._mtags)
+            m["entries"].set(float(len(self._entries)), tags=self._mtags)
+        return True
+
+    def __contains__(self, h: str) -> bool:
+        with self._lock:
+            return h in self._entries
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def export(self, h: str) -> Optional[Dict[str, Any]]:
+        """Serializable form of one entry for cross-replica promotion
+        (the missing replica pulls this straight from a holder)."""
+        with self._lock:
+            e = self._entries.get(h)
+            if e is None:
+                return None
+            return {"k": e.k, "v": e.v, "length": e.length,
+                    "logits": e.logits}
+
+    def insert_blob(self, h: str, blob: Dict[str, Any]) -> bool:
+        return self.put(h, blob["k"], blob["v"], blob["length"],
+                        blob["logits"])
+
+    def stats(self, *, top: int = 64) -> Dict[str, Any]:
+        """Snapshot for serve_stats(): counters, residency, and the
+        hottest resident hashes with per-entry hit counts — the
+        controller's poll feeds these into the cluster PrefixIndex."""
+        with self._lock:
+            hot = sorted(((h, e.hits) for h, e in self._entries.items()),
+                         key=lambda kv: -kv[1])[:top]
+            return {"hits": self.hits, "misses": self.misses,
+                    "bytes": self._bytes, "entries": len(self._entries),
+                    "holders": [h for h, _ in hot],
+                    "hot": dict(hot)}
+
+
+class PrefixIndex:
+    """Cluster view (lives in the ServeController): which replicas hold
+    which prefixes, and how hot each prefix is cluster-wide."""
+
+    def __init__(self):
+        self._by_replica: Dict[str, Dict[str, int]] = {}  # rid -> {h: hits}
+        self._holders: Dict[str, Set[str]] = {}           # h -> {rid}
+        self._promoted: Set[Tuple[str, str]] = set()      # (h, target_rid)
+
+    def update_replica(self, rid: str, holders: List[str],
+                       hot: Dict[str, int]) -> None:
+        """Fold one replica's stats-poll report into the index. Reports
+        are cumulative per replica; cluster hits = sum of latest reports."""
+        self._by_replica[rid] = {h: int(hot.get(h, 0)) for h in holders}
+        self._rebuild()
+
+    def drop_replica(self, rid: str) -> None:
+        if self._by_replica.pop(rid, None) is not None:
+            self._rebuild()
+
+    def _rebuild(self) -> None:
+        holders: Dict[str, Set[str]] = {}
+        for rid, held in self._by_replica.items():
+            for h in held:
+                holders.setdefault(h, set()).add(rid)
+        self._holders = holders
+
+    def holders(self, h: str) -> Set[str]:
+        return set(self._holders.get(h, ()))
+
+    def cluster_hits(self, h: str) -> int:
+        return sum(held.get(h, 0) for held in self._by_replica.values())
+
+    def routes(self, *, top: int = 128) -> Dict[str, List[str]]:
+        """Hot-prefix routing table for get_routing_config(): hash ->
+        sorted holder replica ids, hottest prefixes first."""
+        scored = sorted(self._holders,
+                        key=lambda h: -self.cluster_hits(h))[:top]
+        return {h: sorted(self._holders[h]) for h in scored}
+
+    def promotions(self, all_replicas: List[str],
+                   *, threshold: Optional[int] = None
+                   ) -> List[Tuple[str, str, str]]:
+        """(prefix, holder_rid, target_rid) pulls to run: cluster-hot
+        prefixes broadcast to replicas that don't hold them yet. Each
+        (prefix, target) pair promotes at most once per index lifetime —
+        a replica that joins later still receives earlier hot prefixes,
+        but the broadcast never repeats on every control tick."""
+        if threshold is None:
+            threshold = int(flags.get("RTPU_PREFIX_CACHE_PROMOTE_HITS"))
+        if threshold <= 0 or not flags.get("RTPU_PREFIX_CACHE"):
+            return []
+        out: List[Tuple[str, str, str]] = []
+        for h, holders in self._holders.items():
+            if not holders or self.cluster_hits(h) < threshold:
+                continue
+            holder = sorted(holders)[0]
+            for t in all_replicas:
+                if t in holders or (h, t) in self._promoted:
+                    continue
+                out.append((h, holder, t))
+                self._promoted.add((h, t))
+        return out

@@ -45,7 +45,8 @@ class ReplicaActor:
 
     def handle_request(self, method_name: str, args: Tuple, kwargs: Dict,
                        multiplexed_model_id: str = "",
-                       deadline_ts: Optional[float] = None):
+                       deadline_ts: Optional[float] = None,
+                       start_ts: Optional[float] = None):
         from . import context as serve_context
         from .multiplex import _set_model_id
 
@@ -55,7 +56,7 @@ class ReplicaActor:
             self._total += 1
         token = _set_model_id(multiplexed_model_id)
         ctx_token = serve_context.set_request_context(
-            deadline_ts=deadline_ts)
+            deadline_ts=deadline_ts, start_ts=start_ts)
         try:
             if self._is_function:
                 return self._callable(*args, **kwargs)
@@ -73,7 +74,8 @@ class ReplicaActor:
     def handle_request_streaming(self, method_name: str, args: Tuple,
                                  kwargs: Dict,
                                  multiplexed_model_id: str = "",
-                                 deadline_ts: Optional[float] = None):
+                                 deadline_ts: Optional[float] = None,
+                                 start_ts: Optional[float] = None):
         """Generator variant: the user handler returns a generator/iterable
         whose items stream to the caller one object at a time (reference:
         serve streaming responses over streaming generator returns,
@@ -87,7 +89,7 @@ class ReplicaActor:
             self._total += 1
         _set_model_id(multiplexed_model_id)
         ctx_token = serve_context.set_request_context(
-            deadline_ts=deadline_ts)
+            deadline_ts=deadline_ts, start_ts=start_ts)
         try:
             if self._is_function:
                 result = self._callable(*args, **kwargs)
@@ -107,8 +109,21 @@ class ReplicaActor:
     def queue_len(self) -> int:
         return self._ongoing
 
-    def stats(self) -> Dict[str, int]:
-        return {"ongoing": self._ongoing, "total": self._total}
+    def stats(self) -> Dict[str, Any]:
+        """Replica load snapshot. When the user callable exposes a
+        ``serve_stats()`` protocol (the LLM engine deployments do: slot
+        occupancy, blocked submitters, prefix-cache hit rates), its dict
+        is merged in under ``serve`` — the controller's signal poll and
+        the autoscaler read it from here."""
+        out: Dict[str, Any] = {"ongoing": self._ongoing,
+                               "total": self._total}
+        fn = getattr(self._callable, "serve_stats", None)
+        if callable(fn):
+            try:
+                out["serve"] = fn()
+            except Exception:
+                pass
+        return out
 
     def check_health(self) -> bool:
         user_check = getattr(self._callable, "check_health", None)

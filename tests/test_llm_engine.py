@@ -213,3 +213,78 @@ def test_sampled_slots_vary_and_respect_budget(engine_setup):
         outs.append(eng.result(r, timeout=60))
     assert all(len(o) == 5 for o in outs)
     assert outs[0] != outs[1], "different seeds sampled identical streams"
+
+
+def test_attach_prefilled_matches_submit(engine_setup):
+    """The disagg handoff path (prefill_only on one engine ->
+    attach_prefilled on another) must replay the exact greedy stream that
+    a unified submit() produces — K/V splice, logits carry-over, and
+    length bookkeeping are all byte-equivalent."""
+    cfg, params = engine_setup
+    prefiller = ContinuousBatchingEngine(cfg, params, num_slots=1,
+                                         max_prompt_len=16, max_new_tokens=6)
+    decoder = ContinuousBatchingEngine(cfg, params, num_slots=2,
+                                       max_prompt_len=16, max_new_tokens=6)
+    for prompt in ([5, 9, 2], [7, 1, 3, 3, 8, 1, 2, 2, 4]):
+        r_ref = decoder.submit(prompt, max_new_tokens=6)
+        while decoder.tick():
+            pass
+        ref = decoder.result(r_ref, timeout=60)
+        decoder.discard(r_ref)
+
+        k, v, length, logits = prefiller.prefill_only(prompt)
+        assert length == len(prompt)
+        r = decoder.attach_prefilled(k, v, length, logits, max_new_tokens=6)
+        while decoder.tick():
+            pass
+        got = decoder.result(r, timeout=60)
+        decoder.discard(r)
+        assert got == ref == _naive(params, cfg, prompt, 6), (prompt, got)
+
+
+def test_attach_prefilled_validates_shapes(engine_setup):
+    cfg, params = engine_setup
+    eng = ContinuousBatchingEngine(cfg, params, num_slots=1,
+                                   max_prompt_len=16, max_new_tokens=4)
+    k, v, length, logits = eng.prefill_only([5, 9, 2])
+    with pytest.raises(ValueError):
+        eng.attach_prefilled(k[0], v, length, logits)  # ndim != 4
+    with pytest.raises(ValueError):
+        eng.attach_prefilled(k, v, 0, logits)  # empty prefix
+    with pytest.raises(ValueError):
+        eng.attach_prefilled(k, v, k.shape[1] + 1, logits)  # length > S
+
+
+def test_ttft_measures_from_arrival_not_prefill(engine_setup, monkeypatch):
+    """Satellite fix: TTFT is measured from request ARRIVAL (queue wait
+    included), not from when prefill starts. A request stamped as having
+    arrived 5s ago must observe a TTFT >= 5s even though its prefill runs
+    immediately; an unstamped request stays near zero."""
+    import time as _time
+
+    from ray_tpu.serve.llm_engine import _serve_metrics
+
+    cfg, params = engine_setup
+    eng = ContinuousBatchingEngine(cfg, params, num_slots=1,
+                                   max_prompt_len=16, max_new_tokens=2,
+                                   model="ttft-test")
+    hist = _serve_metrics()["ttft"]
+    seen = []
+    orig = hist.observe
+
+    def spy(value, tags=None):
+        seen.append(float(value))
+        return orig(value, tags=tags)
+
+    monkeypatch.setattr(hist, "observe", spy)
+    r = eng.submit([5, 9, 2], arrival_ts=_time.time() - 5.0)
+    while eng.tick():
+        pass
+    eng.result(r, timeout=60)
+    eng.discard(r)
+    assert seen and seen[0] >= 5.0, seen
+    r2 = eng.submit([5, 9, 2])
+    while eng.tick():
+        pass
+    eng.result(r2, timeout=60)
+    assert len(seen) == 2 and seen[1] < 5.0, seen

@@ -5,6 +5,7 @@ release/benchmarks/README.md (10k+ objects in one wait, 1M+ queued tasks).
 Floors are deliberately ~10x below observed numbers on the 1-CPU CI host
 (benchmarks/PERF.json) so only order-of-magnitude regressions trip them.
 """
+import os
 import time
 
 import numpy as np
@@ -541,3 +542,100 @@ def test_serve_admission_disabled_path_overhead(ray_start_regular,
             f"admission-off serve throughput {100/dt:.0f}/s below floor"
     finally:
         serve.shutdown()
+
+
+def test_prefix_cache_disabled_path_overhead(monkeypatch):
+    """Prefix-cache guard (mirrors the RTPU_TASK_EVENTS guard): with
+    RTPU_PREFIX_CACHE=0 get/put are uniform no-ops — one flag check, no
+    hashing, no locking, no host copies — so a cacheless build pays the
+    serving hot path nothing."""
+    monkeypatch.setenv("RTPU_PREFIX_CACHE", "0")
+    from ray_tpu.serve.prefix_cache import PrefixCache
+
+    cache = PrefixCache(max_bytes=1 << 20, model="perf")
+    k = np.zeros((2, 16, 2, 4), np.float32)
+    v = np.zeros_like(k)
+    logits = np.zeros(64, np.float32)
+    n = 5000
+    t0 = time.perf_counter()
+    for _ in range(n):
+        cache.put("h", k, v, 4, logits)
+        cache.get("h")
+    dt = time.perf_counter() - t0
+    assert len(cache) == 0  # truly off: nothing was stored
+    ops = 2 * n / dt
+    assert ops > 50_000, f"disabled prefix-cache path {ops:.0f} ops/s"
+
+
+def test_serve_disagg_disabled_path_overhead(ray_start_regular,
+                                             monkeypatch):
+    """Disagg guard: with RTPU_SERVE_DISAGG=0 (and the prefix cache off)
+    build_disagg_llm_deployment collapses to the unified single-pool
+    continuous-batching deployment — same request contract, no pool hop,
+    no cache probe — and its tokens are byte-identical to the unified
+    engine reference while holding a streaming throughput floor."""
+    monkeypatch.setenv("RTPU_SERVE_DISAGG", "0")
+    monkeypatch.setenv("RTPU_PREFIX_CACHE", "0")
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu import serve
+    from ray_tpu.models import generate as gen_fn
+    from ray_tpu.models import transformer as tfm
+    from ray_tpu.models.configs import llama_tiny
+    from ray_tpu.serve.disagg import build_disagg_llm_deployment
+
+    cfg = llama_tiny(remat=False)
+
+    def factory():
+        return tfm.init_params(jax.random.key(0), cfg)
+
+    app = build_disagg_llm_deployment(
+        cfg, factory, name="perf-uni", num_decode_replicas=1, num_slots=2,
+        max_prompt_len=16, max_new_tokens=4)
+    handle = serve.run(app, route_prefix="/perf-uni")
+    try:
+        # Single unified deployment: the pools must not exist.
+        st = serve.status()
+        assert "perf-uni" in st and "perf-uni-prefill" not in st
+        prompt = [3, 1, 4, 1]
+        exp = np.asarray(gen_fn(
+            factory(), jnp.asarray([prompt], jnp.int32), cfg,
+            max_new_tokens=4))[0, len(prompt):].tolist()
+        for _ in range(2):  # warm compile + router
+            toks = [c["token"] for c in
+                    handle.options(stream=True).remote({"tokens": prompt})]
+            assert toks == exp, (toks, exp)
+        n = 10
+        t0 = time.perf_counter()
+        for _ in range(n):
+            toks = [c["token"] for c in
+                    handle.options(stream=True).remote({"tokens": prompt})]
+            assert toks == exp
+        dt = time.perf_counter() - t0
+        assert n / dt > 1.0, \
+            f"disagg-off streaming throughput {n/dt:.1f} req/s below floor"
+    finally:
+        serve.shutdown()
+
+
+@pytest.mark.slow
+def test_serve_bench_smoke(tmp_path):
+    """The serve benchmark's --smoke profile must run end to end and
+    emit a well-formed BENCH json (slow tier; the committed
+    benchmarks/BENCH_r10.json comes from the full profile)."""
+    import json
+    import subprocess
+    import sys
+
+    out = tmp_path / "bench.json"
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.run(
+        [sys.executable, os.path.join(root, "benchmarks", "serve_bench.py"),
+         "--smoke", "--out", str(out)],
+        capture_output=True, text=True, timeout=900,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    data = json.loads(out.read_text())
+    assert data["serve_ttft_hit_speedup"]["value"] >= 2.0
+    assert data["serve_failed_streams"]["value"] == 0

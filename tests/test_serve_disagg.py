@@ -1,0 +1,267 @@
+"""Disaggregated serving plane (serve/disagg.py): prefill/decode pools
+with worker<->worker KV handoff, the replica prefix cache + cluster
+index, ingress replay across decode-replica death, and the signal-driven
+serve autoscaler end to end."""
+import os
+import signal
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+from ray_tpu.models import generate as gen_fn
+from ray_tpu.models import transformer as tfm
+from ray_tpu.models.configs import llama_tiny
+from ray_tpu.serve.disagg import build_disagg_llm_deployment
+from ray_tpu.serve.prefix_cache import prefix_key
+
+CFG = llama_tiny(remat=False)
+
+
+def _factory():
+    return tfm.init_params(jax.random.key(0), CFG)
+
+
+def _expected(prompt, n):
+    params = _factory()
+    return np.asarray(gen_fn(
+        params, jnp.asarray([prompt], jnp.int32), CFG,
+        max_new_tokens=n))[0, len(prompt):].tolist()
+
+
+@pytest.fixture(scope="module")
+def serve_instance():
+    ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
+    yield
+    serve.shutdown()
+    ray_tpu.shutdown()
+
+
+def _decode_reps(name):
+    ctrl = ray_tpu.get_actor("SERVE_CONTROLLER")
+    _, reps = ray_tpu.get(ctrl.get_replicas.remote(f"{name}-decode"))
+    return reps
+
+
+def _call(rep, method, *args):
+    return ray_tpu.get(rep.handle_request.remote(method, args, {}),
+                       timeout=30)
+
+
+def test_disagg_matches_unified_and_caches_prefix(serve_instance):
+    """Tokens through the disaggregated plane (prefill pool -> KV handoff
+    -> decode splice) are exactly the unified greedy reference; a repeat
+    of the same prompt is a prefix-cache hit that skips prefill."""
+    app = build_disagg_llm_deployment(
+        CFG, _factory, name="dsg", num_prefill_replicas=1,
+        num_decode_replicas=1, num_slots=2, max_prompt_len=16,
+        max_new_tokens=4)
+    handle = serve.run(app, route_prefix="/dsg")
+    try:
+        for prompt in ([3, 1, 4, 1], [5, 9], [2, 6, 5, 3, 5, 8, 9]):
+            toks = [c["token"] for c in
+                    handle.options(stream=True).remote({"tokens": prompt})]
+            assert toks == _expected(prompt, 4), (prompt, toks)
+        rep = _decode_reps("dsg")[0]
+        st0 = _call(rep, "cache_stats")
+        assert st0["entries"] == 3 and st0["misses"] == 3
+        # Repeat: served from the resident K/V, no new prefill.
+        prompt = [3, 1, 4, 1]
+        toks = [c["token"] for c in
+                handle.options(stream=True).remote({"tokens": prompt})]
+        assert toks == _expected(prompt, 4)
+        st1 = _call(rep, "cache_stats")
+        assert st1["hits"] == st0["hits"] + 1
+        assert st1["misses"] == st0["misses"]
+        assert _call(rep, "has_prefix", prefix_key(prompt))
+    finally:
+        serve.delete("dsg")
+        serve.delete("dsg-decode")
+        serve.delete("dsg-prefill")
+
+
+def test_disagg_disabled_collapses_to_unified(serve_instance, monkeypatch):
+    """RTPU_SERVE_DISAGG=0: the builder returns the single-pool streaming
+    deployment under the same name and request contract."""
+    monkeypatch.setenv("RTPU_SERVE_DISAGG", "0")
+    app = build_disagg_llm_deployment(
+        CFG, _factory, name="uni", num_decode_replicas=1, num_slots=2,
+        max_prompt_len=16, max_new_tokens=4)
+    handle = serve.run(app, route_prefix="/uni")
+    try:
+        prompt = [3, 1, 4, 1]
+        toks = [c["token"] for c in
+                handle.options(stream=True).remote({"tokens": prompt})]
+        assert toks == _expected(prompt, 4)
+        # No pool deployments exist — one unified deployment only.
+        st = serve.status()
+        assert "uni" in st and "uni-decode" not in st \
+            and "uni-prefill" not in st
+    finally:
+        serve.delete("uni")
+
+
+@pytest.mark.chaos
+def test_decode_replica_sigkill_mid_stream(serve_instance):
+    """Chaos: SIGKILL the decode replica serving a stream. The ingress
+    re-routes to the surviving replica — reusing its cached prefix K/V
+    when present, re-prefilling through the pool otherwise — and the
+    client sees every token exactly once (no duplicate, no loss)."""
+    app = build_disagg_llm_deployment(
+        CFG, _factory, name="chs", num_prefill_replicas=1,
+        num_decode_replicas=2, num_slots=2, max_prompt_len=16,
+        max_new_tokens=24)
+    handle = serve.run(app, route_prefix="/chs")
+    try:
+        # ---- variant A: survivor already holds the prefix (cached reuse)
+        prompt = [3, 1, 4, 1, 5]
+        exp = _expected(prompt, 24)
+        # Warm-up runs compile on the serving replica and caches the
+        # prefix there.
+        toks = [c["token"] for c in
+                handle.options(stream=True).remote({"tokens": prompt})]
+        assert toks == exp
+        h = prefix_key(prompt)
+        reps = _decode_reps("chs")
+        held = [_call(r, "has_prefix", h) for r in reps]
+        assert held.count(True) == 1
+        victim = reps[held.index(True)]
+        survivor = reps[held.index(False)]
+        # Pre-position the blob on the survivor (the promotion pull path)
+        # and warm its engine compile so the replay is quick.
+        assert _call(survivor, "pull_prefix", h, victim)
+        warm = [c["token"] for c in handle.options(stream=True).remote(
+            {"tokens": [9, 9, 2]})]
+        assert len(warm) == 24
+        sv0 = _call(survivor, "cache_stats")
+        victim_pid = _call(victim, "pid")
+
+        stream = handle.options(stream=True).remote({"tokens": prompt})
+        it = iter(stream)
+        got = [next(it)["token"] for _ in range(2)]
+        os.kill(victim_pid, signal.SIGKILL)
+        got += [c["token"] for c in it]
+        assert got == exp, ("tokens duplicated or lost across re-route",
+                            got, exp)
+        sv1 = _call(survivor, "cache_stats")
+        assert sv1["hits"] > sv0["hits"], \
+            "survivor should have served the replay from its prefix cache"
+
+        # ---- variant B: survivor does NOT hold the prefix (re-prefill)
+        # Wait for the controller to restore the killed replica first.
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            reps = _decode_reps("chs")
+            if len(reps) == 2:
+                try:
+                    pids = [_call(r, "pid") for r in reps]
+                    if victim_pid not in pids:
+                        break
+                except Exception:
+                    pass
+            time.sleep(0.5)
+        reps = _decode_reps("chs")
+        assert len(reps) == 2
+        prompt2 = [7, 1, 3, 3, 8]
+        exp2 = _expected(prompt2, 24)
+        toks = [c["token"] for c in
+                handle.options(stream=True).remote({"tokens": prompt2})]
+        assert toks == exp2
+        h2 = prefix_key(prompt2)
+        held = [_call(r, "has_prefix", h2) for r in reps]
+        assert held.count(True) == 1
+        victim = reps[held.index(True)]
+        survivor = reps[held.index(False)]
+        sv0 = _call(survivor, "cache_stats")
+        victim_pid = _call(victim, "pid")
+
+        stream = handle.options(stream=True).remote({"tokens": prompt2})
+        it = iter(stream)
+        got = [next(it)["token"] for _ in range(2)]
+        os.kill(victim_pid, signal.SIGKILL)
+        got += [c["token"] for c in it]
+        assert got == exp2, ("tokens duplicated or lost across re-route",
+                             got, exp2)
+        sv1 = _call(survivor, "cache_stats")
+        assert sv1["misses"] > sv0["misses"], \
+            "survivor should have re-prefilled (cache miss) for the replay"
+        assert _call(survivor, "has_prefix", h2)
+    finally:
+        serve.delete("chs")
+        serve.delete("chs-decode")
+        serve.delete("chs-prefill")
+
+
+def test_autoscaler_scales_up_and_drains_down(serve_instance):
+    """Signal-driven autoscaling: sustained queue depth scales the pool
+    up through the deployment path; idle drains it back down without
+    killing a busy replica, and requests keep succeeding throughout."""
+    policy = {"min_replicas": 1, "max_replicas": 2,
+              "queue_depth_high": 3.0, "queue_depth_low": 0.5,
+              "occupancy_low": 0.5, "up_for_s": 2.0, "down_for_s": 3.0,
+              "cooldown_s": 0.0}
+
+    @serve.deployment(name="scaly", scaling_policy=policy)
+    class Scaly:
+        def __init__(self):
+            self._q = 0.0
+
+        def set_queue(self, q):
+            self._q = float(q)
+            return self._q
+
+        def serve_stats(self):
+            return {"queued": self._q, "slots_busy": 0.0,
+                    "slots_total": 1.0, "occupancy": 0.0}
+
+        def __call__(self, x):
+            return x
+
+    handle = serve.run(Scaly.bind(), route_prefix="/scaly")
+    try:
+        ctrl = ray_tpu.get_actor("SERVE_CONTROLLER")
+
+        def stats():
+            return ray_tpu.get(
+                ctrl.get_serve_stats.remote(), timeout=10)["scaly"]
+
+        assert stats()["replicas"] == 1
+        # Sustained pressure: every replica reports a deep queue.
+        def set_all(q):
+            _, reps = ray_tpu.get(ctrl.get_replicas.remote("scaly"))
+            for r in reps:
+                ray_tpu.get(r.handle_request.remote(
+                    "set_queue", (q,), {}), timeout=10)
+
+        deadline = time.time() + 30
+        grew = False
+        while time.time() < deadline:
+            set_all(10.0)
+            if stats()["replicas"] >= 2:
+                grew = True
+                break
+            time.sleep(0.5)
+        assert grew, "autoscaler never scaled up under queue pressure"
+        assert handle.remote(1).result(timeout=30) == 1
+
+        # Idle: queues drain; the pool must fall back to min_replicas
+        # via the drain path (victim leaves routing before it dies).
+        deadline = time.time() + 45
+        shrank = False
+        while time.time() < deadline:
+            set_all(0.0)
+            st = stats()
+            if st["replicas"] == 1 and st["draining"] == 0:
+                shrank = True
+                break
+            # Requests keep working mid-resize.
+            assert handle.remote(2).result(timeout=30) == 2
+            time.sleep(0.5)
+        assert shrank, "autoscaler never drained back down when idle"
+        assert handle.remote(3).result(timeout=30) == 3
+    finally:
+        serve.delete("scaly")
