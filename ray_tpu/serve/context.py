@@ -20,14 +20,24 @@ _request_ctx: "contextvars.ContextVar[Optional[dict]]" = (
 
 def set_request_context(*, deadline_ts: Optional[float] = None,
                         request_id: str = "",
-                        start_ts: Optional[float] = None):
+                        start_ts: Optional[float] = None,
+                        queue_wait_s: float = 0.0):
     """Install the current request's context; returns a reset token.
     ``start_ts`` (epoch seconds) is when the request entered the system —
     stamped once at the outermost hop and inherited by nested handle
-    calls, so TTFT accounting includes every queue the request crossed."""
+    calls, so TTFT accounting includes every queue the request crossed.
+
+    ``queue_wait_s`` is the time the request had already spent upstream,
+    accumulated hop by hop with each host's OWN monotonic clock (the
+    router adds its local dwell before forwarding). Latency accounting
+    (TTFT) uses queue_wait_s plus the locally-stamped ``arrival_mono``
+    delta — never a cross-host epoch difference, which wall-clock skew
+    between machines would bias (or clamp to zero)."""
     return _request_ctx.set(
         {"deadline_ts": deadline_ts, "request_id": request_id,
-         "start_ts": start_ts})
+         "start_ts": start_ts,
+         "queue_wait_s": max(0.0, float(queue_wait_s or 0.0)),
+         "arrival_mono": time.monotonic()})
 
 
 def reset_request_context(token) -> None:
@@ -45,9 +55,24 @@ def get_request_deadline() -> Optional[float]:
 
 
 def get_request_start() -> Optional[float]:
-    """Epoch-seconds arrival time of the active request, or None."""
+    """Epoch-seconds arrival time of the active request, or None.
+    Informational (logs, deadline math on one host); latency deltas
+    should use :func:`elapsed_s`, which is skew-free across hosts."""
     c = _request_ctx.get()
     return c.get("start_ts") if c else None
+
+
+def elapsed_s() -> Optional[float]:
+    """Seconds the active request has spent in the system so far:
+    upstream queue wait (accumulated per-host, monotonic) plus the time
+    since it arrived on THIS host. None when no request context is
+    installed. Immune to wall-clock skew between machines — feed this
+    (not epoch deltas) into TTFT/latency instruments."""
+    c = _request_ctx.get()
+    if c is None:
+        return None
+    return (c.get("queue_wait_s", 0.0)
+            + max(0.0, time.monotonic() - c["arrival_mono"]))
 
 
 def remaining_s(default: Optional[float] = None) -> Optional[float]:

@@ -317,8 +317,16 @@ class ServeController:
                     try:
                         kill = ray_tpu.get(r.queue_len.remote(),
                                            timeout=2.0) == 0
+                    except (ray_tpu.ActorDiedError,
+                            ray_tpu.WorkerCrashedError):
+                        kill = True  # actually dead: nothing to drain
                     except Exception:
-                        kill = True  # already dead
+                        # Probe timed out / transient failure: a LIVE
+                        # replica can be briefly unresponsive (JIT
+                        # compile holding the GIL, busy engine tick).
+                        # Killing it now would cut in-flight streams —
+                        # keep draining; the grace deadline decides.
+                        kill = False
                 if kill:
                     self._kill_replica(r)
                     reaped.append(r)
@@ -384,7 +392,7 @@ class ServeController:
                                        pref.get("hot") or {})
             if idx is not None:
                 live = {r._actor_id for r in replicas}
-                for rid in list(idx._by_replica):
+                for rid in idx.replica_ids():
                     if rid not in live:
                         idx.drop_replica(rid)
             sig = {"queue_depth": queue,

@@ -28,7 +28,17 @@ Data path per request (ingress -> decode -> prefill):
    (router refresh + the same affinity hash, so a cached holder is
    preferred; re-prefill otherwise) and SKIPS the tokens already sent —
    greedy decoding replays exactly, so the client sees no duplicate and
-   no lost token.
+   no lost token. Sampled requests (temperature > 0) cannot be resumed
+   this way: each replica follows its own sampling trajectory, so a
+   mid-stream death after tokens were delivered surfaces as an error
+   instead of silently stitching two incompatible generations (a
+   sampled stream with NO tokens delivered yet still retries — a fresh
+   trajectory is a valid response).
+
+Prefix hashes are derived server-side from the tokens, always: the hash
+keys the prefix cache, so trusting a client-supplied ``prefix_hash``
+would let one request poison (or read) the cached K/V of another
+prompt. The field is stripped from incoming requests.
 
 ``RTPU_SERVE_DISAGG=0`` collapses build_disagg_llm_deployment to the
 unified single-pool continuous-batching deployment with the identical
@@ -263,7 +273,10 @@ def build_disagg_llm_deployment(cfg, params_factory, *, name: str = "llm",
                 yield {"error": f"bad request: {e}"}
                 return
             ids = ids[-max_prompt_len:]
-            h = request.get("prefix_hash") or prefix_key(ids)
+            # Always derived from the tokens, never read from the request:
+            # a forged hash would poison the cache entry for another
+            # prompt (or serve that prompt's cached K/V and logits here).
+            h = prefix_key(ids)
             timeout = serve_context.remaining_s(default=300.0)
             try:
                 k, v, length, logits = self._obtain_prefill(h, ids,
@@ -271,7 +284,7 @@ def build_disagg_llm_deployment(cfg, params_factory, *, name: str = "llm",
                 req = self._engine.attach_prefilled(
                     k, v, length, logits, max_new_tokens=n,
                     temperature=temp, eos_id=eos, timeout=timeout,
-                    arrival_ts=serve_context.get_request_start())
+                    queue_wait_s=serve_context.elapsed_s())
             except TimeoutError as e:
                 yield {"error": f"overloaded: {e}"}
                 return
@@ -354,7 +367,8 @@ def build_disagg_llm_deployment(cfg, params_factory, *, name: str = "llm",
     class DisaggIngress:
         """Routes streams to the decode pool with prefix affinity and
         replays across decode-replica death without duplicating or
-        losing tokens."""
+        losing tokens (exact replay needs greedy decoding; a sampled
+        stream that already delivered tokens fails over to an error)."""
 
         def __init__(self, decode_handle):
             self._decode = decode_handle
@@ -371,11 +385,15 @@ def build_disagg_llm_deployment(cfg, params_factory, *, name: str = "llm",
             try:
                 ids = np.asarray(request["tokens"],
                                  np.int32)[-max_prompt_len:]
-                h = request.get("prefix_hash") or prefix_key(ids)
+                # Server-derived affinity/cache key; any client-supplied
+                # prefix_hash is dropped (cache-poisoning vector).
+                h = prefix_key(ids)
+                greedy = float(request.get("temperature", 0.0) or 0.0) <= 0.0
             except Exception as e:
                 yield {"error": f"bad request: {e}"}
                 return
-            request = dict(request, prefix_hash=h)
+            request = dict(request)
+            request.pop("prefix_hash", None)
             retries = int(flags.get("RTPU_SERVE_DISAGG_RETRIES"))
             sent = 0
             attempt = 0
@@ -404,6 +422,16 @@ def build_disagg_llm_deployment(cfg, params_factory, *, name: str = "llm",
                 except (BackPressureError, DeadlineExceededError):
                     raise
                 except Exception as e:
+                    if sent and not greedy:
+                        # Sampled streams don't replay: another replica
+                        # follows a different trajectory, so skipping
+                        # `sent` tokens would stitch two incompatible
+                        # generations. Surface the failure instead.
+                        yield {"error": "decode replica died mid-stream; "
+                                        "sampled (temperature > 0) "
+                                        "streams cannot be resumed: "
+                                        f"{e}"}
+                        return
                     attempt += 1
                     if attempt > retries:
                         yield {"error": f"decode stream failed after "

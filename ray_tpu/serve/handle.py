@@ -437,7 +437,11 @@ class Router:
             deadline_ts = serve_context.get_request_deadline()
         # Arrival stamp: set once at the outermost hop, inherited by nested
         # calls — TTFT downstream measures from HERE, queue wait included.
+        # The wait itself is forwarded as a per-host monotonic DELTA
+        # (upstream accumulation + local dwell), never as an epoch
+        # difference across machines, so wall-clock skew can't bias it.
         start_ts = serve_context.get_request_start()
+        assign_mono = time.monotonic()
         if start_ts is None:
             start_ts = time.time()
         if deadline_ts is not None and time.time() > deadline_ts:
@@ -484,18 +488,25 @@ class Router:
                 continue
             remaining = (None if deadline_ts is None
                          else max(0.0, deadline_ts - time.time()))
+            # Queue wait accumulated so far, measured at dispatch time on
+            # THIS host's monotonic clock: the enclosing request's elapsed
+            # when nested, or the local assign dwell at the outermost hop.
+            queue_wait = serve_context.elapsed_s()
+            if queue_wait is None:
+                queue_wait = time.monotonic() - assign_mono
             try:
                 if stream:
                     ref_gen = replica.handle_request_streaming.options(
                         num_returns="streaming", deadline_s=remaining,
                     ).remote(method_name, args, kwargs,
-                             multiplexed_model_id, deadline_ts, start_ts)
+                             multiplexed_model_id, deadline_ts, start_ts,
+                             queue_wait)
                     return DeploymentStreamingResponse(
                         ref_gen, self, rid, deadline_ts)
                 ref = replica.handle_request.options(
                     deadline_s=remaining,
                 ).remote(method_name, args, kwargs, multiplexed_model_id,
-                         deadline_ts, start_ts)
+                         deadline_ts, start_ts, queue_wait)
                 return DeploymentResponse(ref, self, rid, deadline_ts)
             except Exception as e:  # dead replica: drop + refresh
                 last_err = e

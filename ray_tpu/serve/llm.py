@@ -245,13 +245,14 @@ def build_streaming_llm_deployment(cfg, params_factory, *, name: str = "llm-stre
                 try:
                     # The slot wait is bounded by the request's remaining
                     # deadline budget (serve context) when one is set.
-                    # TTFT measures from the router's arrival stamp so
-                    # queue wait counts (user-observed latency).
+                    # TTFT measures from system arrival (queue wait
+                    # counts): elapsed_s() is the per-host monotonic
+                    # accumulation, immune to cross-machine clock skew.
                     req = self._engine.submit(
                         ids, max_new_tokens=n, temperature=temp,
                         eos_id=eos,
                         timeout=serve_context.remaining_s(default=300.0),
-                        arrival_ts=serve_context.get_request_start())
+                        queue_wait_s=serve_context.elapsed_s())
                 except TimeoutError as e:
                     # Backpressure uses the same error-chunk contract as
                     # malformed requests — not a raw stream exception.

@@ -167,17 +167,23 @@ class ContinuousBatchingEngine:
     def submit(self, tokens, *, max_new_tokens: Optional[int] = None,
                temperature: float = 0.0, eos_id: Optional[int] = None,
                timeout: Optional[float] = None,
-               arrival_ts: Optional[float] = None) -> int:
+               arrival_ts: Optional[float] = None,
+               queue_wait_s: Optional[float] = None) -> int:
         """Attach a request to a free slot (blocking while all slots busy).
         Returns a stable REQUEST id; poll with peek(), collect with
         result() — valid even after the slot is recycled.
 
-        ``arrival_ts`` (epoch seconds) is when the request ENTERED the
-        system — the proxy/router stamp, not prefill start — so the TTFT
-        histogram includes queue wait and reflects user-observed latency
-        (the signal the serve autoscaler scales on). Defaults to now."""
+        TTFT accounting measures from request ARRIVAL (queue wait
+        included, the signal the serve autoscaler scales on), not from
+        prefill start. ``queue_wait_s`` is the time the request already
+        spent upstream, accumulated per-host with monotonic clocks
+        (serve_context.elapsed_s()); the engine adds its local
+        prefill + slot wait monotonically, so cross-machine wall-clock
+        skew never touches the histogram. ``arrival_ts`` (epoch seconds)
+        is the SAME-PROCESS alternative for embedders/tests; ignored when
+        queue_wait_s is given. With neither, arrival is now."""
         jnp = self._jnp
-        t0 = time.time() if arrival_ts is None else float(arrival_ts)
+        mono0 = time.monotonic()
         ids = np.asarray(tokens, np.int32)
         if ids.ndim != 1 or ids.size == 0:
             raise ValueError("tokens must be a non-empty 1-D integer list")
@@ -197,14 +203,16 @@ class ContinuousBatchingEngine:
         return self._attach(k1, v1, len(ids), np.asarray(logits1),
                             max_new_tokens=max_new_tokens,
                             temperature=temperature, eos_id=eos_id,
-                            timeout=timeout, arrival_ts=t0)
+                            timeout=timeout, arrival_ts=arrival_ts,
+                            queue_wait_s=queue_wait_s, mono0=mono0)
 
     def attach_prefilled(self, k, v, length: int, logits, *,
                          max_new_tokens: Optional[int] = None,
                          temperature: float = 0.0,
                          eos_id: Optional[int] = None,
                          timeout: Optional[float] = None,
-                         arrival_ts: Optional[float] = None) -> int:
+                         arrival_ts: Optional[float] = None,
+                         queue_wait_s: Optional[float] = None) -> int:
         """Attach a request whose prefill ran ELSEWHERE — a prefill-pool
         replica's handoff or a prefix-cache hit — splicing the K/V
         straight into a free slot with no prefill compute here.
@@ -214,6 +222,7 @@ class ContinuousBatchingEngine:
         row that decides the first token. Everything else matches
         submit()."""
         jnp = self._jnp
+        mono0 = time.monotonic()
         k = jnp.asarray(k, self.cfg.dtype)
         v = jnp.asarray(v, self.cfg.dtype)
         if k.ndim != 4 or v.shape != k.shape:
@@ -231,16 +240,22 @@ class ContinuousBatchingEngine:
         return self._attach(k, v, length, np.asarray(logits),
                             max_new_tokens=max_new_tokens,
                             temperature=temperature, eos_id=eos_id,
-                            timeout=timeout, arrival_ts=arrival_ts)
+                            timeout=timeout, arrival_ts=arrival_ts,
+                            queue_wait_s=queue_wait_s, mono0=mono0)
 
     def _attach(self, k1, v1, length: int, logits1: np.ndarray, *,
                 max_new_tokens: Optional[int], temperature: float,
                 eos_id: Optional[int], timeout: Optional[float],
-                arrival_ts: Optional[float]) -> int:
+                arrival_ts: Optional[float],
+                queue_wait_s: Optional[float] = None,
+                mono0: Optional[float] = None) -> int:
         """Shared slot-wait + splice tail of submit()/attach_prefilled():
-        k1/v1 are already padded to max_len, logits1 is the host [V] row."""
+        k1/v1 are already padded to max_len, logits1 is the host [V] row.
+        ``mono0`` is the caller's entry stamp so prefill time counts
+        toward TTFT; ``queue_wait_s``/``arrival_ts`` as in submit()."""
         jnp = self._jnp
-        t0 = time.time() if arrival_ts is None else float(arrival_ts)
+        if mono0 is None:
+            mono0 = time.monotonic()
         with self._free_cv:
             # One monotonic deadline for the whole wait: contended submits
             # that wake repeatedly must not restart the clock each time.
@@ -274,7 +289,18 @@ class ContinuousBatchingEngine:
             # lock with the slot's sampling config.
             first = self._pick_host(logits1, temperature)
             m = _serve_metrics()
-            m["ttft"].observe(max(0.0, time.time() - t0), tags=self._mtags)
+            # Skew-free TTFT: upstream wait is a per-host monotonic
+            # accumulation, local wait (prefill + slot) is this host's
+            # monotonic delta. The epoch arrival_ts path is same-process
+            # only, where wall-clock deltas are safe.
+            local_wait = time.monotonic() - mono0
+            if queue_wait_s is not None:
+                ttft = max(0.0, float(queue_wait_s)) + local_wait
+            elif arrival_ts is not None:
+                ttft = max(0.0, time.time() - float(arrival_ts))
+            else:
+                ttft = local_wait
+            m["ttft"].observe(ttft, tags=self._mtags)
             m["tokens"].inc(1.0, tags=self._mtags)
             n = min(max_new_tokens or self.max_new, self.max_new)
             self.active[slot] = True

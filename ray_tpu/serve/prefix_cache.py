@@ -186,9 +186,15 @@ class PrefixCache:
 
 class PrefixIndex:
     """Cluster view (lives in the ServeController): which replicas hold
-    which prefixes, and how hot each prefix is cluster-wide."""
+    which prefixes, and how hot each prefix is cluster-wide.
+
+    Thread-safe: the controller's control-loop thread mutates it
+    (update_replica/drop_replica per stats poll) while routing queries
+    (routes(), via get_routing_config) arrive on the actor's request
+    threads — every method snapshots or mutates under one lock."""
 
     def __init__(self):
+        self._lock = threading.Lock()
         self._by_replica: Dict[str, Dict[str, int]] = {}  # rid -> {h: hits}
         self._holders: Dict[str, Set[str]] = {}           # h -> {rid}
         self._promoted: Set[Tuple[str, str]] = set()      # (h, target_rid)
@@ -197,32 +203,44 @@ class PrefixIndex:
                        hot: Dict[str, int]) -> None:
         """Fold one replica's stats-poll report into the index. Reports
         are cumulative per replica; cluster hits = sum of latest reports."""
-        self._by_replica[rid] = {h: int(hot.get(h, 0)) for h in holders}
-        self._rebuild()
+        with self._lock:
+            self._by_replica[rid] = {h: int(hot.get(h, 0)) for h in holders}
+            self._rebuild_locked()
 
     def drop_replica(self, rid: str) -> None:
-        if self._by_replica.pop(rid, None) is not None:
-            self._rebuild()
+        with self._lock:
+            if self._by_replica.pop(rid, None) is not None:
+                self._rebuild_locked()
 
-    def _rebuild(self) -> None:
+    def _rebuild_locked(self) -> None:
         holders: Dict[str, Set[str]] = {}
         for rid, held in self._by_replica.items():
             for h in held:
                 holders.setdefault(h, set()).add(rid)
         self._holders = holders
 
+    def replica_ids(self) -> List[str]:
+        with self._lock:
+            return list(self._by_replica)
+
     def holders(self, h: str) -> Set[str]:
-        return set(self._holders.get(h, ()))
+        with self._lock:
+            return set(self._holders.get(h, ()))
+
+    def _cluster_hits_locked(self, h: str) -> int:
+        return sum(held.get(h, 0) for held in self._by_replica.values())
 
     def cluster_hits(self, h: str) -> int:
-        return sum(held.get(h, 0) for held in self._by_replica.values())
+        with self._lock:
+            return self._cluster_hits_locked(h)
 
     def routes(self, *, top: int = 128) -> Dict[str, List[str]]:
         """Hot-prefix routing table for get_routing_config(): hash ->
         sorted holder replica ids, hottest prefixes first."""
-        scored = sorted(self._holders,
-                        key=lambda h: -self.cluster_hits(h))[:top]
-        return {h: sorted(self._holders[h]) for h in scored}
+        with self._lock:
+            scored = sorted(self._holders,
+                            key=lambda h: -self._cluster_hits_locked(h))[:top]
+            return {h: sorted(self._holders[h]) for h in scored}
 
     def promotions(self, all_replicas: List[str],
                    *, threshold: Optional[int] = None
@@ -237,13 +255,14 @@ class PrefixIndex:
         if threshold <= 0 or not flags.get("RTPU_PREFIX_CACHE"):
             return []
         out: List[Tuple[str, str, str]] = []
-        for h, holders in self._holders.items():
-            if not holders or self.cluster_hits(h) < threshold:
-                continue
-            holder = sorted(holders)[0]
-            for t in all_replicas:
-                if t in holders or (h, t) in self._promoted:
+        with self._lock:
+            for h, holders in self._holders.items():
+                if not holders or self._cluster_hits_locked(h) < threshold:
                     continue
-                out.append((h, holder, t))
-                self._promoted.add((h, t))
+                holder = sorted(holders)[0]
+                for t in all_replicas:
+                    if t in holders or (h, t) in self._promoted:
+                        continue
+                    out.append((h, holder, t))
+                    self._promoted.add((h, t))
         return out

@@ -46,7 +46,8 @@ class ReplicaActor:
     def handle_request(self, method_name: str, args: Tuple, kwargs: Dict,
                        multiplexed_model_id: str = "",
                        deadline_ts: Optional[float] = None,
-                       start_ts: Optional[float] = None):
+                       start_ts: Optional[float] = None,
+                       queue_wait_s: float = 0.0):
         from . import context as serve_context
         from .multiplex import _set_model_id
 
@@ -56,7 +57,8 @@ class ReplicaActor:
             self._total += 1
         token = _set_model_id(multiplexed_model_id)
         ctx_token = serve_context.set_request_context(
-            deadline_ts=deadline_ts, start_ts=start_ts)
+            deadline_ts=deadline_ts, start_ts=start_ts,
+            queue_wait_s=queue_wait_s)
         try:
             if self._is_function:
                 return self._callable(*args, **kwargs)
@@ -75,7 +77,8 @@ class ReplicaActor:
                                  kwargs: Dict,
                                  multiplexed_model_id: str = "",
                                  deadline_ts: Optional[float] = None,
-                                 start_ts: Optional[float] = None):
+                                 start_ts: Optional[float] = None,
+                                 queue_wait_s: float = 0.0):
         """Generator variant: the user handler returns a generator/iterable
         whose items stream to the caller one object at a time (reference:
         serve streaming responses over streaming generator returns,
@@ -89,7 +92,8 @@ class ReplicaActor:
             self._total += 1
         _set_model_id(multiplexed_model_id)
         ctx_token = serve_context.set_request_context(
-            deadline_ts=deadline_ts, start_ts=start_ts)
+            deadline_ts=deadline_ts, start_ts=start_ts,
+            queue_wait_s=queue_wait_s)
         try:
             if self._is_function:
                 result = self._callable(*args, **kwargs)
