@@ -25,6 +25,7 @@ from .core.api import (
     available_resources,
     broadcast,
     cluster_resources,
+    error_of,
     free,
     get,
     get_actor,
@@ -67,6 +68,7 @@ __all__ = [
     "get",
     "put",
     "wait",
+    "error_of",
     "free",
     "broadcast",
     "cancel",

@@ -489,6 +489,35 @@ def wait(
     return ready, not_ready
 
 
+def error_of(ref: ObjectRef, *,
+             timeout: Optional[float] = 30.0) -> Optional[BaseException]:
+    """The exception a READY object holds, or None for a data object.
+
+    A location-metadata probe, not a fetch: callers that stream large
+    blocks by reference (the data plane's executor) use this to classify
+    a completed task/actor-call ref as success vs typed system failure
+    (ActorDiedError / WorkerCrashedError / NodePreemptedError / ...)
+    without ever pulling the payload bytes of a healthy block to this
+    process. Direct-dispatch results answer from the local location
+    cache (one dict lookup); otherwise one get_locations round trip.
+    Only error payloads — which are small — are materialized."""
+    wc = ctx.get_worker_context()
+    oid = ref.object_id
+    loc = _local_locs.get(oid)
+    if loc is None:
+        locs = wc.client.request(
+            {"kind": "get_locations", "object_ids": [oid],
+             "timeout": timeout, "node_id": wc.node_id})
+        loc = locs[oid]
+        _cache_loc(loc)
+    if not loc.is_error:
+        return None
+    val, _ = get_bytes_with_refresh(loc, oid, wc.client.request)
+    if isinstance(val, BaseException):
+        return val
+    return RuntimeError(str(val))
+
+
 def free(refs: Sequence[ObjectRef]) -> None:
     wc = ctx.get_worker_context()
     for r in refs:
@@ -2343,6 +2372,10 @@ def available_resources() -> Dict[str, float]:
     state = wc.client.request({"kind": "cluster_state"})
     out: Dict[str, float] = {}
     for n in state["nodes"]:
+        if not n.get("alive", True):
+            # A dead node's snapshot freezes at its last report; counting
+            # it advertises capacity the scheduler can no longer place on.
+            continue
         for k, v in n["available"].items():
             out[k] = out.get(k, 0.0) + v
     return out

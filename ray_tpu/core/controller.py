@@ -468,6 +468,7 @@ class Controller:
         self._agent_spawns: Dict[str, str] = {}  # outstanding agent spawn token -> node_id
         self._spawn_env_hash: Dict[str, str] = {}  # spawn token -> env hash
         self._sched_wakeup = asyncio.Event()
+        self._sched_stuck = False  # last pass left unplaceable queued work
         self._sched_task: Optional[asyncio.Task] = None
         self._health_task: Optional[asyncio.Task] = None
         self._closing = False
@@ -5082,7 +5083,20 @@ class Controller:
         cluster_task_manager.h:117, without the cross-raylet spillback — all
         state is local to the controller here)."""
         while True:
-            await self._sched_wakeup.wait()
+            if self._sched_stuck and len(self.pending_queue):
+                # Unplaceable work is queued and nothing is guaranteed to
+                # wake us: a lease_reclaim nudge that reached the holder
+                # while its routes still had pushes in flight releases
+                # nothing, and the holder only reaps idle leases on its
+                # next submit — which never comes if the driver is blocked
+                # in get() on the queued task's output. Poll so the next
+                # pass re-nudges once the holder's routes drain.
+                try:
+                    await asyncio.wait_for(self._sched_wakeup.wait(), 0.5)
+                except asyncio.TimeoutError:
+                    pass
+            else:
+                await self._sched_wakeup.wait()
             self._sched_wakeup.clear()
             try:
                 await self._schedule_once()
@@ -5122,6 +5136,7 @@ class Controller:
                 self.pending_queue._count -= 1
             if q is not None and not q:
                 self.pending_queue.groups.pop(sig, None)
+        self._sched_stuck = stuck
         if stuck:
             await self._nudge_lease_reclaim()
 

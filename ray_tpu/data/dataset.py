@@ -20,7 +20,7 @@ from . import logical as L
 from .block import Block, BlockAccessor, concat_blocks
 from .context import DataContext
 from .datasource import write_block
-from .executor import StreamingExecutor
+from .executor import StreamingExecutor, ft_get
 
 
 class Dataset:
@@ -153,12 +153,12 @@ class Dataset:
 
     def schema(self) -> Any:
         for ref in self._execute():
-            return BlockAccessor(rt.get(ref)).schema()
+            return BlockAccessor(ft_get(ref)).schema()
         return None
 
     def columns(self) -> List[str]:
         for ref in self._execute():
-            return BlockAccessor(rt.get(ref)).column_names()
+            return BlockAccessor(ft_get(ref)).column_names()
         return []
 
     def num_blocks(self) -> int:
@@ -167,7 +167,7 @@ class Dataset:
     def take(self, n: int = 20) -> List[Dict[str, Any]]:
         out: List[Dict[str, Any]] = []
         for ref in self._execute():
-            for row in BlockAccessor(rt.get(ref)).iter_rows():
+            for row in BlockAccessor(ft_get(ref)).iter_rows():
                 out.append(row)
                 if len(out) >= n:
                     return out
@@ -180,7 +180,7 @@ class Dataset:
         blocks = []
         have = 0
         for ref in self._execute():
-            b = rt.get(ref)
+            b = ft_get(ref)
             blocks.append(b)
             have += BlockAccessor(b).num_rows()
             if have >= batch_size:
@@ -194,7 +194,7 @@ class Dataset:
 
     def iter_rows(self) -> Iterator[Dict[str, Any]]:
         for ref in self._execute():
-            yield from BlockAccessor(rt.get(ref)).iter_rows()
+            yield from BlockAccessor(ft_get(ref)).iter_rows()
 
     def iter_batches(
         self,
@@ -260,7 +260,7 @@ class Dataset:
     def to_pandas(self):
         import pandas as pd
 
-        dfs = [BlockAccessor(rt.get(r)).to_pandas() for r in self._execute()]
+        dfs = [BlockAccessor(ft_get(r)).to_pandas() for r in self._execute()]
         return pd.concat(dfs, ignore_index=True) if dfs else pd.DataFrame()
 
     def to_numpy_refs(self) -> List[Any]:
@@ -284,17 +284,51 @@ class Dataset:
         return Dataset([L.InputData(refs=mine)], self._ctx)
 
     def streaming_split(self, n: int, *, equal: bool = False,
-                        locality_hints: Optional[List[str]] = None) -> List[Any]:
+                        locality_hints: Optional[List[str]] = None,
+                        resume_key: Optional[str] = None) -> List[Any]:
         """reference: dataset.py:1222 — n coordinated iterators backed by an
-        OutputSplitter actor feeding consumers on demand."""
-        from .iterator import SplitCoordinator, SplitIterator
+        OutputSplitter actor feeding consumers on demand.
 
-        name = f"rtpu_split_{uuid.uuid4().hex[:8]}"
-        coord_cls = rt.remote(SplitCoordinator)
-        coord = coord_cls.options(name=name, max_concurrency=max(4, 2 * n)).remote(
-            self._ops, self._ctx, n
-        )
-        return [SplitIterator(coord, i) for i in range(n)]
+        With `resume_key` the coordinator gets a stable name plus
+        max_restarts and a persisted handout journal: a restarted trainer
+        calling streaming_split with the same key reattaches to the live
+        coordinator (or a restarted one that replayed its journal), and
+        each split's iterator resumes from its own journaled block
+        position without re-delivering blocks.
+        """
+        from .iterator import IngestCursor, SplitCoordinator, SplitIterator
+
+        key = resume_key or uuid.uuid4().hex[:8]
+        name = f"rtpu_split_{key}"
+        coord = None
+        if resume_key is not None:
+            try:
+                coord = rt.get_actor(name)
+            except Exception:
+                coord = None
+        if coord is None:
+            coord_cls = rt.remote(SplitCoordinator)
+            opts = {"name": name, "max_concurrency": max(4, 2 * n)}
+            if resume_key is not None:
+                # Coordinator failover: the constructor replays the
+                # persisted handout journal against the re-executed
+                # (deterministic) stream, so orphaned splits re-attach.
+                opts["max_restarts"] = 3
+            coord = coord_cls.options(**opts).remote(
+                self._ops, self._ctx, n,
+                name if resume_key is not None else None,
+            )
+        cursors = [IngestCursor(f"{key}_split{i}") if resume_key else None
+                   for i in range(n)]
+        return [SplitIterator(coord, i, cursor=cursors[i]) for i in range(n)]
+
+    def iterator(self, *, resume_key: Optional[str] = None) -> Any:
+        """A DataIterator over this dataset; with `resume_key` its batch
+        iteration journals a cursor for mid-epoch resume (reference:
+        Dataset.iterator → DataIterator)."""
+        from .iterator import DataIterator
+
+        return DataIterator(self, resume_key=resume_key)
 
     # ------------------------------------------------------------------ write
 
@@ -366,6 +400,8 @@ class Dataset:
             if st["peak_store_pressure"] >= 0.005:
                 line += (f", peak store pressure "
                          f"{st['peak_store_pressure'] * 100:.1f}%")
+            if st.get("retries"):
+                line += f", {st['retries']} retries"
             lines.append(line)
         return "\n".join(lines) or "(no stages executed)"
 

@@ -11,16 +11,140 @@ and blocks stream driver-side only as refs (bytes stay in the host store).
 """
 from __future__ import annotations
 
+import collections
 import time
 from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
 
 import numpy as np
 
 import ray_tpu as rt
+from ray_tpu import flags
+from ray_tpu.core import events
+from ray_tpu.core.controller import (
+    ActorDiedError,
+    DependencyError,
+    ObjectLostError,
+    WorkerCrashedError,
+)
+from ray_tpu.util.metrics import Counter, Gauge
 
 from . import logical as L
 from .block import Block, BlockAccessor, block_from_batch, concat_blocks, rows_to_block
 from .context import DataContext
+
+
+# ------------------------------------------------- fault-tolerance plumbing
+#
+# The streaming plane predates the robustness PRs; everything below is the
+# RTPU_DATA_FT retrofit. Three pieces:
+#   * self-healing actor pools (_actor_pool_stage): typed death on the
+#     in-flight ref -> replace the actor in place, resubmit the batch;
+#   * driver-side lineage for all-to-all shards (_derivable / ft_get): the
+#     producing call is recorded per yielded shard so a shard lost to node
+#     death re-derives from surviving inputs after the controller's own
+#     _maybe_reconstruct path has had its chance;
+#   * process-local counters mirroring the Prometheus instruments, because
+#     tests and benchmarks need synchronous reads while the metrics
+#     aggregator flushes asynchronously.
+
+_retries_total = Counter(
+    "rtpu_data_retries_total",
+    description="Streaming data plane: input batches resubmitted after the "
+                "pool actor running them died, by cause (actor_died / "
+                "worker_crashed / preempted). Preempted resubmissions do "
+                "not consume the per-batch retry budget.",
+    tag_keys=("cause",))
+_rederived_total = Counter(
+    "rtpu_data_blocks_rederived_total",
+    description="Streaming data plane: all-to-all output shards (shuffle / "
+                "sort / repartition / aggregate / zip) re-derived from "
+                "their recorded producing call after the stored copy was "
+                "lost with its node.")
+_inflight_gauge = Gauge(
+    "rtpu_data_inflight_blocks",
+    description="Streaming data plane: blocks currently in flight in one "
+                "executing stage, labeled by stage.",
+    tag_keys=("stage",))
+_pressure_gauge = Gauge(
+    "rtpu_data_store_pressure",
+    description="Streaming data plane: local object-store arena fill "
+                "fraction observed while a stage runs, labeled by stage "
+                "(mirrors the per-op peak_store_pressure stat).",
+    tag_keys=("stage",))
+
+# Synchronous mirror of the instruments above, for tests and data_bench.
+_FT_COUNTERS: Dict[str, int] = {}
+
+
+def _count(key: str, delta: int = 1) -> None:
+    _FT_COUNTERS[key] = _FT_COUNTERS.get(key, 0) + delta
+
+
+def ft_counters() -> Dict[str, int]:
+    """Snapshot of this process's data-plane fault-tolerance counters:
+    ``retries`` (budget-consuming resubmits), ``preempted_retries``
+    (budget-free), ``rederived`` (all-to-all shards rebuilt), and
+    ``proactive_migrations`` (pool actors moved off draining nodes)."""
+    out = {"retries": 0, "preempted_retries": 0, "rederived": 0,
+           "proactive_migrations": 0}
+    out.update(_FT_COUNTERS)
+    return out
+
+
+def reset_ft_counters() -> None:
+    _FT_COUNTERS.clear()
+
+
+# Driver-side lineage for all-to-all shards: object_id -> (thunk that
+# resubmits the producing call, re-derivations so far). Bounded LRU — a
+# long pipeline streams far more shards than are ever simultaneously
+# recoverable, and the controller's own lineage still covers evictees.
+_REDERIVE_CAP = 4096
+_rederive: "collections.OrderedDict[str, Tuple[Callable[[], Any], int]]" = \
+    collections.OrderedDict()
+
+
+def _remember_rederive(ref: Any, make_ref: Callable[[], Any],
+                       attempts: int = 0) -> Any:
+    _rederive[ref.object_id] = (make_ref, attempts)
+    while len(_rederive) > _REDERIVE_CAP:
+        _rederive.popitem(last=False)
+    return ref
+
+
+def ft_get(refs: Any, timeout: Optional[float] = None) -> Any:
+    """`rt.get` that re-derives all-to-all shards lost to node death.
+
+    The controller's lineage path (`_maybe_reconstruct`) runs first — a
+    `get` on a lost-but-reconstructable object simply blocks while the
+    controller re-executes the producer. Only when that path gives up
+    (lineage evicted, cap hit, inputs also lost at the time) does the
+    stored `ObjectLostError` surface here; if the shard was registered by
+    an all-to-all stage we resubmit its producing call against surviving
+    inputs and retry, bounded by RTPU_MAX_RECONSTRUCTIONS per shard.
+    """
+    if isinstance(refs, list):
+        return [ft_get(r, timeout) for r in refs]
+    ref = refs
+    while True:
+        try:
+            return rt.get(ref, timeout=timeout)
+        except (ObjectLostError, WorkerCrashedError, DependencyError) as err:
+            entry = _rederive.pop(ref.object_id, None)
+            if entry is None or not flags.get("RTPU_DATA_FT"):
+                raise
+            make_ref, attempts = entry
+            if attempts >= int(flags.get("RTPU_MAX_RECONSTRUCTIONS")):
+                raise
+            events.emit(
+                "WARNING", "OBJECT_RECONSTRUCTING",
+                f"re-deriving lost data shard {ref.object_id[:8]} from its "
+                f"producing call (attempt {attempts + 1})",
+                source="driver",
+                data={"object_id": ref.object_id, "cause": type(err).__name__})
+            _rederived_total.inc(1.0)
+            _count("rederived")
+            ref = _remember_rederive(make_ref(), make_ref, attempts + 1)
 
 
 # ------------------------------------------------------------- fused map fns
@@ -195,10 +319,11 @@ class StreamingExecutor:
     _PRESSURE_TTL_S = 0.05
 
     def _record_stat(self, label: str, wall_s: float, blocks: int,
-                     peak_pressure: float = 0.0) -> None:
-        self.stats.append({"operator": label, "wall_s": wall_s,
-                           "blocks": blocks,
-                           "peak_store_pressure": peak_pressure})
+                     peak_pressure: float = 0.0, **extra: Any) -> None:
+        row = {"operator": label, "wall_s": wall_s, "blocks": blocks,
+               "peak_store_pressure": peak_pressure}
+        row.update(extra)
+        self.stats.append(row)
 
     def _store_pressure(self) -> float:
         """Local object-store arena fill fraction (0.0 when no native arena
@@ -223,6 +348,35 @@ class StreamingExecutor:
         self._pressure_cache = (now, p)
         return p
 
+    def _note_pressure(self, label: str, pressure: float) -> None:
+        """TTL-throttled export of the sampled pressure as a per-stage
+        gauge; stays off the per-submission hot path."""
+        now = time.perf_counter()
+        if now - getattr(self, "_pressure_gauge_ts", 0.0) >= 0.25:
+            self._pressure_gauge_ts = now
+            _pressure_gauge.set(pressure, tags={"stage": label})
+
+    def _derivable(self, make_ref: Callable[[], Any]) -> Any:
+        """Submit an all-to-all producing call and record its recipe so
+        ft_get can re-derive the shard from surviving inputs if the stored
+        copy is later lost with its node (tries the controller's
+        _maybe_reconstruct lineage path first — see ft_get)."""
+        ref = make_ref()
+        if flags.get("RTPU_DATA_FT"):
+            _remember_rederive(ref, make_ref)
+        return ref
+
+    def _register(self, ref: Any, make_ref: Callable[[], Any]) -> Any:
+        """Like _derivable, but for stages whose cheap initial submission
+        reuses intermediate shard refs while the recovery thunk recomputes
+        from the stage's ORIGINAL inputs (two-round exchanges: the
+        intermediates may be lost with the same node as the output, so a
+        thunk depending on them would just trade ObjectLostError for
+        DependencyError)."""
+        if flags.get("RTPU_DATA_FT"):
+            _remember_rederive(ref, make_ref)
+        return ref
+
     def _bounded_submit(self, submissions: Iterator[Any], label: str,
                         total: Optional[int]) -> Iterator[Any]:
         """Cap in-flight tasks; yield refs in submission (FIFO) order when
@@ -243,6 +397,8 @@ class StreamingExecutor:
                 cap = base_cap
                 pressure = self._store_pressure() if high_water else 0.0
                 peak_pressure = max(peak_pressure, pressure)
+                if high_water:
+                    self._note_pressure(label, pressure)
                 if high_water and pressure >= high_water:
                     cap = min(base_cap, max(1, self.ctx.memory_pressure_cap))
                 while len(pending) >= cap:
@@ -278,7 +434,21 @@ class StreamingExecutor:
     def _actor_pool_stage(self, inputs: Iterator[Any], op: L.MapBatches) -> Iterator[Any]:
         """Fixed/bounded actor pool (reference: ActorPoolMapOperator + _ActorPool
         autoscaling :375; TPU-aware: num_tpus reserves chips per actor so the
-        pool lands one actor per TPU host — the ViT batch-inference shape)."""
+        pool lands one actor per TPU host — the ViT batch-inference shape).
+
+        Self-healing under RTPU_DATA_FT: a typed system death
+        (ActorDiedError / NodePreemptedError / WorkerCrashedError) on the
+        in-flight ref replaces the dead actor in place and resubmits the
+        affected input batch, bounded per batch by RTPU_DATA_FT_RETRIES.
+        Preempted deaths (drain / spot reclamation) resubmit without
+        consuming the budget — the PR 4 drain semantics applied to data.
+        A TTL-gated poll of cluster state proactively migrates pool actors
+        off draining nodes before the drain deadline SIGKILLs them
+        mid-batch (placement of the replacement already avoids draining
+        and suspect nodes: the scheduler excludes them). User exceptions
+        are untouched — the errored ref is yielded downstream exactly as
+        the fail-fast plane yields it.
+        """
         conc = op.concurrency or 1
         if isinstance(conc, (tuple, list)):
             min_actors, max_actors = conc
@@ -290,39 +460,200 @@ class StreamingExecutor:
         if op.num_tpus:
             actor_opts["num_tpus"] = op.num_tpus
         pool_cls = rt.remote(_PoolWorker)
-        actors = [
-            pool_cls.options(**actor_opts).remote(op.fn, op.fn_constructor_args,
-                                                  op.fn_constructor_kwargs)
-            for _ in range(min_actors)
-        ]
+        # Flags are read once per stage: the per-block hot path below pays
+        # one bool test, never a registry lookup.
+        ft = bool(flags.get("RTPU_DATA_FT"))
+        retry_budget = int(flags.get("RTPU_DATA_FT_RETRIES")) if ft else 0
+        drain_poll_s = float(flags.get("RTPU_DATA_DRAIN_POLL_S")) if ft else 0.0
+        label = f"ActorPool[{getattr(op.fn, '__name__', type(op.fn).__name__)}]"
         fmt = op.batch_format or self.ctx.default_batch_format
+        preserve = self.ctx.preserve_order
         t0 = time.perf_counter()
         n = 0
+        retries = 0
         per_actor_cap = 2
-        inflight: List[Tuple[Any, int]] = []  # (ref, actor_idx)
-        load = [0] * len(actors)
 
-        def submit(ref: Any) -> None:
+        def spawn() -> Any:
+            return pool_cls.options(**actor_opts).remote(
+                op.fn, op.fn_constructor_args, op.fn_constructor_kwargs)
+
+        actors = [spawn() for _ in range(min_actors)]
+        load = [0] * len(actors)
+        incarnation = [0] * len(actors)
+        # (slot, incarnation) -> [old handle, in-flight count]: a replaced
+        # actor stays alive until its in-flight batches drain (proactive
+        # migration must not kill work mid-batch), then is killed.
+        retired: Dict[Tuple[int, int], List[Any]] = {}
+        # Entries: {"ref", "slot", "inc", "actor", "input", "attempts"}.
+        inflight: List[Dict[str, Any]] = []
+        last_poll = [0.0]
+        last_gauge = [0.0]
+
+        def note_inflight() -> None:
+            # TTL-throttled: the gauge is observability, not bookkeeping,
+            # and must not put a lock acquisition on every block.
+            now = time.perf_counter()
+            if now - last_gauge[0] >= 0.25:
+                last_gauge[0] = now
+                _inflight_gauge.set(float(len(inflight)),
+                                    tags={"stage": label})
+
+        def _client():
+            from ray_tpu.core import context as cctx
+            return cctx.get_worker_context().client
+
+        def _draining_nodes() -> set:
+            rows = _client().request({"kind": "cluster_state"})["nodes"]
+            return {r["node_id"] for r in rows
+                    if r.get("state") in ("draining", "drained", "suspect")}
+
+        def _actor_nodes() -> Dict[str, str]:
+            rows = _client().request({"kind": "list_state", "what": "actors"})
+            return {r["actor_id"]: r.get("node_id") for r in rows
+                    if r.get("state") == "ALIVE"}
+
+        def replace(i: int, proactive: bool) -> None:
+            old, old_inc = actors[i], incarnation[i]
+            pending = load[i]
+            if pending > 0:
+                # In-flight batches still reference the old handle; kill it
+                # only once they drain (or fail, for a reactive replace).
+                retired[(i, old_inc)] = [old, pending]
+            else:
+                try:
+                    rt.kill(old)
+                except Exception:
+                    pass
+            actors[i] = spawn()
+            incarnation[i] += 1
+            load[i] = 0
+            if proactive:
+                _count("proactive_migrations")
+
+        def poll_drain() -> None:
+            now = time.perf_counter()
+            if now - last_poll[0] < drain_poll_s:
+                return
+            last_poll[0] = now
+            try:
+                dr = _draining_nodes()
+                if not dr:
+                    return
+                nodes = _actor_nodes()
+                for i in range(len(actors)):
+                    nid = nodes.get(actors[i]._actor_id)
+                    if nid is not None and nid in dr:
+                        replace(i, proactive=True)
+            except Exception:
+                pass  # a failed poll never fails the stage
+
+        def _died_preempted(entry: Dict[str, Any],
+                            err: BaseException) -> bool:
+            if getattr(err, "preempted", False):
+                return True
+            # Direct dispatch can fabricate a plain ActorDiedError on the
+            # driver before the controller classifies the death; ask the
+            # cluster whether the actor's node is in fact draining.
+            try:
+                rows = _client().request(
+                    {"kind": "list_state", "what": "actors"})
+                row = next((r for r in rows
+                            if r["actor_id"] == entry["actor"]._actor_id),
+                           None)
+                if row is None or row.get("node_id") is None:
+                    return False
+                return row["node_id"] in _draining_nodes()
+            except Exception:
+                return False
+
+        def submit(input_ref: Any, attempts: int = 0,
+                   at_front: bool = False) -> None:
+            if drain_poll_s > 0:
+                poll_drain()
             # least-loaded dispatch; grow pool if saturated and below max
             i = min(range(len(actors)), key=lambda j: load[j])
             if load[i] >= per_actor_cap and len(actors) < max_actors:
-                actors.append(pool_cls.options(**actor_opts).remote(
-                    op.fn, op.fn_constructor_args, op.fn_constructor_kwargs))
+                actors.append(spawn())
                 load.append(0)
+                incarnation.append(0)
                 i = len(actors) - 1
             load[i] += 1
-            inflight.append((
-                actors[i].apply.remote(ref, fmt, op.batch_size, op.fn_args, op.fn_kwargs),
-                i,
-            ))
+            entry = {
+                "ref": actors[i].apply.remote(input_ref, fmt, op.batch_size,
+                                              op.fn_args, op.fn_kwargs),
+                "slot": i, "inc": incarnation[i], "actor": actors[i],
+                "input": input_ref, "attempts": attempts,
+            }
+            # A resubmitted batch re-enters at the front in ordered mode so
+            # the output stream stays byte-identical to an uninjected run.
+            inflight.insert(0, entry) if at_front else inflight.append(entry)
+            note_inflight()
+
+        def settle(entry: Dict[str, Any]) -> None:
+            i, e_inc = entry["slot"], entry["inc"]
+            if e_inc == incarnation[i]:
+                load[i] -= 1
+            else:
+                r = retired.get((i, e_inc))
+                if r is not None:
+                    r[1] -= 1
+                    if r[1] <= 0:
+                        del retired[(i, e_inc)]
+                        try:
+                            rt.kill(r[0])
+                        except Exception:
+                            pass
 
         def drain_one() -> Any:
-            nonlocal n
-            ref, i = inflight.pop(0)
-            rt.wait([ref], num_returns=1)
-            load[i] -= 1
-            n += 1
-            return ref
+            nonlocal n, retries
+            while True:
+                if preserve:
+                    entry = inflight.pop(0)
+                    rt.wait([entry["ref"]], num_returns=1)
+                else:
+                    # Completion order: wait across the whole in-flight set
+                    # (head-of-line FIFO here wedged the stage on one slow
+                    # batch even with preserve_order off).
+                    ready, _ = rt.wait([e["ref"] for e in inflight],
+                                       num_returns=1)
+                    rid = ready[0].object_id
+                    idx = next(j for j, e in enumerate(inflight)
+                               if e["ref"].object_id == rid)
+                    entry = inflight.pop(idx)
+                err = rt.error_of(entry["ref"]) if ft else None
+                if err is None or not isinstance(
+                        err, (ActorDiedError, WorkerCrashedError,
+                              ObjectLostError)):
+                    # Healthy block, or a user exception: both flow
+                    # downstream unchanged (fail-fast parity for app errors).
+                    settle(entry)
+                    n += 1
+                    note_inflight()
+                    return entry["ref"]
+                # Typed system death on the in-flight ref.
+                preempted = _died_preempted(entry, err)
+                if not preempted and entry["attempts"] >= retry_budget:
+                    settle(entry)  # budget exhausted: surface the error
+                    n += 1
+                    return entry["ref"]
+                if entry["inc"] == incarnation[entry["slot"]] and \
+                        entry["actor"] is actors[entry["slot"]]:
+                    replace(entry["slot"], proactive=False)
+                settle(entry)
+                if preempted:
+                    cause = "preempted"
+                    _count("preempted_retries")
+                elif isinstance(err, ActorDiedError):
+                    cause = "actor_died"
+                    _count("retries")
+                else:
+                    cause = "worker_crashed"
+                    _count("retries")
+                _retries_total.inc(1.0, tags={"cause": cause})
+                retries += 1
+                submit(entry["input"],
+                       attempts=entry["attempts"] + (0 if preempted else 1),
+                       at_front=preserve)
 
         try:
             for ref in inputs:
@@ -337,8 +668,13 @@ class StreamingExecutor:
                     rt.kill(a)
                 except Exception:
                     pass
-            self._record_stat(f"ActorPool[{type(op.fn).__name__}]",
-                              time.perf_counter() - t0, n)
+            for old, _pending in retired.values():
+                try:
+                    rt.kill(old)
+                except Exception:
+                    pass
+            self._record_stat(label, time.perf_counter() - t0, n,
+                              retries=retries)
 
     # -- all-to-all -----------------------------------------------------------
 
@@ -367,7 +703,8 @@ class StreamingExecutor:
             return concat_blocks(parts) if parts else rows_to_block([])
 
         for i in range(num_blocks):
-            yield build.remote(bounds[i], bounds[i + 1], *refs)
+            yield self._derivable(
+                lambda i=i: build.remote(bounds[i], bounds[i + 1], *refs))
 
     def _random_shuffle(self, inputs: Iterator[Any], seed: Optional[int]) -> Iterator[Any]:
         """Two-round push shuffle (reference: planner/exchange push-based
@@ -375,6 +712,12 @@ class StreamingExecutor:
         concat + local permute."""
         refs = list(inputs)
         P = self.ctx.shuffle_partitions or max(1, len(refs))
+        ft = bool(flags.get("RTPU_DATA_FT"))
+        if seed is None and ft:
+            # Pin an entropy-sourced seed so a shard lost to node death can
+            # be re-derived bit-identically; the permutation is still
+            # random across runs.
+            seed = int(np.random.SeedSequence().entropy % (2 ** 31))
 
         def split(block, i):
             rng = np.random.default_rng(None if seed is None else seed + i)
@@ -397,8 +740,26 @@ class StreamingExecutor:
             return acc.take_rows(rng.permutation(acc.num_rows()))
 
         reduce_remote = rt.remote(reduce)
+
+        def split_one(block, i, j):
+            out = split(block, i)
+            return out[j] if P > 1 else out
+
+        split_one_remote = rt.remote(split_one)
+
         for j in range(P):
-            yield reduce_remote.remote(j, *[parts[i][j] for i in range(len(refs))])
+            def rederive(j=j):
+                # Recovery path: recompute only shard j of every input
+                # (deterministic: the seed is pinned above), never touching
+                # the round-1 part refs that may have died with the node.
+                return reduce_remote.remote(j, *[
+                    split_one_remote.remote(refs[i], i, j)
+                    for i in range(len(refs))])
+
+            yield self._register(
+                reduce_remote.remote(
+                    j, *[parts[i][j] for i in range(len(refs))]),
+                rederive)
 
     def _sort(self, inputs: Iterator[Any], key: str, descending: bool) -> Iterator[Any]:
         """Sample-based range partition sort (reference: exchange/sort)."""
@@ -443,7 +804,24 @@ class StreamingExecutor:
             return acc.take_rows(order)
 
         merge_remote = rt.remote(merge)
-        outs = [merge_remote.remote(*[parts[i][j] for i in range(len(refs))])
+
+        def part_one(b, j):
+            out = partition(b)
+            return out[j] if P > 1 else out
+
+        part_one_remote = rt.remote(part_one)
+
+        def make_merge(j):
+            def rederive():
+                return merge_remote.remote(*[
+                    part_one_remote.remote(refs[i], j)
+                    for i in range(len(refs))])
+            return rederive
+
+        outs = [self._register(
+                    merge_remote.remote(
+                        *[parts[i][j] for i in range(len(refs))]),
+                    make_merge(j))
                 for j in range(P)]
         yield from (outs[::-1] if descending else outs)
 
@@ -502,7 +880,9 @@ class StreamingExecutor:
 
         off = 0
         for lb, c in zip(left, lcounts):
-            yield zip_slice.remote(off, off + c, lb, *right)
+            yield self._derivable(
+                lambda off=off, c=c, lb=lb: zip_slice.remote(
+                    off, off + c, lb, *right))
             off += c
 
     def _aggregate(self, inputs: Iterator[Any], op: L.Aggregate) -> Iterator[Any]:
@@ -527,7 +907,7 @@ class StreamingExecutor:
                         row[out_name] = getattr(df[col], kind)()
                 return rows_to_block([row])
 
-            yield global_agg.remote(*refs)
+            yield self._derivable(lambda: global_agg.remote(*refs))
             return
 
         def part_fn(b):
@@ -564,5 +944,20 @@ class StreamingExecutor:
             return {c: out[c].to_numpy() for c in out.columns}
 
         agg_remote = rt.remote(agg_fn)
+
+        def part_one(b, j):
+            out = part_fn(b)
+            return out[j] if P > 1 else out
+
+        part_one_remote = rt.remote(part_one)
+
         for j in range(P):
-            yield agg_remote.remote(*[parts[i][j] for i in range(len(refs))])
+            def rederive(j=j):
+                return agg_remote.remote(*[
+                    part_one_remote.remote(refs[i], j)
+                    for i in range(len(refs))])
+
+            yield self._register(
+                agg_remote.remote(
+                    *[parts[i][j] for i in range(len(refs))]),
+                rederive)

@@ -6,28 +6,104 @@ Dataset.streaming_split (_internal/execution/operators/output_splitter.py).
 TPU-first: `device_batch_stream` overlaps `jax.device_put` H2D with consumer
 compute via a small prefetch queue — the torch `prefetch_batches`/pin-memory
 analog for XLA.
+
+Resumable ingest (RTPU_DATA_FT): `IngestCursor` journals (epoch,
+block-offset) through the durable-checkpoint store, `DataIterator` and
+`streaming_split(resume_key=...)` ride it so a restarted trainer resumes
+mid-epoch without re-reading or double-delivering blocks, and
+`SplitCoordinator` journals its handout log so a restarted coordinator
+replays assignments instead of orphaning splits.
 """
 from __future__ import annotations
 
 import queue
 import threading
+import time
 from typing import Any, Dict, Iterator, List, Optional
 
+import cloudpickle
 import numpy as np
 
 import ray_tpu as rt
 
 from .block import BlockAccessor, concat_blocks
+from .executor import ft_get
+
+
+class IngestCursor:
+    """Journaled ingest position: (epoch, block_offset, carry_rows).
+
+    Rides the PR 8 durable-checkpoint file store (host-local, atomic
+    rename, survives process SIGKILL): each `advance` writes one small
+    record under id ``data_cursor_<key>`` and prunes older ones. Meaning
+    of a state: blocks ``[0, block_offset)`` of epoch ``epoch`` were
+    fully pulled AND delivered as batches, except the last ``carry_rows``
+    rows of block ``block_offset - 1``, which had not yet left the
+    batcher when the journal was cut. The journal advances as each batch
+    is consumed (a pull of batch k+1 proves batch k was delivered), so
+    resume re-fetches only one block's tail and batch boundaries — and
+    therefore the delivered sample stream — are identical to an
+    uninterrupted run.
+    """
+
+    def __init__(self, key: str):
+        from ray_tpu.core import checkpoint as ckpt
+
+        self._ckpt = ckpt
+        self._id = f"data_cursor_{key}"
+        self._seq = 0
+        self.state: Dict[str, int] = {"epoch": 0, "block_offset": 0,
+                                      "carry_rows": 0}
+        latest = ckpt.newest_local(self._id)
+        if latest is not None:
+            self._seq, blob = latest
+            try:
+                self.state.update(cloudpickle.loads(blob))
+            except Exception:
+                pass  # unreadable journal == fresh start, never a crash
+
+    def advance(self, epoch: int, block_offset: int,
+                carry_rows: int = 0) -> None:
+        self.state = {"epoch": epoch, "block_offset": block_offset,
+                      "carry_rows": carry_rows}
+        self._seq += 1
+        self._ckpt.write_local(self._id, self._seq,
+                               cloudpickle.dumps(self.state))
+
+    def clear(self) -> None:
+        self.state = {"epoch": self.state["epoch"] + 1, "block_offset": 0,
+                      "carry_rows": 0}
+        self._seq += 1
+        self._ckpt.write_local(self._id, self._seq,
+                               cloudpickle.dumps(self.state))
 
 
 def batch_stream(refs: Iterator[Any], batch_size: Optional[int], batch_format: str,
                  drop_last: bool, shuffle_buffer: Optional[int],
-                 shuffle_seed: Optional[int]) -> Iterator[Any]:
-    """Re-chunk a stream of block refs into fixed-size batches."""
+                 shuffle_seed: Optional[int],
+                 cursor: Optional[IngestCursor] = None) -> Iterator[Any]:
+    """Re-chunk a stream of block refs into fixed-size batches.
+
+    With a `cursor`, journal progress at block-pull boundaries and resume
+    from the journaled (block_offset, carry_rows) — skipped blocks are
+    never fetched (only block_offset-1's tail is re-pulled to rebuild the
+    carry). Incompatible with a local shuffle buffer: the buffer makes
+    delivery order depend on how much was buffered at the crash, which
+    cannot be replayed exactly.
+    """
+    if cursor is not None and shuffle_buffer:
+        raise ValueError(
+            "resumable ingest (cursor) cannot be combined with a local "
+            "shuffle buffer: buffered rows make exactly-once block "
+            "delivery unreplayable; shuffle upstream (random_shuffle / "
+            "randomize_block_order) instead")
     rng = np.random.default_rng(shuffle_seed)
     carry = None  # leftover block
     buffer: List[Dict[str, np.ndarray]] = []
     buffered_rows = 0
+    skip = cursor.state["block_offset"] if cursor is not None else 0
+    resume_carry_rows = cursor.state["carry_rows"] if cursor is not None else 0
+    epoch = cursor.state["epoch"] if cursor is not None else 0
 
     def emit(block) -> Iterator[Any]:
         nonlocal carry
@@ -43,8 +119,51 @@ def batch_stream(refs: Iterator[Any], batch_size: Optional[int], batch_format: s
             start += batch_size
         carry = acc.slice(start, n) if start < n else None
 
+    def emit_journaled(block, idx) -> Iterator[Any]:
+        # Cursor-aware variant of emit: journal each batch as it is handed
+        # out, BEFORE the yield — a batch the consumer received is never
+        # re-delivered after a restart. (The converse corner — a crash
+        # between the journal write and the consumer taking the batch —
+        # skips that one batch; trainers that need it exactly pair the
+        # cursor's state_dict with their own checkpoint.) The carry is
+        # always shorter than one batch, so every batch boundary maps to
+        # a unique (block, undelivered-tail) pair and resume realigns
+        # exactly.
+        nonlocal carry
+        carry_len = BlockAccessor(carry).num_rows() if carry is not None \
+            else 0
+        if carry is not None:
+            block = concat_blocks([carry, block])
+            carry = None
+        acc = BlockAccessor(block)
+        n = acc.num_rows()
+        fresh = n - carry_len  # rows that belong to block `idx` itself
+        if batch_size is None:
+            cursor.advance(epoch, idx + 1, 0)
+            if n:
+                yield acc.to_batch(batch_format)
+            return
+        start = 0
+        while n - start >= batch_size:
+            nxt = start + batch_size
+            cursor.advance(epoch, idx + 1, fresh - max(0, nxt - carry_len))
+            yield BlockAccessor(acc.slice(start, nxt)).to_batch(batch_format)
+            start = nxt
+        carry = acc.slice(start, n) if start < n else None
+
+    idx = -1
     for ref in refs:
-        block = rt.get(ref)
+        idx += 1
+        if idx < skip:
+            if idx == skip - 1 and resume_carry_rows:
+                # The one re-fetch on resume: the tail of the last
+                # journaled block re-seeds the carry so batch boundaries
+                # line up with the uninterrupted run.
+                acc = BlockAccessor(ft_get(ref))
+                n = acc.num_rows()
+                carry = acc.slice(n - resume_carry_rows, n)
+            continue
+        block = ft_get(ref)
         if shuffle_buffer:
             acc = BlockAccessor(block)
             buffer.append(acc.to_numpy())
@@ -56,6 +175,9 @@ def batch_stream(refs: Iterator[Any], batch_size: Optional[int], batch_format: s
                 block = merged.take_rows(perm)
             else:
                 continue
+        if cursor is not None:
+            yield from emit_journaled(block, idx)
+            continue
         if carry is not None:
             block = concat_blocks([carry, block])
             carry = None
@@ -71,6 +193,8 @@ def batch_stream(refs: Iterator[Any], batch_size: Optional[int], batch_format: s
         acc = BlockAccessor(carry)
         if acc.num_rows():
             yield acc.to_batch(batch_format)
+    if cursor is not None:
+        cursor.clear()  # epoch complete: roll to (epoch + 1, 0)
 
 
 def device_batch_stream(batches: Iterator[Dict[str, np.ndarray]], sharding,
@@ -130,9 +254,18 @@ def device_batch_stream(batches: Iterator[Dict[str, np.ndarray]], sharding,
 class SplitCoordinator:
     """Actor feeding n consumers from one executed stream on demand
     (reference: OutputSplitter behind streaming_split, output_splitter.py;
-    `equal=False` semantics — first-come first-served block handout)."""
+    `equal=False` semantics — first-come first-served block handout).
 
-    def __init__(self, ops, ctx, n: int):
+    Failover (RTPU_DATA_FT): with a `name`, every epoch-0 handout appends
+    to an assignment journal persisted through the durable-checkpoint
+    store. A restarted coordinator (max_restarts re-runs the constructor)
+    re-executes the deterministic stream and replays the journal, so
+    every split's already-assigned blocks are re-derivable and a consumer
+    asking for position `pos` gets the same block it would have gotten —
+    orphaned splits are re-assigned instead of lost.
+    """
+
+    def __init__(self, ops, ctx, n: int, name: Optional[str] = None):
         from .executor import StreamingExecutor
 
         self._stream = StreamingExecutor(ctx).execute(ops)
@@ -141,47 +274,149 @@ class SplitCoordinator:
         self._epoch_refs: List[Any] = []  # replayable for repeated epochs
         self._consumed_all = False
         self._positions: Dict[Any, int] = {}
+        # Epoch-0 handout log: per-split refs in handout order, plus the
+        # stream-order assignment journal that reconstructs it.
+        self._handout: List[List[Any]] = [[] for _ in range(n)]
+        self._assignments: List[int] = []
+        self._journal_id = f"data_split_{name}" if name else None
+        self._journal_seq = 0
+        if self._journal_id is not None:
+            self._replay_journal()
 
-    def next_block(self, split_idx: int, epoch: int) -> Optional[Any]:
+    def _replay_journal(self) -> None:
+        from ray_tpu.core import checkpoint as ckpt
+
+        latest = ckpt.newest_local(self._journal_id)
+        if latest is None:
+            return
+        self._journal_seq, blob = latest
+        try:
+            assignments = cloudpickle.loads(blob)
+        except Exception:
+            return
+        # Re-derive each previously handed-out block by pulling the
+        # re-executed stream in the same order (preserve_order pipelines
+        # are deterministic, so position k is the same block as before
+        # the crash).
+        for split_idx in assignments:
+            try:
+                ref = next(self._stream)
+            except StopIteration:
+                self._consumed_all = True
+                break
+            self._epoch_refs.append(ref)
+            self._handout[split_idx].append(ref)
+            self._assignments.append(split_idx)
+
+    def _journal(self) -> None:
+        if self._journal_id is None:
+            return
+        from ray_tpu.core import checkpoint as ckpt
+
+        self._journal_seq += 1
+        try:
+            ckpt.write_local(self._journal_id, self._journal_seq,
+                             cloudpickle.dumps(self._assignments))
+        except Exception:
+            pass  # journal loss degrades failover, never the stream
+
+    def next_block(self, split_idx: int, epoch: int,
+                   pos: Optional[int] = None) -> Optional[Any]:
         with self._lock:
             if epoch == 0:
+                if pos is not None and pos < len(self._handout[split_idx]):
+                    # Re-delivery: a restarted consumer (or one talking to
+                    # a restarted coordinator) resumes at its journaled
+                    # position and receives the identical assignment.
+                    return self._handout[split_idx][pos]
                 # First epoch: dynamic first-come-first-served handout straight
                 # off the streaming executor (load-balances uneven consumers).
                 if self._consumed_all:
                     return None
                 try:
                     ref = next(self._stream)
-                    self._epoch_refs.append(ref)
-                    return ref
                 except StopIteration:
                     self._consumed_all = True
                     return None
+                self._epoch_refs.append(ref)
+                self._handout[split_idx].append(ref)
+                self._assignments.append(split_idx)
+                self._journal()
+                return ref
             # Later epochs replay the materialized refs round-robin.
             refs = [r for i, r in enumerate(self._epoch_refs)
                     if i % self.n == split_idx]
-            key = (split_idx, epoch)
-            pos = self._positions.get(key, 0)
+            if pos is None:
+                key = (split_idx, epoch)
+                pos = self._positions.get(key, 0)
+                self._positions[key] = pos + 1
             if pos >= len(refs):
                 return None
-            self._positions[key] = pos + 1
             return refs[pos]
 
 
 class SplitIterator:
-    """Per-consumer handle to a SplitCoordinator."""
+    """Per-consumer handle to a SplitCoordinator.
 
-    def __init__(self, coordinator, split_idx: int):
+    With a `cursor`, the iterator journals (epoch, block position) after
+    each block is consumed and resumes from the journal after a restart;
+    paired with the coordinator's handout log this gives exactly-once
+    block delivery per split across both consumer and coordinator
+    failures (block granularity: batch boundaries realign at the resumed
+    block edge).
+    """
+
+    def __init__(self, coordinator, split_idx: int,
+                 cursor: Optional[IngestCursor] = None):
         self._coord = coordinator
         self._idx = split_idx
-        self._epoch = 0
+        self._cursor = cursor
+        self._epoch = cursor.state["epoch"] if cursor is not None else 0
+        self._pos = cursor.state["block_offset"] if cursor is not None else 0
+
+    def state_dict(self) -> Dict[str, int]:
+        return {"epoch": self._epoch, "block_offset": self._pos}
+
+    def load_state_dict(self, state: Dict[str, int]) -> None:
+        self._epoch = int(state["epoch"])
+        self._pos = int(state["block_offset"])
+
+    def _next_block(self) -> Any:
+        """One coordinator round-trip, retried across coordinator restarts.
+
+        A call in flight when the coordinator dies surfaces ActorDiedError
+        even though max_restarts brings the actor back; with RTPU_DATA_FT
+        the journal-replaying restart returns the identical assignment for
+        (epoch, pos), so retrying is exact — not at-least-once.
+        """
+        from ray_tpu import flags
+
+        attempts = 0
+        while True:
+            try:
+                return rt.get(self._coord.next_block.remote(
+                    self._idx, self._epoch, self._pos))
+            except (rt.ActorDiedError, rt.WorkerCrashedError):
+                if not flags.get("RTPU_DATA_FT") or attempts >= 20:
+                    raise
+                attempts += 1
+                time.sleep(0.25)
 
     def _ref_stream(self) -> Iterator[Any]:
         while True:
-            ref = rt.get(self._coord.next_block.remote(self._idx, self._epoch))
+            ref = self._next_block()
             if ref is None:
                 self._epoch += 1
+                self._pos = 0
+                if self._cursor is not None:
+                    self._cursor.advance(self._epoch, 0)
                 return
             yield ref
+            self._pos += 1
+            if self._cursor is not None:
+                # Past the yield: the consumer asked for the next block,
+                # so this one was delivered — journal the new position.
+                self._cursor.advance(self._epoch, self._pos)
 
     def iter_batches(self, *, batch_size: Optional[int] = 256,
                      batch_format: str = "numpy", drop_last: bool = False,
@@ -192,7 +427,45 @@ class SplitIterator:
 
     def iter_rows(self) -> Iterator[Dict[str, Any]]:
         for ref in self._ref_stream():
-            yield from BlockAccessor(rt.get(ref)).iter_rows()
+            yield from BlockAccessor(ft_get(ref)).iter_rows()
+
+    def iter_device_batches(self, *, batch_size: int = 256, sharding=None,
+                            prefetch: int = 2) -> Iterator[Any]:
+        return device_batch_stream(
+            self.iter_batches(batch_size=batch_size, batch_format="numpy"),
+            sharding, prefetch,
+        )
+
+
+class DataIterator:
+    """Resumable iteration handle over a Dataset (reference: DataIterator,
+    data/iterator.py). With a `resume_key`, batch iteration journals an
+    (epoch, block-offset, carry-rows) cursor through the durable
+    checkpoint store: a restarted trainer constructing the iterator with
+    the same key resumes mid-epoch — already-delivered blocks are skipped
+    without being fetched, and the one partially-batched block tail is
+    re-pulled so batch boundaries match an uninterrupted run exactly."""
+
+    def __init__(self, dataset, resume_key: Optional[str] = None):
+        self._ds = dataset
+        self._cursor = IngestCursor(resume_key) if resume_key else None
+
+    @property
+    def cursor(self) -> Optional[IngestCursor]:
+        return self._cursor
+
+    def state_dict(self) -> Dict[str, int]:
+        return dict(self._cursor.state) if self._cursor is not None else {}
+
+    def iter_batches(self, *, batch_size: Optional[int] = 256,
+                     batch_format: str = "numpy", drop_last: bool = False,
+                     local_shuffle_buffer_size: Optional[int] = None,
+                     local_shuffle_seed: Optional[int] = None) -> Iterator[Any]:
+        return batch_stream(
+            self._ds._execute(), batch_size, batch_format, drop_last,
+            local_shuffle_buffer_size, local_shuffle_seed,
+            cursor=self._cursor,
+        )
 
     def iter_device_batches(self, *, batch_size: int = 256, sharding=None,
                             prefetch: int = 2) -> Iterator[Any]:

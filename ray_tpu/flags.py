@@ -317,6 +317,32 @@ _define("RTPU_DAG_RECOVERY_TIMEOUT_S", float, 60.0,
         "back alive (restart scheduling + checkpoint restore) before "
         "giving up and tearing down with DAGTeardownError.")
 
+# -- streaming data plane fault tolerance ------------------------------------
+_define("RTPU_DATA_FT", bool, True,
+        "Fault-tolerant streaming data plane: actor-pool stages detect "
+        "dead/preempted pool actors on the in-flight ref, replace the "
+        "actor in place and resubmit the affected batch (bounded by "
+        "RTPU_DATA_FT_RETRIES; preempted deaths never burn the budget), "
+        "pools proactively migrate off draining nodes, and all-to-all "
+        "shards lost to node death re-derive from their recorded "
+        "producing call (riding the controller's lineage path first). "
+        "0 reproduces the legacy fail-fast plane byte-for-byte; every "
+        "stage then pays one flag check at stage start.")
+_define("RTPU_DATA_FT_RETRIES", int, 3,
+        "Per-batch retry budget of a self-healing actor-pool stage: how "
+        "many times one input block may be resubmitted after its pool "
+        "actor CRASHED before the stage surfaces the error. Preempted "
+        "deaths (drain/spot reclamation) re-submit without consuming "
+        "the budget — planned departures are not failures (the PR 4 "
+        "drain semantics applied to the data plane).")
+_define("RTPU_DATA_DRAIN_POLL_S", float, 1.0,
+        "How often (at most) an actor-pool stage refreshes the cluster's "
+        "draining-node set while submitting work. A pool actor observed "
+        "on a draining node is proactively replaced (new actor placed by "
+        "the scheduler, which already excludes draining nodes) instead "
+        "of waiting for the drain deadline to kill it mid-batch. 0 "
+        "disables the poll; pools then heal only reactively.")
+
 # -- object store / spilling -------------------------------------------------
 _define("RTPU_NATIVE_STORE", bool, True,
         "Use the C++ shm arena when available (0 forces pickle fallback).")

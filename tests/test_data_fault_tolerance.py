@@ -1,0 +1,402 @@
+"""Data-plane fault tolerance: self-healing actor pools, all-to-all shard
+re-derivation, resumable ingest (RTPU_DATA_FT*).
+
+Chaos cases SIGKILL pool-actor workers or kill/drain whole nodes mid-pipeline
+and assert block-for-block identical output plus the right counters. Each test
+owns its init()/Cluster (no shared fixture) because worker death would poison a
+module-scoped cluster.
+"""
+from __future__ import annotations
+
+import os
+import signal
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+
+
+def _client():
+    from ray_tpu.core import context as ctx
+
+    return ctx.get_worker_context().client
+
+
+def _alive_actors():
+    return [a for a in _client().request({"kind": "list_state", "what": "actors"})
+            if a["state"] == "ALIVE"]
+
+
+def _worker_pids():
+    return {w["worker_id"]: w["pid"]
+            for w in _client().request({"kind": "list_state", "what": "workers"})}
+
+
+class MarkingUDF:
+    """Appends each batch's min id to a side-effect file, then transforms.
+
+    The marker file gives (a) a signal that the pool is mid-flight and
+    (b) an at-least-once delivery log: duplicates == replayed batches.
+    """
+
+    def __init__(self, path, mult=2, delay=0.3):
+        self.path = path
+        self.mult = mult
+        self.delay = delay
+
+    def __call__(self, batch):
+        with open(self.path, "a") as f:
+            f.write(f"{int(batch['id'].min())}\n")
+            f.flush()
+        time.sleep(self.delay)
+        batch["value"] = batch["id"] * self.mult
+        return batch
+
+
+@pytest.mark.chaos
+def test_pool_actor_sigkill_identical_output(tmp_path):
+    """SIGKILL a pool actor mid-map: output byte-identical, retries counted,
+    side-effect replays bounded by the retry count (exactly-once output,
+    at-least-once side effects)."""
+    import ray_tpu.data as rd
+    from ray_tpu.data import executor as dx
+
+    ray_tpu.init(num_cpus=4)
+    try:
+        dx.reset_ft_counters()
+        mark = str(tmp_path / "markers.txt")
+
+        killed = {}
+
+        def killer():
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline:
+                try:
+                    lines = open(mark).read().split()
+                except FileNotFoundError:
+                    lines = []
+                if len(lines) >= 2:
+                    acts = [a for a in _alive_actors() if a.get("worker_id")]
+                    if acts:
+                        pid = _worker_pids().get(acts[0]["worker_id"])
+                        if pid and pid != os.getpid():
+                            os.kill(pid, signal.SIGKILL)
+                            killed["pid"] = pid
+                            return
+                time.sleep(0.05)
+
+        ds = rd.range(160, parallelism=8).map_batches(
+            MarkingUDF, fn_constructor_args=(mark,), concurrency=2)
+        t = threading.Thread(target=killer)
+        t.start()
+        out = ds.take_all()
+        t.join()
+
+        assert killed.get("pid"), "killer thread never found a pool actor"
+        assert sorted(r["id"] for r in out) == list(range(160))
+        assert sorted(r["value"] for r in out) == [2 * i for i in range(160)]
+        counters = dx.ft_counters()
+        assert counters["retries"] >= 1, counters
+        attempts = [int(x) for x in open(mark).read().split()]
+        dups = len(attempts) - len(set(attempts))
+        assert dups <= counters["retries"], (dups, counters)
+        # Every block was attempted at least once.
+        assert set(attempts) == {20 * i for i in range(8)}
+    finally:
+        ray_tpu.shutdown()
+
+
+@pytest.mark.chaos
+def test_shuffle_shard_lost_rederives():
+    """Kill the node holding shuffle output shards: ft_get re-derives the
+    lost shards from surviving head-resident inputs via the recorded
+    producing-task specs (controller lineage disabled to force the
+    data-plane path)."""
+    import os
+
+    os.environ["RTPU_LINEAGE_MAX"] = "0"  # controller subprocess inherits
+    try:
+        from ray_tpu.core.cluster_utils import Cluster
+        from ray_tpu.data import executor as dx
+        from ray_tpu.data import logical as L
+        from ray_tpu.data.block import BlockAccessor
+        from ray_tpu.data.dataset import Dataset
+
+        cluster = Cluster(head_resources={"CPU": 1})
+        try:
+            # Occupy the head's only CPU while the shuffle runs so every
+            # split/reduce task — and thus every output shard — lands on
+            # node B; released before recovery so re-derivation tasks can
+            # run on the head.
+            @ray_tpu.remote(num_cpus=1)
+            class Hog:
+                def ping(self):
+                    return "ok"
+
+            hog = Hog.remote()
+            assert ray_tpu.get(hog.ping.remote()) == "ok"  # placed on head
+
+            nid = cluster.add_node({"CPU": 4}, remote=True, host_id="data-node-b")
+            dx.reset_ft_counters()
+
+            # Blocks must be big enough (~400KB) to live on node B rather
+            # than being cached head-side by small-object fast paths.
+            n, p = 200_000, 4
+            blocks = [{"id": np.arange(i * (n // p), (i + 1) * (n // p),
+                                       dtype=np.int64)} for i in range(p)]
+            # Head-resident inputs survive the node kill; only the shuffle
+            # outputs on node B are lost.
+            src = Dataset([L.InputData(refs=[ray_tpu.put(b) for b in blocks])])
+            refs = src.random_shuffle(seed=7).to_block_refs()
+            ray_tpu.wait(refs, num_returns=len(refs))
+
+            cluster._agent_procs[0].kill()
+            deadline = time.monotonic() + 25
+            while time.monotonic() < deadline:
+                nodes = {x["node_id"]: x for x in ray_tpu.nodes()}
+                if not nodes[nid]["alive"]:
+                    break
+                time.sleep(0.2)
+
+            ray_tpu.kill(hog)  # free the head CPU for re-derivation tasks
+            time.sleep(0.3)
+            out = dx.ft_get(refs)
+            ids = np.sort(np.concatenate(
+                [BlockAccessor(b).to_numpy()["id"] for b in out]))
+            assert ids.tolist() == list(range(n)), len(ids)
+            assert dx.ft_counters()["rederived"] >= 1, dx.ft_counters()
+        finally:
+            cluster.shutdown()
+    finally:
+        os.environ.pop("RTPU_LINEAGE_MAX", None)
+
+
+@pytest.mark.chaos
+def test_drain_preemption_budget_untouched(monkeypatch):
+    """Drain the node hosting the pool (reason=preemption) with a ZERO retry
+    budget: the pipeline still completes exactly because preempted deaths and
+    proactive migration never charge the budget."""
+    monkeypatch.setenv("RTPU_DATA_FT_RETRIES", "0")
+    import ray_tpu.data as rd
+    from ray_tpu.core.cluster_utils import Cluster
+    from ray_tpu.data import executor as dx
+    from ray_tpu.util import state as st
+
+    cluster = Cluster(head_resources={"CPU": 1})
+    try:
+        nid = cluster.add_node({"CPU": 5}, remote=True, host_id="drain-node-b")
+        dx.reset_ft_counters()
+
+        drained = {}
+
+        def drainer():
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline:
+                byn = {}
+                for a in _alive_actors():
+                    byn.setdefault(a["node_id"], []).append(a)
+                if nid in byn:
+                    st.drain_node(nid, reason="preemption", deadline_s=0.3)
+                    drained["did"] = True
+                    # Replacements need somewhere to land: B is draining and
+                    # the head can't fit a 2-CPU actor.
+                    cluster.add_node({"CPU": 5}, remote=True,
+                                     host_id="drain-node-c")
+                    return
+                time.sleep(0.05)
+
+        class Slow:
+            def __call__(self, batch):
+                time.sleep(0.4)
+                batch["value"] = batch["id"] * 3
+                return batch
+
+        # num_cpus=2 + 1-CPU head pins both pool actors onto node B, so the
+        # drain deterministically hits the pool.
+        ds = rd.range(160, parallelism=8).map_batches(
+            Slow, concurrency=2, num_cpus=2)
+        t = threading.Thread(target=drainer)
+        t.start()
+        out = ds.take_all()
+        t.join()
+
+        assert drained.get("did"), "drainer never saw a pool actor on node B"
+        assert sorted(r["id"] for r in out) == list(range(160))
+        assert sorted(r["value"] for r in out) == [3 * i for i in range(160)]
+        counters = dx.ft_counters()
+        assert counters["retries"] == 0, counters  # budget untouched
+        assert counters["preempted_retries"] + counters["proactive_migrations"] >= 1, counters
+    finally:
+        cluster.shutdown()
+
+
+@pytest.mark.chaos
+def test_ft_disabled_fail_fast(tmp_path, monkeypatch):
+    """RTPU_DATA_FT=0 restores fail-fast: a SIGKILLed pool actor surfaces a
+    typed error instead of healing."""
+    monkeypatch.setenv("RTPU_DATA_FT", "0")
+    import ray_tpu.data as rd
+    from ray_tpu.data import executor as dx
+
+    ray_tpu.init(num_cpus=4)
+    try:
+        dx.reset_ft_counters()
+        mark = str(tmp_path / "markers.txt")
+
+        def killer():
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline:
+                try:
+                    lines = open(mark).read().split()
+                except FileNotFoundError:
+                    lines = []
+                if len(lines) >= 2:
+                    acts = [a for a in _alive_actors() if a.get("worker_id")]
+                    if acts:
+                        pid = _worker_pids().get(acts[0]["worker_id"])
+                        if pid and pid != os.getpid():
+                            os.kill(pid, signal.SIGKILL)
+                            return
+                time.sleep(0.05)
+
+        ds = rd.range(160, parallelism=8).map_batches(
+            MarkingUDF, fn_constructor_args=(mark,), concurrency=2)
+        t = threading.Thread(target=killer)
+        t.start()
+        with pytest.raises((ray_tpu.ActorDiedError, ray_tpu.WorkerCrashedError)):
+            ds.take_all()
+        t.join()
+        assert dx.ft_counters()["retries"] == 0
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_pool_stats_label():
+    """ActorPool stage stats carry the UDF class name, not 'type'."""
+    import ray_tpu.data as rd
+
+    ray_tpu.init(num_cpus=4)
+    try:
+        class Double:
+            def __call__(self, batch):
+                batch["id"] = batch["id"] * 2
+                return batch
+
+        ds = rd.range(20, parallelism=2).map_batches(Double, concurrency=1)
+        ds.take_all()
+        stats = ds.stats()
+        assert "ActorPool[Double]" in stats, stats
+        assert "ActorPool[type]" not in stats, stats
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_completion_order_no_head_of_line_blocking():
+    """With preserve_order off, a slow first block must not gate delivery of
+    later blocks (drain_one waits on the whole in-flight list)."""
+    import ray_tpu.data as rd
+    from ray_tpu.data.context import DataContext
+
+    ray_tpu.init(num_cpus=4)
+    ctx = DataContext.get_current()
+    old = ctx.preserve_order
+    ctx.preserve_order = False
+    try:
+        class FirstSlow:
+            def __call__(self, batch):
+                if int(batch["id"].min()) == 0:
+                    time.sleep(1.5)
+                batch["value"] = batch["id"] + 1
+                return batch
+
+        order = []
+        ds = rd.range(80, parallelism=4).map_batches(FirstSlow, concurrency=2)
+        rows = 0
+        for b in ds.iter_batches(batch_size=20):
+            order.append(int(b["id"].min()))
+            rows += len(b["id"])
+        assert rows == 80
+        assert sorted(order) == [0, 20, 40, 60]
+        # The slow block finishes last; anything else means head-of-line
+        # blocking in completion-order drain.
+        assert order[-1] == 0, order
+    finally:
+        ctx.preserve_order = old
+        ray_tpu.shutdown()
+
+
+def test_iterator_resume_identical(tmp_path, monkeypatch):
+    """DataIterator with a resume_key journals an (epoch, block, carry)
+    cursor: a restart mid-epoch resumes exactly where it stopped, and a full
+    pass rolls the epoch."""
+    monkeypatch.setenv("RTPU_CHECKPOINT_DIR", str(tmp_path))
+    import ray_tpu.data as rd
+
+    ray_tpu.init(num_cpus=4)
+    try:
+        ds = rd.range(100, parallelism=5)
+        ref = [b["id"].tolist()
+               for b in rd.range(100, parallelism=5).iter_batches(batch_size=8)]
+
+        it = ds.iterator(resume_key="trainA")
+        g = it.iter_batches(batch_size=8)
+        got = [next(g)["id"].tolist() for _ in range(5)]
+        del g  # abandon mid-epoch
+
+        it2 = ds.iterator(resume_key="trainA")
+        rest = [b["id"].tolist() for b in it2.iter_batches(batch_size=8)]
+        assert got + rest == ref
+
+        it3 = ds.iterator(resume_key="trainA")
+        assert it3.cursor.state["epoch"] == 1  # full pass rolled the epoch
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_cursor_rejects_shuffle_buffer(tmp_path, monkeypatch):
+    """A journaled cursor is incompatible with a local shuffle buffer."""
+    monkeypatch.setenv("RTPU_CHECKPOINT_DIR", str(tmp_path))
+    import ray_tpu.data as rd
+
+    ray_tpu.init(num_cpus=2)
+    try:
+        it = rd.range(16, parallelism=2).iterator(resume_key="bad")
+        with pytest.raises(ValueError):
+            next(iter(it.iter_batches(batch_size=4,
+                                      local_shuffle_buffer_size=8)))
+    finally:
+        ray_tpu.shutdown()
+
+
+@pytest.mark.chaos
+def test_streaming_split_coordinator_failover(tmp_path, monkeypatch):
+    """Kill the streaming_split coordinator mid-stream: it restarts, replays
+    its assignment journal, and consumers finish with every row exactly
+    once across splits."""
+    monkeypatch.setenv("RTPU_CHECKPOINT_DIR", str(tmp_path))
+    import ray_tpu.data as rd
+
+    ray_tpu.init(num_cpus=4)
+    try:
+        ds = rd.range(120, parallelism=6)
+        its = ds.streaming_split(2, resume_key="splitjob")
+
+        seen = []
+        streams = [it.iter_batches(batch_size=10) for it in its]
+        # Pull one batch from each split, then SIGKILL the coordinator's
+        # worker — rt.kill() is always permanent, but a crashed worker goes
+        # through the max_restarts path and replays the handout journal.
+        for g in streams:
+            seen.extend(next(g)["id"].tolist())
+        coord_row = next(a for a in _alive_actors()
+                         if a.get("name") == "rtpu_split_splitjob")
+        os.kill(_worker_pids()[coord_row["worker_id"]], signal.SIGKILL)
+        for g in streams:
+            for b in g:
+                seen.extend(b["id"].tolist())
+        assert sorted(seen) == list(range(120)), (len(seen), len(set(seen)))
+    finally:
+        ray_tpu.shutdown()

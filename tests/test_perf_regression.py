@@ -639,3 +639,99 @@ def test_serve_bench_smoke(tmp_path):
     data = json.loads(out.read_text())
     assert data["serve_ttft_hit_speedup"]["value"] >= 2.0
     assert data["serve_failed_streams"]["value"] == 0
+
+
+def test_data_ft_disabled_path_overhead(ray_start_regular, monkeypatch):
+    """Data-plane FT guard (mirrors the RTPU_TASK_EVENTS guard): with
+    RTPU_DATA_FT=0 the streaming executor reverts to fail-fast waits —
+    no retry bookkeeping, no lineage thunks, no journal — and a pool
+    pipeline pays one flag check per wait, so the disabled path holds a
+    floor ~10x under the observed smoke profile (benchmarks/BENCH_r11)."""
+    import ray_tpu.data as rd
+
+    monkeypatch.setenv("RTPU_DATA_FT", "0")
+
+    class Ident:
+        def __call__(self, batch):
+            return batch
+
+    def run(n, parallelism):
+        rows = 0
+        ds = rd.range(n, parallelism=parallelism).map_batches(
+            Ident, concurrency=2)
+        for b in ds.iter_batches(batch_size=1024):
+            rows += len(b["id"])
+        return rows
+
+    run(2_000, 2)  # warm the pool-actor spawn path
+    n = 20_000
+    t0 = time.perf_counter()
+    rows = run(n, 4)
+    dt = time.perf_counter() - t0
+    assert rows == n
+    assert n / dt > 1_000, \
+        f"FT-disabled data pipeline {n/dt:.0f} rows/s below floor"
+
+
+@pytest.mark.slow
+def test_data_pipeline_healthy_throughput_floor(ray_start_regular):
+    """Healthy-path floor with RTPU_DATA_FT on (the default): the full
+    read -> actor-pool map -> shuffle -> ingest chain must hold ~10x
+    under the observed smoke profile, so the fault-tolerance machinery
+    can never silently tax a cluster where nothing fails. Slow-marked:
+    a 100k-row shuffle on a loaded CI host is too noisy for tier-1."""
+    import ray_tpu.data as rd
+    from ray_tpu.data import executor as dx
+
+    class Ident:
+        def __call__(self, batch):
+            return batch
+
+    def run(n, parallelism):
+        rows = 0
+        ds = (rd.range(n, parallelism=parallelism)
+              .map_batches(Ident, concurrency=2)
+              .random_shuffle(seed=3))
+        for b in ds.iter_batches(batch_size=2048):
+            rows += len(b["id"])
+        return rows
+
+    run(5_000, 2)  # warm the pool-actor spawn path
+    dx.reset_ft_counters()
+    n = 100_000
+    t0 = time.perf_counter()
+    rows = run(n, 8)
+    dt = time.perf_counter() - t0
+    assert rows == n
+    # A healthy run must never burn the failure counters.
+    c = dx.ft_counters()
+    assert c["retries"] == 0 and c["rederived"] == 0, c
+    assert n / dt > 5_000, \
+        f"healthy data pipeline {n/dt:.0f} rows/s below floor"
+
+
+@pytest.mark.slow
+def test_data_bench_smoke(tmp_path):
+    """The data-plane benchmark's --smoke profile must run end to end,
+    pass its own acceptance gates (exact recovery from a pool SIGKILL
+    and a node death, non-zero retry/rederive counters, exact ingest
+    resume) and emit a well-formed BENCH json (slow tier; the committed
+    benchmarks/BENCH_r11.json comes from the full profile)."""
+    import json
+    import subprocess
+    import sys
+
+    out = tmp_path / "bench.json"
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.run(
+        [sys.executable, os.path.join(root, "benchmarks", "data_bench.py"),
+         "--smoke", "--out", str(out)],
+        capture_output=True, text=True, timeout=900,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode == 0, (proc.stdout[-2000:], proc.stderr[-2000:])
+    data = json.loads(out.read_text())
+    assert data["data_pool_kill_recovered_ok"] is True
+    assert data["data_pool_kill_retries"] >= 1
+    assert data["data_rederive_recovered_ok"] is True
+    assert data["data_blocks_rederived"] >= 1
+    assert data["data_ingest_resume_ok"] is True
