@@ -154,15 +154,26 @@ def cmd_status(args) -> int:
     nodes = state.get("nodes") or []
     if nodes:
         print(f"{'NODE':14} {'STATE':10} {'CPU%':>6} {'MEM%':>6} "
-              f"{'WORKERS':>8}  RESOURCES", file=sys.stderr)
+              f"{'WORKERS':>8} {'STORE':>13} {'SPILL':>9}  RESOURCES",
+              file=sys.stderr)
         for n in sorted(nodes, key=lambda n: n.get("index", 0)):
             st = n.get("state", "alive" if n.get("alive") else "dead")
             if st in ("draining", "drained") and n.get("drain_reason"):
                 st = f"{st[:4]}:{n['drain_reason'][:5]}"
+            # Object-store occupancy: arena used/capacity + spilled bytes
+            # on disk (the census tiers, per node).
+            arena = n.get("arena") or {}
+            store = (f"{_fmt_bytes(arena.get('used', 0))}"
+                     f"/{_fmt_bytes(arena.get('capacity', 0))}"
+                     if arena.get("capacity") else "-")
+            spill = n.get("spill") or {}
+            spill_s = (_fmt_bytes(spill.get("bytes", 0))
+                       if spill.get("bytes") else "-")
             print(f"{n['node_id'][:12]:14} {st:10} "
                   f"{n.get('cpu_percent') or 0.0:>6.1f} "
                   f"{(n.get('mem_fraction') or 0.0) * 100:>6.1f} "
-                  f"{n.get('num_workers', 0):>8}  "
+                  f"{n.get('num_workers', 0):>8} {store:>13} "
+                  f"{spill_s:>9}  "
                   f"{json.dumps(n.get('resources', {}))}", file=sys.stderr)
         print(file=sys.stderr)
     # Compiled DAGs with live channel plans: their steady-state dispatch
@@ -417,6 +428,39 @@ def _top_frame(window: float = 120.0, spark_points: int = 30) -> str:
                 f"{d.get('occupancy', 0.0) * 100:>6.1f} "
                 + (f"{tv:>8.3f}s" if tv is not None else f"{'-':>9}")
                 + (f" {kv:>7.1f}" if kv is not None else f" {'-':>7}"))
+    # Data plane: per-operator throughput from the streaming executor's
+    # live rtpu_data_operator_* families (Dataset.stats() is the
+    # per-run report; this is the cluster-wide cumulative view).
+    dblocks = q(name="rtpu_data_operator_blocks_total") or []
+    if dblocks:
+        def _last_by(name, **want):
+            out = {}
+            for s2 in q(name=name) or []:
+                tg = s2["tags"]
+                if s2["points"] and all(tg.get(k) == v
+                                        for k, v in want.items()):
+                    out[tg.get("operator", "?")] = s2["points"][-1][1]
+            return out
+
+        wall = _last_by("rtpu_data_operator_seconds_total", phase="wall")
+        udf = _last_by("rtpu_data_operator_seconds_total", phase="udf")
+        bp = _last_by("rtpu_data_operator_seconds_total",
+                      phase="backpressure")
+        byt = _last_by("rtpu_data_operator_bytes_total", dir="out")
+        rws = _last_by("rtpu_data_operator_rows_total", dir="out")
+        lines.append("")
+        lines.append(f"{'DATA OPERATOR':24} {'BLOCKS':>8} "
+                     f"{'ROWS OUT':>10} {'BYTES OUT':>10} {'WALL':>8} "
+                     f"{'UDF':>8} {'BP WAIT':>8}")
+        for ser in sorted(dblocks, key=lambda s: str(s["tags"])):
+            op = ser["tags"].get("operator", "?")
+            pts = [v for _, v in ser["points"]]
+            lines.append(
+                f"{op[:24]:24} {pts[-1] if pts else 0:>8.0f} "
+                f"{rws.get(op, 0):>10.0f} "
+                f"{_fmt_bytes(byt.get(op, 0)):>10} "
+                f"{wall.get(op, 0):>7.1f}s {udf.get(op, 0):>7.1f}s "
+                f"{bp.get(op, 0):>7.1f}s")
     lines.append("")
     try:
         events = state_api.list_events(limit=6)
@@ -552,28 +596,62 @@ def cmd_drain(args) -> int:
 
 
 def cmd_memory(args) -> int:
-    """Object-reference/memory table (reference: `ray memory` — the
-    reference-table dump from _private/state.py)."""
+    """Cluster object census (reference: `ray memory` /
+    `ray summary objects`): the object directory joined with every live
+    process's ownership shard, grouped by owner/tier/node/callsite with a
+    per-tier byte breakdown inside each group. Dead shards are reported
+    as error lines; survivors' totals still aggregate."""
     rt = _connect(args)
-    from ray_tpu.core import context as ctx
+    from ray_tpu.util import state as state_api
 
-    s = ctx.get_worker_context().client.request(
-        {"kind": "memory_summary", "limit": args.limit})
+    s = state_api.summarize_objects(min_size=args.min_size,
+                                    limit=args.limit)
+    if not s.get("enabled", True):
+        for err in s.get("errors") or ():
+            print(err, file=sys.stderr)
+        rt.shutdown()
+        return 1
     print(f"objects: {s['num_objects']}  "
-          f"total: {s['total_bytes'] / 1e6:.1f} MB")
-    for nid, st in sorted(s.get("arenas", {}).items()):
+          f"total: {_fmt_bytes(s['total_bytes'])}  "
+          f"shards: {s.get('shards', 0)}/{s.get('requested', 0)}")
+    for err in s.get("errors") or ():
+        print(f"shard error: {err}", file=sys.stderr)
+    # Ground truth next to attribution: census bytes vs what the arenas
+    # and spill dirs actually hold — a big gap means unattributed memory.
+    for nid, st in sorted((s.get("arenas") or {}).items()):
         used, cap = st.get("used", 0), st.get("capacity", 0)
-        print(f"arena {nid[:8]}: {used / 1e6:.1f}/{cap / 1e6:.1f} MB "
+        print(f"arena {nid[:8]}: {_fmt_bytes(used)}/{_fmt_bytes(cap)} "
               f"({st.get('objects', 0)} objects)")
-    for wid, st in sorted(s.get("workers", {}).items()):
-        print(f"worker {wid[:8]}: owned={st.get('owned', 0)} "
-              f"borrowed={st.get('borrowed', 0)} pins={st.get('pins', 0)}")
-    rows = s["objects"]  # server-ranked largest-first, already truncated
+    for nid, st in sorted((s.get("spill") or {}).items()):
+        if st and st.get("bytes"):
+            print(f"spill {nid[:8]}: {_fmt_bytes(st['bytes'])} "
+                  f"({st.get('files', 0)} files)")
+    groups = (s.get("groups") or {}).get(args.group_by) or {}
+    if groups:
+        print()
+        print(f"{args.group_by.upper():28} {'BYTES':>12} {'COUNT':>7}  "
+              f"TIERS")
+        for key, g in sorted(groups.items(),
+                             key=lambda kv: -kv[1]["bytes"]):
+            tiers = " ".join(
+                f"{t}={_fmt_bytes(b)}"
+                for t, b in sorted(g["tiers"].items(),
+                                   key=lambda kv: -kv[1]))
+            print(f"{str(key)[:28]:28} {_fmt_bytes(g['bytes']):>12} "
+                  f"{g['count']:>7}  {tiers}")
+    rows = s.get("objects") or []  # server-ranked largest-first
     if rows:
-        print(f"{'OBJECT':34} {'SIZE':>12} {'STORAGE':8} NODE")
+        print()
+        print(f"{'OBJECT':34} {'SIZE':>10} {'TIER':8} {'NODE':10} "
+              f"{'OWNER':16} {'AGE':>7}  CALLSITE")
         for o in rows:
-            print(f"{o['object_id'][:32]:34} {o['size']:>12} "
-                  f"{o['storage']:8} {(o['node_id'] or '')[:8]}")
+            cs = o.get("callsite") or ""
+            print(f"{o['object_id'][:32]:34} "
+                  f"{_fmt_bytes(o['size']):>10} "
+                  f"{(o.get('tier') or '?'):8} "
+                  f"{(o.get('node_id') or '')[:8]:10} "
+                  f"{(o.get('owner') or '?')[:16]:16} "
+                  f"{o.get('age_s', 0):>6.0f}s  {cs[-40:]}")
     rt.shutdown()
     return 0
 
@@ -935,9 +1013,17 @@ def main(argv=None) -> int:
                    help="block up to S seconds until the node is drained")
     p.set_defaults(fn=cmd_drain)
 
-    p = sub.add_parser("memory", help="object reference/memory table")
+    p = sub.add_parser("memory", help="cluster object census: who owns "
+                                      "which bytes, in which tier")
     p.add_argument("--address", default=None)
     p.add_argument("--limit", type=int, default=50)
+    p.add_argument("--group-by", default="owner", dest="group_by",
+                   choices=["owner", "tier", "node", "callsite"],
+                   help="grouped byte/count summary (callsite needs "
+                        "RTPU_CALLSITE=1 on the producing processes)")
+    p.add_argument("--min-size", type=int, default=0, dest="min_size",
+                   help="hide per-object rows smaller than this many "
+                        "bytes (group totals still count everything)")
     p.set_defaults(fn=cmd_memory)
 
     p = sub.add_parser("serve", help="deploy/inspect Serve applications")

@@ -123,6 +123,20 @@ CORE_METRIC_META: Dict[str, Tuple[str, str]] = {
                                           "by message kind"),
     "rtpu_rpc_handler_seconds_total": (
         "counter", "Cumulative RPC handler seconds, by message kind"),
+    "rtpu_object_store_bytes": (
+        "gauge", "Object-store bytes tracked by the directory, by node "
+                 "and storage tier (inline/shm/arena/spill/replica) — "
+                 "the census gauge behind `rtpu memory`"),
+    "rtpu_object_store_fill_fraction": (
+        "gauge", "Per-node object arena fill fraction 0-1 (used/capacity "
+                 "from agent heartbeats) — drives the "
+                 "object_store_mem_high alert rule"),
+    "rtpu_node_spill_bytes": (
+        "gauge", "Per-node bytes of spilled objects on disk (host-wide "
+                 "spill-dir scan riding agent heartbeats)"),
+    "rtpu_object_leaks_total": (
+        "counter", "Objects flagged OBJECT_LEAK_SUSPECT by the leak "
+                   "watchdog (old refs whose owner is dead/unreachable)"),
 }
 
 # Families whose HELP/TYPE lines are emitted even with no samples yet
@@ -224,6 +238,9 @@ class NodeInfo:
     # a healed partition rejoins without actor churn or double-allocation.
     suspect: bool = False
     suspect_since: float = 0.0  # monotonic
+    # Host-wide spill usage {files, bytes} (agent heartbeats; local nodes
+    # sample at metrics/census time) — census "spill" tier + `rtpu status`.
+    spill_stats: Dict[str, int] = field(default_factory=dict)
 
 
 @dataclass
@@ -401,6 +418,16 @@ class Controller:
         self.object_callbacks: Dict[str, List[Any]] = {}
         # Last-touched times drive cold-object selection for arena spilling.
         self.object_touch: Dict[str, float] = {}
+        # Census + leak-watchdog bookkeeping: first-registration wall time
+        # per directory object, the registering connection for driver/worker
+        # put paths (a closed conn whose old objects linger = leak suspect),
+        # the once-per-object dedup set, and the cumulative
+        # rtpu_object_leaks_total counter.
+        self.object_created: Dict[str, float] = {}
+        self.object_src: Dict[str, Any] = {}
+        self._leak_reported: Set[str] = set()
+        self.leak_count = 0
+        self._leak_task: Optional[asyncio.Task] = None
         self.spilled_count = 0
         self.rpc_counts: Dict[str, int] = {}  # message kind -> count
         # (due_time, arena_oid) for spilled arena copies awaiting deletion.
@@ -561,6 +588,10 @@ class Controller:
             # Off => no task, no per-sweep work: the disabled-path perf
             # floor is literally zero controller cycles.
             self._watchdog_task = loop.create_task(self._hang_watchdog_loop())
+        if flags.get("RTPU_LEAK_WATCHDOG") and flags.get("RTPU_EVENTS"):
+            # Same off-switch contract as the hang watchdog: disabled means
+            # no task and zero per-sweep work.
+            self._leak_task = loop.create_task(self._leak_watchdog_loop())
         if self.tsdb is not None:
             # RTPU_TSDB=0 => no task, no per-step sampling work: the
             # disabled path is zero controller cycles (perf-floor test).
@@ -703,6 +734,8 @@ class Controller:
             self._memory_task.cancel()
         if self._watchdog_task is not None:
             self._watchdog_task.cancel()
+        if self._leak_task is not None:
+            self._leak_task.cancel()
         if self._telemetry_task is not None:
             self._telemetry_task.cancel()
         if self.tsdb is not None:
@@ -1460,6 +1493,11 @@ class Controller:
             # result the worker managed to deliver before dying.
             return {"ok": True}
         self._store_location(loc)
+        # Leak watchdog: remember who registered the object — a put whose
+        # connection later closes while the object lingers past
+        # RTPU_LEAK_AGE_S is a leak suspect (only the put path records a
+        # source; unattributed objects are never flagged — safe direction).
+        self.object_src.setdefault(loc.object_id, conn)
         return {"ok": True}
 
     async def _wait_for_object(self, oid: str, deadline: Optional[float] = None) -> ObjectLocation:
@@ -1710,6 +1748,9 @@ class Controller:
         for oid in msg["object_ids"]:
             loc = self.objects.pop(oid, None)
             self.object_touch.pop(oid, None)
+            self.object_created.pop(oid, None)
+            self.object_src.pop(oid, None)
+            self._leak_reported.discard(oid)
             # Broadcast replicas die with the primary: each copy frees on
             # its own host (same routing as the primary's bytes).
             reps = self.object_replicas.pop(oid, None)
@@ -2959,9 +3000,10 @@ class Controller:
         seconds, return {worker_id: all-thread stack text}. Workers that
         are busy in native code simply miss the window — partial results
         are returned, never an error."""
-        req_id, requested, workers = await self._gather_from_workers(
+        req_id, targets, workers = await self._gather_from_workers(
             "stack_dump", float(msg.get("timeout", 2.0)))
-        return {"req_id": req_id, "requested": requested, "workers": workers}
+        return {"req_id": req_id, "requested": len(targets),
+                "workers": workers}
 
     async def _gather_from_workers(self, kind: str, timeout: float,
                                    extra: Optional[Dict[str, Any]] = None,
@@ -2969,7 +3011,10 @@ class Controller:
         """Fan a request to the target workers (default: all live) and
         gather replies (arriving as profile_result messages) until all
         respond or the deadline passes — partial results, never an
-        error. ``extra`` fields ride along on the request frame."""
+        error. ``extra`` fields ride along on the request frame. Returns
+        (req_id, target worker-id list, replies) — the target list (not
+        just a count) so callers like the object census can name exactly
+        which shards never answered (dead/SIGKILLed workers)."""
         req_id = uuid.uuid4().hex[:12]
         self._profiles[req_id] = {}
         targets = []
@@ -2987,7 +3032,7 @@ class Controller:
         while (len(self._profiles[req_id]) < len(targets)
                and time.monotonic() < deadline):
             await asyncio.sleep(0.05)
-        return req_id, len(targets), self._profiles.pop(req_id)
+        return req_id, targets, self._profiles.pop(req_id)
 
     async def _h_profile_result(self, conn, msg):
         bucket = self._profiles.get(msg["req_id"])
@@ -3038,12 +3083,12 @@ class Controller:
                              "task/actor/node/worker filter"}
         from . import profiler
 
-        _, requested, replies = await self._gather_from_workers(
+        _, sent_to, replies = await self._gather_from_workers(
             "profile", duration + 5.0,
             extra={"duration": duration, "hz": hz},
             worker_ids=targets)
         merged = profiler.merge_collapsed(replies)
-        return {"requested": requested, "duration": duration, "hz": hz,
+        return {"requested": len(sent_to), "duration": duration, "hz": hz,
                 "stacks": merged["stacks"], "samples": merged["samples"],
                 "workers": merged["workers"]}
 
@@ -3113,6 +3158,200 @@ class Controller:
         return {"objects": objs, "num_objects": len(self.objects),
                 "total_bytes": sum(l.size for l in self.objects.values()),
                 "workers": owners, "arenas": arenas}
+
+    def _local_spill_stats(self) -> Dict[str, int]:
+        """Spill usage of the controller's own host (agent-less nodes have
+        no heartbeat to ride; same local-sampling contract as cpu/mem)."""
+        try:
+            from .object_store import spill_stats
+
+            return spill_stats()
+        except Exception:
+            return {}
+
+    async def _h_object_census(self, conn, msg):
+        """Cluster object census (`rtpu memory --group-by ...`,
+        state.summarize_objects, the dashboard /objects page): the object
+        directory (size/tier/node ground truth) joined with every live
+        process's ownership shard (owner label, pin/borrow/hold counts,
+        optional RTPU_CALLSITE creation sites). Partial-tolerant by
+        construction: shards that never answer — SIGKILLed or wedged
+        workers — are reported as per-shard error strings while survivors'
+        rows still aggregate. The requesting driver ships its OWN shard
+        inline in the request (the controller cannot fan out to drivers)."""
+        if not flags.get("RTPU_CENSUS"):
+            return {"enabled": False, "objects": [], "groups": {},
+                    "errors": ["census disabled (RTPU_CENSUS=0)"],
+                    "num_objects": 0, "total_bytes": 0}
+        timeout = float(msg.get("timeout")
+                        or flags.get("RTPU_CENSUS_TIMEOUT_S"))
+        _, targets, replies = await self._gather_from_workers(
+            "census_dump", timeout)
+        shards: List[Dict[str, Any]] = []
+        errors: List[str] = []
+        for wid in targets:
+            shard = replies.get(wid)
+            if shard is None:
+                errors.append(f"worker {wid[:8]}: no census reply within "
+                              f"{timeout:.1f}s (dead or unreachable)")
+            elif not isinstance(shard, dict):
+                errors.append(f"worker {wid[:8]}: malformed shard "
+                              f"({type(shard).__name__})")
+            elif shard.get("error"):
+                errors.append(f"worker {wid[:8]}: {shard['error']}")
+            else:
+                shards.append(shard)
+        drv = msg.get("shard")
+        if isinstance(drv, dict):
+            shards.append(drv)
+        from .object_store import storage_kind
+
+        now = time.time()
+        rows: Dict[str, Dict[str, Any]] = {}
+        for oid, loc in self.objects.items():
+            rows[oid] = {
+                "object_id": oid, "size": int(loc.size or 0),
+                "tier": storage_kind(loc), "node_id": loc.node_id or "",
+                "owner": "", "local_refs": 0, "borrowers": 0, "holds": 0,
+                "pins": 0, "callsite": None,
+                "age_s": round(now - self.object_created.get(oid, now), 1)}
+        # Broadcast replicas are EXTRA bytes on other hosts: one census row
+        # per copy under the "replica" tier, keyed so they never collide
+        # with the primary.
+        for oid, reps in self.object_replicas.items():
+            for nid, rep in reps.items():
+                rows[f"{oid}+replica:{nid[:8]}"] = {
+                    "object_id": oid, "size": int(rep.size or 0),
+                    "tier": "replica", "node_id": nid,
+                    "owner": "", "local_refs": 0, "borrowers": 0,
+                    "holds": 0, "pins": 0, "callsite": None,
+                    "age_s": round(
+                        now - self.object_created.get(oid, now), 1)}
+        for shard in shards:
+            label = str(shard.get("label") or "?")
+            for r in shard.get("rows") or ():
+                oid = r.get("oid")
+                if not oid:
+                    continue
+                base = rows.get(oid)
+                if base is None:
+                    # Owned-but-unregistered (inline results, directory
+                    # races): the shard row is all we know.
+                    base = rows[oid] = {
+                        "object_id": oid, "size": 0, "tier": "",
+                        "node_id": "", "owner": "", "local_refs": 0,
+                        "borrowers": 0, "holds": 0, "pins": 0,
+                        "callsite": None, "age_s": 0.0}
+                if r.get("owned"):
+                    base["owner"] = base["owner"] or label
+                    base["local_refs"] = int(r.get("local") or 0)
+                    base["borrowers"] = int(r.get("borrowers") or 0)
+                    base["holds"] = int(r.get("holds") or 0)
+                    base["pins"] = int(r.get("pins") or 0)
+                    if r.get("callsite"):
+                        base["callsite"] = r["callsite"]
+                if not base["size"]:
+                    base["size"] = int(r.get("size") or 0)
+                if not base["tier"]:
+                    base["tier"] = r.get("tier") or ""
+        # Owner fallback from the put-path source connection: a census
+        # asked for by a DIFFERENT client (the `rtpu memory` CLI, the
+        # dashboard) cannot ship the driver's shard, but the directory
+        # remembers which connection registered each object — enough to
+        # keep driver/worker puts attributed instead of "(unknown)".
+        src_label: Dict[int, str] = {}
+        for w in self.workers.values():
+            if w.conn is not None:
+                src_label[id(w.conn)] = f"worker:{w.worker_id[:8]}"
+        for dconn in self.driver_conns:
+            src_label.setdefault(id(dconn), "driver")
+        for r in rows.values():
+            if r["owner"]:
+                continue
+            src = self.object_src.get(r["object_id"])
+            if src is not None:
+                r["owner"] = src_label.get(id(src), "")
+        # Per-tier breakdown inside every grouping: `--group-by owner`
+        # still answers "which tier is that owner's 3 GB sitting in?".
+        def _agg(key: str) -> Dict[str, Dict[str, Any]]:
+            out: Dict[str, Dict[str, Any]] = {}
+            for r in rows.values():
+                k = r.get(key) or "(unknown)"
+                if key == "node_id":
+                    k = k[:12] if k != "(unknown)" else k
+                g = out.setdefault(k, {"bytes": 0, "count": 0, "tiers": {}})
+                g["bytes"] += r["size"]
+                g["count"] += 1
+                t = r.get("tier") or "(unknown)"
+                g["tiers"][t] = g["tiers"].get(t, 0) + r["size"]
+            return out
+
+        groups = {"owner": _agg("owner"), "tier": _agg("tier"),
+                  "node": _agg("node_id"), "callsite": _agg("callsite")}
+        min_size = int(msg.get("min_size") or 0)
+        limit = int(msg.get("limit") or 1000)
+        detail = sorted((r for r in rows.values() if r["size"] >= min_size),
+                        key=lambda r: -r["size"])[:limit]
+        arenas = {nid: n.arena_stats for nid, n in self.nodes.items()
+                  if n.arena_stats}
+        spill = {nid: (n.spill_stats if n.agent_conn is not None
+                       else self._local_spill_stats())
+                 for nid, n in self.nodes.items() if n.alive}
+        total = sum(r["size"] for r in rows.values())
+        return {"enabled": True, "objects": detail, "groups": groups,
+                "errors": errors, "num_objects": len(rows),
+                "total_bytes": total,
+                "shards": len(shards), "requested": len(targets) + 1,
+                "arenas": arenas, "spill": spill, "t": now}
+
+    # ------------------------------------------------------- leak watchdog
+
+    async def _leak_watchdog_loop(self) -> None:
+        """Flag directory objects past RTPU_LEAK_AGE_S whose registering
+        connection is gone as OBJECT_LEAK_SUSPECT — once per object (the
+        hang watchdog's self-cleaning dedup-set pattern). Only put-path
+        objects carry a source connection; everything else is never
+        flagged (objects can only be under-reported, never smeared)."""
+        poll = float(flags.get("RTPU_LEAK_POLL_S"))
+        while True:
+            await asyncio.sleep(poll)
+            try:
+                self._leak_sweep()
+            except Exception as e:
+                sys.stderr.write(
+                    f"[controller] leak sweep failed: {e!r}\n")
+
+    def _leak_sweep(self) -> None:
+        age_s = float(flags.get("RTPU_LEAK_AGE_S"))
+        now = time.time()
+        live = set(self.objects)
+        self._leak_reported &= live
+        for d in (self.object_created, self.object_src):
+            for oid in [o for o in d if o not in live]:
+                d.pop(oid, None)
+        for oid, src in list(self.object_src.items()):
+            if oid in self._leak_reported:
+                continue
+            created = self.object_created.get(oid)
+            if created is None or now - created < age_s:
+                continue
+            try:
+                dead = src is None or src.closed.is_set()
+            except Exception:
+                dead = True
+            if not dead:
+                continue
+            loc = self.objects.get(oid)
+            self._leak_reported.add(oid)
+            self.leak_count += 1
+            size = int(getattr(loc, "size", 0) or 0)
+            self._emit_event(
+                "WARNING", "OBJECT_LEAK_SUSPECT",
+                f"object {oid[:8]} ({size} bytes) is "
+                f"{now - created:.0f}s old and its owning connection is "
+                f"closed — suspected leaked ref",
+                data={"object_id": oid, "size": size,
+                      "age_s": round(now - created, 1)})
 
     async def _h_subscribe(self, conn, msg):
         self.subs.setdefault(msg["channel"], []).append(conn)
@@ -4028,6 +4267,50 @@ class Controller:
         families["rtpu_rpc_handler_seconds_total"] = fam(
             "rtpu_rpc_handler_seconds_total",
             {(("kind", k),): round(s, 6) for k, (_, s) in rpc.items()})
+        # Object-census gauges: directory bytes by (node, tier) plus
+        # broadcast replica copies, per-node arena fill fraction (the
+        # object_store_mem_high alert input), and per-node spill bytes.
+        from .object_store import storage_kind as _sk
+
+        store_data: Dict[Tuple, Any] = {}
+        for loc in self.objects.values():
+            key = (("node", (loc.node_id or "?")[:12]),
+                   ("tier", _sk(loc)))
+            store_data[key] = store_data.get(key, 0) + int(loc.size or 0)
+        for reps in self.object_replicas.values():
+            for nid, rep in reps.items():
+                key = (("node", nid[:12]), ("tier", "replica"))
+                store_data[key] = (store_data.get(key, 0)
+                                   + int(rep.size or 0))
+        families["rtpu_object_store_bytes"] = fam(
+            "rtpu_object_store_bytes", store_data)
+        fill_data: Dict[Tuple, Any] = {}
+        spill_data: Dict[Tuple, Any] = {}
+        local_spill: Optional[Dict[str, int]] = None
+        for n in self.nodes.values():
+            if not n.alive:
+                continue
+            key = (("node", n.node_id[:12]),)
+            ast = n.arena_stats
+            if n.agent_conn is None and self._arena is not None:
+                ast = self._arena.stats()
+            cap = float(ast.get("capacity", 0) or 0) if ast else 0.0
+            if cap > 0:
+                fill_data[key] = round(ast.get("used", 0) / cap, 4)
+            if n.agent_conn is not None:
+                sp = n.spill_stats
+            else:
+                if local_spill is None:
+                    local_spill = self._local_spill_stats()
+                sp = local_spill
+            if sp:
+                spill_data[key] = sp.get("bytes", 0)
+        families["rtpu_object_store_fill_fraction"] = fam(
+            "rtpu_object_store_fill_fraction", fill_data)
+        families["rtpu_node_spill_bytes"] = fam(
+            "rtpu_node_spill_bytes", spill_data)
+        families["rtpu_object_leaks_total"] = fam(
+            "rtpu_object_leaks_total", {(): self.leak_count})
         # Conditional families appear once they have samples; the
         # always-set keeps its HELP/TYPE headers from day one.
         for name in [n for n, f in families.items()
@@ -4139,6 +4422,16 @@ class Controller:
                     # Per-worker-process cpu%/rss (agent heartbeats;
                     # dashboard reporter parity). Empty for virtual nodes.
                     "proc_stats": dict(n.proc_stats),
+                    # Object-store occupancy (`rtpu status` STORE/SPILL
+                    # columns): arena used/capacity + host spill usage
+                    # (heartbeats for agent nodes, sampled locally here).
+                    "arena": (dict(n.arena_stats)
+                              if n.agent_conn is not None
+                              else (self._arena.stats()
+                                    if self._arena is not None else {})),
+                    "spill": (dict(n.spill_stats)
+                              if n.agent_conn is not None
+                              else self._local_spill_stats()),
                 }
                 for n in self.nodes.values()
             ],
@@ -4250,6 +4543,7 @@ class Controller:
                 await self._flush_suspect_calls(node)
                 self._wake_scheduler()
             node.arena_stats = msg.get("arena") or {}
+            node.spill_stats = msg.get("spill") or {}
             if msg.get("mem_fraction") is not None:
                 node.mem_fraction = float(msg["mem_fraction"])
             if msg.get("cpu_percent") is not None:
@@ -5058,6 +5352,9 @@ class Controller:
         # Fresh objects are the HOTTEST, not coldest: without this a
         # just-put batch ties at 0.0 and gets spilled first.
         self.object_touch.setdefault(loc.object_id, time.monotonic())
+        # Census age + leak-watchdog clock (setdefault: a spill rewrite or
+        # replica promote must not reset an object's age).
+        self.object_created.setdefault(loc.object_id, time.time())
         for ev in self.object_waiters.pop(loc.object_id, []):
             ev.set()
         for cb in self.object_callbacks.pop(loc.object_id, []):

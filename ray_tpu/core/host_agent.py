@@ -558,12 +558,21 @@ class HostAgent:
             except Exception:
                 cpu_percent = None
             from .worker_logs import log_volume_bytes
+            try:
+                from .object_store import spill_stats
+
+                spill = spill_stats()
+            except Exception:
+                spill = {}
 
             hb = {
                 "kind": "heartbeat",
                 "node_id": self.node_id,
                 "t": time.time(),
                 "arena": stats,
+                # Host-wide spill usage ({files, bytes}): the census
+                # "spill" tier and the `rtpu status` STORE column.
+                "spill": spill,
                 "num_workers": len(self.procs),
                 "mem_fraction": mem_fraction,
                 # Host CPU% (the `rtpu status` per-node column).

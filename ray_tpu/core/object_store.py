@@ -278,6 +278,34 @@ def spill_dir() -> str:
     return d
 
 
+def spill_stats() -> Dict[str, int]:
+    """Host-wide spill usage {files, bytes}: a directory scan (not a
+    per-process counter) because every process on the host spills into the
+    shared per-host dir — the census and the `rtpu status` object-store
+    column want ground truth for the node, not one process's view. The
+    dir is NOT created on a pure read."""
+    d = flags.get("RTPU_SPILL_DIR")
+    if not d:
+        import tempfile
+
+        d = os.path.join(tempfile.gettempdir(),
+                         f"rtpu_spill_{current_host_id()[:16]}")
+    files = 0
+    total = 0
+    try:
+        with os.scandir(d) as it:
+            for ent in it:
+                try:
+                    if ent.is_file():
+                        files += 1
+                        total += ent.stat().st_size
+                except OSError:
+                    continue
+    except OSError:
+        pass
+    return {"files": files, "bytes": total}
+
+
 def _put_spill(data, oob, total, object_id, node_id) -> Optional[ObjectLocation]:
     """Write the object's bytes (same layout as the arena) to a spill file.
 

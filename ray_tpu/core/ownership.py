@@ -46,6 +46,7 @@ live too long, never too short):
 """
 from __future__ import annotations
 
+import sys
 import threading
 import time
 import uuid
@@ -74,6 +75,13 @@ _alive = True  # flipped at interpreter teardown / shutdown
 # Submit-holds this process placed, by token -> [(oid, owner_addr), ...].
 _holds_out: Dict[str, List[Tuple[str, str]]] = {}
 _return_to_token: Dict[str, str] = {}
+# Census side-table: creating callsite per owned oid (RTPU_CALLSITE only —
+# a separate dict so _Entry's __slots__ stay lean on the default path) and
+# this process's human label ("driver" / "worker:<id8>") for owner
+# attribution in `rtpu memory --group-by owner`.
+_callsites: Dict[str, str] = {}
+_proc_label: Optional[str] = None
+_CALLSITES_MAX = 65536
 
 
 class _Entry:
@@ -111,6 +119,40 @@ _TOMBSTONE_TTL_S = 120.0
 
 def process_token() -> str:
     return _token
+
+
+def set_process_label(label: str) -> None:
+    """Name this process for census owner attribution (workers call it at
+    startup with "worker:<id8>"; drivers default to "driver")."""
+    global _proc_label
+    _proc_label = label
+
+
+def process_label() -> str:
+    return _proc_label or "driver"
+
+
+def _capture_callsite() -> Optional[str]:
+    """First stack frame outside ray_tpu, as "file:line" (reference:
+    RAY_record_ref_creation_sites). Called only under RTPU_CALLSITE."""
+    try:
+        f = sys._getframe(2)
+        while f is not None:
+            fn = f.f_code.co_filename
+            if "ray_tpu" not in fn.replace("\\", "/"):
+                return f"{fn}:{f.f_lineno}"
+            f = f.f_back
+    except Exception:
+        pass
+    return None
+
+
+def _record_callsite_locked(oid: str, cs: Optional[str]) -> None:
+    if not cs:
+        return
+    if len(_callsites) >= _CALLSITES_MAX:
+        _callsites.pop(next(iter(_callsites)), None)
+    _callsites[oid] = cs
 
 
 def enabled() -> bool:
@@ -373,12 +415,14 @@ def claim_ownership(oid: str, loc: Any = None) -> None:
     if not enabled():
         return
     addr = self_addr()
+    cs = _capture_callsite() if flags.get("RTPU_CALLSITE") else None
     with _lock:
         e = _entries.get(oid)
         if e is None:
             e = _entries.setdefault(oid, _Entry())
         e.is_owner = True
         e.owner_addr = addr or ""
+        _record_callsite_locked(oid, cs)
 
 
 def claim_return_refs(oids) -> str:
@@ -391,6 +435,7 @@ def claim_return_refs(oids) -> str:
     if not _alive or not enabled():
         return ""
     addr = self_addr() or ""
+    cs = _capture_callsite() if flags.get("RTPU_CALLSITE") else None
     with _lock:
         for oid in oids:
             e = _entries.get(oid)
@@ -399,6 +444,7 @@ def claim_return_refs(oids) -> str:
             e.is_owner = True
             e.owner_addr = addr
             e.local += 1
+            _record_callsite_locked(oid, cs)
     return addr
 
 
@@ -683,6 +729,7 @@ def _maybe_free_locked(oid: str, e: "_Entry") -> None:
     e.freed = True
     _entries.pop(oid, None)
     _pins.pop(oid, None)
+    _callsites.pop(oid, None)
     due = time.monotonic() + float(flags.get("RTPU_FREE_DELAY_S"))
     _pending_free.append((due, oid))
     if not _free_flush_scheduled:
@@ -747,6 +794,7 @@ def shutdown() -> None:
     with _lock:
         _entries.clear()
         _pins.clear()
+        _callsites.clear()
         _holds_out.clear()
         _return_to_token.clear()
         _pending_free.clear()
@@ -772,3 +820,54 @@ def stats() -> Dict[str, int]:
             "pins": len(_pins),
             "holds_out": len(_holds_out),
         }
+
+
+def census_shard(max_entries: int = 20000) -> Dict[str, Any]:
+    """This process's rows for the cluster object census (`rtpu memory`).
+
+    Size and storage tier are resolved lazily at census time from the
+    process-local location cache (api._local_locs) instead of being
+    recorded per ref at creation — the put/return hot paths pay nothing
+    for the census beyond the optional RTPU_CALLSITE stack walk. Rows the
+    local cache can't size are still reported (the controller's directory
+    fills size/tier in for them during aggregation)."""
+    if not flags.get("RTPU_CENSUS"):
+        return {"disabled": True, "label": process_label(),
+                "token": _token, "rows": []}
+    with _lock:
+        items = list(_entries.items())
+        truncated = max(0, len(items) - max_entries)
+        items = items[:max_entries]
+        pin_counts = {o: len(v) for o, v in _pins.items()}
+        callsites = dict(_callsites)
+    try:
+        from . import api
+
+        local_locs = api._local_locs
+    except Exception:
+        local_locs = {}
+    rows: List[Dict[str, Any]] = []
+    for oid, e in items:
+        loc = local_locs.get(oid)
+        size = int(getattr(loc, "size", 0) or 0)
+        tier = ""
+        if loc is not None:
+            try:
+                from . import object_store
+
+                tier = object_store.storage_kind(loc)
+            except Exception:
+                tier = ""
+        rows.append({
+            "oid": oid,
+            "owned": e.is_owner,
+            "local": e.local,
+            "borrowers": len(e.borrowers),
+            "holds": len(e.holds),
+            "pins": pin_counts.get(oid, 0),
+            "size": size,
+            "tier": tier,
+            "callsite": callsites.get(oid),
+        })
+    return {"label": process_label(), "token": _token, "rows": rows,
+            "truncated": truncated, "t": time.time()}

@@ -318,6 +318,11 @@ DEFAULT_ALERT_RULES: List[dict] = [
      "severity": "WARNING",
      "message": "serve TTFT p99 above 5s for 15s — scale the pool or "
                 "shed load (queue wait is counted since arrival)"},
+    {"name": "object_store_mem_high",
+     "metric": "rtpu_object_store_fill_fraction",
+     "op": ">", "threshold": 0.9, "for_s": 10.0, "severity": "WARNING",
+     "message": "object arena above 90% full for 10s — spill pressure; "
+                "run `rtpu memory --group-by owner` to find the holder"},
 ]
 
 

@@ -406,6 +406,9 @@ class WorkerRuntime:
         host, port = controller_addr.rsplit(":", 1)
         self.worker_id = WorkerID.generate()
         self.node_id = node_id
+        from . import ownership as _ownership
+
+        _ownership.set_process_label(f"worker:{self.worker_id[:8]}")
         self.client = CoreClient(host, int(port), handler=self._handle,
                                  reconnect=True,
                                  on_reconnect=self._on_reconnect)
@@ -1027,6 +1030,25 @@ class WorkerRuntime:
                     {"kind": "profile_result", "req_id": msg["req_id"],
                      "worker_id": self.worker_id, "text": st}),
                 daemon=True).start()
+        elif kind == "census_dump":
+            # Object-census shard for the object_census fan-out: full
+            # per-ref rows (owner/size/tier/pins/callsite) vs ref_dump's
+            # summary counters; same off-loop reply pattern.
+            from . import ownership
+
+            def _census_reply(req_id=msg["req_id"]):
+                try:
+                    shard = ownership.census_shard()
+                except Exception as e:
+                    shard = {"error": repr(e), "rows": []}
+                try:
+                    self.client.request(
+                        {"kind": "profile_result", "req_id": req_id,
+                         "worker_id": self.worker_id, "text": shard})
+                except Exception:
+                    pass
+
+            threading.Thread(target=_census_reply, daemon=True).start()
         elif kind == "stack_dump":
             # On-demand profiling (reference: reporter agent py-spy dump):
             # format every thread's current stack and reply off the event

@@ -20,6 +20,10 @@ Endpoints:
                              ?breakdown=1 for per-phase latency p50/p99)
     GET /api/workers         state API list_workers
     GET /api/objects         state API list_objects
+    GET /api/memory          cluster object census (?group_by, ?min_size,
+                             ?limit) — the `rtpu memory` backend
+    GET /objects             object census page (per-owner/tier/node/
+                             callsite bytes + largest objects)
     GET /api/jobs            job list (ray_tpu.jobs)
     GET /api/serve           serve application status (if running)
     GET /api/timeline        chrome-trace events (open in chrome://tracing)
@@ -81,7 +85,7 @@ _PAGE = """<!doctype html>
 <h1>ray_tpu dashboard</h1>
 <p>{cluster}</p>
 <p><a href="/logs">log viewer</a> · <a href="/timeline">timeline</a> ·
-<a href="/events">events</a></p>
+<a href="/events">events</a> · <a href="/objects">objects</a></p>
 <h2>Nodes</h2>{nodes}
 <h2>Telemetry</h2>{telemetry}
 <h2>Recent events</h2>{events}
@@ -476,6 +480,13 @@ class Dashboard:
                 data = state_api.list_workers()
             elif kind == "objects":
                 data = state_api.list_objects()
+            elif kind == "memory":
+                # Cluster object census (the `rtpu memory` backend):
+                # ?group_by=owner|tier|node|callsite, ?min_size=, ?limit=.
+                q = request.query
+                data = state_api.summarize_objects(
+                    min_size=int(q.get("min_size", 0)),
+                    limit=int(q.get("limit", 500)))
             elif kind == "jobs":
                 data = self._jobs()
             elif kind == "serve":
@@ -606,6 +617,66 @@ class Dashboard:
             + table + "</body></html>")
         return web.Response(text=body, content_type="text/html")
 
+    async def _objects_page(self, request):
+        """Cluster memory census (reference: the dashboard object view /
+        `ray memory`): per-group bytes by owner / tier / node / callsite
+        plus the largest individual objects, straight off the
+        controller's object_census aggregation."""
+        from aiohttp import web
+
+        group_by = request.query.get("group_by", "owner")
+        if group_by not in ("owner", "tier", "node", "callsite"):
+            group_by = "owner"
+        try:
+            s = state_api.summarize_objects(
+                min_size=int(request.query.get("min_size", 0)), limit=100)
+        except Exception as e:
+            s = {"enabled": False, "errors": [repr(e)], "objects": [],
+                 "groups": {}, "num_objects": 0, "total_bytes": 0}
+        errs = "".join(f"<p style='color:#b00'>{html.escape(str(e))}</p>"
+                       for e in s.get("errors", ()))
+        hdr = (f"<p>{s.get('num_objects', 0)} objects, "
+               f"{s.get('total_bytes', 0)} bytes across "
+               f"{s.get('shards', '?')} shard(s)</p>")
+        links = " · ".join(
+            f'<a href="/objects?group_by={g}">{g}</a>'
+            for g in ("owner", "tier", "node", "callsite"))
+        grows = [{"key": k, "bytes": v["bytes"], "count": v["count"],
+                  "tiers": ", ".join(f"{t}={b}" for t, b in
+                                     sorted(v.get("tiers", {}).items()))}
+                 for k, v in sorted(
+                     (s.get("groups", {}).get(group_by) or {}).items(),
+                     key=lambda kv: -kv[1]["bytes"])]
+        groups = _table(grows, ["key", "bytes", "count", "tiers"])
+        orows = [{"object_id": (o.get("object_id") or "")[:16],
+                  "size": o.get("size", 0), "tier": o.get("tier", "?"),
+                  "node": (o.get("node_id") or "")[:12],
+                  "owner": o.get("owner", "?"),
+                  "age_s": round(o.get("age_s") or 0, 1),
+                  "callsite": o.get("callsite") or ""}
+                 for o in s.get("objects", ())]
+        objects = _table(orows, ["object_id", "size", "tier", "node",
+                                 "owner", "age_s", "callsite"])
+        body = (
+            "<!doctype html><html><head><title>ray_tpu objects</title>"
+            '<meta http-equiv="refresh" content="10"><style>'
+            "body { font-family: system-ui, sans-serif; margin: 1.2rem; "
+            "color: #1a1a2e; } h1 { font-size: 1.2rem; } "
+            "h2 { font-size: 1.05rem; margin-top: 1.2rem; } "
+            "table { border-collapse: collapse; width: 100%; "
+            "font-size: .85rem; } th, td { text-align: left; "
+            "padding: .3rem .6rem; border-bottom: 1px solid #ddd; } "
+            "th { background: #f4f4f8; }"
+            "</style></head><body>"
+            '<h1>Object census <small style="color:#888">'
+            '(<a href="/">overview</a>)</small></h1>'
+            + hdr + errs
+            + f"<p>group by: {links}</p>"
+            + f"<h2>By {html.escape(group_by)}</h2>" + groups
+            + "<h2>Largest objects</h2>" + objects
+            + "</body></html>")
+        return web.Response(text=body, content_type="text/html")
+
     async def _logs_page(self, request):
         """Log viewer (reference: the dashboard log viewer): lists the
         cluster log index, or — given ?node&name / ?task_id / ?actor_id /
@@ -673,6 +744,7 @@ class Dashboard:
         app = web.Application()
         app.router.add_get("/", self._index)
         app.router.add_get("/logs", self._logs_page)
+        app.router.add_get("/objects", self._objects_page)
         app.router.add_get("/events", self._events_page)
         app.router.add_get("/timeline", self._timeline_page)
         app.router.add_get("/api/{kind}", self._api)

@@ -9,6 +9,7 @@ with consumption, and actor-pool map_batches reserves TPU chips per actor.
 """
 from __future__ import annotations
 
+import time
 import uuid
 from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple, Union
 
@@ -21,6 +22,89 @@ from .block import Block, BlockAccessor, concat_blocks
 from .context import DataContext
 from .datasource import write_block
 from .executor import StreamingExecutor, ft_get
+
+
+def _fmt_bytes(n: float) -> str:
+    n = float(n)
+    for unit in ("B", "KB", "MB", "GB"):
+        if abs(n) < 1024 or unit == "GB":
+            return f"{n:.1f}{unit}" if unit != "B" else f"{int(n)}B"
+        n /= 1024
+    return f"{n:.1f}GB"  # pragma: no cover
+
+
+class DatasetStats(str):
+    """Formatted per-operator execution report (reference: DatasetStats,
+    data/_internal/stats.py). Subclasses str so every existing consumer
+    of the old plain-string report (``"read:" in ds.stats()``) still
+    works, while the structured form rides along: ``.to_dict()`` for the
+    full report, ``.operators`` for the per-op rows."""
+
+    _report: Dict[str, Any]
+
+    def __new__(cls, text: str, report: Dict[str, Any]) -> "DatasetStats":
+        s = super().__new__(cls, text)
+        s._report = report
+        return s
+
+    def to_dict(self) -> Dict[str, Any]:
+        return self._report
+
+    @property
+    def operators(self) -> List[Dict[str, Any]]:
+        return self._report["operators"]
+
+
+def _format_stats(report: Dict[str, Any]) -> str:
+    lines = []
+    for op in report["operators"]:
+        wall = op["wall_s"]
+        rate = op["blocks"] / wall if wall > 0 else 0.0
+        line = (f"{op['operator']}: {wall:.3f}s over "
+                f"{op['blocks']} blocks ({rate:.1f} blocks/s)")
+        if op["peak_store_pressure"] >= 0.005:
+            line += (f", peak store pressure "
+                     f"{op['peak_store_pressure'] * 100:.1f}%")
+        if op.get("retries"):
+            line += f", {op['retries']} retries"
+        lines.append(line)
+        detail = []
+        if op["udf_s"]:
+            detail.append(f"udf {op['udf_s']:.3f}s")
+        if op["self_s"] and op["upstream_s"]:
+            detail.append(f"self {op['self_s']:.3f}s "
+                          f"(+{op['upstream_s']:.3f}s upstream)")
+        if op["backpressure_s"] >= 0.0005:
+            detail.append(f"backpressure wait {op['backpressure_s']:.3f}s")
+        if detail:
+            lines.append("    " + ", ".join(detail))
+        if op["rows_in"] or op["rows_out"]:
+            lines.append(
+                f"    rows: {op['rows_in']} in / {op['rows_out']} out, "
+                f"bytes: {_fmt_bytes(op['bytes_in'])} in / "
+                f"{_fmt_bytes(op['bytes_out'])} out")
+        bb = op["block_bytes"]
+        if bb["count"]:
+            dist = f"    block size: mean {_fmt_bytes(bb['mean'])}"
+            if bb["min"] is not None and bb["max"]:
+                dist += (f", min {_fmt_bytes(bb['min'])}, "
+                         f"max {_fmt_bytes(bb['max'])}")
+            dist += f" over {bb['count']} blocks"
+            lines.append(dist)
+        pool = op.get("actor_pool")
+        if pool:
+            lines.append(
+                f"    actor pool: {pool['actors']} actors, "
+                f"{pool['utilization'] * 100:.0f}% busy")
+    if not lines:
+        return "(no stages executed)"
+    if "total_wall_s" in report:
+        lines.append(
+            f"Total: {report['total_wall_s']:.3f}s wall, "
+            f"{report['total_rows_out']} rows out, "
+            f"{_fmt_bytes(report['total_bytes_out'])} out "
+            f"(per-op self time sums to {report['sum_self_s']:.3f}s)")
+    return "\n".join(lines)
 
 
 class Dataset:
@@ -387,23 +471,20 @@ class Dataset:
 
     # ------------------------------------------------------------------ stats
 
-    def stats(self) -> str:
+    def stats(self) -> DatasetStats:
+        """Execute the pipeline in metered mode and return the
+        per-operator report: wall / UDF / backpressure seconds, rows and
+        bytes in/out, block-size envelope, peak store pressure, and
+        actor-pool utilization. The return is a str (the formatted
+        report) carrying the structured dict on ``.to_dict()``."""
         ex = StreamingExecutor(self._ctx)
+        ex.collect_stats = True
+        t0 = time.perf_counter()
         refs = list(ex.execute(self._ops))
         if refs:
             rt.wait(refs, num_returns=len(refs))
-        lines = []
-        for st in ex.stats:
-            rate = st["blocks"] / st["wall_s"] if st["wall_s"] > 0 else 0.0
-            line = (f"{st['operator']}: {st['wall_s']:.3f}s over "
-                    f"{st['blocks']} blocks ({rate:.1f} blocks/s)")
-            if st["peak_store_pressure"] >= 0.005:
-                line += (f", peak store pressure "
-                         f"{st['peak_store_pressure'] * 100:.1f}%")
-            if st.get("retries"):
-                line += f", {st['retries']} retries"
-            lines.append(line)
-        return "\n".join(lines) or "(no stages executed)"
+        report = ex.stats_report(total_wall_s=time.perf_counter() - t0)
+        return DatasetStats(_format_stats(report), report)
 
     def __repr__(self) -> str:
         names = [type(op).__name__ for op in self._ops]

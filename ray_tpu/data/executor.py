@@ -12,6 +12,7 @@ and blocks stream driver-side only as refs (bytes stay in the host store).
 from __future__ import annotations
 
 import collections
+import sys
 import time
 from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
 
@@ -71,6 +72,37 @@ _pressure_gauge = Gauge(
                 "fraction observed while a stage runs, labeled by stage "
                 "(mirrors the per-op peak_store_pressure stat).",
     tag_keys=("stage",))
+
+# Per-operator execution accounting (reference: _StatsActor +
+# OpRuntimeMetrics). These four families are the data-plane face of the
+# cluster TSDB: `rtpu top`'s DATA section and the Grafana data row read
+# exactly these names/tags, so the executor is their single producer.
+_op_blocks_total = Counter(
+    "rtpu_data_operator_blocks_total",
+    description="Streaming data plane: output blocks produced per "
+                "operator (stage label, e.g. read / MapBatches / "
+                "ActorPool[Fn] / RandomShuffle).",
+    tag_keys=("operator",))
+_op_rows_total = Counter(
+    "rtpu_data_operator_rows_total",
+    description="Streaming data plane: rows entering (dir=in) and "
+                "leaving (dir=out) each metered operator; `iter` is the "
+                "driver-side batch iterator.",
+    tag_keys=("operator", "dir"))
+_op_bytes_total = Counter(
+    "rtpu_data_operator_bytes_total",
+    description="Streaming data plane: block bytes entering (dir=in) "
+                "and leaving (dir=out) each metered operator — dir=out "
+                "approximates object-store bytes the operator "
+                "materialized.",
+    tag_keys=("operator", "dir"))
+_op_seconds_total = Counter(
+    "rtpu_data_operator_seconds_total",
+    description="Streaming data plane: per-operator time by phase — "
+                "wall (stage elapsed), udf (inside the user function), "
+                "backpressure (driver blocked at the in-flight cap "
+                "waiting for downstream to drain).",
+    tag_keys=("operator", "phase"))
 
 # Synchronous mirror of the instruments above, for tests and data_bench.
 _FT_COUNTERS: Dict[str, int] = {}
@@ -190,22 +222,48 @@ def _compile_map_stage(ops: List[L.LogicalOp], batch_format_default: str) -> Cal
 
 class _PoolWorker:
     """Actor hosting a callable-class UDF (reference: _MapWorker inside
-    ActorPoolMapOperator, actor_pool_map_operator.py)."""
+    ActorPoolMapOperator, actor_pool_map_operator.py). Every apply feeds
+    a running meter (rows/bytes in and out, UDF seconds) that the stage
+    fetches once at drain time via ``meter()`` — per-block accounting
+    with zero extra round-trips."""
 
     def __init__(self, cls, ctor_args, ctor_kwargs):
+        import threading
+
         self.fn = cls(*ctor_args, **ctor_kwargs)
+        self._meter_lock = threading.Lock()  # max_concurrency=2
+        self._meter = {"udf_s": 0.0, "rows_in": 0, "rows_out": 0,
+                       "bytes_in": 0, "bytes_out": 0, "blocks": 0}
 
     def apply(self, block: Block, batch_format: str, batch_size: Optional[int],
               fn_args, fn_kwargs) -> Block:
         acc = BlockAccessor(block)
         n = acc.num_rows()
+        bytes_in = acc.size_bytes()
+        t0 = time.perf_counter()
         if batch_size is None or batch_size >= n:
-            return block_from_batch(self.fn(acc.to_batch(batch_format), *fn_args, **fn_kwargs))
-        parts = []
-        for s in range(0, n, batch_size):
-            sub = BlockAccessor(acc.slice(s, min(s + batch_size, n)))
-            parts.append(block_from_batch(self.fn(sub.to_batch(batch_format), *fn_args, **fn_kwargs)))
-        return concat_blocks(parts)
+            out = block_from_batch(self.fn(acc.to_batch(batch_format), *fn_args, **fn_kwargs))
+        else:
+            parts = []
+            for s in range(0, n, batch_size):
+                sub = BlockAccessor(acc.slice(s, min(s + batch_size, n)))
+                parts.append(block_from_batch(self.fn(sub.to_batch(batch_format), *fn_args, **fn_kwargs)))
+            out = concat_blocks(parts)
+        udf_s = time.perf_counter() - t0
+        oacc = BlockAccessor(out)
+        with self._meter_lock:
+            m = self._meter
+            m["udf_s"] += udf_s
+            m["rows_in"] += n
+            m["rows_out"] += oacc.num_rows()
+            m["bytes_in"] += bytes_in
+            m["bytes_out"] += oacc.size_bytes()
+            m["blocks"] += 1
+        return out
+
+    def meter(self) -> Dict[str, Any]:
+        with self._meter_lock:
+            return dict(self._meter)
 
 
 # ----------------------------------------------------------------- executor
@@ -214,10 +272,39 @@ class _PoolWorker:
 class StreamingExecutor:
     def __init__(self, ctx: Optional[DataContext] = None):
         self.ctx = ctx or DataContext.get_current()
-        # Per-op execution stats (reference: _StatsActor / DatasetStats):
-        # per-operator wall time, block count, and peak object-store
-        # pressure observed while the stage ran.
-        self.stats: List[Dict[str, Any]] = []
+        # Per-op execution stats (reference: _StatsActor / DatasetStats).
+        # `stats` keeps the per-stage rows the old API exposed, but
+        # bounded (RTPU_DATA_STATS_ROWS): a long-lived executor re-used
+        # across many runs must not grow a row list forever. The
+        # unbounded view is `op_stats`: O(#operators) running aggregates
+        # — wall/udf/backpressure seconds, rows and bytes in/out, block
+        # count and block-size envelope — updated on every record.
+        rows = max(1, int(flags.get("RTPU_DATA_STATS_ROWS")))
+        self.stats: "collections.deque[Dict[str, Any]]" = \
+            collections.deque(maxlen=rows)
+        self.op_stats: Dict[str, Dict[str, Any]] = {}
+        # Dataset.stats() flips this on: task map stages then run a
+        # metered wrapper (rows/bytes/udf seconds shipped back as a
+        # second return) and actor pools fetch their workers' meters at
+        # drain. Off (the default execution path) nothing extra ships.
+        self.collect_stats = False
+
+    @staticmethod
+    def _timed(inputs: Iterator[Any], cell: List[float]) -> Iterator[Any]:
+        """Pass-through iterator accumulating time spent blocked on the
+        upstream stage into cell[0], so a stage can report self-time
+        (wall minus upstream) — per-op walls in a chained generator
+        pipeline otherwise all approximate the end-to-end wall."""
+        it = iter(inputs)
+        while True:
+            t0 = time.perf_counter()
+            try:
+                item = next(it)
+            except StopIteration:
+                cell[0] += time.perf_counter() - t0
+                return
+            cell[0] += time.perf_counter() - t0
+            yield item
 
     # -- public ---------------------------------------------------------------
 
@@ -236,22 +323,55 @@ class StreamingExecutor:
             elif L.is_fusable_map(op):
                 stream = self._task_map_stage(stream, stage)
             elif isinstance(op, L.Repartition):
-                stream = self._repartition(stream, op.num_blocks)
+                cell = [0.0]
+                stream = self._observe(
+                    "Repartition",
+                    self._repartition(self._timed(stream, cell),
+                                      op.num_blocks), cell)
             elif isinstance(op, L.RandomShuffle):
-                stream = self._random_shuffle(stream, op.seed)
+                cell = [0.0]
+                stream = self._observe(
+                    "RandomShuffle",
+                    self._random_shuffle(self._timed(stream, cell),
+                                         op.seed), cell)
             elif isinstance(op, L.Sort):
-                stream = self._sort(stream, op.key, op.descending)
+                cell = [0.0]
+                stream = self._observe(
+                    "Sort",
+                    self._sort(self._timed(stream, cell), op.key,
+                               op.descending), cell)
             elif isinstance(op, L.Limit):
                 stream = self._limit(stream, op.n)
             elif isinstance(op, L.Union):
                 stream = self._union(stream, op.others)
             elif isinstance(op, L.Zip):
-                stream = self._zip(stream, op.other)
+                cell = [0.0]
+                stream = self._observe(
+                    "Zip", self._zip(self._timed(stream, cell), op.other),
+                    cell)
             elif isinstance(op, L.Aggregate):
-                stream = self._aggregate(stream, op)
+                cell = [0.0]
+                stream = self._observe(
+                    "Aggregate",
+                    self._aggregate(self._timed(stream, cell), op), cell)
             else:  # pragma: no cover
                 raise TypeError(f"unknown logical op {op}")
         return stream
+
+    def _observe(self, label: str, inner: Iterator[Any],
+                 upstream_cell: Optional[List[float]] = None) -> Iterator[Any]:
+        """Record wall / block-count / self-time for stages that manage
+        their own submission (the all-to-all exchanges)."""
+        t0 = time.perf_counter()
+        n = 0
+        try:
+            for ref in inner:
+                n += 1
+                yield ref
+        finally:
+            self._record_stat(
+                label, time.perf_counter() - t0, n,
+                upstream_s=upstream_cell[0] if upstream_cell else 0.0)
 
     # -- stages ---------------------------------------------------------------
 
@@ -308,15 +428,51 @@ class StreamingExecutor:
                 opts["num_cpus"] = mb.num_cpus
             if mb.num_tpus:
                 opts["num_tpus"] = mb.num_tpus
-        remote_fn = rt.remote(apply)
-        if opts:
-            remote_fn = remote_fn.options(**opts)
         label = "+".join(type(o).__name__ for o in stage)
-        return self._bounded_submit(
-            (remote_fn.remote(ref) for ref in inputs), label, None
-        )
+        cell = [0.0]
+        timed = self._timed(inputs, cell)
+        if not self.collect_stats:
+            remote_fn = rt.remote(apply)
+            if opts:
+                remote_fn = remote_fn.options(**opts)
+            return self._bounded_submit(
+                (remote_fn.remote(ref) for ref in timed), label, None,
+                upstream_cell=cell)
+
+        # Metered execution (Dataset.stats()): the task returns
+        # (block, meta) — meta is a tiny dict of rows/bytes/udf seconds
+        # measured where the block actually lives. The block ref streams
+        # downstream unchanged; meta refs are resolved at stage end.
+        def metered(block):
+            acc = BlockAccessor(block)
+            rows_in, bytes_in = acc.num_rows(), acc.size_bytes()
+            t0 = time.perf_counter()
+            out = apply(block)
+            udf_s = time.perf_counter() - t0
+            oacc = BlockAccessor(out)
+            return out, {"udf_s": udf_s, "rows_in": rows_in,
+                         "rows_out": oacc.num_rows(), "bytes_in": bytes_in,
+                         "bytes_out": oacc.size_bytes()}
+
+        remote_fn = rt.remote(metered).options(num_returns=2, **opts)
+        metas: List[Any] = []
+
+        def submissions():
+            for ref in timed:
+                block_ref, meta_ref = remote_fn.remote(ref)
+                metas.append(meta_ref)
+                yield block_ref
+
+        return self._bounded_submit(submissions(), label, None,
+                                    upstream_cell=cell, metas=metas)
 
     _PRESSURE_TTL_S = 0.05
+
+    # Aggregate fields summed across records; everything else in an
+    # extra dict overwrites (gauges like utilization / actor counts).
+    _SUM_FIELDS = ("wall_s", "blocks", "upstream_s", "backpressure_s",
+                   "udf_s", "rows_in", "rows_out", "bytes_in", "bytes_out",
+                   "retries")
 
     def _record_stat(self, label: str, wall_s: float, blocks: int,
                      peak_pressure: float = 0.0, **extra: Any) -> None:
@@ -324,6 +480,93 @@ class StreamingExecutor:
                "peak_store_pressure": peak_pressure}
         row.update(extra)
         self.stats.append(row)
+        agg = self.op_stats.setdefault(label, {
+            "operator": label, "wall_s": 0.0, "self_s": 0.0,
+            "upstream_s": 0.0, "udf_s": 0.0, "backpressure_s": 0.0,
+            "blocks": 0, "rows_in": 0, "rows_out": 0,
+            "bytes_in": 0, "bytes_out": 0, "retries": 0,
+            "peak_store_pressure": 0.0, "records": 0,
+            "block_bytes": {"count": 0, "sum": 0, "min": None, "max": 0},
+        })
+        agg["records"] += 1
+        agg["wall_s"] += wall_s
+        agg["blocks"] += blocks
+        agg["peak_store_pressure"] = max(agg["peak_store_pressure"],
+                                         peak_pressure)
+        for k in self._SUM_FIELDS[2:]:
+            v = extra.get(k)
+            if v:
+                agg[k] += v
+        agg["self_s"] = max(0.0, agg["wall_s"] - agg["upstream_s"])
+        for k, v in extra.items():
+            if k not in self._SUM_FIELDS and k != "block_bytes":
+                agg[k] = v
+        bb = extra.get("block_bytes")
+        if bb and bb.get("count"):
+            dist = agg["block_bytes"]
+            dist["count"] += bb["count"]
+            dist["sum"] += bb["sum"]
+            dist["max"] = max(dist["max"], bb["max"])
+            dist["min"] = bb["min"] if dist["min"] is None \
+                else min(dist["min"], bb["min"])
+        self._export_stat(label, wall_s, blocks, extra)
+
+    @staticmethod
+    def _export_stat(label: str, wall_s: float, blocks: int,
+                     extra: Dict[str, Any]) -> None:
+        """Stream the recorded row into the rtpu_data_operator_* TSDB
+        families (one inc per stage record, not per block)."""
+        try:
+            _op_seconds_total.inc(wall_s, tags={"operator": label,
+                                                "phase": "wall"})
+            if blocks:
+                _op_blocks_total.inc(float(blocks),
+                                     tags={"operator": label})
+            for phase in ("udf", "backpressure"):
+                v = extra.get(f"{phase}_s")
+                if v:
+                    _op_seconds_total.inc(v, tags={"operator": label,
+                                                   "phase": phase})
+            for d in ("in", "out"):
+                r = extra.get(f"rows_{d}")
+                if r:
+                    _op_rows_total.inc(float(r), tags={"operator": label,
+                                                       "dir": d})
+                b = extra.get(f"bytes_{d}")
+                if b:
+                    _op_bytes_total.inc(float(b), tags={"operator": label,
+                                                        "dir": d})
+        except Exception:
+            pass  # metrics export never fails a stage
+
+    def stats_report(self, total_wall_s: Optional[float] = None) -> Dict[str, Any]:
+        """Structured per-operator report from the running aggregates
+        (reference: DatasetStats.to_summary()). Ordered by first
+        execution; block_bytes carries the mean alongside min/max."""
+        ops = []
+        for agg in self.op_stats.values():
+            row = dict(agg)
+            dist = dict(row["block_bytes"])
+            dist["mean"] = (dist["sum"] / dist["count"]) if dist["count"] \
+                else 0
+            row["block_bytes"] = dist
+            # dir=out bytes are what this operator materialized into the
+            # object store — the census-facing holding figure.
+            row["store_bytes_out"] = row["bytes_out"]
+            ops.append(row)
+        # Rows/bytes out of the pipeline = the LAST operator that metered
+        # them (all-to-all exchanges record wall/blocks but not rows).
+        metered = [o for o in ops if o["rows_out"] or o["bytes_out"]]
+        tail = metered[-1] if metered else None
+        report: Dict[str, Any] = {
+            "operators": ops,
+            "total_rows_out": tail["rows_out"] if tail else 0,
+            "total_bytes_out": tail["bytes_out"] if tail else 0,
+            "sum_self_s": round(sum(o["self_s"] for o in ops), 6),
+        }
+        if total_wall_s is not None:
+            report["total_wall_s"] = total_wall_s
+        return report
 
     def _store_pressure(self) -> float:
         """Local object-store arena fill fraction (0.0 when no native arena
@@ -378,19 +621,42 @@ class StreamingExecutor:
         return ref
 
     def _bounded_submit(self, submissions: Iterator[Any], label: str,
-                        total: Optional[int]) -> Iterator[Any]:
+                        total: Optional[int],
+                        upstream_cell: Optional[List[float]] = None,
+                        metas: Optional[List[Any]] = None) -> Iterator[Any]:
         """Cap in-flight tasks; yield refs in submission (FIFO) order when
         preserve_order else completion order. The cap is concurrency-based
         normally and shrinks under object-store memory pressure (see
         DataContext.memory_high_water) so block production stays bounded by
-        downstream consumption, not by spilling capacity."""
+        downstream consumption, not by spilling capacity.
+
+        The at-cap waits in the submission loop are the operator's
+        backpressure: the driver wants to submit more but must first
+        drain a completed block downstream. They are timed separately
+        from the tail drain (which is completion latency, not pressure).
+        """
         base_cap = self.ctx.max_tasks_in_flight
         high_water = self.ctx.memory_high_water
+        progress_s = float(flags.get("RTPU_DATA_PROGRESS_S")) \
+            if flags.get("RTPU_DATA_PROGRESS") else 0.0
         t0 = time.perf_counter()
+        last_progress = t0
         n = 0
+        backpressure_s = 0.0
         peak_pressure = 0.0
         pending: List[Any] = []
         preserve = self.ctx.preserve_order
+
+        def progress() -> None:
+            nonlocal last_progress
+            now = time.perf_counter()
+            if progress_s and now - last_progress >= progress_s:
+                last_progress = now
+                elapsed = max(1e-9, now - t0)
+                print(f"[data] {label}: {n} blocks out, "
+                      f"{len(pending)} in flight, {elapsed:.0f}s elapsed "
+                      f"({n / elapsed:.1f} blocks/s)", file=sys.stderr)
+
         try:
             for ref in submissions:
                 pending.append(ref)
@@ -402,13 +668,16 @@ class StreamingExecutor:
                 if high_water and pressure >= high_water:
                     cap = min(base_cap, max(1, self.ctx.memory_pressure_cap))
                 while len(pending) >= cap:
+                    tw = time.perf_counter()
                     if preserve:
                         out, pending = pending[0], pending[1:]
                         rt.wait([out], num_returns=1)
                     else:
                         ready, pending = rt.wait(pending, num_returns=1)
                         out = ready[0]
+                    backpressure_s += time.perf_counter() - tw
                     n += 1
+                    progress()
                     yield out
             while pending:
                 if preserve:
@@ -423,13 +692,52 @@ class StreamingExecutor:
                     peak_pressure = max(peak_pressure,
                                         self._store_pressure())
                 n += 1
+                progress()
                 yield out
         finally:
             # finally, not fallthrough: a downstream stage that stops
             # pulling early (Limit) raises GeneratorExit here — the stage
             # still ran and must still report.
+            extra: Dict[str, Any] = {
+                "backpressure_s": backpressure_s,
+                "upstream_s": upstream_cell[0] if upstream_cell else 0.0,
+            }
+            if metas is not None:
+                extra.update(self._resolve_metas(metas))
             self._record_stat(label, time.perf_counter() - t0, n,
-                              peak_pressure=peak_pressure)
+                              peak_pressure=peak_pressure, **extra)
+
+    @staticmethod
+    def _resolve_metas(metas: List[Any]) -> Dict[str, Any]:
+        """Sum the per-block meter dicts shipped back by metered map
+        tasks. Only already-finished metas are fetched (short wait):
+        an early-stopped stage (Limit) must not block its own teardown
+        on stragglers, and a block whose task raised is simply absent
+        from the accounting."""
+        out = {"udf_s": 0.0, "rows_in": 0, "rows_out": 0,
+               "bytes_in": 0, "bytes_out": 0}
+        dist = {"count": 0, "sum": 0, "min": None, "max": 0}
+        if not metas:
+            out["block_bytes"] = dist
+            return out
+        try:
+            ready, _ = rt.wait(metas, num_returns=len(metas), timeout=2.0)
+        except Exception:
+            ready = []
+        for ref in ready:
+            try:
+                m = rt.get(ref)
+            except Exception:
+                continue
+            for k in out:
+                out[k] += m.get(k, 0)
+            b = m.get("bytes_out", 0)
+            dist["count"] += 1
+            dist["sum"] += b
+            dist["max"] = max(dist["max"], b)
+            dist["min"] = b if dist["min"] is None else min(dist["min"], b)
+        out["block_bytes"] = dist
+        return out
 
     def _actor_pool_stage(self, inputs: Iterator[Any], op: L.MapBatches) -> Iterator[Any]:
         """Fixed/bounded actor pool (reference: ActorPoolMapOperator + _ActorPool
@@ -468,9 +776,18 @@ class StreamingExecutor:
         label = f"ActorPool[{getattr(op.fn, '__name__', type(op.fn).__name__)}]"
         fmt = op.batch_format or self.ctx.default_batch_format
         preserve = self.ctx.preserve_order
+        progress_s = float(flags.get("RTPU_DATA_PROGRESS_S")) \
+            if flags.get("RTPU_DATA_PROGRESS") else 0.0
+        upstream_cell = [0.0]
+        inputs = self._timed(inputs, upstream_cell)
         t0 = time.perf_counter()
+        last_progress = t0
         n = 0
         retries = 0
+        backpressure_s = 0.0
+        # At-cap waits in the submission loop are backpressure; the tail
+        # drain after inputs are exhausted is completion latency.
+        in_submit = [True]
         per_actor_cap = 2
 
         def spawn() -> Any:
@@ -604,9 +921,21 @@ class StreamingExecutor:
                         except Exception:
                             pass
 
+        def progress() -> None:
+            nonlocal last_progress
+            now = time.perf_counter()
+            if progress_s and now - last_progress >= progress_s:
+                last_progress = now
+                elapsed = max(1e-9, now - t0)
+                print(f"[data] {label}: {n} blocks out, "
+                      f"{len(inflight)} in flight on {len(actors)} actors, "
+                      f"{elapsed:.0f}s elapsed ({n / elapsed:.1f} blocks/s)",
+                      file=sys.stderr)
+
         def drain_one() -> Any:
-            nonlocal n, retries
+            nonlocal n, retries, backpressure_s
             while True:
+                tw = time.perf_counter()
                 if preserve:
                     entry = inflight.pop(0)
                     rt.wait([entry["ref"]], num_returns=1)
@@ -620,6 +949,8 @@ class StreamingExecutor:
                     idx = next(j for j, e in enumerate(inflight)
                                if e["ref"].object_id == rid)
                     entry = inflight.pop(idx)
+                if in_submit[0]:
+                    backpressure_s += time.perf_counter() - tw
                 err = rt.error_of(entry["ref"]) if ft else None
                 if err is None or not isinstance(
                         err, (ActorDiedError, WorkerCrashedError,
@@ -629,6 +960,7 @@ class StreamingExecutor:
                     settle(entry)
                     n += 1
                     note_inflight()
+                    progress()
                     return entry["ref"]
                 # Typed system death on the in-flight ref.
                 preempted = _died_preempted(entry, err)
@@ -660,9 +992,44 @@ class StreamingExecutor:
                 while len(inflight) >= per_actor_cap * len(actors):
                     yield drain_one()
                 submit(ref)
+            in_submit[0] = False
             while inflight:
                 yield drain_one()
         finally:
+            extra: Dict[str, Any] = {
+                "retries": retries,
+                "backpressure_s": backpressure_s,
+                "upstream_s": upstream_cell[0],
+            }
+            if self.collect_stats:
+                # Fetch each live worker's running meter before the pool
+                # is torn down; meters on already-replaced (dead) actors
+                # are simply absent from the accounting.
+                meter = {"udf_s": 0.0, "rows_in": 0, "rows_out": 0,
+                         "bytes_in": 0, "bytes_out": 0, "blocks": 0}
+                metered = 0
+                for a in actors:
+                    try:
+                        m = rt.get(a.meter.remote(), timeout=5.0)
+                    except Exception:
+                        continue
+                    metered += 1
+                    for k in meter:
+                        meter[k] += m.get(k, 0)
+                wall = max(1e-9, time.perf_counter() - t0)
+                blocks_done = meter.pop("blocks")
+                extra.update(meter)
+                extra["block_bytes"] = {
+                    "count": blocks_done, "sum": meter["bytes_out"],
+                    "min": None, "max": 0}
+                extra["actor_pool"] = {
+                    "actors": len(actors),
+                    "metered": metered,
+                    # busy fraction: summed UDF seconds over the pool's
+                    # aggregate wall-clock capacity.
+                    "utilization": round(
+                        meter["udf_s"] / (wall * max(1, len(actors))), 4),
+                }
             for a in actors:
                 try:
                     rt.kill(a)
@@ -673,8 +1040,7 @@ class StreamingExecutor:
                     rt.kill(old)
                 except Exception:
                     pass
-            self._record_stat(label, time.perf_counter() - t0, n,
-                              retries=retries)
+            self._record_stat(label, time.perf_counter() - t0, n, **extra)
 
     # -- all-to-all -----------------------------------------------------------
 

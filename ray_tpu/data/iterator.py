@@ -78,10 +78,68 @@ class IngestCursor:
                                cloudpickle.dumps(self.state))
 
 
+def _batch_rows(batch: Any) -> int:
+    try:
+        if isinstance(batch, dict):
+            return len(next(iter(batch.values()))) if batch else 0
+        if hasattr(batch, "num_rows"):       # arrow table
+            nr = batch.num_rows
+            return nr() if callable(nr) else nr
+        return len(batch)                    # pandas frame
+    except Exception:
+        return 0
+
+
 def batch_stream(refs: Iterator[Any], batch_size: Optional[int], batch_format: str,
                  drop_last: bool, shuffle_buffer: Optional[int],
                  shuffle_seed: Optional[int],
                  cursor: Optional[IngestCursor] = None) -> Iterator[Any]:
+    """Re-chunk a stream of block refs into fixed-size batches, metered
+    into the operator TSDB families under operator="iter" (the
+    driver-side consumption edge of the pipeline) and, with
+    RTPU_DATA_PROGRESS, narrated to stderr every RTPU_DATA_PROGRESS_S.
+    """
+    from ray_tpu import flags
+
+    from .executor import _op_rows_total, _op_seconds_total
+
+    progress_s = float(flags.get("RTPU_DATA_PROGRESS_S")) \
+        if flags.get("RTPU_DATA_PROGRESS") else 0.0
+    inner = _batch_stream_impl(refs, batch_size, batch_format, drop_last,
+                               shuffle_buffer, shuffle_seed, cursor)
+    t0 = time.perf_counter()
+    last_progress = t0
+    batches = 0
+    rows = 0
+    try:
+        for batch in inner:
+            batches += 1
+            rows += _batch_rows(batch)
+            yield batch
+            if progress_s:
+                now = time.perf_counter()
+                if now - last_progress >= progress_s:
+                    last_progress = now
+                    elapsed = max(1e-9, now - t0)
+                    import sys
+
+                    print(f"[data] iter: {batches} batches, {rows} rows "
+                          f"({rows / elapsed:.0f} rows/s)", file=sys.stderr)
+    finally:
+        try:
+            _op_seconds_total.inc(time.perf_counter() - t0,
+                                  tags={"operator": "iter", "phase": "wall"})
+            if rows:
+                _op_rows_total.inc(float(rows),
+                                   tags={"operator": "iter", "dir": "out"})
+        except Exception:
+            pass
+
+
+def _batch_stream_impl(refs: Iterator[Any], batch_size: Optional[int], batch_format: str,
+                       drop_last: bool, shuffle_buffer: Optional[int],
+                       shuffle_seed: Optional[int],
+                       cursor: Optional[IngestCursor] = None) -> Iterator[Any]:
     """Re-chunk a stream of block refs into fixed-size batches.
 
     With a `cursor`, journal progress at block-pull boundaries and resume

@@ -493,6 +493,46 @@ _define("RTPU_PROFILER", bool, True,
         "dependency). 0 rejects profile requests; workers never sample.")
 _define("RTPU_PROFILER_HZ", float, 67.0,
         "Default sampling frequency of the wall-clock profiler.")
+_define("RTPU_CALLSITE", bool, False,
+        "Record the creating Python callsite (file:line) of every owned "
+        "object ref in the ownership census (reference: "
+        "RAY_record_ref_creation_sites). Adds a stack walk per put/task "
+        "submission, so it is off by default and perf-guarded; enable "
+        "when hunting a leak so `rtpu memory --group-by callsite` can "
+        "name the allocating line.")
+_define("RTPU_CENSUS", bool, True,
+        "Cluster object census (`rtpu memory`, state.summarize_objects, "
+        "the dashboard /objects page): each process's ownership table "
+        "records owner/size/tier/pins per ref and answers the "
+        "controller's object_census fan-out. 0 skips all per-ref census "
+        "bookkeeping (the ref hot path pays one flag check) and census "
+        "RPCs report disabled.")
+_define("RTPU_CENSUS_TIMEOUT_S", float, 2.0,
+        "Deadline for the object_census worker fan-out; shards that miss "
+        "it (dead or wedged processes) are reported as per-shard error "
+        "strings while survivors' totals still aggregate.")
+_define("RTPU_LEAK_WATCHDOG", bool, True,
+        "Leak watchdog (needs RTPU_EVENTS): periodically flags directory "
+        "objects older than RTPU_LEAK_AGE_S whose owning process is dead "
+        "or unreachable with an OBJECT_LEAK_SUSPECT event (once per "
+        "object). 0 disables the sweep entirely.")
+_define("RTPU_LEAK_AGE_S", float, 300.0,
+        "Minimum age before an object with a dead/unreachable owner is "
+        "flagged as OBJECT_LEAK_SUSPECT.")
+_define("RTPU_LEAK_POLL_S", float, 10.0,
+        "Leak-watchdog sweep period.")
+_define("RTPU_DATA_PROGRESS", bool, False,
+        "Per-operator progress lines from the streaming data executor "
+        "(one stderr line per operator every RTPU_DATA_PROGRESS_S while "
+        "a stage runs, reference: Ray Data's ProgressBar rows). Off by "
+        "default: interactive use only.")
+_define("RTPU_DATA_PROGRESS_S", float, 5.0,
+        "Seconds between data-executor progress lines when "
+        "RTPU_DATA_PROGRESS is on.")
+_define("RTPU_DATA_STATS_ROWS", int, 256,
+        "Per-operator bound on retained per-batch stat rows in the "
+        "streaming executor (bounded deque + running aggregates keep "
+        "Dataset.stats() O(1) memory on long streams).")
 
 # -- serve: deadlines, admission control, circuit breaking -------------------
 _define("RTPU_SERVE_ADMISSION", bool, True,

@@ -61,6 +61,31 @@ def list_placement_groups(limit: int = 1000) -> List[Dict[str, Any]]:
                  "limit": limit})
 
 
+def summarize_objects(*, min_size: int = 0, limit: int = 1000,
+                      timeout: Optional[float] = None) -> Dict[str, Any]:
+    """Cluster object census (reference: `ray summary objects` /
+    `ray memory`'s grouped views). Returns the aggregated census dict:
+
+    - ``objects``: per-object rows (size/tier/node/owner/pins/age,
+      callsite when RTPU_CALLSITE is on), largest first, ``min_size``
+      filtered and capped at ``limit``;
+    - ``groups``: {owner|tier|node|callsite: {key: {bytes, count,
+      tiers}}} computed over ALL rows before truncation;
+    - ``errors``: one string per shard that never answered (dead or
+      unreachable workers) — partial totals from survivors are still
+      returned;
+    - ``arenas``/``spill``: per-node ground truth for cross-checking
+      attribution.
+
+    The calling process's own ownership shard ships with the request so
+    driver-owned refs are attributed too."""
+    from ray_tpu.core import ownership
+
+    return _req({"kind": "object_census", "min_size": min_size,
+                 "limit": limit, "timeout": timeout,
+                 "shard": ownership.census_shard()})
+
+
 def profile_workers(timeout: float = 2.0) -> Dict[str, Any]:
     """On-demand all-thread stack dump from every live worker (reference:
     dashboard reporter's py-spy stack capture, `ray stack`). Returns

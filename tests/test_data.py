@@ -282,6 +282,62 @@ def test_dataset_stats_per_op():
     assert "read:" in out2 and "MapBatches:" in out2
 
 
+def test_dataset_stats_structured_report():
+    """stats() is a str for display but also carries the full per-operator
+    report (to_dict): wall/udf time, rows+bytes in/out, block sizes,
+    backpressure wait — and per-op self time accounts for the e2e wall."""
+    import time as _time
+
+    def slow(batch):
+        _time.sleep(0.02)
+        return batch
+
+    ds = rd.range(64, parallelism=8).map_batches(slow).random_shuffle(seed=7)
+    stats = ds.stats()
+    report = stats.to_dict()
+    ops = {o["operator"]: o for o in report["operators"]}
+    assert set(ops) >= {"read", "MapBatches", "RandomShuffle"}, set(ops)
+    for o in report["operators"]:
+        for key in ("wall_s", "self_s", "blocks", "backpressure_s",
+                    "rows_in", "rows_out", "bytes_in", "bytes_out",
+                    "block_bytes"):
+            assert key in o, (o["operator"], key)
+        assert o["wall_s"] >= 0 and o["blocks"] >= 1
+    m = ops["MapBatches"]
+    assert m["rows_out"] == 64 and m["bytes_out"] > 0
+    assert m["udf_s"] >= 8 * 0.02 * 0.5  # the sleeps are attributed to UDF
+    assert m["block_bytes"]["count"] == m["blocks"]
+    assert m["block_bytes"]["max"] >= m["block_bytes"]["min"] > 0
+    # Acceptance: per-op self time sums to ~the end-to-end wall (stage
+    # walls all overlap; self = wall minus time blocked on upstream).
+    total = report["total_wall_s"]
+    assert total > 0
+    assert 0.5 * total <= report["sum_self_s"] <= 1.10 * total, report
+    assert report["total_rows_out"] == 64
+    # The formatted view renders the same report.
+    assert "rows" in stats and "backpressure" not in ops  # sanity: str ops
+    assert str(stats).count("\n") > 3
+
+
+def test_dataset_stats_actor_pool_utilization():
+    """ActorPool stages report pool size and busy fraction from the
+    in-actor UDF meter."""
+    class Double:
+        def __call__(self, batch):
+            batch["id"] = batch["id"] * 2
+            return batch
+
+    ds = rd.range(32, parallelism=4).map_batches(Double, concurrency=2)
+    stats = ds.stats()
+    pool = next(o for o in stats.operators
+                if o["operator"].startswith("ActorPool["))
+    ap = pool.get("actor_pool")
+    assert ap and ap["actors"] == 2, pool
+    assert 0.0 <= ap["utilization"] <= 1.0
+    assert pool["rows_out"] == 32 and pool["bytes_out"] > 0
+    assert "busy" in str(stats)
+
+
 def test_from_huggingface(ray_start_regular):
     """HF arrow backing slices into blocks zero-copy (reference:
     read_api.py:2664); DatasetDict must be split-indexed first."""

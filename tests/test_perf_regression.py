@@ -710,6 +710,54 @@ def test_data_pipeline_healthy_throughput_floor(ray_start_regular):
         f"healthy data pipeline {n/dt:.0f} rows/s below floor"
 
 
+def test_callsite_capture_disabled_path_overhead(ray_start_regular,
+                                                 monkeypatch):
+    """Census-callsite guard (mirrors the RTPU_TASK_EVENTS guard): with
+    RTPU_CALLSITE=0 (the default) claiming ownership of a result pays one
+    flag check — no frame walk, no callsite table write — so the task
+    round-trip holds the same throughput floor as the plain benchmark."""
+    monkeypatch.setenv("RTPU_CALLSITE", "0")
+
+    @ray_tpu.remote
+    def nop():
+        return None
+
+    ray_tpu.get([nop.remote() for _ in range(8)])  # warm the pool
+    t0 = time.perf_counter()
+    ray_tpu.get([nop.remote() for _ in range(200)])
+    dt = time.perf_counter() - t0
+    assert 200 / dt > 30, \
+        f"callsite-disabled task throughput {200/dt:.0f}/s below floor"
+
+
+def test_census_disabled_path_overhead(ray_start_regular, monkeypatch):
+    """Object-census guard: with RTPU_CENSUS=0 the census RPC answers
+    with one flag check (no fan-out, no shard merge) and the ownership
+    table keeps exactly its pre-census hot path — the task round-trip
+    holds the same throughput floor, and a disabled census request
+    returns immediately instead of waiting out the shard timeout."""
+    monkeypatch.setenv("RTPU_CENSUS", "0")
+
+    @ray_tpu.remote
+    def nop():
+        return None
+
+    ray_tpu.get([nop.remote() for _ in range(8)])  # warm the pool
+    t0 = time.perf_counter()
+    ray_tpu.get([nop.remote() for _ in range(200)])
+    dt = time.perf_counter() - t0
+    assert 200 / dt > 30, \
+        f"census-disabled task throughput {200/dt:.0f}/s below floor"
+
+    from ray_tpu.util import state
+
+    t0 = time.perf_counter()
+    s = state.summarize_objects()
+    dt = time.perf_counter() - t0
+    assert s["enabled"] is False and s["errors"]
+    assert dt < 2.0, f"disabled census RPC took {dt:.1f}s"
+
+
 @pytest.mark.slow
 def test_data_bench_smoke(tmp_path):
     """The data-plane benchmark's --smoke profile must run end to end,
