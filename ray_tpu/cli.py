@@ -224,6 +224,11 @@ def _fmt_event(ev, stacks: bool = False) -> str:
     line = (f"[{t}] {ev.get('severity', 'INFO'):7} "
             f"{ev.get('kind', '?'):22} {ev.get('message', '')}"
             + (f"  ({ids})" if ids else ""))
+    # DAG recoveries carry the structured cause (`rtpu events --kind
+    # DAG_RECOVERED` answers "what killed it last time" directly).
+    cause = (ev.get("data") or {}).get("cause")
+    if cause:
+        line += f"  cause={cause}"
     stack = (ev.get("data") or {}).get("stack")
     if stacks and stack:
         indented = "\n".join("    " + ln for ln in stack.splitlines())
@@ -461,6 +466,36 @@ def _top_frame(window: float = 120.0, spark_points: int = 30) -> str:
                 f"{_fmt_bytes(byt.get(op, 0)):>10} "
                 f"{wall.get(op, 0):>7.1f}s {udf.get(op, 0):>7.1f}s "
                 f"{bp.get(op, 0):>7.1f}s")
+    # Channel plane: compiled DAGs whose steady-state dispatch bypasses
+    # the controller entirely — steps/s, recovery state and the
+    # bottleneck verdict come from the channel meter's rollup
+    # (`rtpu dag stats` has the full stages×edges view).
+    try:
+        dag_rows = ctx.get_worker_context().client.request(
+            {"kind": "list_state", "what": "dags", "limit": 100})
+    except Exception:
+        dag_rows = []
+    if dag_rows:
+        lines.append("")
+        lines.append(f"{'COMPILED DAG':14} {'STAGES':>6} {'DEPTH':>6} "
+                     f"{'STEPS/S':>8} {'RECOV':>6}  BOTTLENECK")
+        for d in sorted(dag_rows, key=lambda d: d["dag_id"]):
+            methods = {f"s{s.get('idx')}": s.get("method", "")
+                       for s in d.get("stages") or ()}
+            bn = d.get("bottleneck")
+            verdict = (f"{bn} {methods.get(bn, '')}".strip()
+                       if bn else "-")
+            recov = str(d.get("recoveries", 0))
+            if d.get("recovering"):
+                recov += "*"
+                verdict = "(recovering)"
+            sps = d.get("steps_per_s")
+            lines.append(
+                f"{d['dag_id'][:12]:14} "
+                f"{len(d.get('stages') or ()):>6} "
+                f"{d.get('depth', 0):>6} "
+                + (f"{sps:>8.1f}" if sps is not None else f"{'-':>8}")
+                + f" {recov:>6}  {verdict}")
     lines.append("")
     try:
         events = state_api.list_events(limit=6)
@@ -472,6 +507,131 @@ def _top_frame(window: float = 120.0, spark_points: int = 30) -> str:
     if not events:
         lines.append("  (none)")
     return "\n".join(lines)
+
+
+def _bar(frac, width: int = 10) -> str:
+    """Fixed-width busy bar for the `rtpu dag stats` phase cells."""
+    frac = max(0.0, min(1.0, float(frac or 0.0)))
+    n = int(round(frac * width))
+    return "█" * n + "·" * (width - n)
+
+
+def _render_dag_stats(rows, state_api) -> str:
+    """One `rtpu dag stats` frame: per compiled DAG, a stages×phases busy
+    table (recv=starved / compute / send bars from the channel meter's
+    busy-fraction gauges), a per-edge ring table (items/bytes/occupancy/
+    lag/writer-blocked), and THE bottleneck verdict
+    (dag.meter.attribute_bottleneck, computed controller-side)."""
+    if not rows:
+        return ("no compiled DAGs registered "
+                "(compile a pipeline with ray_tpu.dag.compile first)")
+    # Per-stage steps/s from the telemetry ring; one query covers every
+    # DAG (tags carry dag+stage).
+    stage_rate = {}
+    try:
+        resp = state_api.query_metrics(name="rtpu_dag_stage_steps_total")
+        for ser in (resp.get("series") or ()) if resp.get("enabled") else ():
+            pts = ser.get("points") or ()
+            if pts:
+                tg = ser["tags"]
+                stage_rate[(tg.get("dag"), tg.get("stage"))] = pts[-1][1]
+    except Exception:
+        pass
+    lines = []
+    for d in rows:
+        short = d["dag_id"][:12]
+        busy = d.get("stage_busy") or {}
+        edges = d.get("edge_stats") or {}
+        bn = d.get("bottleneck")
+        methods = {f"s{s.get('idx')}": s.get("method", "")
+                   for s in d.get("stages") or ()}
+        recov = str(d.get("recoveries", 0))
+        if d.get("recovering"):
+            recov += "*"
+        sps = d.get("steps_per_s")
+        lines.append(
+            f"DAG {short}  stages {len(d.get('stages') or ())}  "
+            f"depth {d.get('depth', 0)}  recoveries {recov}  "
+            + (f"steps/s {sps:.1f}" if sps is not None else "steps/s -"))
+        if bn is not None:
+            b = busy.get(bn) or {}
+            score = b.get("compute", 0.0) + b.get("send", 0.0)
+            lines.append(
+                f"  bottleneck: {bn} {methods.get(bn, '')} "
+                f"(compute+send {score * 100:.0f}% of wall — this stage "
+                f"bounds throughput; starved stages are its victims)")
+        else:
+            lines.append(
+                "  (no meter samples yet — RTPU_DAG_METER=0, or the "
+                "pipeline has not stepped since the last metrics flush)")
+        if busy:
+            lines.append(f"  {'STAGE':6} {'METHOD':16} {'STEPS/S':>8}  "
+                         f"{'RECV(STARVED)':16} {'COMPUTE':16} "
+                         f"{'SEND':16}")
+            for stage in sorted(busy):
+                ph = busy[stage]
+                r = stage_rate.get((short, stage))
+                cells = " ".join(
+                    f"{_bar(ph.get(p, 0.0))} {ph.get(p, 0.0) * 100:>3.0f}%"
+                    for p in ("recv", "compute", "send"))
+                mark = "  << bottleneck" if stage == bn else ""
+                lines.append(
+                    f"  {stage:6} {methods.get(stage, '?')[:16]:16} "
+                    + (f"{r:>8.1f}" if r is not None else f"{'-':>8}")
+                    + f"  {cells}{mark}")
+        if edges:
+            kinds = d.get("edges") or {}
+            lines.append(f"  {'EDGE':6} {'KIND':7} {'ITEMS':>10} "
+                         f"{'BYTES':>10} {'OCC':>5} {'LAG':>5}  "
+                         f"WRITER-BLOCKED")
+            for eid in sorted(edges):
+                e = edges[eid]
+                bf = e.get("blocked_fraction", 0.0)
+                lines.append(
+                    f"  {eid:6} {str(kinds.get(eid, '?'))[:7]:7} "
+                    f"{e.get('items', 0):>10.0f} "
+                    f"{_fmt_bytes(e.get('bytes', 0)):>10} "
+                    f"{e.get('occupancy', 0):>5.0f} "
+                    f"{e.get('lag', 0):>5.0f}  {_bar(bf)} {bf * 100:.0f}%")
+        lines.append("")
+    return "\n".join(lines).rstrip()
+
+
+def cmd_dag(args) -> int:
+    """`rtpu dag stats [DAG] [--watch]` / `rtpu dag timeline`: the
+    channel-meter consumers. Stats renders the stages×edges busy view
+    with the bottleneck verdict; timeline writes the per-step chrome
+    trace (state.dag_timeline) for chrome://tracing / Perfetto."""
+    rt = _connect(args)
+    from ray_tpu.util import state as state_api
+
+    try:
+        if args.dag_cmd == "timeline":
+            state_api.dag_timeline(args.out, dag=args.dag)
+            print(f"wrote {args.out} (open in chrome://tracing or "
+                  f"ui.perfetto.dev)")
+            return 0
+
+        def frame() -> str:
+            rows = state_api.list_compiled_dags()
+            if args.dag:
+                rows = [r for r in rows
+                        if r["dag_id"].startswith(args.dag)]
+                if not rows:
+                    return f"no compiled DAG matches {args.dag!r}"
+            return _render_dag_stats(rows, state_api)
+
+        if args.watch:
+            while True:
+                sys.stdout.write("\x1b[2J\x1b[H" + frame() + "\n")
+                sys.stdout.flush()
+                time.sleep(args.interval)
+        print(frame())
+        return 0
+    except KeyboardInterrupt:
+        return 0
+    finally:
+        rt.shutdown()
 
 
 def cmd_top(args) -> int:
@@ -962,6 +1122,29 @@ def main(argv=None) -> int:
     p.add_argument("--timeout", type=float, default=2.0,
                    help="seconds to wait for worker replies")
     p.set_defaults(fn=cmd_stack)
+
+    p = sub.add_parser("dag", help="compiled-DAG observability: per-edge "
+                                   "ring telemetry, stage phase "
+                                   "accounting, bottleneck attribution")
+    dsub = p.add_subparsers(dest="dag_cmd", required=True)
+    ds = dsub.add_parser("stats", help="stages×edges busy/starved/blocked "
+                                       "view + bottleneck verdict")
+    ds.add_argument("dag", nargs="?", default=None,
+                    help="dag id (or prefix); default: every compiled DAG")
+    ds.add_argument("--address", default=None)
+    ds.add_argument("--watch", "-w", action="store_true",
+                    help="refresh in place (ctrl-c to stop)")
+    ds.add_argument("--interval", type=float, default=2.0,
+                    help="refresh period seconds with --watch")
+    ds.set_defaults(fn=cmd_dag)
+    dt = dsub.add_parser("timeline",
+                         help="chrome-trace of per-stage steps with "
+                              "recv/compute/send/blocked sub-slices")
+    dt.add_argument("dag", nargs="?", default=None,
+                    help="dag id (or prefix); default: every compiled DAG")
+    dt.add_argument("--address", default=None)
+    dt.add_argument("--out", default="dag_timeline.json")
+    dt.set_defaults(fn=cmd_dag)
 
     p = sub.add_parser("top", help="live cluster view: nodes, task "
                                    "rates/p99 with sparkline history, "

@@ -241,6 +241,10 @@ class NodeInfo:
     # Host-wide spill usage {files, bytes} (agent heartbeats; local nodes
     # sample at metrics/census time) — census "spill" tier + `rtpu status`.
     spill_stats: Dict[str, int] = field(default_factory=dict)
+    # Channel-fabric footprint {segments, bytes}: live rtpu_ch_* shm rings
+    # on the host (agent heartbeats; local nodes scan at cluster_state
+    # time) — the node-level view of the compiled-DAG channel plane.
+    channel_stats: Dict[str, int] = field(default_factory=dict)
 
 
 @dataclass
@@ -2792,12 +2796,18 @@ class Controller:
                 f"compiled DAG {dag_id[:8]}: quiescing survivors, "
                 f"restarting {short}, rebuilding affected channels")
         elif phase == "recovered":
+            # data= carries the structured cause so `rtpu events --kind
+            # DAG_RECOVERED` can surface last_cause without parsing the
+            # human message.
             self._emit_event(
                 "INFO", "DAG_RECOVERED",
                 f"compiled DAG {dag_id[:8]}: recovered from {cause} in "
                 f"{float(msg.get('duration_s', 0.0)):.2f}s "
                 f"(stage actor(s) {short} restarted, channels rebuilt, "
-                f"retained items replayed)")
+                f"retained items replayed)",
+                data={"dag_id": dag_id, "cause": cause,
+                      "actors": list(actors),
+                      "duration_s": float(msg.get("duration_s", 0.0))})
         elif phase == "failed":
             self._emit_event(
                 "ERROR", "DAG_RECOVERY_FAILED",
@@ -3040,6 +3050,26 @@ class Controller:
             bucket[msg["worker_id"]] = msg["text"]
         return {"ok": True}
 
+    async def _h_dag_timeline(self, conn, msg):
+        """Gather the channel meter's recent per-stage step spans (recv /
+        compute / send / blocked ns per microbatch) from every worker
+        hosting resident DAG stages. Same fan-out/partial-result contract
+        as the stack dump; feeds state.dag_timeline()'s chrome trace."""
+        req_id, targets, replies = await self._gather_from_workers(
+            "dag_spans", float(msg.get("timeout", 2.0)),
+            extra={"dag": msg.get("dag")})
+        spans: List[dict] = []
+        for wid, text in replies.items():
+            try:
+                for s in json.loads(text):
+                    s["worker_id"] = str(wid)
+                    spans.append(s)
+            except Exception:
+                pass
+        spans.sort(key=lambda s: s.get("end_s", 0.0))
+        return {"requested": len(targets), "responded": len(replies),
+                "spans": spans}
+
     def _profile_targets(self, msg) -> Optional[List[str]]:
         """Resolve a profile request's scope to worker ids (None = every
         live worker). Entity ids match on prefix, same as the event
@@ -3166,6 +3196,16 @@ class Controller:
             from .object_store import spill_stats
 
             return spill_stats()
+        except Exception:
+            return {}
+
+    def _local_channel_stats(self) -> Dict[str, int]:
+        """Channel-fabric footprint of the controller's own host (same
+        local-sampling contract as _local_spill_stats)."""
+        try:
+            from .object_store import host_channel_stats
+
+            return host_channel_stats()
         except Exception:
             return {}
 
@@ -3473,7 +3513,7 @@ class Controller:
             ]
         if what == "dags":
             return [
-                {
+                dict({
                     "dag_id": d["dag_id"],
                     "stages": [dict(s) for s in d.get("stages", ())],
                     "edges": dict(d.get("edges", {})),
@@ -3483,7 +3523,7 @@ class Controller:
                     "recovering": d.get("recovering", False),
                     "last_recovery_s": d.get("last_recovery_s"),
                     "last_cause": d.get("last_cause"),
-                }
+                }, **self._dag_rollup(d))
                 for d in list(self.compiled_dags.values())[:limit]
             ]
         if what == "summary":
@@ -3497,6 +3537,58 @@ class Controller:
             # `ray summary tasks` timing columns the GcsTaskManager feeds).
             return self._phase_breakdown()
         raise ValueError(f"unknown state listing {what!r}")
+
+    def _dag_rollup(self, d: dict) -> Dict[str, Any]:
+        """Channel-meter rollup for one compiled DAG, merged into its
+        `list_state("dags")` row: latest per-stage busy fractions and
+        per-edge ring stats from the app-metric store (gauges keep last,
+        counters accumulate — see _h_metric_update), steps/s from the
+        TSDB rate, and THE bottleneck verdict
+        (dag.meter.attribute_bottleneck). All fields degrade to empty /
+        None when RTPU_DAG_METER=0 or nothing has sampled yet."""
+        short = d["dag_id"][:12]
+        busy: Dict[str, Dict[str, float]] = {}
+        fam = self.app_metrics.get("rtpu_dag_stage_busy_fraction")
+        for tags, v in (fam or {}).get("data", {}).items():
+            t = dict(tags)
+            if t.get("dag") != short:
+                continue
+            busy.setdefault(t.get("stage", "?"), {})[
+                t.get("phase", "?")] = float(v)
+        edges: Dict[str, Dict[str, float]] = {}
+        for name, field in (
+                ("rtpu_dag_edge_items_total", "items"),
+                ("rtpu_dag_edge_bytes_total", "bytes"),
+                ("rtpu_dag_edge_occupancy", "occupancy"),
+                ("rtpu_dag_edge_lag_seqs", "lag"),
+                ("rtpu_dag_edge_blocked_fraction", "blocked_fraction")):
+            fam = self.app_metrics.get(name)
+            for tags, v in (fam or {}).get("data", {}).items():
+                t = dict(tags)
+                if t.get("dag") != short:
+                    continue
+                edges.setdefault(t.get("edge", "?"), {})[field] = float(v)
+        steps_per_s = None
+        if self.tsdb is not None:
+            try:
+                # The fastest stage's rate IS the pipeline's steady-state
+                # throughput floor-to-ceiling band top; during warmup /
+                # recovery slower stages would underreport it.
+                for ser in self.tsdb.query(
+                        name="rtpu_dag_stage_steps_total",
+                        tags={"dag": short}):
+                    pts = ser.get("points") or ()
+                    if pts:
+                        steps_per_s = max(steps_per_s or 0.0,
+                                          float(pts[-1][1]))
+            except Exception:
+                pass
+        bottleneck = None
+        if busy:
+            from ray_tpu.dag import meter as dag_meter
+            bottleneck = dag_meter.attribute_bottleneck(busy)
+        return {"stage_busy": busy, "edge_stats": edges,
+                "steps_per_s": steps_per_s, "bottleneck": bottleneck}
 
     def _latest_task_events(self) -> Dict[str, Dict[str, Any]]:
         """task_id -> its most recent LIFECYCLE event (events append in
@@ -4432,6 +4524,10 @@ class Controller:
                     "spill": (dict(n.spill_stats)
                               if n.agent_conn is not None
                               else self._local_spill_stats()),
+                    # Channel-fabric footprint (live rtpu_ch_* rings).
+                    "channels": (dict(n.channel_stats)
+                                 if n.agent_conn is not None
+                                 else self._local_channel_stats()),
                 }
                 for n in self.nodes.values()
             ],
@@ -4544,6 +4640,7 @@ class Controller:
                 self._wake_scheduler()
             node.arena_stats = msg.get("arena") or {}
             node.spill_stats = msg.get("spill") or {}
+            node.channel_stats = msg.get("channels") or {}
             if msg.get("mem_fraction") is not None:
                 node.mem_fraction = float(msg["mem_fraction"])
             if msg.get("cpu_percent") is not None:

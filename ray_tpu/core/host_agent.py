@@ -564,6 +564,12 @@ class HostAgent:
                 spill = spill_stats()
             except Exception:
                 spill = {}
+            try:
+                from .object_store import host_channel_stats
+
+                channels = host_channel_stats()
+            except Exception:
+                channels = {}
 
             hb = {
                 "kind": "heartbeat",
@@ -573,6 +579,10 @@ class HostAgent:
                 # Host-wide spill usage ({files, bytes}): the census
                 # "spill" tier and the `rtpu status` STORE column.
                 "spill": spill,
+                # Channel-fabric footprint ({segments, bytes}): live
+                # rtpu_ch_* shm rings on this host — the node-level view
+                # of the compiled-DAG channel plane.
+                "channels": channels,
                 "num_workers": len(self.procs),
                 "mem_fraction": mem_fraction,
                 # Host CPU% (the `rtpu status` per-node column).

@@ -654,7 +654,16 @@ def close_process_segments() -> None:
 #
 #   [write_seq][closed][depth][slot_size][n_readers][writer_waiting]
 #   [read_seq[0]][reader_waiting[0]] ... x MAX_READERS
+#   one 64B writer counter line (items, bytes, blocked_ns)
+#   MAX_READERS 64B reader counter lines (items, bytes, starved_ns)
 #   then `depth` slots of (seq, kind, len) + slot_size payload bytes.
+#
+# The counter lines are the channel-observability substrate (RTPU_DAG_METER):
+# the hot path does plain unsynchronized u64 read-modify-writes into its OWN
+# cache line (single writer per field, same argument as the cursors), and an
+# out-of-band sampler on the ring-hosting worker reads them at heartbeat
+# cadence — occupancy and per-reader lag are derived from the existing
+# cursors at sample time, costing the hot path nothing.
 #
 # Single-writer/multi-reader protocol: the writer fills slot seq%depth and
 # THEN publishes by storing write_seq=seq+1; a reader consumes the slot and
@@ -696,6 +705,27 @@ def channel_segment_stats() -> Dict[str, int]:
                 "bytes": sum(_channel_open.values())}
 
 
+def host_channel_stats() -> Dict[str, int]:
+    """Host-wide channel-fabric footprint {segments, bytes}: a /dev/shm
+    scan for live ``rtpu_ch_*`` segments. Like spill_stats this is ground
+    truth for the NODE (heartbeated by the host agent), not one process's
+    mapped view — every process on the host creates rings in the same
+    namespace, and a leaked ring from a dead writer still shows up here."""
+    segs = 0
+    total = 0
+    try:
+        for fn in os.listdir("/dev/shm"):
+            if fn.startswith("rtpu_ch_"):
+                try:
+                    total += os.stat(os.path.join("/dev/shm", fn)).st_size
+                except OSError:
+                    continue
+                segs += 1
+    except OSError:
+        pass  # non-Linux: no /dev/shm to scan
+    return {"segments": segs, "bytes": total}
+
+
 class SlotRing:
     """One mutable shm channel: a depth-bounded ring of fixed-size slots.
 
@@ -705,7 +735,9 @@ class SlotRing:
 
     MAX_READERS = 8
     _RHDR = 64                       # fixed header bytes before reader table
-    _SLOTS_OFF = _RHDR + 16 * MAX_READERS
+    _CTR_OFF = _RHDR + 16 * MAX_READERS   # writer counter line
+    _CTR_R_OFF = _CTR_OFF + 64            # per-reader counter lines
+    _SLOTS_OFF = _CTR_R_OFF + 64 * MAX_READERS
 
     def __init__(self, seg: shared_memory.SharedMemory, created: bool):
         self._seg = seg
@@ -715,6 +747,17 @@ class SlotRing:
         self.slot_size = _U64.unpack_from(buf, 24)[0]
         self.n_readers = _U64.unpack_from(buf, 32)[0]
         self._stride = _SLOT_HDR.size + self.slot_size
+        # u64-cast view over the counter lines: `q[i] += d` is ~5x cheaper
+        # than struct pack/unpack round-trips, and the counter bumps are
+        # the only shm writes on the metered per-item hot path. mmap
+        # rounds segments to page size, so the cast never fails on
+        # alignment — the guard is for exotic buffer providers only.
+        try:
+            self._ctr_q = buf.cast("Q") if len(buf) % 8 == 0 else None
+        except (TypeError, ValueError):
+            self._ctr_q = None
+        self._qw = self._CTR_OFF // 8       # writer counter line, q-index
+        self._qr = self._CTR_R_OFF // 8     # reader counter lines, q-index
         track_channel_segment(seg.name, seg.size)
 
     # -- lifecycle ---------------------------------------------------------
@@ -763,6 +806,14 @@ class SlotRing:
 
     def close(self) -> None:
         untrack_channel_segment(self._seg.name)
+        if self._ctr_q is not None:
+            # The cast view keeps an export on the mmap; release it or
+            # SharedMemory.close() raises BufferError and leaks the map.
+            try:
+                self._ctr_q.release()
+            except Exception:
+                pass
+            self._ctr_q = None
         try:
             self._seg.close()
         except Exception:
@@ -813,6 +864,76 @@ class SlotRing:
     def set_reader_waiting(self, idx: int, v: bool) -> None:
         _U64.pack_into(self._seg.buf, self._RHDR + 16 * idx + 8,
                        1 if v else 0)
+
+    # -- telemetry counter lines (RTPU_DAG_METER) --------------------------
+    # Unsynchronized u64 read-modify-writes: each field has exactly one
+    # writing process (the ring writer / reader idx), so the only hazard is
+    # a sampler reading mid-update — which observes either the old or new
+    # value, never a torn one (aligned 8-byte stores).
+
+    def _bump(self, off: int, delta: int) -> None:
+        buf = self._seg.buf
+        _U64.pack_into(buf, off, _U64.unpack_from(buf, off)[0] + delta)
+
+    def ctr_write(self, items: int, nbytes: int) -> None:
+        q = self._ctr_q
+        if q is not None:
+            i = self._qw
+            q[i] += items
+            q[i + 1] += nbytes
+            return
+        self._bump(self._CTR_OFF, items)
+        self._bump(self._CTR_OFF + 8, nbytes)
+
+    def ctr_blocked(self, ns: int) -> None:
+        q = self._ctr_q
+        if q is not None:
+            q[self._qw + 2] += ns
+            return
+        self._bump(self._CTR_OFF + 16, ns)
+
+    def ctr_read(self, idx: int, items: int, nbytes: int) -> None:
+        q = self._ctr_q
+        if q is not None:
+            i = self._qr + 8 * idx
+            q[i] += items
+            q[i + 1] += nbytes
+            return
+        off = self._CTR_R_OFF + 64 * idx
+        self._bump(off, items)
+        self._bump(off + 8, nbytes)
+
+    def ctr_starved(self, idx: int, ns: int) -> None:
+        q = self._ctr_q
+        if q is not None:
+            q[self._qr + 8 * idx + 2] += ns
+            return
+        self._bump(self._CTR_R_OFF + 64 * idx + 16, ns)
+
+    def counters(self) -> Dict[str, Any]:
+        """Sampler-side snapshot: cumulative writer/reader counters plus
+        occupancy and per-reader lag derived from the live cursors."""
+        buf = self._seg.buf
+        w = self.write_seq()
+        readers = []
+        for i in range(self.n_readers):
+            off = self._CTR_R_OFF + 64 * i
+            readers.append({
+                "items": _U64.unpack_from(buf, off)[0],
+                "bytes": _U64.unpack_from(buf, off + 8)[0],
+                "starved_ns": _U64.unpack_from(buf, off + 16)[0],
+                "lag": w - self.read_seq(i),
+            })
+        return {
+            "epoch": self.epoch(),
+            "write_seq": w,
+            "occupancy": w - self.min_read_seq(),
+            "depth": self.depth,
+            "items": _U64.unpack_from(buf, self._CTR_OFF)[0],
+            "bytes": _U64.unpack_from(buf, self._CTR_OFF + 8)[0],
+            "blocked_ns": _U64.unpack_from(buf, self._CTR_OFF + 16)[0],
+            "readers": readers,
+        }
 
     # -- writer side -------------------------------------------------------
     def has_space(self, seq: int) -> bool:

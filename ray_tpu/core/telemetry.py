@@ -323,6 +323,17 @@ DEFAULT_ALERT_RULES: List[dict] = [
      "op": ">", "threshold": 0.9, "for_s": 10.0, "severity": "WARNING",
      "message": "object arena above 90% full for 10s — spill pressure; "
                 "run `rtpu memory --group-by owner` to find the holder"},
+    {"name": "dag_stage_starved", "metric": "rtpu_dag_stage_busy_fraction",
+     "tags": {"phase": "recv"}, "op": ">", "threshold": 0.9,
+     "for_s": 30.0, "severity": "WARNING",
+     "message": "compiled-DAG stage starved >90% of wall time for 30s — "
+                "an upstream stage is the bottleneck; run `rtpu dag "
+                "stats` for the attribution"},
+    {"name": "dag_edge_stalled", "metric": "rtpu_dag_edge_blocked_fraction",
+     "op": ">", "threshold": 0.9, "for_s": 30.0, "severity": "WARNING",
+     "message": "compiled-DAG edge writer blocked on ring space >90% of "
+                "wall time for 30s — the consumer stage cannot keep up; "
+                "run `rtpu dag stats` for the attribution"},
 ]
 
 

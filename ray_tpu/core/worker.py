@@ -1049,6 +1049,28 @@ class WorkerRuntime:
                     pass
 
             threading.Thread(target=_census_reply, daemon=True).start()
+        elif kind == "dag_spans":
+            # Channel-meter span ring for `state.dag_timeline()` (the
+            # dag_timeline fan-out): recent per-stage step spans with
+            # recv/compute/send/blocked phase ns; same off-loop reply
+            # pattern as stack_dump.
+            def _spans_reply(req_id=msg["req_id"], dag=msg.get("dag")):
+                import json as _json
+
+                from ray_tpu.dag import meter as _meter
+
+                try:
+                    text = _json.dumps(_meter.spans_snapshot(self, dag))
+                except Exception as e:
+                    text = _json.dumps({"error": repr(e)})
+                try:
+                    self.client.request(
+                        {"kind": "profile_result", "req_id": req_id,
+                         "worker_id": self.worker_id, "text": text})
+                except Exception:
+                    pass
+
+            threading.Thread(target=_spans_reply, daemon=True).start()
         elif kind == "stack_dump":
             # On-demand profiling (reference: reporter agent py-spy dump):
             # format every thread's current stack and reply off the event

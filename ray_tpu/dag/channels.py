@@ -215,20 +215,29 @@ class ShmEdgeWriter:
         self.ring = ring
         self._bell = Doorbell(writer_bell_path(ring.name))
         self._spin_us = int(flags.get("RTPU_DAG_SPIN_US"))
+        self._meter = bool(flags.get("RTPU_DAG_METER"))
         self._sidecars: Dict[int, str] = {}
         self._closed = False
 
     def write(self, seq: int, kind: int, payload: bytes,
-              stop: Optional[Callable[[], bool]] = None) -> None:
+              stop: Optional[Callable[[], bool]] = None,
+              record: bool = True) -> int:
+        """Publish one item. Returns the ns spent blocked on ring space
+        (0 on the fast path / unmetered). ``record=False`` is the recovery
+        replay path: re-delivered items must not re-count."""
         ring = self.ring
-        if len(payload) > ring.slot_size:
+        nbytes = len(payload)
+        if nbytes > ring.slot_size:
             kind, payload = self._spill(seq, kind, payload)
+        blocked = 0
         if not ring.has_space(seq):
-            self._wait_space(seq, stop)
+            blocked = self._wait_space(seq, stop)
         old = self._sidecars.pop(seq - ring.depth, None)
         if old is not None:
             _unlink_segment(old)
         ring.write(seq, kind, payload)
+        if record and self._meter:
+            ring.ctr_write(1, nbytes)
         _BYTES.inc(len(payload), {"edge_kind": "shm"})
         for i in range(ring.n_readers):
             if ring.reader_waiting(i):
@@ -238,21 +247,36 @@ class ShmEdgeWriter:
                 # re-arms the flag every blocking cycle, so no lost wake.
                 ring.set_reader_waiting(i, False)
                 ring_bell(reader_bell_path(ring.name, i))
+        return blocked
 
-    def _wait_space(self, seq: int, stop) -> None:
+    def _wait_space(self, seq: int, stop) -> int:
+        """Wait for ring space; returns the ns spent (0 when unmetered).
+        Wait time here is backpressure from slow consumers — it accrues
+        into the ring's *blocked* counter line, never into the producing
+        stage's send cost, so attribution blames the consumer."""
         ring = self.ring
-        if _spin_until(lambda: ring.has_space(seq), self._spin_us):
-            return
-        while True:
-            if stop is not None and stop():
-                raise ChannelClosed(f"edge ring {ring.name} stopped")
-            ring.set_writer_waiting(True)
-            try:
-                if ring.has_space(seq):
-                    return
-                self._bell.wait(0.05)
-            finally:
-                ring.set_writer_waiting(False)
+        t0 = time.monotonic_ns() if self._meter else 0
+        blocked = 0
+        try:
+            if not _spin_until(lambda: ring.has_space(seq), self._spin_us):
+                while True:
+                    if stop is not None and stop():
+                        raise ChannelClosed(f"edge ring {ring.name} stopped")
+                    ring.set_writer_waiting(True)
+                    try:
+                        if ring.has_space(seq):
+                            break
+                        self._bell.wait(0.05)
+                    finally:
+                        ring.set_writer_waiting(False)
+        finally:
+            if self._meter:
+                blocked = time.monotonic_ns() - t0
+                try:
+                    ring.ctr_blocked(blocked)
+                except Exception:
+                    pass
+        return blocked
 
     def _spill(self, seq: int, kind: int, payload: bytes
                ) -> Tuple[int, bytes]:
@@ -309,34 +333,50 @@ class ShmEdgeReader:
                                   expect_epoch=expect_epoch)
         self._bell = Doorbell(reader_bell_path(ring_name, idx))
         self._spin_us = int(flags.get("RTPU_DAG_SPIN_US"))
+        self._meter = bool(flags.get("RTPU_DAG_METER"))
 
     def recv(self, timeout: float,
              stop: Optional[Callable[[], bool]] = None
              ) -> Optional[Tuple[int, int, bytes]]:
         ring, idx = self.ring, self.idx
         if not ring.readable(idx):
-            if not _spin_until(lambda: ring.readable(idx), self._spin_us):
-                deadline = time.monotonic() + timeout
-                while True:
-                    if stop is not None and stop():
-                        raise ChannelClosed(f"edge ring {ring.name} stopped")
-                    ring.set_reader_waiting(idx, True)
-                    try:
-                        if ring.readable(idx):
-                            break
-                        if ring.closed():
+            # Wait time (spin + doorbell) accrues into this reader's
+            # *starved* counter line: nothing to consume means upstream is
+            # the slow side of this edge.
+            t0 = time.monotonic_ns() if self._meter else 0
+            try:
+                if not _spin_until(lambda: ring.readable(idx),
+                                   self._spin_us):
+                    deadline = time.monotonic() + timeout
+                    while True:
+                        if stop is not None and stop():
                             raise ChannelClosed(
-                                f"edge ring {ring.name} closed by writer")
-                        remaining = deadline - time.monotonic()
-                        if remaining <= 0:
-                            return None
-                        self._bell.wait(min(0.05, remaining))
-                    finally:
-                        ring.set_reader_waiting(idx, False)
+                                f"edge ring {ring.name} stopped")
+                        ring.set_reader_waiting(idx, True)
+                        try:
+                            if ring.readable(idx):
+                                break
+                            if ring.closed():
+                                raise ChannelClosed(
+                                    f"edge ring {ring.name} closed by writer")
+                            remaining = deadline - time.monotonic()
+                            if remaining <= 0:
+                                return None
+                            self._bell.wait(min(0.05, remaining))
+                        finally:
+                            ring.set_reader_waiting(idx, False)
+            finally:
+                if self._meter:
+                    try:
+                        ring.ctr_starved(idx, time.monotonic_ns() - t0)
+                    except Exception:
+                        pass
         seq, kind, payload = ring.read(idx)
         if kind == KIND_SIDECAR:
             kind, payload = _read_sidecar(payload)
         ring.advance(idx)
+        if self._meter:
+            ring.ctr_read(idx, 1, len(payload))
         if ring.writer_waiting():
             # Same elision as the writer side: one queued bell wakes the
             # writer, which re-arms its flag before blocking again.
@@ -463,19 +503,33 @@ class EdgeWriter:
             deque(maxlen=retain) if retain > 0 else None)
         self.epoch = epoch
         self.aborted = False  # recovery retired this writer mid-write
+        self._meter = bool(flags.get("RTPU_DAG_METER"))
+        # Cross-host edges have no shm counter block to sample, so the
+        # writer's cumulative (items, bytes) piggyback on every frame and
+        # the consumer's worker samples the high-water mark it last saw.
+        self.stream_items = 0
+        self.stream_bytes = 0
 
     def write(self, seq: int, kind: int, payload: bytes,
-              stop: Optional[Callable[[], bool]] = None) -> None:
+              stop: Optional[Callable[[], bool]] = None) -> int:
+        """Returns ns spent blocked on the ring's in-flight window (0 on
+        the fast path / unmetered) so the resident loop can subtract
+        backpressure from its send-phase accounting."""
         if self.retained is not None:
             # An aborted-then-retried write (quiesce interrupted the ring
             # leg) must not append the same seq twice.
             if not (self.retained and self.retained[-1][0] == seq):
                 self.retained.append((seq, kind, payload))
+        if self._meter and self.stream_targets:
+            self.stream_items += 1
+            self.stream_bytes += len(payload)
         for send, endpoint in self.stream_targets:
             try:
                 send({"kind": "dag_channel_item", "dag": self.dag_id,
                       "edge": self.edge_id, "to": endpoint, "seq": seq,
-                      "vk": kind, "epoch": self.epoch}, payload)
+                      "vk": kind, "epoch": self.epoch,
+                      "wi": self.stream_items, "wb": self.stream_bytes},
+                     payload)
             except Exception:
                 if self.retained is None:
                     raise  # fail-fast semantics (RTPU_DAG_RECOVERY=0)
@@ -484,7 +538,8 @@ class EdgeWriter:
                 continue
             _BYTES.inc(len(payload), {"edge_kind": "stream"})
         if self.ring_writer is not None:
-            self.ring_writer.write(seq, kind, payload, stop)
+            return self.ring_writer.write(seq, kind, payload, stop)
+        return 0
 
     def replay(self, needs: Dict[str, int], ring_base: Optional[int],
                stop: Optional[Callable[[], bool]] = None) -> None:
@@ -505,7 +560,12 @@ class EdgeWriter:
                     _BYTES.inc(len(payload), {"edge_kind": "stream"})
             if (self.ring_writer is not None and ring_base is not None
                     and seq >= ring_base):
-                self.ring_writer.write(seq, kind, payload, stop)
+                # record=False: the rebuilt ring's counter block starts at
+                # zero and the sampler re-baselines on the epoch bump, so
+                # counting replayed items would double-bill every item the
+                # old incarnation already reported.
+                self.ring_writer.write(seq, kind, payload, stop,
+                                       record=False)
 
     def close(self) -> None:
         if self.ring_writer is not None:

@@ -80,6 +80,25 @@ _live_lock = threading.Lock()
 _live_count = 0
 
 
+class _DriverMeterSource:
+    """Adapter registering the driver's channel state with the channel
+    meter (dag/meter.py): the input edge's ring is created driver-side,
+    so its counter block is sampled here, on the driver's own metrics
+    flush heartbeat. ``rings`` re-reads the live writer every sample, so
+    a recovery's ring swap (bumped epoch, zeroed counters) is picked up
+    without re-registration."""
+
+    def __init__(self, dag: "CompiledDAG"):
+        self._dag = dag
+        self.dag_id = dag.dag_id
+
+    @property
+    def rings(self):
+        iw = self._dag._input_writer
+        rw = iw.ring_writer if iw is not None else None
+        return {"in": rw.ring} if rw is not None else {}
+
+
 def _live_delta(d: int) -> None:
     global _live_count
     with _live_lock:
@@ -194,6 +213,7 @@ class CompiledDAG:
         self._recovering = False
         self._recovery_count = 0
         self._terminal_next: Dict[str, int] = {}  # edge -> next unseen seq
+        self._meter_src: Optional[_DriverMeterSource] = None
         try:
             self._connect_workers(plan)
             self._install(plan)
@@ -201,6 +221,11 @@ class CompiledDAG:
         except Exception:
             self._teardown_channels(kill_actors=False)
             raise
+        if flags.get("RTPU_DAG_METER"):
+            from ray_tpu.dag import meter as dag_meter
+
+            self._meter_src = _DriverMeterSource(self)
+            dag_meter.register_source(self._meter_src)
         try:
             wc.client.request(
                 {"kind": "dag_compiled", "dag_id": self.dag_id,
@@ -1120,6 +1145,11 @@ class CompiledDAG:
     def _teardown_channels(self, *, kill_actors: bool = False,
                            notify: bool = False,
                            _already_failed: bool = False) -> None:
+        if getattr(self, "_meter_src", None) is not None:
+            from ray_tpu.dag import meter as dag_meter
+
+            dag_meter.unregister_source(self._meter_src)
+            self._meter_src = None
         self._pump_stop.set()
         with self._cond:
             self._cond.notify_all()
@@ -1163,6 +1193,13 @@ class CompiledDAG:
                 pass
         self._conns.clear()
         self._sweep_channel_names()
+        # A resident loop that observed ChannelClosed in the teardown window
+        # can re-bind its ring AFTER the sweep above and then be SIGKILLed
+        # before its own 5s force-unlink fires. Idempotent second pass while
+        # the driver is still alive; daemon so interpreter exit never waits.
+        resweep = threading.Timer(2.0, self._sweep_channel_names)
+        resweep.daemon = True
+        resweep.start()
         if notify:
             try:
                 self._wc.client.send_nowait(

@@ -1,0 +1,215 @@
+"""Out-of-band sampler for the compiled-DAG channel meter (RTPU_DAG_METER).
+
+The channel fabric's hot path (dag/channels.py, dag/resident.py) never
+touches a metrics instrument: ring writers/readers bump raw u64 counter
+lines inside the SlotRing segment (core/object_store.py) and resident
+stage loops accumulate plain-int phase ns on their own mailbox thread.
+This module is the cold half: every process hosting channel state
+registers its WorkerDAG / driver channel sources here, and a sampler
+hooked onto the worker's existing metrics-flush heartbeat
+(util/metrics.register_flush_sampler) folds the raw counters into TSDB
+families at flush cadence:
+
+- ``rtpu_dag_edge_items_total`` / ``rtpu_dag_edge_bytes_total`` —
+  cumulative traffic per edge (counter deltas, epoch-aware);
+- ``rtpu_dag_edge_occupancy`` / ``rtpu_dag_edge_lag_seqs`` — in-flight
+  depth and worst reader lag, derived from the live cursors at sample
+  time (zero hot-path cost);
+- ``rtpu_dag_edge_blocked_fraction`` — share of wall time the writer
+  spent waiting for ring space (consumer backpressure);
+- ``rtpu_dag_stage_busy_fraction{phase=recv|compute|send}`` +
+  ``rtpu_dag_stage_steps_total`` — the stage phase accounting.
+
+**Epoch consistency.** A DAG recovery (PR 11) rebuilds affected rings
+under a bumped epoch with zeroed counter blocks, and replay writes skip
+the counters (`record=False`). The sampler keys its per-edge baseline on
+the ring epoch: an epoch bump re-baselines at zero, so rates never go
+negative and replayed items are never double-counted.
+
+``attribute_bottleneck`` is the one attribution rule everything renders:
+the bottleneck is the stage whose compute+send saturation bounds
+steady-state throughput. Starved (recv) time is excluded — a starved
+stage is the VICTIM of an upstream bottleneck — and writer-blocked time
+is excluded from send — a blocked writer is the victim of a downstream
+one. The rule is tested (tests/test_dag_meter.py), not eyeballed.
+"""
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional
+
+from ray_tpu.util import metrics as um
+
+_EDGE_ITEMS = um.Counter(
+    "rtpu_dag_edge_items_total",
+    description="Items published into a compiled-DAG channel edge "
+                "(sampled from the shm ring counter block; stream edges "
+                "count frames landed at the consumer)",
+    tag_keys=("dag", "edge"))
+_EDGE_BYTES = um.Counter(
+    "rtpu_dag_edge_bytes_total",
+    description="Payload bytes published into a compiled-DAG channel "
+                "edge (pre-sidecar size for oversize spills)",
+    tag_keys=("dag", "edge"))
+_EDGE_OCC = um.Gauge(
+    "rtpu_dag_edge_occupancy",
+    description="In-flight items in a compiled-DAG edge ring "
+                "(write_seq - slowest reader cursor; depth bounds it)",
+    tag_keys=("dag", "edge"))
+_EDGE_LAG = um.Gauge(
+    "rtpu_dag_edge_lag_seqs",
+    description="Worst consumer lag on a compiled-DAG edge in seqnos "
+                "(writer high-water minus the reader's cursor)",
+    tag_keys=("dag", "edge"))
+_EDGE_BLOCKED = um.Gauge(
+    "rtpu_dag_edge_blocked_fraction",
+    description="Fraction of wall time the edge's writer spent blocked "
+                "on ring space since the last sample (consumer "
+                "backpressure; drives the dag_edge_stalled alert)",
+    tag_keys=("dag", "edge"))
+_STAGE_BUSY = um.Gauge(
+    "rtpu_dag_stage_busy_fraction",
+    description="Fraction of wall time a resident DAG stage spent in "
+                "each phase since the last sample (recv=starved on "
+                "inputs, compute=user method, send=publishing minus "
+                "backpressure); drives bottleneck attribution and the "
+                "dag_stage_starved alert",
+    tag_keys=("dag", "stage", "phase"))
+_STAGE_STEPS = um.Counter(
+    "rtpu_dag_stage_steps_total",
+    description="Microbatches a resident DAG stage finished (per-second "
+                "rate is the stage's steady-state throughput)",
+    tag_keys=("dag", "stage"))
+
+# Registered channel sources: objects exposing ``dag_id`` plus any of
+# ``rings`` (eid -> SlotRing), ``stage_ns`` (idx -> phase accumulators),
+# ``stream_stats`` (eid -> frame counters). WorkerDAG satisfies all
+# three; the driver registers a thin adapter over the rings it creates.
+_sources: List[Any] = []
+_edge_base: Dict[Any, Dict[str, Any]] = {}
+_stage_base: Dict[Any, Dict[str, Any]] = {}
+_hooked = False
+
+
+def register_source(src: Any) -> None:
+    global _hooked
+    if src not in _sources:
+        _sources.append(src)
+    if not _hooked:
+        _hooked = True
+        um.register_flush_sampler(sample_now)
+
+
+def unregister_source(src: Any) -> None:
+    try:
+        _sources.remove(src)
+    except ValueError:
+        pass
+
+
+def sample_now() -> None:
+    """Fold every registered source's raw counters into the instruments.
+    Runs on the metrics flusher thread each heartbeat; also callable
+    directly from tests for a deterministic sample."""
+    now = time.monotonic()
+    for src in list(_sources):
+        try:
+            _sample_source(src, now)
+        except Exception:
+            pass
+
+
+def _sample_source(src: Any, now: float) -> None:
+    dag = str(src.dag_id)[:12]
+    rings = dict(getattr(src, "rings", None) or {})
+    for eid, ring in rings.items():
+        try:
+            c = ring.counters()
+        except Exception:
+            continue  # ring closed mid-sample
+        key = (dag, eid)
+        base = _edge_base.get(key)
+        if base is None or base["epoch"] != c["epoch"]:
+            # Fresh ring incarnation: its counter block starts at zero,
+            # so the baseline does too — no negative deltas, and items
+            # the old epoch already reported stay reported exactly once.
+            base = {"epoch": c["epoch"], "items": 0, "bytes": 0,
+                    "blocked_ns": 0, "t": None}
+        tags = {"dag": dag, "edge": eid}
+        _EDGE_ITEMS.inc(max(0, c["items"] - base["items"]), tags)
+        _EDGE_BYTES.inc(max(0, c["bytes"] - base["bytes"]), tags)
+        _EDGE_OCC.set(float(c["occupancy"]), tags)
+        _EDGE_LAG.set(float(max((r["lag"] for r in c["readers"]),
+                                default=0)), tags)
+        if base["t"] is not None and now > base["t"]:
+            wall_ns = (now - base["t"]) * 1e9
+            d_blocked = max(0, c["blocked_ns"] - base["blocked_ns"])
+            _EDGE_BLOCKED.set(min(1.0, d_blocked / wall_ns), tags)
+        _edge_base[key] = {"epoch": c["epoch"], "items": c["items"],
+                           "bytes": c["bytes"],
+                           "blocked_ns": c["blocked_ns"], "t": now}
+    for eid, st in list((getattr(src, "stream_stats", None) or {}).items()):
+        if eid in rings:
+            continue  # ring-counted
+        key = ("stream", dag, eid)
+        base = _edge_base.get(key) or {"items": 0, "bytes": 0}
+        tags = {"dag": dag, "edge": eid}
+        _EDGE_ITEMS.inc(max(0, st["items"] - base["items"]), tags)
+        _EDGE_BYTES.inc(max(0, st["bytes"] - base["bytes"]), tags)
+        _EDGE_LAG.set(float(max(0, st.get("wi", 0) - st["items"])), tags)
+        _edge_base[key] = {"items": st["items"], "bytes": st["bytes"]}
+    for idx, stc in list((getattr(src, "stage_ns", None) or {}).items()):
+        snap = dict(stc)
+        key = (dag, idx)
+        base = _stage_base.get(key)
+        stage = f"s{idx}"
+        _STAGE_STEPS.inc(
+            max(0, snap["steps"] - (base["steps"] if base else 0)),
+            {"dag": dag, "stage": stage})
+        if base is not None and now > base["t"]:
+            wall_ns = (now - base["t"]) * 1e9
+            for phase in ("recv", "compute", "send"):
+                frac = max(0, snap[phase] - base[phase]) / wall_ns
+                _STAGE_BUSY.set(min(1.0, frac),
+                                {"dag": dag, "stage": stage,
+                                 "phase": phase})
+        snap["t"] = now
+        _stage_base[key] = snap
+
+
+def attribute_bottleneck(
+        busy: Dict[str, Dict[str, float]]) -> Optional[str]:
+    """THE attribution rule: given ``{stage: {phase: busy_fraction}}``,
+    name the stage whose compute-or-send saturation bounds steady-state
+    throughput. recv (starved) time marks a victim, not a culprit, and
+    never scores; ties break toward the earliest stage so the verdict is
+    deterministic."""
+    best: Optional[str] = None
+    best_score = -1.0
+    for stage in sorted(busy):
+        phases = busy[stage]
+        score = (float(phases.get("compute", 0.0))
+                 + float(phases.get("send", 0.0)))
+        if score > best_score + 1e-12:
+            best, best_score = stage, score
+    return best
+
+
+def spans_snapshot(runtime, dag: Optional[str] = None
+                   ) -> List[Dict[str, Any]]:
+    """Recent per-stage step spans from every DAG this worker hosts, in
+    the wire shape ``state.dag_timeline()`` consumes."""
+    out: List[Dict[str, Any]] = []
+    for dag_id, wd in list((getattr(runtime, "dag_channels", None)
+                            or {}).items()):
+        if dag and not dag_id.startswith(dag):
+            continue
+        methods = {st["idx"]: st.get("method", "")
+                   for st in wd.plan.get("stages", ())}
+        for (idx, seq, end_s, recv, comp, send, blocked) in list(wd.spans):
+            out.append({"dag": dag_id[:12], "stage": f"s{idx}",
+                        "method": methods.get(idx, ""), "seq": int(seq),
+                        "end_s": float(end_s), "recv_ns": int(recv),
+                        "compute_ns": int(comp), "send_ns": int(send),
+                        "blocked_ns": int(blocked)})
+    return out

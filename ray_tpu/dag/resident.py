@@ -41,6 +41,7 @@ turns the migrated stage into an ordinary recovery with zero failed refs.
 from __future__ import annotations
 
 import threading
+import time
 from collections import deque
 from typing import Any, Callable, Dict, List, Optional
 
@@ -117,7 +118,22 @@ def handle_item(runtime, msg):
         return None
     inbox = wd.inboxes.get((msg["edge"], msg["to"]))
     if inbox is not None:
-        inbox.push(msg["seq"], msg["vk"], bytes(msg["data"]))
+        data = bytes(msg["data"])
+        if wd.meter:
+            # Stream edges have no shm counter block: account frames as
+            # they land, and keep the writer's piggybacked cumulative
+            # high-water ("wi"/"wb") so the sampler can report the
+            # producer's view even when this consumer lags.
+            st = wd.stream_stats.get(msg["edge"])
+            if st is None:
+                st = wd.stream_stats[msg["edge"]] = {
+                    "items": 0, "bytes": 0, "wi": 0, "wb": 0}
+            st["items"] += 1
+            st["bytes"] += len(data)
+            if "wi" in msg:
+                st["wi"] = max(st["wi"], int(msg["wi"]))
+                st["wb"] = max(st["wb"], int(msg["wb"]))
+        inbox.push(msg["seq"], msg["vk"], data)
     return None
 
 
@@ -235,6 +251,16 @@ class WorkerDAG:
         self._starts: Dict[str, Dict[str, int]] = {}
         self._retain = (int(plan["depth"]) + 2
                         if flags.get("RTPU_DAG_RECOVERY") else 0)
+        # -- channel meter state (RTPU_DAG_METER) --
+        # Plain-int phase accumulators written only by the stage's own
+        # mailbox thread; the flush sampler (same process) reads them —
+        # GIL-atomic int loads, no locks on the hot path.
+        self.meter = bool(flags.get("RTPU_DAG_METER"))
+        self.stage_ns: Dict[int, Dict[str, int]] = {}
+        self.stream_stats: Dict[str, Dict[str, int]] = {}
+        # Recent per-stage step spans for state.dag_timeline():
+        # (idx, seq, wall_end_s, recv_ns, compute_ns, send_ns, blocked_ns).
+        self.spans: deque = deque(maxlen=512)
 
     # -- install -----------------------------------------------------------
 
@@ -292,6 +318,10 @@ class WorkerDAG:
             mb.q.put({"__create__":
                       (lambda mb=mb, st=stages, rec=rec:
                        self._actor_loop(mb, st, recover=rec))})
+        if self.meter:
+            from ray_tpu.dag import meter
+
+            meter.register_source(self)
 
     def sender(self, host: str, port: int):
         """One persistent raw-tail stream per downstream worker, shared by
@@ -678,6 +708,12 @@ class WorkerDAG:
                    interrupted) -> None:
         idx = stage["idx"]
         readers, writer = sio[0], sio[1]
+        # Phase accounting (RTPU_DAG_METER): four amortized monotonic
+        # reads bracket recv / compute / send; ring backpressure inside
+        # the write is subtracted out (it is the CONSUMER'S cost).
+        mt = self.meter
+        t0 = time.monotonic_ns() if mt else 0
+        t1 = t2 = t0
         cache = self._cache.get(idx)
         if cache is None or cache.get("seq") != seq:
             cache = self._cache[idx] = {"seq": seq, "vals": {}, "out": None}
@@ -688,6 +724,8 @@ class WorkerDAG:
                 got_seq, kind, payload = self._recv_input(
                     reader, eid, seq, interrupted)
                 cache["vals"][eid] = (kind, payload)
+            if mt:
+                t1 = time.monotonic_ns()
             err_payload: Optional[bytes] = None
             chan_vals: Dict[str, Any] = {}
             for eid in readers:
@@ -733,10 +771,13 @@ class WorkerDAG:
                     local_vals[idx] = _Err(out_payload)
             cache["out"] = (out_kind, out_payload)
             self._journal_apply(mb, idx, seq, out_kind, out_payload)
+        if mt:
+            t2 = time.monotonic_ns()
+        blocked = 0
         if writer is not None:
             out_kind, out_payload = cache["out"]
             try:
-                writer.write(
+                blocked = writer.write(
                     seq, out_kind, out_payload,
                     stop=lambda: self._stop_requested() or interrupted())
             except channels.ChannelClosed:
@@ -747,6 +788,23 @@ class WorkerDAG:
                     raise _Paused()
                 raise
         self._cache.pop(idx, None)
+        if mt:
+            t3 = time.monotonic_ns()
+            recv = max(0, t1 - t0)
+            comp = max(0, t2 - t1)
+            send = max(0, t3 - t2 - (blocked or 0))
+            st = self.stage_ns.get(idx)
+            if st is None:
+                st = self.stage_ns[idx] = {
+                    "recv": 0, "compute": 0, "send": 0,
+                    "blocked": 0, "steps": 0}
+            st["recv"] += recv
+            st["compute"] += comp
+            st["send"] += send
+            st["blocked"] += blocked or 0
+            st["steps"] += 1
+            self.spans.append(
+                (idx, seq, time.time(), recv, comp, send, blocked or 0))
 
     # -- teardown ----------------------------------------------------------
 
@@ -758,6 +816,9 @@ class WorkerDAG:
         OSError and exits), and a timer sweeps anything a never-started
         loop would have owned."""
         self.stopped.set()
+        from ray_tpu.dag import meter
+
+        meter.unregister_source(self)
         for inbox in self.inboxes.values():
             inbox.close()
         with self._resume_cond:

@@ -27,6 +27,11 @@ Endpoints:
     GET /api/jobs            job list (ray_tpu.jobs)
     GET /api/serve           serve application status (if running)
     GET /api/timeline        chrome-trace events (open in chrome://tracing)
+    GET /api/dags            compiled-DAG registry + channel-meter rollups
+                             (stage busy fractions, edge ring stats,
+                             steps/s, bottleneck verdict)
+    GET /api/dag_timeline    per-stage step chrome trace with recv/
+                             compute/send/blocked sub-slices (?dag=)
     GET /api/usage           local host cpu/mem (reporter_agent.py role)
     GET /api/logs            cluster log index (?all=1), one host's
                              list/tail (?node, ?name), or ranged /
@@ -87,6 +92,7 @@ _PAGE = """<!doctype html>
 <p><a href="/logs">log viewer</a> · <a href="/timeline">timeline</a> ·
 <a href="/events">events</a> · <a href="/objects">objects</a></p>
 <h2>Nodes</h2>{nodes}
+<h2>Compiled DAGs</h2>{dags}
 <h2>Telemetry</h2>{telemetry}
 <h2>Recent events</h2>{events}
 <h2>Actors</h2>{actors}
@@ -386,6 +392,28 @@ class Dashboard:
                         raw={"logs"})
         jobs = _table(self._safe(self._jobs),
                       ["job_id", "status", "entrypoint"])
+        # Compiled DAGs with channel-meter rollups: the pipelines whose
+        # steady-state dispatch never touches the controller, with their
+        # live steps/s and bottleneck verdict (`rtpu dag stats` detail).
+        dag_rows = []
+        for d in self._safe(state_api.list_compiled_dags) or []:
+            methods = {f"s{s.get('idx')}": s.get("method", "")
+                       for s in d.get("stages") or ()}
+            bn = d.get("bottleneck")
+            sps = d.get("steps_per_s")
+            dag_rows.append({
+                "dag_id": d.get("dag_id", "")[:12],
+                "stages": len(d.get("stages") or ()),
+                "depth": d.get("depth", 0),
+                "steps/s": f"{sps:.1f}" if sps is not None else "-",
+                "recoveries": (str(d.get("recoveries", 0))
+                               + ("*" if d.get("recovering") else "")),
+                "bottleneck": (f"{bn} {methods.get(bn, '')}".strip()
+                               if bn else "-"),
+                "last_cause": d.get("last_cause") or "",
+            })
+        dags = _table(dag_rows, ["dag_id", "stages", "depth", "steps/s",
+                                 "recoveries", "bottleneck", "last_cause"])
         # Recent-events feed (reference: the dashboard event feed): the
         # newest cluster events, newest first, with the full log one click
         # away on /events.
@@ -398,7 +426,7 @@ class Dashboard:
         return web.Response(
             text=_PAGE.format(cluster=cluster, nodes=nodes, actors=actors,
                               tasks=tasks, recent=recent, jobs=jobs,
-                              events=events,
+                              events=events, dags=dags,
                               telemetry=self._telemetry_html()),
             content_type="text/html")
 
@@ -495,6 +523,16 @@ class Dashboard:
                 data = serve_status() or {}  # None = serve not running
             elif kind == "timeline":
                 data = state_api.timeline()
+            elif kind == "dags":
+                # Compiled-DAG registry + channel-meter rollups (stage
+                # busy fractions, edge ring stats, steps/s, bottleneck) —
+                # the `rtpu dag stats` backend.
+                data = state_api.list_compiled_dags()
+            elif kind == "dag_timeline":
+                # Chrome-trace of per-stage steps with recv/compute/send/
+                # blocked sub-slices (merged with the task trace).
+                data = state_api.dag_timeline(
+                    dag=request.query.get("dag"))
             elif kind == "profile":
                 # Dashboard-triggered stack capture (reference: reporter
                 # py-spy endpoint); in an executor — it blocks up to

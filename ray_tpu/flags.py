@@ -316,6 +316,16 @@ _define("RTPU_DAG_RECOVERY_TIMEOUT_S", float, 60.0,
         "How long a recovering DAG waits for a dead stage actor to come "
         "back alive (restart scheduling + checkpoint restore) before "
         "giving up and tearing down with DAGTeardownError.")
+_define("RTPU_DAG_METER", bool, True,
+        "Channel-fabric telemetry: every shm slot ring carries per-writer/"
+        "per-reader counter blocks (items, bytes, blocked/starved ns) and "
+        "every resident stage loop accounts recv/compute/send phase time, "
+        "sampled out-of-band on the worker's metrics-flush heartbeat into "
+        "rtpu_dag_edge_*/rtpu_dag_stage_* TSDB families (`rtpu dag "
+        "stats`, `rtpu top`, state.dag_timeline()). The hot path adds "
+        "only plain cache-line counter stores plus a few amortized "
+        "monotonic clock reads; 0 removes even those (perf-guarded in "
+        "test_perf_regression.py).")
 
 # -- streaming data plane fault tolerance ------------------------------------
 _define("RTPU_DATA_FT", bool, True,

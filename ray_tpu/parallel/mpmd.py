@@ -171,21 +171,61 @@ class MPMDPipeline:
         """In-place recoveries the compiled plan has completed so far."""
         return getattr(self._compiled, "_recovery_count", 0)
 
-    def gap_stats(self) -> Dict[str, float]:
+    def gap_stats(self) -> Dict[str, Any]:
         """Summary of the last run's per-microbatch completion gaps.
         Steady-state gaps exclude the pipeline-fill ramp: the first
-        ``num_stages - 1`` completions arrive while the pipe is filling."""
+        ``num_stages - 1`` completions arrive while the pipe is filling.
+
+        Re-based on the channel meter (RTPU_DAG_METER): the driver-side
+        gap percentiles now ship alongside the cluster-side attribution —
+        ``bottleneck`` names the stage whose compute+send saturation
+        explains the steady-state gap, so the summary answers "WHY is the
+        gap what it is", not just "what is it"."""
         gaps = self.last_gaps_s
         steady = gaps[self.num_stages - 1:] or gaps
         if not steady:
             return {"n": 0}
         s = sorted(steady)
-        return {
+        out: Dict[str, Any] = {
             "n": len(steady),
             "mean_us": sum(steady) / len(steady) * 1e6,
             "p50_us": s[len(s) // 2] * 1e6,
             "max_us": s[-1] * 1e6,
         }
+        out.update(self.meter_stats())
+        return out
+
+    def meter_stats(self) -> Dict[str, Any]:
+        """This pipeline's channel-meter rollup from the controller
+        registry (state.list_compiled_dags): per-stage busy fractions,
+        per-edge ring stats, steps/s, and the bottleneck verdict. Empty
+        dict in submit mode, with RTPU_DAG_METER=0, or before the first
+        out-of-band sample lands."""
+        if self.mode != "channels":
+            return {}
+        try:
+            from ray_tpu.util import state as state_api
+
+            row = next((d for d in state_api.list_compiled_dags()
+                        if d.get("dag_id") == self._compiled.dag_id), None)
+        except Exception:
+            row = None
+        if not row:
+            return {}
+        out: Dict[str, Any] = {}
+        for key in ("stage_busy", "edge_stats", "steps_per_s",
+                    "bottleneck"):
+            v = row.get(key)
+            if v:
+                out[key] = v
+        bn = out.get("bottleneck")
+        if bn:
+            try:
+                idx = int(bn[1:])
+                out["bottleneck_stage"] = idx
+            except (ValueError, IndexError):
+                pass
+        return out
 
     def describe(self) -> List[Dict[str, Any]]:
         """One dict per stage (stage idx, mesh shape), captured at

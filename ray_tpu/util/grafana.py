@@ -77,6 +77,14 @@ def generate_dashboard(prom_text: str,
                 exprs = [(f"rate({name}[5m])", "checkpoints/s"),
                          ("rate(rtpu_actor_checkpoint_bytes[5m])",
                           "bytes/s")]
+            elif name.startswith("rtpu_dag_edge_"):
+                # Channel-meter edge counters: legend per (dag, edge) so
+                # one panel fans out across every compiled pipeline.
+                exprs = [(f"sum(rate({name}[5m])) by (dag, edge)",
+                          "{{dag}}/{{edge}}")]
+            elif name.startswith("rtpu_dag_stage_"):
+                exprs = [(f"sum(rate({name}[5m])) by (dag, stage)",
+                          "{{dag}}/{{stage}}")]
             else:
                 exprs = [(f"rate({name}[5m])", "{{instance}}")]
             ptitle = f"{name} (rate/s)"
@@ -116,6 +124,12 @@ def generate_dashboard(prom_text: str,
                 continue
             if name in ("rtpu_worker_cpu_percent", "rtpu_worker_rss_bytes"):
                 legend = "{{node}}/{{pid}}"
+            elif name == "rtpu_dag_stage_busy_fraction":
+                # The attribution gauge: one line per (dag, stage, phase)
+                # — the tallest compute+send pair is the bottleneck.
+                legend = "{{dag}}/{{stage}}/{{phase}}"
+            elif name.startswith("rtpu_dag_edge_"):
+                legend = "{{dag}}/{{edge}}"
             elif name in ("rtpu_worker_log_bytes",
                           "rtpu_node_arena_used_bytes",
                           "rtpu_node_mem_fraction",

@@ -118,6 +118,14 @@ class _Aggregator:
     def flush(self, final: bool = False) -> None:
         from ray_tpu.core import context as ctx
 
+        # Out-of-band samplers first (e.g. the compiled-DAG channel meter):
+        # they read shared-memory counter blocks and record into the pending
+        # buffer, so their samples ride the very flush that triggered them.
+        for fn in list(_flush_samplers):
+            try:
+                fn()
+            except Exception:
+                pass
         with self.lock:
             if not self.pending:
                 return
@@ -167,6 +175,33 @@ class _Aggregator:
 
 
 _aggregator = _Aggregator()
+
+# Callables run at the top of every flush cycle (the worker's metrics
+# heartbeat). This is the out-of-band sampling hook: subsystems that keep
+# raw counters off the metrics path (shm counter blocks, plain-int stage
+# accounting) register a sampler that folds them into instruments at flush
+# cadence instead of paying instrument overhead on their hot paths.
+_flush_samplers: list = []
+
+
+def register_flush_sampler(fn) -> None:
+    """Register ``fn`` to run at the start of every metrics flush.
+
+    Registration force-starts the flusher thread so a process that never
+    records an app metric directly (a pure channel-plane worker) still
+    samples on the heartbeat. ``fn`` must be cheap and exception-safe;
+    errors are swallowed."""
+    if fn not in _flush_samplers:
+        _flush_samplers.append(fn)
+    with _aggregator.lock:
+        _aggregator._ensure_flusher_locked()
+
+
+def unregister_flush_sampler(fn) -> None:
+    try:
+        _flush_samplers.remove(fn)
+    except ValueError:
+        pass
 
 
 def flush_metrics() -> None:

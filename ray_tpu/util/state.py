@@ -473,3 +473,66 @@ def timeline(filename: Optional[str] = None) -> Any:
             json.dump(trace, f)
         return filename
     return trace
+
+
+def dag_timeline(filename: Optional[str] = None, *,
+                 dag: Optional[str] = None,
+                 include_tasks: bool = True,
+                 timeout: float = 5.0) -> Any:
+    """Chrome-trace export of compiled-DAG stage execution (the channel
+    meter's span rings, gathered from every hosting worker).
+
+    Rows are (``dag <id>``, stage): each finished microbatch is one
+    complete ("ph": "X") slice whose nested sub-slices split the step
+    into recv (waiting on inputs), compute (the user method), blocked
+    (writer waiting for ring space — downstream backpressure) and send
+    (publishing). With ``include_tasks`` (default) the regular
+    ``timeline()`` task trace is merged in, so the dispatch-path tasks
+    that fed the pipeline and the channel-plane steps that bypassed the
+    controller share one clock in chrome://tracing / Perfetto. Requires
+    RTPU_DAG_METER (the default); with the meter off the DAG rows are
+    simply empty. ``dag`` filters by dag-id prefix."""
+    r = _req({"kind": "dag_timeline", "dag": dag, "timeout": timeout})
+    trace: List[Dict[str, Any]] = (
+        list(timeline()) if include_tasks else [])
+    for sp in r.get("spans", ()):
+        try:
+            recv = int(sp.get("recv_ns", 0))
+            comp = int(sp.get("compute_ns", 0))
+            send = int(sp.get("send_ns", 0))
+            blocked = int(sp.get("blocked_ns", 0))
+            total_ns = recv + comp + send + blocked
+            end_us = float(sp["end_s"]) * 1e6
+            pid = f"dag {sp['dag']}"
+            row = f"{sp['stage']} {sp.get('method') or ''}".strip()
+            start_us = end_us - total_ns / 1e3
+        except Exception:
+            continue
+        trace.append({
+            "name": f"step {sp.get('seq')}", "cat": "dag_step",
+            "ph": "X", "ts": start_us,
+            "dur": max(1.0, total_ns / 1e3), "pid": pid, "tid": row,
+            "args": {"seq": sp.get("seq"), "recv_ns": recv,
+                     "compute_ns": comp, "send_ns": send,
+                     "blocked_ns": blocked,
+                     "worker_id": sp.get("worker_id")},
+        })
+        cursor = start_us
+        # Phase order mirrors the stage loop: wait for inputs, run the
+        # method, wait out backpressure, publish.
+        for ns, nm in ((recv, "recv"), (comp, "compute"),
+                       (blocked, "blocked"), (send, "send")):
+            if ns <= 0:
+                continue
+            trace.append({
+                "name": nm, "cat": "dag_phase", "ph": "X",
+                "ts": cursor, "dur": max(0.5, ns / 1e3),
+                "pid": pid, "tid": row,
+                "args": {"seq": sp.get("seq")},
+            })
+            cursor += ns / 1e3
+    if filename is not None:
+        with open(filename, "w") as f:
+            json.dump(trace, f)
+        return filename
+    return trace
