@@ -947,7 +947,8 @@ def cmd_job(args) -> int:
         if args.working_dir:
             renv["working_dir"] = args.working_dir
         job_id = client.submit_job(entrypoint=entrypoint,
-                                   runtime_env=renv or None)
+                                   runtime_env=renv or None,
+                                   max_attempts=args.max_attempts)
         print(job_id)
         if args.wait:
             status = client.wait_until_finished(job_id, timeout=args.timeout)
@@ -958,13 +959,28 @@ def cmd_job(args) -> int:
     elif args.job_cmd == "status":
         print(client.get_job_status(args.job_id))
     elif args.job_cmd == "logs":
-        print(client.get_job_logs(args.job_id), end="")
+        if getattr(args, "follow", False):
+            # Durable follow: the stream rides the controller's job-log
+            # walker, so it rolls across supervisor failovers and keeps
+            # tailing the replacement attempt mid-flight.
+            try:
+                for chunk in client.tail_job_logs(args.job_id,
+                                                  follow=True):
+                    print(chunk, end="", flush=True)
+            except KeyboardInterrupt:
+                pass
+        else:
+            print(client.get_job_logs(args.job_id), end="")
     elif args.job_cmd == "stop":
         client.stop_job(args.job_id)
         print("stopped")
     elif args.job_cmd == "list":
         for d in client.list_jobs():
-            print(f"{d.job_id}\t{d.status}\t{d.entrypoint}")
+            attempts = (f"{d.attempts_used}/{d.max_attempts}"
+                        if d.max_attempts else "-")
+            rc = "-" if d.returncode is None else str(d.returncode)
+            print(f"{d.job_id}\t{d.status}\tattempts={attempts}\t"
+                  f"rc={rc}\t{d.entrypoint}")
     rt.shutdown()
     return 0
 
@@ -1237,11 +1253,20 @@ def main(argv=None) -> int:
     j.add_argument("--working-dir", default=None)
     j.add_argument("--wait", action="store_true")
     j.add_argument("--timeout", type=float, default=600.0)
+    j.add_argument("--max-attempts", type=int, default=None,
+                   help="entrypoint retry budget (default "
+                        "RTPU_JOB_MAX_ATTEMPTS; preempted attempts are "
+                        "free)")
     j.add_argument("entrypoint", nargs=argparse.REMAINDER,
                    help="command after --")
     for name in ("status", "logs", "stop"):
         j = jsub.add_parser(name)
         j.add_argument("job_id")
+        if name == "logs":
+            j.add_argument("--follow", "-f", action="store_true",
+                           help="stream until the job is terminal "
+                                "(rides the controller long-poll; "
+                                "survives supervisor failover)")
     jsub.add_parser("list")
     p.set_defaults(fn=cmd_job)
 

@@ -137,6 +137,17 @@ CORE_METRIC_META: Dict[str, Tuple[str, str]] = {
     "rtpu_object_leaks_total": (
         "counter", "Objects flagged OBJECT_LEAK_SUSPECT by the leak "
                    "watchdog (old refs whose owner is dead/unreachable)"),
+    "rtpu_jobs": ("gauge", "Jobs in the controller job table, by status "
+                           "(PENDING/RUNNING/RETRYING/SUCCEEDED/FAILED/"
+                           "STOPPED)"),
+    "rtpu_job_attempts_total": (
+        "counter", "Entrypoint launches across all jobs, by cause "
+                   "(initial/exit/worker_died/preempted/"
+                   "supervisor_restart) — the rate behind the "
+                   "job_flapping alert"),
+    "rtpu_job_runtime_s": (
+        "histogram", "End-to-end runtime of terminal jobs, "
+                     "submitted-to-finished (seconds)"),
 }
 
 # Families whose HELP/TYPE lines are emitted even with no samples yet
@@ -534,6 +545,13 @@ class Controller:
         # configured (RTPU_STATE_PATH or the CLI's --state-path).
         self.persist_path = flags.get("RTPU_STATE_PATH")
         self._state_dirty = False
+        # Durable job table (core/job_manager.py): job records, attempt
+        # accounting, and wait_job cursors live here and ride the state
+        # snapshot — constructed before _restore_state so a bounce
+        # restores the table alongside KV/actors.
+        from .job_manager import JobManager
+
+        self.jobs = JobManager(self)
         self._restore_state()
         # Cluster event log (reference: `ray list cluster-events` + the
         # dashboard event feed): bounded ring + JSONL persistence next to
@@ -1198,6 +1216,14 @@ class Controller:
             data={"cause": f"{type(err).__name__}: {err}",
                   "preempted": preempted,
                   "restarts": actor.restart_count})
+        from .job_manager import SUPERVISOR_PREFIX
+
+        if (actor.name or "").startswith(SUPERVISOR_PREFIX):
+            # Job supervisor going around the restart loop: record the
+            # pending attempt cause (preempted restarts bill no job
+            # budget) and sweep the orphaned entrypoint process group.
+            self.jobs.note_supervisor_died(actor, err, preempted,
+                                           fatal=False)
         # Fail calls already forwarded to the dead worker — but NOT calls
         # still buffered in pending_calls (never dispatched): those replay
         # after restart, and erroring them here would double-signal.
@@ -1676,6 +1702,13 @@ class Controller:
                         "size": 0, "eof": True}
             m["name"] = t["name"]
             m["node_id"] = t["node_id"]
+        return await self._fetch_log(m)
+
+    async def _fetch_log(self, m: Dict[str, Any]) -> Dict[str, Any]:
+        """Route one ranged log read to the owning host agent (or serve
+        locally for head-host/virtual-node files). Shared by _h_get_log
+        and the job-log walker, which follows a job's output across
+        supervisor failovers file by file."""
         node = self.nodes.get(m.get("node_id") or "")
         if node is not None and node.agent_conn is not None:
             try:
@@ -1689,6 +1722,40 @@ class Controller:
         from .worker_logs import serve_get_log_wait
 
         return await serve_get_log_wait(m)
+
+    # jobs (core/job_manager.py) ----------------------------------------------
+    # Thin delegates: the job table, attempt protocol, and log walker all
+    # live in JobManager; these exist so `_handle` dispatch finds them.
+
+    async def _h_job_submit(self, conn, msg):
+        return self.jobs.submit(msg)
+
+    async def _h_job_attempt_start(self, conn, msg):
+        return await self.jobs.attempt_start(msg)
+
+    async def _h_job_exec(self, conn, msg):
+        return self.jobs.attempt_exec(msg)
+
+    async def _h_job_attempt_done(self, conn, msg):
+        return self.jobs.attempt_done(msg)
+
+    async def _h_job_status(self, conn, msg):
+        return self.jobs.status(msg.get("job_id") or "")
+
+    async def _h_job_list(self, conn, msg):
+        return {"jobs": self.jobs.list()}
+
+    async def _h_job_wait(self, conn, msg):
+        return await self.jobs.wait(msg)
+
+    async def _h_job_stop(self, conn, msg):
+        return await self.jobs.stop(msg)
+
+    async def _h_job_stop_ack(self, conn, msg):
+        return self.jobs.stop_ack(msg)
+
+    async def _h_job_logs(self, conn, msg):
+        return await self.jobs.logs(msg)
 
     async def _h_wait(self, conn, msg):
         """O(n) wait: one callback registration per missing object, arrivals
@@ -2870,6 +2937,15 @@ class Controller:
                   f"{err}", "restarts": actor.restart_count})
         if actor.detached:
             self._state_dirty = True
+        from .job_manager import SUPERVISOR_PREFIX
+
+        if (actor.name or "").startswith(SUPERVISOR_PREFIX):
+            # Supervisor permanently dead (restart budget gone / actor
+            # dropped): the job can never run again — fail it now so
+            # wait_job callers don't hang on a supervisor that will
+            # never report attempt_done.
+            self.jobs.note_supervisor_died(actor, err, preempted=False,
+                                           fatal=True)
         actor.creation_error = actor.creation_error or err
         for call in actor.pending_calls:
             self._fail_task(call, err)
@@ -3791,6 +3867,13 @@ class Controller:
             actor_id=actor.actor_id, node_id=node.node_id,
             data={"name": actor.name,
                   "reason": node.drain_reason})
+        from .job_manager import SUPERVISOR_PREFIX
+
+        if (actor.name or "").startswith(SUPERVISOR_PREFIX):
+            # The supervisor instance migrates, its entrypoint subprocess
+            # cannot: the restored instance relaunches, and a planned
+            # drain departure bills no attempt budget (PR 4/16 rule).
+            self.jobs.note_supervisor_migrating(actor, node)
         w = self.workers.get(actor.worker_id or "")
         blob = None
         if w is not None:
@@ -4403,6 +4486,20 @@ class Controller:
             "rtpu_node_spill_bytes", spill_data)
         families["rtpu_object_leaks_total"] = fam(
             "rtpu_object_leaks_total", {(): self.leak_count})
+        # Job plane (core/job_manager.py): table gauge, attempt-cause
+        # counter, and terminal-runtime histogram (built by hand — fam()
+        # leaves boundaries empty, histograms need theirs).
+        families["rtpu_jobs"] = fam("rtpu_jobs",
+                                    self.jobs.status_counts())
+        families["rtpu_job_attempts_total"] = fam(
+            "rtpu_job_attempts_total", self.jobs.attempt_count_data())
+        from .job_manager import JOB_RUNTIME_BOUNDARIES
+
+        _jr_type, _jr_help = CORE_METRIC_META["rtpu_job_runtime_s"]
+        families["rtpu_job_runtime_s"] = {
+            "type": _jr_type, "help": _jr_help,
+            "boundaries": list(JOB_RUNTIME_BOUNDARIES),
+            "data": self.jobs.runtime_hist_data()}
         # Conditional families appear once they have samples; the
         # always-set keeps its HELP/TYPE headers from day one.
         for name in [n for n, f in families.items()
@@ -5028,6 +5125,10 @@ class Controller:
             return
         self.kv.update(snap.get("kv", {}))
         self.functions.update(snap.get("functions", {}))
+        # Job table + attempt counters + runtime histogram: restored
+        # before anything can touch them, so an in-flight wait_job's
+        # after_seq cursor stays meaningful across the bounce.
+        self.jobs.restore(snap.get("jobs"))
         # In-progress drains resume after the bounce (wall-clock deadlines,
         # so the grace window keeps shrinking through the downtime).
         drains = snap.get("drains") or {}
@@ -5177,6 +5278,7 @@ class Controller:
             ],
             "drains": {"counts": dict(self.drain_counts),
                        "pending": dict(self.pending_drains)},
+            "jobs": self.jobs.snapshot(),
         }
         tmp = self.persist_path + f".tmp{os.getpid()}"
         try:

@@ -353,6 +353,17 @@ class HostAgent:
                 except Exception:
                     pass
             return {"ok": True}
+        if kind == "kill_pgid":
+            # Job-plane orphan/stop sweep: escalate through one process
+            # group (a dead supervisor's entrypoint and its shell=True
+            # children live in their own session on THIS host). Runs off
+            # the agent loop — the grace window would stall heartbeats.
+            from .job_manager import kill_process_group
+
+            ok = await asyncio.to_thread(
+                kill_process_group, int(msg.get("pgid") or 0),
+                float(msg.get("grace_s") or 3.0))
+            return {"ok": bool(ok)}
         if kind == "free_object":
             loc = msg["loc"]
             from .object_store import free_location

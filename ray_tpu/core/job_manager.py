@@ -1,0 +1,739 @@
+"""Controller-side durable job table (reference: GcsJobManager + the
+dashboard/modules/job JobManager driving one JobSupervisor actor per job).
+
+The JobManager owns every job record — id, entrypoint, runtime env,
+attempt accounting, status history, supervisor actor id, current
+entrypoint process group — persisted in the --state-path snapshot so the
+table (and an in-flight ``wait_job`` cursor) survives a controller
+bounce. The per-job supervisor (ray_tpu/jobs.py) is a restartable
+detached actor; it never decides anything about attempts itself: every
+attempt starts with a ``job_attempt_start`` RPC here, which is where the
+retry budget, the capped-exponential backoff, and the PR 4/16 convention
+that preempted/drained deaths burn zero budget are enforced.
+
+Attempt accounting model: ``attempt`` counts every launch of the
+entrypoint (monotonic — the RTPU_JOB_ATTEMPT value), ``billed`` counts
+only launches that consumed retry budget. A launch following a planned
+departure (drain/preemption) is free; everything else — the first
+launch, relaunch after a nonzero exit, relaunch after a supervisor
+crash — bills one unit, and a billed launch that would exceed
+``max_attempts`` fails the job instead of starting.
+"""
+from __future__ import annotations
+
+import asyncio
+import os
+import signal
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from ray_tpu import flags
+
+# Mirrors jobs.JobStatus (jobs.py imports these — core must not import
+# the driver-side API back).
+PENDING = "PENDING"
+RUNNING = "RUNNING"
+RETRYING = "RETRYING"
+SUCCEEDED = "SUCCEEDED"
+FAILED = "FAILED"
+STOPPED = "STOPPED"
+TERMINAL_STATES = frozenset({SUCCEEDED, FAILED, STOPPED})
+
+# Actor-name prefix linking a supervisor actor back to its job record
+# (the controller's actor-death hooks key off it).
+SUPERVISOR_PREFIX = "_job:"
+# Pubsub channel prefix the supervisor subscribes to for stop requests.
+STOP_CHANNEL_PREFIX = "__job__:"
+
+JOB_RUNTIME_BOUNDARIES = [1.0, 5.0, 15.0, 60.0, 300.0, 1800.0,
+                          7200.0, 43200.0]
+
+_HISTORY_MAX = 50
+
+
+def stop_channel(job_id: str) -> str:
+    return STOP_CHANNEL_PREFIX + job_id
+
+
+def kill_process_group(pgid: int, grace_s: float = 3.0) -> bool:
+    """Terminate→kill escalation of one process group, reaped bounded.
+
+    The entrypoint runs in its own session (start_new_session=True), so
+    this takes down shell=True children and detached grandchildren the
+    old ``proc.terminate()`` leaked. Never signals pgid <= 1 or our own
+    group. Returns True once the group is observably gone."""
+    try:
+        pgid = int(pgid)
+    except (TypeError, ValueError):
+        return False
+    if pgid <= 1:
+        return False
+    try:
+        if pgid == os.getpgrp():
+            return False
+    except OSError:
+        pass
+    try:
+        os.killpg(pgid, signal.SIGTERM)
+    except ProcessLookupError:
+        return True
+    except OSError:
+        return False
+    deadline = time.monotonic() + max(0.0, float(grace_s))
+    while time.monotonic() < deadline:
+        try:
+            os.killpg(pgid, 0)
+        except ProcessLookupError:
+            return True
+        except OSError:
+            return False
+        time.sleep(0.05)
+    try:
+        os.killpg(pgid, signal.SIGKILL)
+    except ProcessLookupError:
+        return True
+    except OSError:
+        return False
+    # Reap window: direct children are reaped by their Popen owner;
+    # orphans reparent to init. Poll until the group is gone (bounded).
+    deadline = time.monotonic() + 2.0
+    while time.monotonic() < deadline:
+        try:
+            os.killpg(pgid, 0)
+        except ProcessLookupError:
+            return True
+        except OSError:
+            return False
+        time.sleep(0.05)
+    return False
+
+
+class JobManager:
+    """Job table + attempt protocol, living inside the controller."""
+
+    def __init__(self, ctrl) -> None:
+        self.ctrl = ctrl
+        import collections
+
+        # job_id -> record (plain dicts: they pickle into the state
+        # snapshot as-is). Insertion-ordered for bounded eviction.
+        self.jobs: "collections.OrderedDict[str, Dict[str, Any]]" = (
+            collections.OrderedDict())
+        # rtpu_job_attempts_total{cause} — persisted so the counter
+        # never goes backwards across a controller bounce.
+        self.attempt_counts: Dict[str, int] = {}
+        # rtpu_job_runtime_s histogram state (terminal-job runtimes).
+        self.runtime_hist: Dict[str, Any] = {
+            "buckets": [0] * len(JOB_RUNTIME_BOUNDARIES),
+            "sum": 0.0, "count": 0}
+        self._waiters: Dict[str, List[asyncio.Event]] = {}
+        self._gc_done = False
+
+    # ------------------------------------------------------------ plumbing
+
+    def _touch(self, rec: Dict[str, Any]) -> None:
+        """Bump the record's wait_job cursor and wake long-pollers."""
+        rec["seq"] = int(rec.get("seq", 0)) + 1
+        self.ctrl._state_dirty = True
+        for ev in self._waiters.pop(rec["job_id"], []):
+            ev.set()
+
+    async def _wait_change(self, job_id: str, timeout: float) -> None:
+        ev = asyncio.Event()
+        self._waiters.setdefault(job_id, []).append(ev)
+        try:
+            await asyncio.wait_for(ev.wait(), max(0.01, timeout))
+        except asyncio.TimeoutError:
+            pass
+        finally:
+            lst = self._waiters.get(job_id)
+            if lst is not None and ev in lst:
+                lst.remove(ev)
+
+    def _set_status(self, rec: Dict[str, Any], status: str,
+                    cause: Optional[str] = None) -> None:
+        rec["status"] = status
+        rec["history"].append({"status": status, "ts": time.time(),
+                               "cause": cause})
+        del rec["history"][:-_HISTORY_MAX]
+        if status in TERMINAL_STATES:
+            rec["finished_ts"] = time.time()
+            if rec.get("started_ts"):
+                self._observe_runtime(rec["finished_ts"]
+                                      - rec["started_ts"])
+        self._touch(rec)
+
+    def _observe_runtime(self, runtime_s: float) -> None:
+        h = self.runtime_hist
+        for i, b in enumerate(JOB_RUNTIME_BOUNDARIES):
+            if runtime_s <= b:
+                h["buckets"][i] += 1
+                break
+        h["sum"] += runtime_s
+        h["count"] += 1
+
+    def _emit(self, severity: str, kind: str, message: str,
+              rec: Dict[str, Any], **extra) -> None:
+        data = dict(extra.pop("data", None) or {})
+        data.setdefault("job_id", rec["job_id"])
+        ex = rec.get("exec") or {}
+        self.ctrl._emit_event(
+            severity, kind, message,
+            actor_id=rec.get("supervisor_actor_id"),
+            node_id=extra.pop("node_id", None) or ex.get("node_id"),
+            data=data, **extra)
+
+    def _gc_legacy_kv(self) -> None:
+        """Drop the pre-FT ``__jobs__`` KV rows (they rotted into
+        status="DEAD", entrypoint="?" listings); the job table is the
+        listing source of truth now."""
+        if self._gc_done:
+            return
+        self._gc_done = True
+        stale = [k for k in self.ctrl.kv if k[0] == "__jobs__"]
+        for k in stale:
+            self.ctrl.kv.pop(k, None)
+        if stale:
+            self.ctrl._state_dirty = True
+
+    def _evict(self) -> None:
+        cap = int(flags.get("RTPU_JOBS_MAX"))
+        if len(self.jobs) <= cap:
+            return
+        for jid in [j for j, r in self.jobs.items()
+                    if r["status"] in TERMINAL_STATES]:
+            if len(self.jobs) <= cap:
+                break
+            self.jobs.pop(jid, None)
+            self._waiters.pop(jid, None)
+
+    def public(self, rec: Dict[str, Any]) -> Dict[str, Any]:
+        ex = rec.get("exec") or {}
+        return {
+            "job_id": rec["job_id"],
+            "status": rec["status"],
+            "entrypoint": rec["entrypoint"],
+            "returncode": rec.get("returncode"),
+            "attempt": rec.get("attempt", 0),
+            "attempts_used": rec.get("billed", 0),
+            "max_attempts": rec.get("max_attempts"),
+            "message": rec.get("message"),
+            "stop_requested": bool(rec.get("stop_requested")),
+            "submitted_ts": rec.get("submitted_ts"),
+            "started_ts": rec.get("started_ts"),
+            "finished_ts": rec.get("finished_ts"),
+            "node_id": ex.get("node_id"),
+            "history": list(rec.get("history") or [])[-20:],
+        }
+
+    # ----------------------------------------------------------- lifecycle
+
+    def submit(self, msg: Dict[str, Any]) -> Dict[str, Any]:
+        self._gc_legacy_kv()
+        job_id = msg["job_id"]
+        if job_id in self.jobs:
+            # Idempotent: a driver retrying submit after a reconnect must
+            # not reset a live record.
+            return {"ok": True, "job_id": job_id,
+                    "record": self.public(self.jobs[job_id])}
+        rec: Dict[str, Any] = {
+            "job_id": job_id,
+            "entrypoint": msg.get("entrypoint") or "",
+            "env_vars": dict(msg.get("env_vars") or {}),
+            "working_dir": msg.get("working_dir"),
+            "num_cpus": float(msg.get("num_cpus") or 1.0),
+            "max_attempts": int(msg.get("max_attempts")
+                                or flags.get("RTPU_JOB_MAX_ATTEMPTS")),
+            "status": PENDING,
+            "returncode": None,
+            "message": None,
+            "attempt": 0,
+            "billed": 0,
+            "supervisor_actor_id": None,
+            "seq": 0,
+            "history": [],
+            "submitted_ts": time.time(),
+            "started_ts": None,
+            "finished_ts": None,
+            "stop_requested": False,
+            "pending_cause": None,
+            "exec": None,
+            "attempt_logs": [],
+            "last_tail": "",
+        }
+        self.jobs[job_id] = rec
+        self._set_status(rec, PENDING)
+        self._emit("INFO", "JOB_SUBMITTED",
+                   f"job {job_id} submitted: {rec['entrypoint'][:120]}",
+                   rec, data={"entrypoint": rec["entrypoint"],
+                              "max_attempts": rec["max_attempts"]})
+        self._evict()
+        return {"ok": True, "job_id": job_id, "record": self.public(rec)}
+
+    async def attempt_start(self, msg: Dict[str, Any]) -> Dict[str, Any]:
+        """A supervisor (fresh, restarted, or restored) asks to launch
+        the entrypoint. The controller is the attempt journal: it decides
+        run/stop/fail, bills the budget, computes the backoff, emits
+        JOB_STARTED / exactly one JOB_RETRYING per attempt, and
+        best-effort kills the previous attempt's orphaned process
+        group."""
+        job_id = msg.get("job_id") or ""
+        rec = self.jobs.get(job_id)
+        if rec is None:
+            return {"action": "fail", "error": f"unknown job {job_id!r}"}
+        if msg.get("actor_id"):
+            rec["supervisor_actor_id"] = msg["actor_id"]
+        if rec["status"] in TERMINAL_STATES:
+            return {"action": "stop", "status": rec["status"]}
+        if rec.get("stop_requested"):
+            self._set_status(rec, STOPPED, cause="stop requested")
+            self._emit("INFO", "JOB_STOPPED",
+                       f"job {job_id} stopped before attempt "
+                       f"{rec['attempt'] + 1} started", rec)
+            return {"action": "stop", "status": STOPPED}
+        # Orphan sweep: the previous attempt's process group survived its
+        # supervisor (SIGKILLed worker, preempted node) — tear it down
+        # before a replacement launches, so two attempts never overlap.
+        prev = rec.get("exec")
+        if prev and prev.get("pgid"):
+            self._spawn_exec_kill(dict(prev))
+        cause = rec.pop("pending_cause", None)
+        if cause is None:
+            if rec["attempt"] == 0:
+                cause = {"cause": "initial", "detail": "first attempt",
+                         "preempted": False}
+            else:
+                # Supervisor came back without the controller observing a
+                # death (live drain-migration restores take this path when
+                # the migration hook raced). Infer from the previous
+                # placement.
+                node = self.ctrl.nodes.get((prev or {}).get("node_id")
+                                           or "")
+                preempted = node is not None and (node.draining
+                                                  or node.drained)
+                cause = {"cause": "preempted" if preempted
+                         else "supervisor_restart",
+                         "detail": "supervisor restarted",
+                         "preempted": preempted}
+        billed = not cause.get("preempted")
+        if billed and rec["attempt"] > 0 \
+                and rec["billed"] >= rec["max_attempts"]:
+            self._fail(rec, f"retry budget exhausted "
+                            f"({rec['billed']}/{rec['max_attempts']} "
+                            f"attempts): {cause.get('detail')}")
+            return {"action": "fail", "status": FAILED}
+        if billed:
+            rec["billed"] += 1
+        rec["attempt"] += 1
+        label = cause.get("cause") or "unknown"
+        self.attempt_counts[label] = self.attempt_counts.get(label, 0) + 1
+        if rec["started_ts"] is None:
+            rec["started_ts"] = time.time()
+        # Backoff: capped-exponential over BILLED retries; preemption
+        # relaunches immediately (the departure was planned, the work is
+        # idle — waiting buys nothing).
+        if rec["attempt"] == 1 or not billed:
+            backoff = 0.0
+        else:
+            base = float(flags.get("RTPU_JOB_BACKOFF_BASE_S"))
+            cap = float(flags.get("RTPU_JOB_BACKOFF_MAX_S"))
+            backoff = min(base * (2.0 ** max(0, rec["billed"] - 2)), cap)
+        # Placement + durable log reference for this attempt: the
+        # supervisor's worker log file is where the entrypoint's output
+        # lands (actor-attributed), and the reference outlives the
+        # worker. The supervisor's run thread races actor_ready — its
+        # first attempt_start can arrive before the controller learned
+        # which worker hosts it — so wait briefly for the link.
+        aid = rec.get("supervisor_actor_id") or ""
+        actor = self.ctrl.actors.get(aid)
+        for _ in range(100):
+            if actor is not None and actor.worker_id:
+                break
+            await asyncio.sleep(0.05)
+            actor = self.ctrl.actors.get(aid)
+            rec = self.jobs.get(job_id)
+            if rec is None:
+                return {"action": "fail", "error": "job evicted"}
+        exec_info = {"node_id": actor.node_id if actor else None,
+                     "worker_id": actor.worker_id if actor else None,
+                     "pgid": None, "pid": None,
+                     "attempt": rec["attempt"]}
+        rec["exec"] = exec_info
+        ref = self.ctrl.worker_log_names.get(exec_info["worker_id"] or "")
+        logref = {"attempt": rec["attempt"],
+                  "node_id": (ref or {}).get("node_id")
+                  or exec_info["node_id"],
+                  "worker_id": exec_info["worker_id"],
+                  "name": (ref or {}).get("name")}
+        logs = rec["attempt_logs"]
+        if logref["name"] and not (
+                logs and logs[-1].get("name") == logref["name"]
+                and logs[-1].get("node_id") == logref["node_id"]):
+            logs.append(logref)
+        self._set_status(rec, RUNNING, cause=cause.get("cause"))
+        if rec["attempt"] == 1:
+            self._emit("INFO", "JOB_STARTED",
+                       f"job {job_id} started "
+                       f"(attempt 1/{rec['max_attempts']})", rec,
+                       data={"attempt": 1})
+        else:
+            self._emit(
+                "WARNING", "JOB_RETRYING",
+                f"job {job_id} retrying: attempt {rec['attempt']} "
+                f"({'free — preempted' if not billed else 'billed '+str(rec['billed'])+'/'+str(rec['max_attempts'])}, "
+                f"cause: {cause.get('cause')})", rec,
+                data={"attempt": rec["attempt"],
+                      "billed": rec["billed"],
+                      "cause": cause.get("cause"),
+                      "detail": cause.get("detail"),
+                      "preempted": not billed,
+                      "backoff_s": backoff})
+        return {"action": "run", "attempt": rec["attempt"],
+                "backoff_s": backoff,
+                "max_attempts": rec["max_attempts"]}
+
+    def attempt_exec(self, msg: Dict[str, Any]) -> Dict[str, Any]:
+        """The supervisor reports the spawned entrypoint's pid/pgid —
+        the child-pid state that makes stop/orphan-cleanup work after
+        the supervisor itself dies (persisted with the record)."""
+        rec = self.jobs.get(msg.get("job_id") or "")
+        if rec is None:
+            return {"ok": False}
+        if int(msg.get("attempt") or 0) != rec["attempt"]:
+            return {"ok": False, "stale": True}
+        ex = rec.get("exec") or {}
+        ex["pid"] = msg.get("pid")
+        ex["pgid"] = msg.get("pgid")
+        rec["exec"] = ex
+        self.ctrl._state_dirty = True
+        if rec.get("stop_requested"):
+            # stop_job raced the spawn: the supervisor's stop path kills
+            # the group too, but don't rely on it having seen the event.
+            self._spawn_exec_kill(dict(ex))
+        return {"ok": True}
+
+    def attempt_done(self, msg: Dict[str, Any]) -> Dict[str, Any]:
+        """The entrypoint exited. Decide: succeed / stop / retry / fail."""
+        rec = self.jobs.get(msg.get("job_id") or "")
+        if rec is None:
+            return {"action": "exit"}
+        if int(msg.get("attempt") or 0) != rec["attempt"]:
+            return {"action": "exit", "stale": True}
+        if rec["status"] in TERMINAL_STATES:
+            return {"action": "exit", "status": rec["status"]}
+        rc = msg.get("returncode")
+        tail = (msg.get("tail") or "")[-4096:]
+        ex = rec.get("exec")
+        if ex:
+            ex["pgid"] = None
+            ex["pid"] = None
+        rec["returncode"] = rc
+        if rec.get("stop_requested"):
+            self._set_status(rec, STOPPED, cause="stopped")
+            self._emit("INFO", "JOB_STOPPED",
+                       f"job {rec['job_id']} stopped "
+                       f"(returncode {rc})", rec,
+                       data={"returncode": rc})
+            return {"action": "exit", "status": STOPPED}
+        if rc == 0:
+            self._set_status(rec, SUCCEEDED, cause="exit 0")
+            self._emit("INFO", "JOB_SUCCEEDED",
+                       f"job {rec['job_id']} succeeded after "
+                       f"{rec['attempt']} attempt(s)", rec,
+                       data={"attempts": rec["attempt"],
+                             "billed": rec["billed"]})
+            return {"action": "exit", "status": SUCCEEDED}
+        rec["last_tail"] = tail
+        rec["message"] = f"attempt {rec['attempt']} exited {rc}"
+        if rec["billed"] < rec["max_attempts"]:
+            rec["pending_cause"] = {"cause": "exit",
+                                    "detail": f"exit code {rc}",
+                                    "preempted": False}
+            self._set_status(rec, RETRYING, cause=f"exit:{rc}")
+            self._emit("WARNING", "JOB_ATTEMPT_FAILED",
+                       f"job {rec['job_id']} attempt {rec['attempt']} "
+                       f"exited {rc} "
+                       f"({rec['billed']}/{rec['max_attempts']} billed)",
+                       rec,
+                       data={"attempt": rec["attempt"],
+                             "returncode": rc, "tail": tail[-1024:]})
+            return {"action": "retry"}
+        self._fail(rec, f"attempt {rec['attempt']} exited {rc}; "
+                        f"budget exhausted "
+                        f"({rec['billed']}/{rec['max_attempts']})")
+        return {"action": "exit", "status": FAILED}
+
+    def _fail(self, rec: Dict[str, Any], message: str) -> None:
+        rec["message"] = message
+        self._set_status(rec, FAILED, cause=message)
+        self._emit("ERROR", "JOB_FAILED",
+                   f"job {rec['job_id']} failed: {message}", rec,
+                   data={"attempts": rec["attempt"],
+                         "billed": rec["billed"],
+                         "returncode": rec.get("returncode"),
+                         "tail": rec.get("last_tail") or ""})
+
+    # -------------------------------------------- supervisor-death hooks
+    # Called from the controller's actor lifecycle paths, keyed on the
+    # `_job:` actor-name prefix.
+
+    def note_supervisor_died(self, actor, err: Exception,
+                             preempted: bool, fatal: bool) -> None:
+        job_id = (actor.name or "")[len(SUPERVISOR_PREFIX):]
+        rec = self.jobs.get(job_id)
+        if rec is None or rec["status"] in TERMINAL_STATES:
+            return
+        ex = rec.get("exec")
+        if ex and ex.get("pgid"):
+            # The entrypoint's process group outlived its supervisor:
+            # tear it down so the replacement attempt never overlaps it.
+            self._spawn_exec_kill(dict(ex))
+            ex["pgid"] = None
+        if fatal:
+            self._fail(rec, f"supervisor died permanently: "
+                            f"{type(err).__name__}: {err}")
+            return
+        rec["pending_cause"] = {
+            "cause": "preempted" if preempted else "worker_died",
+            "detail": f"{type(err).__name__}: {err}",
+            "preempted": preempted}
+        self._emit("WARNING", "JOB_SUPERVISOR_DIED",
+                   f"job {job_id} supervisor died "
+                   f"({'preempted' if preempted else 'crash'}): {err} — "
+                   f"rescheduling", rec,
+                   node_id=actor.node_id,
+                   data={"cause": f"{type(err).__name__}: {err}",
+                         "preempted": preempted})
+        self._touch(rec)
+
+    def note_supervisor_migrating(self, actor, node) -> None:
+        """Live drain-migration: the supervisor instance moves with its
+        state, but its entrypoint subprocess cannot — the restored
+        supervisor relaunches, and the relaunch is a planned departure
+        (zero budget)."""
+        job_id = (actor.name or "")[len(SUPERVISOR_PREFIX):]
+        rec = self.jobs.get(job_id)
+        if rec is None or rec["status"] in TERMINAL_STATES:
+            return
+        rec["pending_cause"] = {
+            "cause": "preempted",
+            "detail": f"node {node.node_id[:8]} draining "
+                      f"({node.drain_reason or 'drain'})",
+            "preempted": True}
+        self.ctrl._state_dirty = True
+
+    def _spawn_exec_kill(self, ex: Dict[str, Any]) -> None:
+        try:
+            loop = asyncio.get_running_loop()
+        except RuntimeError:
+            return
+        loop.create_task(self._kill_exec(ex))
+
+    async def _kill_exec(self, ex: Dict[str, Any]) -> None:
+        """Kill one attempt's process group wherever it lives: via the
+        owning host agent's kill_pgid handler, or locally for head-host
+        and virtual-node spawns."""
+        pgid = ex.get("pgid")
+        if not pgid:
+            return
+        grace = float(flags.get("RTPU_JOB_STOP_GRACE_S"))
+        node = self.ctrl.nodes.get(ex.get("node_id") or "")
+        try:
+            if node is not None and node.agent_conn is not None:
+                await node.agent_conn.request(
+                    {"kind": "kill_pgid", "pgid": pgid, "grace_s": grace},
+                    timeout=grace + 10)
+            elif node is not None and (
+                    not node.host_id
+                    or node.host_id == self.ctrl.host_id):
+                # Head-host / virtual-node spawn (or the node's agent died
+                # but the processes share this machine): kill locally. A
+                # pgid from a genuinely different host must NOT be
+                # signalled here — the number could collide with an
+                # unrelated local group.
+                await asyncio.to_thread(kill_process_group, pgid, grace)
+        except Exception:
+            pass
+
+    # ------------------------------------------------------------- queries
+
+    def status(self, job_id: str) -> Dict[str, Any]:
+        rec = self.jobs.get(job_id)
+        if rec is None:
+            return {"error": f"unknown job {job_id!r}"}
+        return {"record": self.public(rec), "seq": rec["seq"]}
+
+    def list(self) -> List[Dict[str, Any]]:
+        return [self.public(r) for r in self.jobs.values()]
+
+    async def wait(self, msg: Dict[str, Any]) -> Dict[str, Any]:
+        """Long-poll on one job's status sequence (the get_events
+        after_seq pattern): returns as soon as the record changed past
+        ``after_seq``, immediately for terminal jobs, or when the wait
+        window closes."""
+        job_id = msg.get("job_id") or ""
+        rec = self.jobs.get(job_id)
+        if rec is None:
+            return {"error": f"unknown job {job_id!r}"}
+        after = int(msg.get("after_seq") or 0)
+        deadline = time.monotonic() + max(
+            0.0, min(float(msg.get("wait_s") or 0), 30.0))
+        while (rec["seq"] <= after
+               and rec["status"] not in TERMINAL_STATES):
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                break
+            await self._wait_change(job_id, remaining)
+            rec = self.jobs.get(job_id)
+            if rec is None:
+                return {"error": f"unknown job {job_id!r}"}
+        return {"record": self.public(rec), "seq": rec["seq"]}
+
+    async def stop(self, msg: Dict[str, Any]) -> Dict[str, Any]:
+        """Stop a job: mark it (persisted), nudge the supervisor over
+        pubsub (it escalates through the entrypoint's process group),
+        and kill the recorded process group directly in case the
+        supervisor is mid-failover."""
+        job_id = msg.get("job_id") or ""
+        rec = self.jobs.get(job_id)
+        if rec is None:
+            return {"ok": False, "error": f"unknown job {job_id!r}"}
+        if rec["status"] in TERMINAL_STATES:
+            return {"ok": True, "status": rec["status"]}
+        rec["stop_requested"] = True
+        self._touch(rec)
+        try:
+            await self.ctrl._h_publish(
+                None, {"channel": stop_channel(job_id),
+                       "data": {"op": "stop"}})
+        except Exception:
+            pass
+        ex = rec.get("exec")
+        if ex and ex.get("pgid"):
+            self._spawn_exec_kill(dict(ex))
+        aid = rec.get("supervisor_actor_id") or ""
+        actor = self.ctrl.actors.get(aid)
+        if actor is None or actor.state == "dead":
+            self._set_status(rec, STOPPED, cause="stop requested")
+            self._emit("INFO", "JOB_STOPPED",
+                       f"job {job_id} stopped (no live supervisor)", rec)
+        return {"ok": True, "status": rec["status"]}
+
+    def stop_ack(self, msg: Dict[str, Any]) -> Dict[str, Any]:
+        """Supervisor acknowledges a stop that arrived while no attempt
+        was running (e.g. during backoff)."""
+        rec = self.jobs.get(msg.get("job_id") or "")
+        if rec is None or rec["status"] in TERMINAL_STATES:
+            return {"ok": True}
+        if rec.get("stop_requested"):
+            self._set_status(rec, STOPPED, cause="stop requested")
+            self._emit("INFO", "JOB_STOPPED",
+                       f"job {rec['job_id']} stopped", rec)
+        return {"ok": True}
+
+    async def logs(self, msg: Dict[str, Any]) -> Dict[str, Any]:
+        """Durable job logs: walk the per-attempt log-file references in
+        order, reading each file's supervisor-attributed ranges through
+        the cluster log plane. The cursor is {i: attempt-ref index,
+        offset: attributed-stream offset}, so a follow stream crosses a
+        supervisor failover by rolling from the dead attempt's file
+        (wherever it lives) onto the replacement's."""
+        job_id = msg.get("job_id") or ""
+        rec = self.jobs.get(job_id)
+        cur = dict(msg.get("cursor") or {})
+        cur = {"i": int(cur.get("i") or 0),
+               "offset": int(cur.get("offset") or 0)}
+        if rec is None:
+            return {"error": f"unknown job {job_id!r}", "data": "",
+                    "cursor": cur, "eof": True, "status": None}
+        max_bytes = min(int(msg.get("max_bytes") or 65536), 1 << 20)
+        deadline = time.monotonic() + max(
+            0.0, min(float(msg.get("wait_s") or 0), 10.0))
+        while True:
+            refs = [r for r in rec["attempt_logs"] if r.get("name")]
+            terminal = rec["status"] in TERMINAL_STATES
+            if cur["i"] >= len(refs):
+                if terminal:
+                    return {"data": "", "cursor": cur, "eof": True,
+                            "status": rec["status"]}
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return {"data": "", "cursor": cur, "eof": False,
+                            "status": rec["status"]}
+                await self._wait_change(job_id, remaining)
+                rec = self.jobs.get(job_id) or rec
+                continue
+            ref = refs[cur["i"]]
+            last = cur["i"] == len(refs) - 1
+            m: Dict[str, Any] = {
+                "name": ref["name"], "node_id": ref.get("node_id"),
+                "actor_id": rec.get("supervisor_actor_id"),
+                "offset": cur["offset"], "max_bytes": max_bytes}
+            if last and not terminal:
+                m["wait_s"] = max(
+                    0.0, min(deadline - time.monotonic(), 10.0))
+            out = await self.ctrl._fetch_log(m)
+            data = out.get("data") or ""
+            if data:
+                cur = {"i": cur["i"],
+                       "offset": int(out.get("offset")
+                                     or cur["offset"] + len(data))}
+                return {"data": data, "cursor": cur, "eof": False,
+                        "status": rec["status"]}
+            if not last:
+                # This attempt's stream is drained (or its host is
+                # gone): roll onto the next attempt's file.
+                cur = {"i": cur["i"] + 1, "offset": 0}
+                continue
+            if terminal:
+                return {"data": "", "cursor": cur, "eof": True,
+                        "status": rec["status"]}
+            if time.monotonic() >= deadline:
+                return {"data": "", "cursor": cur, "eof": False,
+                        "status": rec["status"]}
+
+    # -------------------------------------------------------- persistence
+
+    def snapshot(self) -> Dict[str, Any]:
+        return {"jobs": [dict(r) for r in self.jobs.values()],
+                "attempt_counts": dict(self.attempt_counts),
+                "runtime_hist": {
+                    "buckets": list(self.runtime_hist["buckets"]),
+                    "sum": self.runtime_hist["sum"],
+                    "count": self.runtime_hist["count"]}}
+
+    def restore(self, snap: Optional[Dict[str, Any]]) -> None:
+        if not snap:
+            return
+        for rec in snap.get("jobs") or []:
+            if not isinstance(rec, dict) or not rec.get("job_id"):
+                continue
+            self.jobs[rec["job_id"]] = rec
+        self.attempt_counts.update(snap.get("attempt_counts") or {})
+        rh = snap.get("runtime_hist") or {}
+        if rh.get("buckets") and len(rh["buckets"]) == len(
+                JOB_RUNTIME_BOUNDARIES):
+            self.runtime_hist = {"buckets": list(rh["buckets"]),
+                                 "sum": float(rh.get("sum", 0.0)),
+                                 "count": int(rh.get("count", 0))}
+        self._gc_legacy_kv()
+
+    # ------------------------------------------------------------- metrics
+
+    def status_counts(self) -> Dict[Tuple, int]:
+        out: Dict[Tuple, int] = {}
+        for rec in self.jobs.values():
+            key = (("status", rec["status"]),)
+            out[key] = out.get(key, 0) + 1
+        return out
+
+    def attempt_count_data(self) -> Dict[Tuple, int]:
+        return {(("cause", c),): n
+                for c, n in self.attempt_counts.items()}
+
+    def runtime_hist_data(self) -> Dict[Tuple, Any]:
+        h = self.runtime_hist
+        if not h["count"]:
+            return {}
+        return {(): {"buckets": list(h["buckets"]),
+                     "sum": round(h["sum"], 3), "count": h["count"]}}

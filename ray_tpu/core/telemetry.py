@@ -334,6 +334,12 @@ DEFAULT_ALERT_RULES: List[dict] = [
      "message": "compiled-DAG edge writer blocked on ring space >90% of "
                 "wall time for 30s — the consumer stage cannot keep up; "
                 "run `rtpu dag stats` for the attribution"},
+    {"name": "job_flapping", "metric": "rtpu_job_attempts_total",
+     "stat": "rate", "op": ">", "threshold": 0.2, "for_s": 30.0,
+     "severity": "WARNING",
+     "message": "job entrypoints relaunching >0.2/s for 30s — a job is "
+                "crash-looping through its retry budget; check `rtpu job "
+                "list` and the JOB_RETRYING events for the cause"},
 ]
 
 

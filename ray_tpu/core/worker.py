@@ -650,6 +650,13 @@ class WorkerRuntime:
 
             def flush(self) -> None:
                 self._inner.flush()
+                # An explicit flush is a visibility request: publish the
+                # pending attribution range too, so a live `rtpu logs`
+                # follower sees the line now, not at the next context
+                # switch or batching threshold.
+                attr = runtime._log_attributor
+                if attr is not None:
+                    attr.flush()
 
             def __getattr__(self, name):
                 return getattr(self._inner, name)
@@ -1736,6 +1743,11 @@ class WorkerRuntime:
 
             _held = ownership.acquire_spec_refs(spec)  # noqa: F841
             try:
+                # Set before instantiating: constructors may legitimately
+                # ask for their own id (ray parity: get_runtime_context()
+                # works inside __init__), and threads an actor spawns from
+                # its constructor inherit it by copying.
+                ctx.task_local.actor_id = actor_id
                 rec = self._restore_record(spec, mb)
                 restored_epoch = None
                 if rec is not None:
@@ -1760,7 +1772,6 @@ class WorkerRuntime:
                     cls = self._load_function(spec["func_id"])
                     args, kwargs = self._resolve_args(spec)
                     mb.instance = cls(*args, **kwargs)
-                ctx.task_local.actor_id = actor_id
                 ready: Dict[str, Any] = {"kind": "actor_ready",
                                          "actor_id": actor_id}
                 if restored_epoch is not None:

@@ -476,6 +476,13 @@ class Dashboard:
 
     @staticmethod
     def _jobs():
+        from ray_tpu import flags
+
+        if flags.get("RTPU_JOBS_FT"):
+            # Durable job table: full records (attempt accounting,
+            # placement, bounded status history) straight from the
+            # controller — terminal jobs keep real status/returncode.
+            return state_api.list_jobs()
         from ray_tpu.jobs import JobSubmissionClient
 
         return [vars(j) for j in JobSubmissionClient().list_jobs()]

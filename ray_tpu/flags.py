@@ -450,6 +450,52 @@ _define("RTPU_EVENTS_BUF", int, 2048,
         "Per-process bounded buffer of unshipped cluster events: oldest "
         "drop first when the controller is unreachable longer than the "
         "buffer covers.")
+_define("RTPU_JOBS_FT", bool, True,
+        "Durable job plane (core/job_manager.py + jobs.py): the controller "
+        "owns a persisted job table, the per-job supervisor is a "
+        "restartable checkpointed actor whose attempts survive worker "
+        "SIGKILL / node death / drain preemption under a capped-"
+        "exponential retry budget, job output streams into the worker-log "
+        "plane, and wait_job becomes a controller long-poll (reference: "
+        "GcsJobManager + dashboard/modules/job JobSupervisor semantics). "
+        "0 keeps the legacy fail-fast supervisor: job dies with its "
+        "worker, in-memory logs, busy-poll waits.")
+_define("RTPU_JOB_MAX_ATTEMPTS", int, 3,
+        "Default entrypoint attempt budget per job (submit_job "
+        "max_attempts overrides). Crashed/failed attempts consume budget; "
+        "attempts lost to a draining/preempted node never do (the "
+        "PR 4/16 planned-departure convention).")
+_define("RTPU_JOB_BACKOFF_BASE_S", float, 0.5,
+        "Base delay of the capped-exponential backoff between billed job "
+        "attempts (retry n sleeps min(base * 2^(n-1), RTPU_JOB_BACKOFF_"
+        "MAX_S)); preemption-driven restarts relaunch immediately.")
+_define("RTPU_JOB_BACKOFF_MAX_S", float, 30.0,
+        "Upper bound on the exponential backoff between job attempts.")
+_define("RTPU_JOB_STOP_GRACE_S", float, 3.0,
+        "stop_job escalation grace: SIGTERM the entrypoint's whole "
+        "process group, wait this long, then SIGKILL whatever survives "
+        "(shell=True children included) and reap before returning.")
+_define("RTPU_JOB_SUP_CHECKPOINT_S", float, 5.0,
+        "checkpoint_interval_s applied to FT job supervisor actors: the "
+        "hosting worker durably snapshots the supervisor (attempt number "
+        "+ child-pid state) this often, so a restore resumes attempt "
+        "accounting instead of starting cold. 0 disables supervisor "
+        "checkpoints (the controller job table still survives).")
+_define("RTPU_JOBS_MAX", int, 1000,
+        "Bound on the controller job table: once exceeded, the oldest "
+        "TERMINAL job records are evicted (running jobs are never "
+        "dropped).")
+_define("RTPU_JOB_ID", str, None,
+        "Set by the job supervisor in every entrypoint's environment: the "
+        "submission id of the job this driver belongs to. Resumable "
+        "drivers key their checkpoints/DataIterator resume_key off it.",
+        external=True)
+_define("RTPU_JOB_ATTEMPT", str, None,
+        "Set by the job supervisor in every entrypoint's environment: "
+        "1-based attempt number of this launch. Attempt 1 starts cold; "
+        "attempt >1 should restore from RTPU_JOB_ID-keyed state instead "
+        "of restarting from scratch.",
+        external=True)
 _define("RTPU_HANG_WATCHDOG", bool, True,
         "Controller watchdog sweeping running tasks/actor calls for hangs "
         "and stragglers: a task older than max(RTPU_HANG_MIN_S, "

@@ -1,58 +1,316 @@
 """Job submission: run an entrypoint command on the cluster, track status.
 
 Parity: reference dashboard/modules/job/ (JobSubmissionClient job_sdk,
-JobManager spawning a supervisor actor per job that runs the entrypoint as a
-subprocess and streams logs — dashboard/modules/job/job_manager.py). Here
-the supervisor is a detached named actor; logs and status live in the
-controller KV so any driver can query them.
+JobManager spawning a supervisor actor per job that runs the entrypoint as
+a subprocess and streams logs — dashboard/modules/job/job_manager.py).
+
+Under ``RTPU_JOBS_FT`` (default on) jobs are durable: the controller job
+table (core/job_manager.py) owns every record and the supervisor here is a
+restartable checkpointed detached actor. Each entrypoint launch is one
+*attempt* negotiated with the controller (``job_attempt_start`` →
+``job_exec`` → ``job_attempt_done``), so when the supervisor's worker — or
+its whole node — dies mid-job, the controller reschedules the supervisor
+on another live node and the replacement resumes at the next attempt with
+the budget, backoff, and preemption accounting enforced centrally. The
+entrypoint runs in its own process group (terminate→kill escalation, no
+leaked shell children) and gets ``RTPU_JOB_ID``/``RTPU_JOB_ATTEMPT`` so
+resumable drivers (DataIterator(resume_key=), checkpointed actors) splice
+instead of restarting cold. Output goes through the worker's log plane
+with actor attribution, which is what makes ``rtpu job logs --follow``
+survive a failover mid-stream.
+
+``RTPU_JOBS_FT=0`` keeps the legacy fail-fast supervisor: spawn in the
+constructor, in-memory logs, busy-poll waits, job dies with its worker.
 """
 from __future__ import annotations
 
 from ray_tpu import flags
 
-import os
+import collections
 import subprocess
 import sys
 import threading
 import time
+import traceback
 import uuid
 from dataclasses import dataclass
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, Iterator, List, Optional
 
 import ray_tpu
+from ray_tpu.core.job_manager import (TERMINAL_STATES, kill_process_group,
+                                      stop_channel)
 
-_KV_NS = "__jobs__"
+_KV_NS = "__jobs__"  # legacy listing namespace (GC'd by the controller)
+
+_TAIL_LINES = 120  # stderr/stdout tail kept per attempt for JOB_FAILED
 
 
 class JobStatus:
     PENDING = "PENDING"
     RUNNING = "RUNNING"
+    RETRYING = "RETRYING"
     SUCCEEDED = "SUCCEEDED"
     FAILED = "FAILED"
     STOPPED = "STOPPED"
 
 
+def _ft() -> bool:
+    return bool(flags.get("RTPU_JOBS_FT"))
+
+
 class _JobSupervisor:
-    """Detached actor owning one job's entrypoint subprocess."""
+    """Detached actor owning one job's entrypoint subprocess.
+
+    FT mode: a supervision loop (daemon thread) that asks the controller
+    for permission before every launch and reports every exit — the
+    controller's job table is the attempt journal, so a restarted or
+    restored supervisor instance just rejoins the loop; it never guesses
+    attempt numbers itself. The instance is checkpoint-picklable:
+    ``__getstate__`` drops the live subprocess/threads and
+    ``__setstate__`` re-arms the loop on the restore host."""
 
     def __init__(self, job_id: str, entrypoint: str,
                  env_vars: Optional[Dict[str, str]] = None,
                  working_dir: Optional[str] = None):
+        from ray_tpu.core import context as ctx
+
         self.job_id = job_id
         self.entrypoint = entrypoint
+        self.env_vars = dict(env_vars or {})
+        self.working_dir = working_dir
         self.log_lines: List[str] = []
         self.status = JobStatus.PENDING
         self.returncode: Optional[int] = None
-        env = flags.child_env()
-        env.update(env_vars or {})
+        self.attempt = 0
         # The job's driver connects to THIS cluster.
+        self._address = ctx.get_worker_context().extra.get(
+            "address", "") or flags.get("RTPU_CONTROLLER", default="")
+        self._proc: Optional[subprocess.Popen] = None
+        self._stop_event = threading.Event()
+        self._tail: "collections.deque[str]" = collections.deque(
+            maxlen=_TAIL_LINES)
+        if not _ft():
+            self._legacy_spawn()
+            return
+        self._actor_id = ctx.current_actor_id()
+        self._arm()
+
+    # ------------------------------------------------------------ FT loop
+
+    def _arm(self) -> None:
+        """Subscribe the stop channel and start the supervision loop —
+        called from the constructor AND from ``__setstate__`` after a
+        checkpoint restore on a new worker."""
         from ray_tpu.core import context as ctx
 
-        env["RTPU_ADDRESS"] = ctx.get_worker_context().extra.get(
-            "address", "") or flags.get("RTPU_CONTROLLER", default="")
+        ch = stop_channel(self.job_id)
+        ctx.on_pubsub(ch, self._on_stop_msg)
+        try:
+            ctx.get_worker_context().client.request(
+                {"kind": "subscribe", "channel": ch})
+        except Exception:
+            pass
+        self._runner = threading.Thread(
+            target=self._run, name=f"job-supervisor:{self.job_id}",
+            daemon=True)
+        self._runner.start()
+
+    def _rpc(self, msg: Dict[str, Any],
+             timeout: Optional[float] = None) -> Any:
+        """Controller RPC with a bounded retry window: the supervision
+        loop must ride out a controller bounce (the client reconnects and
+        replays subscriptions underneath)."""
+        from ray_tpu.core import context as ctx
+
+        deadline = time.monotonic() + 120.0
+        while True:
+            try:
+                return ctx.get_worker_context().client.request(
+                    msg, timeout)
+            except Exception:
+                if time.monotonic() >= deadline:
+                    raise
+                time.sleep(1.0)
+
+    def _rpc_quiet(self, msg: Dict[str, Any]) -> None:
+        try:
+            self._rpc(msg)
+        except Exception:
+            pass
+
+    def _run(self) -> None:
+        from ray_tpu.core import context as ctx
+
+        # Everything this thread writes to stdout/stderr is stamped with
+        # the supervisor's actor id by the worker's log tee — that
+        # attribution is the durable per-attempt log stream the job-log
+        # walker reads (rotation-safe, survives this very worker dying).
+        if not self._actor_id:
+            # Constructor context missed the id (shouldn't happen, but
+            # the attribution chain is load-bearing): the supervisor is a
+            # named actor, so the controller's registry has it.
+            for _ in range(60):
+                resp = self._rpc_quiet(
+                    {"kind": "get_named_actor",
+                     "name": f"_job:{self.job_id}"}) or {}
+                if resp.get("actor_id"):
+                    self._actor_id = resp["actor_id"]
+                    break
+                if self._stop_event.wait(0.5):
+                    return
+        ctx.task_local.actor_id = self._actor_id
+        ctx.task_local.task_id = None
+        while True:
+            try:
+                resp = self._rpc({"kind": "job_attempt_start",
+                                  "job_id": self.job_id,
+                                  "actor_id": self._actor_id}) or {}
+            except Exception:
+                return  # controller gone past the retry window
+            action = resp.get("action")
+            if action != "run":
+                if (action == "fail" and self.attempt == 0
+                        and "unknown job" in (resp.get("error") or "")):
+                    # Submitter ran with RTPU_JOBS_FT=0 (no table row)
+                    # but this worker sees the flag on: degrade to the
+                    # legacy fail-fast supervisor instead of failing a
+                    # job that was never registered.
+                    self._legacy_spawn()
+                    return
+                self.status = resp.get("status") or (
+                    JobStatus.FAILED if action == "fail"
+                    else JobStatus.STOPPED)
+                return
+            self.attempt = int(resp.get("attempt") or 1)
+            backoff = float(resp.get("backoff_s") or 0.0)
+            if backoff:
+                self._stop_event.wait(backoff)
+            if self._stop_event.is_set():
+                self._rpc_quiet({"kind": "job_stop_ack",
+                                 "job_id": self.job_id})
+                self.status = JobStatus.STOPPED
+                return
+            self.status = JobStatus.RUNNING
+            rc, tail = self._run_attempt()
+            self.returncode = rc
+            try:
+                resp = self._rpc({"kind": "job_attempt_done",
+                                  "job_id": self.job_id,
+                                  "attempt": self.attempt,
+                                  "returncode": rc,
+                                  "tail": tail}) or {}
+            except Exception:
+                return
+            if resp.get("action") == "retry":
+                self.status = JobStatus.RETRYING
+                continue
+            self.status = resp.get("status") or (
+                JobStatus.SUCCEEDED if rc == 0 else JobStatus.FAILED)
+            return
+
+    def _child_env(self) -> Dict[str, str]:
+        env = flags.child_env()
+        env.update(self.env_vars)
+        env["RTPU_ADDRESS"] = self._address
+        # Resume contract: a driver that finds the same RTPU_JOB_ID with
+        # RTPU_JOB_ATTEMPT > 1 knows it is a relaunch of itself and can
+        # splice from its own checkpoints instead of restarting cold.
+        env["RTPU_JOB_ID"] = self.job_id
+        env["RTPU_JOB_ATTEMPT"] = str(self.attempt)
+        return env
+
+    def _run_attempt(self) -> "tuple[int, str]":
+        """One entrypoint launch: own process group, pid/pgid journaled
+        with the controller before any output, lines streamed through the
+        attributed log plane + kept as a bounded in-memory tail."""
+        try:
+            proc = subprocess.Popen(
+                self.entrypoint, shell=True, env=self._child_env(),
+                cwd=self.working_dir or None, stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT, text=True,
+                start_new_session=True)
+        except Exception:
+            tb = traceback.format_exc()
+            self._tail.extend(tb.splitlines(keepends=True)[-10:])
+            sys.stdout.write(f"[job {self.job_id}] spawn failed: {tb}\n")
+            sys.stdout.flush()
+            return 127, tb[-2048:]
+        self._proc = proc
+        self._rpc_quiet({"kind": "job_exec", "job_id": self.job_id,
+                         "attempt": self.attempt, "pid": proc.pid,
+                         "pgid": proc.pid})
+        try:
+            for line in proc.stdout:
+                self._tail.append(line)
+                self.log_lines.append(line)
+                if len(self.log_lines) > 10_000:
+                    del self.log_lines[:1000]
+                sys.stdout.write(line)
+                sys.stdout.flush()
+        except Exception:
+            pass
+        rc = proc.wait()
+        self._proc = None
+        return rc, "".join(self._tail)[-2048:]
+
+    # -------------------------------------------------------------- stop
+
+    def _on_stop_msg(self, data: Any) -> None:
+        # Delivered on the worker's message loop: stop() blocks through
+        # the kill escalation, so it must run on its own thread or the
+        # loop (heartbeats, task dispatch, RPC replies) stalls with it.
+        if isinstance(data, dict) and data.get("op") == "stop":
+            threading.Thread(target=self.stop, daemon=True).start()
+
+    # ------------------------------------------------- checkpoint contract
+
+    def __getstate__(self) -> Dict[str, Any]:
+        """Checkpoint payload: config + attempt cursor + log tail. Live
+        handles (subprocess, threads, events) never travel — the restore
+        host re-arms and the controller table supplies the truth."""
+        return {
+            "job_id": self.job_id,
+            "entrypoint": self.entrypoint,
+            "env_vars": dict(self.env_vars),
+            "working_dir": self.working_dir,
+            "status": self.status,
+            "returncode": self.returncode,
+            "attempt": self.attempt,
+            "_address": self._address,
+            "_actor_id": getattr(self, "_actor_id", None),
+            "tail": list(self._tail),
+            "log_lines": self.log_lines[-1000:],
+        }
+
+    def __setstate__(self, state: Dict[str, Any]) -> None:
+        self.job_id = state["job_id"]
+        self.entrypoint = state["entrypoint"]
+        self.env_vars = dict(state.get("env_vars") or {})
+        self.working_dir = state.get("working_dir")
+        self.status = state.get("status") or JobStatus.PENDING
+        self.returncode = state.get("returncode")
+        self.attempt = int(state.get("attempt") or 0)
+        self._address = state.get("_address") or ""
+        self._actor_id = state.get("_actor_id")
+        self.log_lines = list(state.get("log_lines") or [])
+        self._proc = None
+        self._stop_event = threading.Event()
+        self._tail = collections.deque(state.get("tail") or [],
+                                       maxlen=_TAIL_LINES)
+        if _ft():
+            self._arm()
+
+    # ------------------------------------------------------------- legacy
+
+    def _legacy_spawn(self) -> None:
+        env = flags.child_env()
+        env.update(self.env_vars)
+        env["RTPU_ADDRESS"] = self._address
         self.proc = subprocess.Popen(
-            entrypoint, shell=True, env=env, cwd=working_dir or None,
-            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+            self.entrypoint, shell=True, env=env,
+            cwd=self.working_dir or None, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True, start_new_session=True)
+        self._proc = self.proc
         self.status = JobStatus.RUNNING
         self._pump = threading.Thread(target=self._pump_logs, daemon=True)
         self._pump.start()
@@ -65,19 +323,43 @@ class _JobSupervisor:
         rc = self.proc.wait()
         self.returncode = rc
         if self.status != JobStatus.STOPPED:
-            self.status = JobStatus.SUCCEEDED if rc == 0 else JobStatus.FAILED
+            self.status = (JobStatus.SUCCEEDED if rc == 0
+                           else JobStatus.FAILED)
+
+    # ------------------------------------------------------------- shared
 
     def get_status(self) -> Dict[str, Any]:
         return {"job_id": self.job_id, "status": self.status,
-                "returncode": self.returncode, "entrypoint": self.entrypoint}
+                "returncode": self.returncode,
+                "entrypoint": self.entrypoint, "attempt": self.attempt}
 
     def get_logs(self) -> str:
         return "".join(self.log_lines)
 
     def stop(self) -> None:
-        if self.proc.poll() is None:
+        """Stop the job: escalate through the entrypoint's whole process
+        group (SIGTERM → grace → SIGKILL) and reap — shell=True children
+        and detached grandchildren go down with it, where the old
+        ``proc.terminate()`` only reached the shell."""
+        self._stop_event.set()
+        proc = self._proc
+        if proc is not None and proc.poll() is None:
             self.status = JobStatus.STOPPED
-            self.proc.terminate()
+            kill_process_group(
+                proc.pid, float(flags.get("RTPU_JOB_STOP_GRACE_S")))
+            try:
+                proc.wait(timeout=5)
+            except Exception:
+                pass
+        elif _ft() and self.status not in (JobStatus.SUCCEEDED,
+                                           JobStatus.FAILED,
+                                           JobStatus.STOPPED):
+            # No attempt in flight (backoff window / between attempts):
+            # tell the controller directly so the record goes STOPPED
+            # even if the run thread is asleep.
+            self.status = JobStatus.STOPPED
+            self._rpc_quiet({"kind": "job_stop_ack",
+                             "job_id": self.job_id})
 
 
 @dataclass
@@ -86,6 +368,26 @@ class JobDetails:
     status: str
     entrypoint: str
     returncode: Optional[int] = None
+    attempt: int = 0
+    attempts_used: int = 0
+    max_attempts: Optional[int] = None
+    message: Optional[str] = None
+    node_id: Optional[str] = None
+    submitted_ts: Optional[float] = None
+    finished_ts: Optional[float] = None
+
+
+def _details(rec: Dict[str, Any]) -> JobDetails:
+    return JobDetails(
+        job_id=rec["job_id"], status=rec["status"],
+        entrypoint=rec.get("entrypoint") or "",
+        returncode=rec.get("returncode"),
+        attempt=int(rec.get("attempt") or 0),
+        attempts_used=int(rec.get("attempts_used") or 0),
+        max_attempts=rec.get("max_attempts"),
+        message=rec.get("message"), node_id=rec.get("node_id"),
+        submitted_ts=rec.get("submitted_ts"),
+        finished_ts=rec.get("finished_ts"))
 
 
 class JobSubmissionClient:
@@ -99,6 +401,12 @@ class JobSubmissionClient:
                 raise RuntimeError(
                     "pass address=... or ray_tpu.init() first")
 
+    def _request(self, msg: Dict[str, Any],
+                 timeout: Optional[float] = None) -> Any:
+        from ray_tpu.core import context as ctx
+
+        return ctx.get_worker_context().client.request(msg, timeout)
+
     def submit_job(
         self,
         *,
@@ -106,50 +414,124 @@ class JobSubmissionClient:
         submission_id: Optional[str] = None,
         runtime_env: Optional[Dict[str, Any]] = None,
         entrypoint_num_cpus: float = 1.0,
+        max_attempts: Optional[int] = None,
+        _scheduling_strategy: Any = None,
     ) -> str:
         job_id = submission_id or f"rtpu-job-{uuid.uuid4().hex[:10]}"
         renv = runtime_env or {}
+        opts: Dict[str, Any] = {
+            "name": f"_job:{job_id}", "lifetime": "detached",
+            "num_cpus": entrypoint_num_cpus}
+        if _ft():
+            # Record first: the supervisor's loop asks the controller for
+            # permission before every launch, so the table row must exist
+            # before the actor's constructor runs anywhere.
+            self._request({
+                "kind": "job_submit", "job_id": job_id,
+                "entrypoint": entrypoint,
+                "env_vars": renv.get("env_vars") or {},
+                "working_dir": renv.get("working_dir"),
+                "num_cpus": entrypoint_num_cpus,
+                "max_attempts": max_attempts})
+            # Effectively-unbounded actor restarts: the JOB's budget is
+            # max_attempts, enforced by the controller table — the actor
+            # restart counter must never be the binding constraint.
+            opts.update(
+                max_restarts=1_000_000,
+                checkpoint_interval_s=flags.get("RTPU_JOB_SUP_CHECKPOINT_S"))
+        if _scheduling_strategy is not None:
+            opts["scheduling_strategy"] = _scheduling_strategy
         sup = (
             ray_tpu.remote(_JobSupervisor)
-            .options(name=f"_job:{job_id}", lifetime="detached",
-                     num_cpus=entrypoint_num_cpus)
+            .options(**opts)
             .remote(job_id, entrypoint, renv.get("env_vars"),
                     renv.get("working_dir"))
         )
         # Surface constructor errors now (bad working_dir etc.).
         ray_tpu.get(sup.get_status.remote(), timeout=60)
-        self._kv_record(job_id)
+        if not _ft():
+            self._kv_record(job_id)
         return job_id
 
     def _kv_record(self, job_id: str) -> None:
-        from ray_tpu.core import context as ctx
-
-        ctx.get_worker_context().client.request(
+        self._request(
             {"kind": "kv_put", "ns": _KV_NS, "key": job_id, "value": b"1"})
 
     def _sup(self, job_id: str):
         return ray_tpu.get_actor(f"_job:{job_id}")
 
+    def _record(self, job_id: str) -> Dict[str, Any]:
+        resp = self._request({"kind": "job_status", "job_id": job_id})
+        if resp.get("error"):
+            raise ValueError(resp["error"])
+        return resp["record"]
+
     def get_job_status(self, job_id: str) -> str:
+        if _ft():
+            return self._record(job_id)["status"]
         return ray_tpu.get(self._sup(job_id).get_status.remote())["status"]
 
     def get_job_info(self, job_id: str) -> JobDetails:
+        if _ft():
+            return _details(self._record(job_id))
         d = ray_tpu.get(self._sup(job_id).get_status.remote())
         return JobDetails(job_id=d["job_id"], status=d["status"],
                           entrypoint=d["entrypoint"],
                           returncode=d["returncode"])
 
+    def tail_job_logs(self, job_id: str, follow: bool = False,
+                      timeout: Optional[float] = None) -> Iterator[str]:
+        """Yield chunks of the job's durable log stream in order, across
+        every attempt (and every host an attempt ran on). ``follow``
+        long-polls until the job is terminal AND the stream is drained —
+        it rides the controller's job-log walker, so a supervisor
+        failover mid-stream just rolls onto the next attempt's file."""
+        cursor: Dict[str, Any] = {"i": 0, "offset": 0}
+        deadline = (time.monotonic() + timeout) if timeout else None
+        while True:
+            wait_s = 5.0 if follow else 0.0
+            if deadline is not None:
+                wait_s = min(wait_s, max(0.0, deadline - time.monotonic()))
+            resp = self._request(
+                {"kind": "job_logs", "job_id": job_id, "cursor": cursor,
+                 "wait_s": wait_s}, timeout=wait_s + 30)
+            if resp.get("error"):
+                raise ValueError(resp["error"])
+            if resp.get("data"):
+                yield resp["data"]
+            cursor = resp.get("cursor") or cursor
+            if resp.get("eof"):
+                return
+            if not follow and not resp.get("data"):
+                return
+            if deadline is not None and time.monotonic() >= deadline:
+                return
+
     def get_job_logs(self, job_id: str) -> str:
+        if _ft():
+            out = "".join(self.tail_job_logs(job_id))
+            if out:
+                return out
+            # Attribution not on this deployment (log plane disabled):
+            # fall back to the supervisor's in-memory tail.
+            try:
+                return ray_tpu.get(self._sup(job_id).get_logs.remote())
+            except Exception:
+                return ""
         return ray_tpu.get(self._sup(job_id).get_logs.remote())
 
     def stop_job(self, job_id: str) -> bool:
+        if _ft():
+            resp = self._request({"kind": "job_stop", "job_id": job_id})
+            return bool(resp.get("ok"))
         ray_tpu.get(self._sup(job_id).stop.remote())
         return True
 
     def list_jobs(self) -> List[JobDetails]:
-        from ray_tpu.core import context as ctx
-
-        keys = ctx.get_worker_context().client.request(
+        if _ft():
+            resp = self._request({"kind": "job_list"})
+            return [_details(r) for r in resp.get("jobs") or []]
+        keys = self._request(
             {"kind": "kv_keys", "ns": _KV_NS, "prefix": ""})
         out = []
         for job_id in keys:
@@ -162,9 +544,31 @@ class JobSubmissionClient:
 
     def wait_until_finished(self, job_id: str, timeout: float = 300.0) -> str:
         deadline = time.monotonic() + timeout
+        if _ft():
+            # Long-poll on the job's status sequence — one blocked RPC per
+            # state change instead of a 300ms busy loop of actor calls.
+            after_seq = 0
+            while True:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                wait_s = min(10.0, remaining)
+                resp = self._request(
+                    {"kind": "job_wait", "job_id": job_id,
+                     "after_seq": after_seq, "wait_s": wait_s},
+                    timeout=wait_s + 30)
+                if resp.get("error"):
+                    raise ValueError(resp["error"])
+                after_seq = int(resp.get("seq") or after_seq)
+                st = resp["record"]["status"]
+                if st in TERMINAL_STATES:
+                    return st
+            raise TimeoutError(
+                f"job {job_id} not finished within {timeout}s")
         while time.monotonic() < deadline:
             st = self.get_job_status(job_id)
-            if st in (JobStatus.SUCCEEDED, JobStatus.FAILED, JobStatus.STOPPED):
+            if st in (JobStatus.SUCCEEDED, JobStatus.FAILED,
+                      JobStatus.STOPPED):
                 return st
             time.sleep(0.3)
         raise TimeoutError(f"job {job_id} not finished within {timeout}s")

@@ -174,6 +174,38 @@ def drain_node(node_id: str, reason: str = "manual",
                  "reason": reason, "deadline_s": deadline_s})
 
 
+def list_jobs() -> List[Dict[str, Any]]:
+    """Jobs from the controller's durable job table (reference: `ray list
+    jobs`): id, status, entrypoint, returncode, attempt accounting
+    (``attempt`` counts every launch, ``attempts_used`` only launches
+    that billed the retry budget — preempted/drained attempts are free),
+    placement, and a bounded status history. Terminal jobs keep their
+    real status/entrypoint/returncode; the table itself rides
+    --state-path, so listings survive a controller bounce."""
+    return _req({"kind": "job_list"})["jobs"]
+
+
+def get_job(job_id: str) -> Dict[str, Any]:
+    """One job's record from the durable job table (see list_jobs)."""
+    resp = _req({"kind": "job_status", "job_id": job_id})
+    if resp.get("error"):
+        raise ValueError(resp["error"])
+    return resp["record"]
+
+
+def wait_job(job_id: str, after_seq: int = 0,
+             wait_s: float = 10.0) -> Dict[str, Any]:
+    """Long-poll one job's status cursor (the get_events ``after_seq``
+    shape): returns {"record", "seq"} as soon as the record changed past
+    ``after_seq``, immediately for terminal jobs, else when ``wait_s``
+    expires. Feed ``seq`` back in to follow a job without polling."""
+    resp = _req({"kind": "job_wait", "job_id": job_id,
+                 "after_seq": after_seq, "wait_s": wait_s})
+    if resp.get("error"):
+        raise ValueError(resp["error"])
+    return resp
+
+
 def list_events(severity: Optional[str] = None,
                 kind: Optional[Any] = None,
                 task_id: Optional[str] = None,

@@ -1,0 +1,393 @@
+"""Durable job plane chaos matrix (RTPU_JOBS_FT acceptance).
+
+The failure cases the job table + supervised-attempt protocol exist for:
+SIGKILL of the supervisor's worker mid-job (relaunch with budget billed,
+log stream continuous across the failover), whole-node death (supervisor
+reschedules on another live node), drain_node preemption (the relaunch
+bills ZERO budget — a max_attempts=1 job survives), a controller bounce
+mid-job (table + an in-flight wait_job cursor ride --state-path), retry
+budget exhaustion (JOB_FAILED carries the last attempt's output tail),
+and stop_job escalating through the entrypoint's whole process group.
+"""
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+import ray_tpu
+
+PKG_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _client():
+    from ray_tpu.core import context as ctx
+
+    return ctx.get_worker_context().client
+
+
+def _wait_for(pred, timeout=60.0, interval=0.1, desc="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        v = pred()
+        if v:
+            return v
+        time.sleep(interval)
+    raise TimeoutError(f"timed out waiting for {desc}")
+
+
+def _sup_row(job_id):
+    rows = _client().request({"kind": "list_state", "what": "actors"})
+    for a in rows:
+        if a.get("name") == f"_job:{job_id}":
+            return a
+    return None
+
+
+def _worker_pid(worker_id):
+    rows = _client().request({"kind": "list_state", "what": "workers"})
+    return next(w["pid"] for w in rows if w["worker_id"] == worker_id)
+
+
+def _record(job_id):
+    return _client().request(
+        {"kind": "job_status", "job_id": job_id})["record"]
+
+
+def _events(kind, job_id):
+    evs = _client().request({"kind": "get_events",
+                             "kinds": [kind]})["events"]
+    return [e for e in evs if (e.get("data") or {}).get("job_id") == job_id]
+
+
+def _script(tmp_path, name, body):
+    p = tmp_path / name
+    p.write_text(body)
+    return f"{sys.executable} -u {p}"
+
+
+_ATTEMPT_AWARE = """\
+import os, time
+a = int(os.environ.get("RTPU_JOB_ATTEMPT", "1"))
+print(f"attempt-{a}-start", flush=True)
+n = 60 if a == 1 else 5
+for i in range(n):
+    print(f"line-{a}-{i}", flush=True)
+    time.sleep(0.2)
+print(f"attempt-{a}-done", flush=True)
+"""
+
+
+class _Follower:
+    """Background `rtpu job logs --follow` equivalent: one long-poll
+    stream that must survive the supervisor failover mid-tail."""
+
+    def __init__(self, client, job_id):
+        self.chunks = []
+        self.error = None
+
+        def run():
+            try:
+                for chunk in client.tail_job_logs(job_id, follow=True,
+                                                  timeout=180):
+                    self.chunks.append(chunk)
+            except Exception as e:  # surfaced by .text() assertions
+                self.error = e
+
+        self.thread = threading.Thread(target=run, daemon=True)
+        self.thread.start()
+
+    def text(self):
+        self.thread.join(timeout=60)
+        assert self.error is None, f"follow stream broke: {self.error!r}"
+        return "".join(self.chunks)
+
+
+@pytest.mark.chaos
+def test_supervisor_worker_sigkill_mid_job(tmp_path):
+    """ACCEPTANCE: SIGKILL the worker hosting the supervisor mid-attempt.
+    The controller reschedules the supervisor, the relaunch bills one
+    budget unit, the follow stream stays continuous across the failover,
+    and exactly one JOB_RETRYING fires for the relaunch."""
+    from ray_tpu.jobs import JobSubmissionClient
+
+    ray_tpu.init(num_cpus=4)
+    try:
+        client = JobSubmissionClient()
+        job_id = client.submit_job(
+            entrypoint=_script(tmp_path, "job.py", _ATTEMPT_AWARE))
+        follower = _Follower(client, job_id)
+        row = _wait_for(
+            lambda: (_sup_row(job_id)
+                     if (_sup_row(job_id) or {}).get("worker_id")
+                     and _record(job_id)["status"] == "RUNNING" else None),
+            desc="job running with a linked supervisor")
+        # Let a few attempt-1 lines land in the durable stream first.
+        _wait_for(lambda: "line-1-2" in "".join(follower.chunks),
+                  desc="attempt-1 output tailed")
+        os.kill(_worker_pid(row["worker_id"]), signal.SIGKILL)
+        assert client.wait_until_finished(job_id, timeout=120) \
+            == "SUCCEEDED"
+        rec = _record(job_id)
+        assert rec["attempt"] == 2, rec
+        assert rec["attempts_used"] == 2, rec  # a crash bills budget
+        assert rec["returncode"] == 0
+        text = follower.text()
+        assert "attempt-1-start" in text, "pre-failover tail lost"
+        assert "attempt-2-done" in text, "post-failover tail lost"
+        assert len(_events("JOB_RETRYING", job_id)) == 1
+        assert _events("JOB_SUPERVISOR_DIED", job_id)
+        assert _events("JOB_SUCCEEDED", job_id)
+    finally:
+        ray_tpu.shutdown()
+
+
+@pytest.mark.chaos
+def test_whole_node_death_mid_job(tmp_path):
+    """ACCEPTANCE: kill the supervisor's worker AND its whole node's
+    agent mid-attempt — the supervisor comes back on another live node,
+    the job ends SUCCEEDED, and the follow stream rolls from the dead
+    node's log file onto the replacement attempt's."""
+    from ray_tpu.core.cluster_utils import Cluster
+    from ray_tpu.jobs import JobSubmissionClient
+    from ray_tpu.util.scheduling_strategies import (
+        NodeAffinitySchedulingStrategy,
+    )
+
+    cluster = Cluster(head_resources={"CPU": 2})
+    try:
+        nid = cluster.add_node({"CPU": 2}, remote=True,
+                               host_id="jobhostB")
+        client = JobSubmissionClient()
+        job_id = client.submit_job(
+            entrypoint=_script(tmp_path, "job.py", _ATTEMPT_AWARE),
+            _scheduling_strategy=NodeAffinitySchedulingStrategy(
+                node_id=nid, soft=True))
+        follower = _Follower(client, job_id)
+        row = _wait_for(
+            lambda: (_sup_row(job_id)
+                     if (_sup_row(job_id) or {}).get("node_id") == nid
+                     and _record(job_id)["status"] == "RUNNING" else None),
+            desc="job running on the doomed node")
+        _wait_for(lambda: "line-1-2" in "".join(follower.chunks),
+                  desc="attempt-1 output tailed")
+        victim = _worker_pid(row["worker_id"])
+        os.kill(victim, signal.SIGKILL)
+        cluster.kill_node_agent(0)  # the whole host is gone
+        assert client.wait_until_finished(job_id, timeout=120) \
+            == "SUCCEEDED"
+        rec = _record(job_id)
+        assert rec["attempt"] == 2 and rec["attempts_used"] == 2, rec
+        assert rec["node_id"] != nid, "relaunch must land elsewhere"
+        text = follower.text()
+        assert "attempt-1-start" in text and "attempt-2-done" in text
+        assert len(_events("JOB_RETRYING", job_id)) == 1
+    finally:
+        cluster.shutdown()
+
+
+@pytest.mark.chaos
+def test_drain_preemption_burns_no_budget(tmp_path):
+    """ACCEPTANCE: drain the supervisor's node mid-attempt. The attempt
+    lost to the drain is FREE (PR 4/16 convention) — this job has
+    max_attempts=1 and still ends SUCCEEDED on attempt 2 with only the
+    initial launch billed."""
+    from ray_tpu.core.cluster_utils import Cluster
+    from ray_tpu.jobs import JobSubmissionClient
+    from ray_tpu.util import state as state_api
+    from ray_tpu.util.scheduling_strategies import (
+        NodeAffinitySchedulingStrategy,
+    )
+
+    body = """\
+import os, time
+a = int(os.environ.get("RTPU_JOB_ATTEMPT", "1"))
+print(f"attempt-{a}-start", flush=True)
+time.sleep(45 if a == 1 else 0.2)
+print(f"attempt-{a}-done", flush=True)
+"""
+    cluster = Cluster(head_resources={"CPU": 2})
+    try:
+        nid = cluster.add_node({"CPU": 2})
+        client = JobSubmissionClient()
+        job_id = client.submit_job(
+            entrypoint=_script(tmp_path, "job.py", body),
+            max_attempts=1,
+            _scheduling_strategy=NodeAffinitySchedulingStrategy(
+                node_id=nid, soft=True))
+        _wait_for(
+            lambda: (_sup_row(job_id) or {}).get("node_id") == nid
+            and _record(job_id)["status"] == "RUNNING"
+            and "attempt-1-start" in client.get_job_logs(job_id),
+            desc="attempt 1 running on the doomed node")
+        state_api.drain_node(nid, reason="preemption")
+        assert client.wait_until_finished(job_id, timeout=120) \
+            == "SUCCEEDED"
+        rec = _record(job_id)
+        assert rec["attempt"] == 2, rec
+        assert rec["attempts_used"] == 1, \
+            f"preempted attempt billed budget: {rec}"
+        retries = _events("JOB_RETRYING", job_id)
+        assert len(retries) == 1
+        assert retries[0]["data"].get("preempted") is True
+    finally:
+        cluster.shutdown()
+
+
+@pytest.mark.chaos
+def test_controller_bounce_mid_job(tmp_path):
+    """ACCEPTANCE: SIGKILL the controller mid-job and restart it on the
+    same port with the same --state-path. The job table survives, the
+    in-flight wait_until_finished long-poll rides the client reconnect to
+    a SUCCEEDED verdict, and a pre-bounce wait_job cursor stays valid."""
+    import test_controller_reconnect as tcr
+
+    from ray_tpu.jobs import JobSubmissionClient
+
+    body = """\
+import time
+print("bounce-job-start", flush=True)
+time.sleep(10)
+print("bounce-job-done", flush=True)
+"""
+    port = tcr._free_port()
+    state = str(tmp_path / "state.pkl")
+    head = tcr._start_head(port, state,
+                           log_path=str(tmp_path / "head1.log"))
+    pids = []
+    result = {}
+    try:
+        ray_tpu.init(address=f"127.0.0.1:{port}")
+        client = JobSubmissionClient()
+        job_id = client.submit_job(
+            entrypoint=_script(tmp_path, "job.py", body))
+
+        def waiter():
+            result["status"] = client.wait_until_finished(
+                job_id, timeout=120)
+
+        t = threading.Thread(target=waiter, daemon=True)
+        t.start()
+        # Journal proof: the snapshot carries the RUNNING record with the
+        # child process group before the bounce.
+        tcr._wait_snapshot(
+            state,
+            lambda s: any(r["job_id"] == job_id
+                          and r["status"] == "RUNNING"
+                          and (r.get("exec") or {}).get("pgid")
+                          for r in (s.get("jobs") or {}).get("jobs", [])))
+        pre_seq = _client().request(
+            {"kind": "job_wait", "job_id": job_id, "after_seq": 0,
+             "wait_s": 0})["seq"]
+        pids = tcr._worker_pids(_client())
+        tcr._kill9(head)
+        head = tcr._start_head(port, state,
+                               log_path=str(tmp_path / "head2.log"))
+        t.join(timeout=120)
+        assert result.get("status") == "SUCCEEDED", \
+            f"in-flight wait did not survive the bounce: {result}"
+        # The pre-bounce cursor still addresses the same record stream.
+        resp = _client().request(
+            {"kind": "job_wait", "job_id": job_id,
+             "after_seq": pre_seq, "wait_s": 5})
+        assert resp["record"]["status"] == "SUCCEEDED"
+        assert resp["seq"] > pre_seq
+        listed = {d.job_id: d for d in client.list_jobs()}
+        assert listed[job_id].status == "SUCCEEDED"
+        assert "job.py" in listed[job_id].entrypoint  # no "?" rot
+        assert "bounce-job-done" in client.get_job_logs(job_id)
+    finally:
+        tcr._cleanup(head, pids)
+
+
+@pytest.mark.chaos
+def test_max_attempts_exhaustion_surfaces_tail(tmp_path):
+    """Budget exhaustion: every attempt exits 3 after writing to stderr;
+    the job ends FAILED with the last attempt's output tail inside the
+    JOB_FAILED event, one JOB_RETRYING for the one relaunch, and the
+    real returncode on the record."""
+    from ray_tpu.jobs import JobSubmissionClient
+
+    body = """\
+import os, sys
+a = os.environ.get("RTPU_JOB_ATTEMPT", "?")
+print(f"boom-stdout-{a}", flush=True)
+print(f"boom-stderr-{a}", file=sys.stderr, flush=True)
+sys.exit(3)
+"""
+    ray_tpu.init(num_cpus=4)
+    try:
+        client = JobSubmissionClient()
+        job_id = client.submit_job(
+            entrypoint=_script(tmp_path, "job.py", body),
+            max_attempts=2)
+        assert client.wait_until_finished(job_id, timeout=120) == "FAILED"
+        rec = _record(job_id)
+        assert rec["returncode"] == 3
+        assert rec["attempt"] == 2 and rec["attempts_used"] == 2, rec
+        failed = _events("JOB_FAILED", job_id)
+        assert failed, "JOB_FAILED event missing"
+        tail = failed[-1]["data"].get("tail") or ""
+        assert "boom-stderr-2" in tail, \
+            f"last attempt's stderr tail not surfaced: {tail!r}"
+        assert len(_events("JOB_RETRYING", job_id)) == 1
+        assert len(_events("JOB_ATTEMPT_FAILED", job_id)) == 1
+        # The env contract both attempts saw, through the durable logs.
+        logs = client.get_job_logs(job_id)
+        assert "boom-stdout-1" in logs and "boom-stdout-2" in logs
+    finally:
+        ray_tpu.shutdown()
+
+
+@pytest.mark.chaos
+def test_stop_job_kills_whole_process_group(tmp_path):
+    """stop_job escalation: the entrypoint's shell, its python child,
+    and a detached grandchild all share the job's process group — stop
+    must reap every one of them (the legacy terminate() leaked the
+    grandchildren)."""
+    from ray_tpu.jobs import JobSubmissionClient
+
+    body = """\
+import os, subprocess, time
+child = subprocess.Popen(["sleep", "300"])
+print(f"pids {os.getpid()} {child.pid}", flush=True)
+time.sleep(300)
+"""
+    ray_tpu.init(num_cpus=4)
+    try:
+        client = JobSubmissionClient()
+        job_id = client.submit_job(
+            entrypoint=_script(tmp_path, "job.py", body))
+        logs = _wait_for(
+            lambda: (client.get_job_logs(job_id)
+                     if "pids " in client.get_job_logs(job_id) else None),
+            desc="entrypoint reported its pids")
+        pids = [int(p) for p in
+                logs.split("pids ", 1)[1].split()[:2]]
+        assert client.stop_job(job_id)
+        _wait_for(lambda: _record(job_id)["status"] == "STOPPED",
+                  desc="record went STOPPED")
+
+        def all_dead():
+            for pid in pids:
+                try:
+                    os.kill(pid, 0)
+                    return False
+                except ProcessLookupError:
+                    continue
+                except OSError:
+                    return False
+            return True
+
+        _wait_for(all_dead, timeout=30,
+                  desc="entrypoint process group reaped")
+        assert _events("JOB_STOPPED", job_id)
+        # Stopping a terminal job is a no-op, not an error.
+        assert client.stop_job(job_id)
+    finally:
+        ray_tpu.shutdown()

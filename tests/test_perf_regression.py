@@ -673,6 +673,34 @@ def test_data_ft_disabled_path_overhead(ray_start_regular, monkeypatch):
         f"FT-disabled data pipeline {n/dt:.0f} rows/s below floor"
 
 
+def test_jobs_ft_disabled_path_overhead(ray_start_regular, monkeypatch):
+    """Job-plane FT guard (mirrors the RTPU_DATA_FT guard): with
+    RTPU_JOBS_FT=0 the legacy fail-fast supervisor comes back — spawn in
+    the constructor, in-memory logs, actor-RPC status polls — so a
+    trivial job's end-to-end latency holds a generous floor and the
+    status-poll path stays a cheap actor round-trip."""
+    import sys
+
+    from ray_tpu.jobs import JobSubmissionClient
+
+    monkeypatch.setenv("RTPU_JOBS_FT", "0")
+    client = JobSubmissionClient()
+    t0 = time.perf_counter()
+    job_id = client.submit_job(
+        entrypoint=f"{sys.executable} -c \"print('ok')\"")
+    status = client.wait_until_finished(job_id, timeout=60)
+    dt = time.perf_counter() - t0
+    assert status == "SUCCEEDED"
+    assert dt < 30.0, f"FT-disabled job took {dt:.1f}s end to end"
+    n = 50
+    t0 = time.perf_counter()
+    for _ in range(n):
+        client.get_job_status(job_id)
+    rate = n / (time.perf_counter() - t0)
+    assert rate > 20, \
+        f"FT-disabled status polls {rate:.0f}/s below floor"
+
+
 @pytest.mark.slow
 def test_data_pipeline_healthy_throughput_floor(ray_start_regular):
     """Healthy-path floor with RTPU_DATA_FT on (the default): the full
