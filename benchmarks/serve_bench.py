@@ -1,4 +1,4 @@
-"""Disaggregated serving benchmark -> benchmarks/BENCH_r10.json.
+"""Disaggregated serving benchmark -> benchmarks/BENCH_r13.json.
 
 Drives concurrent STREAMED HTTP requests through the serve proxy into
 the disaggregated LLM plane (serve/disagg.py: prefill pool -> KV handoff
@@ -8,6 +8,15 @@ the disaggregated LLM plane (serve/disagg.py: prefill pool -> KV handoff
   token for cold prompts (prefill pool + handoff) vs prefix-cache hits
   (resident K/V splice) at the SAME bucket length — the headline
   `serve_ttft_hit_speedup` is the acceptance ratio (target >= 5x).
+- serve_hop_*_ms: the trace plane's per-hop dwell baseline — median
+  exclusive time per hop name (proxy ingress, router assign, ingress
+  replica, decode attempt, KV handoff, engine attach, stream) read back
+  from the controller request ledger, plus the attributed fraction
+  (exclusive dwells over end-to-end wall — the waterfall must account
+  for the latency it claims to explain).
+- serve_trace_overhead_pct: traced-vs-untraced A/B on the same live
+  deployment (RTPU_SERVE_TRACE toggled at the ingress, which gates
+  trace identity end to end) — acceptance <= 10%.
 - serve_stream_tokens_per_s + TTFT p50/p99 under a concurrent flood.
 - serve_prefix_cache_hit_rate and serve_handoff_bytes (scraped from the
   Prometheus endpoint's rtpu_serve_handoff_bytes_total).
@@ -19,7 +28,7 @@ Usage:
     python benchmarks/serve_bench.py [--smoke] [--out PATH]
 
 --smoke shrinks request counts ~10x for the slow-tier CI check; the
-committed BENCH_r10.json comes from the full profile on the same 1-CPU
+committed BENCH_r13.json comes from the full profile on the same 1-CPU
 host as PERF.json.
 """
 import argparse
@@ -65,12 +74,15 @@ def _prompt(rng, length):
     return rng.integers(1, CFG.vocab_size - 1, size=length).tolist()
 
 
-def _stream_request(body, timeout=120.0):
+def _stream_request(body, timeout=120.0, request_id=None):
     """POST a streamed generation; returns (tokens, ttft_s, total_s).
     Raises on transport errors or in-band {'error': ...} chunks."""
+    headers = {"Content-Type": "application/json"}
+    if request_id:
+        headers["X-Request-Id"] = request_id
     req = urllib.request.Request(
         f"http://127.0.0.1:{PORT}/llm", data=json.dumps(body).encode(),
-        headers={"Content-Type": "application/json"})
+        headers=headers)
     t0 = time.perf_counter()
     ttft = None
     toks = []
@@ -140,6 +152,29 @@ def _scrape_metric(name):
         return None
 
 
+def _ledger_rows(rids, timeout=30.0):
+    """Fetch the request ledger rows (with waterfalls) for the given
+    request ids, waiting out the replica shippers' 0.5s flush cadence."""
+    from ray_tpu.serve import trace as serve_trace
+    from ray_tpu.util import state as state_api
+
+    rows = {}
+    deadline = time.time() + timeout
+    while time.time() < deadline and len(rows) < len(rids):
+        serve_trace.flush_serve_trace()
+        for rid in rids:
+            if rid in rows:
+                continue
+            try:
+                row = state_api.serve_trace(rid)
+            except KeyError:
+                continue
+            if row.get("status") == "ok" and row.get("waterfall"):
+                rows[rid] = row
+        time.sleep(0.5)
+    return list(rows.values())
+
+
 def _serve_stats():
     ctrl = ray_tpu.get_actor("SERVE_CONTROLLER")
     return ray_tpu.get(ctrl.get_serve_stats.remote(), timeout=10)
@@ -165,10 +200,12 @@ def main():
     ap.add_argument("--smoke", action="store_true",
                     help="~10x smaller request counts (CI slow tier)")
     ap.add_argument("--out", default=os.path.join(
-        os.path.dirname(os.path.abspath(__file__)), "BENCH_r10.json"))
+        os.path.dirname(os.path.abspath(__file__)), "BENCH_r13.json"))
     args = ap.parse_args()
 
     n_ttft = 6 if args.smoke else 20          # cold/hit prompt pairs
+    n_hop = 4 if args.smoke else 12           # traced-waterfall requests
+    n_ab = 10 if args.smoke else 40           # traced/untraced A/B reqs
     n_flood = 60 if args.smoke else 600       # streamed flood requests
     conc = 8 if args.smoke else 32
     conc_auto = 24                             # autoscale-phase clients:
@@ -222,6 +259,75 @@ def main():
             note="prefix-cache hit: resident K/V splice, no prefill")
         rec("serve_ttft_hit_speedup", cold_ms / max(hit_ms, 1e-9), "x",
             bucket_len=256)
+
+        # --------------------------------- per-hop breakdown (trace plane)
+        print(f"per-hop breakdown: {n_hop} traced cold streams ...",
+              flush=True)
+        rids = []
+        for i in range(n_hop):
+            rid = f"bench-hop-{i:03d}"
+            # Fresh tokens per request: the cold path exercises every hop
+            # (prefill pool + KV handoff), not just the resident splice.
+            _stream_request({"tokens": _prompt(rng, prompt_len),
+                             "max_new_tokens": 8}, request_id=rid)
+            rids.append(rid)
+        rows = _ledger_rows(rids)
+        assert len(rows) >= max(1, n_hop // 2), \
+            f"only {len(rows)}/{n_hop} traced requests reached the ledger"
+        hop_self = {}
+        attributed = []
+        for row in rows:
+            wall = max(row["wall_s"], 1e-9)
+            attributed.append(
+                sum(s["self_s"] for s in row["waterfall"]) / wall)
+            for s in row["waterfall"]:
+                hop_self.setdefault(s["name"], []).append(s["self_s"])
+        for hop_name in sorted(hop_self):
+            key = "serve_hop_" + hop_name.replace("serve.", "") \
+                                         .replace(".", "_") + "_ms"
+            rec(key, float(np.median(hop_self[hop_name])) * 1e3, "ms",
+                hop=hop_name, samples=len(hop_self[hop_name]),
+                note="median EXCLUSIVE dwell (self time) per request")
+        rec("serve_trace_attributed_fraction",
+            float(np.median(attributed)), "ratio", requests=len(rows),
+            note="per-hop exclusive dwells over end-to-end wall — the "
+                 "waterfall accounts for this share of measured latency")
+
+        # ------------------------------ traced-vs-untraced A/B (overhead)
+        print(f"trace overhead A/B: {n_ab} streams per arm ...",
+              flush=True)
+
+        def ab_arm():
+            times = []
+            for i in range(n_ab):
+                _, _, tot = _stream_request(
+                    {"tokens": pool_ab[i % len(pool_ab)],
+                     "max_new_tokens": 4})
+                times.append(tot)
+            return float(np.median(times))
+
+        pool_ab = [_prompt(rng, prompt_len) for _ in range(4)]
+        # Untraced FIRST so each arm's prompts are equally cache-warm by
+        # its measured half (warm once up front). The ingress flag gates
+        # trace IDENTITY end to end: with it off no root exists, so no
+        # process allocates or ships a span (the engine's bounded token
+        # ring is governed by the replica's own env and stays on in both
+        # arms — its cost is two deque ops per token, identical here).
+        for p in pool_ab:
+            _stream_request({"tokens": p, "max_new_tokens": 4})
+        os.environ["RTPU_SERVE_TRACE"] = "0"
+        try:
+            off_s = ab_arm()
+        finally:
+            os.environ.pop("RTPU_SERVE_TRACE", None)
+        on_s = ab_arm()
+        overhead_pct = (on_s - off_s) / off_s * 100.0
+        rec("serve_trace_overhead_pct", overhead_pct, "%",
+            traced_ms=round(on_s * 1e3, 3),
+            untraced_ms=round(off_s * 1e3, 3), requests_per_arm=n_ab,
+            note="median streamed-request wall, traced vs "
+                 "RTPU_SERVE_TRACE=0 on the same live deployment "
+                 "(acceptance <= 10%)")
 
         # ----------------------------------------- concurrent stream flood
         print(f"flood: {n_flood} streams, concurrency {conc} ...",

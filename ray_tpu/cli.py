@@ -416,15 +416,36 @@ def _top_frame(window: float = 120.0, spark_points: int = 30) -> str:
         toks = {s["tags"].get("model"): s["points"][-1][1]
                 for s in (q(name="rtpu_serve_decode_tokens_total") or [])
                 if s["points"]}
+        itl = {s["tags"].get("model"): s["points"][-1][1]
+               for s in (q(name="rtpu_serve_itl_s", stat="p99",
+                           window_s=60.0) or []) if s["points"]}
+        # SLO miss rate = misses/s over finished-requests/s (both rate
+        # stats over the same window), per deployment.
+        reqr = {}
+        for s in (q(name="rtpu_serve_requests_total", stat="rate",
+                    window_s=60.0) or []):
+            if s["points"]:
+                dep = s["tags"].get("deployment")
+                reqr[dep] = reqr.get(dep, 0.0) + s["points"][-1][1]
+        missr = {s["tags"].get("deployment"): s["points"][-1][1]
+                 for s in (q(name="rtpu_serve_slo_miss_total",
+                             stat="rate", window_s=60.0) or [])
+                 if s["points"]}
         lines.append("")
         lines.append(f"{'SERVE DEPLOYMENT':22} {'POOL':8} {'REPL':>5} "
                      f"{'DRAIN':>6} {'QUEUE':>6} {'OCC%':>6} "
-                     f"{'TTFT P99':>9} {'TOK/S':>7}")
+                     f"{'TTFT P99':>9} {'ITL P99':>9} {'TOK/S':>7} "
+                     f"{'SLO-MISS%':>9}")
         for dname in sorted(sstats):
             d = sstats[dname]
             base = dname.split("-")[0]
             tv = ttft.get(dname, ttft.get(base))
             kv = toks.get(dname, toks.get(base))
+            iv = itl.get(dname, itl.get(base))
+            rr = reqr.get(dname, reqr.get(base))
+            mr = missr.get(dname, missr.get(base, 0.0))
+            miss_pct = (min(100.0, mr / rr * 100.0)
+                        if rr else (100.0 if mr else None))
             repl = f"{d.get('replicas', 0)}/{d.get('target', 0)}"
             lines.append(
                 f"{dname[:22]:22} {str(d.get('pool', 'main'))[:8]:8} "
@@ -432,7 +453,11 @@ def _top_frame(window: float = 120.0, spark_points: int = 30) -> str:
                 f"{d.get('queue_depth', 0.0):>6.0f} "
                 f"{d.get('occupancy', 0.0) * 100:>6.1f} "
                 + (f"{tv:>8.3f}s" if tv is not None else f"{'-':>9}")
-                + (f" {kv:>7.1f}" if kv is not None else f" {'-':>7}"))
+                + (f" {iv * 1e3:>6.1f}ms" if iv is not None
+                   else f" {'-':>9}")
+                + (f" {kv:>7.1f}" if kv is not None else f" {'-':>7}")
+                + (f" {miss_pct:>9.1f}" if miss_pct is not None
+                   else f" {'-':>9}"))
     # Data plane: per-operator throughput from the streaming executor's
     # live rtpu_data_operator_* families (Dataset.stats() is the
     # per-run report; this is the cluster-wide cumulative view).
@@ -882,6 +907,93 @@ def cmd_serve(args) -> int:
             serve.shutdown()
             print("serve shut down")
             return 0
+        if args.serve_cmd == "requests":
+            from ray_tpu.util import state
+
+            since = (time.time() - args.since_s) if args.since_s else None
+            rows = state.list_serve_requests(
+                model=args.model, status=args.status,
+                min_latency_s=args.min_latency_s, since=since,
+                limit=args.limit)
+            if not rows:
+                print("no matching requests in the ledger")
+                return 0
+            print(f"{'REQUEST':18} {'DEPLOYMENT':16} {'PROTO':6} "
+                  f"{'STATUS':9} {'WALL':>9} {'TOKENS':>6} "
+                  f"{'ITL P99':>9} {'SLO':>4}  ERROR")
+            for r in rows:
+                wall = r.get("wall_s")
+                itl = r.get("itl_p99_s")
+                print(
+                    f"{r['request_id'][:18]:18} "
+                    f"{(r.get('deployment') or '-')[:16]:16} "
+                    f"{(r.get('proto') or '-')[:6]:6} "
+                    f"{(r.get('status') or '?')[:9]:9} "
+                    + (f"{wall * 1e3:>8.1f}m" if wall is not None
+                       else f"{'-':>9}")
+                    + f" {r.get('tokens', '-'):>6}"
+                    + (f" {itl * 1e3:>8.2f}m" if itl is not None
+                       else f" {'-':>9}")
+                    + f" {'MISS' if r.get('slo_miss') else '-':>4}"
+                    + f"  {(r.get('error') or '')[:40]}")
+            return 0
+        if args.serve_cmd == "trace":
+            from ray_tpu.util import state
+
+            row = state.serve_trace(args.request_id)
+            wall = row.get("wall_s")
+            print(f"request {row['request_id']}  "
+                  f"trace {row.get('trace_id') or '?'}")
+            print(f"  deployment={row.get('deployment') or '-'} "
+                  f"proto={row.get('proto') or '-'} "
+                  f"method={row.get('method') or '-'} "
+                  f"status={row.get('status')}"
+                  + (f" wall={wall * 1e3:.1f}ms" if wall is not None
+                     else "")
+                  + (" SLO-MISS" if row.get("slo_miss") else ""))
+            if row.get("tokens") is not None:
+                itl50, itl99 = row.get("itl_p50_s"), row.get("itl_p99_s")
+                print(f"  tokens={row['tokens']}"
+                      + (f" ttft={row['ttft_s'] * 1e3:.1f}ms"
+                         if row.get("ttft_s") is not None else "")
+                      + (f" itl p50/p99={itl50 * 1e3:.2f}/"
+                         f"{itl99 * 1e3:.2f}ms"
+                         if itl50 is not None and itl99 is not None
+                         else "")
+                      + (f" abort={row['abort_cause']}"
+                         if row.get("abort_cause") else ""))
+            if row.get("error"):
+                print(f"  error: {row['error']}")
+            wf = row.get("waterfall") or []
+            if not wf:
+                print("  (no hop spans shipped yet — replicas flush on "
+                      "the task-events cadence)")
+                return 0
+            t0 = min(e["start_ts"] for e in wf if e.get("start_ts"))
+            print()
+            print(f"{'HOP':44} {'START':>9} {'DWELL':>10} {'SELF':>10}"
+                  f"  DETAIL")
+            attributed = 0.0
+            for e in wf:
+                attributed += e["self_s"]
+                a = e.get("attributes") or {}
+                detail = " ".join(
+                    f"{k}={a[k]}" for k in sorted(a)
+                    if k not in ("stack",))[:48]
+                nm = ("  " * e["depth"] + e["name"])[:44]
+                off = ((e["start_ts"] - t0) * 1e3
+                       if e.get("start_ts") else 0.0)
+                print(f"{nm:44} {off:>7.1f}ms "
+                      f"{e['dwell_s'] * 1e3:>8.2f}ms "
+                      f"{e['self_s'] * 1e3:>8.2f}ms  {detail}")
+            line = (f"hop dwell (self) total {attributed * 1e3:.2f}ms")
+            if wall is not None:
+                line += (f" of {wall * 1e3:.2f}ms wall "
+                         f"({attributed / wall * 100:.1f}% attributed)"
+                         if wall > 0 else "")
+            print()
+            print(line)
+            return 0
         raise SystemExit(f"unknown serve subcommand {args.serve_cmd!r}")
     finally:
         if args.serve_cmd != "run":
@@ -1236,6 +1348,28 @@ def main(argv=None) -> int:
         sp = ssub.add_parser(name)
         sp.add_argument("--address", default=None)
         sp.set_defaults(fn=cmd_serve)
+    sq = ssub.add_parser("requests",
+                         help="the cluster request ledger: finished serve "
+                              "requests with status, latency, token stats")
+    sq.add_argument("--address", default=None)
+    sq.add_argument("--model", default=None,
+                    help="filter by deployment-name prefix")
+    sq.add_argument("--status", default=None,
+                    choices=["ok", "error", "shed", "deadline",
+                             "cancelled", "inflight"])
+    sq.add_argument("--min-latency-s", type=float, default=None,
+                    dest="min_latency_s",
+                    help="only requests slower than this many seconds")
+    sq.add_argument("--since-s", type=float, default=None, dest="since_s",
+                    help="only requests that started in the last N seconds")
+    sq.add_argument("--limit", type=int, default=50)
+    sq.set_defaults(fn=cmd_serve)
+    st_ = ssub.add_parser("trace",
+                          help="per-hop waterfall of one request "
+                               "(request id may be a unique prefix)")
+    st_.add_argument("request_id")
+    st_.add_argument("--address", default=None)
+    st_.set_defaults(fn=cmd_serve)
 
     p = sub.add_parser("dashboard", help="serve the web dashboard")
     p.add_argument("--address", default=None)

@@ -526,6 +526,12 @@ class Controller:
         # recorders (util/tracing.py get_cluster_spans backend).
         self.cluster_spans: "collections.deque" = collections.deque(
             maxlen=flags.get("RTPU_SPANS_MAX"))
+        # Serve request ledger (serve/trace.py): request_id -> folded row
+        # of hop spans + the terminal record. Bounded by
+        # RTPU_SERVE_LEDGER_MAX with slow/shed/deadline rows retained
+        # ahead of LRU eviction (slow-request auto-capture).
+        self.serve_ledger: "collections.OrderedDict[str, Dict[str, Any]]" = (
+            collections.OrderedDict())
         # Cluster log index: worker_id -> {node_id, name} of its log file,
         # kept after the worker dies so `rtpu logs --task-id/--worker-id`
         # can route post-mortem fetches to the owning host (bounded).
@@ -4134,6 +4140,114 @@ class Controller:
             spans = [s for s in spans if s.get("trace_id") == trace_id]
         limit = int(msg.get("limit", 10000))
         return spans[-limit:]
+
+    # ------------------------------------------------ serve request ledger
+
+    def _serve_ledger_row(self, request_id: str) -> Dict[str, Any]:
+        """Fetch-or-create one ledger row. Rows created by an early span
+        (record still in flight on another process) start "inflight"."""
+        row = self.serve_ledger.get(request_id)
+        if row is None:
+            row = self.serve_ledger[request_id] = {
+                "request_id": request_id, "trace_id": "",
+                "deployment": "", "method": "", "proto": "",
+                "status": "inflight", "error": "", "start_ts": None,
+                "wall_s": None, "slo_miss": False, "retained": False,
+                "spans": [],
+            }
+            self._serve_ledger_evict()
+        return row
+
+    def _serve_ledger_evict(self) -> None:
+        """LRU with slow-request auto-capture: oldest UNFLAGGED row goes
+        first; retained rows (SLO miss / shed / deadline) are reclaimed
+        only once every unflagged row is gone."""
+        cap = max(16, int(flags.get("RTPU_SERVE_LEDGER_MAX")))
+        while len(self.serve_ledger) > cap:
+            victim = None
+            for rid, row in self.serve_ledger.items():
+                if not row.get("retained"):
+                    victim = rid
+                    break
+            if victim is None:  # every row is retained: evict oldest
+                self.serve_ledger.popitem(last=False)
+            else:
+                self.serve_ledger.pop(victim, None)
+
+    async def _h_serve_request_events(self, conn, msg):
+        """Ingest one shipped batch of serve hop spans + ledger records
+        (serve/trace.py _Shipper). Spans fold into their request's row
+        (bounded per row); serve.stream spans contribute the token stats;
+        the record sets the terminal fields and the retention flag."""
+        for d in msg.get("spans", ()):
+            rid = d.get("request_id")
+            if not rid:
+                continue
+            row = self._serve_ledger_row(rid)
+            if not row["trace_id"]:
+                row["trace_id"] = d.get("trace_id") or ""
+            if len(row["spans"]) < 128:
+                row["spans"].append(d)
+            if d.get("name") == "serve.stream":
+                a = d.get("attributes") or {}
+                for k in ("tokens", "ttft_s", "itl_mean_s", "itl_p50_s",
+                          "itl_p99_s", "itl_max_s", "abort_cause",
+                          "sent"):
+                    if a.get(k) not in (None, ""):
+                        row[k] = a[k]
+        for r in msg.get("records", ()):
+            rid = r.get("request_id")
+            if not rid:
+                continue
+            row = self._serve_ledger_row(rid)
+            row.update({k: r[k] for k in
+                        ("trace_id", "deployment", "method", "proto",
+                         "status", "error", "start_ts", "wall_s",
+                         "slo_miss") if k in r})
+            row["retained"] = bool(
+                r.get("slo_miss")
+                or r.get("status") in ("shed", "deadline"))
+            self.serve_ledger.move_to_end(rid)
+        return {"ok": True}
+
+    async def _h_serve_requests(self, conn, msg):
+        """Query the request ledger (state.list_serve_requests / `rtpu
+        serve requests` / the dashboard page). Filters: ``model``
+        (deployment prefix), ``status``, ``min_latency_s``, ``since``
+        (start_ts lower bound), ``request_id`` (prefix — includes the
+        per-hop spans for the trace waterfall). Newest first."""
+        model = msg.get("model")
+        status = msg.get("status")
+        min_lat = msg.get("min_latency_s")
+        since = msg.get("since")
+        rid_pfx = msg.get("request_id")
+        with_spans = bool(msg.get("with_spans") or rid_pfx)
+        limit = int(msg.get("limit", 100))
+        out = []
+        for row in reversed(self.serve_ledger.values()):
+            if model and not (row.get("deployment") or "").startswith(
+                    model):
+                continue
+            if status and row.get("status") != status:
+                continue
+            if min_lat is not None and (
+                    row.get("wall_s") is None
+                    or row["wall_s"] < float(min_lat)):
+                continue
+            if since is not None and (
+                    row.get("start_ts") is None
+                    or row["start_ts"] < float(since)):
+                continue
+            if rid_pfx and not row["request_id"].startswith(rid_pfx):
+                continue
+            r = dict(row)
+            if not with_spans:
+                r.pop("spans", None)
+                r["n_spans"] = len(row.get("spans") or ())
+            out.append(r)
+            if len(out) >= limit:
+                break
+        return out
 
     # --------------------------------------------------- cluster event log
     # Reference: the cluster-event framework (`ray list cluster-events`,

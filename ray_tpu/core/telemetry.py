@@ -334,6 +334,15 @@ DEFAULT_ALERT_RULES: List[dict] = [
      "message": "compiled-DAG edge writer blocked on ring space >90% of "
                 "wall time for 30s — the consumer stage cannot keep up; "
                 "run `rtpu dag stats` for the attribution"},
+    {"name": "serve_slo_miss_rate_high",
+     "metric": "rtpu_serve_slo_miss_total",
+     "stat": "rate", "op": ">", "threshold": 0.5, "for_s": 15.0,
+     "severity": "WARNING",
+     "message": "serve SLO misses >0.5 req/s for 15s — requests over "
+                "RTPU_SERVE_SLO_MS (or shed / deadline-exceeded); the "
+                "offending rows are retained in the request ledger: "
+                "`rtpu serve requests --status deadline` / "
+                "`rtpu serve trace REQUEST_ID` for the hop breakdown"},
     {"name": "job_flapping", "metric": "rtpu_job_attempts_total",
      "stat": "rate", "op": ">", "threshold": 0.2, "for_s": 30.0,
      "severity": "WARNING",

@@ -26,6 +26,9 @@ Endpoints:
                              callsite bytes + largest objects)
     GET /api/jobs            job list (ray_tpu.jobs)
     GET /api/serve           serve application status (if running)
+    GET /api/serve_requests  per-request ledger (?model, ?status,
+                             ?min_latency_s, ?since; ?request_id= adds
+                             the hop-span waterfall)
     GET /api/timeline        chrome-trace events (open in chrome://tracing)
     GET /api/dags            compiled-DAG registry + channel-meter rollups
                              (stage busy fractions, edge ring stats,
@@ -46,6 +49,8 @@ Endpoints:
     GET /logs                log viewer page (live tail via /api/logs)
     GET /events              event feed page (hang events expose their
                              captured stacks)
+    GET /serve-requests      request ledger page (?request_id= renders
+                             one request's per-hop waterfall)
     GET /healthz             200 ok (dashboard/modules/healthz)
     GET /metrics             proxied controller Prometheus text
 """
@@ -528,6 +533,22 @@ class Dashboard:
                 from ray_tpu.serve.api import status as serve_status
 
                 data = serve_status() or {}  # None = serve not running
+            elif kind == "serve_requests":
+                # The cluster request ledger (?model=, ?status=,
+                # ?min_latency_s=, ?since=, ?request_id= adds the hop
+                # spans — the `rtpu serve requests/trace` backend).
+                q = request.query
+                if q.get("request_id"):
+                    data = state_api.serve_trace(q["request_id"])
+                else:
+                    data = state_api.list_serve_requests(
+                        model=q.get("model"), status=q.get("status"),
+                        min_latency_s=(float(q["min_latency_s"])
+                                       if q.get("min_latency_s")
+                                       else None),
+                        since=(float(q["since"]) if q.get("since")
+                               else None),
+                        limit=int(q.get("limit", 100)))
             elif kind == "timeline":
                 data = state_api.timeline()
             elif kind == "dags":
@@ -722,6 +743,107 @@ class Dashboard:
             + "</body></html>")
         return web.Response(text=body, content_type="text/html")
 
+    async def _serve_requests_page(self, request):
+        """Per-request serving ledger page: newest requests with status /
+        latency / token stats; ?request_id= renders one request's hop
+        waterfall (dwell bars indented by span depth)."""
+        from aiohttp import web
+
+        q = request.query
+        rid = q.get("request_id")
+        style = (
+            "<style>body { font-family: system-ui, sans-serif; "
+            "margin: 1.2rem; color: #1a1a2e; } h1 { font-size: 1.2rem; } "
+            "table { border-collapse: collapse; width: 100%; "
+            "font-size: .85rem; } th, td { text-align: left; "
+            "padding: .3rem .6rem; border-bottom: 1px solid #ddd; } "
+            "th { background: #f4f4f8; } .bar { background: #4a7fd4; "
+            "height: 10px; display: inline-block; }</style>")
+        if rid:
+            try:
+                row = state_api.serve_trace(rid)
+            except Exception as e:
+                return web.Response(
+                    text=f"<p>{html.escape(repr(e))}</p>",
+                    content_type="text/html")
+            wf = row.get("waterfall") or []
+            wall = row.get("wall_s") or max(
+                [e["dwell_s"] for e in wf] or [0]) or 1e-9
+            rows = []
+            for e in wf:
+                a = e.get("attributes") or {}
+                detail = " ".join(f"{k}={a[k]}" for k in sorted(a))
+                pct = min(100.0, e["dwell_s"] / wall * 100.0)
+                rows.append({
+                    "hop": ("&nbsp;" * 2 * e["depth"]
+                            + html.escape(e["name"] or "")),
+                    "dwell": f"{e['dwell_s'] * 1e3:.2f} ms",
+                    "self": f"{e['self_s'] * 1e3:.2f} ms",
+                    "share": f'<span class="bar" '
+                             f'style="width:{pct:.1f}%"></span>',
+                    "detail": html.escape(detail),
+                })
+            table = _table(rows, ["hop", "dwell", "self", "share",
+                                  "detail"], raw={"hop", "share"})
+            hdr = (f"<p>deployment={html.escape(row.get('deployment') or '-')} "
+                   f"proto={html.escape(row.get('proto') or '-')} "
+                   f"status={html.escape(str(row.get('status')))} "
+                   + (f"wall={row['wall_s'] * 1e3:.1f}ms "
+                      if row.get("wall_s") is not None else "")
+                   + ("<b>SLO-MISS</b> " if row.get("slo_miss") else "")
+                   + (f"tokens={row['tokens']} " if row.get("tokens")
+                      is not None else "")
+                   + (f"error={html.escape(row['error'])}"
+                      if row.get("error") else "") + "</p>")
+            body = (
+                "<!doctype html><html><head><title>serve trace</title>"
+                + style + "</head><body>"
+                f"<h1>Request {html.escape(row['request_id'])} "
+                '<small style="color:#888">'
+                '(<a href="/serve-requests">ledger</a>)</small></h1>'
+                + hdr + table + "</body></html>")
+            return web.Response(text=body, content_type="text/html")
+        try:
+            reqs = state_api.list_serve_requests(
+                model=q.get("model"), status=q.get("status"),
+                limit=int(q.get("limit", 100)))
+        except Exception as e:
+            reqs = []
+            err = f"<p>{html.escape(repr(e))}</p>"
+        else:
+            err = ""
+        rows = []
+        for r in reqs:
+            wall = r.get("wall_s")
+            itl = r.get("itl_p99_s")
+            rows.append({
+                "request": f'<a href="/serve-requests?request_id='
+                           f'{html.escape(r["request_id"])}">'
+                           f'{html.escape(r["request_id"][:16])}</a>',
+                "deployment": r.get("deployment") or "-",
+                "proto": r.get("proto") or "-",
+                "status": r.get("status") or "?",
+                "wall": (f"{wall * 1e3:.1f} ms"
+                         if wall is not None else "-"),
+                "tokens": r.get("tokens", "-"),
+                "itl p99": (f"{itl * 1e3:.2f} ms"
+                            if itl is not None else "-"),
+                "slo": "MISS" if r.get("slo_miss") else "",
+                "started": _fmt_ts(r.get("start_ts")),
+                "error": (r.get("error") or "")[:60],
+            })
+        table = _table(rows, ["request", "deployment", "proto", "status",
+                              "wall", "tokens", "itl p99", "slo",
+                              "started", "error"], raw={"request"})
+        body = (
+            "<!doctype html><html><head><title>serve requests</title>"
+            '<meta http-equiv="refresh" content="5">' + style
+            + "</head><body>"
+            '<h1>Serve requests <small style="color:#888">'
+            '(<a href="/">overview</a>; filters: ?model=, ?status=, '
+            "?limit=)</small></h1>" + err + table + "</body></html>")
+        return web.Response(text=body, content_type="text/html")
+
     async def _logs_page(self, request):
         """Log viewer (reference: the dashboard log viewer): lists the
         cluster log index, or — given ?node&name / ?task_id / ?actor_id /
@@ -791,6 +913,7 @@ class Dashboard:
         app.router.add_get("/logs", self._logs_page)
         app.router.add_get("/objects", self._objects_page)
         app.router.add_get("/events", self._events_page)
+        app.router.add_get("/serve-requests", self._serve_requests_page)
         app.router.add_get("/timeline", self._timeline_page)
         app.router.add_get("/api/{kind}", self._api)
         app.router.add_get("/healthz", self._healthz)

@@ -662,6 +662,30 @@ _define("RTPU_SERVE_DRAIN_DEADLINE_S", float, 30.0,
 _define("RTPU_SERVE_SCALE_COOLDOWN_S", float, 5.0,
         "Minimum seconds between two autoscaler actions on the same "
         "deployment, bounding resize churn.")
+_define("RTPU_SERVE_TRACE", bool, True,
+        "Per-request serving trace plane: every hop (proxy, router "
+        "assign, replica, batch seal, engine slot wait, prefill, KV "
+        "handoff, token stream) emits a span on its host's monotonic "
+        "clock, finished requests ship to the controller's request "
+        "ledger (`rtpu serve requests` / `rtpu serve trace ID`), and the "
+        "engine records per-token timelines into rtpu_serve_itl_s. 0 "
+        "reduces the whole plane to one flag check per hop.")
+_define("RTPU_SERVE_STALL_S", float, 30.0,
+        "Stream-stall detector threshold: a live generation slot that "
+        "emits no token for this many seconds raises one STREAM_STALLED "
+        "event (per request) with the replica's all-thread stack capture "
+        "attached. <=0 disables the detector.")
+_define("RTPU_SERVE_LEDGER_MAX", int, 2048,
+        "Controller request-ledger capacity (finished serve request "
+        "records with their spans). Past it, LRU rows evict — except "
+        "SLO-miss / shed / deadline-exceeded rows, which are only "
+        "reclaimed once every unflagged row is gone.")
+_define("RTPU_SERVE_SLO_MS", float, 0.0,
+        "Serving latency SLO in milliseconds: finished requests slower "
+        "than this count into rtpu_serve_slo_miss_total, are retained "
+        "ahead of LRU eviction in the request ledger, and feed the "
+        "serve_slo_miss_rate_high alert rule. <=0 means no latency SLO "
+        "(shed / deadline-exceeded outcomes still count as misses).")
 
 # -- bench -------------------------------------------------------------------
 _define("RTPU_BENCH_TPU_TIMEOUT", int, 1500,

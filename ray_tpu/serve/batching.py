@@ -38,9 +38,18 @@ class _Batcher:
         # The request deadline (serve context, set by the replica around
         # user code) rides along so the seal step can drop expired items.
         from ray_tpu.serve import context as serve_context
+        from ray_tpu.serve import trace
 
+        # Trace plane: the enqueue stamp lets the seal sweep attribute each
+        # item's coalescing-queue dwell to its request's trace.
+        tinfo = None
+        if trace.enabled():
+            tctx = trace.current_trace_ctx()
+            if tctx is not None:
+                tinfo = (tctx, time.monotonic(), time.time())
         slot: "queue.Queue" = queue.Queue(1)
-        self._queue.put((item, slot, serve_context.get_request_deadline()))
+        self._queue.put((item, slot, serve_context.get_request_deadline(),
+                         tinfo))
         result = slot.get()
         if isinstance(result, _Err):
             raise result.exc
@@ -94,6 +103,20 @@ def _batcher_loop(ref: "weakref.ref[_Batcher]") -> None:
                     "request deadline passed while waiting in batch queue")))
             else:
                 live.append(b)
+        # Seal spans: each traced item's dwell between its submit and this
+        # seal (measured on this host's monotonic clock), expired items
+        # flagged — the waterfall's "time lost to coalescing" bar.
+        seal_mono = time.monotonic()
+        for b in batch:
+            t = b[3] if len(b) > 3 else None
+            if t is not None:
+                from ray_tpu.serve import trace
+
+                trace.emit_span(
+                    "serve.batch_seal", trace_ctx=t[0], kind="batch",
+                    dwell_s=seal_mono - t[1], start_ts=t[2],
+                    attributes={"batch_size": len(live),
+                                "expired": b not in live})
         batch = live
         if not batch:
             del fn

@@ -21,7 +21,8 @@ _request_ctx: "contextvars.ContextVar[Optional[dict]]" = (
 def set_request_context(*, deadline_ts: Optional[float] = None,
                         request_id: str = "",
                         start_ts: Optional[float] = None,
-                        queue_wait_s: float = 0.0):
+                        queue_wait_s: float = 0.0,
+                        trace_ctx: Optional[dict] = None):
     """Install the current request's context; returns a reset token.
     ``start_ts`` (epoch seconds) is when the request entered the system —
     stamped once at the outermost hop and inherited by nested handle
@@ -32,12 +33,33 @@ def set_request_context(*, deadline_ts: Optional[float] = None,
     router adds its local dwell before forwarding). Latency accounting
     (TTFT) uses queue_wait_s plus the locally-stamped ``arrival_mono``
     delta — never a cross-host epoch difference, which wall-clock skew
-    between machines would bias (or clamp to zero)."""
-    return _request_ctx.set(
-        {"deadline_ts": deadline_ts, "request_id": request_id,
+    between machines would bias (or clamp to zero).
+
+    ``trace_ctx`` is the serving trace plane's wire context (serve/
+    trace.py): {"traceparent", "request_id", "deployment"}. The
+    traceparent rides this same dict as ``trace_id``/``parent_span_id``,
+    so nested handle calls and the disagg prefill→decode hop inherit one
+    trace_id without threading kwargs through user code."""
+    c = {"deadline_ts": deadline_ts, "request_id": request_id,
          "start_ts": start_ts,
          "queue_wait_s": max(0.0, float(queue_wait_s or 0.0)),
-         "arrival_mono": time.monotonic()})
+         "arrival_mono": time.monotonic()}
+    if trace_ctx:
+        tp = trace_ctx.get("traceparent") or ""
+        try:
+            from ray_tpu.util.tracing import SpanContext
+
+            sc = SpanContext.from_traceparent(tp)
+        except Exception:
+            sc = None
+        if sc is not None:
+            c["trace_id"] = sc.trace_id
+            c["parent_span_id"] = sc.span_id
+        if not c["request_id"]:
+            c["request_id"] = trace_ctx.get("request_id") or ""
+        if trace_ctx.get("deployment"):
+            c["deployment"] = trace_ctx["deployment"]
+    return _request_ctx.set(c)
 
 
 def reset_request_context(token) -> None:
@@ -46,6 +68,12 @@ def reset_request_context(token) -> None:
 
 def get_request_context() -> Optional[dict]:
     return _request_ctx.get()
+
+
+def get_request_id() -> str:
+    """Request id of the active request ("" when none installed)."""
+    c = _request_ctx.get()
+    return (c.get("request_id") or "") if c else ""
 
 
 def get_request_deadline() -> Optional[float]:

@@ -149,10 +149,16 @@ def build_disagg_llm_deployment(cfg, params_factory, *, name: str = "llm",
         def prefill(self, tokens) -> Dict[str, Any]:
             import jax.numpy as jnp
 
+            from ray_tpu.serve import trace
             from ray_tpu.serve.llm_engine import bucket_len
 
             with self._lock:
                 self._inflight += 1
+            # Prefill-execution span on the PREFILL replica's own clock:
+            # the nested handle call carried the trace over, so this
+            # lands in the same waterfall as the decode-side hops.
+            hop = trace.start_hop("serve.prefill", kind="prefill",
+                                  attributes={"model": name})
             try:
                 ids = np.asarray(tokens, np.int32)
                 if ids.ndim != 1 or ids.size == 0:
@@ -165,9 +171,18 @@ def build_disagg_llm_deployment(cfg, params_factory, *, name: str = "llm",
                 logits, k, v = self._prefill(
                     self._params, jnp.asarray(padded),
                     jnp.asarray([len(ids)], jnp.int32))
+                if hop is not None:
+                    hop.attributes.update(prompt_len=len(ids), bucket=S)
                 return {"k": np.asarray(k), "v": np.asarray(v),
                         "length": len(ids), "logits": np.asarray(logits)}
+            except BaseException as e:
+                if hop is not None:
+                    hop.end(error=type(e).__name__)
+                    hop = None
+                raise
             finally:
+                if hop is not None:
+                    hop.end()
                 with self._lock:
                     self._inflight -= 1
 
@@ -230,24 +245,47 @@ def build_disagg_llm_deployment(cfg, params_factory, *, name: str = "llm",
             """(k, v, length, logits) for this prompt: cache hit ->
             resident blob; miss -> prefill pool (streamed handoff pull);
             pool failure -> local prefill fallback."""
+            from ray_tpu.serve import trace
+
             e = self._cache.get(h)
             if e is not None:
                 return e.k, e.v, e.length, e.logits
             blob = None
             if self._prefill_pool is not None:
+                # KV-handoff span: the prefill-pool RPC + result pull —
+                # its dwell IS the transfer time, bytes attached below.
+                hop = trace.start_hop("serve.kv_handoff", kind="handoff",
+                                      attributes={"model": name})
                 try:
                     blob = self._prefill_pool.prefill.remote(
                         [int(t) for t in ids]).result(timeout=timeout)
+                    nbytes = float(blob["k"].nbytes + blob["v"].nbytes
+                                   + blob["logits"].nbytes)
                     _disagg_metrics()["handoff"].inc(
-                        float(blob["k"].nbytes + blob["v"].nbytes
-                              + blob["logits"].nbytes), tags=self._mtags)
+                        nbytes, tags=self._mtags)
+                    if hop is not None:
+                        hop.end(bytes=int(nbytes))
+                        hop = None
                 except Exception as exc:
+                    if hop is not None:
+                        hop.end(error=type(exc).__name__)
+                        hop = None
                     logger.warning(
                         "prefill pool unavailable (%s); falling back to "
                         "local prefill", exc)
                     blob = None
             if blob is None:
-                k, v, length, logits = self._engine.prefill_only(ids)
+                hop = trace.start_hop("serve.prefill", kind="prefill",
+                                      attributes={"model": name,
+                                                  "local": True})
+                try:
+                    k, v, length, logits = self._engine.prefill_only(ids)
+                except BaseException as exc:
+                    if hop is not None:
+                        hop.end(error=type(exc).__name__)
+                    raise
+                if hop is not None:
+                    hop.end()
                 blob = {"k": k, "v": v, "length": length,
                         "logits": logits}
             self._cache.put(h, blob["k"], blob["v"], blob["length"],
@@ -256,6 +294,7 @@ def build_disagg_llm_deployment(cfg, params_factory, *, name: str = "llm",
 
         def __call__(self, request: Dict[str, Any]):
             from ray_tpu.serve import context as serve_context
+            from ray_tpu.serve import trace
 
             try:
                 ids = np.asarray(request["tokens"], np.int32)
@@ -278,24 +317,35 @@ def build_disagg_llm_deployment(cfg, params_factory, *, name: str = "llm",
             # prompt (or serve that prompt's cached K/V and logits here).
             h = prefix_key(ids)
             timeout = serve_context.remaining_s(default=300.0)
-            try:
-                k, v, length, logits = self._obtain_prefill(h, ids,
-                                                            timeout)
-                req = self._engine.attach_prefilled(
-                    k, v, length, logits, max_new_tokens=n,
-                    temperature=temp, eos_id=eos, timeout=timeout,
-                    queue_wait_s=serve_context.elapsed_s())
-            except TimeoutError as e:
-                yield {"error": f"overloaded: {e}"}
-                return
+            # The stream span covers prefill-obtain -> attach -> last
+            # token on THIS replica; its end attaches the engine's token
+            # stats (computed BEFORE abort, which would drop the ring).
+            hop = trace.start_hop(
+                "serve.stream", kind="decode",
+                attributes={"model": name,
+                            "prefix_hit": h in self._cache})
+            req = None
             sent = 0
+            status = "ok"
             try:
+                try:
+                    k, v, length, logits = self._obtain_prefill(h, ids,
+                                                                timeout)
+                    req = self._engine.attach_prefilled(
+                        k, v, length, logits, max_new_tokens=n,
+                        temperature=temp, eos_id=eos, timeout=timeout,
+                        queue_wait_s=serve_context.elapsed_s())
+                except TimeoutError as e:
+                    status = "slot_timeout"
+                    yield {"error": f"overloaded: {e}"}
+                    return
                 while True:
                     if serve_context.expired():
                         from ray_tpu.core.controller import (
                             DeadlineExceededError,
                         )
 
+                        status = "deadline"
                         raise DeadlineExceededError(
                             "request deadline passed mid-stream")
                     toks = self._engine.peek(req)
@@ -304,20 +354,40 @@ def build_disagg_llm_deployment(cfg, params_factory, *, name: str = "llm",
                         sent += 1
                     if self._engine.check_failed() is not None \
                             and not self._engine.is_done(req):
+                        status = "engine_failed"
                         yield {"error": "generation engine failed"}
                         return
                     if self._engine.is_done(req):
                         try:
                             tail = self._engine.pop_result(req)[sent:]
                         except RuntimeError as e:
+                            status = "engine_failed"
                             yield {"error": str(e)}
                             return
                         for tok in tail:
                             yield {"token": tok}
+                            sent += 1
                         return
                     time.sleep(0.005)
+            except BaseException as e:
+                if status == "ok":
+                    status = ("cancelled"
+                              if isinstance(e, GeneratorExit)
+                              else type(e).__name__)
+                raise
             finally:
-                self._engine.abort(req)
+                st = (self._engine.token_stats(req) or {}) \
+                    if req is not None else {}
+                if req is not None:
+                    self._engine.abort(req)
+                if hop is not None:
+                    attrs: Dict[str, Any] = {"sent": sent,
+                                             "status": status}
+                    for k_, v_ in st.items():
+                        if v_ is not None:
+                            attrs[k_] = (round(v_, 6)
+                                         if isinstance(v_, float) else v_)
+                    hop.end(**attrs)
 
         # -------------------------------------------------- prefix plane
 
@@ -378,6 +448,7 @@ def build_disagg_llm_deployment(cfg, params_factory, *, name: str = "llm",
             from ray_tpu.core.controller import DeadlineExceededError
 
             from .admission import BackPressureError
+            from .trace import start_hop
 
             if not isinstance(request, dict) or "tokens" not in request:
                 yield {"error": "expected {'tokens': [...]} request body"}
@@ -399,6 +470,14 @@ def build_disagg_llm_deployment(cfg, params_factory, *, name: str = "llm",
             attempt = 0
             while True:
                 stream = None
+                # One span PER ATTEMPT, all on the ingress replica's
+                # clock and sharing the request's trace_id: when a decode
+                # replica dies mid-stream and its unshipped spans die with
+                # it, the ledger row still links every attempt.
+                attempt_hop = start_hop(
+                    "serve.decode_attempt", kind="ingress",
+                    attributes={"model": name, "attempt": attempt + 1,
+                                "skip": sent})
                 try:
                     stream = self._decode.options(
                         stream=True,
@@ -422,6 +501,10 @@ def build_disagg_llm_deployment(cfg, params_factory, *, name: str = "llm",
                 except (BackPressureError, DeadlineExceededError):
                     raise
                 except Exception as e:
+                    if attempt_hop is not None:
+                        attempt_hop.end(error=type(e).__name__,
+                                        sent=sent)
+                        attempt_hop = None
                     if sent and not greedy:
                         # Sampled streams don't replay: another replica
                         # follows a different trajectory, so skipping
@@ -449,6 +532,8 @@ def build_disagg_llm_deployment(cfg, params_factory, *, name: str = "llm",
                         pass
                     time.sleep(min(0.25 * attempt, 1.0))
                 finally:
+                    if attempt_hop is not None:
+                        attempt_hop.end(sent=sent)
                     if stream is not None:
                         try:
                             stream.close()

@@ -19,6 +19,7 @@ import ray_tpu
 from ray_tpu import flags
 from ray_tpu.core.controller import DeadlineExceededError
 
+from . import trace
 from .admission import BackPressureError
 from .controller import CONTROLLER_NAME
 from .handle import DeploymentHandle
@@ -35,6 +36,16 @@ def _envelope_timeout_s(request) -> float:
     except (TypeError, ValueError):
         pass
     return float(flags.get("RTPU_SERVE_REQUEST_TIMEOUT_S"))
+
+
+def _ingress_request_id(request) -> str:
+    """Ingress stamping (the HTTP proxy's X-Request-Id analog): honor the
+    envelope's request_id when the client sent one, else mint one HERE —
+    ledger rows and cancellation events must never carry an empty id."""
+    rid = request.get("request_id")
+    if isinstance(rid, str) and rid:
+        return rid
+    return trace.new_request_id()
 
 
 def _ser(obj) -> bytes:
@@ -115,47 +126,91 @@ class GRPCProxy:
     def _call(self, request, context):
         import grpc
 
+        rid = _ingress_request_id(request)
+        root = None
         try:
-            handle, _ = self._handle_for(request)
+            context.send_initial_metadata((("x-request-id", rid),))
+        except Exception:
+            pass  # metadata already sent / test doubles without support
+        try:
+            handle, info = self._handle_for(request)
+            root = trace.start_request(request_id=rid,
+                                       deployment=info["name"],
+                                       proto="grpc", method="Call")
             result = handle.options(
-                deadline_s=_envelope_timeout_s(request)).remote(
-                request.get("input")).result()
+                deadline_s=_envelope_timeout_s(request), request_id=rid,
+                trace_ctx=root.trace_ctx if root is not None else None,
+            ).remote(request.get("input")).result()
+            if root is not None:
+                root.finish("ok")
             return {"result": result}
         except BackPressureError as e:
+            if root is not None:
+                root.finish("shed", error=str(e))
             context.set_trailing_metadata(
                 (("retry-after-s", f"{e.retry_after_s:g}"),))
             context.abort(grpc.StatusCode.RESOURCE_EXHAUSTED, str(e))
         except DeadlineExceededError as e:
+            if root is not None:
+                root.finish("deadline", error=str(e))
             context.abort(grpc.StatusCode.DEADLINE_EXCEEDED, str(e))
         except Exception as e:
+            if root is not None:
+                root.finish("error", error=str(e))
             context.abort(grpc.StatusCode.INTERNAL, str(e))
 
     def _call_stream(self, request, context):
         import grpc
 
-        stream = None
+        rid = _ingress_request_id(request)
+        root = None
         try:
-            handle, _ = self._handle_for(request)
+            context.send_initial_metadata((("x-request-id", rid),))
+        except Exception:
+            pass
+        stream = None
+        items = 0
+        try:
+            handle, info = self._handle_for(request)
+            root = trace.start_request(request_id=rid,
+                                       deployment=info["name"],
+                                       proto="grpc", method="CallStream")
             stream = iter(handle.options(
                 stream=True,
-                deadline_s=_envelope_timeout_s(request)).remote(
-                request.get("input")))
+                deadline_s=_envelope_timeout_s(request), request_id=rid,
+                trace_ctx=root.trace_ctx if root is not None else None,
+            ).remote(request.get("input")))
             for item in stream:
                 if not context.is_active():
                     # Client went away mid-stream: stop pulling; the
                     # finally's close() aborts the replica generator and
                     # frees its engine slot now.
+                    if root is not None:
+                        root.finish("cancelled", items=items)
                     return
+                items += 1
                 yield {"item": item}
+            if root is not None:
+                root.finish("ok", items=items)
         except BackPressureError as e:
+            if root is not None:
+                root.finish("shed", error=str(e), items=items)
             context.set_trailing_metadata(
                 (("retry-after-s", f"{e.retry_after_s:g}"),))
             context.abort(grpc.StatusCode.RESOURCE_EXHAUSTED, str(e))
         except DeadlineExceededError as e:
+            if root is not None:
+                root.finish("deadline", error=str(e), items=items)
             context.abort(grpc.StatusCode.DEADLINE_EXCEEDED, str(e))
         except Exception as e:
+            if root is not None:
+                root.finish("error", error=str(e), items=items)
             context.abort(grpc.StatusCode.INTERNAL, str(e))
         finally:
+            if root is not None:
+                # GeneratorExit (client hangup) skips every except arm;
+                # first finish wins, so this is a no-op on normal paths.
+                root.finish("cancelled", items=items)
             close = getattr(stream, "close", None)
             if close is not None:
                 close()

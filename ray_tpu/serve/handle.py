@@ -24,6 +24,7 @@ from ray_tpu.core.controller import (ActorDiedError, DeadlineExceededError,
 
 from . import admission
 from . import context as serve_context
+from . import trace
 from .controller import CONTROLLER_NAME
 
 
@@ -44,12 +45,16 @@ class DeploymentResponse:
     """Future-like result of handle.remote() (reference DeploymentResponse:
     resolves to the result; .result() blocks; ._to_object_ref for chaining)."""
 
-    def __init__(self, ref, router, replica_key, deadline_ts=None):
+    def __init__(self, ref, router, replica_key, deadline_ts=None,
+                 root=None):
         self._ref = ref
         self._router = router
         self._replica_key = replica_key
         self._deadline_ts = deadline_ts
         self._done = False
+        # Trace root when THIS call created the trace (bare driver-side
+        # handle call): the terminal outcome here becomes the ledger record.
+        self._root = root
 
     def result(self, timeout: Optional[float] = None) -> Any:
         if timeout is None and self._deadline_ts is not None:
@@ -63,19 +68,31 @@ class DeploymentResponse:
                 # The request's own budget ran out — that is the client's
                 # deadline, not a replica fault: no breaker strike.
                 admission.deadline_exceeded(self._router.name)
+                if self._root is not None:
+                    self._root.finish("deadline", error=str(e))
                 raise DeadlineExceededError(
                     f"request to {self._router.name} deadline exceeded "
                     f"while awaiting the result") from e
             self._router._note_result(self._replica_key, e)
+            if self._root is not None:
+                self._root.finish("error", error=str(e))
             raise
         except Exception as e:
             e2 = _unwrap(e)
             self._router._note_result(self._replica_key, e2)
+            if self._root is not None:
+                status = ("deadline"
+                          if isinstance(e2, DeadlineExceededError) else
+                          "cancelled"
+                          if isinstance(e2, TaskCancelledError) else "error")
+                self._root.finish(status, error=str(e2))
             if e2 is not e:
                 raise e2 from e
             raise
         else:
             self._router._note_result(self._replica_key, None)
+            if self._root is not None:
+                self._root.finish("ok")
             return out
         finally:
             self._release()
@@ -88,12 +105,19 @@ class DeploymentResponse:
         except Exception:
             pass
         admission.cancelled(self._router.name)
+        if self._root is not None:
+            self._root.finish("cancelled")
         self._release()
 
     def _release(self) -> None:
         if not self._done:
             self._done = True
             self._router._on_done(self._replica_key)
+            if self._root is not None:
+                # Fire-and-forget callers never observe the outcome; close
+                # the ledger record as ok at release (first finish wins, so
+                # an explicit terminal status above is never overwritten).
+                self._root.finish("ok")
 
     def __del__(self):
         # Fire-and-forget callers never call result(); without this the
@@ -113,13 +137,16 @@ class DeploymentStreamingResponse:
     DeploymentResponseGenerator, serve/handle.py). Yields VALUES; the
     underlying transport is the core streaming-generator protocol."""
 
-    def __init__(self, ref_gen, router, replica_key, deadline_ts=None):
+    def __init__(self, ref_gen, router, replica_key, deadline_ts=None,
+                 root=None):
         self._gen = ref_gen
         self._router = router
         self._replica_key = replica_key
         self._deadline_ts = deadline_ts
         self._done = False
         self._exhausted = False
+        self._root = root
+        self._items = 0
 
     def __iter__(self):
         return self
@@ -130,6 +157,8 @@ class DeploymentStreamingResponse:
             # The consumer's budget ran out mid-stream: stop pulling and
             # close the producer (frees its engine slot).
             admission.deadline_exceeded(self._router.name)
+            if self._root is not None:
+                self._root.finish("deadline", items=self._items)
             self._release()
             raise DeadlineExceededError(
                 f"stream from {self._router.name} deadline exceeded")
@@ -138,12 +167,20 @@ class DeploymentStreamingResponse:
         except StopIteration:
             self._exhausted = True
             self._router._note_result(self._replica_key, None)
+            if self._root is not None:
+                self._root.finish("ok", items=self._items)
             self._release()
             raise
         except Exception as e:
-            self._router._note_result(self._replica_key, _unwrap(e))
+            e2 = _unwrap(e)
+            self._router._note_result(self._replica_key, e2)
+            if self._root is not None:
+                self._root.finish(
+                    "deadline" if isinstance(e2, DeadlineExceededError)
+                    else "error", error=str(e2), items=self._items)
             self._release()
             raise
+        self._items += 1
         return ray_tpu.get(ref)
 
     def close(self) -> None:
@@ -152,12 +189,22 @@ class DeploymentStreamingResponse:
         engine request, freeing the KV slot immediately."""
         if not self._done and not self._exhausted:
             admission.cancelled(self._router.name)
+            if self._root is not None:
+                self._root.finish("cancelled", items=self._items)
+        elif self._root is not None:
+            self._root.finish("ok", items=self._items)
         self._release()
 
     def _release(self) -> None:
         if not self._done:
             self._done = True
             self._router._on_done(self._replica_key)
+            if self._root is not None:
+                # Abandoned without an explicit outcome (__del__): a
+                # pre-exhaustion drop is a cancellation. First finish wins.
+                self._root.finish(
+                    "ok" if self._exhausted else "cancelled",
+                    items=self._items)
             close = getattr(self._gen, "close", None)
             if close is not None:
                 # Frees a producer stalled in the backpressure window when
@@ -430,7 +477,58 @@ class Router:
     def assign(self, method_name: str, args, kwargs,
                retries: int = 3, stream: bool = False,
                multiplexed_model_id: str = "",
-               deadline_ts: Optional[float] = None):
+               deadline_ts: Optional[float] = None,
+               request_id: str = "",
+               trace_ctx: Optional[dict] = None):
+        """Route one request. ``trace_ctx`` is the explicit wire trace
+        context from an ingress (HTTP/gRPC proxy); without one, a nested
+        call inherits the enclosing request's trace from the serve
+        context, and a bare driver-side call ROOTS a new trace here (its
+        response wrapper then owns the ledger record)."""
+        root = hop = None
+        if trace.enabled():
+            wire = trace_ctx or trace.current_trace_ctx()
+            if wire is None:
+                root = trace.start_request(
+                    request_id=request_id, deployment=self.name,
+                    proto="python", method=method_name)
+                wire = root.trace_ctx
+            hop = trace.start_hop("serve.assign", kind="router",
+                                  trace_ctx=wire, deployment=self.name)
+            # Downstream spans parent under the CALLER (root / enclosing
+            # replica), not the assign hop: assign ends at dispatch, so
+            # execution dwell nested under it would double-count when the
+            # waterfall attributes exclusive time.
+            trace_ctx = wire
+        else:
+            trace_ctx = None
+        try:
+            resp = self._assign(method_name, args, kwargs, retries, stream,
+                                multiplexed_model_id, deadline_ts,
+                                trace_ctx, hop)
+        except BaseException as e:
+            if hop is not None:
+                hop.end(error=type(e).__name__)
+            if root is not None:
+                status = ("shed"
+                          if isinstance(e, admission.BackPressureError) else
+                          "deadline"
+                          if isinstance(e, DeadlineExceededError) else
+                          "error")
+                root.finish(status, error=str(e))
+            raise
+        if hop is not None:
+            hop.end()
+        if root is not None:
+            resp._root = root
+        return resp
+
+    def _assign(self, method_name: str, args, kwargs,
+                retries: int = 3, stream: bool = False,
+                multiplexed_model_id: str = "",
+                deadline_ts: Optional[float] = None,
+                trace_ctx: Optional[dict] = None,
+                hop=None):
         if deadline_ts is None:
             # Nested composition: a handle call made INSIDE a serve
             # request inherits the enclosing request's budget.
@@ -494,19 +592,23 @@ class Router:
             queue_wait = serve_context.elapsed_s()
             if queue_wait is None:
                 queue_wait = time.monotonic() - assign_mono
+            if hop is not None:
+                hop.attributes.update(attempts=attempt + 1,
+                                      replica=rid[:12],
+                                      queue_wait_s=round(queue_wait, 6))
             try:
                 if stream:
                     ref_gen = replica.handle_request_streaming.options(
                         num_returns="streaming", deadline_s=remaining,
                     ).remote(method_name, args, kwargs,
                              multiplexed_model_id, deadline_ts, start_ts,
-                             queue_wait)
+                             queue_wait, trace_ctx)
                     return DeploymentStreamingResponse(
                         ref_gen, self, rid, deadline_ts)
                 ref = replica.handle_request.options(
                     deadline_s=remaining,
                 ).remote(method_name, args, kwargs, multiplexed_model_id,
-                         deadline_ts, start_ts, queue_wait)
+                         deadline_ts, start_ts, queue_wait, trace_ctx)
                 return DeploymentResponse(ref, self, rid, deadline_ts)
             except Exception as e:  # dead replica: drop + refresh
                 last_err = e
@@ -522,12 +624,16 @@ class Router:
 class DeploymentHandle:
     def __init__(self, deployment_name: str, method_name: str = "__call__",
                  stream: bool = False, multiplexed_model_id: str = "",
-                 deadline_s: Optional[float] = None):
+                 deadline_s: Optional[float] = None,
+                 request_id: str = "",
+                 trace_ctx: Optional[dict] = None):
         self.deployment_name = deployment_name
         self._method_name = method_name
         self._stream = stream
         self._multiplexed_model_id = multiplexed_model_id
         self._deadline_s = deadline_s
+        self._request_id = request_id
+        self._trace_ctx = trace_ctx
         self._router: Optional[Router] = None
 
     # Routers hold runtime state; rebuild lazily after pickling (handles are
@@ -545,12 +651,20 @@ class DeploymentHandle:
         self._stream = state.get("_stream", False)
         self._multiplexed_model_id = state.get("_multiplexed_model_id", "")
         self._deadline_s = state.get("_deadline_s")
+        self._request_id = ""
+        self._trace_ctx = None
         self._router = None
 
     def options(self, *, method_name: Optional[str] = None,
                 stream: Optional[bool] = None,
                 multiplexed_model_id: Optional[str] = None,
-                deadline_s: Optional[float] = None) -> "DeploymentHandle":
+                deadline_s: Optional[float] = None,
+                request_id: Optional[str] = None,
+                trace_ctx: Optional[dict] = None) -> "DeploymentHandle":
+        """``request_id`` names the trace this call roots (an ingress's
+        stamped id); ``trace_ctx`` hands over an already-rooted trace
+        (the proxies' own root span), making the proxy — not the response
+        wrapper — the owner of the ledger record."""
         h = DeploymentHandle(
             self.deployment_name,
             method_name if method_name is not None else self._method_name,
@@ -558,6 +672,8 @@ class DeploymentHandle:
             (multiplexed_model_id if multiplexed_model_id is not None
              else self._multiplexed_model_id),
             deadline_s if deadline_s is not None else self._deadline_s,
+            request_id if request_id is not None else self._request_id,
+            trace_ctx if trace_ctx is not None else self._trace_ctx,
         )
         h._router = self._ensure_router()
         return h
@@ -591,4 +707,5 @@ class DeploymentHandle:
         return self._ensure_router().assign(
             self._method_name, args, kwargs, stream=self._stream,
             multiplexed_model_id=self._multiplexed_model_id,
-            deadline_ts=deadline_ts)
+            deadline_ts=deadline_ts, request_id=self._request_id,
+            trace_ctx=self._trace_ctx)

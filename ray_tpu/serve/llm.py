@@ -241,7 +241,13 @@ def build_streaming_llm_deployment(cfg, params_factory, *, name: str = "llm-stre
                 import time as _t
 
                 from ray_tpu.serve import context as serve_context
+                from ray_tpu.serve import trace
 
+                # Final stream span: the engine's token stats (counts +
+                # ITL percentiles + abort cause) attach at end, computed
+                # BEFORE abort() drops the timeline ring.
+                hop = trace.start_hop("serve.stream", kind="decode",
+                                      attributes={"model": name})
                 try:
                     # The slot wait is bounded by the request's remaining
                     # deadline budget (serve context) when one is set.
@@ -256,9 +262,16 @@ def build_streaming_llm_deployment(cfg, params_factory, *, name: str = "llm-stre
                 except TimeoutError as e:
                     # Backpressure uses the same error-chunk contract as
                     # malformed requests — not a raw stream exception.
+                    if hop is not None:
+                        hop.end(status="slot_timeout")
                     yield {"error": f"overloaded: {e}"}
                     return
+                except BaseException as e:
+                    if hop is not None:
+                        hop.end(error=type(e).__name__)
+                    raise
                 sent = 0
+                status = "ok"
                 try:
                     while True:
                         if serve_context.expired():
@@ -268,6 +281,7 @@ def build_streaming_llm_deployment(cfg, params_factory, *, name: str = "llm-stre
                                 DeadlineExceededError,
                             )
 
+                            status = "deadline"
                             raise DeadlineExceededError(
                                 "request deadline passed mid-stream")
                         toks = self._engine.peek(req)
@@ -276,24 +290,42 @@ def build_streaming_llm_deployment(cfg, params_factory, *, name: str = "llm-stre
                             sent += 1
                         if self._engine.check_failed() is not None \
                                 and not self._engine.is_done(req):
+                            status = "engine_failed"
                             yield {"error": "generation engine failed"}
                             return
                         if self._engine.is_done(req):
                             try:
                                 tail = self._engine.pop_result(req)[sent:]
                             except RuntimeError as e:
+                                status = "engine_failed"
                                 yield {"error": str(e)}
                                 return
                             for tok in tail:
                                 yield {"token": tok}
+                                sent += 1
                             return
                         _t.sleep(0.005)
+                except BaseException as e:
+                    if status == "ok":
+                        status = ("cancelled"
+                                  if isinstance(e, GeneratorExit)
+                                  else type(e).__name__)
+                    raise
                 finally:
                     # Client disconnect (GeneratorExit) or deadline closes
                     # this generator mid-loop: abort frees the KV slot
                     # between engine steps, not at some later tick. After
                     # a normal pop_result this is a no-op.
+                    st = self._engine.token_stats(req) or {}
                     self._engine.abort(req)
+                    if hop is not None:
+                        attrs = {"sent": sent, "status": status}
+                        for k_, v_ in st.items():
+                            if v_ is not None:
+                                attrs[k_] = (round(v_, 6)
+                                             if isinstance(v_, float)
+                                             else v_)
+                        hop.end(**attrs)
             logits, cache = self._prefill(self._params, ids[None])
             for i in range(n):
                 if temp > 0:

@@ -22,6 +22,7 @@ compiled program.
 """
 from __future__ import annotations
 
+import collections
 import threading
 import time
 from typing import Any, Dict, List, Optional
@@ -60,8 +61,23 @@ def _serve_metrics():
                 description="Continuous-batching slots currently "
                             "generating",
                 tag_keys=("model",)),
+            "itl": Histogram(
+                "rtpu_serve_itl_s",
+                description="Serve inter-token latency: gap between "
+                            "consecutive sampled tokens of one stream "
+                            "(per decode tick, engine-side)",
+                boundaries=[0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+                            0.5, 1.0, 5.0],
+                tag_keys=("model",)),
         }
     return _serve_metrics_cache
+
+
+# Per-request token-timestamp ring capacity and the bounded finished-stats
+# map: enough stamps to characterize ITL tails without unbounded growth on
+# very long generations.
+_TOKEN_RING = 2048
+_DONE_STATS_MAX = 1024
 
 
 def bucket_len(n: int, max_len: int, floor: int = 8) -> int:
@@ -124,6 +140,16 @@ class ContinuousBatchingEngine:
         self.failed: Optional[BaseException] = None
         self._free = list(range(self.B))
         self._free_cv = threading.Condition(self.lock)
+        # Token timeline (trace plane): per-live-request monotonic token
+        # stamps in a bounded ring + per-request TTFT; finished requests
+        # fold into a bounded summary map so the final span / ledger row
+        # can carry token stats after slot recycling. _stall_flagged makes
+        # the stream-stall event exactly-once per request.
+        self._token_times: Dict[int, Any] = {}
+        self._ttft_vals: Dict[int, float] = {}
+        self._token_stats_done: "collections.OrderedDict[int, Dict]" = \
+            collections.OrderedDict()
+        self._stall_flagged: set = set()
         # Submitters blocked waiting for a slot: the queue-depth signal the
         # serve autoscaler scales decode pools on.
         self._waiting = 0
@@ -192,9 +218,22 @@ class ContinuousBatchingEngine:
         padded = np.zeros((1, S), np.int32)
         padded[0, :len(ids)] = ids
         # Prefill OUTSIDE the engine lock (seconds on first compile).
-        logits1, k1, v1 = self._prefill_one(
-            self.params, jnp.asarray(padded),
-            jnp.asarray([len(ids)], jnp.int32))
+        from . import trace as serve_trace
+
+        hop = serve_trace.start_hop(
+            "serve.prefill", kind="prefill",
+            attributes={"model": self.model, "prompt_len": len(ids),
+                        "bucket": S, "local": True})
+        try:
+            logits1, k1, v1 = self._prefill_one(
+                self.params, jnp.asarray(padded),
+                jnp.asarray([len(ids)], jnp.int32))
+        except BaseException as e:
+            if hop is not None:
+                hop.end(error=type(e).__name__)
+            raise
+        if hop is not None:
+            hop.end()
         # Pad the slot K/V out to the engine max_len on the host once.
         pad = self.max_len - S
         if pad:
@@ -252,7 +291,37 @@ class ContinuousBatchingEngine:
         """Shared slot-wait + splice tail of submit()/attach_prefilled():
         k1/v1 are already padded to max_len, logits1 is the host [V] row.
         ``mono0`` is the caller's entry stamp so prefill time counts
-        toward TTFT; ``queue_wait_s``/``arrival_ts`` as in submit()."""
+        toward TTFT; ``queue_wait_s``/``arrival_ts`` as in submit().
+
+        Trace plane: the engine-attach hop covers this host's slot wait +
+        splice (the "engine slot wait" bar of the waterfall); it rides the
+        caller thread's serve context, so it nests under the replica span
+        automatically."""
+        from . import trace as serve_trace
+
+        hop = serve_trace.start_hop(
+            "serve.engine_attach", kind="engine",
+            attributes={"model": self.model})
+        try:
+            req = self._attach_locked(
+                k1, v1, length, logits1, max_new_tokens=max_new_tokens,
+                temperature=temperature, eos_id=eos_id, timeout=timeout,
+                arrival_ts=arrival_ts, queue_wait_s=queue_wait_s,
+                mono0=mono0, hop=hop)
+        except BaseException as e:
+            if hop is not None:
+                hop.end(error=type(e).__name__)
+            raise
+        if hop is not None:
+            hop.end()
+        return req
+
+    def _attach_locked(self, k1, v1, length: int, logits1: np.ndarray, *,
+                       max_new_tokens: Optional[int], temperature: float,
+                       eos_id: Optional[int], timeout: Optional[float],
+                       arrival_ts: Optional[float],
+                       queue_wait_s: Optional[float] = None,
+                       mono0: Optional[float] = None, hop=None) -> int:
         jnp = self._jnp
         if mono0 is None:
             mono0 = time.monotonic()
@@ -302,6 +371,20 @@ class ContinuousBatchingEngine:
                 ttft = local_wait
             m["ttft"].observe(ttft, tags=self._mtags)
             m["tokens"].inc(1.0, tags=self._mtags)
+            # Token timeline: stamp the first token on this host's
+            # monotonic clock; tick() appends one stamp per decode token.
+            # Gated on the trace flag so RTPU_SERVE_TRACE=0 keeps the
+            # timeline/ITL/stall plane to a single flag check.
+            from . import trace as serve_trace
+
+            if serve_trace.enabled():
+                self._token_times[req] = collections.deque(
+                    [time.monotonic()], maxlen=_TOKEN_RING)
+                self._ttft_vals[req] = float(ttft)
+            if hop is not None:
+                hop.attributes.update(
+                    slot=slot, ttft_s=round(float(ttft), 6),
+                    slot_wait_s=round(local_wait, 6))
             n = min(max_new_tokens or self.max_new, self.max_new)
             self.active[slot] = True
             self.budget[slot] = n - 1
@@ -361,10 +444,39 @@ class ContinuousBatchingEngine:
         return int(jax.random.categorical(
             key, self._jnp.asarray(logits) / max(temperature, 1e-6)))
 
+    def _summarize_locked(self, req: int, *, cause: str = "") -> None:
+        """Fold a request's token ring into the bounded finished-stats
+        map (called at retirement, under the engine lock) so the final
+        stream span / ledger row can read token counts + ITL percentiles
+        after the slot recycles."""
+        dq = self._token_times.pop(req, None)
+        ttft = self._ttft_vals.pop(req, None)
+        self._stall_flagged.discard(req)
+        if dq is None:
+            return
+        stamps = list(dq)
+        itls = [b - a for a, b in zip(stamps, stamps[1:])]
+        slot = self._req_slot.get(req)
+        tokens = len(self.out[slot]) if slot is not None else len(stamps)
+        st: Dict[str, Any] = {"tokens": tokens, "ttft_s": ttft,
+                              "abort_cause": cause}
+        if itls:
+            srt = sorted(itls)
+            st.update(
+                itl_mean_s=sum(itls) / len(itls),
+                itl_p50_s=srt[len(srt) // 2],
+                itl_p99_s=srt[min(len(srt) - 1, int(len(srt) * 0.99))],
+                itl_max_s=srt[-1])
+        self._token_stats_done[req] = st
+        while len(self._token_stats_done) > _DONE_STATS_MAX:
+            self._token_stats_done.popitem(last=False)
+
     def _retire_locked(self, slot: int) -> None:
         self.active[slot] = False
         req = self.slot_req[slot]
         if req is not None:
+            self._summarize_locked(
+                req, cause="discarded" if req in self._discarded else "")
             if req in self._discarded:
                 # Consumer went away mid-stream: drop the output instead
                 # of storing it for a reader that will never come.
@@ -404,6 +516,9 @@ class ContinuousBatchingEngine:
         with self.lock:
             slot = self._req_slot.get(req)
             if slot is not None:
+                # Summarize FIRST with the abort cause: _retire_locked's
+                # own summarize is then a no-op (ring already folded).
+                self._summarize_locked(req, cause="aborted")
                 self._discarded.add(req)
                 self._retire_locked(slot)
                 _serve_metrics()["slots"].set(
@@ -440,11 +555,19 @@ class ContinuousBatchingEngine:
             self.cache = cache
             self.cur_tok = nxt
             emitted = 0
+            now_m = time.monotonic()
+            m_itl = _serve_metrics()["itl"]
             for s in range(self.B):
                 if not self.active[s]:
                     continue
                 tok = int(nxt_host[s])
                 self.out[s].append(tok)
+                # Token timeline: one monotonic stamp per emitted token
+                # feeds the ITL histogram and the stream-stall detector.
+                dq = self._token_times.get(self.slot_req[s])
+                if dq is not None:
+                    m_itl.observe(now_m - dq[-1], tags=self._mtags)
+                    dq.append(now_m)
                 emitted += 1
                 self.budget[s] -= 1
                 if self.budget[s] <= 0 or (self.eos[s] is not None
@@ -490,7 +613,14 @@ class ContinuousBatchingEngine:
         return self.failed
 
     def peek(self, req: int) -> List[int]:
-        """Tokens emitted so far (streaming consumers poll this)."""
+        """Tokens emitted so far (streaming consumers poll this).
+
+        The stream-stall detector lives here rather than in the ticker:
+        a hung tick thread (the main way a stream stalls) can't run its
+        own watchdog, but the consumer polling peek() is alive by
+        definition — it notices the silence and fires the exactly-once
+        STREAM_STALLED event with a stack capture of every thread."""
+        stalled_age = None
         with self.lock:
             done = self._results.get(req)
             if done is not None:
@@ -498,7 +628,81 @@ class ContinuousBatchingEngine:
             slot = self._req_slot.get(req)
             if slot is None:
                 raise KeyError(f"unknown request {req}")
-            return list(self.out[slot])
+            out = list(self.out[slot])
+            dq = self._token_times.get(req)
+            if dq is not None and req not in self._stall_flagged:
+                from ray_tpu import flags
+
+                stall_s = float(flags.get("RTPU_SERVE_STALL_S") or 0.0)
+                if stall_s > 0:
+                    age = time.monotonic() - dq[-1]
+                    if age > stall_s:
+                        self._stall_flagged.add(req)
+                        stalled_age = age
+        if stalled_age is not None:
+            self._emit_stall(req, stalled_age)
+        return out
+
+    def _emit_stall(self, req: int, age_s: float) -> None:
+        """Ship the STREAM_STALLED cluster event (outside the engine lock:
+        the stack capture walks every thread's frames)."""
+        from ray_tpu.core import events
+        from . import context as serve_context
+        from . import trace as serve_trace
+
+        rid = serve_context.get_request_id()
+        try:
+            events.emit(
+                "WARNING", "STREAM_STALLED",
+                f"stream {rid or req} on model {self.model} emitted no "
+                f"token for {age_s:.1f}s with a live slot",
+                source="serve",
+                data={"stack": serve_trace.capture_stacks(),
+                      "request_id": rid, "engine_req": req,
+                      "model": self.model, "age_s": round(age_s, 3)})
+        except Exception:
+            pass
+
+    # ------------------------------------------------------- token stats
+
+    def token_stats(self, req: int) -> Optional[Dict[str, Any]]:
+        """Per-request token timeline summary: token count, TTFT, ITL
+        mean/p50/p99/max, abort cause. Live requests get an in-flight
+        summary; finished ones read the bounded done-map (so the final
+        stream span can attach stats AFTER the slot recycled — call this
+        BEFORE abort() on cleanup paths, which records cause=aborted)."""
+        with self.lock:
+            st = self._token_stats_done.get(req)
+            if st is not None:
+                return dict(st)
+            dq = self._token_times.get(req)
+            if dq is None:
+                return None
+            stamps = list(dq)
+            itls = [b - a for a, b in zip(stamps, stamps[1:])]
+            slot = self._req_slot.get(req)
+            out: Dict[str, Any] = {
+                "tokens": len(self.out[slot]) if slot is not None
+                else len(stamps),
+                "ttft_s": self._ttft_vals.get(req), "abort_cause": ""}
+            if itls:
+                srt = sorted(itls)
+                out.update(
+                    itl_mean_s=sum(itls) / len(itls),
+                    itl_p50_s=srt[len(srt) // 2],
+                    itl_p99_s=srt[min(len(srt) - 1,
+                                      int(len(srt) * 0.99))],
+                    itl_max_s=srt[-1])
+            return out
+
+    def last_token_age(self, req: int) -> Optional[float]:
+        """Seconds since the request's newest token (monotonic), None for
+        unknown/finished requests — the stall detector's raw signal."""
+        with self.lock:
+            dq = self._token_times.get(req)
+            if dq is None:
+                return None
+            return time.monotonic() - dq[-1]
 
     # ------------------------------------------------------- driver thread
 

@@ -18,6 +18,7 @@ import ray_tpu
 from ray_tpu import flags
 from ray_tpu.core.controller import DeadlineExceededError
 
+from . import trace
 from .admission import BackPressureError
 from .controller import CONTROLLER_NAME
 from .handle import DeploymentHandle, DeploymentNotFoundError
@@ -97,37 +98,62 @@ class HTTPProxy:
                 arg = body.decode()
         handle = self._handles.setdefault(name, DeploymentHandle(name))
         timeout_s = _request_timeout_s(request)
+        # Ingress stamping: the client's X-Request-Id or a generated one —
+        # every ledger row / cancellation event downstream carries it, and
+        # it echoes back on the response for log correlation. The proxy
+        # owns the trace root, so the record's wall is true end-to-end
+        # (handle dispatch + replica + result/stream relay).
+        rid = request.headers.get("X-Request-Id") or trace.new_request_id()
+        root = trace.start_request(request_id=rid, deployment=name,
+                                   proto="http", method=request.method)
+        tctx = root.trace_ctx if root is not None else None
+        hdrs = {"X-Request-Id": rid}
         if info.get("stream"):
             return await self._handle_streaming(request, handle, name, arg,
-                                                timeout_s)
+                                                timeout_s, rid, root)
         try:
             # The deadline threads end-to-end: router admission, replica
             # dequeue, and batch seal all honor it — result() just waits
             # out the same budget.
             resp = await asyncio.get_running_loop().run_in_executor(
-                None, lambda: handle.options(deadline_s=timeout_s)
+                None, lambda: handle.options(
+                    deadline_s=timeout_s, request_id=rid, trace_ctx=tctx)
                 .remote(arg).result())
         except DeploymentNotFoundError:
             # Deployment was deleted: drop the stale route + handle.
             self._handles.pop(name, None)
             self._refresh_routes()
+            if root is not None:
+                root.finish("error", error="deployment not found")
             return web.json_response(
-                {"error": f"deployment {name} not found"}, status=404)
+                {"error": f"deployment {name} not found"}, status=404,
+                headers=hdrs)
         except BackPressureError as e:
+            if root is not None:
+                root.finish("shed", error=str(e), http_status=503)
             return web.json_response(
                 {"error": str(e)}, status=503,
-                headers={"Retry-After":
-                         f"{max(1, round(e.retry_after_s))}"})
+                headers=dict(hdrs, **{"Retry-After":
+                                      f"{max(1, round(e.retry_after_s))}"}))
         except DeadlineExceededError as e:
-            return web.json_response({"error": str(e)}, status=504)
+            if root is not None:
+                root.finish("deadline", error=str(e), http_status=504)
+            return web.json_response({"error": str(e)}, status=504,
+                                     headers=hdrs)
         except Exception as e:
-            return web.json_response({"error": str(e)}, status=500)
+            if root is not None:
+                root.finish("error", error=str(e), http_status=500)
+            return web.json_response({"error": str(e)}, status=500,
+                                     headers=hdrs)
+        if root is not None:
+            root.finish("ok", http_status=200)
         if isinstance(resp, (dict, list, int, float, bool)) or resp is None:
-            return web.json_response({"result": resp})
-        return web.Response(text=str(resp))
+            return web.json_response({"result": resp}, headers=hdrs)
+        return web.Response(text=str(resp), headers=hdrs)
 
     async def _handle_streaming(self, request, handle, name: str, arg,
-                                timeout_s: Optional[float] = None):
+                                timeout_s: Optional[float] = None,
+                                rid: str = "", root=None):
         """Chunked-transfer response fed by a streaming deployment call
         (reference: serve HTTP streaming responses over the generator
         protocol). Each yielded item becomes one chunk; str/bytes pass
@@ -136,6 +162,8 @@ class HTTPProxy:
         (GeneratorExit) and frees its engine slot immediately."""
         from aiohttp import web
 
+        hdrs = {"X-Request-Id": rid} if rid else {}
+        tctx = root.trace_ctx if root is not None else None
         loop = asyncio.get_running_loop()
         try:
             # assign() does blocking controller/replica RPCs — keep them off
@@ -143,28 +171,45 @@ class HTTPProxy:
             gen = await loop.run_in_executor(
                 self._stream_pool,
                 lambda: iter(handle.options(
-                    stream=True, deadline_s=timeout_s).remote(arg)))
+                    stream=True, deadline_s=timeout_s, request_id=rid,
+                    trace_ctx=tctx).remote(arg)))
         except BackPressureError as e:
+            if root is not None:
+                root.finish("shed", error=str(e), http_status=503)
             return web.json_response(
                 {"error": str(e)}, status=503,
-                headers={"Retry-After":
-                         f"{max(1, round(e.retry_after_s))}"})
+                headers=dict(hdrs, **{"Retry-After":
+                                      f"{max(1, round(e.retry_after_s))}"}))
         except DeadlineExceededError as e:
-            return web.json_response({"error": str(e)}, status=504)
+            if root is not None:
+                root.finish("deadline", error=str(e), http_status=504)
+            return web.json_response({"error": str(e)}, status=504,
+                                     headers=hdrs)
         except Exception as e:
-            return web.json_response({"error": str(e)}, status=500)
-        resp = web.StreamResponse()
+            if root is not None:
+                root.finish("error", error=str(e), http_status=500)
+            return web.json_response({"error": str(e)}, status=500,
+                                     headers=hdrs)
+        resp = web.StreamResponse(headers=hdrs)
         resp.enable_chunked_encoding()
         await resp.prepare(request)
         _END = object()
+        items = 0
+        complete = False
+        failed = deadline = False
         try:
             while True:
                 try:
                     item = await loop.run_in_executor(
                         self._stream_pool, lambda: next(gen, _END))
+                except DeadlineExceededError:
+                    deadline = True
+                    break
                 except Exception:
+                    failed = True
                     break  # mid-stream failure: terminate the chunked body
                 if item is _END:
+                    complete = True
                     break
                 if isinstance(item, bytes):
                     data = item
@@ -173,10 +218,16 @@ class HTTPProxy:
                 else:
                     data = (json.dumps(item) + "\n").encode()
                 await resp.write(data)
+                items += 1
         finally:
             # Reached on normal end AND on client disconnect (aiohttp
             # raises/cancels out of resp.write): cancel the producer so a
             # walked-away client never keeps a KV slot warm.
+            if root is not None:
+                root.finish("ok" if complete
+                            else "deadline" if deadline
+                            else "error" if failed else "cancelled",
+                            items=items)
             close = getattr(gen, "close", None)
             if close is not None:
                 await loop.run_in_executor(self._stream_pool, close)

@@ -47,8 +47,10 @@ class ReplicaActor:
                        multiplexed_model_id: str = "",
                        deadline_ts: Optional[float] = None,
                        start_ts: Optional[float] = None,
-                       queue_wait_s: float = 0.0):
+                       queue_wait_s: float = 0.0,
+                       trace_ctx: Optional[Dict] = None):
         from . import context as serve_context
+        from . import trace
         from .multiplex import _set_model_id
 
         self._check_deadline(deadline_ts, "before replica execution")
@@ -58,16 +60,31 @@ class ReplicaActor:
         token = _set_model_id(multiplexed_model_id)
         ctx_token = serve_context.set_request_context(
             deadline_ts=deadline_ts, start_ts=start_ts,
-            queue_wait_s=queue_wait_s)
+            queue_wait_s=queue_wait_s, trace_ctx=trace_ctx)
+        # The replica hop measures execution on THIS host's clock; the
+        # upstream queue accumulation it rode in on (router dwell + the
+        # mailbox) is attached so the waterfall can attribute the gap
+        # between the router's dispatch and this span's start.
+        hop = trace.start_hop(
+            "serve.replica", kind="replica",
+            attributes={"method": method_name,
+                        "queue_wait_s": round(queue_wait_s or 0.0, 6)})
         try:
             if self._is_function:
                 return self._callable(*args, **kwargs)
             if method_name == "__call__":
                 return self._callable(*args, **kwargs)
             return getattr(self._callable, method_name)(*args, **kwargs)
+        except BaseException as e:
+            if hop is not None:
+                hop.end(error=type(e).__name__)
+                hop = None
+            raise
         finally:
             from .multiplex import _model_id_ctx
 
+            if hop is not None:
+                hop.end()
             serve_context.reset_request_context(ctx_token)
             _model_id_ctx.reset(token)
             with self._lock:
@@ -78,12 +95,14 @@ class ReplicaActor:
                                  multiplexed_model_id: str = "",
                                  deadline_ts: Optional[float] = None,
                                  start_ts: Optional[float] = None,
-                                 queue_wait_s: float = 0.0):
+                                 queue_wait_s: float = 0.0,
+                                 trace_ctx: Optional[Dict] = None):
         """Generator variant: the user handler returns a generator/iterable
         whose items stream to the caller one object at a time (reference:
         serve streaming responses over streaming generator returns,
         serve/_private/replica.py handle_request_streaming)."""
         from . import context as serve_context
+        from . import trace
         from .multiplex import _set_model_id
 
         self._check_deadline(deadline_ts, "before replica execution")
@@ -93,7 +112,15 @@ class ReplicaActor:
         _set_model_id(multiplexed_model_id)
         ctx_token = serve_context.set_request_context(
             deadline_ts=deadline_ts, start_ts=start_ts,
-            queue_wait_s=queue_wait_s)
+            queue_wait_s=queue_wait_s, trace_ctx=trace_ctx)
+        # Covers the stream's whole replica-side life: opened before the
+        # user generator starts, ended when it exhausts or the consumer
+        # walks away (GeneratorExit lands in the finally).
+        hop = trace.start_hop(
+            "serve.replica", kind="replica",
+            attributes={"method": method_name, "stream": True,
+                        "queue_wait_s": round(queue_wait_s or 0.0, 6)})
+        items = 0
         try:
             if self._is_function:
                 result = self._callable(*args, **kwargs)
@@ -102,8 +129,16 @@ class ReplicaActor:
             else:
                 result = getattr(self._callable, method_name)(*args, **kwargs)
             for item in result:
+                items += 1
                 yield item
+        except BaseException as e:
+            if hop is not None:
+                hop.end(error=type(e).__name__, items=items)
+                hop = None
+            raise
         finally:
+            if hop is not None:
+                hop.end(items=items)
             serve_context.reset_request_context(ctx_token)
             with self._lock:
                 self._ongoing -= 1

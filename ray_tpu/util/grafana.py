@@ -85,6 +85,14 @@ def generate_dashboard(prom_text: str,
             elif name.startswith("rtpu_dag_stage_"):
                 exprs = [(f"sum(rate({name}[5m])) by (dag, stage)",
                           "{{dag}}/{{stage}}")]
+            elif name == "rtpu_serve_requests_total":
+                # Terminal-status mix per deployment: a rising shed /
+                # deadline share is the serve overload signal.
+                exprs = [(f"sum(rate({name}[5m])) by (deployment, status)",
+                          "{{deployment}}/{{status}}")]
+            elif name == "rtpu_serve_slo_miss_total":
+                exprs = [(f"sum(rate({name}[5m])) by (deployment)",
+                          "{{deployment}}")]
             else:
                 exprs = [(f"rate({name}[5m])", "{{instance}}")]
             ptitle = f"{name} (rate/s)"
@@ -96,6 +104,15 @@ def generate_dashboard(prom_text: str,
                     (f"histogram_quantile({q}, "
                      f"sum(rate({name}_bucket[5m])) by (le, label))",
                      f"{{{{label}}}} p{int(q * 100)}")
+                    for q in (0.5, 0.99)
+                ]
+            elif name in ("rtpu_serve_itl_s", "rtpu_serve_ttft_s"):
+                # Serving latency histograms are tagged per model —
+                # quantile per model so one panel covers every engine.
+                exprs = [
+                    (f"histogram_quantile({q}, "
+                     f"sum(rate({name}_bucket[5m])) by (le, model))",
+                     f"{{{{model}}}} p{int(q * 100)}")
                     for q in (0.5, 0.99)
                 ]
             else:

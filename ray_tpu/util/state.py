@@ -500,11 +500,102 @@ def timeline(filename: Optional[str] = None) -> Any:
                 "tid": (s.get("worker_id") or "worker")[:12],
             }
         )
+    # Serve request spans (the per-request trace plane) share the same
+    # clock: each hop becomes a complete slice on the "serve" pid, one
+    # row per deployment, so a request's waterfall lines up against the
+    # tasks that ran under it.
+    try:
+        srows = _req({"kind": "serve_requests", "with_spans": True,
+                      "limit": 200})
+    except Exception:
+        srows = []
+    for row in srows:
+        for sp in row.get("spans") or ():
+            try:
+                trace.append({
+                    "name": sp["name"], "cat": "serve", "ph": "X",
+                    "ts": float(sp["start_ts"]) * 1e6,
+                    "dur": max(1.0, float(sp.get("dwell_s") or 0) * 1e6),
+                    "pid": "serve",
+                    "tid": (sp.get("deployment")
+                            or row.get("deployment") or "serve"),
+                    "args": dict(sp.get("attributes") or {},
+                                 request_id=row.get("request_id"),
+                                 trace_id=row.get("trace_id"),
+                                 status=row.get("status")),
+                })
+            except Exception:
+                continue
     if filename is not None:
         with open(filename, "w") as f:
             json.dump(trace, f)
         return filename
     return trace
+
+
+def list_serve_requests(*, model: Optional[str] = None,
+                        status: Optional[str] = None,
+                        min_latency_s: Optional[float] = None,
+                        since: Optional[float] = None,
+                        limit: int = 100) -> List[Dict[str, Any]]:
+    """Finished (and in-flight) serve requests from the controller's
+    request ledger (serve/trace.py), newest first. ``model`` filters by
+    deployment-name prefix; ``status`` by terminal status (ok / error /
+    shed / deadline / cancelled / inflight); ``min_latency_s`` keeps only
+    slower requests; ``since`` is a start_ts lower bound. Rows carry the
+    terminal record + token stats; fetch one request's hop spans with
+    serve_trace()."""
+    return _req({"kind": "serve_requests", "model": model,
+                 "status": status, "min_latency_s": min_latency_s,
+                 "since": since, "limit": limit})
+
+
+def serve_trace(request_id: str) -> Dict[str, Any]:
+    """One request's full trace: the ledger row plus a per-hop
+    ``waterfall`` — spans ordered depth-first with ``depth`` for
+    indentation and ``self_s`` (the span's dwell minus its children's,
+    clamped at zero) so the exclusive times sum to the end-to-end wall.
+    ``request_id`` may be a unique prefix. Raises KeyError when the
+    ledger has no such request."""
+    rows = _req({"kind": "serve_requests", "request_id": request_id,
+                 "limit": 1})
+    if not rows:
+        raise KeyError(f"no serve request {request_id!r} in the ledger")
+    row = dict(rows[0])
+    spans = sorted(row.get("spans") or (),
+                   key=lambda s: s.get("start_ts") or 0)
+    by_id = {s.get("span_id"): s for s in spans}
+    kids: Dict[str, List[Dict[str, Any]]] = {}
+    roots: List[Dict[str, Any]] = []
+    for s in spans:
+        p = s.get("parent_span_id") or ""
+        if p and p in by_id:
+            kids.setdefault(p, []).append(s)
+        else:
+            roots.append(s)
+    waterfall: List[Dict[str, Any]] = []
+
+    def walk(s: Dict[str, Any], depth: int) -> None:
+        ch = kids.get(s.get("span_id"), ())
+        dwell = float(s.get("dwell_s") or 0.0)
+        child_sum = sum(float(c.get("dwell_s") or 0.0) for c in ch)
+        waterfall.append({
+            "name": s.get("name"), "kind": s.get("kind"),
+            "span_id": s.get("span_id"),
+            "parent_span_id": s.get("parent_span_id") or "",
+            "deployment": s.get("deployment") or "",
+            "depth": depth, "start_ts": s.get("start_ts"),
+            "dwell_s": dwell,
+            "self_s": max(0.0, dwell - child_sum),
+            "attributes": dict(s.get("attributes") or {}),
+        })
+        for c in ch:
+            walk(c, depth + 1)
+
+    for s in roots:
+        walk(s, 0)
+    row["waterfall"] = waterfall
+    return row
 
 
 def dag_timeline(filename: Optional[str] = None, *,
